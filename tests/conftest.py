@@ -1,0 +1,36 @@
+"""Test session config: force an 8-device virtual CPU platform.
+
+Mirrors the reference strategy (SURVEY.md §4: multi-node simulated by
+multi-process gloo on CPU): here, multi-chip is simulated by
+``--xla_force_host_platform_device_count=8`` so mesh/sharding/collective tests
+run without TPU hardware. Must run before jax is imported anywhere.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+# the container's sitecustomize force-registers the axon TPU backend and sets
+# jax_platforms="axon,cpu"; tests must run on the virtual 8-device CPU platform
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+NUM_PROCESSES = 2
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+@pytest.fixture(autouse=True)
+def _seed_numpy():
+    np.random.seed(42)
+    yield

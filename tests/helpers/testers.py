@@ -1,0 +1,188 @@
+"""Universal metric test harness.
+
+Parity target: reference ``tests/unittests/helpers/testers.py`` (SURVEY.md §4.1).
+The core invariants checked per metric:
+
+1. per-batch ``forward`` == reference computed on that batch;
+2. ``compute`` after streaming updates == reference on the full concatenated
+   dataset;
+3. the **distributed invariant**: W metric replicas fed disjoint shards, merged
+   via ``merge_state`` (same reduction path as mesh sync), == single-replica
+   result on all data — transitively proving the psum/all_gather path;
+4. pickle round-trip, clone independence, reset semantics.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+NUM_PROCESSES = 2
+NUM_BATCHES = 4
+BATCH_SIZE = 32
+NUM_CLASSES = 5
+EXTRA_DIM = 3
+THRESHOLD = 0.5
+
+
+def _assert_allclose(tm_result: Any, ref_result: Any, atol: float = 1e-6, key: Optional[str] = None) -> None:
+    """Recursively compare metric output against reference."""
+    if isinstance(tm_result, dict):
+        assert isinstance(ref_result, dict), f"expected dict reference, got {type(ref_result)}"
+        for k in tm_result:
+            _assert_allclose(tm_result[k], ref_result[k], atol=atol, key=k)
+        return
+    if isinstance(tm_result, (list, tuple)) and not hasattr(tm_result, "shape"):
+        for t, r in zip(tm_result, ref_result):
+            _assert_allclose(t, r, atol=atol, key=key)
+        return
+    tm_np = np.asarray(tm_result, dtype=np.float64)
+    ref_np = np.asarray(ref_result, dtype=np.float64)
+    assert np.allclose(tm_np, ref_np, atol=atol, equal_nan=True), (
+        f"mismatch{f' for key {key}' if key else ''}: got {tm_np}, expected {ref_np}"
+    )
+
+
+class MetricTester:
+    """Subclass per metric; provides class/functional/distributed test drivers."""
+
+    atol: float = 1e-6
+
+    def run_class_metric_test(
+        self,
+        preds: Sequence,
+        target: Sequence,
+        metric_class: type,
+        reference_metric: Callable,
+        metric_args: Optional[Dict[str, Any]] = None,
+        check_merge: bool = True,
+        check_pickle: bool = True,
+        atol: Optional[float] = None,
+    ) -> None:
+        """Streaming class-API test: forward per batch, compute on all, merge invariant."""
+        atol = atol if atol is not None else self.atol
+        metric_args = metric_args or {}
+        metric = metric_class(**metric_args)
+
+        # flag immutability (reference testers.py:126-129)
+        for flag in ("is_differentiable", "higher_is_better", "full_state_update"):
+            try:
+                setattr(metric, flag, True)
+                raise AssertionError(f"expected RuntimeError when setting {flag}")
+            except RuntimeError:
+                pass
+
+        if check_pickle:
+            metric = pickle.loads(pickle.dumps(metric))
+
+        # clone is independent
+        clone = metric.clone()
+        assert clone is not metric
+
+        num_batches = len(preds)
+        for i in range(num_batches):
+            batch_result = metric(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+            ref = reference_metric(np.asarray(preds[i]), np.asarray(target[i]))
+            _assert_allclose(batch_result, ref, atol=atol)
+
+        result = metric.compute()
+        all_preds = np.concatenate([np.asarray(p) for p in preds])
+        all_target = np.concatenate([np.asarray(t) for t in target])
+        total_ref = reference_metric(all_preds, all_target)
+        _assert_allclose(result, total_ref, atol=atol)
+
+        # repeated compute returns the cached identical value
+        _assert_allclose(metric.compute(), result, atol=0.0)
+
+        if check_merge:
+            self._run_merge_test(preds, target, metric_class, metric_args, result, atol)
+
+        # reset restores defaults
+        metric.reset()
+        assert metric._update_count == 0
+
+    def _run_merge_test(
+        self,
+        preds: Sequence,
+        target: Sequence,
+        metric_class: type,
+        metric_args: Dict[str, Any],
+        expected: Any,
+        atol: float,
+    ) -> None:
+        """Distributed invariant: W replicas on disjoint shards, merged == single replica."""
+        replicas = [metric_class(**metric_args) for _ in range(NUM_PROCESSES)]
+        for i in range(len(preds)):
+            replicas[i % NUM_PROCESSES].update(jnp.asarray(preds[i]), jnp.asarray(target[i]))
+        main = replicas[0]
+        for other in replicas[1:]:
+            main.merge_state(other)
+        _assert_allclose(main.compute(), expected, atol=atol)
+
+    def run_functional_metric_test(
+        self,
+        preds: Sequence,
+        target: Sequence,
+        metric_functional: Callable,
+        reference_metric: Callable,
+        metric_args: Optional[Dict[str, Any]] = None,
+        atol: Optional[float] = None,
+    ) -> None:
+        """Batchwise functional-vs-reference comparison."""
+        atol = atol if atol is not None else self.atol
+        metric_args = metric_args or {}
+        for i in range(len(preds)):
+            result = metric_functional(jnp.asarray(preds[i]), jnp.asarray(target[i]), **metric_args)
+            ref = reference_metric(np.asarray(preds[i]), np.asarray(target[i]))
+            _assert_allclose(result, ref, atol=atol)
+
+
+from torchmetrics_tpu.metric import Metric as _Metric  # noqa: E402
+from torchmetrics_tpu.utilities.data import dim_zero_cat as _dim_zero_cat  # noqa: E402
+
+
+class DummySumMetric(_Metric):
+    """Scalar sum-state dummy (reference ``testers.py:581-655``)."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", jnp.zeros(()), dist_reduce_fx="sum")
+
+    def update(self, x):
+        self.x = self.x + jnp.asarray(x, dtype=jnp.float32)
+
+    def compute(self):
+        return self.x
+
+
+class DummyListMetric(_Metric):
+    """Append-mode cat-state dummy."""
+
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("x", [], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.x.append(jnp.asarray(x, dtype=jnp.float32))
+
+    def compute(self):
+        return _dim_zero_cat(self.x)
+
+
+class DummyMetric:
+    """Factory shims kept for test-code parity."""
+
+    @staticmethod
+    def scalar_sum():
+        return DummySumMetric
+
+    @staticmethod
+    def list_cat():
+        return DummyListMetric

@@ -1,0 +1,247 @@
+"""Core runtime tests (reference ``tests/unittests/bases/test_metric.py``)."""
+
+import pickle
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.helpers.testers import DummyMetric
+from torchmetrics_tpu.metric import CompositionalMetric, Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+DummySum = DummyMetric.scalar_sum()
+DummyList = DummyMetric.list_cat()
+
+
+class TestAddState:
+    def test_tensor_state(self):
+        m = DummySum()
+        assert float(m.x) == 0.0
+        assert m._reductions["x"] == "sum"
+
+    def test_invalid_state(self):
+        m = DummySum()
+        with pytest.raises(ValueError, match="state variable must be a jax array"):
+            m.add_state("bad", 42, "sum")
+        with pytest.raises(ValueError, match="`dist_reduce_fx` must be callable"):
+            m.add_state("bad", jnp.zeros(()), "invalid")
+        with pytest.raises(ValueError, match="valid python attribute name"):
+            m.add_state("not valid", jnp.zeros(()), "sum")
+
+    def test_unexpected_kwarg(self):
+        with pytest.raises(ValueError, match="Unexpected keyword arguments"):
+            DummySum(not_a_real_kwarg=1)
+
+    def test_bad_config_types(self):
+        with pytest.raises(ValueError, match="compute_on_cpu"):
+            DummySum(compute_on_cpu=3)
+        with pytest.raises(ValueError, match="dist_sync_on_step"):
+            DummySum(dist_sync_on_step="yes")
+
+
+class TestUpdateCompute:
+    def test_accumulate(self):
+        m = DummySum()
+        m.update(1.0)
+        m.update(2.0)
+        assert float(m.compute()) == 3.0
+        assert m._update_count == 2
+
+    def test_compute_cache(self):
+        m = DummySum()
+        m.update(1.0)
+        v1 = m.compute()
+        v2 = m.compute()
+        assert v1 is v2  # cached object
+
+    def test_no_cache_option(self):
+        m = DummySum(compute_with_cache=False)
+        m.update(1.0)
+        v1 = m.compute()
+        v2 = m.compute()
+        assert float(v1) == float(v2) == 1.0
+        assert m._computed is None
+
+    def test_forward_returns_batch_value(self):
+        m = DummySum()
+        out1 = m(2.0)
+        out2 = m(3.0)
+        assert float(out1) == 2.0
+        assert float(out2) == 3.0
+        assert float(m.compute()) == 5.0
+
+    def test_reset(self):
+        m = DummySum()
+        m.update(5.0)
+        m.reset()
+        assert float(m.x) == 0.0
+        assert m._update_count == 0
+
+    def test_list_state(self):
+        m = DummyList()
+        m.update(jnp.array([1.0, 2.0]))
+        m.update(jnp.array([3.0]))
+        out = m.compute()
+        np.testing.assert_allclose(np.asarray(out), [1, 2, 3])
+
+    def test_list_state_reset(self):
+        m = DummyList()
+        m.update(jnp.array([1.0]))
+        m.reset()
+        assert m.x == []
+
+    def test_compute_before_update_warns(self):
+        m = DummySum()
+        with pytest.warns(UserWarning, match="before the ``update`` method"):
+            m.compute()
+
+
+class TestMergeState:
+    def test_merge_sum(self):
+        a, b = DummySum(), DummySum()
+        a.update(1.0)
+        b.update(2.0)
+        a.merge_state(b)
+        assert float(a.compute()) == 3.0
+
+    def test_merge_cat(self):
+        a, b = DummyList(), DummyList()
+        a.update(jnp.array([1.0]))
+        b.update(jnp.array([2.0, 3.0]))
+        a.merge_state(b)
+        np.testing.assert_allclose(np.asarray(a.compute()), [1, 2, 3])
+
+    def test_merge_type_mismatch(self):
+        a, b = DummySum(), DummyList()
+        with pytest.raises(TorchMetricsUserError):
+            a.merge_state(b)
+
+
+class TestSerialization:
+    def test_pickle_roundtrip(self):
+        m = DummySum()
+        m.update(4.0)
+        m2 = pickle.loads(pickle.dumps(m))
+        assert float(m2.compute()) == 4.0
+        m2.update(1.0)
+        assert float(m2.compute()) == 5.0
+
+    def test_state_dict_excludes_nonpersistent(self):
+        m = DummySum()
+        assert m.state_dict() == {}
+
+    def test_state_dict_persistent(self):
+        class P(Metric):
+            full_state_update = False
+
+            def __init__(self):
+                super().__init__()
+                self.add_state("x", jnp.zeros(()), "sum", persistent=True)
+
+            def update(self, x):
+                self.x = self.x + x
+
+            def compute(self):
+                return self.x
+
+        m = P()
+        m.update(7.0)
+        sd = m.state_dict()
+        assert float(sd["x"]) == 7.0
+        m2 = P()
+        m2.load_state_dict(sd)
+        m2._update_count = 1
+        assert float(m2.compute()) == 7.0
+
+    def test_persistent_toggle(self):
+        m = DummySum()
+        m.persistent(True)
+        m.update(1.0)
+        assert "x" in m.state_dict()
+
+
+class TestFlags:
+    def test_flag_immutable(self):
+        m = DummySum()
+        for flag in ("is_differentiable", "higher_is_better", "full_state_update"):
+            with pytest.raises(RuntimeError, match="Can't change const"):
+                setattr(m, flag, True)
+
+    def test_hashable(self):
+        m = DummySum()
+        assert isinstance(hash(m), int)
+
+    def test_no_iteration(self):
+        m = DummySum()
+        with pytest.raises(NotImplementedError):
+            iter(m)
+
+
+class TestComposition:
+    def test_add(self):
+        a, b = DummySum(), DummySum()
+        c = a + b
+        assert isinstance(c, CompositionalMetric)
+        c.update(2.0)
+        assert float(c.compute()) == 4.0
+
+    def test_scalar_op(self):
+        a = DummySum()
+        c = a * 2.0
+        c.update(3.0)
+        assert float(c.compute()) == 6.0
+
+    def test_neg(self):
+        a = DummySum()
+        c = -a
+        c.update(3.0)
+        assert float(c.compute()) == -3.0
+
+    def test_getitem(self):
+        m = DummyList()
+        c = m[0]
+        c.update(jnp.array([9.0, 1.0]))
+        assert float(c.compute()) == 9.0
+
+    def test_compositional_reset(self):
+        a = DummySum()
+        c = a + 1.0
+        c.update(1.0)
+        c.reset()
+        assert float(a.x) == 0.0
+
+
+class TestSyncGuards:
+    def test_double_sync_raises(self):
+        m = DummySum(distributed_available_fn=lambda: True, dist_sync_fn=lambda x, group: [x, x])
+        m.update(1.0)
+        m.sync()
+        assert float(m.x) == 2.0  # world of 2 fake replicas summed
+        with pytest.raises(TorchMetricsUserError, match="already been synced"):
+            m.sync()
+        m.unsync()
+        assert float(m.x) == 1.0
+        with pytest.raises(TorchMetricsUserError, match="already been un-synced"):
+            m.unsync()
+
+    def test_sync_context_restores(self):
+        m = DummySum(distributed_available_fn=lambda: True, dist_sync_fn=lambda x, group: [x, x])
+        m.update(1.5)
+        with m.sync_context():
+            assert float(m.x) == 3.0
+        assert float(m.x) == 1.5
+
+    def test_compute_uses_sync(self):
+        m = DummySum(distributed_available_fn=lambda: True, dist_sync_fn=lambda x, group: [x, x])
+        m.update(2.0)
+        assert float(m.compute()) == 4.0
+        # state restored after compute
+        assert float(m.x) == 2.0
+
+    def test_forward_while_synced_raises(self):
+        m = DummySum(distributed_available_fn=lambda: True, dist_sync_fn=lambda x, group: [x, x])
+        m.update(1.0)
+        m.sync()
+        with pytest.raises(TorchMetricsUserError, match="shouldn't be synced"):
+            m(1.0)

@@ -1,0 +1,285 @@
+"""Classification vs sklearn oracles (reference ``tests/unittests/classification/``)."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from sklearn.metrics import (
+    accuracy_score,
+    confusion_matrix as sk_confusion_matrix,
+    f1_score as sk_f1,
+    fbeta_score as sk_fbeta,
+    hamming_loss as sk_hamming,
+    precision_score as sk_precision,
+    recall_score as sk_recall,
+)
+
+from tests.helpers.testers import BATCH_SIZE, NUM_BATCHES, NUM_CLASSES, THRESHOLD, MetricTester
+from torchmetrics_tpu.classification import (
+    Accuracy,
+    BinaryAccuracy,
+    BinaryConfusionMatrix,
+    BinaryF1Score,
+    BinaryFBetaScore,
+    BinaryHammingDistance,
+    BinaryPrecision,
+    BinaryRecall,
+    BinarySpecificity,
+    BinaryStatScores,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassExactMatch,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+    MultilabelAccuracy,
+    MultilabelF1Score,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_accuracy,
+    binary_stat_scores,
+    multiclass_accuracy,
+    multiclass_confusion_matrix,
+    multiclass_f1_score,
+)
+
+seed = np.random.default_rng(42)
+_bin_preds = seed.random((NUM_BATCHES, BATCH_SIZE)).astype(np.float32)
+_bin_target = seed.integers(0, 2, (NUM_BATCHES, BATCH_SIZE))
+_mc_logits = seed.normal(size=(NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+_mc_target = seed.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE))
+_ml_preds = seed.random((NUM_BATCHES, BATCH_SIZE, NUM_CLASSES)).astype(np.float32)
+_ml_target = seed.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, NUM_CLASSES))
+
+
+def _sk_binary(fn):
+    return lambda preds, target: fn(target, preds > THRESHOLD)
+
+
+class TestBinaryAccuracy(MetricTester):
+    def test_class(self):
+        self.run_class_metric_test(_bin_preds, _bin_target, BinaryAccuracy, _sk_binary(accuracy_score))
+
+    def test_functional(self):
+        self.run_functional_metric_test(_bin_preds, _bin_target, binary_accuracy, _sk_binary(accuracy_score))
+
+    def test_task_wrapper(self):
+        m = Accuracy(task="binary")
+        assert isinstance(m, BinaryAccuracy)
+
+
+class TestBinaryStatScores(MetricTester):
+    @staticmethod
+    def _ref(preds, target):
+        p = (preds > THRESHOLD).astype(int)
+        tp = int(((p == 1) & (target == 1)).sum())
+        fp = int(((p == 1) & (target == 0)).sum())
+        tn = int(((p == 0) & (target == 0)).sum())
+        fn = int(((p == 0) & (target == 1)).sum())
+        return np.array([tp, fp, tn, fn, tp + fn])
+
+    def test_class(self):
+        self.run_class_metric_test(_bin_preds, _bin_target, BinaryStatScores, self._ref)
+
+    def test_functional(self):
+        self.run_functional_metric_test(_bin_preds, _bin_target, binary_stat_scores, self._ref)
+
+
+class TestBinaryPrecisionRecall(MetricTester):
+    def test_precision(self):
+        self.run_class_metric_test(_bin_preds, _bin_target, BinaryPrecision, _sk_binary(sk_precision))
+
+    def test_recall(self):
+        self.run_class_metric_test(_bin_preds, _bin_target, BinaryRecall, _sk_binary(sk_recall))
+
+    def test_specificity(self):
+        def _sk_spec(preds, target):
+            p = (preds > THRESHOLD).astype(int)
+            tn = ((p == 0) & (target == 0)).sum()
+            fp = ((p == 1) & (target == 0)).sum()
+            return tn / (tn + fp)
+
+        self.run_class_metric_test(_bin_preds, _bin_target, BinarySpecificity, _sk_spec)
+
+    def test_f1(self):
+        self.run_class_metric_test(_bin_preds, _bin_target, BinaryF1Score, _sk_binary(sk_f1))
+
+    def test_fbeta(self):
+        self.run_class_metric_test(
+            _bin_preds, _bin_target, BinaryFBetaScore,
+            lambda p, t: sk_fbeta(t, p > THRESHOLD, beta=2.0),
+            metric_args={"beta": 2.0},
+        )
+
+    def test_hamming(self):
+        self.run_class_metric_test(_bin_preds, _bin_target, BinaryHammingDistance, _sk_binary(sk_hamming))
+
+
+class TestBinaryConfusionMatrix(MetricTester):
+    def test_class(self):
+        self.run_class_metric_test(
+            _bin_preds, _bin_target, BinaryConfusionMatrix,
+            lambda p, t: sk_confusion_matrix(t, p > THRESHOLD, labels=[0, 1]),
+        )
+
+
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", None])
+class TestMulticlassMetrics(MetricTester):
+    def test_accuracy(self, average):
+        def _ref(preds, target):
+            p = preds.argmax(-1).ravel()
+            t = target.ravel()
+            if average == "micro":
+                return accuracy_score(t, p)
+            recalls = sk_recall(t, p, average=None, labels=range(NUM_CLASSES), zero_division=0)
+            present = np.bincount(t, minlength=NUM_CLASSES) > 0
+            if average == "macro":
+                return recalls[present].mean() if present.any() else 0.0
+            if average == "weighted":
+                w = np.bincount(t, minlength=NUM_CLASSES)
+                return (recalls * w).sum() / w.sum()
+            return recalls
+
+        self.run_class_metric_test(
+            _mc_logits, _mc_target, MulticlassAccuracy, _ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    def test_precision(self, average):
+        def _ref(preds, target):
+            p = preds.argmax(-1).ravel()
+            return sk_precision(target.ravel(), p, average=average, labels=range(NUM_CLASSES), zero_division=0)
+
+        if average == "macro":
+            # sklearn macro keeps absent classes; reference drops classes with no support
+            pytest.skip("macro semantics differ from sklearn for absent classes")
+        self.run_class_metric_test(
+            _mc_logits, _mc_target, MulticlassPrecision, _ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+    def test_f1(self, average):
+        def _ref(preds, target):
+            p = preds.argmax(-1).ravel()
+            return sk_f1(target.ravel(), p, average=average, labels=range(NUM_CLASSES), zero_division=0)
+
+        if average == "macro":
+            pytest.skip("macro semantics differ from sklearn for absent classes")
+        self.run_class_metric_test(
+            _mc_logits, _mc_target, MulticlassF1Score, _ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": average},
+        )
+
+
+class TestMulticlassConfusionMatrix(MetricTester):
+    def test_class(self):
+        self.run_class_metric_test(
+            _mc_logits, _mc_target, MulticlassConfusionMatrix,
+            lambda p, t: sk_confusion_matrix(t, p.argmax(-1), labels=range(NUM_CLASSES)),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_functional(self):
+        self.run_functional_metric_test(
+            _mc_logits, _mc_target, multiclass_confusion_matrix,
+            lambda p, t: sk_confusion_matrix(t, p.argmax(-1), labels=range(NUM_CLASSES)),
+            metric_args={"num_classes": NUM_CLASSES},
+        )
+
+    def test_normalize_true(self):
+        cm = MulticlassConfusionMatrix(num_classes=NUM_CLASSES, normalize="true")
+        cm.update(jnp.asarray(_mc_logits[0]), jnp.asarray(_mc_target[0]))
+        out = np.asarray(cm.compute())
+        np.testing.assert_allclose(out.sum(1), np.ones(NUM_CLASSES), atol=1e-6)
+
+
+class TestMultilabel(MetricTester):
+    def test_accuracy_macro(self):
+        def _ref(preds, target):
+            p = (preds > THRESHOLD).astype(int)
+            accs = [(p[:, i] == target[:, i]).mean() for i in range(NUM_CLASSES)]
+            return np.mean(accs)
+
+        self.run_class_metric_test(
+            _ml_preds, _ml_target, MultilabelAccuracy, _ref,
+            metric_args={"num_labels": NUM_CLASSES, "average": "macro"},
+        )
+
+    def test_f1_micro(self):
+        def _ref(preds, target):
+            return sk_f1(target.ravel(), (preds > THRESHOLD).astype(int).ravel(), zero_division=0)
+
+        self.run_class_metric_test(
+            _ml_preds, _ml_target, MultilabelF1Score, _ref,
+            metric_args={"num_labels": NUM_CLASSES, "average": "micro"},
+        )
+
+
+class TestExactMatch(MetricTester):
+    def test_multiclass(self):
+        mc_preds = seed.integers(0, NUM_CLASSES, (NUM_BATCHES, BATCH_SIZE, 3))
+        mc_tgt = mc_preds.copy()
+        flip = seed.random(mc_tgt.shape) < 0.3
+        mc_tgt = np.where(flip, (mc_tgt + 1) % NUM_CLASSES, mc_tgt)
+
+        def _ref(preds, target):
+            return (preds == target).all(-1).mean()
+
+        self.run_class_metric_test(
+            mc_preds, mc_tgt, MulticlassExactMatch, _ref, metric_args={"num_classes": NUM_CLASSES}
+        )
+
+
+class TestIgnoreIndex(MetricTester):
+    def test_binary_ignore(self):
+        target = _bin_target.copy()
+        target[:, ::4] = -1
+
+        def _ref(preds, t):
+            mask = t != -1
+            return accuracy_score(t[mask], (preds > THRESHOLD)[mask])
+
+        self.run_class_metric_test(
+            _bin_preds, target, BinaryAccuracy, _ref, metric_args={"ignore_index": -1}
+        )
+
+    def test_multiclass_ignore(self):
+        target = _mc_target.copy()
+        target[:, ::5] = -1
+
+        def _ref(preds, t):
+            mask = t != -1
+            return accuracy_score(t[mask], preds.argmax(-1)[mask])
+
+        self.run_class_metric_test(
+            _mc_logits, target, MulticlassAccuracy, _ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": "micro", "ignore_index": -1},
+        )
+
+
+class TestTopK(MetricTester):
+    def test_multiclass_top2_micro(self):
+        def _ref(preds, target):
+            top2 = np.argsort(-preds, -1)[:, :2]
+            hit = (top2 == target[:, None]).any(-1)
+            return hit.mean()
+
+        self.run_class_metric_test(
+            _mc_logits, _mc_target, MulticlassAccuracy, _ref,
+            metric_args={"num_classes": NUM_CLASSES, "average": "micro", "top_k": 2},
+        )
+
+
+class TestSamplewise(MetricTester):
+    def test_binary_samplewise(self):
+        preds3d = seed.random((NUM_BATCHES, BATCH_SIZE, 6)).astype(np.float32)
+        target3d = seed.integers(0, 2, (NUM_BATCHES, BATCH_SIZE, 6))
+
+        def _ref(preds, target):
+            p = (preds > THRESHOLD).astype(int)
+            return (p == target).mean(-1)
+
+        # merge check skipped: samplewise output order depends on shard order
+        self.run_class_metric_test(
+            preds3d, target3d, BinaryAccuracy, _ref,
+            metric_args={"multidim_average": "samplewise"}, check_merge=False,
+        )

@@ -1,0 +1,6 @@
+__version__ = "0.1.0"
+__author__ = "torchmetrics-tpu contributors"
+__license__ = "Apache-2.0"
+__docs__ = "TPU-native (JAX/XLA/Pallas) metrics framework with the TorchMetrics capability surface."
+
+__all__ = ["__version__", "__author__", "__license__", "__docs__"]

@@ -1,0 +1,29 @@
+"""torchmetrics_tpu: a TPU-native (JAX/XLA/Pallas) metrics framework.
+
+Same capability surface as TorchMetrics; designed from scratch for JAX — state
+is immutable array pytrees, distributed sync is XLA collectives over a device
+mesh, heavy kernels are jit-compiled XLA/Pallas.
+"""
+
+import logging as __logging
+
+from torchmetrics_tpu.__about__ import __version__
+from torchmetrics_tpu.metric import CompositionalMetric, Metric
+
+_logger = __logging.getLogger("torchmetrics_tpu")
+_logger.addHandler(__logging.StreamHandler())
+_logger.setLevel(__logging.INFO)
+
+from torchmetrics_tpu import classification, functional, utilities  # noqa: E402
+from torchmetrics_tpu.classification import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.classification import __all__ as _classification_all  # noqa: E402
+
+__all__ = [
+    "CompositionalMetric",
+    "Metric",
+    "classification",
+    "functional",
+    "utilities",
+    "__version__",
+    *_classification_all,
+]

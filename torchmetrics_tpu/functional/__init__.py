@@ -1,0 +1,6 @@
+"""Stateless functional metrics (L2)."""
+
+from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.classification import __all__ as _classification_all
+
+__all__ = list(_classification_all)

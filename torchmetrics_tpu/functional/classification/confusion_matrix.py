@@ -1,0 +1,334 @@
+"""Confusion-matrix kernels (reference ``functional/classification/confusion_matrix.py``).
+
+TPU-first design: the reference fuses indices and runs ``_bincount`` with
+``minlength=C²`` (``confusion_matrix.py:333-336``) — a scatter-add. Here the
+confusion matrix is a **one-hot einsum** ``target_oh.T @ preds_oh``: a single
+(N,C)×(N,C) matmul that XLA tiles straight onto the MXU and that batches/shards
+trivially. Counts are identical.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape, _is_concrete
+from torchmetrics_tpu.utilities.compute import _safe_divide, normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _confusion_matrix_reduce(confmat: Array, normalize: Optional[str] = None) -> Array:
+    """Normalize over true/pred/all (reference ``confusion_matrix.py:26-59``)."""
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+    if normalize is not None and normalize != "none":
+        confmat = confmat.astype(jnp.float32)
+        if normalize == "true":
+            confmat = _safe_divide(confmat, jnp.sum(confmat, axis=-1, keepdims=True))
+        elif normalize == "pred":
+            confmat = _safe_divide(confmat, jnp.sum(confmat, axis=-2, keepdims=True))
+        elif normalize == "all":
+            confmat = _safe_divide(confmat, jnp.sum(confmat, axis=(-2, -1), keepdims=True))
+    return confmat
+
+
+# ---------------------------------------------------------------------------
+# Binary
+# ---------------------------------------------------------------------------
+
+
+def _binary_confusion_matrix_arg_validation(
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    normalize: Optional[str] = None,
+) -> None:
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+
+
+def _binary_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if _is_concrete(target):
+        import numpy as np
+
+        unique = set(np.unique(np.asarray(target)).tolist())
+        allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+        if not unique.issubset(allowed):
+            raise RuntimeError(
+                f"Detected the following values in `target`: {sorted(unique)} but expected only"
+                f" the following values {sorted(allowed)}."
+            )
+
+
+def _binary_confusion_matrix_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.ravel(jnp.asarray(preds))
+    target = jnp.ravel(jnp.asarray(target))
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    valid = jnp.ones(target.shape, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    preds = jnp.where(valid, preds, 0)
+    return preds, target, valid
+
+
+def _confusion_matrix_update(preds: Array, target: Array, valid: Array, num_classes: int) -> Array:
+    """One-hot einsum confusion matrix: rows=true class, cols=pred class."""
+    t_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.float32) * valid[..., None]
+    p_oh = jax.nn.one_hot(preds, num_classes, dtype=jnp.float32)
+    return jnp.einsum("nc,nd->cd", t_oh, p_oh).astype(jnp.int32)
+
+
+def _binary_confusion_matrix_update(preds: Array, target: Array, valid: Array) -> Array:
+    return _confusion_matrix_update(preds, target, valid, 2)
+
+
+def _binary_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def binary_confusion_matrix(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Binary confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_confusion_matrix
+        >>> target = jnp.array([1, 1, 0, 0])
+        >>> preds = jnp.array([0.35, 0.85, 0.48, 0.01])
+        >>> binary_confusion_matrix(preds, target)
+        Array([[2, 0],
+               [1, 1]], dtype=int32)
+    """
+    if validate_args:
+        _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target, valid = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target, valid)
+    return _binary_confusion_matrix_compute(confmat, normalize)
+
+
+# ---------------------------------------------------------------------------
+# Multiclass
+# ---------------------------------------------------------------------------
+
+
+def _multiclass_confusion_matrix_arg_validation(
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    normalize: Optional[str] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+
+
+def _multiclass_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim == target.ndim + 1:
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
+                             " equal to number of classes.")
+    elif preds.ndim != target.ndim:
+        raise ValueError("Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should"
+                         " be (N, ...) and `preds` should be (N, C, ...).")
+
+
+def _multiclass_confusion_matrix_format(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim == target.ndim + 1:
+        preds = jnp.argmax(preds, axis=1)
+    preds = jnp.ravel(preds).astype(jnp.int32)
+    target = jnp.ravel(target)
+    valid = jnp.ones(target.shape, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    preds = jnp.where(valid, preds, 0)
+    return preds, target, valid
+
+
+def _multiclass_confusion_matrix_update(preds: Array, target: Array, valid: Array, num_classes: int) -> Array:
+    return _confusion_matrix_update(preds, target, valid, num_classes)
+
+
+def _multiclass_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multiclass_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multiclass confusion matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_confusion_matrix
+        >>> target = jnp.array([2, 1, 0, 0])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> multiclass_confusion_matrix(preds, target, num_classes=3)
+        Array([[1, 1, 0],
+               [0, 1, 0],
+               [0, 0, 1]], dtype=int32)
+    """
+    if validate_args:
+        _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid = _multiclass_confusion_matrix_format(preds, target, ignore_index)
+    confmat = _multiclass_confusion_matrix_update(preds, target, valid, num_classes)
+    return _multiclass_confusion_matrix_compute(confmat, normalize)
+
+
+# ---------------------------------------------------------------------------
+# Multilabel
+# ---------------------------------------------------------------------------
+
+
+def _multilabel_confusion_matrix_arg_validation(
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    normalize: Optional[str] = None,
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float, but got {threshold}.")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+    allowed_normalize = ("true", "pred", "all", "none", None)
+    if normalize not in allowed_normalize:
+        raise ValueError(f"Argument `normalize` needs to one of the following: {allowed_normalize}")
+
+
+def _multilabel_confusion_matrix_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if preds.shape[1] != num_labels:
+        raise ValueError(f"Expected `preds.shape[1]`={preds.shape[1]} to equal `num_labels`={num_labels}")
+
+
+def _multilabel_confusion_matrix_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(target, 1, -1).reshape(-1, num_labels)
+    valid = jnp.ones(target.shape, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    preds = jnp.where(valid, preds, 0)
+    return preds, target, valid
+
+
+def _multilabel_confusion_matrix_update(preds: Array, target: Array, valid: Array, num_labels: int) -> Array:
+    """Per-label 2×2 matrices, shape ``(L, 2, 2)``."""
+    v = valid
+    tp = jnp.sum((preds == 1) & (target == 1) & v, axis=0)
+    fp = jnp.sum((preds == 1) & (target == 0) & v, axis=0)
+    tn = jnp.sum((preds == 0) & (target == 0) & v, axis=0)
+    fn = jnp.sum((preds == 0) & (target == 1) & v, axis=0)
+    return jnp.stack([tn, fp, fn, tp], axis=-1).reshape(num_labels, 2, 2).astype(jnp.int32)
+
+
+def _multilabel_confusion_matrix_compute(confmat: Array, normalize: Optional[str] = None) -> Array:
+    return _confusion_matrix_reduce(confmat, normalize)
+
+
+def multilabel_confusion_matrix(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multilabel confusion matrix: one 2×2 matrix per label."""
+    if validate_args:
+        _multilabel_confusion_matrix_arg_validation(num_labels, threshold, ignore_index, normalize)
+        _multilabel_confusion_matrix_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, valid = _multilabel_confusion_matrix_format(preds, target, num_labels, threshold, ignore_index)
+    confmat = _multilabel_confusion_matrix_update(preds, target, valid, num_labels)
+    return _multilabel_confusion_matrix_compute(confmat, normalize)
+
+
+def confusion_matrix(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    normalize: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task dispatcher for confusion matrix."""
+    from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_confusion_matrix(preds, target, threshold, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_confusion_matrix(preds, target, num_classes, normalize, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_confusion_matrix(
+            preds, target, num_labels, threshold, normalize, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,134 @@
+"""Exact-match kernels (reference ``functional/classification/exact_match.py``).
+
+Exact match differs from the other stat-scores-derived metrics: a sample counts
+only if *every* element/label is predicted correctly, so the sufficient
+statistics are ``correct`` / ``total`` sample counts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _multiclass_stat_scores_arg_validation,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_tensor_validation,
+    _multilabel_stat_scores_arg_validation,
+    _multilabel_stat_scores_format,
+    _multilabel_stat_scores_tensor_validation,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _exact_match_reduce(correct: Array, total: Array) -> Array:
+    return _safe_divide(correct, total)
+
+
+def _multiclass_exact_match_update(
+    preds: Array,
+    target: Array,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array]:
+    """Per-sample all-correct counts over the trailing dims."""
+    valid = jnp.ones(target.shape, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    match = (preds == target) | ~valid
+    n = target.shape[0]
+    correct = jnp.all(match.reshape(n, -1), axis=1).astype(jnp.int32)
+    if multidim_average == "global":
+        return jnp.sum(correct), jnp.asarray(n, dtype=jnp.int32)
+    return correct, jnp.ones_like(correct)
+
+
+def multiclass_exact_match(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multiclass exact match (all positions in a sample correct).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_exact_match
+        >>> target = jnp.array([[0, 2, 1], [2, 1, 0]])
+        >>> preds = jnp.array([[0, 2, 1], [2, 1, 1]])
+        >>> multiclass_exact_match(preds, target, num_classes=3)
+        Array(0.5, dtype=float32)
+    """
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, 1, None, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, 1)
+    correct, total = _multiclass_exact_match_update(preds, target, multidim_average, ignore_index)
+    return _exact_match_reduce(correct, total)
+
+
+def _multilabel_exact_match_update(
+    preds: Array,
+    target: Array,
+    valid: Array,
+    multidim_average: str = "global",
+) -> Tuple[Array, Array]:
+    """All labels correct per (sample, spatial...) position (reference ``exact_match.py:128-133``)."""
+    match = (preds == target) | ~valid
+    n = target.shape[0]
+    pos_correct = jnp.all(match, axis=1)  # (N, ...) — all labels right at each position
+    if multidim_average == "global":
+        flat = pos_correct.reshape(-1)
+        return jnp.sum(flat).astype(jnp.int32), jnp.asarray(flat.shape[0], dtype=jnp.int32)
+    flat = pos_correct.reshape(n, -1)
+    return jnp.sum(flat, axis=1).astype(jnp.int32), jnp.full((n,), flat.shape[1], dtype=jnp.int32)
+
+
+def multilabel_exact_match(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Multilabel exact match (all labels in a sample correct)."""
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, None, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target, valid = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    correct, total = _multilabel_exact_match_update(preds, target, valid, multidim_average)
+    return _exact_match_reduce(correct, total)
+
+
+def exact_match(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task dispatcher for exact match (no binary task, reference parity)."""
+    from torchmetrics_tpu.utilities.enums import ClassificationTaskNoBinary
+
+    task = ClassificationTaskNoBinary.from_str(task)
+    if task == ClassificationTaskNoBinary.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_exact_match(preds, target, num_classes, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTaskNoBinary.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_exact_match(
+            preds, target, num_labels, threshold, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

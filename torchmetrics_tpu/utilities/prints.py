@@ -1,0 +1,71 @@
+"""Rank-zero-aware printing helpers.
+
+Parity target: reference ``torchmetrics/utilities/prints.py:22-56``. In JAX the
+rank is ``jax.process_index()`` rather than the ``LOCAL_RANK`` env var.
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+from functools import partial, wraps
+from typing import Any, Callable
+
+log = logging.getLogger("torchmetrics_tpu")
+
+
+def _process_index() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def rank_zero_only(fn: Callable) -> Callable:
+    """Call ``fn`` only on process 0."""
+
+    @wraps(fn)
+    def wrapped_fn(*args: Any, **kwargs: Any) -> Any:
+        if _process_index() == 0:
+            return fn(*args, **kwargs)
+        return None
+
+    return wrapped_fn
+
+
+@rank_zero_only
+def rank_zero_warn(message: str, category: Any = UserWarning, stacklevel: int = 2, **kwargs: Any) -> None:
+    warnings.warn(message, category=category, stacklevel=stacklevel, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_info(message: str, **kwargs: Any) -> None:
+    log.info(message, **kwargs)
+
+
+@rank_zero_only
+def rank_zero_debug(message: str, **kwargs: Any) -> None:
+    log.debug(message, **kwargs)
+
+
+def _warn(message: str, **kwargs: Any) -> None:
+    warnings.warn(message, stacklevel=3, **kwargs)
+
+
+_future_warning = partial(_warn, category=FutureWarning)
+
+
+def _deprecated_root_import_class(name: str, domain: str) -> None:
+    _future_warning(
+        f"Importing `{name}` from `torchmetrics_tpu` was deprecated; import it from"
+        f" `torchmetrics_tpu.{domain}` instead."
+    )
+
+
+def _deprecated_root_import_func(name: str, domain: str) -> None:
+    _future_warning(
+        f"Importing `{name}` from `torchmetrics_tpu.functional` was deprecated; import it from"
+        f" `torchmetrics_tpu.functional.{domain}` instead."
+    )
