@@ -1,0 +1,96 @@
+"""Benchmark: MulticlassAccuracy streaming-update throughput (BASELINE.md config #1).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+- "value": jitted torchmetrics_tpu update steps/sec on the default jax device
+  (real TPU chip under the driver; CPU elsewhere).
+- "vs_baseline": ratio vs the reference semantics executed with torch on CPU
+  (the reference stack is torch-CPU/CUDA; torch-cpu is what this image has).
+  The baseline loop reproduces `_multiclass_stat_scores_update` from the
+  reference (argmax + per-class tp/fp/tn/fn accumulate), i.e. the same
+  sufficient-statistics computation TorchMetrics runs per `update()`.
+"""
+
+import json
+import time
+
+BATCH = 4096
+NUM_CLASSES = 5
+WARMUP = 5
+ITERS = 50
+
+
+def _bench_ours() -> float:
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.functional.classification.stat_scores import (
+        _multiclass_stat_scores_update,
+    )
+
+    key = jax.random.PRNGKey(0)
+    preds = jax.random.uniform(key, (BATCH, NUM_CLASSES), dtype=jnp.float32)
+    target = jax.random.randint(jax.random.PRNGKey(1), (BATCH,), 0, NUM_CLASSES)
+
+    @jax.jit
+    def step(state, preds, target):
+        preds_lbl = jnp.argmax(preds, axis=1)
+        tp, fp, tn, fn = _multiclass_stat_scores_update(preds_lbl, target, NUM_CLASSES)
+        return tuple(s + d for s, d in zip(state, (tp, fp, tn, fn)))
+
+    state = tuple(jnp.zeros(NUM_CLASSES, jnp.int32) for _ in range(4))
+    for _ in range(WARMUP):
+        state = step(state, preds, target)
+    jax.block_until_ready(state)
+
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        state = step(state, preds, target)
+    jax.block_until_ready(state)
+    return ITERS / (time.perf_counter() - t0)
+
+
+def _bench_torch_cpu_baseline() -> float:
+    import torch
+
+    g = torch.Generator().manual_seed(0)
+    preds = torch.rand((BATCH, NUM_CLASSES), generator=g)
+    target = torch.randint(0, NUM_CLASSES, (BATCH,), generator=g)
+    state = [torch.zeros(NUM_CLASSES, dtype=torch.long) for _ in range(4)]
+
+    def step():
+        lbl = preds.argmax(dim=1)
+        p_oh = torch.nn.functional.one_hot(lbl, NUM_CLASSES)
+        t_oh = torch.nn.functional.one_hot(target, NUM_CLASSES)
+        tp = (p_oh * t_oh).sum(0)
+        fp = (p_oh * (1 - t_oh)).sum(0)
+        fn = ((1 - p_oh) * t_oh).sum(0)
+        tn = BATCH - tp - fp - fn
+        for s, d in zip(state, (tp, fp, tn, fn)):
+            s += d
+
+    for _ in range(WARMUP):
+        step()
+    t0 = time.perf_counter()
+    for _ in range(ITERS):
+        step()
+    return ITERS / (time.perf_counter() - t0)
+
+
+def main() -> None:
+    ours = _bench_ours()
+    base = _bench_torch_cpu_baseline()
+    print(
+        json.dumps(
+            {
+                "metric": "multiclass_accuracy_updates_per_sec",
+                "value": round(ours, 2),
+                "unit": f"updates/sec (batch={BATCH}, C={NUM_CLASSES})",
+                "vs_baseline": round(ours / base, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

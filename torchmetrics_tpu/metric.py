@@ -517,11 +517,11 @@ class Metric(ABC):
                 destination[prefix + key] = np.asarray(current)
         return destination
 
-    def load_state_dict(self, state_dict: Dict, strict: bool = True) -> None:
-        """Restore states from a :meth:`state_dict` mapping."""
+    def load_state_dict(self, state_dict: Dict, strict: bool = True, prefix: str = "") -> None:
+        """Restore states from a :meth:`state_dict` mapping (symmetric with its ``prefix``)."""
         for key in self._defaults:
-            if key in state_dict:
-                val = state_dict[key]
+            if prefix + key in state_dict:
+                val = state_dict[prefix + key]
                 if isinstance(val, list):
                     setattr(self, key, [jnp.asarray(v) for v in val])
                 else:
@@ -757,6 +757,12 @@ class CompositionalMetric(Metric):
     """Lazy composition of metrics under an elementwise op (reference ``metric.py:1088-1211``)."""
 
     full_state_update = True
+
+    def _wrap_compute(self, compute: Callable) -> Callable:
+        # no caching/sync wrapping: children compute (and sync) themselves, and
+        # their states keep changing between our compute() calls (reference
+        # metric.py:1209-1211 returns compute unwrapped for CompositionalMetric)
+        return compute
 
     def __init__(self, operator: Callable, metric_a: Union[Metric, float, Array], metric_b: Union[Metric, float, Array, None]) -> None:
         super().__init__()

@@ -56,9 +56,9 @@ def _adjust_weights_safe_divide(
 ) -> Array:
     """Apply macro/weighted averaging over per-class scores.
 
-    Parity: reference ``torchmetrics/utilities/compute.py:58-92``. Classes that
+    Parity: reference ``torchmetrics/utilities/compute.py:57-68``. Classes that
     never appear (``tp+fp+fn == 0``) are dropped from the macro average unless
-    running multilabel with ``top_k > 1``.
+    running multilabel.
     """
     if average is None or average == "none":
         return score
@@ -66,7 +66,7 @@ def _adjust_weights_safe_divide(
         weights = (tp + fn).astype(jnp.float32)
     else:
         weights = jnp.ones_like(score)
-        if not multilabel and top_k == 1:
+        if not multilabel:
             weights = jnp.where(tp + fp + fn == 0, 0.0, weights)
     return _safe_divide(
         jnp.sum(weights * score, axis=-1),

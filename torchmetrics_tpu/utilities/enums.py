@@ -19,7 +19,11 @@ class EnumStr(str, Enum):
     @classmethod
     def from_str(cls, value: str, source: str = "key") -> "EnumStr":
         try:
-            return cls[value.replace("-", "_").upper()]
+            norm = value.replace("-", "_").replace(" ", "_").lower()
+            for member in cls:
+                if member.value.replace("-", "_").replace(" ", "_").lower() == norm or member.name.lower() == norm:
+                    return member
+            raise KeyError(value)
         except (KeyError, AttributeError):
             valid = [m.lower() for m in cls.__members__]
             raise ValueError(
@@ -31,11 +35,12 @@ class EnumStr(str, Enum):
 
     def __eq__(self, other: object) -> bool:
         if isinstance(other, str):
-            return self.value.lower() == other.replace("-", "_").lower()
+            norm = lambda s: s.replace("-", "_").replace(" ", "_").lower()  # noqa: E731
+            return norm(self.value) == norm(other)
         return super().__eq__(other)
 
     def __hash__(self) -> int:
-        return hash(self.value.lower())
+        return hash(self.value.replace("-", "_").replace(" ", "_").lower())
 
 
 class DataType(EnumStr):
