@@ -1,0 +1,19 @@
+"""Repo-root pytest config: pin doctest runs to the deterministic CPU platform.
+
+Docstring examples embed exact float32 reprs; the real-TPU backend (axon) can
+differ in the last digit, so doctests — like the unit suite (tests/conftest.py)
+— always run on CPU. The env var alone is not enough: the container's
+sitecustomize force-registers the axon plugin, so the config update below is
+what actually switches the platform.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,92 @@
+"""Aggregation metric tests (reference ``tests/unittests/bases/test_aggregation.py``)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu.aggregation import (
+    CatMetric,
+    MaxMetric,
+    MeanMetric,
+    MinMetric,
+    RunningMean,
+    RunningSum,
+    SumMetric,
+)
+
+
+def test_max():
+    m = MaxMetric()
+    m.update(1.0)
+    m.update(jnp.array([2.0, 3.0]))
+    assert float(m.compute()) == 3.0
+
+
+def test_min():
+    m = MinMetric()
+    m.update(5.0)
+    m.update(jnp.array([2.0, 3.0]))
+    assert float(m.compute()) == 2.0
+
+
+def test_sum():
+    m = SumMetric()
+    m.update(1.0)
+    m.update(jnp.array([2.0, 3.0]))
+    assert float(m.compute()) == 6.0
+
+
+def test_cat():
+    m = CatMetric()
+    m.update(jnp.array([1.0, 2.0]))
+    m.update(jnp.array([3.0]))
+    assert np.allclose(np.asarray(m.compute()), [1.0, 2.0, 3.0])
+
+
+def test_mean_weighted():
+    m = MeanMetric()
+    m.update(jnp.array([1.0, 2.0]), weight=jnp.array([1.0, 3.0]))
+    m.update(3.0)
+    # (1*1 + 2*3 + 3*1) / (1+3+1)
+    assert np.allclose(float(m.compute()), 10.0 / 5.0)
+
+
+@pytest.mark.parametrize("strategy", ["error", "warn", "ignore", 0.0])
+def test_nan_strategies(strategy):
+    m = SumMetric(nan_strategy=strategy)
+    vals = jnp.array([1.0, float("nan"), 2.0])
+    if strategy == "error":
+        with pytest.raises(RuntimeError, match="Encountered `nan` values in tensor"):
+            m.update(vals)
+    elif strategy == 0.0:
+        m.update(vals)
+        assert float(m.compute()) == 3.0
+    else:
+        if strategy == "warn":
+            with pytest.warns(UserWarning):
+                m.update(vals)
+        else:
+            m.update(vals)
+        assert float(m.compute()) == 3.0
+
+
+def test_running_mean():
+    m = RunningMean(window=2)
+    for v in [1.0, 2.0, 3.0]:
+        m.update(jnp.array(v))
+    assert float(m.compute()) == 2.5  # mean of last two
+
+
+def test_running_sum():
+    m = RunningSum(window=3)
+    for v in [1.0, 2.0, 3.0, 4.0]:
+        m.update(jnp.array(v))
+    assert float(m.compute()) == 9.0  # 2+3+4
+
+
+def test_mean_forward_accumulates():
+    m = MeanMetric()
+    out = m(jnp.array([2.0, 4.0]))
+    assert np.allclose(float(out), 3.0)
+    m(jnp.array([6.0]))
+    assert np.allclose(float(m.compute()), 4.0)

@@ -1,0 +1,130 @@
+"""MetricCollection tests (reference ``tests/unittests/bases/test_collections.py``)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.classification import (
+    BinaryAccuracy,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassF1Score,
+    MulticlassPrecision,
+    MulticlassRecall,
+)
+
+NUM_CLASSES = 5
+
+
+def _data(n=32, seed=0):
+    rng = np.random.default_rng(seed)
+    preds = jnp.asarray(rng.integers(0, NUM_CLASSES, n))
+    target = jnp.asarray(rng.integers(0, NUM_CLASSES, n))
+    return preds, target
+
+
+def test_list_construction_and_forward():
+    mc = MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES), MulticlassPrecision(num_classes=NUM_CLASSES)])
+    preds, target = _data()
+    out = mc(preds, target)
+    assert set(out) == {"MulticlassAccuracy", "MulticlassPrecision"}
+
+
+def test_dict_construction():
+    mc = MetricCollection({
+        "acc": MulticlassAccuracy(num_classes=NUM_CLASSES),
+        "prec": MulticlassPrecision(num_classes=NUM_CLASSES),
+    })
+    preds, target = _data()
+    mc.update(preds, target)
+    out = mc.compute()
+    assert set(out) == {"acc", "prec"}
+
+
+def test_prefix_postfix():
+    mc = MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES)], prefix="train_", postfix="_epoch")
+    preds, target = _data()
+    out = mc(preds, target)
+    assert list(out) == ["train_MulticlassAccuracy_epoch"]
+    cloned = mc.clone(prefix="val_")
+    out2 = cloned(preds, target)
+    assert list(out2) == ["val_MulticlassAccuracy_epoch"]
+
+
+def test_compute_groups_formed_and_correct():
+    metrics = [
+        MulticlassAccuracy(num_classes=NUM_CLASSES),
+        MulticlassPrecision(num_classes=NUM_CLASSES),
+        MulticlassRecall(num_classes=NUM_CLASSES),
+        MulticlassF1Score(num_classes=NUM_CLASSES),
+        MulticlassConfusionMatrix(num_classes=NUM_CLASSES),
+    ]
+    mc = MetricCollection(metrics)
+    singles = [
+        MulticlassAccuracy(num_classes=NUM_CLASSES),
+        MulticlassPrecision(num_classes=NUM_CLASSES),
+        MulticlassRecall(num_classes=NUM_CLASSES),
+        MulticlassF1Score(num_classes=NUM_CLASSES),
+        MulticlassConfusionMatrix(num_classes=NUM_CLASSES),
+    ]
+    for seed in range(3):
+        preds, target = _data(seed=seed)
+        mc.update(preds, target)
+        for s in singles:
+            s.update(preds, target)
+    # stat-scores family should share one group, confmat its own
+    groups = mc.compute_groups
+    sizes = sorted(len(g) for g in groups.values())
+    assert sizes == [1, 4]
+    out = mc.compute()
+    for s, key in zip(
+        singles,
+        ["MulticlassAccuracy", "MulticlassPrecision", "MulticlassRecall", "MulticlassF1Score", "MulticlassConfusionMatrix"],
+    ):
+        assert np.allclose(np.asarray(out[key]), np.asarray(s.compute()), atol=1e-6), key
+
+
+def test_compute_groups_disabled_matches():
+    preds, target = _data()
+    mc1 = MetricCollection(
+        [MulticlassAccuracy(num_classes=NUM_CLASSES), MulticlassPrecision(num_classes=NUM_CLASSES)],
+        compute_groups=True,
+    )
+    mc2 = MetricCollection(
+        [MulticlassAccuracy(num_classes=NUM_CLASSES), MulticlassPrecision(num_classes=NUM_CLASSES)],
+        compute_groups=False,
+    )
+    for mc in (mc1, mc2):
+        mc.update(preds, target)
+        mc.update(*_data(seed=1))
+    o1, o2 = mc1.compute(), mc2.compute()
+    for k in o1:
+        assert np.allclose(np.asarray(o1[k]), np.asarray(o2[k]))
+
+
+def test_name_collision_raises():
+    with pytest.raises(ValueError, match="two metrics both named"):
+        MetricCollection([BinaryAccuracy(), BinaryAccuracy()])
+
+
+def test_reset():
+    mc = MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES)])
+    preds, target = _data()
+    mc.update(preds, target)
+    mc.reset()
+    m = mc["MulticlassAccuracy"]
+    assert m._update_count == 0
+
+
+def test_state_dict_roundtrip():
+    mc = MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES)])
+    mc.persistent(True)
+    preds, target = _data()
+    mc.update(preds, target)
+    sd = mc.state_dict()
+    mc2 = MetricCollection([MulticlassAccuracy(num_classes=NUM_CLASSES)])
+    mc2.load_state_dict(sd)
+    assert np.allclose(
+        np.asarray(mc2["MulticlassAccuracy"].compute()), np.asarray(mc["MulticlassAccuracy"].compute())
+    )

@@ -1,0 +1,123 @@
+"""Wrapper metric tests (reference ``tests/unittests/wrappers/``)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu.aggregation import SumMetric
+from torchmetrics_tpu.classification import BinaryAccuracy, MulticlassAccuracy
+from torchmetrics_tpu.regression import MeanSquaredError, R2Score
+from torchmetrics_tpu.wrappers import (
+    BootStrapper,
+    ClasswiseWrapper,
+    MetricTracker,
+    MinMaxMetric,
+    MultioutputWrapper,
+    MultitaskWrapper,
+    Running,
+)
+
+
+def test_classwise():
+    m = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None))
+    m.update(jnp.array([0, 1, 2]), jnp.array([0, 1, 1]))
+    out = m.compute()
+    assert set(out) == {"multiclassaccuracy_0", "multiclassaccuracy_1", "multiclassaccuracy_2"}
+    assert float(out["multiclassaccuracy_0"]) == 1.0
+
+
+def test_classwise_labels():
+    m = ClasswiseWrapper(MulticlassAccuracy(num_classes=3, average=None), labels=["a", "b", "c"])
+    m.update(jnp.array([0, 1, 2]), jnp.array([0, 1, 1]))
+    assert set(m.compute()) == {"multiclassaccuracy_a", "multiclassaccuracy_b", "multiclassaccuracy_c"}
+
+
+def test_minmax():
+    m = MinMaxMetric(BinaryAccuracy())
+    m.update(jnp.array([1.0, 1.0]), jnp.array([1, 1]))
+    out = m.compute()
+    assert float(out["raw"]) == 1.0 and float(out["max"]) == 1.0
+    m.update(jnp.array([0.0, 0.0]), jnp.array([1, 1]))
+    out = m.compute()
+    assert float(out["raw"]) == 0.5
+    assert float(out["max"]) == 1.0
+    assert float(out["min"]) == 0.5
+
+
+def test_multioutput():
+    m = MultioutputWrapper(MeanSquaredError(), num_outputs=2)
+    preds = jnp.array([[1.0, 10.0], [2.0, 20.0]])
+    target = jnp.array([[1.0, 12.0], [2.0, 18.0]])
+    m.update(preds, target)
+    out = np.asarray(m.compute())
+    assert np.allclose(out, [0.0, 4.0])
+
+
+def test_multitask():
+    m = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+    m.update(
+        {"cls": jnp.array([1, 0]), "reg": jnp.array([1.0, 2.0])},
+        {"cls": jnp.array([1, 1]), "reg": jnp.array([1.0, 4.0])},
+    )
+    out = m.compute()
+    assert float(out["cls"]) == 0.5
+    assert float(out["reg"]) == 2.0
+
+
+def test_running_window():
+    m = Running(SumMetric(), window=2)
+    for v in [1.0, 2.0, 3.0]:
+        m.update(jnp.array(v))
+    assert float(m.compute()) == 5.0
+
+
+def test_tracker():
+    tracker = MetricTracker(BinaryAccuracy())
+    for batch in ([1, 1], [1, 0], [0, 0]):
+        tracker.increment()
+        tracker.update(jnp.array(batch), jnp.array([1, 1]))
+    all_vals = np.asarray(tracker.compute_all())
+    assert np.allclose(all_vals, [1.0, 0.5, 0.0])
+    best, idx = tracker.best_metric(return_step=True)
+    assert float(best) == 1.0 and idx == 0
+    assert tracker.n_steps == 3
+
+
+def test_tracker_with_collection():
+    tracker = MetricTracker(MetricCollection([BinaryAccuracy()]), maximize=True)
+    tracker.increment()
+    tracker.update(jnp.array([1, 1]), jnp.array([1, 1]))
+    out = tracker.compute_all()
+    assert np.allclose(np.asarray(out["BinaryAccuracy"]), [1.0])
+
+
+def test_bootstrapper():
+    m = BootStrapper(BinaryAccuracy(), num_bootstraps=20, seed=42)
+    rng = np.random.default_rng(0)
+    preds = jnp.asarray(rng.integers(0, 2, 128))
+    target = jnp.asarray(rng.integers(0, 2, 128))
+    m.update(preds, target)
+    out = m.compute()
+    base = BinaryAccuracy()
+    base.update(preds, target)
+    true_val = float(base.compute())
+    assert abs(float(out["mean"]) - true_val) < 0.15
+    assert float(out["std"]) > 0
+
+
+def test_bootstrapper_quantile_raw():
+    m = BootStrapper(BinaryAccuracy(), num_bootstraps=5, quantile=0.5, raw=True, seed=1)
+    m.update(jnp.array([1, 0, 1, 0]), jnp.array([1, 1, 1, 0]))
+    out = m.compute()
+    assert out["raw"].shape == (5,)
+    assert "quantile" in out
+
+
+def test_compositional():
+    a = BinaryAccuracy()
+    comp = a * 2.0
+    comp(jnp.array([1, 0]), jnp.array([1, 1]))
+    assert float(comp.compute()) == 1.0
+    comp2 = 1.0 - a
+    assert np.allclose(float(comp2.compute()), 0.5)

@@ -1,0 +1,223 @@
+"""Regression metrics vs sklearn/scipy oracles (reference test strategy, SURVEY.md §4)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from scipy.stats import kendalltau, pearsonr, spearmanr
+from sklearn.metrics import (
+    explained_variance_score,
+    mean_absolute_error as sk_mae,
+    mean_absolute_percentage_error as sk_mape,
+    mean_squared_error as sk_mse,
+    mean_squared_log_error as sk_msle,
+    mean_tweedie_deviance,
+    r2_score as sk_r2,
+)
+
+from torchmetrics_tpu import regression as R
+from torchmetrics_tpu.functional import regression as F
+
+N = 64
+NUM_BATCHES = 4
+
+
+def _stream(metric, preds, target):
+    for p, t in zip(np.array_split(preds, NUM_BATCHES), np.array_split(target, NUM_BATCHES)):
+        metric.update(jnp.asarray(p), jnp.asarray(t))
+    return np.asarray(metric.compute())
+
+
+@pytest.fixture
+def data():
+    rng = np.random.default_rng(7)
+    return rng.normal(size=N).astype(np.float32), rng.normal(size=N).astype(np.float32)
+
+
+@pytest.fixture
+def pos_data():
+    rng = np.random.default_rng(8)
+    return (
+        rng.uniform(0.1, 2.0, size=N).astype(np.float32),
+        rng.uniform(0.1, 2.0, size=N).astype(np.float32),
+    )
+
+
+def test_mse(data):
+    p, t = data
+    assert np.allclose(_stream(R.MeanSquaredError(), p, t), sk_mse(t, p), atol=1e-5)
+    assert np.allclose(np.asarray(F.mean_squared_error(jnp.asarray(p), jnp.asarray(t))), sk_mse(t, p), atol=1e-5)
+    assert np.allclose(_stream(R.MeanSquaredError(squared=False), p, t), np.sqrt(sk_mse(t, p)), atol=1e-5)
+
+
+def test_mae(data):
+    p, t = data
+    assert np.allclose(_stream(R.MeanAbsoluteError(), p, t), sk_mae(t, p), atol=1e-5)
+
+
+def test_mape(pos_data):
+    p, t = pos_data
+    assert np.allclose(_stream(R.MeanAbsolutePercentageError(), p, t), sk_mape(t, p), atol=1e-4)
+
+
+def test_smape(pos_data):
+    p, t = pos_data
+    expected = np.mean(2 * np.abs(p - t) / (np.abs(p) + np.abs(t)))
+    assert np.allclose(_stream(R.SymmetricMeanAbsolutePercentageError(), p, t), expected, atol=1e-4)
+
+
+def test_wmape(pos_data):
+    p, t = pos_data
+    expected = np.sum(np.abs(p - t)) / np.sum(np.abs(t))
+    assert np.allclose(_stream(R.WeightedMeanAbsolutePercentageError(), p, t), expected, atol=1e-4)
+
+
+def test_msle(pos_data):
+    p, t = pos_data
+    assert np.allclose(_stream(R.MeanSquaredLogError(), p, t), sk_msle(t, p), atol=1e-5)
+
+
+def test_r2(data):
+    p, t = data
+    assert np.allclose(_stream(R.R2Score(), p, t), sk_r2(t, p), atol=1e-4)
+
+
+def test_r2_multioutput():
+    rng = np.random.default_rng(3)
+    p = rng.normal(size=(N, 2)).astype(np.float32)
+    t = rng.normal(size=(N, 2)).astype(np.float32)
+    m = R.R2Score(num_outputs=2, multioutput="raw_values")
+    assert np.allclose(_stream(m, p, t), sk_r2(t, p, multioutput="raw_values"), atol=1e-4)
+
+
+def test_explained_variance(data):
+    p, t = data
+    assert np.allclose(_stream(R.ExplainedVariance(), p, t), explained_variance_score(t, p), atol=1e-4)
+
+
+def test_pearson(data):
+    p, t = data
+    assert np.allclose(_stream(R.PearsonCorrCoef(), p, t), pearsonr(t, p)[0], atol=1e-4)
+
+
+def test_pearson_merge_parallel(data):
+    """Moment-merge (_final_aggregation) == single-pass result."""
+    p, t = data
+    halves = [(p[:32], t[:32]), (p[32:], t[32:])]
+    moments = []
+    for ph, th in halves:
+        m = R.PearsonCorrCoef()
+        m.update(jnp.asarray(ph), jnp.asarray(th))
+        moments.append([m.mean_x, m.mean_y, m.var_x, m.var_y, m.corr_xy, m.n_total])
+    stacked = [jnp.stack([mo[i] for mo in moments]) for i in range(6)]
+    from torchmetrics_tpu.functional.regression.pearson import _final_aggregation, _pearson_corrcoef_compute
+
+    merged = _final_aggregation(*stacked)
+
+    val = _pearson_corrcoef_compute(merged[2], merged[3], merged[4], merged[5])
+    assert np.allclose(np.asarray(val), pearsonr(t, p)[0], atol=1e-4)
+
+
+def test_concordance(data):
+    p, t = data
+    # Lin's CCC closed form
+    mx, my = p.mean(), t.mean()
+    vx, vy = p.var(), t.var()
+    cxy = np.mean((p - mx) * (t - my))
+    expected = 2 * cxy / (vx + vy + (mx - my) ** 2)
+    assert np.allclose(_stream(R.ConcordanceCorrCoef(), p, t), expected, atol=1e-4)
+
+
+def test_spearman(data):
+    p, t = data
+    assert np.allclose(_stream(R.SpearmanCorrCoef(), p, t), spearmanr(t, p)[0], atol=1e-4)
+
+
+def test_spearman_ties():
+    p = np.array([1.0, 1.0, 2.0, 3.0, 3.0, 3.0], dtype=np.float32)
+    t = np.array([2.0, 2.0, 1.0, 4.0, 4.0, 5.0], dtype=np.float32)
+    m = R.SpearmanCorrCoef()
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    assert np.allclose(np.asarray(m.compute()), spearmanr(t, p)[0], atol=1e-4)
+
+
+@pytest.mark.parametrize("variant", ["a", "b", "c"])
+def test_kendall(data, variant):
+    p, t = data
+    if variant == "a":
+        # scipy only implements b/c; tau-a oracle by direct pair counting
+        n = len(p)
+        con = dis = 0
+        for i in range(n):
+            for j in range(i + 1, n):
+                s = np.sign(p[j] - p[i]) * np.sign(t[j] - t[i])
+                con += s > 0
+                dis += s < 0
+        expected = (con - dis) / (n * (n - 1) / 2)
+    else:
+        expected = kendalltau(t, p, variant=variant).statistic
+    m = R.KendallRankCorrCoef(variant=variant)
+    assert np.allclose(_stream(m, p, t), expected, atol=1e-4)
+
+
+def test_kendall_ties():
+    p = np.array([1.0, 1.0, 2.0, 3.0, 3.0, 3.0], dtype=np.float32)
+    t = np.array([2.0, 2.0, 1.0, 4.0, 4.0, 5.0], dtype=np.float32)
+    expected = kendalltau(t, p, variant="b").statistic
+    m = R.KendallRankCorrCoef(variant="b")
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    assert np.allclose(np.asarray(m.compute()), expected, atol=1e-4)
+
+
+def test_cosine_similarity():
+    rng = np.random.default_rng(5)
+    p = rng.normal(size=(N, 8)).astype(np.float32)
+    t = rng.normal(size=(N, 8)).astype(np.float32)
+    expected = np.mean(
+        np.sum(p * t, axis=1) / (np.linalg.norm(p, axis=1) * np.linalg.norm(t, axis=1))
+    )
+    assert np.allclose(_stream(R.CosineSimilarity(reduction="mean"), p, t), expected, atol=1e-5)
+
+
+def test_kl_divergence():
+    rng = np.random.default_rng(6)
+    p = rng.uniform(0.1, 1.0, size=(N, 5)).astype(np.float32)
+    q = rng.uniform(0.1, 1.0, size=(N, 5)).astype(np.float32)
+    p_n = p / p.sum(1, keepdims=True)
+    q_n = q / q.sum(1, keepdims=True)
+    expected = np.mean(np.sum(p_n * np.log(p_n / q_n), axis=1))
+    assert np.allclose(_stream(R.KLDivergence(), p, q), expected, atol=1e-4)
+
+
+def test_minkowski(data):
+    p, t = data
+    expected = np.power(np.sum(np.abs(p - t) ** 3), 1 / 3)
+    assert np.allclose(_stream(R.MinkowskiDistance(p=3), p, t), expected, atol=1e-4)
+
+
+@pytest.mark.parametrize("power", [0, 1, 2, 1.5])
+def test_tweedie(pos_data, power):
+    p, t = pos_data
+    expected = mean_tweedie_deviance(t, p, power=power)
+    assert np.allclose(_stream(R.TweedieDevianceScore(power=power), p, t), expected, atol=1e-4)
+
+
+def test_log_cosh(data):
+    p, t = data
+    expected = np.mean(np.log(np.cosh(p - t)))
+    assert np.allclose(_stream(R.LogCoshError(), p, t), expected, atol=1e-4)
+
+
+def test_csi():
+    p = np.array([0.8, 0.2, 0.7, 0.6], dtype=np.float32)
+    t = np.array([0.9, 0.1, 0.2, 0.7], dtype=np.float32)
+    m = R.CriticalSuccessIndex(0.5)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    # hits=2 ([0], [3]), false_alarms=1 ([2]), misses=0
+    assert np.allclose(np.asarray(m.compute()), 2 / 3, atol=1e-6)
+
+
+def test_rse(data):
+    p, t = data
+    expected = np.sum((t - p) ** 2) / np.sum((t - t.mean()) ** 2)
+    assert np.allclose(_stream(R.RelativeSquaredError(), p, t), expected, atol=1e-4)

@@ -14,16 +14,30 @@ _logger = __logging.getLogger("torchmetrics_tpu")
 _logger.addHandler(__logging.StreamHandler())
 _logger.setLevel(__logging.INFO)
 
-from torchmetrics_tpu import classification, functional, utilities  # noqa: E402
+from torchmetrics_tpu import aggregation, classification, functional, regression, utilities, wrappers  # noqa: E402
+from torchmetrics_tpu.aggregation import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.aggregation import __all__ as _aggregation_all  # noqa: E402
 from torchmetrics_tpu.classification import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.classification import __all__ as _classification_all  # noqa: E402
+from torchmetrics_tpu.collections import MetricCollection  # noqa: E402
+from torchmetrics_tpu.regression import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.regression import __all__ as _regression_all  # noqa: E402
+from torchmetrics_tpu.wrappers import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.wrappers import __all__ as _wrappers_all  # noqa: E402
 
 __all__ = [
     "CompositionalMetric",
     "Metric",
+    "MetricCollection",
+    "aggregation",
     "classification",
     "functional",
+    "regression",
     "utilities",
+    "wrappers",
     "__version__",
+    *_aggregation_all,
     *_classification_all,
+    *_regression_all,
+    *_wrappers_all,
 ]

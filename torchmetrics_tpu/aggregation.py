@@ -1,0 +1,212 @@
+"""Aggregation primitives: Max/Min/Sum/Cat/Mean + running variants.
+
+Parity target: reference ``torchmetrics/aggregation.py`` (727 LoC) — the
+primitive aggregators built directly on the state DSL. TPU-first notes:
+
+- NaN handling (``nan_strategy``) runs eagerly in the shim ``update`` on
+  concrete arrays; inside jit, use the functional kernels with masking instead
+  (``ignore`` becomes a zero-weight mask, which is the static-shape form of the
+  reference's boolean filtering).
+- ``MeanMetric`` keeps (weighted-sum, weight-sum) — both plain ``sum`` states,
+  so the distributed merge is a single fused psum.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+__all__ = [
+    "BaseAggregator",
+    "MaxMetric",
+    "MinMetric",
+    "SumMetric",
+    "CatMetric",
+    "MeanMetric",
+    "RunningMean",
+    "RunningSum",
+]
+
+
+class BaseAggregator(Metric):
+    """Base class for aggregation metrics (reference ``aggregation.py:30-113``)."""
+
+    is_differentiable = None
+    higher_is_better = None
+    full_state_update: bool = False
+
+    def __init__(
+        self,
+        fn: Union[Callable, str],
+        default_value: Union[Array, List],
+        nan_strategy: Union[str, float] = "error",
+        state_name: str = "value",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_nan_strategy = ("error", "warn", "ignore", "disable")
+        if nan_strategy not in allowed_nan_strategy and not isinstance(nan_strategy, float):
+            raise ValueError(
+                f"Arg `nan_strategy` should either be a float or one of {allowed_nan_strategy}"
+                f" but got {nan_strategy}."
+            )
+        self.nan_strategy = nan_strategy
+        self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
+        self.state_name = state_name
+
+    def _cast_and_nan_check_input(
+        self, x: Union[float, Array], weight: Optional[Union[float, Array]] = None
+    ) -> Tuple[Array, Array]:
+        """Convert input to float arrays and apply the NaN strategy."""
+        x = jnp.asarray(x, dtype=jnp.float32) if not hasattr(x, "dtype") else jnp.asarray(x).astype(jnp.float32)
+        if weight is not None:
+            weight = jnp.asarray(weight, dtype=jnp.float32)
+        else:
+            weight = jnp.ones_like(x)
+        weight = jnp.broadcast_to(weight, x.shape)
+
+        if self.nan_strategy == "disable":
+            return x, weight
+        nans = jnp.isnan(x) | jnp.isnan(weight)
+        if bool(jnp.any(nans)):
+            if self.nan_strategy == "error":
+                raise RuntimeError("Encountered `nan` values in tensor")
+            if self.nan_strategy in ("ignore", "warn"):
+                if self.nan_strategy == "warn":
+                    rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
+                # eager path on concrete arrays: dynamic filtering is fine here
+                keep = jnp.nonzero(~nans.reshape(-1))[0]
+                x = x.reshape(-1)[keep]
+                weight = weight.reshape(-1)[keep]
+            else:
+                x = jnp.where(nans, float(self.nan_strategy), x)
+                weight = jnp.where(nans, float(self.nan_strategy), weight)
+        return x, weight
+
+    def update(self, value: Union[float, Array]) -> None:
+        """Overwrite in child class."""
+
+    def compute(self) -> Array:
+        return getattr(self, self.state_name)
+
+
+class MaxMetric(BaseAggregator):
+    """Running maximum of a stream of values (reference ``aggregation.py:114``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import MaxMetric
+        >>> metric = MaxMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.array([2.0, 3.0]))
+        >>> metric.compute()
+        Array(3., dtype=float32)
+    """
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("max", jnp.array(-jnp.inf, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = jnp.maximum(self.value, jnp.max(value))
+
+
+class MinMetric(BaseAggregator):
+    """Running minimum of a stream of values (reference ``aggregation.py:219``)."""
+
+    full_state_update = True
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("min", jnp.array(jnp.inf, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = jnp.minimum(self.value, jnp.min(value))
+
+
+class SumMetric(BaseAggregator):
+    """Running sum of a stream of values (reference ``aggregation.py:324``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.array(0.0, dtype=jnp.float32), nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value = self.value + jnp.sum(value)
+
+
+class CatMetric(BaseAggregator):
+    """Concatenate a stream of values (reference ``aggregation.py:429``)."""
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("cat", [], nan_strategy, **kwargs)
+
+    def update(self, value: Union[float, Array]) -> None:
+        value, _ = self._cast_and_nan_check_input(value)
+        if value.size:
+            self.value.append(value)
+
+    def compute(self) -> Array:
+        if isinstance(self.value, list) and self.value:
+            return dim_zero_cat(self.value)
+        return self.value
+
+
+class MeanMetric(BaseAggregator):
+    """Weighted running mean of a stream of values (reference ``aggregation.py:493``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.aggregation import MeanMetric
+        >>> metric = MeanMetric()
+        >>> metric.update(1.0)
+        >>> metric.update(jnp.array([2.0, 3.0]))
+        >>> metric.compute()
+        Array(2., dtype=float32)
+    """
+
+    def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        super().__init__("sum", jnp.array(0.0, dtype=jnp.float32), nan_strategy, **kwargs)
+        self.add_state("weight", default=jnp.array(0.0, dtype=jnp.float32), dist_reduce_fx="sum")
+
+    def update(self, value: Union[float, Array], weight: Union[float, Array] = 1.0) -> None:
+        value, weight = self._cast_and_nan_check_input(value, weight)
+        if value.size == 0:
+            return
+        self.value = self.value + jnp.sum(value * weight)
+        self.weight = self.weight + jnp.sum(weight)
+
+    def compute(self) -> Array:
+        from torchmetrics_tpu.utilities.compute import _safe_divide
+
+        return _safe_divide(self.value, self.weight)
+
+
+def _make_running(name: str, base_cls: type, doc: str) -> type:
+    from torchmetrics_tpu.wrappers.running import Running
+
+    def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
+        Running.__init__(self, base_cls(nan_strategy=nan_strategy, **kwargs), window=window)
+
+    return type(name, (Running,), {"__init__": __init__, "__doc__": doc})
+
+
+RunningMean = _make_running(
+    "RunningMean", MeanMetric, "Mean over the last ``window`` updates (reference ``aggregation.py:616``)."
+)
+RunningSum = _make_running(
+    "RunningSum", SumMetric, "Sum over the last ``window`` updates (reference ``aggregation.py:673``)."
+)

@@ -1,0 +1,341 @@
+"""MetricCollection with automatic compute groups.
+
+Parity target: reference ``torchmetrics/collections.py`` (661 LoC). TPU-first
+notes:
+
+- States are immutable ``jax.Array`` leaves, so the reference's deep-copy-on-
+  access dance (``collections.py:515-550``, guarding against user mutation of
+  aliased states) is unnecessary: "aliasing" member states to the group head is
+  just rebinding attribute references, and no copy is ever needed.
+- Compute-group detection keeps the reference's behavior (first update runs all
+  metrics, then states are pairwise compared shape+allclose and groups merged
+  until fixpoint), after which only the group head's ``update`` runs and member
+  states are rebound from the head.
+"""
+
+from __future__ import annotations
+
+from collections import OrderedDict
+from copy import deepcopy
+from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+__all__ = ["MetricCollection"]
+
+
+def _state_equal(a: Any, b: Any) -> bool:
+    if isinstance(a, list) and isinstance(b, list):
+        return len(a) == len(b) and all(_state_equal(x, y) for x, y in zip(a, b))
+    if isinstance(a, list) != isinstance(b, list):
+        return False
+    a, b = jnp.asarray(a), jnp.asarray(b)
+    if a.shape != b.shape or a.dtype != b.dtype:
+        return False
+    return bool(jnp.allclose(a.astype(jnp.float32), b.astype(jnp.float32)))
+
+
+class MetricCollection:
+    """Dict-like container fanning update/compute over many metrics.
+
+    Reference ``collections.py:34``. Accepts a single metric, a sequence,
+    a mapping, or nested collections.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu import MetricCollection
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy, MulticlassPrecision
+        >>> mc = MetricCollection([MulticlassAccuracy(num_classes=3), MulticlassPrecision(num_classes=3)])
+        >>> preds = jnp.array([0, 2, 1]); target = jnp.array([0, 1, 1])
+        >>> out = mc(preds, target)
+        >>> sorted(out.keys())
+        ['MulticlassAccuracy', 'MulticlassPrecision']
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        *additional_metrics: Metric,
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+        compute_groups: Union[bool, List[List[str]]] = True,
+    ) -> None:
+        self._modules: "OrderedDict[str, Metric]" = OrderedDict()
+        self.prefix = self._check_arg(prefix, "prefix")
+        self.postfix = self._check_arg(postfix, "postfix")
+        self._enable_compute_groups = compute_groups
+        self._groups_checked = False
+        self._state_is_copy = False
+        self._groups: Dict[int, List[str]] = {}
+
+        self.add_metrics(metrics, *additional_metrics)
+
+    # ------------------------------------------------------------- construction
+    @staticmethod
+    def _check_arg(arg: Optional[str], name: str) -> Optional[str]:
+        if arg is None or isinstance(arg, str):
+            return arg
+        raise ValueError(f"Expected input `{name}` to be a string, but got {type(arg)}")
+
+    def add_metrics(
+        self, metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]], *additional_metrics: Metric
+    ) -> None:
+        """Add metrics to the collection (reference ``collections.py:389-454``)."""
+        if isinstance(metrics, Metric):
+            metrics = [metrics]
+        if isinstance(metrics, Sequence):
+            metrics = list(metrics)
+            remain: list = []
+            for m in additional_metrics:
+                (metrics if isinstance(m, Metric) else remain).append(m)
+            if remain:
+                raise ValueError(f"You have passed extra arguments {remain} which are not `Metric`.")
+        elif additional_metrics:
+            raise ValueError(
+                f"You have passes extra arguments {additional_metrics} which are not compatible"
+                f" with first passed dictionary {metrics} so they will be ignored."
+            )
+
+        if isinstance(metrics, dict):
+            for name in sorted(metrics.keys()):
+                metric = metrics[name]
+                if not isinstance(metric, (Metric, MetricCollection)):
+                    raise ValueError(
+                        f"Value {metric} belonging to key {name} is not an instance of"
+                        " `Metric` or `MetricCollection`"
+                    )
+                if isinstance(metric, Metric):
+                    self._modules[name] = metric
+                else:
+                    for k, v in metric.items(keep_base=False):
+                        self._modules[f"{name}_{k}"] = v
+        elif isinstance(metrics, Sequence):
+            for metric in metrics:
+                if isinstance(metric, MetricCollection):
+                    for name, m in metric.items(keep_base=False):
+                        if name in self._modules:
+                            raise ValueError(f"Encountered two metrics both named {name}")
+                        self._modules[name] = m
+                elif isinstance(metric, Metric):
+                    name = metric.__class__.__name__
+                    if name in self._modules:
+                        raise ValueError(f"Encountered two metrics both named {name}")
+                    self._modules[name] = metric
+                else:
+                    raise ValueError(f"Input {metric} to `MetricCollection` is not a instance of `Metric`")
+        else:
+            raise ValueError(
+                "Unknown input to MetricCollection. Expected a `Metric`, sequence of `Metric`s, or a dict."
+            )
+        self._groups_checked = False
+
+    # ------------------------------------------------------------------ update
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Update each metric (group heads only once groups are formed)."""
+        if self._groups_checked:
+            for cg in self._groups.values():
+                head = self._modules[cg[0]]
+                head.update(*args, **head._filter_kwargs(**kwargs))
+            self._sync_compute_groups()
+        else:
+            for m in self._modules.values():
+                m.update(*args, **m._filter_kwargs(**kwargs))
+            if self._enable_compute_groups:
+                self._merge_compute_groups()
+            else:
+                self._groups = {i: [name] for i, name in enumerate(self._modules)}
+                self._groups_checked = True
+
+    def _merge_compute_groups(self) -> None:
+        """Pairwise-merge metrics whose states are identical (reference ``collections.py:228-262``)."""
+        if isinstance(self._enable_compute_groups, list):
+            self._groups = {i: [str(n) for n in g] for i, g in enumerate(self._enable_compute_groups)}
+            grouped = {n for g in self._groups.values() for n in g}
+            i = len(self._groups)
+            for name in self._modules:
+                if name not in grouped:
+                    self._groups[i] = [name]
+                    i += 1
+            self._groups_checked = True
+            return
+
+        self._groups = {i: [name] for i, name in enumerate(self._modules)}
+        while True:
+            for cg_idx1, cg_members1 in deepcopy(self._groups).items():
+                for cg_idx2, cg_members2 in deepcopy(self._groups).items():
+                    if cg_idx1 == cg_idx2:
+                        continue
+                    m1 = self._modules[cg_members1[0]]
+                    m2 = self._modules[cg_members2[0]]
+                    if self._equal_metric_states(m1, m2):
+                        self._groups[cg_idx1].extend(self._groups.pop(cg_idx2))
+                        break
+                else:
+                    continue
+                break
+            else:
+                break
+        self._groups = {i: g for i, g in enumerate(self._groups.values())}
+        self._groups_checked = True
+
+    @staticmethod
+    def _equal_metric_states(metric1: Metric, metric2: Metric) -> bool:
+        """Shape + allclose comparison of two metrics' states (reference ``collections.py:264-287``)."""
+        if not metric1._defaults or not metric2._defaults:
+            return False
+        if metric1._defaults.keys() != metric2._defaults.keys():
+            return False
+        if metric1._update_count != metric2._update_count:
+            return False
+        return all(_state_equal(getattr(metric1, k), getattr(metric2, k)) for k in metric1._defaults)
+
+    def _sync_compute_groups(self) -> None:
+        """Rebind member states from their group head (immutable arrays → no copies)."""
+        for cg in self._groups.values():
+            head = self._modules[cg[0]]
+            for name in cg[1:]:
+                member = self._modules[name]
+                for attr in head._defaults:
+                    state = getattr(head, attr)
+                    setattr(member, attr, list(state) if isinstance(state, list) else state)
+                member._update_count = head._update_count
+                member._computed = None
+
+    # ----------------------------------------------------------------- compute
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Per-batch value from every metric while accumulating global state."""
+        res = {name: m(*args, **m._filter_kwargs(**kwargs)) for name, m in self._modules.items()}
+        if not self._groups_checked and self._enable_compute_groups:
+            self._merge_compute_groups()
+        return self._flatten_results(res)
+
+    def compute(self) -> Dict[str, Any]:
+        if self._groups_checked:
+            self._sync_compute_groups()
+        res = {name: m.compute() for name, m in self._modules.items()}
+        return self._flatten_results(res)
+
+    def _flatten_results(self, res: Dict[str, Any]) -> Dict[str, Any]:
+        """Flatten dict-valued results and apply prefix/postfix (reference ``collections.py:314-359``)."""
+        out: Dict[str, Any] = {}
+        for name, value in res.items():
+            if isinstance(value, dict):
+                for k, v in value.items():
+                    if k in res or k in out:
+                        k = f"{name}_{k}"
+                    out[k] = v
+            else:
+                out[name] = value
+        return {self._set_name(k): v for k, v in out.items()}
+
+    def _set_name(self, base: str) -> str:
+        name = base if self.prefix is None else self.prefix + base
+        return name if self.postfix is None else name + self.postfix
+
+    def __call__(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        return self.forward(*args, **kwargs)
+
+    # -------------------------------------------------------------- maintenance
+    def reset(self) -> None:
+        for m in self._modules.values():
+            m.reset()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
+        mc = deepcopy(self)
+        if prefix is not None:
+            mc.prefix = self._check_arg(prefix, "prefix")
+        if postfix is not None:
+            mc.postfix = self._check_arg(postfix, "postfix")
+        return mc
+
+    def persistent(self, mode: bool = True) -> None:
+        for m in self._modules.values():
+            m.persistent(mode)
+
+    def state_dict(self, prefix: str = "") -> Dict[str, Any]:
+        destination: Dict[str, Any] = {}
+        for name, m in self._modules.items():
+            m.state_dict(destination, prefix=f"{prefix}{name}.")
+        return destination
+
+    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True, prefix: str = "") -> None:
+        for name, m in self._modules.items():
+            m.load_state_dict(state_dict, strict=strict, prefix=f"{prefix}{name}.")
+
+    def set_dtype(self, dst_type: Any) -> "MetricCollection":
+        for m in self._modules.values():
+            m.set_dtype(dst_type)
+        return self
+
+    def to_device(self, device: Any) -> "MetricCollection":
+        for m in self._modules.values():
+            m.to_device(device)
+        return self
+
+    def sync(self, **kwargs: Any) -> None:
+        for m in self._modules.values():
+            m.sync(**kwargs)
+
+    def unsync(self, should_unsync: bool = True) -> None:
+        for m in self._modules.values():
+            m.unsync(should_unsync)
+
+    @property
+    def compute_groups(self) -> Dict[int, List[str]]:
+        """Current compute-group assignment."""
+        return self._groups
+
+    # -------------------------------------------------------------- dict-like
+    def items(self, keep_base: bool = False, copy_state: bool = True) -> Iterable[Tuple[str, Metric]]:
+        if self._groups_checked:
+            self._sync_compute_groups()
+        if keep_base:
+            return self._modules.items()
+        return [(self._set_name(k), v) for k, v in self._modules.items()]
+
+    def keys(self, keep_base: bool = False) -> Iterable[str]:
+        if keep_base:
+            return self._modules.keys()
+        return [self._set_name(k) for k in self._modules]
+
+    def values(self, copy_state: bool = True) -> Iterable[Metric]:
+        if self._groups_checked:
+            self._sync_compute_groups()
+        return self._modules.values()
+
+    def __getitem__(self, key: str) -> Metric:
+        if self._groups_checked:
+            self._sync_compute_groups()
+        return self._modules[key]
+
+    def __len__(self) -> int:
+        return len(self._modules)
+
+    def __iter__(self):
+        return iter(self.keys())
+
+    def __contains__(self, key: str) -> bool:
+        return key in self._modules or key in self.keys()
+
+    def __repr__(self) -> str:
+        repr_str = self.__class__.__name__ + "("
+        for name, m in self._modules.items():
+            repr_str += f"\n  {name}: {m!r}"
+        if self.prefix:
+            repr_str += f"\n  prefix={self.prefix}"
+        if self.postfix:
+            repr_str += f"\n  postfix={self.postfix}"
+        return repr_str + "\n)"
+
+    # ---------------------------------------------------------------- plotting
+    def plot(self, val: Optional[Any] = None, ax: Optional[Any] = None, together: bool = False):
+        """Plot all collection members (reference ``collections.py:578-661``)."""
+        val = val if val is not None else self.compute()
+        if together:
+            from torchmetrics_tpu.utilities.plot import plot_single_or_multi_val
+
+            return plot_single_or_multi_val(val, ax=ax)
+        return [m.plot(val[self._set_name(name)], ax=ax) for name, m in self._modules.items()]

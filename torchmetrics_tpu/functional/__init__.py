@@ -1,6 +1,9 @@
 """Stateless functional metrics (L2)."""
 
+from torchmetrics_tpu.functional import classification, regression
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
+from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.regression import __all__ as _regression_all
 
-__all__ = list(_classification_all)
+__all__ = ["classification", "regression", *_classification_all, *_regression_all]

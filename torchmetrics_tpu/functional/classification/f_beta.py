@@ -36,7 +36,7 @@ def _fbeta_reduce(
         fp = jnp.sum(fp, axis=axis)
         return _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
     fbeta_score = _safe_divide((1 + beta2) * tp, (1 + beta2) * tp + beta2 * fn + fp, zero_division)
-    return _adjust_weights_safe_divide(fbeta_score, average, multilabel, tp, fp, fn, top_k, zero_division)
+    return _adjust_weights_safe_divide(fbeta_score, average, multilabel, tp, fp, fn, zero_division)
 
 
 def _validate_beta(beta: float) -> None:

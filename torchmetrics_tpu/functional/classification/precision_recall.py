@@ -35,7 +35,7 @@ def _precision_recall_reduce(
         different_stat = jnp.sum(different_stat, axis=axis)
         return _safe_divide(tp, tp + different_stat, zero_division)
     score = _safe_divide(tp, tp + different_stat, zero_division)
-    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, top_k, zero_division)
+    return _adjust_weights_safe_divide(score, average, multilabel, tp, fp, fn, zero_division)
 
 
 def binary_precision(

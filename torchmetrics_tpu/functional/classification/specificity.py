@@ -32,7 +32,7 @@ def _specificity_reduce(
         fp = jnp.sum(fp, axis=axis)
         return _safe_divide(tn, tn + fp)
     specificity_score = _safe_divide(tn, tn + fp)
-    return _adjust_weights_safe_divide(specificity_score, average, multilabel, tp, fp, fn, top_k)
+    return _adjust_weights_safe_divide(specificity_score, average, multilabel, tp, fp, fn)
 
 
 def binary_specificity(

@@ -1,0 +1,40 @@
+"""Concordance correlation coefficient (reference ``functional/regression/concordance.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.pearson import _pearson_corrcoef_update
+
+Array = jax.Array
+
+
+def _concordance_corrcoef_compute(
+    mean_x: Array, mean_y: Array, var_x: Array, var_y: Array, corr_xy: Array, nb: Array
+) -> Array:
+    """CCC from the shared pearson co-moment state."""
+    vx = var_x / nb
+    vy = var_y / nb
+    cxy = corr_xy / nb
+    eps = jnp.finfo(jnp.float32).eps
+    return (2.0 * cxy / jnp.clip(vx + vy + (mean_x - mean_y) ** 2, min=eps)).squeeze()
+
+
+def concordance_corrcoef(preds: Array, target: Array) -> Array:
+    """Lin's concordance correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import concordance_corrcoef
+        >>> concordance_corrcoef(jnp.array([3.0, 5.0, 2.5, 7.0]), jnp.array([3.0, 5.5, 3.0, 7.0]))
+        Array(0.97969544, dtype=float32)
+    """
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    z = jnp.zeros(d, dtype=jnp.float32)
+    mean_x, mean_y, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, z, z, z, z, z, jnp.zeros(d, jnp.float32), num_outputs=d
+    )
+    return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, nb)

@@ -1,0 +1,46 @@
+"""Critical success index (reference ``functional/regression/csi.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _critical_success_index_update(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: bool = False
+) -> Tuple[Array, Array, Array]:
+    _check_same_shape(preds, target)
+    preds_bin = jnp.asarray(preds) >= threshold
+    target_bin = jnp.asarray(target) >= threshold
+    axis = None if not keep_sequence_dim else tuple(range(1, preds_bin.ndim))
+    hits = jnp.sum(preds_bin & target_bin, axis=axis)
+    misses = jnp.sum(~preds_bin & target_bin, axis=axis)
+    false_alarms = jnp.sum(preds_bin & ~target_bin, axis=axis)
+    return hits, misses, false_alarms
+
+
+def _critical_success_index_compute(hits: Array, misses: Array, false_alarms: Array) -> Array:
+    from torchmetrics_tpu.utilities.compute import _safe_divide
+
+    return _safe_divide(hits, hits + misses + false_alarms)
+
+
+def critical_success_index(
+    preds: Array, target: Array, threshold: float, keep_sequence_dim: bool = False
+) -> Array:
+    """Critical success index (threat score).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import critical_success_index
+        >>> critical_success_index(jnp.array([0.8, 0.2, 0.7]), jnp.array([0.9, 0.1, 0.2]), threshold=0.5)
+        Array(0.5, dtype=float32)
+    """
+    hits, misses, false_alarms = _critical_success_index_update(preds, target, threshold, keep_sequence_dim)
+    return _critical_success_index_compute(hits, misses, false_alarms)

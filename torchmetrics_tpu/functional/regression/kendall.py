@@ -1,0 +1,120 @@
+"""Kendall rank correlation (reference ``functional/regression/kendall.py``).
+
+All three tau variants (a/b/c) via the O(n²) pairwise sign matrix — fully
+vectorized, static shapes, no sort-based discordance counting.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.enums import EnumStr
+
+Array = jax.Array
+
+
+class _MetricVariant(EnumStr):
+    A = "a"
+    B = "b"
+    C = "c"
+
+    @staticmethod
+    def _name() -> str:
+        return "variant"
+
+
+class _TestAlternative(EnumStr):
+    TWO_SIDED = "two-sided"
+    LESS = "less"
+    GREATER = "greater"
+
+    @staticmethod
+    def _name() -> str:
+        return "alternative"
+
+
+def _kendall_corrcoef_compute_single(preds: Array, target: Array, variant: str) -> Tuple[Array, Array]:
+    """Tau + concordance stats for 1-D inputs; returns (tau, n_pairs_info)."""
+    n = preds.shape[0]
+    sp = jnp.sign(preds[None, :] - preds[:, None])
+    st = jnp.sign(target[None, :] - target[:, None])
+    iu = jnp.triu(jnp.ones((n, n), dtype=bool), k=1)
+    con = jnp.sum((sp * st > 0) & iu)
+    dis = jnp.sum((sp * st < 0) & iu)
+    ties_x = jnp.sum((sp == 0) & (st != 0) & iu)
+    ties_y = jnp.sum((st == 0) & (sp != 0) & iu)
+    ties_xy = jnp.sum((sp == 0) & (st == 0) & iu)
+    n_total = n * (n - 1) // 2
+
+    con = con.astype(jnp.float32)
+    dis = dis.astype(jnp.float32)
+    if variant == "a":
+        tau = (con - dis) / n_total
+    elif variant == "b":
+        tx = (ties_x + ties_xy).astype(jnp.float32)
+        ty = (ties_y + ties_xy).astype(jnp.float32)
+        tau = (con - dis) / jnp.sqrt((n_total - tx) * (n_total - ty))
+    else:
+        # tau-c: m = min(#distinct x, #distinct y) approximated via tie structure
+        unique_x = n - jnp.sum(jnp.any((preds[None, :] == preds[:, None]) & jnp.tril(jnp.ones((n, n), bool), -1), axis=1))
+        unique_y = n - jnp.sum(jnp.any((target[None, :] == target[:, None]) & jnp.tril(jnp.ones((n, n), bool), -1), axis=1))
+        m = jnp.minimum(unique_x, unique_y).astype(jnp.float32)
+        tau = 2 * (con - dis) / (n**2 * (m - 1) / m)
+    return jnp.clip(tau, -1.0, 1.0), con - dis
+
+
+def _kendall_pvalue(tau: Array, n: int, alternative: str) -> Array:
+    """Normal-approximation p-value for tau (reference asymptotic test)."""
+    var = (4 * n + 10.0) / (9.0 * n * (n - 1))
+    z = tau / jnp.sqrt(var)
+    from jax.scipy.stats import norm
+
+    if alternative == "two-sided":
+        return 2 * (1 - norm.cdf(jnp.abs(z)))
+    if alternative == "greater":
+        return 1 - norm.cdf(z)
+    return norm.cdf(z)
+
+
+def kendall_rank_corrcoef(
+    preds: Array,
+    target: Array,
+    variant: str = "b",
+    t_test: bool = False,
+    alternative: Optional[str] = "two-sided",
+) -> Array:
+    """Kendall rank correlation (tau-a/b/c), optionally with a p-value.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import kendall_rank_corrcoef
+        >>> kendall_rank_corrcoef(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
+        Array(1., dtype=float32)
+    """
+    variant = str(_MetricVariant.from_str(variant))
+    if t_test and alternative is not None:
+        alternative = str(_TestAlternative.from_str(alternative))
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+
+    if preds.ndim == 1:
+        tau, _ = _kendall_corrcoef_compute_single(preds, target, variant)
+        if t_test:
+            return tau, _kendall_pvalue(tau, preds.shape[0], alternative)
+        return tau
+    taus = []
+    pvals = []
+    for i in range(preds.shape[1]):
+        tau, _ = _kendall_corrcoef_compute_single(preds[:, i], target[:, i], variant)
+        taus.append(tau)
+        if t_test:
+            pvals.append(_kendall_pvalue(tau, preds.shape[0], alternative))
+    if t_test:
+        return jnp.stack(taus), jnp.stack(pvals)
+    return jnp.stack(taus)

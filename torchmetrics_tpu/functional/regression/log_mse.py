@@ -1,0 +1,71 @@
+"""Mean squared log error (reference ``functional/regression/log_mse.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_log_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    d = jnp.log1p(preds) - jnp.log1p(target)
+    return jnp.sum(d * d), target.size
+
+
+def _mean_squared_log_error_compute(sum_squared_log_error: Array, num_obs: Union[int, Array]) -> Array:
+    return sum_squared_log_error / num_obs
+
+
+def mean_squared_log_error(preds: Array, target: Array) -> Array:
+    """Mean squared logarithmic error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import mean_squared_log_error
+        >>> mean_squared_log_error(jnp.array([0., 1., 2., 3.]), jnp.array([0., 1., 2., 2.]))
+        Array(0.02069024, dtype=float32)
+    """
+    s, n = _mean_squared_log_error_update(preds, target)
+    return _mean_squared_log_error_compute(s, n)
+
+
+def _log_cosh_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    diff = preds - target
+    # numerically-stable log(cosh(x)) = x + softplus(-2x) - log(2)
+    sum_log_cosh = jnp.sum(diff + jax.nn.softplus(-2.0 * diff) - jnp.log(2.0), axis=0)
+    return sum_log_cosh, target.shape[0]
+
+
+def _log_cosh_error_compute(sum_log_cosh_error: Array, total: Union[int, Array]) -> Array:
+    return (sum_log_cosh_error / total).squeeze()
+
+
+def log_cosh_error(preds: Array, target: Array) -> Array:
+    """LogCosh error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import log_cosh_error
+        >>> log_cosh_error(jnp.array([3.0, 5.0, 2.5]), jnp.array([0.25, 5.0, 4.0]))
+        Array(0.9721238, dtype=float32)
+    """
+    num_outputs = 1 if jnp.asarray(preds).ndim == 1 else jnp.asarray(preds).shape[1]
+    s, n = _log_cosh_error_update(preds, target, num_outputs)
+    return _log_cosh_error_compute(s, n)

@@ -1,0 +1,40 @@
+"""Mean absolute error (reference ``functional/regression/mae.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_absolute_error_update(preds: Array, target: Array, num_outputs: int = 1) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    sum_abs_error = jnp.sum(jnp.abs(preds - target), axis=0)
+    return sum_abs_error, target.shape[0]
+
+
+def _mean_absolute_error_compute(sum_abs_error: Array, total: Union[int, Array]) -> Array:
+    return sum_abs_error / total
+
+
+def mean_absolute_error(preds: Array, target: Array, num_outputs: int = 1) -> Array:
+    """Mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import mean_absolute_error
+        >>> mean_absolute_error(jnp.array([0., 1., 2., 3.]), jnp.array([0., 1., 2., 2.]))
+        Array(0.25, dtype=float32)
+    """
+    sum_abs_error, total = _mean_absolute_error_update(preds, target, num_outputs)
+    return _mean_absolute_error_compute(sum_abs_error, total)

@@ -1,0 +1,37 @@
+"""Minkowski distance (reference ``functional/regression/minkowski.py``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+
+def _minkowski_distance_update(preds: Array, targets: Array, p: float) -> Array:
+    _check_same_shape(preds, targets)
+    if not (isinstance(p, (float, int)) and p >= 1):
+        raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    targets = jnp.asarray(targets, dtype=jnp.float32)
+    return jnp.sum(jnp.abs(preds - targets) ** p)
+
+
+def _minkowski_distance_compute(distance: Array, p: float) -> Array:
+    return distance ** (1.0 / p)
+
+
+def minkowski_distance(preds: Array, targets: Array, p: float) -> Array:
+    """Minkowski distance of order p.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import minkowski_distance
+        >>> minkowski_distance(jnp.array([1., 2., 3.]), jnp.array([1., 2., 4.]), p=2)
+        Array(1., dtype=float32)
+    """
+    distance = _minkowski_distance_update(preds, targets, p)
+    return _minkowski_distance_compute(distance, p)

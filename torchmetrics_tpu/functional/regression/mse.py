@@ -1,0 +1,44 @@
+"""Mean squared error (reference ``functional/regression/mse.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _mean_squared_error_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, int]:
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    if num_outputs == 1:
+        preds = preds.reshape(-1)
+        target = target.reshape(-1)
+    diff = preds - target
+    sum_squared_error = jnp.sum(diff * diff, axis=0)
+    return sum_squared_error, target.shape[0]
+
+
+def _mean_squared_error_compute(sum_squared_error: Array, total: Union[int, Array], squared: bool = True) -> Array:
+    mse = sum_squared_error / total
+    return mse if squared else jnp.sqrt(mse)
+
+
+def mean_squared_error(
+    preds: Array, target: Array, squared: bool = True, num_outputs: int = 1
+) -> Array:
+    """Mean squared error (or RMSE with ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import mean_squared_error
+        >>> mean_squared_error(jnp.array([0., 1., 2., 3.]), jnp.array([0., 1., 2., 2.]))
+        Array(0.25, dtype=float32)
+    """
+    sum_squared_error, total = _mean_squared_error_update(preds, target, num_outputs)
+    return _mean_squared_error_compute(sum_squared_error, total, squared)

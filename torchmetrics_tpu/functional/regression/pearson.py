@@ -1,0 +1,108 @@
+"""Pearson correlation (reference ``functional/regression/pearson.py``).
+
+The one metric whose distributed reduction is *algorithmic*: per-device
+(mean, var, cov, n) moment sets are merged with the parallel-variance update
+rather than a plain sum (SURVEY.md §2.5). ``_final_aggregation`` is that merge,
+expressed as a ``lax.scan``-style fold so it also jits for an in-graph
+multi-device merge.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _pearson_corrcoef_update(
+    preds: Array,
+    target: Array,
+    mean_x: Array,
+    mean_y: Array,
+    var_x: Array,
+    var_y: Array,
+    corr_xy: Array,
+    num_prior: Array,
+    num_outputs: int,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Streaming update of co-moment statistics (Welford-style)."""
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    num_obs = preds.shape[0]
+    cond = (num_prior == 0).all() if hasattr(num_prior, "all") else num_prior == 0
+
+    mx_new = jnp.where(cond, jnp.mean(preds, axis=0), (num_prior * mean_x + jnp.sum(preds, axis=0)) / (num_prior + num_obs))
+    my_new = jnp.where(cond, jnp.mean(target, axis=0), (num_prior * mean_y + jnp.sum(target, axis=0)) / (num_prior + num_obs))
+    num_prior = num_prior + num_obs
+    var_x = var_x + jnp.sum((preds - mx_new) * (preds - mean_x), axis=0)
+    var_y = var_y + jnp.sum((target - my_new) * (target - mean_y), axis=0)
+    corr_xy = corr_xy + jnp.sum((preds - mx_new) * (target - mean_y), axis=0)
+    return mx_new, my_new, var_x, var_y, corr_xy, num_prior
+
+
+def _pearson_corrcoef_compute(var_x: Array, var_y: Array, corr_xy: Array, nb: Array) -> Array:
+    """Final correlation from accumulated co-moments."""
+    var_x = var_x / (nb - 1)
+    var_y = var_y / (nb - 1)
+    corr_xy = corr_xy / (nb - 1)
+    eps = jnp.finfo(jnp.float32).eps
+    corrcoef = corr_xy / jnp.clip(jnp.sqrt(var_x * var_y), min=eps)
+    return jnp.clip(corrcoef, -1.0, 1.0).squeeze()
+
+
+def _final_aggregation(
+    means_x: Array,
+    means_y: Array,
+    vars_x: Array,
+    vars_y: Array,
+    corrs_xy: Array,
+    nbs: Array,
+) -> Tuple[Array, Array, Array, Array, Array, Array]:
+    """Merge per-device moment sets ``(D, ...)`` into one (parallel-variance fold)."""
+    if means_x.shape[0] == 1:
+        return means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0]
+
+    def merge(acc, new):
+        mx1, my1, vx1, vy1, cxy1, n1 = acc
+        mx2, my2, vx2, vy2, cxy2, n2 = new
+        nb = n1 + n2
+        safe_nb = jnp.where(nb == 0, 1.0, nb)
+        mean_x = (n1 * mx1 + n2 * mx2) / safe_nb
+        mean_y = (n1 * my1 + n2 * my2) / safe_nb
+        vx = vx1 + vx2 + n1 * n2 / safe_nb * (mx1 - mx2) ** 2
+        vy = vy1 + vy2 + n1 * n2 / safe_nb * (my1 - my2) ** 2
+        cxy = cxy1 + cxy2 + n1 * n2 / safe_nb * (mx1 - mx2) * (my1 - my2)
+        return (mean_x, mean_y, vx, vy, cxy, nb), None
+
+    acc = (means_x[0], means_y[0], vars_x[0], vars_y[0], corrs_xy[0], nbs[0])
+    for i in range(1, means_x.shape[0]):
+        acc, _ = merge(acc, (means_x[i], means_y[i], vars_x[i], vars_y[i], corrs_xy[i], nbs[i]))
+    return acc
+
+
+def pearson_corrcoef(preds: Array, target: Array) -> Array:
+    """Pearson correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import pearson_corrcoef
+        >>> pearson_corrcoef(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
+        Array(0.98486954, dtype=float32)
+    """
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    d = preds.shape[1] if preds.ndim == 2 else 1
+    _temp = jnp.zeros(d, dtype=jnp.float32)
+    mean_x, mean_y, var_x, var_y, corr_xy, nb = (_temp,) * 5 + (jnp.zeros(d, dtype=jnp.float32),)
+    mean_x, mean_y, var_x, var_y, corr_xy, nb = _pearson_corrcoef_update(
+        preds, target, mean_x, mean_y, var_x, var_y, corr_xy, nb, num_outputs=d
+    )
+    return _pearson_corrcoef_compute(var_x, var_y, corr_xy, nb)

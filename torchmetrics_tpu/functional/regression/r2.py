@@ -1,0 +1,88 @@
+"""R² score (reference ``functional/regression/r2.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _r2_score_update(preds: Array, target: Array) -> Tuple[Array, Array, Array, int]:
+    _check_same_shape(preds, target)
+    if preds.ndim > 2:
+        raise ValueError(
+            f"Expected both prediction and target to be 1D or 2D tensors, but received tensors with dimension {preds.shape}"
+        )
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+    sum_obs = jnp.sum(target, axis=0)
+    sum_squared_obs = jnp.sum(target * target, axis=0)
+    residual = jnp.sum((target - preds) ** 2, axis=0)
+    return sum_squared_obs, sum_obs, residual, target.shape[0]
+
+
+def _r2_score_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    residual: Array,
+    total: Union[int, Array],
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    if (jnp.asarray(total) < 2).any():
+        raise ValueError("Needs at least two samples to calculate r2 score.")
+    mean_obs = sum_obs / total
+    tss = sum_squared_obs - sum_obs * mean_obs
+    raw_scores = 1 - (residual / tss)
+
+    if multioutput == "raw_values":
+        r2 = raw_scores
+    elif multioutput == "uniform_average":
+        r2 = jnp.mean(raw_scores)
+    elif multioutput == "variance_weighted":
+        tss_sum = jnp.sum(tss)
+        r2 = jnp.sum(tss / tss_sum * raw_scores)
+    else:
+        raise ValueError(
+            "Argument `multioutput` must be either `raw_values`,"
+            f" `uniform_average` or `variance_weighted`. Received {multioutput}."
+        )
+
+    if not isinstance(adjusted, int) or adjusted < 0:
+        raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
+    if adjusted != 0:
+        total = int(jnp.asarray(total)) if not isinstance(total, int) else total
+        if adjusted > total - 1:
+            rank_zero_warn(
+                "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
+                UserWarning,
+            )
+        elif adjusted == total - 1:
+            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+        else:
+            return 1 - (1 - r2) * (total - 1) / (total - adjusted - 1)
+    return r2
+
+
+def r2_score(
+    preds: Array,
+    target: Array,
+    adjusted: int = 0,
+    multioutput: str = "uniform_average",
+) -> Array:
+    """R² (coefficient of determination).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import r2_score
+        >>> r2_score(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
+        Array(0.94860816, dtype=float32)
+    """
+    sum_squared_obs, sum_obs, residual, total = _r2_score_update(preds, target)
+    return _r2_score_compute(sum_squared_obs, sum_obs, residual, total, adjusted, multioutput)

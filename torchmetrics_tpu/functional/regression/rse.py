@@ -1,0 +1,39 @@
+"""Relative squared error (reference ``functional/regression/rse.py``)."""
+
+from __future__ import annotations
+
+from typing import Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.r2 import _r2_score_update
+
+Array = jax.Array
+
+
+def _relative_squared_error_compute(
+    sum_squared_obs: Array,
+    sum_obs: Array,
+    sum_squared_error: Array,
+    total: Union[int, Array],
+    squared: bool = True,
+) -> Array:
+    epsilon = jnp.finfo(jnp.float32).eps
+    rse = sum_squared_error / jnp.clip(sum_squared_obs - sum_obs * sum_obs / total, min=epsilon)
+    if not squared:
+        rse = jnp.sqrt(rse)
+    return jnp.mean(rse)
+
+
+def relative_squared_error(preds: Array, target: Array, squared: bool = True) -> Array:
+    """Relative squared error (or root-RSE with ``squared=False``).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import relative_squared_error
+        >>> relative_squared_error(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
+        Array(0.05139186, dtype=float32)
+    """
+    sum_squared_obs, sum_obs, rss, total = _r2_score_update(preds, target)
+    return _relative_squared_error_compute(sum_squared_obs, sum_obs, rss, total, squared=squared)

@@ -1,0 +1,59 @@
+"""Spearman rank correlation (reference ``functional/regression/spearman.py``).
+
+Ranks are computed with the O(n²) broadcast formulation in
+``regression/utils._rank_data`` — static shapes, tiles onto the MXU — instead
+of the reference's sort + dynamic tie-repair loop.
+"""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs, _rank_data
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _spearman_corrcoef_update(preds: Array, target: Array, num_outputs: int) -> Tuple[Array, Array]:
+    if not (jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating) and jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating)):
+        raise TypeError(
+            "Expected `preds` and `target` both to be floating point tensors, but got"
+            f" {jnp.asarray(preds).dtype} and {jnp.asarray(target).dtype}"
+        )
+    _check_same_shape(preds, target)
+    _check_data_shape_to_num_outputs(preds, target, num_outputs)
+    return jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32)
+
+
+def _spearman_corrcoef_compute(preds: Array, target: Array, eps: float = 1e-6) -> Array:
+    if preds.ndim == 1:
+        preds = _rank_data(preds)
+        target = _rank_data(target)
+    else:
+        preds = _rank_data(preds.T).T
+        target = _rank_data(target.T).T
+    preds_diff = preds - preds.mean(axis=0)
+    target_diff = target - target.mean(axis=0)
+    cov = (preds_diff * target_diff).mean(axis=0)
+    preds_std = jnp.sqrt((preds_diff * preds_diff).mean(axis=0))
+    target_std = jnp.sqrt((target_diff * target_diff).mean(axis=0))
+    corrcoef = cov / (preds_std * target_std + eps)
+    return jnp.clip(corrcoef, -1.0, 1.0)
+
+
+def spearman_corrcoef(preds: Array, target: Array) -> Array:
+    """Spearman rank correlation.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import spearman_corrcoef
+        >>> spearman_corrcoef(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
+        Array(0.9999992, dtype=float32)
+    """
+    num_outputs = 1 if jnp.asarray(preds).ndim == 1 else jnp.asarray(preds).shape[1]
+    preds, target = _spearman_corrcoef_update(preds, target, num_outputs)
+    return _spearman_corrcoef_compute(preds, target)

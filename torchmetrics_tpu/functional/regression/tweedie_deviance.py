@@ -1,0 +1,55 @@
+"""Tweedie deviance score (reference ``functional/regression/tweedie_deviance.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.compute import _safe_xlogy
+
+Array = jax.Array
+
+
+def _tweedie_deviance_score_update(preds: Array, targets: Array, power: float = 0.0) -> Tuple[Array, Array]:
+    _check_same_shape(preds, targets)
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    targets = jnp.asarray(targets, dtype=jnp.float32)
+
+    if 0 < power < 1:
+        raise ValueError(f"Deviance Score is not defined for power={power}.")
+
+    if power == 0:
+        deviance_score = (targets - preds) ** 2
+    elif power == 1:
+        # Poisson: requires targets >= 0, preds > 0 (checked eagerly by classes)
+        deviance_score = 2 * (_safe_xlogy(targets, targets / preds) + preds - targets)
+    elif power == 2:
+        # Gamma: requires targets > 0, preds > 0
+        deviance_score = 2 * (jnp.log(preds / targets) + (targets / preds) - 1)
+    else:
+        term_1 = jnp.power(jnp.clip(targets, min=0), 2 - power) / ((1 - power) * (2 - power))
+        term_2 = targets * jnp.power(preds, 1 - power) / (1 - power)
+        term_3 = jnp.power(preds, 2 - power) / (2 - power)
+        deviance_score = 2 * (term_1 - term_2 + term_3)
+
+    return jnp.sum(deviance_score), jnp.asarray(targets.size, dtype=jnp.float32)
+
+
+def _tweedie_deviance_score_compute(sum_deviance_score: Array, num_observations: Array) -> Array:
+    return sum_deviance_score / num_observations
+
+
+def tweedie_deviance_score(preds: Array, targets: Array, power: float = 0.0) -> Array:
+    """Tweedie deviance score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.regression import tweedie_deviance_score
+        >>> tweedie_deviance_score(jnp.array([1.0, 2.0, 3.0]), jnp.array([1.5, 2.5, 4.5]), power=0)
+        Array(0.9166667, dtype=float32)
+    """
+    s, n = _tweedie_deviance_score_update(preds, targets, power)
+    return _tweedie_deviance_score_compute(s, n)

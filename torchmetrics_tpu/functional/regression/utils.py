@@ -1,0 +1,40 @@
+"""Shared regression helpers.
+
+``_rank_data`` computes average ranks (ties averaged) without dynamic shapes:
+rank_i = #{x_j < x_i} + (#{x_j == x_i} + 1) / 2, evaluated as an O(n²)
+broadcasted comparison — a matmul-shaped pattern XLA tiles onto the MXU/VPU,
+unlike the reference's sort + ``unique``-based tie repair
+(``functional/regression/utils.py`` + ``spearman.py:22-53``) which is
+dynamic-shape and host-bound.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_data_shape_to_num_outputs(preds: Array, target: Array, num_outputs: int) -> None:
+    """Validate (N,) for num_outputs=1 or (N, M) for num_outputs=M."""
+    if preds.ndim > 2 or target.ndim > 2:
+        raise ValueError(
+            f"Expected both predictions and target to be either 1- or 2-dimensional tensors,"
+            f" but got {target.ndim} and {preds.ndim}."
+        )
+    cond1 = num_outputs == 1 and not (preds.ndim == 1 or preds.shape[1] == 1)
+    cond2 = num_outputs > 1 and (preds.ndim < 2 or preds.shape[1] != num_outputs)
+    if cond1 or cond2:
+        raise ValueError(
+            f"Expected argument `num_outputs` to match the second dimension of input, but got {num_outputs}"
+            f" and {preds.shape}"
+        )
+
+
+def _rank_data(data: Array) -> Array:
+    """Average ranks (1-indexed) along the last axis, ties get the mean rank."""
+    x = data.astype(jnp.float32)
+    lt = (x[..., None, :] < x[..., :, None]).sum(axis=-1).astype(jnp.float32)
+    eq = (x[..., None, :] == x[..., :, None]).sum(axis=-1).astype(jnp.float32)
+    return lt + (eq + 1.0) / 2.0

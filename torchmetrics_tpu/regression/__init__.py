@@ -1,0 +1,41 @@
+"""Modular regression metrics (reference ``torchmetrics/regression/``)."""
+
+from torchmetrics_tpu.regression.cosine_similarity import CosineSimilarity
+from torchmetrics_tpu.regression.csi import CriticalSuccessIndex
+from torchmetrics_tpu.regression.explained_variance import ExplainedVariance
+from torchmetrics_tpu.regression.kl_divergence import KLDivergence
+from torchmetrics_tpu.regression.log_mse import LogCoshError, MeanSquaredLogError
+from torchmetrics_tpu.regression.mae import MeanAbsoluteError
+from torchmetrics_tpu.regression.mape import (
+    MeanAbsolutePercentageError,
+    SymmetricMeanAbsolutePercentageError,
+    WeightedMeanAbsolutePercentageError,
+)
+from torchmetrics_tpu.regression.minkowski import MinkowskiDistance
+from torchmetrics_tpu.regression.mse import MeanSquaredError
+from torchmetrics_tpu.regression.pearson import ConcordanceCorrCoef, PearsonCorrCoef
+from torchmetrics_tpu.regression.r2 import R2Score, RelativeSquaredError
+from torchmetrics_tpu.regression.spearman import KendallRankCorrCoef, SpearmanCorrCoef
+from torchmetrics_tpu.regression.tweedie_deviance import TweedieDevianceScore
+
+__all__ = [
+    "ConcordanceCorrCoef",
+    "CosineSimilarity",
+    "CriticalSuccessIndex",
+    "ExplainedVariance",
+    "KendallRankCorrCoef",
+    "KLDivergence",
+    "LogCoshError",
+    "MeanSquaredLogError",
+    "MeanAbsoluteError",
+    "MeanAbsolutePercentageError",
+    "MinkowskiDistance",
+    "MeanSquaredError",
+    "PearsonCorrCoef",
+    "R2Score",
+    "RelativeSquaredError",
+    "SpearmanCorrCoef",
+    "SymmetricMeanAbsolutePercentageError",
+    "TweedieDevianceScore",
+    "WeightedMeanAbsolutePercentageError",
+]

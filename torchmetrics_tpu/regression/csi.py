@@ -1,0 +1,74 @@
+"""CriticalSuccessIndex (reference ``regression/csi.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.csi import (
+    _critical_success_index_compute,
+    _critical_success_index_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CriticalSuccessIndex(Metric):
+    """Critical success index (threat score).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import CriticalSuccessIndex
+        >>> metric = CriticalSuccessIndex(0.5)
+        >>> metric.update(jnp.array([0.8, 0.2, 0.7]), jnp.array([0.9, 0.1, 0.2]))
+        >>> metric.compute()
+        Array(0.5, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, threshold: float, keep_sequence_dim: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(threshold, (int, float)):
+            raise ValueError(f"Expected argument `threshold` to be a float or int, but got {threshold}")
+        self.threshold = float(threshold)
+        if keep_sequence_dim is not None and not isinstance(keep_sequence_dim, bool):
+            raise ValueError(f"Expected argument `keep_sequence_dim` to be bool, but got {keep_sequence_dim}")
+        self.keep_sequence_dim = keep_sequence_dim
+
+        if not keep_sequence_dim:
+            self.add_state("hits", default=jnp.array(0), dist_reduce_fx="sum")
+            self.add_state("misses", default=jnp.array(0), dist_reduce_fx="sum")
+            self.add_state("false_alarms", default=jnp.array(0), dist_reduce_fx="sum")
+        else:
+            self.add_state("hits", default=[], dist_reduce_fx="cat")
+            self.add_state("misses", default=[], dist_reduce_fx="cat")
+            self.add_state("false_alarms", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        hits, misses, false_alarms = _critical_success_index_update(
+            preds, target, self.threshold, self.keep_sequence_dim
+        )
+        if self.keep_sequence_dim:
+            self.hits.append(hits)
+            self.misses.append(misses)
+            self.false_alarms.append(false_alarms)
+        else:
+            self.hits = self.hits + hits
+            self.misses = self.misses + misses
+            self.false_alarms = self.false_alarms + false_alarms
+
+    def compute(self) -> Array:
+        if self.keep_sequence_dim:
+            hits = dim_zero_cat(self.hits)
+            misses = dim_zero_cat(self.misses)
+            false_alarms = dim_zero_cat(self.false_alarms)
+        else:
+            hits, misses, false_alarms = self.hits, self.misses, self.false_alarms
+        return _critical_success_index_compute(hits, misses, false_alarms)

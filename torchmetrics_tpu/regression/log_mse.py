@@ -1,0 +1,83 @@
+"""MeanSquaredLogError + LogCoshError (reference ``regression/{log_mse,log_cosh}.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.log_mse import (
+    _log_cosh_error_compute,
+    _log_cosh_error_update,
+    _mean_squared_log_error_compute,
+    _mean_squared_log_error_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MeanSquaredLogError(Metric):
+    """Mean squared logarithmic error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanSquaredLogError
+        >>> metric = MeanSquaredLogError()
+        >>> metric.update(jnp.array([0., 1., 2., 3.]), jnp.array([0., 1., 2., 2.]))
+        >>> metric.compute()
+        Array(0.02069024, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_squared_log_error", default=jnp.array(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.array(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _mean_squared_log_error_update(preds, target)
+        self.sum_squared_log_error = self.sum_squared_log_error + s
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        return _mean_squared_log_error_compute(self.sum_squared_log_error, self.total)
+
+
+class LogCoshError(Metric):
+    """LogCosh error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import LogCoshError
+        >>> metric = LogCoshError()
+        >>> metric.update(jnp.array([3.0, 5.0, 2.5]), jnp.array([0.25, 5.0, 4.0]))
+        >>> metric.compute()
+        Array(0.9721238, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_log_cosh_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.array(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _log_cosh_error_update(preds, target, self.num_outputs)
+        self.sum_log_cosh_error = self.sum_log_cosh_error + s
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        return _log_cosh_error_compute(self.sum_log_cosh_error, self.total)

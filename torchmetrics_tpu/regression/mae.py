@@ -1,0 +1,47 @@
+"""MeanAbsoluteError (reference ``torchmetrics/regression/mae.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.mae import _mean_absolute_error_compute, _mean_absolute_error_update
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MeanAbsoluteError(Metric):
+    """Mean absolute error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanAbsoluteError
+        >>> metric = MeanAbsoluteError()
+        >>> metric.update(jnp.array([0., 1., 2., 3.]), jnp.array([0., 1., 2., 2.]))
+        >>> metric.compute()
+        Array(0.25, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(num_outputs, int) and num_outputs > 0):
+            raise ValueError(f"Expected num_outputs to be a positive integer but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("sum_abs_error", default=jnp.zeros(num_outputs), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.array(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        sum_abs_error, num_obs = _mean_absolute_error_update(preds, target, self.num_outputs)
+        self.sum_abs_error = self.sum_abs_error + sum_abs_error
+        self.total = self.total + num_obs
+
+    def compute(self) -> Array:
+        return _mean_absolute_error_compute(self.sum_abs_error, self.total)

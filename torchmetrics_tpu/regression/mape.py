@@ -1,0 +1,83 @@
+"""MAPE / SMAPE / WMAPE modular metrics (reference ``regression/{mape,symmetric_mape,wmape}.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.mape import (
+    _mean_absolute_percentage_error_compute,
+    _mean_absolute_percentage_error_update,
+    _symmetric_mean_absolute_percentage_error_update,
+    _weighted_mean_absolute_percentage_error_compute,
+    _weighted_mean_absolute_percentage_error_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class MeanAbsolutePercentageError(Metric):
+    """Mean absolute percentage error.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MeanAbsolutePercentageError
+        >>> metric = MeanAbsolutePercentageError()
+        >>> metric.update(jnp.array([1., 2., 4.]), jnp.array([1., 2., 2.]))
+        >>> metric.compute()
+        Array(0.33333334, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_per_error", default=jnp.array(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + s
+        self.total = self.total + n
+
+    def compute(self) -> Array:
+        return _mean_absolute_percentage_error_compute(self.sum_abs_per_error, self.total)
+
+
+class SymmetricMeanAbsolutePercentageError(MeanAbsolutePercentageError):
+    """Symmetric MAPE (bounded in [0, 2])."""
+
+    plot_upper_bound: float = 2.0
+
+    def update(self, preds: Array, target: Array) -> None:
+        s, n = _symmetric_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_per_error = self.sum_abs_per_error + s
+        self.total = self.total + n
+
+
+class WeightedMeanAbsolutePercentageError(Metric):
+    """Weighted MAPE: sum|p-t| / sum|t|."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("sum_abs_error", default=jnp.array(0.0), dist_reduce_fx="sum")
+        self.add_state("sum_scale", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        e, s = _weighted_mean_absolute_percentage_error_update(preds, target)
+        self.sum_abs_error = self.sum_abs_error + e
+        self.sum_scale = self.sum_scale + s
+
+    def compute(self) -> Array:
+        return _weighted_mean_absolute_percentage_error_compute(self.sum_abs_error, self.sum_scale)

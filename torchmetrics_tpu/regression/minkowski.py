@@ -1,0 +1,48 @@
+"""MinkowskiDistance (reference ``regression/minkowski.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.minkowski import (
+    _minkowski_distance_compute,
+    _minkowski_distance_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+Array = jax.Array
+
+
+class MinkowskiDistance(Metric):
+    """Minkowski distance of order p.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import MinkowskiDistance
+        >>> metric = MinkowskiDistance(p=3)
+        >>> metric.update(jnp.array([1., 2., 3.]), jnp.array([1., 2., 4.]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, p: float, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, (float, int)) and p >= 1):
+            raise TorchMetricsUserError(f"Argument ``p`` must be a float or int greater than 1, but got {p}")
+        self.p = p
+        self.add_state("minkowski_dist_sum", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        self.minkowski_dist_sum = self.minkowski_dist_sum + _minkowski_distance_update(preds, targets, self.p)
+
+    def compute(self) -> Array:
+        return _minkowski_distance_compute(self.minkowski_dist_sum, self.p)

@@ -1,0 +1,94 @@
+"""PearsonCorrCoef + ConcordanceCorrCoef (reference ``regression/{pearson,concordance}.py``).
+
+These are the metrics whose distributed merge is *algorithmic* (SURVEY.md
+§2.5): states are per-process co-moments with ``dist_reduce_fx=None`` (gather,
+don't reduce), and ``compute`` folds the gathered ``(world, ...)`` moment sets
+with the parallel-variance merge in ``_final_aggregation``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.concordance import _concordance_corrcoef_compute
+from torchmetrics_tpu.functional.regression.pearson import (
+    _final_aggregation,
+    _pearson_corrcoef_compute,
+    _pearson_corrcoef_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class PearsonCorrCoef(Metric):
+    """Pearson correlation coefficient.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import PearsonCorrCoef
+        >>> metric = PearsonCorrCoef()
+        >>> metric.update(jnp.array([2.5, 0.0, 2.0, 8.0]), jnp.array([3.0, -0.5, 2.0, 7.0]))
+        >>> metric.compute()
+        Array(0.98486954, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = True
+    plot_lower_bound: float = -1.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, num_outputs: int = 1, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_outputs, int) and num_outputs < 1:
+            raise ValueError("Expected argument `num_outputs` to be an int larger than 0, but got {num_outputs}")
+        self.num_outputs = num_outputs
+        self.add_state("mean_x", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("mean_y", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("var_x", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("var_y", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("corr_xy", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+        self.add_state("n_total", default=jnp.zeros(self.num_outputs), dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total = _pearson_corrcoef_update(
+            preds,
+            target,
+            self.mean_x,
+            self.mean_y,
+            self.var_x,
+            self.var_y,
+            self.corr_xy,
+            self.n_total,
+            self.num_outputs,
+        )
+
+    def _aggregate(self):
+        if self.mean_x.ndim > 1:  # gathered (world, num_outputs) moment sets
+            return _final_aggregation(self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total)
+        return self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
+
+    def compute(self) -> Array:
+        _, _, var_x, var_y, corr_xy, n_total = self._aggregate()
+        return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)
+
+
+class ConcordanceCorrCoef(PearsonCorrCoef):
+    """Lin's concordance correlation coefficient (shares Pearson moment state).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import ConcordanceCorrCoef
+        >>> metric = ConcordanceCorrCoef()
+        >>> metric.update(jnp.array([3.0, 5.0, 2.5, 7.0]), jnp.array([3.0, 5.5, 3.0, 7.0]))
+        >>> metric.compute()
+        Array(0.97969544, dtype=float32)
+    """
+
+    def compute(self) -> Array:
+        mean_x, mean_y, var_x, var_y, corr_xy, n_total = self._aggregate()
+        return _concordance_corrcoef_compute(mean_x, mean_y, var_x, var_y, corr_xy, n_total)

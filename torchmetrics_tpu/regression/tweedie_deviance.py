@@ -1,0 +1,50 @@
+"""TweedieDevianceScore (reference ``regression/tweedie_deviance.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.regression.tweedie_deviance import (
+    _tweedie_deviance_score_compute,
+    _tweedie_deviance_score_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class TweedieDevianceScore(Metric):
+    """Tweedie deviance score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.regression import TweedieDevianceScore
+        >>> metric = TweedieDevianceScore(power=2)
+        >>> metric.update(jnp.array([1.0, 2.0, 3.0]), jnp.array([1.5, 2.5, 4.5]))
+        >>> metric.compute()
+        Array(0.14395078, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = None
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, power: float = 0.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if 0 < power < 1:
+            raise ValueError(f"Deviance Score is not defined for power={power}.")
+        self.power = power
+        self.add_state("sum_deviance_score", default=jnp.array(0.0), dist_reduce_fx="sum")
+        self.add_state("num_observations", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, targets: Array) -> None:
+        sum_deviance_score, num_observations = _tweedie_deviance_score_update(preds, targets, self.power)
+        self.sum_deviance_score = self.sum_deviance_score + sum_deviance_score
+        self.num_observations = self.num_observations + num_observations
+
+    def compute(self) -> Array:
+        return _tweedie_deviance_score_compute(self.sum_deviance_score, self.num_observations)

@@ -51,7 +51,6 @@ def _adjust_weights_safe_divide(
     tp: Array,
     fp: Array,
     fn: Array,
-    top_k: int = 1,
     zero_division: float = 0.0,
 ) -> Array:
     """Apply macro/weighted averaging over per-class scores.

@@ -1,0 +1,123 @@
+"""BootStrapper (reference ``wrappers/bootstrapping.py:54``).
+
+TPU note: the reference keeps N deep-copies and loops them per update. The
+resampling itself (poisson/multinomial index draw) is host-side RNG either
+way; the per-copy updates here reuse the same jitted kernels, so XLA caches a
+single compilation across copies.
+"""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+Array = jax.Array
+
+
+def _bootstrap_sampler(size: int, sampling_strategy: str, rng: np.random.Generator) -> np.ndarray:
+    """Resampling indices for one bootstrap copy (reference ``bootstrapping.py:31``)."""
+    if sampling_strategy == "poisson":
+        p = rng.poisson(1, size)
+        return np.repeat(np.arange(size), p)
+    if sampling_strategy == "multinomial":
+        return rng.integers(0, size, size)
+    raise ValueError("Unknown sampling strategy")
+
+
+class BootStrapper(WrapperMetric):
+    """Bootstrap-resampled uncertainty estimates for any metric.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import BootStrapper
+        >>> from torchmetrics_tpu.classification import MulticlassAccuracy
+        >>> metric = BootStrapper(MulticlassAccuracy(num_classes=3), num_bootstraps=5)
+        >>> metric.update(jnp.array([0, 1, 2, 0]), jnp.array([0, 1, 1, 0]))
+        >>> sorted(metric.compute().keys())
+        ['mean', 'std']
+    """
+
+    full_state_update: Optional[bool] = True
+
+    def __init__(
+        self,
+        base_metric: Metric,
+        num_bootstraps: int = 10,
+        mean: bool = True,
+        std: bool = True,
+        quantile: Optional[Union[float, Array]] = None,
+        raw: bool = False,
+        sampling_strategy: str = "poisson",
+        seed: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(
+                f"Expected base metric to be an instance of torchmetrics_tpu.Metric but received {base_metric}"
+            )
+        self.metrics = [deepcopy(base_metric) for _ in range(num_bootstraps)]
+        self.num_bootstraps = num_bootstraps
+        self.mean = mean
+        self.std = std
+        self.quantile = quantile
+        self.raw = raw
+        allowed_sampling = ("poisson", "multinomial")
+        if sampling_strategy not in allowed_sampling:
+            raise ValueError(
+                f"Expected argument ``sampling_strategy`` to be one of {allowed_sampling}"
+                f" but received {sampling_strategy}"
+            )
+        self.sampling_strategy = sampling_strategy
+        self._rng = np.random.default_rng(seed)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        """Resample the batch per bootstrap copy and update each copy."""
+        args_sizes = [a.shape[0] for a in args if hasattr(a, "shape") and a.ndim > 0]
+        kwargs_sizes = [v.shape[0] for v in kwargs.values() if hasattr(v, "shape") and v.ndim > 0]
+        if args_sizes:
+            size = args_sizes[0]
+        elif kwargs_sizes:
+            size = kwargs_sizes[0]
+        else:
+            raise ValueError("None of the input contained any tensor, so no sampling could be done")
+        for idx in range(self.num_bootstraps):
+            sample_idx = _bootstrap_sampler(size, self.sampling_strategy, self._rng)
+            if sample_idx.size == 0:
+                continue
+            idx_arr = jnp.asarray(sample_idx)
+            new_args = [a[idx_arr] if hasattr(a, "shape") and a.ndim > 0 else a for a in args]
+            new_kwargs = {
+                k: (v[idx_arr] if hasattr(v, "shape") and v.ndim > 0 else v) for k, v in kwargs.items()
+            }
+            self.metrics[idx].update(*new_args, **new_kwargs)
+
+    def compute(self) -> Dict[str, Array]:
+        """Mean/std/quantile/raw over the bootstrap distribution."""
+        computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
+        output: Dict[str, Array] = {}
+        if self.mean:
+            output["mean"] = computed_vals.mean(axis=0)
+        if self.std:
+            output["std"] = computed_vals.std(axis=0, ddof=1)
+        if self.quantile is not None:
+            output["quantile"] = jnp.quantile(computed_vals, self.quantile, axis=0)
+        if self.raw:
+            output["raw"] = computed_vals
+        return output
+
+    def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Array]:
+        self.update(*args, **kwargs)
+        return self.compute()
+
+    def reset(self) -> None:
+        for m in self.metrics:
+            m.reset()
+        super().reset()

@@ -1,0 +1,89 @@
+"""FeatureShare (reference ``wrappers/feature_share.py:45``).
+
+Dedups a shared feature-extractor (e.g. one InceptionV3 trunk for
+FID/KID/InceptionScore) across the members of a collection by replacing each
+member's extractor with a single LRU-cached forward.
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Any, Dict, List, Optional, Sequence, Union
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import Metric
+
+
+class _HashableRef:
+    """Hashable identity wrapper that keeps the wrapped object alive.
+
+    jax arrays aren't hashable, so the LRU cache is keyed on object identity —
+    but the key must hold a strong reference, otherwise a freed array's id can
+    be reused by a new allocation and return stale features.
+    """
+
+    __slots__ = ("obj",)
+
+    def __init__(self, obj: Any) -> None:
+        self.obj = obj
+
+    def __hash__(self) -> int:
+        return id(self.obj)
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, _HashableRef) and other.obj is self.obj
+
+
+class NetworkCache:
+    """Wrap a feature-extractor callable with an LRU cache over input identity."""
+
+    def __init__(self, network: Any, max_size: int = 100) -> None:
+        self.network = network
+        self._cached = lru_cache(maxsize=max_size)(self._forward)
+
+    def _forward(self, ref: "_HashableRef") -> Any:
+        return self.network(ref.obj)
+
+    def __call__(self, x: Any) -> Any:
+        return self._cached(_HashableRef(x))
+
+    def __getattr__(self, name: str) -> Any:
+        return getattr(self.__dict__["network"], name)
+
+
+class FeatureShare(MetricCollection):
+    """A MetricCollection that shares one feature extractor across members.
+
+    Each member metric must expose its extractor via a ``feature_network``
+    attribute naming the submodule to replace.
+    """
+
+    def __init__(
+        self,
+        metrics: Union[Metric, Sequence[Metric], Dict[str, Metric]],
+        max_cache_size: Optional[int] = None,
+    ) -> None:
+        super().__init__(metrics=metrics, compute_groups=False)
+        if max_cache_size is None:
+            max_cache_size = len(self)
+        if not isinstance(max_cache_size, int):
+            raise TypeError(f"max_cache_size should be an integer, but got {max_cache_size}")
+
+        try:
+            first = next(iter(self._modules.values()))
+            network_name = str(first.feature_network)
+            shared_net = getattr(first, network_name)
+        except AttributeError as err:
+            raise AttributeError(
+                "Tried to extract the network to share from the first metric, but it did not have a"
+                " `feature_network` attribute. Please make sure that the metric has an attribute with that name,"
+                " else it cannot be shared."
+            ) from err
+        cached = NetworkCache(shared_net, max_size=max_cache_size)
+        for metric in self._modules.values():
+            if not hasattr(metric, "feature_network"):
+                raise AttributeError(
+                    "Tried to set the cached network to all metrics, but one of the metrics did not have a"
+                    " `feature_network` attribute."
+                )
+            setattr(metric, str(metric.feature_network), cached)

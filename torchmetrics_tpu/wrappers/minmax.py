@@ -1,0 +1,58 @@
+"""MinMaxMetric (reference ``wrappers/minmax.py:29``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MinMaxMetric(WrapperMetric):
+    """Track the running min and max of another metric's compute value.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MinMaxMetric
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> metric = MinMaxMetric(BinaryAccuracy())
+        >>> _ = metric(jnp.array([1.0, 0.0]), jnp.array([1, 1]))
+        >>> sorted(metric.compute().keys())
+        ['max', 'min', 'raw']
+    """
+
+    full_state_update: bool = True
+
+    def __init__(self, base_metric: Metric, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(base_metric, Metric):
+            raise ValueError(f"Expected base metric to be an instance of `Metric` but received {base_metric}")
+        self._base_metric = base_metric
+        self.add_state("min_val", default=jnp.array(jnp.inf), dist_reduce_fx="min")
+        self.add_state("max_val", default=jnp.array(-jnp.inf), dist_reduce_fx="max")
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._base_metric.update(*args, **kwargs)
+
+    def compute(self) -> Dict[str, Any]:
+        val = self._base_metric.compute()
+        if not self._is_suitable_val(val):
+            raise RuntimeError(f"Returned value from base metric should be a float or scalar, but got {val}")
+        val = jnp.asarray(val, dtype=jnp.float32)
+        self.max_val = jnp.where(self.max_val < val, val, self.max_val)
+        self.min_val = jnp.where(self.min_val > val, val, self.min_val)
+        return {"raw": val, "max": self.max_val, "min": self.min_val}
+
+    def reset(self) -> None:
+        super().reset()
+        self._base_metric.reset()
+
+    @staticmethod
+    def _is_suitable_val(val: Any) -> bool:
+        if isinstance(val, (int, float)):
+            return True
+        if hasattr(val, "size"):
+            return val.size == 1
+        return False

@@ -1,0 +1,86 @@
+"""MultitaskWrapper (reference ``wrappers/multitask.py:30``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Union
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MultitaskWrapper(WrapperMetric):
+    """Route per-task (preds, target) dicts to a dict of metrics.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MultitaskWrapper
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> from torchmetrics_tpu.regression import MeanSquaredError
+        >>> metric = MultitaskWrapper({"cls": BinaryAccuracy(), "reg": MeanSquaredError()})
+        >>> preds = {"cls": jnp.array([1, 0]), "reg": jnp.array([1.0, 2.0])}
+        >>> target = {"cls": jnp.array([1, 1]), "reg": jnp.array([1.5, 2.0])}
+        >>> metric.update(preds, target)
+        >>> sorted(metric.compute().keys())
+        ['cls', 'reg']
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        task_metrics: Dict[str, Union[Metric, MetricCollection]],
+        prefix: Optional[str] = None,
+        postfix: Optional[str] = None,
+    ) -> None:
+        super().__init__()
+        if not isinstance(task_metrics, dict):
+            raise TypeError(f"Expected argument `task_metrics` to be a dict. Found task_metrics = {task_metrics}")
+        for metric in task_metrics.values():
+            if not isinstance(metric, (Metric, MetricCollection)):
+                raise TypeError(
+                    "Expected each task's metric to be a Metric or a MetricCollection. "
+                    f"Found a metric of type {type(metric)}"
+                )
+        self.task_metrics = task_metrics
+        self._prefix = prefix or ""
+        self._postfix = postfix or ""
+
+    def _check_all_tasks_covered(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> None:
+        if self.task_metrics.keys() != task_preds.keys() or self.task_metrics.keys() != task_targets.keys():
+            raise ValueError(
+                "Expected arguments `task_preds` and `task_targets` to have the same keys as the wrapped"
+                f" `task_metrics`. Found task_preds.keys() = {task_preds.keys()},"
+                f" task_targets.keys() = {task_targets.keys()}"
+                f" and self.task_metrics.keys() = {self.task_metrics.keys()}"
+            )
+
+    def update(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> None:
+        self._check_all_tasks_covered(task_preds, task_targets)
+        for name, metric in self.task_metrics.items():
+            metric.update(task_preds[name], task_targets[name])
+
+    def compute(self) -> Dict[str, Any]:
+        return {self._prefix + name + self._postfix: metric.compute() for name, metric in self.task_metrics.items()}
+
+    def forward(self, task_preds: Dict[str, Any], task_targets: Dict[str, Any]) -> Dict[str, Any]:
+        self._check_all_tasks_covered(task_preds, task_targets)
+        return {
+            self._prefix + name + self._postfix: metric(task_preds[name], task_targets[name])
+            for name, metric in self.task_metrics.items()
+        }
+
+    def reset(self) -> None:
+        for metric in self.task_metrics.values():
+            metric.reset()
+        super().reset()
+
+    def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MultitaskWrapper":
+        from copy import deepcopy
+
+        mt = deepcopy(self)
+        if prefix is not None:
+            mt._prefix = prefix
+        if postfix is not None:
+            mt._postfix = postfix
+        return mt

@@ -1,0 +1,141 @@
+"""MetricTracker (reference ``wrappers/tracker.py:31``)."""
+
+from __future__ import annotations
+
+from copy import deepcopy
+from typing import Any, Dict, List, Optional, Tuple, Union
+
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+from torchmetrics_tpu.wrappers.abstract import WrapperMetric
+
+
+class MetricTracker(WrapperMetric):
+    """Track a metric (or collection) over multiple steps/epochs.
+
+    ``increment()`` starts a new tracked step (a fresh clone); ``best_metric``
+    returns the best value (optionally with its step index) according to
+    ``maximize`` / the metric's ``higher_is_better``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.wrappers import MetricTracker
+        >>> from torchmetrics_tpu.classification import BinaryAccuracy
+        >>> tracker = MetricTracker(BinaryAccuracy())
+        >>> for epoch_acc in ([1, 1], [1, 0]):
+        ...     tracker.increment()
+        ...     _ = tracker(jnp.array(epoch_acc), jnp.array([1, 1]))
+        >>> float(tracker.best_metric())
+        1.0
+    """
+
+    def __init__(self, metric: Union[Metric, MetricCollection], maximize: Union[bool, List[bool], None] = True) -> None:
+        super().__init__()
+        if not isinstance(metric, (Metric, MetricCollection)):
+            raise TypeError(
+                "Metric arg need to be an instance of a Metric or MetricCollection" f" but got {metric}"
+            )
+        self._base_metric = metric
+        if maximize is None:
+            if isinstance(metric, Metric):
+                if metric.higher_is_better is None:
+                    raise AttributeError("`higher_is_better` undefined; provide `maximize` explicitly")
+                maximize = metric.higher_is_better
+            else:
+                maximize = [
+                    m.higher_is_better if m.higher_is_better is not None else True for m in metric.values()
+                ]
+        if not isinstance(maximize, (bool, list)):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        if isinstance(maximize, list) and not all(isinstance(m, bool) for m in maximize):
+            raise ValueError("Argument `maximize` should either be a single bool or list of bool")
+        self.maximize = maximize
+        self._steps: List[Union[Metric, MetricCollection]] = []
+        self._increment_called = False
+
+    @property
+    def n_steps(self) -> int:
+        """Number of tracked steps."""
+        return len(self._steps)
+
+    def increment(self) -> None:
+        """Start tracking a new step."""
+        self._increment_called = True
+        self._steps.append(deepcopy(self._base_metric))
+        self._steps[-1].reset()
+
+    def _check_for_increment(self, method: str) -> None:
+        if not self._increment_called:
+            raise ValueError(f"`{method}` cannot be called before `.increment()` has been called.")
+
+    def forward(self, *args: Any, **kwargs: Any) -> Any:
+        self._check_for_increment("forward")
+        return self._steps[-1](*args, **kwargs)
+
+    def update(self, *args: Any, **kwargs: Any) -> None:
+        self._check_for_increment("update")
+        self._steps[-1].update(*args, **kwargs)
+
+    def compute(self) -> Any:
+        self._check_for_increment("compute")
+        return self._steps[-1].compute()
+
+    def compute_all(self) -> Any:
+        """Compute every tracked step; stacked array (or dict of stacked arrays)."""
+        self._check_for_increment("compute_all")
+        res = [step.compute() for step in self._steps]
+        if isinstance(self._base_metric, MetricCollection):
+            keys = res[0].keys()
+            return {k: jnp.stack([r[k] for r in res], axis=0) for k in keys}
+        return jnp.stack(res, axis=0)
+
+    def best_metric(
+        self, return_step: bool = False
+    ) -> Any:
+        """Best value over tracked steps (optionally with its step index)."""
+        res = self.compute_all()
+
+        def _best(vals: Any, maximize: bool) -> Tuple[Any, int]:
+            arr = np.asarray(vals)
+            if arr.ndim != 1:
+                raise ValueError("Per-step values are not scalars; cannot determine best")
+            idx = int(np.argmax(arr) if maximize else np.argmin(arr))
+            return vals[idx], idx
+
+        try:
+            if isinstance(res, dict):
+                maximize = self.maximize if isinstance(self.maximize, list) else [self.maximize] * len(res)
+                value, idx = {}, {}
+                for i, (k, v) in enumerate(res.items()):
+                    value[k], idx[k] = _best(v, maximize[i])
+                if return_step:
+                    return value, idx
+                return value
+            value, idx = _best(res, bool(self.maximize))
+            if return_step:
+                return value, idx
+            return value
+        except (ValueError, TypeError) as err:
+            rank_zero_warn(
+                f"Encountered the following error when trying to get the best metric: {err}"
+                " this is probably due to the 'best' not being defined for this metric."
+                " Returning `None` instead.",
+                UserWarning,
+            )
+            if return_step:
+                return None, None
+            return None
+
+    def reset(self) -> None:
+        """Reset the current step."""
+        if self._steps:
+            self._steps[-1].reset()
+
+    def reset_all(self) -> None:
+        """Forget all tracked steps."""
+        self._steps = []
+        self._increment_called = False
