@@ -121,3 +121,27 @@ def test_compositional():
     assert float(comp.compute()) == 1.0
     comp2 = 1.0 - a
     assert np.allclose(float(comp2.compute()), 0.5)
+
+
+def test_minmax_forward_reference_vector():
+    """Exact parity with the reference's own forward test
+    (reference tests/unittests/wrappers/test_minmax.py::test_basic_example)."""
+    preds = ([[0.9, 0.1], [0.2, 0.8]], [[0.1, 0.9], [0.2, 0.8]], [[0.1, 0.9], [0.8, 0.2]])
+    labels = jnp.array([[0, 1], [0, 1]])
+    raws, maxs, mins = (0.5, 1.0, 0.5), (0.5, 1.0, 1.0), (0.5, 0.5, 0.5)
+    mm = MinMaxMetric(BinaryAccuracy())
+    for i in range(3):
+        mm(jnp.array(preds[i]), labels)
+        out = mm.compute()
+        assert abs(float(out["raw"]) - raws[i]) < 1e-6
+        assert abs(float(out["max"]) - maxs[i]) < 1e-6
+        assert abs(float(out["min"]) - mins[i]) < 1e-6
+
+
+def test_kendall_invalid_variant_fails_fast():
+    import pytest as _pytest
+
+    from torchmetrics_tpu.regression import KendallRankCorrCoef
+
+    with _pytest.raises(ValueError):
+        KendallRankCorrCoef(variant="zz")

@@ -1,0 +1,204 @@
+"""Curve-family metrics vs sklearn oracles (PR curve, ROC, AUROC, AP)."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from sklearn.metrics import (
+    average_precision_score,
+    precision_recall_curve as sk_precision_recall_curve,
+    roc_auc_score,
+    roc_curve as sk_roc_curve,
+)
+
+from torchmetrics_tpu.classification import (
+    BinaryAUROC,
+    BinaryAveragePrecision,
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAUROC,
+    MulticlassAveragePrecision,
+    MultilabelAUROC,
+    MultilabelAveragePrecision,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_auroc,
+    binary_average_precision,
+    binary_precision_recall_curve,
+    binary_roc,
+    multiclass_auroc,
+    multiclass_average_precision,
+    multilabel_auroc,
+)
+
+N = 128
+NUM_CLASSES = 4
+
+
+@pytest.fixture
+def binary_data():
+    rng = np.random.default_rng(11)
+    return rng.random(N).astype(np.float32), rng.integers(0, 2, N)
+
+
+@pytest.fixture
+def mc_data():
+    rng = np.random.default_rng(12)
+    logits = rng.random((N, NUM_CLASSES)).astype(np.float32)
+    preds = logits / logits.sum(1, keepdims=True)
+    return preds, rng.integers(0, NUM_CLASSES, N)
+
+
+@pytest.fixture
+def ml_data():
+    rng = np.random.default_rng(13)
+    return rng.random((N, 3)).astype(np.float32), rng.integers(0, 2, (N, 3))
+
+
+def test_binary_pr_curve_exact(binary_data):
+    p, t = binary_data
+    prec, rec, thr = binary_precision_recall_curve(jnp.asarray(p), jnp.asarray(t))
+    sk_prec, sk_rec, sk_thr = sk_precision_recall_curve(t, p)
+    assert np.allclose(np.asarray(prec), sk_prec, atol=1e-5)
+    assert np.allclose(np.asarray(rec), sk_rec, atol=1e-5)
+    assert np.allclose(np.asarray(thr), sk_thr, atol=1e-5)
+
+
+def test_binary_roc_exact(binary_data):
+    p, t = binary_data
+    fpr, tpr, thr = binary_roc(jnp.asarray(p), jnp.asarray(t))
+    sk_fpr, sk_tpr, _ = sk_roc_curve(t, p, drop_intermediate=False)
+    assert np.allclose(np.asarray(fpr), sk_fpr, atol=1e-5)
+    assert np.allclose(np.asarray(tpr), sk_tpr, atol=1e-5)
+
+
+def test_binary_auroc_exact(binary_data):
+    p, t = binary_data
+    assert np.allclose(float(binary_auroc(jnp.asarray(p), jnp.asarray(t))), roc_auc_score(t, p), atol=1e-5)
+
+
+def test_binary_auroc_binned_close(binary_data):
+    p, t = binary_data
+    binned = float(binary_auroc(jnp.asarray(p), jnp.asarray(t), thresholds=200))
+    assert abs(binned - roc_auc_score(t, p)) < 0.02
+
+
+def test_binary_ap_exact(binary_data):
+    p, t = binary_data
+    assert np.allclose(
+        float(binary_average_precision(jnp.asarray(p), jnp.asarray(t))), average_precision_score(t, p), atol=1e-5
+    )
+
+
+def test_binary_modular_streaming_exact(binary_data):
+    p, t = binary_data
+    for m_cls, fn in [
+        (BinaryAUROC, roc_auc_score),
+        (BinaryAveragePrecision, average_precision_score),
+    ]:
+        m = m_cls()
+        for ps, ts in zip(np.array_split(p, 4), np.array_split(t, 4)):
+            m.update(jnp.asarray(ps), jnp.asarray(ts))
+        assert np.allclose(float(m.compute()), fn(t, p), atol=1e-5), m_cls.__name__
+
+
+def test_binary_modular_streaming_binned(binary_data):
+    p, t = binary_data
+    m = BinaryAUROC(thresholds=200)
+    for ps, ts in zip(np.array_split(p, 4), np.array_split(t, 4)):
+        m.update(jnp.asarray(ps), jnp.asarray(ts))
+    assert abs(float(m.compute()) - roc_auc_score(t, p)) < 0.02
+    assert m.confmat.shape == (200, 2, 2)
+
+
+def test_binary_pr_curve_binned_endpoints(binary_data):
+    p, t = binary_data
+    m = BinaryPrecisionRecallCurve(thresholds=11)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    prec, rec, thr = m.compute()
+    assert prec.shape == (12,) and rec.shape == (12,) and thr.shape == (11,)
+    assert float(prec[-1]) == 1.0 and float(rec[-1]) == 0.0
+
+
+def test_binary_roc_binned_monotone(binary_data):
+    p, t = binary_data
+    m = BinaryROC(thresholds=21)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    fpr, tpr, thr = m.compute()
+    assert np.all(np.diff(np.asarray(fpr)) >= -1e-6)
+    assert np.all(np.diff(np.asarray(tpr)) >= -1e-6)
+
+
+def test_multiclass_auroc_exact(mc_data):
+    p, t = mc_data
+    expected = roc_auc_score(t, p, multi_class="ovr", average="macro")
+    got = float(multiclass_auroc(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES, average="macro"))
+    assert np.allclose(got, expected, atol=1e-4)
+
+
+def test_multiclass_auroc_modular_binned(mc_data):
+    p, t = mc_data
+    expected = roc_auc_score(t, p, multi_class="ovr", average="macro")
+    m = MulticlassAUROC(num_classes=NUM_CLASSES, thresholds=200)
+    for ps, ts in zip(np.array_split(p, 4), np.array_split(t, 4)):
+        m.update(jnp.asarray(ps), jnp.asarray(ts))
+    assert abs(float(m.compute()) - expected) < 0.02
+
+
+def test_multiclass_ap_exact(mc_data):
+    p, t = mc_data
+    t_oh = np.eye(NUM_CLASSES)[t]
+    expected = np.mean([average_precision_score(t_oh[:, i], p[:, i]) for i in range(NUM_CLASSES)])
+    got = float(multiclass_average_precision(jnp.asarray(p), jnp.asarray(t), NUM_CLASSES, average="macro"))
+    assert np.allclose(got, expected, atol=1e-4)
+
+
+def test_multiclass_ap_modular(mc_data):
+    p, t = mc_data
+    t_oh = np.eye(NUM_CLASSES)[t]
+    expected = np.mean([average_precision_score(t_oh[:, i], p[:, i]) for i in range(NUM_CLASSES)])
+    m = MulticlassAveragePrecision(num_classes=NUM_CLASSES)
+    for ps, ts in zip(np.array_split(p, 4), np.array_split(t, 4)):
+        m.update(jnp.asarray(ps), jnp.asarray(ts))
+    assert np.allclose(float(m.compute()), expected, atol=1e-4)
+
+
+def test_multilabel_auroc_exact(ml_data):
+    p, t = ml_data
+    expected = roc_auc_score(t, p, average="macro")
+    got = float(multilabel_auroc(jnp.asarray(p), jnp.asarray(t), 3, average="macro"))
+    assert np.allclose(got, expected, atol=1e-4)
+
+
+def test_multilabel_ap_modular(ml_data):
+    p, t = ml_data
+    expected = average_precision_score(t, p, average="macro")
+    m = MultilabelAveragePrecision(num_labels=3)
+    for ps, ts in zip(np.array_split(p, 4), np.array_split(t, 4)):
+        m.update(jnp.asarray(ps), jnp.asarray(ts))
+    assert np.allclose(float(m.compute()), expected, atol=1e-4)
+
+
+def test_multilabel_auroc_modular_binned(ml_data):
+    p, t = ml_data
+    expected = roc_auc_score(t, p, average="macro")
+    m = MultilabelAUROC(num_labels=3, thresholds=200)
+    for ps, ts in zip(np.array_split(p, 4), np.array_split(t, 4)):
+        m.update(jnp.asarray(ps), jnp.asarray(ts))
+    assert abs(float(m.compute()) - expected) < 0.02
+
+
+def test_binned_update_jits(binary_data):
+    """The binned update must be jit-compilable (fixed shapes)."""
+    import jax
+
+    p, t = binary_data
+    from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+        _binary_precision_recall_curve_update,
+    )
+
+    thresholds = jnp.linspace(0, 1, 50)
+    fn = jax.jit(lambda pp, tt: _binary_precision_recall_curve_update(pp, tt, thresholds))
+    out = fn(jnp.asarray(p), jnp.asarray(t))
+    assert out.shape == (50, 2, 2)
+    assert int(out[0].sum()) == N

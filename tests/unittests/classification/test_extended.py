@@ -1,0 +1,214 @@
+"""Extended classification metrics vs sklearn oracles.
+
+Jaccard, Cohen's kappa, MCC, calibration, hinge, ranking, fairness, dice,
+operating-point metrics.
+"""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from sklearn.metrics import (
+    cohen_kappa_score,
+    coverage_error as sk_coverage_error,
+    hinge_loss as sk_hinge_loss,
+    jaccard_score,
+    label_ranking_average_precision_score,
+    label_ranking_loss,
+    matthews_corrcoef as sk_mcc,
+)
+
+import torchmetrics_tpu.classification as C
+import torchmetrics_tpu.functional.classification as F
+
+N = 96
+NUM_CLASSES = 4
+
+
+@pytest.fixture
+def binary_data():
+    rng = np.random.default_rng(21)
+    return rng.integers(0, 2, N), rng.integers(0, 2, N)
+
+
+@pytest.fixture
+def mc_data():
+    rng = np.random.default_rng(22)
+    return rng.integers(0, NUM_CLASSES, N), rng.integers(0, NUM_CLASSES, N)
+
+
+@pytest.fixture
+def ml_scores():
+    rng = np.random.default_rng(23)
+    return rng.random((N, 3)).astype(np.float32), rng.integers(0, 2, (N, 3))
+
+
+def _stream(metric, p, t, splits=3):
+    for ps, ts in zip(np.array_split(p, splits), np.array_split(t, splits)):
+        metric.update(jnp.asarray(ps), jnp.asarray(ts))
+    return metric.compute()
+
+
+def test_binary_jaccard(binary_data):
+    p, t = binary_data
+    m = C.BinaryJaccardIndex()
+    assert np.allclose(float(_stream(m, p, t)), jaccard_score(t, p), atol=1e-5)
+
+
+def test_multiclass_jaccard(mc_data):
+    p, t = mc_data
+    for avg in ("macro", "micro", "weighted"):
+        m = C.MulticlassJaccardIndex(num_classes=NUM_CLASSES, average=avg)
+        assert np.allclose(float(_stream(m, p, t)), jaccard_score(t, p, average=avg), atol=1e-5), avg
+
+
+def test_multilabel_jaccard(ml_scores):
+    p, t = ml_scores
+    pb = (p > 0.5).astype(int)
+    m = C.MultilabelJaccardIndex(num_labels=3, average="macro")
+    assert np.allclose(float(_stream(m, pb, t)), jaccard_score(t, pb, average="macro"), atol=1e-5)
+
+
+def test_binary_cohen_kappa(binary_data):
+    p, t = binary_data
+    m = C.BinaryCohenKappa()
+    assert np.allclose(float(_stream(m, p, t)), cohen_kappa_score(t, p), atol=1e-5)
+
+
+@pytest.mark.parametrize("weights", [None, "linear", "quadratic"])
+def test_multiclass_cohen_kappa(mc_data, weights):
+    p, t = mc_data
+    m = C.MulticlassCohenKappa(num_classes=NUM_CLASSES, weights=weights)
+    assert np.allclose(float(_stream(m, p, t)), cohen_kappa_score(t, p, weights=weights), atol=1e-5)
+
+
+def test_binary_mcc(binary_data):
+    p, t = binary_data
+    m = C.BinaryMatthewsCorrCoef()
+    assert np.allclose(float(_stream(m, p, t)), sk_mcc(t, p), atol=1e-5)
+
+
+def test_multiclass_mcc(mc_data):
+    p, t = mc_data
+    m = C.MulticlassMatthewsCorrCoef(num_classes=NUM_CLASSES)
+    assert np.allclose(float(_stream(m, p, t)), sk_mcc(t, p), atol=1e-5)
+
+
+def test_binary_calibration_error():
+    rng = np.random.default_rng(24)
+    p = rng.random(256).astype(np.float32)
+    t = (rng.random(256) < p).astype(int)
+    m = C.BinaryCalibrationError(n_bins=10, norm="l1")
+    got = float(_stream(m, p, t))
+    # manual binned ECE oracle
+    conf = np.where(p > 0.5, p, 1 - p)
+    acc = ((p > 0.5).astype(int) == t).astype(float)
+    bins = np.linspace(0, 1, 11)
+    idx = np.clip(np.searchsorted(bins[1:-1], conf, side="right"), 0, 9)
+    ece = 0.0
+    for b in range(10):
+        mask = idx == b
+        if mask.sum():
+            ece += abs(acc[mask].mean() - conf[mask].mean()) * mask.mean()
+    assert np.allclose(got, ece, atol=1e-5)
+
+
+def test_binary_hinge(binary_data):
+    rng = np.random.default_rng(25)
+    p = rng.random(N).astype(np.float32)
+    t = binary_data[1]
+    m = C.BinaryHingeLoss()
+    expected = np.mean(np.maximum(0, 1 - np.where(t == 1, 1.0, -1.0) * p))
+    assert np.allclose(float(_stream(m, p, t)), expected, atol=1e-5)
+
+
+def test_multiclass_hinge():
+    rng = np.random.default_rng(26)
+    p = rng.random((N, NUM_CLASSES)).astype(np.float32)
+    p = p / p.sum(1, keepdims=True)
+    t = rng.integers(0, NUM_CLASSES, N)
+    m = C.MulticlassHingeLoss(num_classes=NUM_CLASSES)
+    got = float(_stream(m, p, t))
+    expected = sk_hinge_loss(t, p, labels=list(range(NUM_CLASSES)))
+    assert np.allclose(got, expected, atol=1e-4)
+
+
+def test_ranking_metrics(ml_scores):
+    p, t = ml_scores
+    m = C.MultilabelCoverageError(num_labels=3)
+    assert np.allclose(float(_stream(m, p, t)), sk_coverage_error(t, p), atol=1e-4)
+    m = C.MultilabelRankingAveragePrecision(num_labels=3)
+    assert np.allclose(float(_stream(m, p, t)), label_ranking_average_precision_score(t, p), atol=1e-4)
+    m = C.MultilabelRankingLoss(num_labels=3)
+    assert np.allclose(float(_stream(m, p, t)), label_ranking_loss(t, p), atol=1e-4)
+
+
+def test_group_stat_rates():
+    preds = jnp.array([1, 0, 1, 0])
+    target = jnp.array([1, 0, 0, 1])
+    groups = jnp.array([0, 0, 1, 1])
+    m = C.BinaryGroupStatRates(num_groups=2)
+    m.update(preds, target, groups)
+    out = m.compute()
+    assert np.allclose(np.asarray(out["group_0"]), [0.5, 0, 0.5, 0])  # tp, fp, tn, fn rates
+    assert np.allclose(np.asarray(out["group_1"]), [0, 0.5, 0, 0.5])
+
+
+def test_binary_fairness():
+    preds = jnp.array([1, 0, 1, 0, 1, 1])
+    target = jnp.array([1, 0, 0, 1, 1, 0])
+    groups = jnp.array([0, 0, 0, 1, 1, 1])
+    m = C.BinaryFairness(num_groups=2)
+    m.update(preds, target, groups)
+    out = m.compute()
+    assert any(k.startswith("DP") for k in out)
+    assert any(k.startswith("EO") for k in out)
+
+
+def test_dice(mc_data):
+    p, t = mc_data
+    m = C.Dice(num_classes=NUM_CLASSES, average="micro")
+    got = float(_stream(m, p, t))
+    # micro dice == micro f1 == accuracy for multiclass single-label
+    from sklearn.metrics import f1_score
+
+    assert np.allclose(got, f1_score(t, p, average="micro"), atol=1e-5)
+
+
+def test_recall_at_fixed_precision():
+    p = jnp.array([0.1, 0.4, 0.6, 0.8])
+    t = jnp.array([0, 1, 1, 1])
+    rec, thr = F.binary_recall_at_fixed_precision(p, t, min_precision=1.0)
+    assert float(rec) == 1.0
+    m = C.BinaryRecallAtFixedPrecision(min_precision=1.0)
+    m.update(p, t)
+    rec2, thr2 = m.compute()
+    assert float(rec2) == 1.0
+
+
+def test_precision_at_fixed_recall():
+    p = jnp.array([0.1, 0.4, 0.6, 0.8])
+    t = jnp.array([0, 0, 1, 1])
+    prec, thr = F.binary_precision_at_fixed_recall(p, t, min_recall=1.0)
+    assert float(prec) == 1.0
+
+
+def test_specificity_at_sensitivity():
+    p = jnp.array([0.1, 0.4, 0.6, 0.8])
+    t = jnp.array([0, 0, 1, 1])
+    spec, thr = F.binary_specificity_at_sensitivity(p, t, min_sensitivity=1.0)
+    assert float(spec) == 1.0
+    sens, thr = F.binary_sensitivity_at_specificity(p, t, min_specificity=1.0)
+    assert float(sens) == 1.0
+
+
+def test_multiclass_recall_at_fixed_precision():
+    rng = np.random.default_rng(27)
+    p = rng.random((N, NUM_CLASSES)).astype(np.float32)
+    p = p / p.sum(1, keepdims=True)
+    t = rng.integers(0, NUM_CLASSES, N)
+    m = C.MulticlassRecallAtFixedPrecision(num_classes=NUM_CLASSES, min_precision=0.5)
+    m.update(jnp.asarray(p), jnp.asarray(t))
+    rec, thr = m.compute()
+    assert rec.shape == (NUM_CLASSES,)
+    assert np.all(np.asarray(rec) >= 0) and np.all(np.asarray(rec) <= 1)

@@ -1,0 +1,78 @@
+"""Modular Dice score (reference ``classification/dice.py``) — stat-scores state."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.dice import _dice_compute
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_format,
+    _binary_stat_scores_update,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_update,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class Dice(Metric):
+    """Dice score: ``2·tp / (2·tp + fp + fn)``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import Dice
+        >>> metric = Dice(num_classes=3, average='micro')
+        >>> metric.update(jnp.array([2, 0, 2, 1]), jnp.array([1, 1, 2, 0]))
+        >>> metric.compute()
+        Array(0.25, dtype=float32)
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        zero_division: float = 0.0,
+        num_classes: Optional[int] = None,
+        threshold: float = 0.5,
+        average: Optional[str] = "micro",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+        if average not in allowed_average:
+            raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        self.zero_division = zero_division
+        self.num_classes = num_classes
+        self.threshold = threshold
+        self.average = average
+        self.ignore_index = ignore_index
+        n = num_classes if num_classes is not None else 1
+        self.add_state("tp", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("fp", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.add_state("fn", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.num_classes is None:
+            p, t, valid = _binary_stat_scores_format(preds, target, self.threshold, self.ignore_index)
+            tp, fp, tn, fn = _binary_stat_scores_update(p, t, valid)
+            tp, fp, fn = tp[None], fp[None], fn[None]
+        else:
+            p, t = _multiclass_stat_scores_format(preds, target)
+            tp, fp, tn, fn = _multiclass_stat_scores_update(
+                p, t, self.num_classes, 1, "global", self.ignore_index
+            )
+        self.tp = self.tp + tp
+        self.fp = self.fp + fp
+        self.fn = self.fn + fn
+
+    def compute(self) -> Array:
+        return _dice_compute(self.tp, self.fp, self.fn, self.average, self.zero_division)

@@ -1,0 +1,139 @@
+"""Modular group-fairness metrics (reference ``classification/group_fairness.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.group_fairness import (
+    _binary_groups_stat_scores,
+    _compute_binary_demographic_parity,
+    _compute_binary_equal_opportunity,
+    _groups_reduce,
+    _groups_stat_transform,
+)
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class _AbstractGroupStatScores(Metric):
+    """Holds per-group tp/fp/tn/fn states."""
+
+    def _create_states(self, num_groups: int) -> None:
+        default = lambda: jnp.zeros(num_groups, dtype=jnp.int32)  # noqa: E731
+        self.add_state("tp", default(), dist_reduce_fx="sum")
+        self.add_state("fp", default(), dist_reduce_fx="sum")
+        self.add_state("tn", default(), dist_reduce_fx="sum")
+        self.add_state("fn", default(), dist_reduce_fx="sum")
+
+    def _update_states(self, group_stats) -> None:
+        self.tp = self.tp + jnp.stack([s[0] for s in group_stats])
+        self.fp = self.fp + jnp.stack([s[1] for s in group_stats])
+        self.tn = self.tn + jnp.stack([s[2] for s in group_stats])
+        self.fn = self.fn + jnp.stack([s[3] for s in group_stats])
+
+
+class BinaryGroupStatRates(_AbstractGroupStatScores):
+    """Per-group tp/fp/tn/fn rates.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryGroupStatRates
+        >>> metric = BinaryGroupStatRates(num_groups=2)
+        >>> metric.update(jnp.array([1, 0, 1, 0]), jnp.array([1, 0, 0, 1]), jnp.array([0, 0, 1, 1]))
+        >>> sorted(metric.compute().keys())
+        ['group_0', 'group_1']
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(num_groups, int) or num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Array, groups: Array) -> None:
+        group_stats = _binary_groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(group_stats)
+
+    def compute(self) -> Dict[str, Array]:
+        return _groups_reduce([(self.tp[g], self.fp[g], self.tn[g], self.fn[g]) for g in range(self.num_groups)])
+
+
+class BinaryFairness(_AbstractGroupStatScores):
+    """Demographic parity / equal opportunity across groups.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryFairness
+        >>> metric = BinaryFairness(num_groups=2)
+        >>> metric.update(jnp.array([1, 0, 1, 0]), jnp.array([1, 0, 0, 1]), jnp.array([0, 0, 1, 1]))
+        >>> sorted(metric.compute().keys())
+        ['DP_0_1', 'EO_0_1']
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+
+    def __init__(
+        self,
+        num_groups: int,
+        task: str = "all",
+        threshold: float = 0.5,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if task not in ["demographic_parity", "equal_opportunity", "all"]:
+            raise ValueError(
+                f"Expected argument `task` to either be ``demographic_parity``,"
+                f"``equal_opportunity`` or ``all`` but got {task}."
+            )
+        if not isinstance(num_groups, int) or num_groups < 2:
+            raise ValueError(f"Expected argument `num_groups` to be an int larger than 1, but got {num_groups}")
+        self.num_groups = num_groups
+        self.task = task
+        self.threshold = threshold
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self._create_states(num_groups)
+
+    def update(self, preds: Array, target: Array, groups: Array) -> None:
+        if self.task == "demographic_parity":
+            target = jnp.zeros(jnp.asarray(preds).shape, dtype=jnp.int32)
+        group_stats = _binary_groups_stat_scores(
+            preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
+        )
+        self._update_states(group_stats)
+
+    def compute(self) -> Dict[str, Array]:
+        stats = {"tp": self.tp, "fp": self.fp, "tn": self.tn, "fn": self.fn}
+        if self.task == "demographic_parity":
+            return _compute_binary_demographic_parity(**stats)
+        if self.task == "equal_opportunity":
+            return _compute_binary_equal_opportunity(**stats)
+        return {
+            **_compute_binary_demographic_parity(**stats),
+            **_compute_binary_equal_opportunity(**stats),
+        }

@@ -1,0 +1,143 @@
+"""Modular hinge loss (reference ``classification/hinge.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.classification.base import _ClassificationTaskWrapper
+from torchmetrics_tpu.functional.classification.hinge import (
+    _binary_hinge_loss_arg_validation,
+    _binary_hinge_loss_tensor_validation,
+    _binary_hinge_loss_update,
+    _hinge_loss_compute,
+    _multiclass_hinge_loss_arg_validation,
+    _multiclass_hinge_loss_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed
+from torchmetrics_tpu.utilities.enums import ClassificationTaskNoMultilabel
+
+Array = jax.Array
+
+
+class BinaryHingeLoss(Metric):
+    """Hinge loss for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.classification import BinaryHingeLoss
+        >>> metric = BinaryHingeLoss()
+        >>> metric.update(jnp.array([0.25, 0.25, 0.55, 0.75, 0.75]), jnp.array([0, 0, 1, 1, 1]))
+        >>> metric.compute()
+        Array(0.69, dtype=float32)
+    """
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        squared: bool = False,
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _binary_hinge_loss_arg_validation(squared, ignore_index)
+        self.squared = squared
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measures", jnp.array(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        if self.validate_args:
+            _binary_hinge_loss_tensor_validation(preds, target, self.ignore_index)
+        preds = jnp.asarray(preds, jnp.float32).reshape(-1)
+        target = jnp.asarray(target).reshape(-1)
+        if self.ignore_index is not None:
+            keep = jnp.nonzero(target != self.ignore_index)[0]
+            preds = preds[keep]
+            target = target[keep]
+        measures, total = _binary_hinge_loss_update(preds, target, self.squared)
+        self.measures = self.measures + measures
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hinge_loss_compute(self.measures, self.total)
+
+
+class MulticlassHingeLoss(Metric):
+    """Hinge loss for multiclass tasks (crammer-singer or one-vs-all)."""
+
+    is_differentiable = True
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        num_classes: int,
+        squared: bool = False,
+        multiclass_mode: str = "crammer-singer",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if validate_args:
+            _multiclass_hinge_loss_arg_validation(num_classes, squared, multiclass_mode, ignore_index)
+        self.num_classes = num_classes
+        self.squared = squared
+        self.multiclass_mode = multiclass_mode
+        self.ignore_index = ignore_index
+        self.validate_args = validate_args
+        self.add_state("measures", jnp.array(0.0), dist_reduce_fx="sum")
+        self.add_state("total", jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        preds = jnp.asarray(preds, jnp.float32)
+        target = jnp.asarray(target).reshape(-1)
+        preds = jnp.moveaxis(preds, 1, -1).reshape(-1, self.num_classes)
+        if self.ignore_index is not None:
+            keep = jnp.nonzero(target != self.ignore_index)[0]
+            preds = preds[keep]
+            target = target[keep]
+        measures, total = _multiclass_hinge_loss_update(
+            preds, target, self.num_classes, self.squared, self.multiclass_mode
+        )
+        self.measures = self.measures + measures
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _hinge_loss_compute(self.measures, self.total)
+
+
+class HingeLoss(_ClassificationTaskWrapper):
+    """Task-dispatching hinge loss (binary/multiclass)."""
+
+    def __new__(  # type: ignore[misc]
+        cls,
+        task: str,
+        num_classes: Optional[int] = None,
+        squared: bool = False,
+        multiclass_mode: str = "crammer-singer",
+        ignore_index: Optional[int] = None,
+        validate_args: bool = True,
+        **kwargs: Any,
+    ):
+        task = ClassificationTaskNoMultilabel.from_str(task)
+        kwargs.update({"squared": squared, "ignore_index": ignore_index, "validate_args": validate_args})
+        if task == ClassificationTaskNoMultilabel.BINARY:
+            return BinaryHingeLoss(**kwargs)
+        if task == ClassificationTaskNoMultilabel.MULTICLASS:
+            if not isinstance(num_classes, int):
+                raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+            return MulticlassHingeLoss(num_classes, multiclass_mode=multiclass_mode, **kwargs)
+        raise ValueError(f"Task {task} not supported!")

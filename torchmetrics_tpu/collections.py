@@ -17,12 +17,11 @@ from __future__ import annotations
 
 from collections import OrderedDict
 from copy import deepcopy
-from typing import Any, Dict, Hashable, Iterable, List, Optional, Sequence, Tuple, Union
+from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 
 from torchmetrics_tpu.metric import Metric
-from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 
 __all__ = ["MetricCollection"]
 

@@ -1,5 +1,80 @@
 """Functional classification kernels."""
 
+from torchmetrics_tpu.functional.classification.calibration_error import (
+    binary_calibration_error,
+    calibration_error,
+    multiclass_calibration_error,
+)
+from torchmetrics_tpu.functional.classification.cohen_kappa import (
+    binary_cohen_kappa,
+    cohen_kappa,
+    multiclass_cohen_kappa,
+)
+from torchmetrics_tpu.functional.classification.dice import dice
+from torchmetrics_tpu.functional.classification.group_fairness import (
+    binary_fairness,
+    binary_groups_stat_rates,
+    demographic_parity,
+    equal_opportunity,
+)
+from torchmetrics_tpu.functional.classification.hinge import binary_hinge_loss, hinge_loss, multiclass_hinge_loss
+from torchmetrics_tpu.functional.classification.jaccard import (
+    binary_jaccard_index,
+    jaccard_index,
+    multiclass_jaccard_index,
+    multilabel_jaccard_index,
+)
+from torchmetrics_tpu.functional.classification.matthews_corrcoef import (
+    binary_matthews_corrcoef,
+    matthews_corrcoef,
+    multiclass_matthews_corrcoef,
+    multilabel_matthews_corrcoef,
+)
+from torchmetrics_tpu.functional.classification.ranking import (
+    multilabel_coverage_error,
+    multilabel_ranking_average_precision,
+    multilabel_ranking_loss,
+)
+from torchmetrics_tpu.functional.classification.recall_fixed_precision import (
+    binary_precision_at_fixed_recall,
+    binary_recall_at_fixed_precision,
+    multiclass_precision_at_fixed_recall,
+    multiclass_recall_at_fixed_precision,
+    multilabel_precision_at_fixed_recall,
+    multilabel_recall_at_fixed_precision,
+)
+from torchmetrics_tpu.functional.classification.specificity_sensitivity import (
+    binary_sensitivity_at_specificity,
+    binary_specificity_at_sensitivity,
+    multiclass_sensitivity_at_specificity,
+    multiclass_specificity_at_sensitivity,
+    multilabel_sensitivity_at_specificity,
+    multilabel_specificity_at_sensitivity,
+)
+from torchmetrics_tpu.functional.classification.auroc import (
+    auroc,
+    binary_auroc,
+    multiclass_auroc,
+    multilabel_auroc,
+)
+from torchmetrics_tpu.functional.classification.average_precision import (
+    average_precision,
+    binary_average_precision,
+    multiclass_average_precision,
+    multilabel_average_precision,
+)
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    binary_precision_recall_curve,
+    multiclass_precision_recall_curve,
+    multilabel_precision_recall_curve,
+    precision_recall_curve,
+)
+from torchmetrics_tpu.functional.classification.roc import (
+    binary_roc,
+    multiclass_roc,
+    multilabel_roc,
+    roc,
+)
 from torchmetrics_tpu.functional.classification.accuracy import (
     accuracy,
     binary_accuracy,
@@ -57,6 +132,61 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
 )
 
 __all__ = [
+    "binary_calibration_error",
+    "calibration_error",
+    "multiclass_calibration_error",
+    "binary_cohen_kappa",
+    "cohen_kappa",
+    "multiclass_cohen_kappa",
+    "dice",
+    "binary_fairness",
+    "binary_groups_stat_rates",
+    "demographic_parity",
+    "equal_opportunity",
+    "binary_hinge_loss",
+    "hinge_loss",
+    "multiclass_hinge_loss",
+    "binary_jaccard_index",
+    "jaccard_index",
+    "multiclass_jaccard_index",
+    "multilabel_jaccard_index",
+    "binary_matthews_corrcoef",
+    "matthews_corrcoef",
+    "multiclass_matthews_corrcoef",
+    "multilabel_matthews_corrcoef",
+    "multilabel_coverage_error",
+    "multilabel_ranking_average_precision",
+    "multilabel_ranking_loss",
+    "binary_precision_at_fixed_recall",
+    "binary_recall_at_fixed_precision",
+    "multiclass_precision_at_fixed_recall",
+    "multiclass_recall_at_fixed_precision",
+    "multilabel_precision_at_fixed_recall",
+    "multilabel_recall_at_fixed_precision",
+    "binary_sensitivity_at_specificity",
+    "binary_specificity_at_sensitivity",
+    "multiclass_sensitivity_at_specificity",
+    "multiclass_specificity_at_sensitivity",
+    "multilabel_sensitivity_at_specificity",
+    "multilabel_specificity_at_sensitivity",
+
+    "auroc",
+    "binary_auroc",
+    "multiclass_auroc",
+    "multilabel_auroc",
+    "average_precision",
+    "binary_average_precision",
+    "multiclass_average_precision",
+    "multilabel_average_precision",
+    "binary_precision_recall_curve",
+    "multiclass_precision_recall_curve",
+    "multilabel_precision_recall_curve",
+    "precision_recall_curve",
+    "binary_roc",
+    "multiclass_roc",
+    "multilabel_roc",
+    "roc",
+
     "accuracy",
     "binary_accuracy",
     "multiclass_accuracy",

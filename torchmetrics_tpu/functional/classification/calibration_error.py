@@ -1,0 +1,180 @@
+"""Calibration error: binned ECE/MCE (reference ``functional/classification/calibration_error.py``).
+
+TPU note: the binning is a one-hot bucket matmul (static ``n_bins`` shape)
+instead of torch's ``bucketize``+``scatter_add`` — jit-friendly and
+accumulator-compatible.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.compute import _safe_divide, normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _binning_bucketize(confidences: Array, accuracies: Array, bin_boundaries: Array) -> Tuple[Array, Array, Array]:
+    """Per-bin (accuracy, confidence, proportion) via one-hot bucket reduction."""
+    n_bins = bin_boundaries.shape[0] - 1
+    # bucket index in [0, n_bins-1]
+    idx = jnp.clip(jnp.searchsorted(bin_boundaries[1:-1], confidences, side="right"), 0, n_bins - 1)
+    oh = jax.nn.one_hot(idx, n_bins, dtype=jnp.float32)
+    counts = oh.sum(axis=0)
+    conf_bin = _safe_divide(oh.T @ confidences.astype(jnp.float32), counts)
+    acc_bin = _safe_divide(oh.T @ accuracies.astype(jnp.float32), counts)
+    prop_bin = counts / confidences.shape[0]
+    return acc_bin, conf_bin, prop_bin
+
+
+def _ce_compute(
+    confidences: Array,
+    accuracies: Array,
+    bin_boundaries: Array,
+    norm: str = "l1",
+    debias: bool = False,
+) -> Array:
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Argument `norm` is expected to be one of 'l1', 'l2', 'max' but got {norm}")
+    if isinstance(bin_boundaries, int):
+        bin_boundaries = jnp.linspace(0, 1, bin_boundaries + 1, dtype=jnp.float32)
+    acc_bin, conf_bin, prop_bin = _binning_bucketize(confidences, accuracies, bin_boundaries)
+
+    if norm == "l1":
+        return jnp.sum(jnp.abs(acc_bin - conf_bin) * prop_bin)
+    if norm == "max":
+        return jnp.max(jnp.abs(acc_bin - conf_bin))
+    ce = jnp.sum(jnp.square(acc_bin - conf_bin) * prop_bin)
+    if debias:
+        debias_bins = _safe_divide(acc_bin * (acc_bin - 1) * prop_bin, prop_bin * confidences.shape[0] - 1)
+        ce = ce + jnp.sum(debias_bins)
+    return jnp.sqrt(ce) if bool(ce > 0) else jnp.asarray(0.0)
+
+
+def _binary_calibration_error_arg_validation(
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(n_bins, int) or n_bins < 1:
+        raise ValueError(f"Expected argument `n_bins` to be an integer larger than 0, but got {n_bins}")
+    if norm not in ("l1", "l2", "max"):
+        raise ValueError(f"Expected argument `norm` to be one of 'l1', 'l2' or 'max' but got {norm}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_calibration_error_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError(f"Expected argument `preds` to be floating tensor but got {jnp.asarray(preds).dtype}")
+
+
+def _binary_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    confidences = jnp.where(preds > 0.5, preds, 1 - preds)
+    accuracies = ((preds > 0.5).astype(jnp.int32) == target).astype(jnp.float32)
+    return confidences, accuracies
+
+
+def binary_calibration_error(
+    preds: Array,
+    target: Array,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Expected/maximum calibration error for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_calibration_error
+        >>> preds = jnp.array([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> binary_calibration_error(preds, target, n_bins=2, norm='l1')
+        Array(0.29, dtype=float32)
+    """
+    if validate_args:
+        _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+        _binary_calibration_error_tensor_validation(preds, target, ignore_index)
+    preds = jnp.asarray(preds).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+    if ignore_index is not None:
+        keep = jnp.nonzero(target != ignore_index)[0]
+        preds = preds[keep]
+        target = target[keep]
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    confidences, accuracies = _binary_calibration_error_update(preds, target)
+    return _ce_compute(confidences, accuracies, jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32), norm)
+
+
+def _multiclass_calibration_error_arg_validation(
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_calibration_error_arg_validation(n_bins, norm, ignore_index)
+
+
+def _multiclass_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
+    if not bool(jnp.all((preds >= 0) & (preds <= 1))):
+        preds = jax.nn.softmax(preds, axis=1)
+    confidences = jnp.max(preds, axis=1)
+    predictions = jnp.argmax(preds, axis=1)
+    accuracies = (predictions == target).astype(jnp.float32)
+    return confidences, accuracies
+
+
+def multiclass_calibration_error(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    n_bins: int = 15,
+    norm: str = "l1",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Top-1 calibration error for multiclass tasks."""
+    if validate_args:
+        _multiclass_calibration_error_arg_validation(num_classes, n_bins, norm, ignore_index)
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target).reshape(-1)
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+    if ignore_index is not None:
+        keep = jnp.nonzero(target != ignore_index)[0]
+        preds = preds[keep]
+        target = target[keep]
+    confidences, accuracies = _multiclass_calibration_error_update(preds, target)
+    return _ce_compute(confidences, accuracies, jnp.linspace(0, 1, n_bins + 1, dtype=jnp.float32), norm)
+
+
+def calibration_error(
+    preds: Array,
+    target: Array,
+    task: str,
+    n_bins: int = 15,
+    norm: str = "l1",
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching calibration error (binary/multiclass)."""
+    from torchmetrics_tpu.utilities.enums import ClassificationTaskNoMultilabel
+
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_calibration_error(preds, target, n_bins, norm, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_calibration_error(preds, target, num_classes, n_bins, norm, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,131 @@
+"""Cohen's kappa (reference ``functional/classification/cohen_kappa.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.confusion_matrix import (
+    _binary_confusion_matrix_arg_validation,
+    _binary_confusion_matrix_format,
+    _binary_confusion_matrix_tensor_validation,
+    _binary_confusion_matrix_update,
+    _multiclass_confusion_matrix_arg_validation,
+    _multiclass_confusion_matrix_format,
+    _multiclass_confusion_matrix_tensor_validation,
+    _multiclass_confusion_matrix_update,
+)
+
+Array = jax.Array
+
+
+def _cohen_kappa_reduce(confmat: Array, weights: Optional[str] = None) -> Array:
+    """Kappa from a confusion matrix with None/linear/quadratic disagreement weighting."""
+    confmat = confmat.astype(jnp.float32)
+    n_classes = confmat.shape[0]
+    sum0 = confmat.sum(axis=0)
+    sum1 = confmat.sum(axis=1)
+    expected = jnp.outer(sum1, sum0) / sum0.sum()
+
+    if weights is None:
+        w_mat = jnp.ones((n_classes, n_classes), dtype=jnp.float32)
+        w_mat = w_mat - jnp.eye(n_classes, dtype=jnp.float32)
+    elif weights in ("linear", "quadratic"):
+        w_mat = jnp.arange(n_classes, dtype=jnp.float32)
+        w_mat = jnp.abs(w_mat[:, None] - w_mat[None, :])
+        if weights == "quadratic":
+            w_mat = w_mat**2
+    else:
+        raise ValueError(
+            f"Received {weights} for argument ``weights`` but should be either None, 'linear' or 'quadratic'"
+        )
+    k = jnp.sum(w_mat * confmat) / jnp.sum(w_mat * expected)
+    return 1.0 - k
+
+
+def _binary_cohen_kappa_arg_validation(
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    weights: Optional[str] = None,
+) -> None:
+    _binary_confusion_matrix_arg_validation(threshold, ignore_index, normalize=None)
+    allowed_weights = ("linear", "quadratic", "none", None)
+    if weights not in allowed_weights:
+        raise ValueError(f"Expected argument `weight` to be one of {allowed_weights}, but got {weights}.")
+
+
+def binary_cohen_kappa(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    weights: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Cohen's kappa for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_cohen_kappa
+        >>> binary_cohen_kappa(jnp.array([0.35, 0.85, 0.48, 0.01]), jnp.array([1, 1, 0, 0]))
+        Array(0.5, dtype=float32)
+    """
+    if validate_args:
+        _binary_cohen_kappa_arg_validation(threshold, ignore_index, weights)
+        _binary_confusion_matrix_tensor_validation(preds, target, ignore_index)
+    preds, target, valid = _binary_confusion_matrix_format(preds, target, threshold, ignore_index)
+    confmat = _binary_confusion_matrix_update(preds, target, valid)
+    return _cohen_kappa_reduce(confmat, weights if weights != "none" else None)
+
+
+def _multiclass_cohen_kappa_arg_validation(
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+    weights: Optional[str] = None,
+) -> None:
+    _multiclass_confusion_matrix_arg_validation(num_classes, ignore_index, normalize=None)
+    allowed_weights = ("linear", "quadratic", "none", None)
+    if weights not in allowed_weights:
+        raise ValueError(f"Expected argument `weight` to be one of {allowed_weights}, but got {weights}.")
+
+
+def multiclass_cohen_kappa(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    weights: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Cohen's kappa for multiclass tasks."""
+    if validate_args:
+        _multiclass_cohen_kappa_arg_validation(num_classes, ignore_index, weights)
+        _multiclass_confusion_matrix_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, valid = _multiclass_confusion_matrix_format(preds, target, ignore_index)
+    confmat = _multiclass_confusion_matrix_update(preds, target, valid, num_classes)
+    return _cohen_kappa_reduce(confmat, weights if weights != "none" else None)
+
+
+def cohen_kappa(
+    preds: Array,
+    target: Array,
+    task: str,
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    weights: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching Cohen's kappa (binary/multiclass)."""
+    from torchmetrics_tpu.utilities.enums import ClassificationTaskNoMultilabel
+
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_cohen_kappa(preds, target, threshold, weights, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_cohen_kappa(preds, target, num_classes, weights, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

@@ -1,0 +1,75 @@
+"""Dice score (reference ``functional/classification/dice.py``).
+
+Dice = 2·tp / (2·tp + fp + fn), built on the stat-scores state.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_format,
+    _binary_stat_scores_update,
+    _multiclass_stat_scores_format,
+    _multiclass_stat_scores_update,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _dice_compute(
+    tp: Array,
+    fp: Array,
+    fn: Array,
+    average: Optional[str] = "micro",
+    zero_division: float = 0.0,
+) -> Array:
+    if average == "micro":
+        tp = tp.sum()
+        fp = fp.sum()
+        fn = fn.sum()
+    numerator = 2 * tp
+    denominator = 2 * tp + fp + fn
+    dice = _safe_divide(numerator, denominator, zero_division)
+    if average == "macro":
+        return dice.mean()
+    if average == "weighted":
+        weights = tp + fn
+        return jnp.sum(_safe_divide(weights, weights.sum()) * dice)
+    return dice
+
+
+def dice(
+    preds: Array,
+    target: Array,
+    zero_division: float = 0.0,
+    average: Optional[str] = "micro",
+    threshold: float = 0.5,
+    num_classes: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+) -> Array:
+    """Dice score.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import dice
+        >>> preds = jnp.array([2, 0, 2, 1])
+        >>> target = jnp.array([1, 1, 2, 0])
+        >>> dice(preds, target, average='micro')
+        Array(0.25, dtype=float32)
+    """
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if num_classes is None and (preds.ndim > target.ndim or (jnp.issubdtype(preds.dtype, jnp.integer) and bool(jnp.max(preds) > 1))):
+        num_classes = int(max(int(jnp.max(preds)) if preds.ndim == target.ndim else preds.shape[1], int(jnp.max(target)))) + 1
+    if num_classes is None or num_classes == 2 and preds.shape == target.shape and not bool(jnp.max(target) > 1):
+        p, t, valid = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+        tp, fp, tn, fn = _binary_stat_scores_update(p, t, valid)
+    else:
+        p, t = _multiclass_stat_scores_format(preds, target)
+        tp, fp, tn, fn = _multiclass_stat_scores_update(p, t, num_classes, 1, "global", ignore_index)
+    return _dice_compute(tp, fp, fn, average)

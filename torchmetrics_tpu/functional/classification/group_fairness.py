@@ -1,0 +1,181 @@
+"""Group fairness metrics (reference ``functional/classification/group_fairness.py``).
+
+TPU-first: per-group stat scores via one-hot group masking — a single fused
+reduction over the batch — instead of the reference's sort + flexible-bincount
++ split (dynamic shapes, host sync).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.stat_scores import (
+    _binary_stat_scores_arg_validation,
+    _binary_stat_scores_format,
+    _binary_stat_scores_tensor_validation,
+)
+from torchmetrics_tpu.utilities.compute import _safe_divide
+
+Array = jax.Array
+
+
+def _groups_validation(groups: Array, num_groups: int) -> None:
+    from torchmetrics_tpu.utilities.checks import _is_concrete
+
+    if _is_concrete(groups):
+        import numpy as np
+
+        if int(np.max(np.asarray(groups))) > num_groups:
+            raise ValueError(
+                f"The largest number in the groups tensor is {int(jnp.max(groups))}, which is larger than the"
+                f" specified number of groups {num_groups}. The group identifiers should be"
+                " ``0, 1, ..., (num_groups - 1)``."
+            )
+    if not jnp.issubdtype(jnp.asarray(groups).dtype, jnp.integer):
+        raise ValueError(f"Expected dtype of argument groups to be int, not {jnp.asarray(groups).dtype}.")
+
+
+def _binary_groups_stat_scores(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> List[Tuple[Array, Array, Array, Array]]:
+    """Per-group (tp, fp, tn, fn) via one-hot group masks."""
+    if validate_args:
+        _binary_stat_scores_arg_validation(threshold, "global", ignore_index)
+        _binary_stat_scores_tensor_validation(preds, target, "global", ignore_index)
+        _groups_validation(groups, num_groups)
+    preds, target, valid = _binary_stat_scores_format(preds, target, threshold, ignore_index)
+    preds = preds.reshape(-1)
+    target = target.reshape(-1)
+    valid = valid.reshape(-1)
+    groups = jnp.asarray(groups).reshape(-1)
+
+    group_oh = jax.nn.one_hot(groups, num_groups, dtype=jnp.bool_)  # (N, G)
+    v = valid[:, None] & group_oh
+    tp = jnp.sum(((preds == 1) & (target == 1))[:, None] & v, axis=0)
+    fp = jnp.sum(((preds == 1) & (target == 0))[:, None] & v, axis=0)
+    tn = jnp.sum(((preds == 0) & (target == 0))[:, None] & v, axis=0)
+    fn = jnp.sum(((preds == 0) & (target == 1))[:, None] & v, axis=0)
+    return [(tp[g], fp[g], tn[g], fn[g]) for g in range(num_groups)]
+
+
+def _groups_reduce(group_stats: List[Tuple[Array, Array, Array, Array]]) -> Dict[str, Array]:
+    return {
+        f"group_{group}": jnp.stack(stats) / jnp.stack(stats).sum() for group, stats in enumerate(group_stats)
+    }
+
+
+def _groups_stat_transform(group_stats: List[Tuple[Array, Array, Array, Array]]) -> Dict[str, Array]:
+    return {
+        "tp": jnp.stack([s[0] for s in group_stats]),
+        "fp": jnp.stack([s[1] for s in group_stats]),
+        "tn": jnp.stack([s[2] for s in group_stats]),
+        "fn": jnp.stack([s[3] for s in group_stats]),
+    }
+
+
+def binary_groups_stat_rates(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    num_groups: int,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Per-group tp/fp/tn/fn rates.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_groups_stat_rates
+        >>> preds = jnp.array([1, 0, 1, 0])
+        >>> target = jnp.array([1, 0, 0, 1])
+        >>> groups = jnp.array([0, 0, 1, 1])
+        >>> sorted(binary_groups_stat_rates(preds, target, groups, 2).keys())
+        ['group_0', 'group_1']
+    """
+    stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    return _groups_reduce(stats)
+
+
+def _compute_binary_demographic_parity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    pos_rates = _safe_divide(tp + fp, tp + fp + tn + fn)
+    min_pos_rate_id = int(jnp.argmin(pos_rates))
+    max_pos_rate_id = int(jnp.argmax(pos_rates))
+    return {
+        f"DP_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(
+            pos_rates[min_pos_rate_id], pos_rates[max_pos_rate_id]
+        )
+    }
+
+
+def demographic_parity(
+    preds: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity: ratio of min/max per-group positive prediction rates."""
+    num_groups = int(jnp.max(jnp.asarray(groups))) + 1
+    target = jnp.zeros(jnp.asarray(preds).shape, dtype=jnp.int32)
+    stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    return _compute_binary_demographic_parity(**_groups_stat_transform(stats))
+
+
+def _compute_binary_equal_opportunity(tp: Array, fp: Array, tn: Array, fn: Array) -> Dict[str, Array]:
+    true_pos_rates = _safe_divide(tp, tp + fn)
+    min_pos_rate_id = int(jnp.argmin(true_pos_rates))
+    max_pos_rate_id = int(jnp.argmax(true_pos_rates))
+    return {
+        f"EO_{min_pos_rate_id}_{max_pos_rate_id}": _safe_divide(
+            true_pos_rates[min_pos_rate_id], true_pos_rates[max_pos_rate_id]
+        )
+    }
+
+
+def equal_opportunity(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Equal opportunity: ratio of min/max per-group true positive rates."""
+    num_groups = int(jnp.max(jnp.asarray(groups))) + 1
+    stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    return _compute_binary_equal_opportunity(**_groups_stat_transform(stats))
+
+
+def binary_fairness(
+    preds: Array,
+    target: Array,
+    groups: Array,
+    task: str = "all",
+    threshold: float = 0.5,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Dict[str, Array]:
+    """Demographic parity and/or equal opportunity (``task`` in demographic_parity/equal_opportunity/all)."""
+    if task not in ["demographic_parity", "equal_opportunity", "all"]:
+        raise ValueError(
+            f"Expected argument `task` to either be ``demographic_parity``,"
+            f"``equal_opportunity`` or ``all`` but got {task}."
+        )
+    num_groups = int(jnp.max(jnp.asarray(groups))) + 1
+    if task == "demographic_parity":
+        return demographic_parity(preds, groups, threshold, ignore_index, validate_args)
+    if task == "equal_opportunity":
+        return equal_opportunity(preds, target, groups, threshold, ignore_index, validate_args)
+    stats = _binary_groups_stat_scores(preds, target, groups, num_groups, threshold, ignore_index, validate_args)
+    transformed = _groups_stat_transform(stats)
+    return {**_compute_binary_demographic_parity(**transformed), **_compute_binary_equal_opportunity(**transformed)}

@@ -1,0 +1,153 @@
+"""Hinge loss (reference ``functional/classification/hinge.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _hinge_loss_compute(measure: Array, total: Array) -> Array:
+    return measure / total
+
+
+def _binary_hinge_loss_arg_validation(squared: bool, ignore_index: Optional[int] = None) -> None:
+    if not isinstance(squared, bool):
+        raise ValueError(f"Expected argument `squared` to be an bool but got {squared}")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_hinge_loss_tensor_validation(preds: Array, target: Array, ignore_index: Optional[int] = None) -> None:
+    _check_same_shape(preds, target)
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `preds` to be floating tensor with probabilities/logits"
+            f" but got tensor with dtype {jnp.asarray(preds).dtype}"
+        )
+
+
+def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool) -> Tuple[Array, Array]:
+    target = jnp.where(target == 1, 1.0, -1.0)
+    measures = 1 - target * preds
+    measures = jnp.clip(measures, min=0)
+    if squared:
+        measures = measures**2
+    return jnp.sum(measures), jnp.asarray(target.shape[0], dtype=jnp.float32)
+
+
+def binary_hinge_loss(
+    preds: Array,
+    target: Array,
+    squared: bool = False,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = False,
+) -> Array:
+    """Hinge loss for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_hinge_loss
+        >>> preds = jnp.array([0.25, 0.25, 0.55, 0.75, 0.75])
+        >>> target = jnp.array([0, 0, 1, 1, 1])
+        >>> binary_hinge_loss(preds, target)
+        Array(0.69, dtype=float32)
+    """
+    if validate_args:
+        _binary_hinge_loss_arg_validation(squared, ignore_index)
+        _binary_hinge_loss_tensor_validation(preds, target, ignore_index)
+    preds = jnp.asarray(preds, jnp.float32).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+    if ignore_index is not None:
+        keep = jnp.nonzero(target != ignore_index)[0]
+        preds = preds[keep]
+        target = target[keep]
+    measures, total = _binary_hinge_loss_update(preds, target, squared)
+    return _hinge_loss_compute(measures, total)
+
+
+def _multiclass_hinge_loss_arg_validation(
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    _binary_hinge_loss_arg_validation(squared, ignore_index)
+    allowed_mm = ("crammer-singer", "one-vs-all")
+    if multiclass_mode not in allowed_mm:
+        raise ValueError(f"Expected argument `multiclass_mode` to be one of {allowed_mm}, but got {multiclass_mode}.")
+
+
+def _multiclass_hinge_loss_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    squared: bool,
+    multiclass_mode: str,
+) -> Tuple[Array, Array]:
+    preds = normalize_logits_if_needed(preds, "softmax")
+    target_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.bool_)
+    if multiclass_mode == "crammer-singer":
+        margin = jnp.sum(jnp.where(target_oh, preds, 0.0), axis=1)
+        margin = margin - jnp.max(jnp.where(target_oh, -jnp.inf, preds), axis=1)
+        measures = jnp.clip(1 - margin, min=0)
+    else:
+        target_pm = jnp.where(target_oh, 1.0, -1.0)
+        measures = jnp.clip(1 - target_pm * preds, min=0).sum(axis=1)
+    if squared:
+        measures = measures**2
+    return jnp.sum(measures), jnp.asarray(target.shape[0], dtype=jnp.float32)
+
+
+def multiclass_hinge_loss(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = False,
+) -> Array:
+    """Hinge loss for multiclass tasks (crammer-singer or one-vs-all)."""
+    if validate_args:
+        _multiclass_hinge_loss_arg_validation(num_classes, squared, multiclass_mode, ignore_index)
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target).reshape(-1)
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+    if ignore_index is not None:
+        keep = jnp.nonzero(target != ignore_index)[0]
+        preds = preds[keep]
+        target = target[keep]
+    measures, total = _multiclass_hinge_loss_update(preds, target, num_classes, squared, multiclass_mode)
+    return _hinge_loss_compute(measures, total)
+
+
+def hinge_loss(
+    preds: Array,
+    target: Array,
+    task: str,
+    num_classes: Optional[int] = None,
+    squared: bool = False,
+    multiclass_mode: str = "crammer-singer",
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Task-dispatching hinge loss (binary/multiclass)."""
+    from torchmetrics_tpu.utilities.enums import ClassificationTaskNoMultilabel
+
+    task = ClassificationTaskNoMultilabel.from_str(task)
+    if task == ClassificationTaskNoMultilabel.BINARY:
+        return binary_hinge_loss(preds, target, squared, ignore_index, validate_args)
+    if task == ClassificationTaskNoMultilabel.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_hinge_loss(preds, target, num_classes, squared, multiclass_mode, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

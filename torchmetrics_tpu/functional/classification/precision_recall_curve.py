@@ -1,0 +1,483 @@
+"""Precision-recall curves (reference ``functional/classification/precision_recall_curve.py``).
+
+Two state modes (SURVEY.md §2.4 "curve metrics"):
+
+- ``thresholds=None`` → exact curve: cat preds/target, sort + cumsum at
+  compute (dynamic output length; runs eagerly, outside jit).
+- ``thresholds=int/list/array`` → **binned**: fixed-shape ``(T, 2, 2)`` (or
+  ``(T, C, 2, 2)``) confusion accumulator. This is the jit/TPU-native default
+  path: the update is one broadcast compare + reduce, which XLA fuses into a
+  single pass over the batch — no bincount scatter needed (the reference's
+  fused-index ``_bincount`` exists only because torch lacks that fusion).
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape, _is_concrete
+from torchmetrics_tpu.utilities.compute import _safe_divide, interp, normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _binary_clf_curve(
+    preds: Array,
+    target: Array,
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    """fps/tps at each distinct threshold (descending). Eager-only (dynamic shape)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim > target.ndim:
+        preds = preds[:, 0]
+    desc = jnp.argsort(-preds, stable=True)
+    preds = preds[desc]
+    target = target[desc]
+
+    distinct = jnp.nonzero(preds[1:] - preds[:-1])[0]
+    threshold_idxs = jnp.concatenate([distinct, jnp.array([target.shape[0] - 1])])
+    target = (target == pos_label).astype(jnp.int32)
+    tps = jnp.cumsum(target)[threshold_idxs]
+    fps = 1 + threshold_idxs - tps
+    return fps, tps, preds[threshold_idxs]
+
+
+def _adjust_threshold_arg(
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+) -> Optional[Array]:
+    """Normalize the thresholds argument to a 1d array (or None)."""
+    if isinstance(thresholds, int):
+        return jnp.linspace(0, 1, thresholds)
+    if isinstance(thresholds, list):
+        return jnp.asarray(thresholds, dtype=jnp.float32)
+    return thresholds
+
+
+def _binary_precision_recall_curve_arg_validation(
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if thresholds is not None and not isinstance(thresholds, (list, int)) and not hasattr(thresholds, "ndim"):
+        raise ValueError(
+            "Expected argument `thresholds` to either be an integer, list of floats or"
+            f" tensor of floats, but got {thresholds}"
+        )
+    if isinstance(thresholds, int) and thresholds < 2:
+        raise ValueError(
+            f"If argument `thresholds` is an integer, expected it to be larger than 1, but got {thresholds}"
+        )
+    if isinstance(thresholds, list) and not all(isinstance(t, float) and 0 <= t <= 1 for t in thresholds):
+        raise ValueError(
+            "If argument `thresholds` is a list, expected all elements to be floats in the [0,1] range,"
+            f" but got {thresholds}"
+        )
+    if hasattr(thresholds, "ndim") and thresholds.ndim != 1:
+        raise ValueError("If argument `thresholds` is an tensor, expected the tensor to be 1d")
+    if ignore_index is not None and not isinstance(ignore_index, int):
+        raise ValueError(f"Expected argument `ignore_index` to either be `None` or an integer, but got {ignore_index}")
+
+
+def _binary_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if jnp.issubdtype(jnp.asarray(target).dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `target` to be an int or long tensor with ground truth labels"
+            f" but got tensor with dtype {jnp.asarray(target).dtype}"
+        )
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError(
+            "Expected argument `preds` to be an floating tensor with probability/logit scores,"
+            f" but got tensor with dtype {jnp.asarray(preds).dtype}"
+        )
+    if _is_concrete(target):
+        import numpy as np
+
+        unique = set(np.unique(np.asarray(target)).tolist())
+        allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+        if not unique.issubset(allowed):
+            raise RuntimeError(
+                f"Detected the following values in `target`: {sorted(unique)} but expected only"
+                f" the following values {sorted(allowed)}."
+            )
+
+
+def _binary_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    preds = jnp.asarray(preds).reshape(-1)
+    target = jnp.asarray(target).reshape(-1)
+    if ignore_index is not None:
+        keep = jnp.nonzero(target != ignore_index)[0]
+        preds = preds[keep]
+        target = target[keep]
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds
+
+
+def _binary_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Array],
+) -> Union[Array, Tuple[Array, Array]]:
+    """Either passthrough (exact mode) or the (T,2,2) binned confusion tensor."""
+    if thresholds is None:
+        return preds, target
+    len_t = thresholds.shape[0]
+    preds_t = preds[:, None] >= thresholds[None, :]  # (N, T)
+    target_b = (target == 1)[:, None]
+    tp = jnp.sum(preds_t & target_b, axis=0)
+    fp = jnp.sum(preds_t & ~target_b, axis=0)
+    fn = jnp.sum(~preds_t & target_b, axis=0)
+    tn = target.shape[0] - tp - fp - fn
+    # layout [t, target, pred] to match reference (tn=[0,0], fp=[0,1], fn=[1,0], tp=[1,1])
+    return jnp.stack([jnp.stack([tn, fp], axis=-1), jnp.stack([fn, tp], axis=-1)], axis=1).astype(jnp.int32)
+
+
+def _binary_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    thresholds: Optional[Array],
+    pos_label: int = 1,
+) -> Tuple[Array, Array, Array]:
+    if thresholds is not None and not isinstance(state, tuple):
+        tps = state[:, 1, 1]
+        fps = state[:, 0, 1]
+        fns = state[:, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones(1, dtype=precision.dtype)])
+        recall = jnp.concatenate([recall, jnp.zeros(1, dtype=recall.dtype)])
+        return precision, recall, thresholds
+
+    fps, tps, thresh = _binary_clf_curve(state[0], state[1], pos_label=pos_label)
+    precision = tps / (tps + fps)
+    recall = tps / tps[-1]
+    precision = jnp.concatenate([precision[::-1], jnp.ones(1, dtype=precision.dtype)])
+    recall = jnp.concatenate([recall[::-1], jnp.zeros(1, dtype=recall.dtype)])
+    return precision, recall, thresh[::-1]
+
+
+def binary_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array, Array]:
+    """Precision-recall curve for binary tasks.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_precision_recall_curve
+        >>> preds = jnp.array([0.0, 0.5, 0.7, 0.8])
+        >>> target = jnp.array([0, 1, 1, 0])
+        >>> precision, recall, thresholds = binary_precision_recall_curve(preds, target, thresholds=5)
+        >>> precision
+        Array([0.5      , 0.6666667, 0.6666667, 0.5      , 0.       , 1.       ],      dtype=float32)
+    """
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_precision_recall_curve_compute(state, thresholds)
+
+
+# ---------------------------------------------------------------------------
+# Multiclass
+# ---------------------------------------------------------------------------
+
+
+def _multiclass_precision_recall_curve_arg_validation(
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    average: Optional[str] = None,
+) -> None:
+    if not isinstance(num_classes, int) or num_classes < 2:
+        raise ValueError(f"Expected argument `num_classes` to be an integer larger than 1, but got {num_classes}")
+    if average is not None and average not in ("micro", "macro"):
+        raise ValueError(f"Expected argument `average` to be one of None, 'micro' or 'macro', but got {average}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multiclass_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_classes: int, ignore_index: Optional[int] = None
+) -> None:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    if preds.ndim != target.ndim + 1:
+        raise ValueError("Expected `preds` to have one more dimension than `target`")
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {preds.dtype}")
+    if preds.shape[1] != num_classes:
+        raise ValueError(f"Expected `preds.shape[1]={preds.shape[1]}` to be equal to the number of classes")
+    if preds.shape[0] != target.shape[0] or preds.shape[2:] != target.shape[1:]:
+        raise ValueError("Expected the shape of `preds` should be (N, C, ...) and the shape of `target` should be (N, ...).")
+
+
+def _multiclass_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    average: Optional[str] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    # (N, C, ...) → (N*, C)
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_classes)
+    target = target.reshape(-1)
+    if ignore_index is not None:
+        keep = jnp.nonzero(target != ignore_index)[0]
+        preds = preds[keep]
+        target = target[keep]
+    preds = normalize_logits_if_needed(preds, "softmax")
+    if average == "micro":
+        preds = preds.reshape(-1)
+        target = jax.nn.one_hot(target, num_classes, dtype=jnp.int32).reshape(-1)
+    thresholds = _adjust_threshold_arg(thresholds)
+    return preds, target, thresholds
+
+
+def _multiclass_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = None,
+) -> Union[Array, Tuple[Array, Array]]:
+    if thresholds is None:
+        return preds, target
+    if average == "micro":
+        return _binary_precision_recall_curve_update(preds, target, thresholds)
+    preds_t = preds[:, :, None] >= thresholds[None, None, :]  # (N, C, T)
+    target_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.bool_)[:, :, None]  # (N, C, 1)
+    tp = jnp.sum(preds_t & target_oh, axis=0)  # (C, T)
+    fp = jnp.sum(preds_t & ~target_oh, axis=0)
+    fn = jnp.sum(~preds_t & target_oh, axis=0)
+    tn = target.shape[0] - tp - fp - fn
+    # (T, C, 2, 2) with [t, c, target, pred] layout
+    confmat = jnp.stack([jnp.stack([tn, fp], axis=-1), jnp.stack([fn, tp], axis=-1)], axis=-2)
+    return jnp.moveaxis(confmat, 1, 0).astype(jnp.int32)
+
+
+def _multiclass_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_classes: int,
+    thresholds: Optional[Array],
+    average: Optional[str] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if average == "micro":
+        return _binary_precision_recall_curve_compute(state, thresholds)
+
+    if thresholds is not None and not isinstance(state, tuple):
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_classes), dtype=precision.dtype)], axis=0).T
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_classes), dtype=recall.dtype)], axis=0).T
+        thres = thresholds
+        tensor_state = True
+    else:
+        precision_list, recall_list, thres_list = [], [], []
+        for i in range(num_classes):
+            res = _binary_precision_recall_curve_compute((state[0][:, i], state[1]), None, pos_label=i)
+            precision_list.append(res[0])
+            recall_list.append(res[1])
+            thres_list.append(res[2])
+        tensor_state = False
+
+    if average == "macro":
+        thres = jnp.tile(thres, num_classes) if tensor_state else jnp.concatenate(thres_list, 0)
+        thres = jnp.sort(thres)
+        mean_precision = precision.reshape(-1) if tensor_state else jnp.concatenate(precision_list, 0)
+        mean_precision = jnp.sort(mean_precision)
+        mean_recall = jnp.zeros_like(mean_precision)
+        for i in range(num_classes):
+            mean_recall = mean_recall + interp(
+                mean_precision,
+                precision[i] if tensor_state else precision_list[i],
+                recall[i] if tensor_state else recall_list[i],
+            )
+        mean_recall = mean_recall / num_classes
+        return mean_precision, mean_recall, thres
+
+    if tensor_state:
+        return precision, recall, thres
+    return precision_list, recall_list, thres_list
+
+
+def multiclass_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    average: Optional[str] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Precision-recall curve for multiclass tasks (one-vs-rest)."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index, average)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index, average
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds, average)
+    return _multiclass_precision_recall_curve_compute(state, num_classes, thresholds, average)
+
+
+# ---------------------------------------------------------------------------
+# Multilabel
+# ---------------------------------------------------------------------------
+
+
+def _multilabel_precision_recall_curve_arg_validation(
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> None:
+    if not isinstance(num_labels, int) or num_labels < 2:
+        raise ValueError(f"Expected argument `num_labels` to be an integer larger than 1, but got {num_labels}")
+    _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+
+
+def _multilabel_precision_recall_curve_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if jnp.asarray(preds).shape[1] != num_labels:
+        raise ValueError("Expected `preds.shape[1]` to be equal to the number of labels")
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {jnp.asarray(preds).dtype}")
+
+
+def _multilabel_precision_recall_curve_format(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Optional[Array]]:
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(target, 1, -1).reshape(-1, num_labels)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    thresholds = _adjust_threshold_arg(thresholds)
+    if ignore_index is not None and thresholds is None:
+        # exact mode: mark ignored positions with an out-of-range sentinel
+        preds = jnp.where(target == ignore_index, -1000.0, preds)
+    return preds, target, thresholds
+
+
+def _multilabel_precision_recall_curve_update(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Union[Array, Tuple[Array, Array]]:
+    if thresholds is None:
+        return preds, target
+    preds_t = preds[:, :, None] >= thresholds[None, None, :]  # (N, L, T)
+    target_b = (target == 1)[:, :, None]
+    valid = jnp.ones_like(target_b) if ignore_index is None else (target != ignore_index)[:, :, None]
+    tp = jnp.sum(preds_t & target_b & valid, axis=0)
+    fp = jnp.sum(preds_t & ~target_b & valid, axis=0)
+    fn = jnp.sum(~preds_t & target_b & valid, axis=0)
+    tn = jnp.sum(~preds_t & ~target_b & valid, axis=0)
+    confmat = jnp.stack([jnp.stack([tn, fp], axis=-1), jnp.stack([fn, tp], axis=-1)], axis=-2)
+    return jnp.moveaxis(confmat, 1, 0).astype(jnp.int32)
+
+
+def _multilabel_precision_recall_curve_compute(
+    state: Union[Array, Tuple[Array, Array]],
+    num_labels: int,
+    thresholds: Optional[Array],
+    ignore_index: Optional[int] = None,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    if thresholds is not None and not isinstance(state, tuple):
+        tps = state[:, :, 1, 1]
+        fps = state[:, :, 0, 1]
+        fns = state[:, :, 1, 0]
+        precision = _safe_divide(tps, tps + fps)
+        recall = _safe_divide(tps, tps + fns)
+        precision = jnp.concatenate([precision, jnp.ones((1, num_labels), dtype=precision.dtype)], axis=0).T
+        recall = jnp.concatenate([recall, jnp.zeros((1, num_labels), dtype=recall.dtype)], axis=0).T
+        return precision, recall, thresholds
+
+    precision_list, recall_list, thres_list = [], [], []
+    for i in range(num_labels):
+        preds_i = state[0][:, i]
+        target_i = state[1][:, i]
+        if ignore_index is not None:
+            keep = jnp.nonzero(target_i != ignore_index)[0]
+            preds_i = preds_i[keep]
+            target_i = target_i[keep]
+        res = _binary_precision_recall_curve_compute((preds_i, target_i), None, pos_label=1)
+        precision_list.append(res[0])
+        recall_list.append(res[1])
+        thres_list.append(res[2])
+    return precision_list, recall_list, thres_list
+
+
+def multilabel_precision_recall_curve(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Precision-recall curve for multilabel tasks (per label)."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, ignore_index)
+    return _multilabel_precision_recall_curve_compute(state, num_labels, thresholds, ignore_index)
+
+
+def precision_recall_curve(
+    preds: Array,
+    target: Array,
+    task: str,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
+    """Task-dispatching precision-recall curve."""
+    from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+    task = ClassificationTask.from_str(task)
+    if task == ClassificationTask.BINARY:
+        return binary_precision_recall_curve(preds, target, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_precision_recall_curve(
+            preds, target, num_classes, thresholds, None, ignore_index, validate_args
+        )
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_precision_recall_curve(preds, target, num_labels, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")

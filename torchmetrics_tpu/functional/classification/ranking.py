@@ -1,0 +1,152 @@
+"""Multilabel ranking metrics (reference ``functional/classification/ranking.py``).
+
+Coverage error, label-ranking average precision, label-ranking loss. Ranks are
+computed with broadcast comparisons (static shapes) rather than sort loops.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed
+
+Array = jax.Array
+
+
+def _multilabel_ranking_tensor_validation(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> None:
+    _check_same_shape(preds, target)
+    if jnp.asarray(preds).shape[1] != num_labels:
+        raise ValueError(f"Expected `preds.shape[1]` to be {num_labels}")
+    if not jnp.issubdtype(jnp.asarray(preds).dtype, jnp.floating):
+        raise ValueError(f"Expected `preds` to be a float tensor, but got {jnp.asarray(preds).dtype}")
+
+
+def _multilabel_ranking_format(
+    preds: Array, target: Array, num_labels: int, ignore_index: Optional[int] = None
+) -> Tuple[Array, Array]:
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target)
+    preds = jnp.moveaxis(preds, 1, -1).reshape(-1, num_labels)
+    target = jnp.moveaxis(target, 1, -1).reshape(-1, num_labels)
+    preds = normalize_logits_if_needed(preds, "sigmoid")
+    if ignore_index is not None:
+        # drop rows containing any ignored entry (eager)
+        keep = jnp.nonzero(~jnp.any(target == ignore_index, axis=1))[0]
+        preds = preds[keep]
+        target = target[keep]
+    return preds, target
+
+
+def _multilabel_coverage_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    # for each sample: max rank (1-indexed position in descending score order)
+    # over its relevant labels == how far down the list we must go
+    offset = jnp.zeros_like(preds)
+    offset = jnp.where(target == 1, 0.0, 1e30)
+    min_relevant_score = jnp.min(preds + offset, axis=1, keepdims=True)  # min score among relevant
+    has_relevant = jnp.any(target == 1, axis=1)
+    coverage = jnp.sum(preds >= min_relevant_score, axis=1).astype(jnp.float32)
+    coverage = jnp.where(has_relevant, coverage, 0.0)
+    return jnp.sum(coverage), preds.shape[0]
+
+
+def multilabel_coverage_error(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Coverage error: average depth needed to cover all relevant labels.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import multilabel_coverage_error
+        >>> preds = jnp.array([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.35]])
+        >>> target = jnp.array([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
+        >>> multilabel_coverage_error(preds, target, num_labels=3)
+        Array(1.6666666, dtype=float32)
+    """
+    if validate_args:
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
+    coverage, total = _multilabel_coverage_error_update(preds, target)
+    return coverage / total
+
+
+def _rank_with_ties(scores: Array) -> Array:
+    """Descending rank (1-indexed, ties get average rank) per row."""
+    gt = (scores[:, None, :] > scores[:, :, None]).sum(axis=-1).astype(jnp.float32)
+    eq = (scores[:, None, :] == scores[:, :, None]).sum(axis=-1).astype(jnp.float32)
+    return gt + (eq + 1.0) / 2.0
+
+
+def _multilabel_ranking_average_precision_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    n, L = preds.shape
+    rank_all = _rank_with_ties(preds)  # rank among all labels (descending)
+    # rank among relevant labels only: count relevant labels with score >= this one
+    relevant = target == 1
+    rel_scores = jnp.where(relevant, preds, -jnp.inf)
+    gt_rel = ((rel_scores[:, None, :] > preds[:, :, None]) & relevant[:, None, :]).sum(axis=-1).astype(jnp.float32)
+    eq_rel = ((rel_scores[:, None, :] == preds[:, :, None]) & relevant[:, None, :]).sum(axis=-1).astype(jnp.float32)
+    rank_rel = gt_rel + (eq_rel + 1.0) / 2.0
+
+    ratio = jnp.where(relevant, rank_rel / rank_all, 0.0)
+    n_relevant = relevant.sum(axis=1)
+    per_sample = jnp.where(
+        (n_relevant > 0) & (n_relevant < L),
+        jnp.sum(ratio, axis=1) / jnp.maximum(n_relevant, 1),
+        1.0,
+    )
+    return jnp.sum(per_sample), n
+
+
+def multilabel_ranking_average_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Label-ranking average precision."""
+    if validate_args:
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
+    score, total = _multilabel_ranking_average_precision_update(preds, target)
+    return score / total
+
+
+def _multilabel_ranking_loss_update(preds: Array, target: Array) -> Tuple[Array, int]:
+    n, L = preds.shape
+    relevant = target == 1
+    n_relevant = relevant.sum(axis=1)
+    n_irrelevant = L - n_relevant
+    # count mis-ordered (relevant, irrelevant) pairs: score_rel <= score_irr
+    rel_s = jnp.where(relevant, preds, jnp.nan)
+    irr_s = jnp.where(~relevant, preds, jnp.nan)
+    pairs = (rel_s[:, :, None] <= irr_s[:, None, :]).astype(jnp.float32)
+    pairs = jnp.where(jnp.isnan(rel_s)[:, :, None] | jnp.isnan(irr_s)[:, None, :], 0.0, pairs)
+    miss = pairs.sum(axis=(1, 2))
+    denom = (n_relevant * n_irrelevant).astype(jnp.float32)
+    loss = jnp.where(denom > 0, miss / jnp.maximum(denom, 1.0), 0.0)
+    return jnp.sum(loss), n
+
+
+def multilabel_ranking_loss(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Array:
+    """Label-ranking loss: fraction of mis-ordered label pairs."""
+    if validate_args:
+        _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target = _multilabel_ranking_format(preds, target, num_labels, ignore_index)
+    loss, total = _multilabel_ranking_loss_update(preds, target)
+    return loss / total

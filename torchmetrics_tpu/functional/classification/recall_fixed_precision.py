@@ -1,0 +1,236 @@
+"""Recall at fixed precision + precision at fixed recall
+(reference ``functional/classification/{recall_fixed_precision,precision_fixed_recall}.py``)."""
+
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+    _binary_precision_recall_curve_arg_validation,
+    _binary_precision_recall_curve_compute,
+    _binary_precision_recall_curve_format,
+    _binary_precision_recall_curve_tensor_validation,
+    _binary_precision_recall_curve_update,
+    _multiclass_precision_recall_curve_arg_validation,
+    _multiclass_precision_recall_curve_compute,
+    _multiclass_precision_recall_curve_format,
+    _multiclass_precision_recall_curve_tensor_validation,
+    _multiclass_precision_recall_curve_update,
+    _multilabel_precision_recall_curve_arg_validation,
+    _multilabel_precision_recall_curve_compute,
+    _multilabel_precision_recall_curve_format,
+    _multilabel_precision_recall_curve_tensor_validation,
+    _multilabel_precision_recall_curve_update,
+)
+
+Array = jax.Array
+
+
+def _recall_at_precision(
+    precision: Array,
+    recall: Array,
+    thresholds: Array,
+    min_precision: float,
+) -> Tuple[Array, Array]:
+    """Max recall subject to precision >= min_precision (+ the achieving threshold)."""
+    zipped_len = min(recall.shape[0], precision.shape[0], thresholds.shape[0])
+    r, p, t = recall[:zipped_len], precision[:zipped_len], thresholds[:zipped_len]
+    mask = p >= min_precision
+    max_recall = jnp.max(jnp.where(mask, r, -jnp.inf))
+    any_valid = jnp.any(mask)
+    max_recall = jnp.where(any_valid, max_recall, 0.0)
+    # among points hitting max recall with precision ok, pick the one the
+    # reference's lexicographic argmax picks (max recall, then max precision)
+    tie = mask & (r == max_recall)
+    p_best = jnp.max(jnp.where(tie, p, -jnp.inf))
+    tie2 = tie & (p == p_best)
+    idx = jnp.argmax(tie2)
+    best_threshold = jnp.where(any_valid, t[idx], 0.0)
+    best_threshold = jnp.where(max_recall == 0.0, jnp.asarray(1e6, dtype=thresholds.dtype), best_threshold)
+    return max_recall, best_threshold
+
+
+def _precision_at_recall(
+    precision: Array,
+    recall: Array,
+    thresholds: Array,
+    min_recall: float,
+) -> Tuple[Array, Array]:
+    """Max precision subject to recall >= min_recall."""
+    zipped_len = min(recall.shape[0], precision.shape[0], thresholds.shape[0])
+    r, p, t = recall[:zipped_len], precision[:zipped_len], thresholds[:zipped_len]
+    mask = r >= min_recall
+    max_precision = jnp.max(jnp.where(mask, p, -jnp.inf))
+    any_valid = jnp.any(mask)
+    max_precision = jnp.where(any_valid, max_precision, 0.0)
+    tie = mask & (p == max_precision)
+    r_best = jnp.max(jnp.where(tie, r, -jnp.inf))
+    idx = jnp.argmax(tie & (r == r_best))
+    best_threshold = jnp.where(any_valid, t[idx], 0.0)
+    best_threshold = jnp.where(max_precision == 0.0, jnp.asarray(1e6, dtype=thresholds.dtype), best_threshold)
+    return max_precision, best_threshold
+
+
+def _binary_fixed_op_compute(
+    state,
+    thresholds: Optional[Array],
+    constraint: float,
+    reduce_fn: Callable,
+) -> Tuple[Array, Array]:
+    precision, recall, thresholds = _binary_precision_recall_curve_compute(state, thresholds)
+    return reduce_fn(precision, recall, thresholds, constraint)
+
+
+def binary_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    min_precision: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest recall achievable with precision >= ``min_precision``.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import binary_recall_at_fixed_precision
+        >>> preds = jnp.array([0.1, 0.4, 0.6, 0.8])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> recall, threshold = binary_recall_at_fixed_precision(preds, target, min_precision=1.0)
+        >>> float(recall)
+        1.0
+    """
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        if not isinstance(min_precision, float) or not (0 <= min_precision <= 1):
+            raise ValueError(
+                f"Expected argument `min_precision` to be an float in the [0,1] range, but got {min_precision}"
+            )
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_fixed_op_compute(state, thresholds, min_precision, _recall_at_precision)
+
+
+def binary_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    min_recall: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Highest precision achievable with recall >= ``min_recall``."""
+    if validate_args:
+        _binary_precision_recall_curve_arg_validation(thresholds, ignore_index)
+        if not isinstance(min_recall, float) or not (0 <= min_recall <= 1):
+            raise ValueError(f"Expected argument `min_recall` to be an float in the [0,1] range, but got {min_recall}")
+        _binary_precision_recall_curve_tensor_validation(preds, target, ignore_index)
+    preds, target, thresholds = _binary_precision_recall_curve_format(preds, target, thresholds, ignore_index)
+    state = _binary_precision_recall_curve_update(preds, target, thresholds)
+    return _binary_fixed_op_compute(state, thresholds, min_recall, _precision_at_recall)
+
+
+def _per_class_fixed_op(
+    precision, recall, thresholds, num: int, constraint: float, reduce_fn: Callable
+) -> Tuple[Array, Array]:
+    vals, thrs = [], []
+    for i in range(num):
+        p_i = precision[i] if not isinstance(precision, list) else precision[i]
+        r_i = recall[i] if not isinstance(recall, list) else recall[i]
+        t_i = thresholds if not isinstance(thresholds, list) and thresholds.ndim == 1 else thresholds[i]
+        v, t = reduce_fn(p_i, r_i, t_i, constraint)
+        vals.append(v)
+        thrs.append(t)
+    return jnp.stack(vals), jnp.stack(thrs)
+
+
+def multiclass_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_precision: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest recall with precision >= ``min_precision``."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    precision, recall, thresholds = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    return _per_class_fixed_op(precision, recall, thresholds, num_classes, min_precision, _recall_at_precision)
+
+
+def multiclass_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    min_recall: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-class highest precision with recall >= ``min_recall``."""
+    if validate_args:
+        _multiclass_precision_recall_curve_arg_validation(num_classes, thresholds, ignore_index)
+        _multiclass_precision_recall_curve_tensor_validation(preds, target, num_classes, ignore_index)
+    preds, target, thresholds = _multiclass_precision_recall_curve_format(
+        preds, target, num_classes, thresholds, ignore_index
+    )
+    state = _multiclass_precision_recall_curve_update(preds, target, num_classes, thresholds)
+    precision, recall, thresholds = _multiclass_precision_recall_curve_compute(state, num_classes, thresholds)
+    return _per_class_fixed_op(precision, recall, thresholds, num_classes, min_recall, _precision_at_recall)
+
+
+def multilabel_recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_precision: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest recall with precision >= ``min_precision``."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, ignore_index)
+    precision, recall, thresholds = _multilabel_precision_recall_curve_compute(
+        state, num_labels, thresholds, ignore_index
+    )
+    return _per_class_fixed_op(precision, recall, thresholds, num_labels, min_precision, _recall_at_precision)
+
+
+def multilabel_precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    num_labels: int,
+    min_recall: float,
+    thresholds: Optional[Union[int, List[float], Array]] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Tuple[Array, Array]:
+    """Per-label highest precision with recall >= ``min_recall``."""
+    if validate_args:
+        _multilabel_precision_recall_curve_arg_validation(num_labels, thresholds, ignore_index)
+        _multilabel_precision_recall_curve_tensor_validation(preds, target, num_labels, ignore_index)
+    preds, target, thresholds = _multilabel_precision_recall_curve_format(
+        preds, target, num_labels, thresholds, ignore_index
+    )
+    state = _multilabel_precision_recall_curve_update(preds, target, num_labels, thresholds, ignore_index)
+    precision, recall, thresholds = _multilabel_precision_recall_curve_compute(
+        state, num_labels, thresholds, ignore_index
+    )
+    return _per_class_fixed_op(precision, recall, thresholds, num_labels, min_recall, _precision_at_recall)

@@ -11,7 +11,6 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from torchmetrics_tpu.functional.regression.utils import _check_data_shape_to_num_outputs
 from torchmetrics_tpu.utilities.checks import _check_same_shape
 from torchmetrics_tpu.utilities.enums import EnumStr
 

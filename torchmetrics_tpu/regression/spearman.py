@@ -85,6 +85,11 @@ class KendallRankCorrCoef(Metric):
         super().__init__(**kwargs)
         if not isinstance(t_test, bool):
             raise ValueError(f"Argument `t_test` is expected to be of a type `bool`, but got {t_test}.")
+        from torchmetrics_tpu.functional.regression.kendall import _MetricVariant, _TestAlternative
+
+        _MetricVariant.from_str(str(variant))  # fail fast on invalid variant
+        if t_test and alternative is not None:
+            _TestAlternative.from_str(str(alternative))
         self.variant = variant
         self.alternative = alternative if t_test else None
         self.t_test = t_test

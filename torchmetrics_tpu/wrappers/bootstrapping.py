@@ -9,7 +9,7 @@ single compilation across copies.
 from __future__ import annotations
 
 from copy import deepcopy
-from typing import Any, Dict, Optional, Sequence, Union
+from typing import Any, Dict, Optional, Union
 
 import jax
 import jax.numpy as jnp
