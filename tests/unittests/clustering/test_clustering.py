@@ -1,0 +1,138 @@
+"""Clustering + nominal metrics vs sklearn/scipy oracles."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from sklearn.metrics import (
+    adjusted_mutual_info_score as sk_ami,
+    adjusted_rand_score as sk_ari,
+    calinski_harabasz_score as sk_ch,
+    completeness_score as sk_completeness,
+    davies_bouldin_score as sk_db,
+    fowlkes_mallows_score as sk_fmi,
+    homogeneity_score as sk_homogeneity,
+    mutual_info_score as sk_mi,
+    normalized_mutual_info_score as sk_nmi,
+    rand_score as sk_rand,
+    v_measure_score as sk_v,
+)
+
+import torchmetrics_tpu.clustering as CL
+import torchmetrics_tpu.functional.clustering as FC
+import torchmetrics_tpu.functional.nominal as FN
+import torchmetrics_tpu.nominal as NOM
+
+
+@pytest.fixture
+def labels():
+    rng = np.random.default_rng(41)
+    return rng.integers(0, 4, 100), rng.integers(0, 5, 100)
+
+
+@pytest.fixture
+def data_labels():
+    rng = np.random.default_rng(42)
+    centers = np.array([[0, 0], [5, 5], [0, 5]])
+    labels = rng.integers(0, 3, 90)
+    data = centers[labels] + rng.normal(scale=0.5, size=(90, 2))
+    return data.astype(np.float32), labels
+
+
+@pytest.mark.parametrize(
+    ("ours", "oracle"),
+    [
+        (FC.mutual_info_score, sk_mi),
+        (FC.normalized_mutual_info_score, sk_nmi),
+        (FC.adjusted_mutual_info_score, sk_ami),
+        (FC.rand_score, sk_rand),
+        (FC.adjusted_rand_score, sk_ari),
+        (FC.homogeneity_score, sk_homogeneity),
+        (FC.completeness_score, sk_completeness),
+        (FC.v_measure_score, sk_v),
+        (FC.fowlkes_mallows_index, sk_fmi),
+    ],
+)
+def test_extrinsic_functional(labels, ours, oracle):
+    p, t = labels
+    assert np.allclose(float(ours(jnp.asarray(p), jnp.asarray(t))), oracle(t, p), atol=1e-5)
+
+
+def test_extrinsic_modular_streaming(labels):
+    p, t = labels
+    m = CL.MutualInfoScore()
+    for s in np.array_split(np.arange(len(p)), 4):
+        m.update(jnp.asarray(p[s]), jnp.asarray(t[s]))
+    assert np.allclose(float(m.compute()), sk_mi(t, p), atol=1e-5)
+
+
+def test_intrinsic(data_labels):
+    data, labels = data_labels
+    assert np.allclose(float(FC.calinski_harabasz_score(jnp.asarray(data), jnp.asarray(labels))), sk_ch(data, labels), rtol=1e-4)
+    assert np.allclose(float(FC.davies_bouldin_score(jnp.asarray(data), jnp.asarray(labels))), sk_db(data, labels), rtol=1e-4)
+    di = float(FC.dunn_index(jnp.asarray(data), jnp.asarray(labels)))
+    assert di > 0
+
+
+def test_intrinsic_modular(data_labels):
+    data, labels = data_labels
+    m = CL.CalinskiHarabaszScore()
+    for s in np.array_split(np.arange(len(labels)), 3):
+        m.update(jnp.asarray(data[s]), jnp.asarray(labels[s]))
+    assert np.allclose(float(m.compute()), sk_ch(data, labels), rtol=1e-4)
+
+
+def test_cramers_v(labels):
+    p, t = labels
+    # scipy oracle
+    from scipy.stats import contingency
+
+    cm = np.asarray(FC.calculate_contingency_matrix(jnp.asarray(p), jnp.asarray(t))).astype(int)
+    expected = contingency.association(cm, method="cramer", correction=False)
+    got = float(FN.cramers_v(jnp.asarray(p), jnp.asarray(t), bias_correction=False))
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_tschuprows_t(labels):
+    p, t = labels
+    from scipy.stats import contingency
+
+    cm = np.asarray(FC.calculate_contingency_matrix(jnp.asarray(p), jnp.asarray(t))).astype(int)
+    expected = contingency.association(cm, method="tschuprow", correction=False)
+    got = float(FN.tschuprows_t(jnp.asarray(p), jnp.asarray(t), bias_correction=False))
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_pearson_contingency(labels):
+    p, t = labels
+    from scipy.stats import contingency
+
+    cm = np.asarray(FC.calculate_contingency_matrix(jnp.asarray(p), jnp.asarray(t))).astype(int)
+    expected = contingency.association(cm, method="pearson", correction=False)
+    got = float(FN.pearsons_contingency_coefficient(jnp.asarray(p), jnp.asarray(t)))
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_theils_u():
+    # U(x|x) == 1; independence ~ 0
+    x = jnp.asarray(np.tile([0, 1, 2], 30))
+    assert np.allclose(float(FN.theils_u(x, x)), 1.0, atol=1e-5)
+
+
+def test_fleiss_kappa():
+    # classic example from Fleiss (1971)-style data
+    ratings = jnp.array([[5, 0], [3, 2], [0, 5], [5, 0]])
+    k = float(FN.fleiss_kappa(ratings))
+    assert 0.6 < k < 0.7
+
+
+def test_nominal_modular(labels):
+    p, t = labels
+    m = NOM.CramersV(bias_correction=False)
+    for s in np.array_split(np.arange(len(p)), 3):
+        m.update(jnp.asarray(p[s]), jnp.asarray(t[s]))
+    from scipy.stats import contingency
+
+    cm = np.asarray(FC.calculate_contingency_matrix(jnp.asarray(p), jnp.asarray(t))).astype(int)
+    expected = contingency.association(cm, method="cramer", correction=False)
+    assert np.allclose(float(m.compute()), expected, atol=1e-5)

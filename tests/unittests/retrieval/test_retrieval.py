@@ -1,0 +1,183 @@
+"""Retrieval metrics vs sklearn + hand oracles."""
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from sklearn.metrics import average_precision_score, ndcg_score, roc_auc_score
+
+import torchmetrics_tpu.functional.retrieval as FR
+from torchmetrics_tpu.retrieval import (
+    RetrievalAUROC,
+    RetrievalFallOut,
+    RetrievalHitRate,
+    RetrievalMAP,
+    RetrievalMRR,
+    RetrievalNormalizedDCG,
+    RetrievalPrecision,
+    RetrievalPrecisionRecallCurve,
+    RetrievalRecall,
+    RetrievalRecallAtFixedPrecision,
+    RetrievalRPrecision,
+)
+
+
+@pytest.fixture
+def queries():
+    rng = np.random.default_rng(31)
+    num_q, per_q = 8, 12
+    indexes, preds, target = [], [], []
+    for q in range(num_q):
+        n = per_q - (q % 3)  # uneven query sizes
+        indexes.append(np.full(n, q))
+        preds.append(rng.random(n).astype(np.float32))
+        t = rng.integers(0, 2, n)
+        if t.sum() == 0:
+            t[0] = 1
+        target.append(t)
+    return np.concatenate(indexes), np.concatenate(preds), np.concatenate(target)
+
+
+def test_functional_ap():
+    p = jnp.array([0.2, 0.3, 0.5])
+    t = jnp.array([True, False, True])
+    assert np.allclose(float(FR.retrieval_average_precision(p, t)), 0.8333333, atol=1e-5)
+
+
+def test_functional_vs_sklearn_ap():
+    rng = np.random.default_rng(32)
+    p = rng.random(20).astype(np.float32)
+    t = rng.integers(0, 2, 20)
+    assert np.allclose(
+        float(FR.retrieval_average_precision(jnp.asarray(p), jnp.asarray(t))),
+        average_precision_score(t, p),
+        atol=1e-5,
+    )
+
+
+def test_functional_mrr():
+    p = jnp.array([0.9, 0.8, 0.7])
+    t = jnp.array([0, 1, 0])
+    assert float(FR.retrieval_reciprocal_rank(p, t)) == 0.5
+
+
+def test_functional_precision_recall_topk():
+    p = jnp.array([0.9, 0.8, 0.7, 0.6])
+    t = jnp.array([1, 0, 1, 1])
+    assert float(FR.retrieval_precision(p, t, top_k=2)) == 0.5
+    assert np.allclose(float(FR.retrieval_recall(p, t, top_k=2)), 1 / 3)
+    assert float(FR.retrieval_hit_rate(p, t, top_k=2)) == 1.0
+    assert float(FR.retrieval_fall_out(p, t, top_k=2)) == 1.0  # the only irrelevant doc is at rank 2
+    assert np.allclose(float(FR.retrieval_r_precision(p, t)), 2 / 3)
+
+
+def test_functional_ndcg_vs_sklearn():
+    rng = np.random.default_rng(33)
+    p = rng.random(15).astype(np.float32)
+    t = rng.integers(0, 4, 15)  # graded relevance
+    got = float(FR.retrieval_normalized_dcg(jnp.asarray(p), jnp.asarray(t)))
+    expected = ndcg_score(t[None, :], p[None, :])
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_functional_auroc_vs_sklearn():
+    rng = np.random.default_rng(34)
+    p = rng.random(30).astype(np.float32)
+    t = rng.integers(0, 2, 30)
+    assert np.allclose(float(FR.retrieval_auroc(jnp.asarray(p), jnp.asarray(t))), roc_auc_score(t, p), atol=1e-5)
+
+
+def test_map_modular_vs_per_query(queries):
+    idx, p, t = queries
+    m = RetrievalMAP()
+    for s in np.array_split(np.arange(len(idx)), 3):
+        m.update(jnp.asarray(p[s]), jnp.asarray(t[s]), jnp.asarray(idx[s]))
+    got = float(m.compute())
+    expected = np.mean([average_precision_score(t[idx == q], p[idx == q]) for q in np.unique(idx)])
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_ndcg_modular_vs_sklearn(queries):
+    idx, p, t = queries
+    m = RetrievalNormalizedDCG()
+    m.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+    got = float(m.compute())
+    expected = np.mean([ndcg_score(t[idx == q][None, :], p[idx == q][None, :]) for q in np.unique(idx)])
+    assert np.allclose(got, expected, atol=1e-5)
+
+
+def test_all_modular_run(queries):
+    idx, p, t = queries
+    for cls in [RetrievalMRR, RetrievalPrecision, RetrievalRecall, RetrievalFallOut, RetrievalHitRate, RetrievalRPrecision, RetrievalAUROC]:
+        m = cls()
+        m.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+        v = float(m.compute())
+        assert 0.0 <= v <= 1.0, cls.__name__
+
+
+def test_empty_target_actions():
+    idx = jnp.array([0, 0, 1, 1])
+    p = jnp.array([0.9, 0.1, 0.8, 0.2])
+    t = jnp.array([1, 0, 0, 0])  # query 1 has no positives
+    for action, expected in [("neg", 0.5), ("pos", 1.0), ("skip", 1.0)]:
+        m = RetrievalMAP(empty_target_action=action)
+        m.update(p, t, idx)
+        assert np.allclose(float(m.compute()), expected), action
+    m = RetrievalMAP(empty_target_action="error")
+    m.update(p, t, idx)
+    with pytest.raises(ValueError):
+        m.compute()
+
+
+def test_precision_recall_curve_modular(queries):
+    idx, p, t = queries
+    m = RetrievalPrecisionRecallCurve(max_k=5)
+    m.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+    precisions, recalls, ks = m.compute()
+    assert precisions.shape == (5,) and recalls.shape == (5,)
+    assert np.all(np.diff(np.asarray(recalls)) >= -1e-6)  # recall non-decreasing in k
+
+
+def test_recall_at_fixed_precision(queries):
+    idx, p, t = queries
+    m = RetrievalRecallAtFixedPrecision(min_precision=0.1, max_k=5)
+    m.update(jnp.asarray(p), jnp.asarray(t), jnp.asarray(idx))
+    recall, k = m.compute()
+    assert 0.0 <= float(recall) <= 1.0
+    assert 1 <= int(k) <= 5
+
+
+def test_auroc_top_k():
+    p = jnp.array([0.9, 0.8, 0.1, 0.2])
+    t = jnp.array([0, 1, 1, 0])
+    # top-2: docs with preds 0.9 (neg), 0.8 (pos): rank of pos=2 → auc = 0
+    assert float(FR.retrieval_auroc(p, t, top_k=2)) == 0.0
+    full = float(FR.retrieval_auroc(p, t))
+    assert full == 0.25  # 1 of 4 (pos, neg) pairs correctly ordered
+
+
+def test_fall_out_empty_semantics():
+    idx = jnp.array([0, 0, 1, 1])
+    p = jnp.array([0.9, 0.1, 0.8, 0.2])
+    t = jnp.array([1, 1, 0, 1])  # query 0 has no negatives
+    m = RetrievalFallOut(top_k=1)  # default empty_target_action='pos'
+    m.update(p, t, idx)
+    # query 0 "empty" → 1.0; query 1: top-1 doc (0.8) is negative → fall-out 1.0
+    assert np.allclose(float(m.compute()), 1.0)
+    m2 = RetrievalFallOut(top_k=1, empty_target_action="skip")
+    m2.update(p, t, idx)
+    assert np.allclose(float(m2.compute()), 1.0)
+
+
+def test_prc_empty_target_action():
+    idx = jnp.array([0, 0, 1, 1])
+    p = jnp.array([0.9, 0.1, 0.8, 0.2])
+    t = jnp.array([1, 0, 0, 0])  # query 1 has no positives
+    m = RetrievalPrecisionRecallCurve(max_k=2, empty_target_action="error")
+    m.update(p, t, idx)
+    with pytest.raises(ValueError):
+        m.compute()
+    m2 = RetrievalPrecisionRecallCurve(max_k=2, empty_target_action="skip")
+    m2.update(p, t, idx)
+    prec, rec, ks = m2.compute()
+    assert np.allclose(np.asarray(prec), [1.0, 0.5])  # only query 0 counted

@@ -14,7 +14,23 @@ _logger = __logging.getLogger("torchmetrics_tpu")
 _logger.addHandler(__logging.StreamHandler())
 _logger.setLevel(__logging.INFO)
 
-from torchmetrics_tpu import aggregation, classification, functional, regression, utilities, wrappers  # noqa: E402
+from torchmetrics_tpu import (  # noqa: E402
+    aggregation,
+    classification,
+    clustering,
+    functional,
+    nominal,
+    regression,
+    retrieval,
+    utilities,
+    wrappers,
+)
+from torchmetrics_tpu.clustering import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.clustering import __all__ as _clustering_all  # noqa: E402
+from torchmetrics_tpu.nominal import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.nominal import __all__ as _nominal_all  # noqa: E402
+from torchmetrics_tpu.retrieval import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.retrieval import __all__ as _retrieval_all  # noqa: E402
 from torchmetrics_tpu.aggregation import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.aggregation import __all__ as _aggregation_all  # noqa: E402
 from torchmetrics_tpu.classification import *  # noqa: F401,F403,E402
@@ -31,13 +47,19 @@ __all__ = [
     "MetricCollection",
     "aggregation",
     "classification",
+    "clustering",
     "functional",
+    "nominal",
     "regression",
+    "retrieval",
     "utilities",
     "wrappers",
     "__version__",
     *_aggregation_all,
     *_classification_all,
+    *_clustering_all,
+    *_nominal_all,
     *_regression_all,
+    *_retrieval_all,
     *_wrappers_all,
 ]

@@ -201,7 +201,10 @@ def _make_running(name: str, base_cls: type, doc: str) -> type:
     def __init__(self, window: int = 5, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         Running.__init__(self, base_cls(nan_strategy=nan_strategy, **kwargs), window=window)
 
-    return type(name, (Running,), {"__init__": __init__, "__doc__": doc})
+    cls = type(name, (Running,), {"__init__": __init__, "__doc__": doc})
+    cls.__module__ = __name__  # make the generated class picklable
+    cls.__qualname__ = name
+    return cls
 
 
 RunningMean = _make_running(

@@ -1,0 +1,169 @@
+"""Modular clustering metrics (reference ``torchmetrics/clustering/``).
+
+Extrinsic metrics keep cat-list label states; intrinsic metrics keep cat-list
+(data, labels) states. Compute runs the functional kernels on the
+concatenated state.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering import (
+    adjusted_mutual_info_score,
+    adjusted_rand_score,
+    calinski_harabasz_score,
+    completeness_score,
+    davies_bouldin_score,
+    dunn_index,
+    fowlkes_mallows_index,
+    homogeneity_score,
+    mutual_info_score,
+    normalized_mutual_info_score,
+    rand_score,
+    v_measure_score,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class _LabelPairMetric(Metric):
+    """Base for extrinsic metrics on (preds, target) label streams."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.preds.append(jnp.asarray(preds).reshape(-1))
+        self.target.append(jnp.asarray(target).reshape(-1))
+
+    def _compute_fn(self, preds: Array, target: Array) -> Array:
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return self._compute_fn(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+def _make_label_pair(name: str, fn: Callable, doc: str, **fixed: Any) -> type:
+    def _compute_fn(self, preds, target):
+        return fn(preds, target, **{k: getattr(self, k) for k in fixed})
+
+    def __init__(self, **kwargs):
+        init_kwargs = {k: kwargs.pop(k, v) for k, v in fixed.items()}
+        _LabelPairMetric.__init__(self, **kwargs)
+        for k, v in init_kwargs.items():
+            setattr(self, k, v)
+
+    cls = type(name, (_LabelPairMetric,), {"__init__": __init__, "_compute_fn": _compute_fn, "__doc__": doc})
+    cls.__module__ = __name__  # make the generated class picklable
+    cls.__qualname__ = name
+    return cls
+
+
+MutualInfoScore = _make_label_pair(
+    "MutualInfoScore", mutual_info_score,
+    """Mutual information between cluster assignments.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.clustering import MutualInfoScore
+        >>> metric = MutualInfoScore()
+        >>> metric.update(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]))
+        >>> metric.compute()
+        Array(0.6931472, dtype=float32)
+    """,
+)
+NormalizedMutualInfoScore = _make_label_pair(
+    "NormalizedMutualInfoScore", normalized_mutual_info_score,
+    "Normalized mutual information.", average_method="arithmetic",
+)
+AdjustedMutualInfoScore = _make_label_pair(
+    "AdjustedMutualInfoScore", adjusted_mutual_info_score,
+    "Adjusted (chance-corrected) mutual information.", average_method="arithmetic",
+)
+RandScore = _make_label_pair("RandScore", rand_score, "Rand index.")
+AdjustedRandScore = _make_label_pair("AdjustedRandScore", adjusted_rand_score, "Adjusted Rand index.")
+HomogeneityScore = _make_label_pair("HomogeneityScore", homogeneity_score, "Homogeneity score.")
+CompletenessScore = _make_label_pair("CompletenessScore", completeness_score, "Completeness score.")
+VMeasureScore = _make_label_pair("VMeasureScore", v_measure_score, "V-measure.", beta=1.0)
+FowlkesMallowsIndex = _make_label_pair("FowlkesMallowsIndex", fowlkes_mallows_index, "Fowlkes-Mallows index.")
+
+
+class _DataLabelMetric(Metric):
+    """Base for intrinsic metrics on (data, labels) streams."""
+
+    is_differentiable = True
+    full_state_update = True
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("data", default=[], dist_reduce_fx="cat")
+        self.add_state("labels", default=[], dist_reduce_fx="cat")
+
+    def update(self, data: Array, labels: Array) -> None:
+        self.data.append(jnp.asarray(data, jnp.float32))
+        self.labels.append(jnp.asarray(labels).reshape(-1))
+
+    def _compute_fn(self, data: Array, labels: Array) -> Array:
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return self._compute_fn(dim_zero_cat(self.data), dim_zero_cat(self.labels))
+
+
+class CalinskiHarabaszScore(_DataLabelMetric):
+    """Calinski-Harabasz score (between/within dispersion ratio)."""
+
+    higher_is_better = True
+
+    def _compute_fn(self, data: Array, labels: Array) -> Array:
+        return calinski_harabasz_score(data, labels)
+
+
+class DaviesBouldinScore(_DataLabelMetric):
+    """Davies-Bouldin score (lower is better)."""
+
+    higher_is_better = False
+
+    def _compute_fn(self, data: Array, labels: Array) -> Array:
+        return davies_bouldin_score(data, labels)
+
+
+class DunnIndex(_DataLabelMetric):
+    """Dunn index (higher is better)."""
+
+    higher_is_better = True
+
+    def __init__(self, p: float = 2.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.p = p
+
+    def _compute_fn(self, data: Array, labels: Array) -> Array:
+        return dunn_index(data, labels, self.p)
+
+
+__all__ = [
+    "AdjustedMutualInfoScore",
+    "AdjustedRandScore",
+    "CalinskiHarabaszScore",
+    "CompletenessScore",
+    "DaviesBouldinScore",
+    "DunnIndex",
+    "FowlkesMallowsIndex",
+    "HomogeneityScore",
+    "MutualInfoScore",
+    "NormalizedMutualInfoScore",
+    "RandScore",
+    "VMeasureScore",
+]

@@ -1,9 +1,26 @@
 """Stateless functional metrics (L2)."""
 
-from torchmetrics_tpu.functional import classification, regression
+from torchmetrics_tpu.functional import classification, clustering, nominal, regression, retrieval
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
+from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.clustering import __all__ as _clustering_all
+from torchmetrics_tpu.functional.nominal import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.nominal import __all__ as _nominal_all
 from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
+from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.retrieval import __all__ as _retrieval_all
 
-__all__ = ["classification", "regression", *_classification_all, *_regression_all]
+__all__ = [
+    "classification",
+    "clustering",
+    "nominal",
+    "regression",
+    "retrieval",
+    *_classification_all,
+    *_clustering_all,
+    *_nominal_all,
+    *_regression_all,
+    *_retrieval_all,
+]

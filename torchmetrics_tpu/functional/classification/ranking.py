@@ -46,7 +46,6 @@ def _multilabel_ranking_format(
 def _multilabel_coverage_error_update(preds: Array, target: Array) -> Tuple[Array, int]:
     # for each sample: max rank (1-indexed position in descending score order)
     # over its relevant labels == how far down the list we must go
-    offset = jnp.zeros_like(preds)
     offset = jnp.where(target == 1, 0.0, 1e30)
     min_relevant_score = jnp.min(preds + offset, axis=1, keepdims=True)  # min score among relevant
     has_relevant = jnp.any(target == 1, axis=1)

@@ -139,8 +139,8 @@ def _per_class_fixed_op(
 ) -> Tuple[Array, Array]:
     vals, thrs = [], []
     for i in range(num):
-        p_i = precision[i] if not isinstance(precision, list) else precision[i]
-        r_i = recall[i] if not isinstance(recall, list) else recall[i]
+        p_i = precision[i]
+        r_i = recall[i]
         t_i = thresholds if not isinstance(thresholds, list) and thresholds.ndim == 1 else thresholds[i]
         v, t = reduce_fn(p_i, r_i, t_i, constraint)
         vals.append(v)

@@ -1,0 +1,165 @@
+"""Extrinsic (label-vs-label) clustering metrics.
+
+Reference ``functional/clustering/{mutual_info_score,normalized_mutual_info_score,
+adjusted_mutual_info_score,rand_score,adjusted_rand_score,homogeneity_completeness_v_measure,
+fowlkes_mallows_index}.py``.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.clustering.utils import (
+    calculate_contingency_matrix,
+    calculate_entropy,
+    calculate_generalized_mean,
+    calculate_pair_cluster_confusion_matrix,
+    check_cluster_labels,
+)
+
+Array = jax.Array
+
+
+def mutual_info_score(preds: Array, target: Array) -> Array:
+    """Mutual information between two clusterings.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import mutual_info_score
+        >>> mutual_info_score(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]))
+        Array(0.6931472, dtype=float32)
+    """
+    check_cluster_labels(preds, target)
+    contingency = calculate_contingency_matrix(preds, target)
+    n = contingency.sum()
+    pij = contingency / n
+    pi = contingency.sum(axis=1, keepdims=True) / n
+    pj = contingency.sum(axis=0, keepdims=True) / n
+    outer = pi @ pj
+    return jnp.sum(jnp.where(pij > 0, pij * (jnp.log(jnp.clip(pij, min=1e-30)) - jnp.log(jnp.clip(outer, min=1e-30))), 0.0))
+
+
+def normalized_mutual_info_score(preds: Array, target: Array, average_method: str = "arithmetic") -> Array:
+    """NMI = MI / generalized-mean(H(preds), H(target))."""
+    mi = mutual_info_score(preds, target)
+    if bool(mi == 0):
+        return jnp.asarray(0.0, dtype=jnp.float32)
+    h_preds = calculate_entropy(preds)
+    h_target = calculate_entropy(target)
+    norm = calculate_generalized_mean(jnp.stack([h_preds, h_target]), average_method)
+    return mi / norm
+
+
+def expected_mutual_info_score(contingency: Array, n: int) -> float:
+    """Hypergeometric E[MI] (sklearn's expected_mutual_information; host-side)."""
+    from scipy.special import gammaln
+
+    c = np.asarray(contingency)
+    a = c.sum(axis=1)
+    b = c.sum(axis=0)
+    emi = 0.0
+    log_n = np.log(n)
+    gln_n = gammaln(n + 1)
+    for i, ai in enumerate(a):
+        for j, bj in enumerate(b):
+            start = int(max(1, ai + bj - n))
+            end = int(min(ai, bj)) + 1
+            for nij in range(start, end):
+                term1 = nij / n * (np.log(nij) - np.log(ai) - np.log(bj) + log_n)
+                gln = (
+                    gammaln(ai + 1)
+                    + gammaln(bj + 1)
+                    + gammaln(n - ai + 1)
+                    + gammaln(n - bj + 1)
+                    - gln_n
+                    - gammaln(nij + 1)
+                    - gammaln(ai - nij + 1)
+                    - gammaln(bj - nij + 1)
+                    - gammaln(n - ai - bj + nij + 1)
+                )
+                emi += term1 * np.exp(gln)
+    return float(emi)
+
+
+def adjusted_mutual_info_score(preds: Array, target: Array, average_method: str = "arithmetic") -> Array:
+    """AMI = (MI - E[MI]) / (mean(H) - E[MI])."""
+    contingency = calculate_contingency_matrix(preds, target)
+    mi = mutual_info_score(preds, target)
+    n = int(contingency.sum())
+    emi = expected_mutual_info_score(contingency, n)
+    h_preds = calculate_entropy(preds)
+    h_target = calculate_entropy(target)
+    norm = calculate_generalized_mean(jnp.stack([h_preds, h_target]), average_method)
+    denom = float(norm) - emi
+    if abs(denom) < 1e-15:
+        return jnp.asarray(0.0, dtype=jnp.float32)
+    return (mi - emi) / denom
+
+
+def rand_score(preds: Array, target: Array) -> Array:
+    """Rand index: fraction of agreeing sample pairs.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import rand_score
+        >>> rand_score(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]))
+        Array(1., dtype=float32)
+    """
+    check_cluster_labels(preds, target)
+    pair = calculate_pair_cluster_confusion_matrix(preds, target)
+    total = pair.sum()
+    return jnp.where(total > 0, (pair[0, 0] + pair[1, 1]) / jnp.maximum(total, 1.0), 1.0)
+
+
+def adjusted_rand_score(preds: Array, target: Array) -> Array:
+    """Adjusted Rand index (chance-corrected)."""
+    check_cluster_labels(preds, target)
+    pair = calculate_pair_cluster_confusion_matrix(preds, target)
+    tn, fp, fn, tp = pair[0, 0], pair[0, 1], pair[1, 0], pair[1, 1]
+    if bool(fn == 0) and bool(fp == 0):
+        return jnp.asarray(1.0, dtype=jnp.float32)
+    return 2.0 * (tp * tn - fn * fp) / ((tp + fn) * (fn + tn) + (tp + fp) * (fp + tn))
+
+
+def homogeneity_score(preds: Array, target: Array) -> Array:
+    """Homogeneity: each cluster contains only members of one class."""
+    check_cluster_labels(preds, target)
+    h_target = calculate_entropy(target)
+    if bool(h_target == 0):
+        return jnp.asarray(1.0, dtype=jnp.float32)
+    # H(target | preds)
+    contingency = calculate_contingency_matrix(preds, target)
+    n = contingency.sum()
+    p_cluster = contingency.sum(axis=0) / n  # over preds clusters
+    p_joint = contingency / n
+    cond = -jnp.sum(
+        jnp.where(p_joint > 0, p_joint * (jnp.log(jnp.clip(p_joint, min=1e-30)) - jnp.log(jnp.clip(p_cluster[None, :], min=1e-30))), 0.0)
+    )
+    return 1.0 - cond / h_target
+
+
+def completeness_score(preds: Array, target: Array) -> Array:
+    """Completeness: all members of a class are assigned to the same cluster."""
+    return homogeneity_score(target, preds)
+
+
+def v_measure_score(preds: Array, target: Array, beta: float = 1.0) -> Array:
+    """V-measure: weighted harmonic mean of homogeneity and completeness."""
+    h = homogeneity_score(preds, target)
+    c = completeness_score(preds, target)
+    if bool(h + c == 0):
+        return jnp.asarray(0.0, dtype=jnp.float32)
+    return (1 + beta) * h * c / (beta * h + c)
+
+
+def fowlkes_mallows_index(preds: Array, target: Array) -> Array:
+    """FMI = TP / sqrt((TP+FP)(TP+FN)) over sample pairs."""
+    check_cluster_labels(preds, target)
+    pair = calculate_pair_cluster_confusion_matrix(preds, target)
+    tp = pair[1, 1]
+    fp = pair[0, 1]
+    fn = pair[1, 0]
+    denom = jnp.sqrt((tp + fp) * (tp + fn))
+    return jnp.where(denom > 0, tp / jnp.maximum(denom, 1.0), 0.0)

@@ -1,0 +1,85 @@
+"""Intrinsic clustering metrics working on raw data + labels.
+
+Reference ``functional/clustering/{calinski_harabasz_score,davies_bouldin_score,
+dunn_index}.py``. All are dense distance computations that map cleanly onto
+the MXU (pairwise matmuls / centroid reductions).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _validate_intrinsic_cluster_data(data: Array, labels: Array) -> None:
+    if jnp.asarray(data).ndim != 2:
+        raise ValueError(f"Expected 2D data, got {jnp.asarray(data).ndim}D")
+    if jnp.asarray(labels).ndim != 1:
+        raise ValueError("Expected 1D labels")
+    if jnp.asarray(data).shape[0] != jnp.asarray(labels).shape[0]:
+        raise ValueError("Expected the same number of samples in `data` and `labels`")
+
+
+def _cluster_stats(data: Array, labels: Array):
+    from torchmetrics_tpu.functional.clustering.utils import _relabel
+
+    lab, k = _relabel(labels)
+    oh = jax.nn.one_hot(lab, k, dtype=jnp.float32)  # (N, K)
+    counts = oh.sum(axis=0)  # (K,)
+    centroids = (oh.T @ data) / jnp.maximum(counts[:, None], 1.0)  # (K, D)
+    return lab, k, oh, counts, centroids
+
+
+def calinski_harabasz_score(data: Array, labels: Array) -> Array:
+    """Between/within dispersion ratio.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.clustering import calinski_harabasz_score
+        >>> data = jnp.array([[0.0, 0.0], [0.1, 0.1], [5.0, 5.0], [5.1, 5.1]])
+        >>> labels = jnp.array([0, 0, 1, 1])
+        >>> calinski_harabasz_score(data, labels) > 100
+        Array(True, dtype=bool)
+    """
+    data = jnp.asarray(data, jnp.float32)
+    _validate_intrinsic_cluster_data(data, labels)
+    n = data.shape[0]
+    lab, k, oh, counts, centroids = _cluster_stats(data, labels)
+    mean_all = data.mean(axis=0)
+    between = jnp.sum(counts * jnp.sum((centroids - mean_all) ** 2, axis=1))
+    within = jnp.sum((data - centroids[lab]) ** 2)
+    return (between / jnp.maximum(within, 1e-30)) * ((n - k) / max(k - 1, 1))
+
+
+def davies_bouldin_score(data: Array, labels: Array) -> Array:
+    """Average worst-case within-to-between cluster similarity ratio."""
+    data = jnp.asarray(data, jnp.float32)
+    _validate_intrinsic_cluster_data(data, labels)
+    lab, k, oh, counts, centroids = _cluster_stats(data, labels)
+    # mean intra-cluster distance (scatter) per cluster
+    dists = jnp.linalg.norm(data - centroids[lab], axis=1)
+    scatter = (oh.T @ dists) / jnp.maximum(counts, 1.0)  # (K,)
+    # centroid distances
+    cdist = jnp.linalg.norm(centroids[:, None, :] - centroids[None, :, :], axis=-1)
+    ratio = (scatter[:, None] + scatter[None, :]) / jnp.where(cdist == 0, jnp.inf, cdist)
+    ratio = jnp.where(jnp.eye(k, dtype=bool), -jnp.inf, ratio)
+    return jnp.mean(jnp.max(ratio, axis=1))
+
+
+def dunn_index(data: Array, labels: Array, p: float = 2.0) -> Array:
+    """Min inter-cluster distance / max intra-cluster diameter."""
+    data = jnp.asarray(data, jnp.float32)
+    _validate_intrinsic_cluster_data(data, labels)
+    lab_np = np.asarray(labels)
+    uniq = np.unique(lab_np)
+    lab = np.searchsorted(uniq, lab_np)
+    pd = jnp.sum(jnp.abs(data[:, None, :] - data[None, :, :]) ** p, axis=-1) ** (1.0 / p)
+    same = lab[:, None] == lab[None, :]
+    same = jnp.asarray(same)
+    max_intra = jnp.max(jnp.where(same, pd, 0.0))
+    inter = jnp.where(~same, pd, jnp.inf)
+    min_inter = jnp.min(inter)
+    return min_inter / jnp.maximum(max_intra, 1e-30)

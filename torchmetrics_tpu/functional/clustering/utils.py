@@ -1,0 +1,93 @@
+"""Clustering utilities (reference ``functional/clustering/utils.py``).
+
+Contingency matrices are built with one-hot einsums (MXU-shaped); label
+relabelling to a dense range happens eagerly (cluster label sets are
+data-dependent, so this layer runs outside jit, like the reference's
+``torch.unique``-based path).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _relabel(labels: Array) -> Tuple[Array, int]:
+    """Map arbitrary labels to 0..K-1 (eager)."""
+    lab = np.asarray(labels).reshape(-1)
+    uniq, inv = np.unique(lab, return_inverse=True)
+    return jnp.asarray(inv), len(uniq)
+
+
+def check_cluster_labels(preds: Array, target: Array) -> None:
+    if jnp.asarray(preds).ndim != 1 or jnp.asarray(target).ndim != 1:
+        raise ValueError("Expected 1d arrays of cluster labels")
+    if jnp.asarray(preds).shape != jnp.asarray(target).shape:
+        raise ValueError(
+            f"Expected `preds` and `target` to have the same shape, got {jnp.asarray(preds).shape} and"
+            f" {jnp.asarray(target).shape}"
+        )
+
+
+def calculate_contingency_matrix(
+    preds: Array, target: Array, eps: Optional[float] = None
+) -> Array:
+    """Contingency matrix ``(num_target_classes, num_pred_classes)``."""
+    p, kp = _relabel(preds)
+    t, kt = _relabel(target)
+    t_oh = jax.nn.one_hot(t, kt, dtype=jnp.float32)
+    p_oh = jax.nn.one_hot(p, kp, dtype=jnp.float32)
+    contingency = jnp.einsum("nc,nd->cd", t_oh, p_oh)
+    if eps is not None:
+        contingency = contingency + eps
+    return contingency
+
+
+def calculate_pair_cluster_confusion_matrix(
+    preds: Optional[Array] = None,
+    target: Optional[Array] = None,
+    contingency: Optional[Array] = None,
+) -> Array:
+    """2×2 pair confusion matrix (counts of sample pairs, reference ``utils.py:215``)."""
+    if contingency is None:
+        if preds is None or target is None:
+            raise ValueError("Expected both `preds` and `target` when `contingency` is not provided")
+        contingency = calculate_contingency_matrix(preds, target)
+    n = contingency.sum()
+    sum_rows = contingency.sum(axis=1)
+    sum_cols = contingency.sum(axis=0)
+    sum_squared = jnp.sum(contingency**2)
+    n11 = sum_squared - n
+    n10 = jnp.sum(sum_rows**2) - sum_squared
+    n01 = jnp.sum(sum_cols**2) - sum_squared
+    n00 = n**2 - n11 - n10 - n01 - n
+    return jnp.array([[n00, n01], [n10, n11]])
+
+
+def calculate_entropy(x: Array) -> Array:
+    """Entropy of a label assignment (natural log, reference ``utils.py:47``)."""
+    lab, k = _relabel(x)
+    counts = jnp.sum(jax.nn.one_hot(lab, k, dtype=jnp.float32), axis=0)
+    n = counts.sum()
+    p = counts / n
+    return -jnp.sum(jnp.where(p > 0, p * jnp.log(p), 0.0))
+
+
+def calculate_generalized_mean(x: Array, p) -> Array:
+    """Generalized mean: 'min' | 'max' | 'arithmetic' | 'geometric' (reference ``utils.py:78``)."""
+    if isinstance(p, str):
+        if p == "min":
+            return jnp.min(x)
+        if p == "max":
+            return jnp.max(x)
+        if p == "arithmetic":
+            return jnp.mean(x)
+        if p == "geometric":
+            return jnp.exp(jnp.mean(jnp.log(jnp.clip(x, min=1e-30))))
+        raise ValueError(f"Invalid generalized mean: {p}")
+    return jnp.mean(x**p) ** (1.0 / p)

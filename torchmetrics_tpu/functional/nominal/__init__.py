@@ -1,0 +1,175 @@
+"""Functional nominal-association metrics (reference ``torchmetrics/functional/nominal/``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.clustering.utils import calculate_contingency_matrix
+
+Array = jax.Array
+
+
+def _nominal_input_validation(nan_strategy: str, nan_replace_value: Optional[float]) -> None:
+    if nan_strategy not in ("replace", "drop"):
+        raise ValueError(
+            f"Argument `nan_strategy` is expected to be one of `['replace', 'drop']`, but got {nan_strategy}"
+        )
+    if nan_strategy == "replace" and not isinstance(nan_replace_value, (int, float)):
+        raise ValueError(
+            "Argument `nan_replace` is expected to be of a type `int` or `float` when `nan_strategy = 'replace`, "
+            f"but got {nan_replace_value}"
+        )
+
+
+def _handle_nan(preds: Array, target: Array, nan_strategy: str, nan_replace_value: Optional[float]):
+    preds = jnp.asarray(preds, jnp.float32).reshape(-1)
+    target = jnp.asarray(target, jnp.float32).reshape(-1)
+    nans = jnp.isnan(preds) | jnp.isnan(target)
+    if nan_strategy == "replace":
+        preds = jnp.where(jnp.isnan(preds), nan_replace_value, preds)
+        target = jnp.where(jnp.isnan(target), nan_replace_value, target)
+    else:
+        keep = jnp.nonzero(~nans)[0]
+        preds = preds[keep]
+        target = target[keep]
+    return preds.astype(jnp.int32), target.astype(jnp.int32)
+
+
+def _chi2(confmat: Array) -> Array:
+    n = confmat.sum()
+    expected = jnp.outer(confmat.sum(axis=1), confmat.sum(axis=0)) / n
+    return jnp.sum(jnp.where(expected > 0, (confmat - expected) ** 2 / jnp.clip(expected, min=1e-30), 0.0))
+
+
+def cramers_v(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Cramér's V association between two categorical series.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.nominal import cramers_v
+        >>> cramers_v(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]), bias_correction=False)
+        Array(1., dtype=float32)
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
+    confmat = calculate_contingency_matrix(preds, target)
+    n = confmat.sum()
+    r, k = confmat.shape
+    chi2 = _chi2(confmat)
+    phi2 = chi2 / n
+    if bias_correction:
+        phi2 = jnp.clip(phi2 - (r - 1) * (k - 1) / (n - 1), min=0.0)
+        r = r - (r - 1) ** 2 / float(n - 1)
+        k = k - (k - 1) ** 2 / float(n - 1)
+    denom = min(r - 1, k - 1) if not bias_correction else jnp.minimum(r - 1, k - 1)
+    return jnp.sqrt(phi2 / jnp.clip(jnp.asarray(denom, jnp.float32), min=1e-30))
+
+
+def tschuprows_t(
+    preds: Array,
+    target: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Tschuprow's T association."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
+    confmat = calculate_contingency_matrix(preds, target)
+    n = confmat.sum()
+    r, k = confmat.shape
+    chi2 = _chi2(confmat)
+    phi2 = chi2 / n
+    if bias_correction:
+        phi2 = jnp.clip(phi2 - (r - 1) * (k - 1) / (n - 1), min=0.0)
+        r = r - (r - 1) ** 2 / float(n - 1)
+        k = k - (k - 1) ** 2 / float(n - 1)
+    return jnp.sqrt(phi2 / jnp.sqrt(jnp.clip(jnp.asarray((r - 1) * (k - 1), jnp.float32), min=1e-30)))
+
+
+def pearsons_contingency_coefficient(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pearson's contingency coefficient sqrt(chi2/(chi2+n))."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
+    confmat = calculate_contingency_matrix(preds, target)
+    n = confmat.sum()
+    chi2 = _chi2(confmat)
+    return jnp.sqrt(chi2 / (chi2 + n))
+
+
+def theils_u(
+    preds: Array,
+    target: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Theil's U (uncertainty coefficient): U(preds | target), asymmetric."""
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
+    confmat = calculate_contingency_matrix(target, preds)  # rows=preds? see below
+    # rows: preds categories (x), cols: target categories (y)
+    n = confmat.sum()
+    p_joint = confmat / n
+    p_x = p_joint.sum(axis=1)  # preds marginal
+    p_y = p_joint.sum(axis=0)
+    h_x = -jnp.sum(jnp.where(p_x > 0, p_x * jnp.log(jnp.clip(p_x, min=1e-30)), 0.0))
+    # H(X|Y) = -sum p(x,y) log(p(x,y)/p(y))
+    h_xy = -jnp.sum(
+        jnp.where(p_joint > 0, p_joint * (jnp.log(jnp.clip(p_joint, min=1e-30)) - jnp.log(jnp.clip(p_y[None, :], min=1e-30))), 0.0)
+    )
+    return jnp.where(h_x == 0, jnp.asarray(0.0), (h_x - h_xy) / jnp.clip(h_x, min=1e-30))
+
+
+def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
+    """Fleiss' kappa for inter-rater agreement.
+
+    ``mode='counts'``: ratings is (n_subjects, n_categories) count matrix;
+    ``mode='probs'``: (n_raters, n_subjects, n_categories) probabilities which
+    are argmaxed into counts.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.nominal import fleiss_kappa
+        >>> ratings = jnp.array([[5, 0], [3, 2], [0, 5], [5, 0]])
+        >>> round(float(fleiss_kappa(ratings)), 3)
+        0.655
+    """
+    if mode not in ("counts", "probs"):
+        raise ValueError("Argument `mode` must be one of 'counts' or 'probs'")
+    ratings = jnp.asarray(ratings)
+    if mode == "probs":
+        if ratings.ndim != 3:
+            raise ValueError("If argument `mode` is 'probs', ratings must be a 3D tensor")
+        import jax.nn as jnn
+
+        ratings = jnn.one_hot(jnp.argmax(ratings, axis=-1), ratings.shape[-1], dtype=jnp.float32).sum(axis=0)
+    ratings = ratings.astype(jnp.float32)
+    n_raters = ratings.sum(axis=1)[0]
+    p_cat = ratings.sum(axis=0) / ratings.sum()
+    p_subject = (jnp.sum(ratings**2, axis=1) - n_raters) / (n_raters * (n_raters - 1))
+    p_bar = jnp.mean(p_subject)
+    pe_bar = jnp.sum(p_cat**2)
+    return (p_bar - pe_bar) / jnp.clip(1 - pe_bar, min=1e-30)
+
+
+__all__ = [
+    "cramers_v",
+    "fleiss_kappa",
+    "pearsons_contingency_coefficient",
+    "theils_u",
+    "tschuprows_t",
+]

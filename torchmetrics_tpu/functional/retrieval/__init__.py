@@ -1,0 +1,116 @@
+"""Functional retrieval metrics (reference ``torchmetrics/functional/retrieval/``).
+
+Public per-query functions operate on 1-D (preds, target); the mask-aware
+kernels in ``_masked`` power the vmapped modular path.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.retrieval import _masked as _mk
+
+Array = jax.Array
+
+
+def _check_retrieval_functional_inputs(preds, target, allow_non_binary_target: bool = False):
+    preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+    target = jnp.asarray(target).reshape(-1)
+    if preds.shape != target.shape:
+        raise ValueError("`preds` and `target` must be of the same shape")
+    if not allow_non_binary_target:
+        target = (target > 0).astype(jnp.int32)
+    return preds, target
+
+
+def _full(preds, target, kernel, allow_non_binary: bool = False, **kw):
+    preds, target = _check_retrieval_functional_inputs(preds, target, allow_non_binary)
+    mask = jnp.ones(preds.shape, dtype=jnp.bool_)
+    return kernel(preds, target, mask, **kw)
+
+
+def retrieval_average_precision(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Average precision for a single query.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.retrieval import retrieval_average_precision
+        >>> retrieval_average_precision(jnp.array([0.2, 0.3, 0.5]), jnp.array([True, False, True]))
+        Array(0.8333334, dtype=float32)
+    """
+    return _full(preds, target, _mk.average_precision_masked, top_k=top_k)
+
+
+def retrieval_reciprocal_rank(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Reciprocal rank of the first relevant document."""
+    return _full(preds, target, _mk.reciprocal_rank_masked, top_k=top_k)
+
+
+def retrieval_precision(
+    preds: Array, target: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    """Precision@k for a single query."""
+    return _full(preds, target, _mk.precision_masked, top_k=top_k, adaptive_k=adaptive_k)
+
+
+def retrieval_recall(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Recall@k for a single query."""
+    return _full(preds, target, _mk.recall_masked, top_k=top_k)
+
+
+def retrieval_fall_out(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Fall-out@k (fraction of irrelevant docs retrieved) for a single query."""
+    return _full(preds, target, _mk.fall_out_masked, top_k=top_k)
+
+
+def retrieval_hit_rate(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Hit-rate@k for a single query."""
+    return _full(preds, target, _mk.hit_rate_masked, top_k=top_k)
+
+
+def retrieval_r_precision(preds: Array, target: Array) -> Array:
+    """R-precision (precision at R = number of relevant docs)."""
+    return _full(preds, target, _mk.r_precision_masked)
+
+
+def retrieval_auroc(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Per-query AUROC via the Mann-Whitney rank statistic."""
+    return _full(preds, target, _mk.auroc_masked, top_k=top_k)
+
+
+def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
+    """Normalized discounted cumulative gain (graded relevance supported)."""
+    return _full(preds, target, _mk.ndcg_masked, allow_non_binary=True, top_k=top_k)
+
+
+def retrieval_precision_recall_curve(
+    preds: Array, target: Array, max_k: Optional[int] = None, adaptive_k: bool = False
+):
+    """(precision@k, recall@k, k) for k = 1..max_k for a single query."""
+    preds, target = _check_retrieval_functional_inputs(preds, target)
+    n = preds.shape[-1]
+    max_k = min(max_k or n, n)
+    mask = jnp.ones(preds.shape, dtype=jnp.bool_)
+    ks = jnp.arange(1, max_k + 1)
+    precisions = jnp.stack(
+        [_mk.precision_masked(preds, target, mask, top_k=int(k), adaptive_k=adaptive_k) for k in range(1, max_k + 1)]
+    )
+    recalls = jnp.stack([_mk.recall_masked(preds, target, mask, top_k=int(k)) for k in range(1, max_k + 1)])
+    return precisions, recalls, ks
+
+
+__all__ = [
+    "retrieval_auroc",
+    "retrieval_average_precision",
+    "retrieval_fall_out",
+    "retrieval_hit_rate",
+    "retrieval_normalized_dcg",
+    "retrieval_precision",
+    "retrieval_precision_recall_curve",
+    "retrieval_r_precision",
+    "retrieval_recall",
+    "retrieval_reciprocal_rank",
+]

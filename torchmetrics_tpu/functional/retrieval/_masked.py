@@ -1,0 +1,148 @@
+"""Mask-aware per-query retrieval kernels.
+
+Every kernel takes fixed-shape ``(L,)`` arrays plus a validity mask, so a
+batch of queries padded to a common length can be evaluated with one
+``jax.vmap`` — the TPU-native replacement for the reference's sort +
+``_flexible_bincount`` + python split (``retrieval/base.py:155-163``), which
+is dynamic-shape and host-bound.
+
+Convention: ``preds`` padding is ``-inf`` (sorts last), ``target`` padding 0,
+``mask`` True on valid entries. ``top_k`` is a static int (or None = all).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+NEG_INF = -jnp.inf
+
+
+def _sorted_by_preds(preds: Array, target: Array, mask: Array):
+    """Descending stable sort of target/mask by preds, padding last."""
+    p = jnp.where(mask, preds, NEG_INF)
+    order = jnp.argsort(-p, stable=True)
+    return target[order], mask[order]
+
+
+def _topk_keep(mask_sorted: Array, top_k: Optional[int]) -> Array:
+    """Positions (post-sort) that count: valid and within top_k."""
+    pos = jnp.arange(1, mask_sorted.shape[-1] + 1)
+    keep = mask_sorted
+    if top_k is not None:
+        keep = keep & (pos <= top_k)
+    return keep
+
+
+def average_precision_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    t, m = _sorted_by_preds(preds, target, mask)
+    keep = _topk_keep(m, top_k)
+    rel = (t > 0) & keep
+    pos = jnp.arange(1, t.shape[-1] + 1, dtype=jnp.float32)
+    cum_rel = jnp.cumsum(rel.astype(jnp.float32))
+    n_rel = jnp.sum(rel)
+    ap = jnp.sum(jnp.where(rel, cum_rel / pos, 0.0))
+    return jnp.where(n_rel > 0, ap / jnp.maximum(n_rel, 1), 0.0)
+
+
+def reciprocal_rank_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    t, m = _sorted_by_preds(preds, target, mask)
+    keep = _topk_keep(m, top_k)
+    rel = (t > 0) & keep
+    pos = jnp.arange(1, t.shape[-1] + 1, dtype=jnp.float32)
+    first = jnp.min(jnp.where(rel, pos, jnp.inf))
+    return jnp.where(jnp.isfinite(first), 1.0 / first, 0.0)
+
+
+def precision_masked(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None, adaptive_k: bool = False
+) -> Array:
+    n_valid = jnp.sum(mask)
+    k = n_valid if top_k is None else jnp.asarray(top_k)
+    if adaptive_k:
+        k = jnp.minimum(k, n_valid)
+    t, m = _sorted_by_preds(preds, target, mask)
+    keep = _topk_keep(m, None if top_k is None else int(top_k)) if not adaptive_k else (
+        m & (jnp.arange(1, t.shape[-1] + 1) <= k)
+    )
+    rel = jnp.sum(((t > 0) & keep).astype(jnp.float32))
+    return rel / k.astype(jnp.float32)
+
+
+def recall_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    total_rel = jnp.sum(((target > 0) & mask).astype(jnp.float32))
+    t, m = _sorted_by_preds(preds, target, mask)
+    keep = _topk_keep(m, top_k)
+    rel = jnp.sum(((t > 0) & keep).astype(jnp.float32))
+    return jnp.where(total_rel > 0, rel / jnp.maximum(total_rel, 1.0), 0.0)
+
+
+def fall_out_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    total_irrel = jnp.sum(((target == 0) & mask).astype(jnp.float32))
+    t, m = _sorted_by_preds(preds, target, mask)
+    keep = _topk_keep(m, top_k)
+    irrel = jnp.sum(((t == 0) & keep).astype(jnp.float32))
+    return jnp.where(total_irrel > 0, irrel / jnp.maximum(total_irrel, 1.0), 0.0)
+
+
+def hit_rate_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    t, m = _sorted_by_preds(preds, target, mask)
+    keep = _topk_keep(m, top_k)
+    return jnp.any((t > 0) & keep).astype(jnp.float32)
+
+
+def r_precision_masked(preds: Array, target: Array, mask: Array) -> Array:
+    total_rel = jnp.sum((target > 0) & mask)
+    t, m = _sorted_by_preds(preds, target, mask)
+    pos = jnp.arange(1, t.shape[-1] + 1)
+    keep = m & (pos <= total_rel)
+    rel = jnp.sum(((t > 0) & keep).astype(jnp.float32))
+    return jnp.where(total_rel > 0, rel / jnp.maximum(total_rel, 1).astype(jnp.float32), 0.0)
+
+
+def auroc_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """Rank-statistic AUROC (Mann-Whitney U), mask-aware; ties get average rank.
+
+    With ``top_k``, only the k highest-scoring valid docs are considered
+    (reference ``functional/retrieval/auroc.py`` truncates to ``topk`` first).
+    """
+    if top_k is not None:
+        # keep only entries ranked within top_k by preds
+        p_sortkey = jnp.where(mask, preds, NEG_INF)
+        rank_desc = jnp.argsort(jnp.argsort(-p_sortkey, stable=True), stable=True)  # 0-indexed rank
+        mask = mask & (rank_desc < top_k)
+    p = jnp.where(mask, preds, NEG_INF)
+    rel = (target > 0) & mask
+    irrel = (target == 0) & mask
+    # average ranks over valid entries (ascending)
+    lt = ((p[None, :] < p[:, None]) & mask[None, :]).sum(axis=-1).astype(jnp.float32)
+    eq = ((p[None, :] == p[:, None]) & mask[None, :]).sum(axis=-1).astype(jnp.float32)
+    ranks = lt + (eq + 1.0) / 2.0
+    n_pos = jnp.sum(rel.astype(jnp.float32))
+    n_neg = jnp.sum(irrel.astype(jnp.float32))
+    rank_sum = jnp.sum(jnp.where(rel, ranks, 0.0))
+    auc = (rank_sum - n_pos * (n_pos + 1) / 2) / jnp.maximum(n_pos * n_neg, 1.0)
+    return jnp.where((n_pos > 0) & (n_neg > 0), auc, 0.0)
+
+
+def ndcg_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+    """nDCG with log2 discount. Ties broken by stable sort (the reference
+    tie-averages; identical when scores are distinct)."""
+    L = preds.shape[-1]
+    pos = jnp.arange(L, dtype=jnp.float32)
+    discount = 1.0 / jnp.log2(pos + 2.0)
+    if top_k is not None:
+        discount = jnp.where(pos < top_k, discount, 0.0)
+
+    t, m = _sorted_by_preds(preds, target, mask)
+    gain = jnp.sum(jnp.where(m, t.astype(jnp.float32), 0.0) * discount)
+
+    t_f = jnp.where(mask, target.astype(jnp.float32), NEG_INF)
+    ideal = jnp.sort(t_f)[::-1]
+    ideal = jnp.where(jnp.isfinite(ideal), ideal, 0.0)
+    ideal_gain = jnp.sum(ideal * discount)
+    return jnp.where(ideal_gain > 0, gain / jnp.maximum(ideal_gain, 1e-12), 0.0)

@@ -1,0 +1,139 @@
+"""Modular nominal-association metrics (reference ``torchmetrics/nominal/``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.nominal import (
+    _nominal_input_validation,
+    cramers_v,
+    fleiss_kappa,
+    pearsons_contingency_coefficient,
+    theils_u,
+    tschuprows_t,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class _NominalPairMetric(Metric):
+    """Base: cat-list (preds, target) categorical streams."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        nan_strategy: str = "replace",
+        nan_replace_value: Optional[float] = 0.0,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        _nominal_input_validation(nan_strategy, nan_replace_value)
+        self.nan_strategy = nan_strategy
+        self.nan_replace_value = nan_replace_value
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        self.preds.append(jnp.asarray(preds).reshape(-1))
+        self.target.append(jnp.asarray(target).reshape(-1))
+
+    def _compute_fn(self, preds, target):
+        raise NotImplementedError
+
+    def compute(self) -> Array:
+        return self._compute_fn(dim_zero_cat(self.preds), dim_zero_cat(self.target))
+
+
+class CramersV(_NominalPairMetric):
+    """Cramér's V.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import CramersV
+        >>> metric = CramersV(bias_correction=False)
+        >>> metric.update(jnp.array([0, 0, 1, 1]), jnp.array([0, 0, 1, 1]))
+        >>> metric.compute()
+        Array(1., dtype=float32)
+    """
+
+    def __init__(self, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.bias_correction = bias_correction
+
+    def _compute_fn(self, preds, target):
+        return cramers_v(preds, target, self.bias_correction, self.nan_strategy, self.nan_replace_value)
+
+
+class TschuprowsT(_NominalPairMetric):
+    """Tschuprow's T."""
+
+    def __init__(self, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.bias_correction = bias_correction
+
+    def _compute_fn(self, preds, target):
+        return tschuprows_t(preds, target, self.bias_correction, self.nan_strategy, self.nan_replace_value)
+
+
+class PearsonsContingencyCoefficient(_NominalPairMetric):
+    """Pearson's contingency coefficient."""
+
+    def _compute_fn(self, preds, target):
+        return pearsons_contingency_coefficient(preds, target, self.nan_strategy, self.nan_replace_value)
+
+
+class TheilsU(_NominalPairMetric):
+    """Theil's U (uncertainty coefficient)."""
+
+    def _compute_fn(self, preds, target):
+        return theils_u(preds, target, self.nan_strategy, self.nan_replace_value)
+
+
+class FleissKappa(Metric):
+    """Fleiss' kappa for inter-rater agreement.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.nominal import FleissKappa
+        >>> metric = FleissKappa(mode='counts')
+        >>> metric.update(jnp.array([[5, 0], [3, 2], [0, 5], [5, 0]]))
+        >>> round(float(metric.compute()), 3)
+        0.655
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, mode: str = "counts", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if mode not in ("counts", "probs"):
+            raise ValueError("Argument `mode` must be one of 'counts' or 'probs'")
+        self.mode = mode
+        self.add_state("ratings", default=[], dist_reduce_fx="cat")
+
+    def update(self, ratings: Array) -> None:
+        ratings = jnp.asarray(ratings)
+        if self.mode == "probs":
+            import jax.nn as jnn
+
+            ratings = jnn.one_hot(jnp.argmax(ratings, axis=-1), ratings.shape[-1], dtype=jnp.float32).sum(axis=0)
+        self.ratings.append(ratings)
+
+    def compute(self) -> Array:
+        return fleiss_kappa(dim_zero_cat(self.ratings), mode="counts")
+
+
+__all__ = ["CramersV", "FleissKappa", "PearsonsContingencyCoefficient", "TheilsU", "TschuprowsT"]

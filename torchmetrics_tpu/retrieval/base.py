@@ -1,0 +1,130 @@
+"""Retrieval metric base (reference ``retrieval/base.py:43``).
+
+States: ``indexes / preds / target`` cat lists with ``dist_reduce_fx=None``
+semantics (gathered, not reduced). ``compute`` groups by query index and
+evaluates the per-query kernel. TPU-first: queries are padded to a common
+length and the mask-aware kernel is evaluated with ONE ``jax.vmap`` call —
+a single fused device computation — instead of the reference's sort +
+``_flexible_bincount`` + per-query python loop.
+"""
+
+from __future__ import annotations
+
+from abc import abstractmethod
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class RetrievalMetric(Metric):
+    """Base for retrieval metrics working on (indexes, preds, target) triplets."""
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        empty_target_action_options = ("error", "skip", "neg", "pos")
+        if empty_target_action not in empty_target_action_options:
+            raise ValueError(
+                f"Argument `empty_target_action` received a wrong value `{empty_target_action}`."
+            )
+        self.empty_target_action = empty_target_action
+        if ignore_index is not None and not isinstance(ignore_index, int):
+            raise ValueError("Argument `ignore_index` must be an integer or None.")
+        self.ignore_index = ignore_index
+        self.add_state("indexes", default=[], dist_reduce_fx=None)
+        self.add_state("preds", default=[], dist_reduce_fx=None)
+        self.add_state("target", default=[], dist_reduce_fx=None)
+
+    def update(self, preds: Array, target: Array, indexes: Array) -> None:
+        if indexes is None:
+            raise ValueError("Argument `indexes` cannot be None")
+        preds = jnp.asarray(preds).reshape(-1).astype(jnp.float32)
+        target = jnp.asarray(target).reshape(-1)
+        indexes = jnp.asarray(indexes).reshape(-1)
+        if not (preds.shape == target.shape == indexes.shape):
+            raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
+        if self.ignore_index is not None:
+            keep = jnp.nonzero(target != self.ignore_index)[0]
+            preds, target, indexes = preds[keep], target[keep], indexes[keep]
+        self.indexes.append(indexes)
+        self.preds.append(preds)
+        self.target.append(target)
+
+    # queries are "empty" when they have no positive target; FallOut inverts
+    # this to "no negative target" (reference retrieval/fall_out.py semantics)
+    _empty_query_has_no = "positives"
+
+    def _group_and_pad(self):
+        """Cat states → padded (num_q, max_len) preds/target/mask arrays."""
+        indexes = np.asarray(dim_zero_cat(self.indexes))
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+
+        order = np.argsort(indexes, kind="stable")
+        sorted_idx = indexes[order]
+        uniq, counts = np.unique(sorted_idx, return_counts=True)
+        num_q = len(uniq)
+        if num_q == 0:
+            return None
+        max_len = int(counts.max())
+
+        starts = np.concatenate([[0], np.cumsum(counts)[:-1]])
+        row = np.repeat(np.arange(num_q), counts)
+        col = np.arange(len(indexes)) - np.repeat(starts, counts)
+
+        preds_np = np.asarray(preds)[order]
+        target_np = np.asarray(target)[order]
+        pad_preds = np.full((num_q, max_len), -np.inf, dtype=np.float32)
+        pad_target = np.zeros((num_q, max_len), dtype=target_np.dtype)
+        pad_mask = np.zeros((num_q, max_len), dtype=bool)
+        pad_preds[row, col] = preds_np
+        pad_target[row, col] = target_np
+        pad_mask[row, col] = True
+        return jnp.asarray(pad_preds), jnp.asarray(pad_target), jnp.asarray(pad_mask)
+
+    def _non_empty(self, pad_target: Array, pad_mask: Array) -> Array:
+        if self._empty_query_has_no == "negatives":
+            return jnp.asarray(((pad_target == 0) & pad_mask).any(axis=1))
+        return jnp.asarray((pad_target > 0).any(axis=1))
+
+    def _apply_empty_target_action(self, res: Array, non_empty: Array) -> Array:
+        if self.empty_target_action == "error" and bool(jnp.any(~non_empty)):
+            raise ValueError("`compute` method was provided with a query without positive target.")
+        if self.empty_target_action == "pos":
+            return jnp.where(non_empty, res, 1.0)
+        if self.empty_target_action == "neg":
+            return jnp.where(non_empty, res, 0.0)
+        if self.empty_target_action == "skip":
+            return res[jnp.nonzero(non_empty)[0]]
+        return res
+
+    def compute(self) -> Array:
+        padded = self._group_and_pad()
+        if padded is None:
+            return jnp.asarray(0.0)
+        pad_preds, pad_target, pad_mask = padded
+        res = jax.vmap(self._metric)(pad_preds, pad_target, pad_mask)
+        res = self._apply_empty_target_action(res, self._non_empty(pad_target, pad_mask))
+        return self._aggregate(res)
+
+    def _aggregate(self, res: Array) -> Array:
+        return jnp.mean(res) if res.size else jnp.asarray(0.0)
+
+    @abstractmethod
+    def _metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        """Per-query kernel on padded (L,) arrays with validity mask."""

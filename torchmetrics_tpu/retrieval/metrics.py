@@ -1,0 +1,194 @@
+"""Concrete retrieval metrics (reference ``retrieval/{average_precision,...}.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.retrieval import _masked as _mk
+from torchmetrics_tpu.retrieval.base import RetrievalMetric
+
+Array = jax.Array
+
+
+def _validate_top_k(top_k: Optional[int]) -> None:
+    if top_k is not None and not (isinstance(top_k, int) and top_k > 0):
+        raise ValueError("`top_k` has to be a positive integer or None")
+
+
+class _TopKRetrievalMetric(RetrievalMetric):
+    _kernel = None
+
+    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None, top_k: Optional[int] = None, **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        _validate_top_k(top_k)
+        self.top_k = top_k
+
+    def _metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return type(self)._kernel(preds, target, mask, top_k=self.top_k)
+
+
+class RetrievalMAP(_TopKRetrievalMetric):
+    """Mean average precision over queries.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.retrieval import RetrievalMAP
+        >>> metric = RetrievalMAP()
+        >>> metric.update(jnp.array([0.2, 0.3, 0.5, 0.1]), jnp.array([1, 0, 1, 1]), jnp.array([0, 0, 0, 1]))
+        >>> round(float(metric.compute()), 4)
+        0.9167
+    """
+
+    _kernel = staticmethod(_mk.average_precision_masked)
+
+
+class RetrievalMRR(_TopKRetrievalMetric):
+    """Mean reciprocal rank over queries."""
+
+    _kernel = staticmethod(_mk.reciprocal_rank_masked)
+
+
+class RetrievalRecall(_TopKRetrievalMetric):
+    """Mean recall@k over queries."""
+
+    _kernel = staticmethod(_mk.recall_masked)
+
+
+class RetrievalFallOut(_TopKRetrievalMetric):
+    """Mean fall-out@k over queries (lower is better).
+
+    A query is "empty" when it has no NEGATIVE targets (inverted semantics,
+    reference ``retrieval/fall_out.py``); default action is ``pos``.
+    """
+
+    higher_is_better = False
+    _empty_query_has_no = "negatives"
+    _kernel = staticmethod(_mk.fall_out_masked)
+
+    def __init__(self, empty_target_action: str = "pos", **kwargs: Any) -> None:
+        super().__init__(empty_target_action=empty_target_action, **kwargs)
+
+
+class RetrievalHitRate(_TopKRetrievalMetric):
+    """Mean hit-rate@k over queries."""
+
+    _kernel = staticmethod(_mk.hit_rate_masked)
+
+
+class RetrievalNormalizedDCG(_TopKRetrievalMetric):
+    """Mean nDCG over queries (graded relevance supported)."""
+
+    _kernel = staticmethod(_mk.ndcg_masked)
+
+
+class RetrievalAUROC(_TopKRetrievalMetric):
+    """Mean per-query AUROC."""
+
+    _kernel = staticmethod(_mk.auroc_masked)
+
+
+class RetrievalPrecision(RetrievalMetric):
+    """Mean precision@k over queries."""
+
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        _validate_top_k(top_k)
+        if not isinstance(adaptive_k, bool):
+            raise ValueError("`adaptive_k` has to be a boolean")
+        self.top_k = top_k
+        self.adaptive_k = adaptive_k
+
+    def _metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return _mk.precision_masked(preds, target, mask, top_k=self.top_k, adaptive_k=self.adaptive_k)
+
+
+class RetrievalRPrecision(RetrievalMetric):
+    """Mean R-precision over queries."""
+
+    def _metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return _mk.r_precision_masked(preds, target, mask)
+
+
+class RetrievalPrecisionRecallCurve(RetrievalMetric):
+    """Averaged (precision@k, recall@k) curves over queries for k=1..max_k."""
+
+    def __init__(
+        self,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        if max_k is not None and not (isinstance(max_k, int) and max_k > 0):
+            raise ValueError("`max_k` has to be a positive integer or None")
+        self.max_k = max_k
+        self.adaptive_k = adaptive_k
+
+    def _metric(self, preds: Array, target: Array, mask: Array) -> Array:  # pragma: no cover
+        raise NotImplementedError
+
+    def compute(self):
+        padded = self._group_and_pad()
+        if padded is None:
+            return jnp.zeros(0), jnp.zeros(0), jnp.zeros(0, jnp.int32)
+        pad_preds, pad_target, pad_mask = padded
+        max_len = pad_preds.shape[1]
+        max_k = min(self.max_k or max_len, max_len)
+        non_empty = self._non_empty(pad_target, pad_mask)
+
+        precisions, recalls = [], []
+        for k in range(1, max_k + 1):
+            p_k = jax.vmap(lambda p, t, m: _mk.precision_masked(p, t, m, top_k=k, adaptive_k=self.adaptive_k))(
+                pad_preds, pad_target, pad_mask
+            )
+            r_k = jax.vmap(lambda p, t, m: _mk.recall_masked(p, t, m, top_k=k))(pad_preds, pad_target, pad_mask)
+            p_k = self._apply_empty_target_action(p_k, non_empty)
+            r_k = self._apply_empty_target_action(r_k, non_empty)
+            precisions.append(jnp.mean(p_k))
+            recalls.append(jnp.mean(r_k))
+        return jnp.stack(precisions), jnp.stack(recalls), jnp.arange(1, max_k + 1)
+
+
+class RetrievalRecallAtFixedPrecision(RetrievalPrecisionRecallCurve):
+    """Max recall@k whose precision@k >= ``min_precision`` (returns (recall, k))."""
+
+    def __init__(
+        self,
+        min_precision: float = 0.0,
+        max_k: Optional[int] = None,
+        adaptive_k: bool = False,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            max_k=max_k,
+            adaptive_k=adaptive_k,
+            empty_target_action=empty_target_action,
+            ignore_index=ignore_index,
+            **kwargs,
+        )
+        if not (isinstance(min_precision, float) and 0.0 <= min_precision <= 1.0):
+            raise ValueError("`min_precision` has to be a float between 0 and 1")
+        self.min_precision = min_precision
+
+    def compute(self):
+        precisions, recalls, ks = super().compute()
+        ok = precisions >= self.min_precision
+        best_recall = jnp.max(jnp.where(ok, recalls, -jnp.inf))
+        any_ok = jnp.any(ok)
+        best_recall = jnp.where(any_ok, best_recall, 0.0)
+        best_k = jnp.where(any_ok, ks[jnp.argmax(jnp.where(ok & (recalls == best_recall), 1, 0))], jnp.max(ks))
+        return best_recall, best_k
