@@ -1,0 +1,65 @@
+"""Image kernel helpers: separable gaussian/uniform filters as depthwise convs.
+
+XLA maps ``lax.conv_general_dilated`` with ``feature_group_count=C`` onto the
+TPU convolution units; all kernels here keep static shapes.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Array = jax.Array
+
+
+def _gaussian_kernel_1d(kernel_size: int, sigma: float, dtype=jnp.float32) -> Array:
+    dist = jnp.arange((1 - kernel_size) / 2, (1 + kernel_size) / 2, 1, dtype=dtype)
+    gauss = jnp.exp(-jnp.square(dist / sigma) / 2)
+    return gauss / gauss.sum()
+
+
+def _uniform_kernel_1d(kernel_size: int, dtype=jnp.float32) -> Array:
+    return jnp.full((kernel_size,), 1.0 / kernel_size, dtype=dtype)
+
+
+def _depthwise_conv2d(x: Array, kernel: Array) -> Array:
+    """Depthwise valid conv. ``x``: (N, C, H, W); ``kernel``: (kh, kw)."""
+    c = x.shape[1]
+    k = jnp.broadcast_to(kernel[None, None, :, :], (c, 1, *kernel.shape))
+    return lax.conv_general_dilated(
+        x,
+        k,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        feature_group_count=c,
+    )
+
+
+def _gaussian_filter2d(x: Array, kernel_size: Sequence[int], sigma: Sequence[float]) -> Array:
+    kh = _gaussian_kernel_1d(kernel_size[0], sigma[0])
+    kw = _gaussian_kernel_1d(kernel_size[1], sigma[1])
+    return _depthwise_conv2d(x, jnp.outer(kh, kw))
+
+
+def _uniform_filter2d(x: Array, kernel_size: Sequence[int]) -> Array:
+    kh = _uniform_kernel_1d(kernel_size[0])
+    kw = _uniform_kernel_1d(kernel_size[1])
+    return _depthwise_conv2d(x, jnp.outer(kh, kw))
+
+
+def _reflection_pad2d(x: Array, pad: int) -> Array:
+    return jnp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)), mode="reflect")
+
+
+def _check_image_pair(preds: Array, target: Array) -> Tuple[Array, Array]:
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if preds.shape != target.shape:
+        raise ValueError(
+            f"Expected `preds` and `target` to have the same shape, got {preds.shape} and {target.shape}"
+        )
+    return preds, target

@@ -1,0 +1,230 @@
+"""Smaller pure-compute image metrics.
+
+Reference ``functional/image/{uqi,sam,ergas,rase,rmse_sw,tv,scc,d_lambda}.py``.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.helper import (
+    _check_image_pair,
+    _depthwise_conv2d,
+    _gaussian_kernel_1d,
+    _uniform_filter2d,
+)
+
+Array = jax.Array
+
+
+def universal_image_quality_index(
+    preds: Array,
+    target: Array,
+    kernel_size: Sequence[int] = (11, 11),
+    sigma: Sequence[float] = (1.5, 1.5),
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Universal image quality index (UQI == SSIM with C1=C2=0).
+
+    Example:
+        >>> import jax
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.image import universal_image_quality_index
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 32, 32))
+        >>> universal_image_quality_index(preds, preds)
+        Array(1., dtype=float32)
+    """
+    preds, target = _check_image_pair(preds, target)
+    kh = _gaussian_kernel_1d(kernel_size[0], sigma[0])
+    kw = _gaussian_kernel_1d(kernel_size[1], sigma[1])
+    kernel = jnp.outer(kh, kw)
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+    preds_p = jnp.pad(preds, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+    target_p = jnp.pad(target, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+    mu_x = _depthwise_conv2d(preds_p, kernel)
+    mu_y = _depthwise_conv2d(target_p, kernel)
+    sigma_x = _depthwise_conv2d(preds_p**2, kernel) - mu_x**2
+    sigma_y = _depthwise_conv2d(target_p**2, kernel) - mu_y**2
+    sigma_xy = _depthwise_conv2d(preds_p * target_p, kernel) - mu_x * mu_y
+
+    upper = 2 * sigma_xy
+    lower = sigma_x + sigma_y
+    eps = jnp.finfo(jnp.float32).eps
+    uqi_map = (2 * mu_x * mu_y * upper) / ((mu_x**2 + mu_y**2) * lower + eps)
+    uqi_map = uqi_map[..., pad_h:-pad_h if pad_h else None, pad_w:-pad_w if pad_w else None]
+    vals = uqi_map.reshape(uqi_map.shape[0], -1).mean(-1)
+    if reduction == "elementwise_mean":
+        return jnp.mean(vals)
+    if reduction == "sum":
+        return jnp.sum(vals)
+    return vals
+
+
+def spectral_angle_mapper(
+    preds: Array,
+    target: Array,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """Spectral angle mapper (radians) between multispectral images (N,C,H,W)."""
+    preds, target = _check_image_pair(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape, got {preds.shape}")
+    dot_product = (preds * target).sum(axis=1)
+    preds_norm = jnp.linalg.norm(preds, axis=1)
+    target_norm = jnp.linalg.norm(target, axis=1)
+    sam_score = jnp.arccos(jnp.clip(dot_product / (preds_norm * target_norm), -1.0, 1.0))
+    if reduction == "elementwise_mean":
+        return jnp.mean(sam_score)
+    if reduction == "sum":
+        return jnp.sum(sam_score)
+    return sam_score
+
+
+def error_relative_global_dimensionless_synthesis(
+    preds: Array,
+    target: Array,
+    ratio: float = 4,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """ERGAS for pan-sharpening quality (N,C,H,W)."""
+    preds, target = _check_image_pair(preds, target)
+    b, c, h, w = preds.shape
+    preds_f = preds.reshape(b, c, -1)
+    target_f = target.reshape(b, c, -1)
+    diff = preds_f - target_f
+    sum_squared_error = jnp.sum(diff * diff, axis=2)
+    rmse_per_band = jnp.sqrt(sum_squared_error / (h * w))
+    mean_target = jnp.mean(target_f, axis=2)
+    ergas_score = 100 * ratio * jnp.sqrt(jnp.sum((rmse_per_band / mean_target) ** 2, axis=1) / c)
+    if reduction == "elementwise_mean":
+        return jnp.mean(ergas_score)
+    if reduction == "sum":
+        return jnp.sum(ergas_score)
+    return ergas_score
+
+
+def relative_average_spectral_error(
+    preds: Array,
+    target: Array,
+    window_size: int = 8,
+) -> Array:
+    """RASE: relative average spectral error via sliding-window RMSE (N,C,H,W)."""
+    preds, target = _check_image_pair(preds, target)
+    rmse_map, target_mu = _rmse_sw_maps(preds, target, window_size)
+    # mean target intensity over all bands per window
+    rase_map = 100 / target_mu.mean(axis=1) * jnp.sqrt(jnp.mean(rmse_map**2, axis=1))
+    return jnp.mean(rase_map)
+
+
+def _rmse_sw_maps(preds: Array, target: Array, window_size: int) -> Tuple[Array, Array]:
+    mu_t = _uniform_filter2d(target, (window_size, window_size))
+    diff2 = (preds - target) ** 2
+    mse_map = _uniform_filter2d(diff2, (window_size, window_size))
+    return jnp.sqrt(mse_map), mu_t
+
+
+def root_mean_squared_error_using_sliding_window(
+    preds: Array,
+    target: Array,
+    window_size: int = 8,
+) -> Array:
+    """RMSE over sliding windows (N,C,H,W)."""
+    preds, target = _check_image_pair(preds, target)
+    if not isinstance(window_size, int) or window_size < 1:
+        raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
+    rmse_map, _ = _rmse_sw_maps(preds, target, window_size)
+    return jnp.mean(rmse_map)
+
+
+def total_variation(img: Array, reduction: Optional[str] = "sum") -> Array:
+    """Total variation of an image batch (N,C,H,W).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.image import total_variation
+        >>> img = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 16, 16))
+        >>> total_variation(img).shape
+        ()
+    """
+    img = jnp.asarray(img, jnp.float32)
+    if img.ndim != 4:
+        raise RuntimeError(f"Expected input `img` to be an 4D tensor, but got {img.shape}")
+    diff1 = jnp.abs(img[..., 1:, :] - img[..., :-1, :]).sum(axis=(1, 2, 3))
+    diff2 = jnp.abs(img[..., :, 1:] - img[..., :, :-1]).sum(axis=(1, 2, 3))
+    res = diff1 + diff2
+    if reduction == "mean":
+        return res.mean()
+    if reduction == "sum":
+        return res.sum()
+    if reduction is None or reduction == "none":
+        return res
+    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+
+
+def spatial_correlation_coefficient(
+    preds: Array,
+    target: Array,
+    hp_filter: Optional[Array] = None,
+    window_size: int = 8,
+) -> Array:
+    """Spatial correlation coefficient with a high-pass Laplacian pre-filter."""
+    preds, target = _check_image_pair(preds, target)
+    if preds.ndim == 3:
+        preds = preds[:, None]
+        target = target[:, None]
+    if hp_filter is None:
+        hp_filter = jnp.array([[-1.0, -1.0, -1.0], [-1.0, 8.0, -1.0], [-1.0, -1.0, -1.0]])
+    pad = hp_filter.shape[0] // 2
+    preds_p = jnp.pad(preds, ((0, 0), (0, 0), (pad, pad), (pad, pad)), mode="reflect")
+    target_p = jnp.pad(target, ((0, 0), (0, 0), (pad, pad), (pad, pad)), mode="reflect")
+    preds_hp = _depthwise_conv2d(preds_p, hp_filter)
+    target_hp = _depthwise_conv2d(target_p, hp_filter)
+
+    mu_x = _uniform_filter2d(preds_hp, (window_size, window_size))
+    mu_y = _uniform_filter2d(target_hp, (window_size, window_size))
+    var_x = _uniform_filter2d(preds_hp**2, (window_size, window_size)) - mu_x**2
+    var_y = _uniform_filter2d(target_hp**2, (window_size, window_size)) - mu_y**2
+    cov_xy = _uniform_filter2d(preds_hp * target_hp, (window_size, window_size)) - mu_x * mu_y
+
+    denom = jnp.sqrt(jnp.clip(var_x, min=0.0)) * jnp.sqrt(jnp.clip(var_y, min=0.0))
+    scc_map = jnp.where(denom > 1e-10, cov_xy / jnp.where(denom > 1e-10, denom, 1.0), 0.0)
+    return jnp.mean(scc_map)
+
+
+def spectral_distortion_index(
+    preds: Array,
+    target: Array,
+    p: int = 1,
+    reduction: Optional[str] = "elementwise_mean",
+) -> Array:
+    """D_lambda spectral distortion index for pan-sharpening (N,C,H,W)."""
+    uqi = universal_image_quality_index
+    preds, target = _check_image_pair(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape, got {preds.shape}")
+    length = preds.shape[1]
+    if length < 2:
+        raise ValueError("Expected at least 2 spectral bands")
+    rows1, rows2 = [], []
+    for k in range(length):
+        r1, r2 = [], []
+        for r in range(length):
+            if k == r:
+                r1.append(jnp.asarray(1.0))
+                r2.append(jnp.asarray(1.0))
+            else:
+                r1.append(uqi(target[:, k : k + 1], target[:, r : r + 1], reduction="elementwise_mean"))
+                r2.append(uqi(preds[:, k : k + 1], preds[:, r : r + 1], reduction="elementwise_mean"))
+        rows1.append(jnp.stack(r1))
+        rows2.append(jnp.stack(r2))
+    m1 = jnp.stack(rows1)
+    m2 = jnp.stack(rows2)
+    diff = jnp.abs(m1 - m2) ** p
+    # exclude diagonal
+    total = jnp.sum(diff) - jnp.sum(jnp.diag(diff))
+    return (total / (length * (length - 1))) ** (1.0 / p)

@@ -1,0 +1,126 @@
+"""PSNR + PSNR-B (reference ``functional/image/{psnr,psnrb}.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _psnr_compute(
+    sum_squared_error: Array,
+    num_obs: Array,
+    data_range: Array,
+    base: float = 10.0,
+) -> Array:
+    psnr_base_e = 2 * jnp.log(data_range) - jnp.log(sum_squared_error / num_obs)
+    return psnr_base_e * (10 / jnp.log(base))
+
+
+def _psnr_update(
+    preds: Array,
+    target: Array,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+) -> Tuple[Array, Array]:
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if dim is None:
+        sum_squared_error = jnp.sum(jnp.square(preds - target))
+        num_obs = jnp.asarray(target.size, dtype=jnp.float32)
+    else:
+        diff = preds - target
+        sum_squared_error = jnp.sum(diff * diff, axis=dim)
+        num_obs = jnp.asarray(np_prod_axis(target.shape, dim), dtype=jnp.float32)
+        num_obs = jnp.broadcast_to(num_obs, sum_squared_error.shape)
+    return sum_squared_error, num_obs
+
+
+def np_prod_axis(shape, dim) -> int:
+    dims = (dim,) if isinstance(dim, int) else dim
+    out = 1
+    for d in dims:
+        out *= shape[d]
+    return out
+
+
+def peak_signal_noise_ratio(
+    preds: Array,
+    target: Array,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    base: float = 10.0,
+    dim: Optional[Union[int, Tuple[int, ...]]] = None,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Peak signal-to-noise ratio.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.image import peak_signal_noise_ratio
+        >>> preds = jnp.array([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
+        >>> peak_signal_noise_ratio(preds, target)
+        Array(2.5527055, dtype=float32)
+    """
+    _check_same_shape(preds, target)
+    if data_range is None:
+        if dim is not None:
+            raise ValueError("The `data_range` must be given when `dim` is not None.")
+        data_range = jnp.max(jnp.asarray(target)) - jnp.min(jnp.asarray(target))
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(jnp.asarray(preds), data_range[0], data_range[1])
+        target = jnp.clip(jnp.asarray(target), data_range[0], data_range[1])
+        data_range = jnp.asarray(data_range[1] - data_range[0], jnp.float32)
+    else:
+        data_range = jnp.asarray(float(data_range))
+    sum_squared_error, num_obs = _psnr_update(preds, target, dim=dim)
+    psnr = _psnr_compute(sum_squared_error, num_obs, data_range, base=base)
+    if reduction == "elementwise_mean" and psnr.ndim > 0:
+        return jnp.mean(psnr)
+    if reduction == "sum" and psnr.ndim > 0:
+        return jnp.sum(psnr)
+    return psnr
+
+
+def _psnrb_compute_bef(x: Array, block_size: int = 8) -> Array:
+    """Blocking effect factor of a single-channel image batch (N,1,H,W)."""
+    height, width = x.shape[-2], x.shape[-1]
+    h = jnp.arange(width - 1)
+    h_b = h[(h + 1) % block_size == 0]
+    h_bc = h[(h + 1) % block_size != 0]
+    v = jnp.arange(height - 1)
+    v_b = v[(v + 1) % block_size == 0]
+    v_bc = v[(v + 1) % block_size != 0]
+
+    d_b = jnp.sum((x[..., :, h_b] - x[..., :, h_b + 1]) ** 2) + jnp.sum((x[..., v_b, :] - x[..., v_b + 1, :]) ** 2)
+    d_bc = jnp.sum((x[..., :, h_bc] - x[..., :, h_bc + 1]) ** 2) + jnp.sum(
+        (x[..., v_bc, :] - x[..., v_bc + 1, :]) ** 2
+    )
+    n_hb = height * len(h_b)
+    n_hbc = height * len(h_bc)
+    n_vb = width * len(v_b)
+    n_vbc = width * len(v_bc)
+    d_b = d_b / (n_hb + n_vb)
+    d_bc = d_bc / (n_hbc + n_vbc)
+    t = jnp.log2(jnp.asarray(block_size, jnp.float32)) / jnp.log2(jnp.asarray(min(height, width), jnp.float32))
+    return jnp.where(d_b > d_bc, t * (d_b - d_bc), 0.0)
+
+
+def peak_signal_noise_ratio_with_blocked_effect(
+    preds: Array,
+    target: Array,
+    block_size: int = 8,
+) -> Array:
+    """PSNR-B: PSNR adjusted by the blocking effect factor (single-channel images)."""
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    _check_same_shape(preds, target)
+    data_range = jnp.max(target) - jnp.min(target)
+    sum_squared_error, num_obs = _psnr_update(preds, target)
+    bef = _psnrb_compute_bef(preds, block_size=block_size)
+    mse = sum_squared_error / num_obs
+    return 10.0 * jnp.log10(data_range**2 / (mse + bef))

@@ -1,0 +1,212 @@
+"""SSIM + MS-SSIM (reference ``functional/image/ssim.py``).
+
+Gaussian/uniform windows run as depthwise convolutions
+(``lax.conv_general_dilated`` with ``feature_group_count=C``) — the canonical
+TPU conv-unit mapping; everything is static-shape and jit-safe.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.helper import (
+    _check_image_pair,
+    _depthwise_conv2d,
+    _gaussian_kernel_1d,
+    _uniform_kernel_1d,
+)
+
+Array = jax.Array
+
+
+def _ssim_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
+    preds, target = _check_image_pair(preds, target)
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape, got {preds.shape}")
+    return preds, target
+
+
+def _ssim_update(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    if isinstance(kernel_size, int):
+        kernel_size = (kernel_size, kernel_size)
+    if isinstance(sigma, (int, float)):
+        sigma = (float(sigma), float(sigma))
+    if data_range is None:
+        data_range = jnp.maximum(jnp.max(preds) - jnp.min(preds), jnp.max(target) - jnp.min(target))
+    elif isinstance(data_range, tuple):
+        preds = jnp.clip(preds, data_range[0], data_range[1])
+        target = jnp.clip(target, data_range[0], data_range[1])
+        data_range = data_range[1] - data_range[0]
+
+    c1 = (k1 * data_range) ** 2
+    c2 = (k2 * data_range) ** 2
+
+    if gaussian_kernel:
+        kh = _gaussian_kernel_1d(kernel_size[0], sigma[0])
+        kw = _gaussian_kernel_1d(kernel_size[1], sigma[1])
+    else:
+        kh = _uniform_kernel_1d(kernel_size[0])
+        kw = _uniform_kernel_1d(kernel_size[1])
+    kernel = jnp.outer(kh, kw)
+
+    pad_h = (kernel_size[0] - 1) // 2
+    pad_w = (kernel_size[1] - 1) // 2
+    preds_p = jnp.pad(preds, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+    target_p = jnp.pad(target, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+
+    mu_x = _depthwise_conv2d(preds_p, kernel)
+    mu_y = _depthwise_conv2d(target_p, kernel)
+    mu_xx = _depthwise_conv2d(preds_p * preds_p, kernel)
+    mu_yy = _depthwise_conv2d(target_p * target_p, kernel)
+    mu_xy = _depthwise_conv2d(preds_p * target_p, kernel)
+
+    sigma_x = mu_xx - mu_x**2
+    sigma_y = mu_yy - mu_y**2
+    sigma_xy = mu_xy - mu_x * mu_y
+
+    upper = 2 * sigma_xy + c2
+    lower = sigma_x + sigma_y + c2
+    luminance = (2 * mu_x * mu_y + c1) / (mu_x**2 + mu_y**2 + c1)
+    cs_map = upper / lower
+    ssim_map = luminance * cs_map
+
+    # crop the padded border like the reference (outputs only the valid region)
+    ssim_map = ssim_map[..., pad_h:-pad_h if pad_h else None, pad_w:-pad_w if pad_w else None]
+    ssim_vals = ssim_map.reshape(ssim_map.shape[0], -1).mean(axis=-1)
+
+    if return_contrast_sensitivity:
+        cs_map = cs_map[..., pad_h:-pad_h if pad_h else None, pad_w:-pad_w if pad_w else None]
+        return ssim_vals, cs_map.reshape(cs_map.shape[0], -1).mean(axis=-1)
+    if return_full_image:
+        return ssim_vals, ssim_map
+    return ssim_vals
+
+
+def structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    return_full_image: bool = False,
+    return_contrast_sensitivity: bool = False,
+):
+    """Structural similarity index (SSIM).
+
+    Example:
+        >>> import jax
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.image import structural_similarity_index_measure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 32, 32))
+        >>> structural_similarity_index_measure(preds, preds)
+        Array(1., dtype=float32)
+    """
+    preds, target = _ssim_check_inputs(preds, target)
+    out = _ssim_update(
+        preds,
+        target,
+        gaussian_kernel,
+        sigma,
+        kernel_size,
+        data_range,
+        k1,
+        k2,
+        return_full_image,
+        return_contrast_sensitivity,
+    )
+    if return_full_image or return_contrast_sensitivity:
+        ssim_vals, extra = out
+    else:
+        ssim_vals = out
+    if reduction == "elementwise_mean":
+        res = jnp.mean(ssim_vals)
+    elif reduction == "sum":
+        res = jnp.sum(ssim_vals)
+    else:
+        res = ssim_vals
+    if return_full_image or return_contrast_sensitivity:
+        return res, extra
+    return res
+
+
+def multiscale_structural_similarity_index_measure(
+    preds: Array,
+    target: Array,
+    gaussian_kernel: bool = True,
+    sigma: Union[float, Sequence[float]] = 1.5,
+    kernel_size: Union[int, Sequence[int]] = 11,
+    reduction: Optional[str] = "elementwise_mean",
+    data_range: Optional[Union[float, Tuple[float, float]]] = None,
+    k1: float = 0.01,
+    k2: float = 0.03,
+    betas: Sequence[float] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+    normalize: Optional[str] = "relu",
+) -> Array:
+    """Multi-scale SSIM with the standard 5-scale beta weights.
+
+    Example:
+        >>> import jax
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.image import multiscale_structural_similarity_index_measure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
+        >>> multiscale_structural_similarity_index_measure(preds, preds)
+        Array(1., dtype=float32)
+    """
+    preds, target = _ssim_check_inputs(preds, target)
+    if not isinstance(betas, tuple) or not all(isinstance(b, float) for b in betas):
+        betas = tuple(float(b) for b in betas)
+
+    kh = kernel_size if isinstance(kernel_size, int) else kernel_size[0]
+    min_size = (kh - 1) * 2 ** (len(betas) - 1) + 1
+    if preds.shape[-1] < min_size or preds.shape[-2] < min_size:
+        raise ValueError(
+            f"For a given number of `betas` parameters {len(betas)}, the image height and width should be larger"
+            f" than {min_size} but got {preds.shape[-2]} and {preds.shape[-1]}"
+        )
+
+    mcs_list = []
+    sim = None
+    for i in range(len(betas)):
+        sim, cs = _ssim_update(
+            preds, target, gaussian_kernel, sigma, kernel_size, data_range, k1, k2,
+            return_contrast_sensitivity=True,
+        )
+        mcs_list.append(cs)
+        if i < len(betas) - 1:
+            preds = jax.lax.reduce_window(
+                preds, 0.0, jax.lax.add, (1, 1, 2, 2), (1, 1, 2, 2), "VALID"
+            ) / 4.0
+            target = jax.lax.reduce_window(
+                target, 0.0, jax.lax.add, (1, 1, 2, 2), (1, 1, 2, 2), "VALID"
+            ) / 4.0
+
+    mcs_list[-1] = sim
+    mcs_stack = jnp.stack(mcs_list, axis=0)  # (S, N)
+    if normalize == "relu":
+        mcs_stack = jax.nn.relu(mcs_stack)
+    betas_arr = jnp.asarray(betas)[:, None]
+    mcs_weighted = mcs_stack ** betas_arr
+    out = jnp.prod(mcs_weighted, axis=0)
+    if reduction == "elementwise_mean":
+        return jnp.mean(out)
+    if reduction == "sum":
+        return jnp.sum(out)
+    return out
