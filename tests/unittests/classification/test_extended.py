@@ -100,9 +100,10 @@ def test_binary_calibration_error():
     t = (rng.random(256) < p).astype(int)
     m = C.BinaryCalibrationError(n_bins=10, norm="l1")
     got = float(_stream(m, p, t))
-    # manual binned ECE oracle
-    conf = np.where(p > 0.5, p, 1 - p)
-    acc = ((p > 0.5).astype(int) == t).astype(float)
+    # manual binned ECE oracle — reference convention: confidence IS the
+    # predicted probability and accuracy IS the label
+    conf = p
+    acc = t.astype(float)
     bins = np.linspace(0, 1, 11)
     idx = np.clip(np.searchsorted(bins[1:-1], conf, side="right"), 0, 9)
     ece = 0.0

@@ -77,9 +77,10 @@ def _binary_calibration_error_tensor_validation(
 
 
 def _binary_calibration_error_update(preds: Array, target: Array) -> Tuple[Array, Array]:
-    confidences = jnp.where(preds > 0.5, preds, 1 - preds)
-    accuracies = ((preds > 0.5).astype(jnp.int32) == target).astype(jnp.float32)
-    return confidences, accuracies
+    # reference semantics (functional/classification/calibration_error.py):
+    # confidence IS the predicted probability and accuracy IS the label --
+    # not the max-prob/argmax-match convention used by the multiclass path.
+    return preds, target.astype(jnp.float32)
 
 
 def binary_calibration_error(

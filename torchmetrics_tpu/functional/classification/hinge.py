@@ -34,6 +34,10 @@ def _binary_hinge_loss_tensor_validation(preds: Array, target: Array, ignore_ind
 
 
 def _binary_hinge_loss_update(preds: Array, target: Array, squared: bool) -> Tuple[Array, Array]:
+    # reference routes binary preds through the confusion-matrix format step,
+    # which auto-applies sigmoid when values fall outside [0, 1]; conditional,
+    # so in-range probabilities pass through untouched
+    preds = normalize_logits_if_needed(preds, "sigmoid")
     target = jnp.where(target == 1, 1.0, -1.0)
     measures = 1 - target * preds
     measures = jnp.clip(measures, min=0)

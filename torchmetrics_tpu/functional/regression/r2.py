@@ -39,7 +39,18 @@ def _r2_score_compute(
         raise ValueError("Needs at least two samples to calculate r2 score.")
     mean_obs = sum_obs / total
     tss = sum_squared_obs - sum_obs * mean_obs
-    raw_scores = 1 - (residual / tss)
+    # constant-target guards (reference functional/regression/r2.py):
+    # tss≈0, rss≈0 -> perfect prediction of a constant -> 1.0;
+    # tss≈0, rss>0 -> imperfect prediction of a constant -> 0.0
+    # (never -inf/nan from the raw 1 - rss/tss division).
+    atol = 1e-8
+    cond_rss = residual > atol
+    cond_tss = tss > atol
+    raw_scores = jnp.where(
+        cond_rss & cond_tss,
+        1 - (residual / jnp.where(cond_tss, tss, 1.0)),
+        jnp.where(cond_rss & ~cond_tss, 0.0, 1.0),
+    )
 
     if multioutput == "raw_values":
         r2 = raw_scores

@@ -29,6 +29,13 @@ def _sorted_by_preds(preds: Array, target: Array, mask: Array):
     return target[order], mask[order]
 
 
+def _sorted_by_preds_with_scores(preds: Array, target: Array, mask: Array):
+    """Like :func:`_sorted_by_preds` but also returns the sorted scores."""
+    p = jnp.where(mask, preds, NEG_INF)
+    order = jnp.argsort(-p, stable=True)
+    return p[order], target[order], mask[order]
+
+
 def _topk_keep(mask_sorted: Array, top_k: Optional[int]) -> Array:
     """Positions (post-sort) that count: valid and within top_k."""
     pos = jnp.arange(1, mask_sorted.shape[-1] + 1)
@@ -130,16 +137,29 @@ def auroc_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] 
 
 
 def ndcg_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
-    """nDCG with log2 discount. Ties broken by stable sort (the reference
-    tie-averages; identical when scores are distinct)."""
+    """nDCG with log2 discount and sklearn/reference tie handling.
+
+    DCG tie-averages (reference ``functional/retrieval/ndcg.py`` ``_tie_average_dcg``):
+    every run of equal prediction scores contributes (mean target in run) x
+    (sum of discounts over the run's positions) — realised here as each item
+    taking its run's *average* discount, via a segment-sum over equal-pred runs
+    in sorted order (fixed shape, jittable). IDCG ignores ties (sorted target).
+    """
     L = preds.shape[-1]
     pos = jnp.arange(L, dtype=jnp.float32)
     discount = 1.0 / jnp.log2(pos + 2.0)
     if top_k is not None:
         discount = jnp.where(pos < top_k, discount, 0.0)
 
-    t, m = _sorted_by_preds(preds, target, mask)
-    gain = jnp.sum(jnp.where(m, t.astype(jnp.float32), 0.0) * discount)
+    p_sorted, t, m = _sorted_by_preds_with_scores(preds, target, mask)
+    # run ids over equal consecutive sorted preds (padding -inf forms its own
+    # trailing run; its target/gain are masked to zero anyway)
+    new_run = jnp.concatenate([jnp.ones(1, jnp.int32), (p_sorted[1:] != p_sorted[:-1]).astype(jnp.int32)])
+    gid = jnp.cumsum(new_run) - 1
+    seg_disc = jax.ops.segment_sum(discount, gid, num_segments=L)
+    seg_cnt = jax.ops.segment_sum(jnp.ones(L, jnp.float32), gid, num_segments=L)
+    avg_disc = seg_disc[gid] / jnp.maximum(seg_cnt[gid], 1.0)
+    gain = jnp.sum(jnp.where(m, t.astype(jnp.float32), 0.0) * avg_disc)
 
     t_f = jnp.where(mask, target.astype(jnp.float32), NEG_INF)
     ideal = jnp.sort(t_f)[::-1]

@@ -119,7 +119,8 @@ def normalize_logits_if_needed(tensor: Array, normalization: Optional[str]) -> A
     (``functional/classification/*_format``); under jit that is a traced bool, so
     we compute it as a lax.cond-free ``jnp.where`` over the whole array.
     """
-    if normalization is None:
+    if normalization is None or tensor.size == 0:
+        # size-0: reference's torch.all on empty is True -> no normalization
         return tensor
     outside = (jnp.min(tensor) < 0) | (jnp.max(tensor) > 1)
     if normalization == "sigmoid":
