@@ -1,0 +1,173 @@
+"""Loop-based numpy reimplementation of pycocotools ``COCOeval`` (bbox/segm).
+
+Serves as the differential-test oracle for the pure-XLA mAP engine, since
+``pycocotools`` itself is not installed in this environment. Follows the
+published COCO evaluation protocol step by step (per-image/per-category
+greedy matching, area ranges, crowd handling, 101-point interpolation) in
+deliberately plain python loops.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+AREA_RANGES = [(0.0, 1e10), (0.0, 32.0**2), (32.0**2, 96.0**2), (96.0**2, 1e10)]
+
+
+def box_iou_crowd(dt, gt, iscrowd):
+    """IoU between xyxy det and gt boxes; crowd gt columns use det-area denom."""
+    dt = np.asarray(dt, np.float64).reshape(-1, 4)
+    gt = np.asarray(gt, np.float64).reshape(-1, 4)
+    out = np.zeros((len(dt), len(gt)))
+    for i, d in enumerate(dt):
+        da = max(d[2] - d[0], 0) * max(d[3] - d[1], 0)
+        for j, g in enumerate(gt):
+            ga = max(g[2] - g[0], 0) * max(g[3] - g[1], 0)
+            iw = min(d[2], g[2]) - max(d[0], g[0])
+            ih = min(d[3], g[3]) - max(d[1], g[1])
+            if iw <= 0 or ih <= 0:
+                continue
+            inter = iw * ih
+            denom = da if iscrowd[j] else da + ga - inter
+            out[i, j] = inter / denom if denom > 0 else 0.0
+    return out
+
+
+def mask_iou_crowd(dt_masks, gt_masks, iscrowd):
+    out = np.zeros((len(dt_masks), len(gt_masks)))
+    for i, d in enumerate(dt_masks):
+        d = np.asarray(d, bool)
+        da = d.sum()
+        for j, g in enumerate(gt_masks):
+            g = np.asarray(g, bool)
+            inter = (d & g).sum()
+            denom = da if iscrowd[j] else da + g.sum() - inter
+            out[i, j] = inter / denom if denom > 0 else 0.0
+    return out
+
+
+def evaluate_img(dt, gt, iou_mat, iou_thrs, area_rng, max_det):
+    """pycocotools ``evaluateImg`` for one (image, category, area range)."""
+    # dt: dict(scores, areas) already score-sorted and capped; gt: dict(areas, iscrowd)
+    n_dt, n_gt = len(dt["scores"]), len(gt["areas"])
+    gt_ig = np.array(
+        [bool(c) or a < area_rng[0] or a > area_rng[1] for c, a in zip(gt["iscrowd"], gt["areas"])],
+        dtype=bool,
+    )
+    gtind = np.argsort(gt_ig, kind="mergesort")  # non-ignored first, stable
+    T = len(iou_thrs)
+    dtm = -np.ones((T, n_dt), dtype=int)  # matched gt index (into gtind order), -1 none
+    gtm = -np.ones((T, n_gt), dtype=int)
+    dt_ig = np.zeros((T, n_dt), dtype=bool)
+    for tind, t in enumerate(iou_thrs):
+        for dind in range(min(n_dt, max_det)):
+            iou = min(t, 1 - 1e-10)
+            m = -1
+            for gi in gtind:
+                if gtm[tind, gi] >= 0 and not gt["iscrowd"][gi]:
+                    continue
+                if m > -1 and not gt_ig[m] and gt_ig[gi]:
+                    break
+                if iou_mat[dind, gi] < iou:
+                    continue
+                iou = iou_mat[dind, gi]
+                m = gi
+            if m == -1:
+                continue
+            dt_ig[tind, dind] = gt_ig[m]
+            dtm[tind, dind] = m
+            gtm[tind, m] = dind
+    a_out = np.array([a < area_rng[0] or a > area_rng[1] for a in dt["areas"]], dtype=bool)
+    dt_ig = dt_ig | ((dtm == -1) & a_out[None, :])
+    return dtm, dt_ig, gt_ig
+
+
+def coco_eval_oracle(preds, targets, iou_thrs, rec_thrs, max_dets, class_ids, masks=False):
+    """Full evaluate+accumulate. preds/targets: per-image dicts of numpy arrays.
+
+    preds[i]: boxes (N,4) xyxy [or masks (N,H,W)], scores (N,), labels (N,)
+    targets[i]: boxes (M,4) [or masks], labels (M,), iscrowd (M,), area (M,) optional
+    Returns precision (T,R,C,A,M), recall (T,C,A,M).
+    """
+    n_img = len(preds)
+    T, R, C, A, M = len(iou_thrs), len(rec_thrs), len(class_ids), len(AREA_RANGES), len(max_dets)
+    max_det_last = max_dets[-1]
+
+    # per (img, cat): sorted/capped dets, gts, iou matrix, per-area matches
+    evals = {}
+    for i in range(n_img):
+        p, t = preds[i], targets[i]
+        for ci, c in enumerate(class_ids):
+            dsel = np.where(np.asarray(p["labels"]) == c)[0]
+            gsel = np.where(np.asarray(t["labels"]) == c)[0]
+            order = np.argsort(-np.asarray(p["scores"])[dsel], kind="mergesort")
+            dsel = dsel[order][:max_det_last]
+            if masks:
+                d_geo = [np.asarray(p["masks"])[k] for k in dsel]
+                g_geo = [np.asarray(t["masks"])[k] for k in gsel]
+                d_areas = [g.sum() for g in d_geo]
+                g_def_areas = [g.sum() for g in g_geo]
+            else:
+                d_geo = np.asarray(p["boxes"], np.float64).reshape(-1, 4)[dsel]
+                g_geo = np.asarray(t["boxes"], np.float64).reshape(-1, 4)[gsel]
+                d_areas = [(b[2] - b[0]) * (b[3] - b[1]) for b in d_geo]
+                g_def_areas = [(b[2] - b[0]) * (b[3] - b[1]) for b in g_geo]
+            iscrowd = np.asarray(t.get("iscrowd", np.zeros(len(t["labels"]))), bool)[gsel]
+            if "area" in t:
+                prov = np.asarray(t["area"], np.float64)[gsel]
+                g_areas = [pa if pa > 0 else da for pa, da in zip(prov, g_def_areas)]
+            else:
+                g_areas = g_def_areas
+            iou_mat = (
+                mask_iou_crowd(d_geo, g_geo, iscrowd) if masks else box_iou_crowd(d_geo, g_geo, iscrowd)
+            )
+            dt = {"scores": np.asarray(p["scores"])[dsel], "areas": d_areas}
+            gt = {"areas": g_areas, "iscrowd": iscrowd}
+            per_area = []
+            for rng in AREA_RANGES:
+                per_area.append(evaluate_img(dt, gt, iou_mat, iou_thrs, rng, max_det_last))
+            evals[(i, ci)] = (dt, gt, per_area)
+
+    precision = -np.ones((T, R, C, A, M))
+    recall = -np.ones((T, C, A, M))
+    for ci in range(C):
+        for ai in range(A):
+            npig = 0
+            for i in range(n_img):
+                _, gt, per_area = evals[(i, ci)]
+                npig += int((~per_area[ai][2]).sum())
+            if npig == 0:
+                continue
+            for mi, md in enumerate(max_dets):
+                scores, dtms, dtigs = [], [], []
+                for i in range(n_img):
+                    dt, _, per_area = evals[(i, ci)]
+                    dtm, dt_ig, _ = per_area[ai]
+                    scores.append(dt["scores"][:md])
+                    dtms.append(dtm[:, :md])
+                    dtigs.append(dt_ig[:, :md])
+                scores = np.concatenate(scores)
+                inds = np.argsort(-scores, kind="mergesort")
+                dtm = np.concatenate(dtms, axis=1)[:, inds]
+                dt_ig = np.concatenate(dtigs, axis=1)[:, inds]
+                tps = (dtm >= 0) & ~dt_ig
+                fps = (dtm == -1) & ~dt_ig
+                tp_sum = np.cumsum(tps, axis=1).astype(float)
+                fp_sum = np.cumsum(fps, axis=1).astype(float)
+                for tind in range(T):
+                    tp, fp = tp_sum[tind], fp_sum[tind]
+                    nd = len(tp)
+                    rc = tp / npig
+                    pr = tp / (fp + tp + np.spacing(1))
+                    recall[tind, ci, ai, mi] = rc[-1] if nd else 0
+                    pr = pr.tolist()
+                    q = np.zeros(R)
+                    for k in range(nd - 1, 0, -1):
+                        if pr[k] > pr[k - 1]:
+                            pr[k - 1] = pr[k]
+                    inds_r = np.searchsorted(rc, rec_thrs, side="left")
+                    for ri, pi in enumerate(inds_r):
+                        if pi < nd:
+                            q[ri] = pr[pi]
+                    precision[tind, :, ci, ai, mi] = q
+    return precision, recall

@@ -1,0 +1,155 @@
+"""IoU / GIoU / DIoU / CIoU kernel and class tests.
+
+Oracle values: torchvision.ops doctest outputs recorded in the reference
+(``functional/detection/{iou,giou,diou,ciou}.py`` docstrings) plus a plain
+numpy reimplementation for random boxes.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection import (
+    CompleteIntersectionOverUnion,
+    DistanceIntersectionOverUnion,
+    GeneralizedIntersectionOverUnion,
+    IntersectionOverUnion,
+)
+from torchmetrics_tpu.functional.detection import (
+    complete_intersection_over_union,
+    distance_intersection_over_union,
+    generalized_intersection_over_union,
+    intersection_over_union,
+)
+from torchmetrics_tpu.functional.detection._pairwise import box_convert, pairwise_iou
+
+PREDS = jnp.array(
+    [[296.55, 93.96, 314.97, 152.79], [328.94, 97.05, 342.49, 122.98], [356.62, 95.47, 372.33, 147.55]]
+)
+TARGET = jnp.array(
+    [[300.00, 100.00, 315.00, 150.00], [330.00, 100.00, 350.00, 125.00], [350.00, 100.00, 375.00, 150.00]]
+)
+
+
+def np_iou(a, b):
+    a, b = np.asarray(a, np.float64), np.asarray(b, np.float64)
+    out = np.zeros((len(a), len(b)))
+    for i, d in enumerate(a):
+        for j, g in enumerate(b):
+            iw = min(d[2], g[2]) - max(d[0], g[0])
+            ih = min(d[3], g[3]) - max(d[1], g[1])
+            inter = max(iw, 0) * max(ih, 0)
+            union = (d[2] - d[0]) * (d[3] - d[1]) + (g[2] - g[0]) * (g[3] - g[1]) - inter
+            out[i, j] = inter / union if union > 0 else 0
+    return out
+
+
+def test_iou_reference_values():
+    assert np.isclose(float(intersection_over_union(PREDS, TARGET)), 0.5879, atol=1e-4)
+    mat = intersection_over_union(PREDS, TARGET, aggregate=False)
+    assert np.allclose(np.diag(np.asarray(mat)), [0.6898, 0.5086, 0.5654], atol=1e-4)
+
+
+def test_giou_diou_ciou_reference_values():
+    assert np.isclose(float(complete_intersection_over_union(PREDS, TARGET)), 0.5790, atol=1e-4)
+    cmat = complete_intersection_over_union(PREDS, TARGET, aggregate=False)
+    assert np.allclose(
+        np.asarray(cmat),
+        [[0.6883, -0.2072, -0.3352], [-0.2217, 0.4881, -0.1913], [-0.3971, -0.1543, 0.5606]],
+        atol=1e-4,
+    )
+    # GIoU <= IoU always; DIoU <= IoU always
+    g = np.asarray(generalized_intersection_over_union(PREDS, TARGET, aggregate=False))
+    d = np.asarray(distance_intersection_over_union(PREDS, TARGET, aggregate=False))
+    i = np.asarray(intersection_over_union(PREDS, TARGET, aggregate=False))
+    assert (g <= i + 1e-6).all() and (d <= i + 1e-6).all()
+
+
+def test_pairwise_iou_random_vs_numpy():
+    rng = np.random.default_rng(3)
+    a = np.sort(rng.random((17, 2, 2)) * 100, axis=1).reshape(17, 4)[:, [0, 2, 1, 3]]
+    b = np.sort(rng.random((11, 2, 2)) * 100, axis=1).reshape(11, 4)[:, [0, 2, 1, 3]]
+    got = np.asarray(pairwise_iou(jnp.asarray(a, jnp.float32), jnp.asarray(b, jnp.float32)))
+    assert np.allclose(got, np_iou(a, b), atol=1e-5)
+
+
+def test_iou_threshold_replacement():
+    mat = np.asarray(intersection_over_union(PREDS, TARGET, iou_threshold=0.6, replacement_val=-1, aggregate=False))
+    ref = np_iou(PREDS, TARGET)
+    assert np.allclose(mat, np.where(ref < 0.6, -1.0, ref), atol=1e-5)
+
+
+def test_box_convert_roundtrip():
+    rng = np.random.default_rng(0)
+    xyxy = np.sort(rng.random((9, 2, 2)) * 50, axis=1).reshape(9, 4)[:, [0, 2, 1, 3]]
+    for fmt in ("xywh", "cxcywh"):
+        alt = box_convert(jnp.asarray(xyxy, jnp.float32), "xyxy", fmt)
+        back = box_convert(alt, fmt, "xyxy")
+        assert np.allclose(np.asarray(back), xyxy, atol=1e-4)
+
+
+def test_iou_class_reference_example():
+    preds = [
+        {
+            "boxes": jnp.array([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+            "labels": jnp.array([4, 5]),
+        }
+    ]
+    target = [{"boxes": jnp.array([[300.00, 100.00, 315.00, 150.00]]), "labels": jnp.array([5])}]
+    metric = IntersectionOverUnion()
+    res = metric(preds, target)
+    assert np.isclose(float(res["iou"]), 0.8614, atol=1e-4)
+
+
+def test_iou_class_class_metrics():
+    preds = [
+        {
+            "boxes": jnp.array([[296.55, 93.96, 314.97, 152.79], [298.55, 98.96, 314.97, 151.79]]),
+            "labels": jnp.array([4, 5]),
+        }
+    ]
+    target = [
+        {
+            "boxes": jnp.array([[300.00, 100.00, 315.00, 150.00], [300.00, 100.00, 315.00, 150.00]]),
+            "labels": jnp.array([4, 5]),
+        }
+    ]
+    metric = IntersectionOverUnion(class_metrics=True)
+    res = metric(preds, target)
+    assert np.isclose(float(res["iou"]), 0.7756, atol=1e-4)
+    assert np.isclose(float(res["iou/cl_4"]), 0.6898, atol=1e-4)
+    assert np.isclose(float(res["iou/cl_5"]), 0.8614, atol=1e-4)
+
+
+@pytest.mark.parametrize(
+    "cls,key", [(GeneralizedIntersectionOverUnion, "giou"), (DistanceIntersectionOverUnion, "diou"),
+                (CompleteIntersectionOverUnion, "ciou")]
+)
+def test_variant_classes_run(cls, key):
+    preds = [{"boxes": PREDS, "labels": jnp.array([0, 1, 2]), "scores": jnp.array([0.9, 0.8, 0.7])}]
+    target = [{"boxes": TARGET, "labels": jnp.array([0, 1, 2])}]
+    metric = cls()
+    res = metric(preds, target)
+    assert key in res and np.isfinite(float(res[key]))
+
+
+def test_iou_class_streaming_matches_single_shot():
+    rng = np.random.default_rng(5)
+
+    def boxes(n):
+        xy = rng.random((n, 2)) * 100
+        wh = rng.random((n, 2)) * 30 + 1
+        return np.concatenate([xy, xy + wh], 1).astype(np.float32)
+
+    imgs = [
+        ({"boxes": jnp.asarray(boxes(4)), "labels": jnp.asarray(rng.integers(0, 3, 4))},
+         {"boxes": jnp.asarray(boxes(3)), "labels": jnp.asarray(rng.integers(0, 3, 3))})
+        for _ in range(6)
+    ]
+    m1 = IntersectionOverUnion(respect_labels=False)
+    for p, t in imgs:
+        m1.update([p], [t])
+    m2 = IntersectionOverUnion(respect_labels=False)
+    m2.update([p for p, _ in imgs], [t for _, t in imgs])
+    assert np.isclose(float(m1.compute()["iou"]), float(m2.compute()["iou"]), atol=1e-6)

@@ -1,0 +1,222 @@
+"""Differential tests: pure-XLA MeanAveragePrecision vs the numpy COCO oracle.
+
+The oracle (``coco_oracle.py``) is a loop-based reimplementation of
+pycocotools' evaluate/accumulate, written independently of the engine.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.unittests.detection.coco_oracle import coco_eval_oracle
+from torchmetrics_tpu.detection import MeanAveragePrecision
+from torchmetrics_tpu.functional.detection._map_eval import summarize
+
+IOU_THRS = np.linspace(0.5, 0.95, 10).round(2).tolist()
+REC_THRS = np.linspace(0.0, 1.0, 101).round(2).tolist()
+MAX_DETS = [1, 10, 100]
+
+
+def _random_dataset(seed, n_img=6, n_cls=4, crowd_p=0.25, with_area=False, jitter=True):
+    rng = np.random.default_rng(seed)
+
+    def boxes(n):
+        xy = rng.random((n, 2)) * 300
+        wh = np.exp(rng.random((n, 2)) * 5.0) + 1
+        return np.concatenate([xy, xy + wh], 1)
+
+    preds, targets = [], []
+    for _ in range(n_img):
+        nd, ng = int(rng.integers(0, 15)), int(rng.integers(0, 10))
+        gtb, dtb = boxes(ng), boxes(nd)
+        if jitter:
+            for k in range(nd):
+                if ng and rng.random() < 0.6:
+                    dtb[k] = gtb[rng.integers(0, ng)] + rng.normal(0, 5, 4)
+        t = dict(
+            boxes=gtb,
+            labels=rng.integers(0, n_cls, ng),
+            iscrowd=(rng.random(ng) < crowd_p).astype(int),
+        )
+        if with_area:
+            t["area"] = np.where(rng.random(ng) < 0.5, rng.random(ng) * 9000, 0.0)
+        preds.append(dict(boxes=dtb, scores=np.round(rng.random(nd), 2), labels=rng.integers(0, n_cls, nd)))
+        targets.append(t)
+    return preds, targets
+
+
+def _to_jnp(dicts, keys):
+    out = []
+    for d in dicts:
+        out.append({k: jnp.asarray(v) for k, v in d.items() if k in keys})
+    return out
+
+
+@pytest.mark.parametrize("seed", range(4))
+@pytest.mark.parametrize("with_area", [False, True])
+def test_map_matches_coco_oracle(seed, with_area):
+    preds, targets = _random_dataset(seed, with_area=with_area)
+    classes = sorted(
+        set(np.concatenate([p["labels"] for p in preds]).tolist())
+        | set(np.concatenate([t["labels"] for t in targets]).tolist())
+    )
+    p_ref, r_ref = coco_eval_oracle(preds, targets, IOU_THRS, REC_THRS, MAX_DETS, classes)
+    ref = summarize(p_ref, r_ref, IOU_THRS, MAX_DETS)
+
+    metric = MeanAveragePrecision()
+    metric.update(
+        _to_jnp(preds, {"boxes", "scores", "labels"}),
+        _to_jnp(targets, {"boxes", "labels", "iscrowd", "area"}),
+    )
+    got = metric.compute()
+    for k, v in ref.items():
+        assert np.isclose(float(jnp.asarray(got[k]).reshape(-1)[0]), v, atol=1e-5), (k, float(got[k]), v)
+
+
+def test_map_reference_doctest_case():
+    preds = [dict(boxes=jnp.array([[258.0, 41.0, 606.0, 285.0]]), scores=jnp.array([0.536]), labels=jnp.array([0]))]
+    target = [dict(boxes=jnp.array([[214.0, 41.0, 562.0, 285.0]]), labels=jnp.array([0]))]
+    m = MeanAveragePrecision(iou_type="bbox")
+    m.update(preds, target)
+    out = m.compute()
+    expect = {
+        "map": 0.6, "map_50": 1.0, "map_75": 1.0, "map_large": 0.6, "map_medium": -1.0, "map_small": -1.0,
+        "mar_1": 0.6, "mar_10": 0.6, "mar_100": 0.6, "mar_large": 0.6, "mar_medium": -1.0, "mar_small": -1.0,
+    }
+    for k, v in expect.items():
+        assert np.isclose(float(jnp.asarray(out[k]).reshape(-1)[0]), v, atol=1e-4), k
+    assert np.asarray(out["classes"]).reshape(-1).tolist() == [0]
+
+
+def test_map_class_metrics_and_custom_thresholds():
+    preds, targets = _random_dataset(11)
+    classes = sorted(
+        set(np.concatenate([p["labels"] for p in preds]).tolist())
+        | set(np.concatenate([t["labels"] for t in targets]).tolist())
+    )
+    iou_thrs = [0.4, 0.6]
+    p_ref, r_ref = coco_eval_oracle(preds, targets, iou_thrs, REC_THRS, MAX_DETS, classes)
+
+    m = MeanAveragePrecision(iou_thresholds=iou_thrs, class_metrics=True)
+    m.update(_to_jnp(preds, {"boxes", "scores", "labels"}), _to_jnp(targets, {"boxes", "labels", "iscrowd"}))
+    out = m.compute()
+    # map_50/map_75 are -1 sentinels with custom thresholds
+    assert float(out["map_50"]) == -1.0 and float(out["map_75"]) == -1.0
+    # per-class values match oracle slices
+    map_pc = np.asarray(out["map_per_class"]).reshape(-1)
+    for ci in range(len(classes)):
+        s = p_ref[:, :, ci, 0, -1]
+        s = s[s > -1]
+        ref_v = s.mean() if s.size else -1.0
+        assert np.isclose(map_pc[ci], ref_v, atol=1e-5)
+
+
+def test_map_micro_average_runs():
+    preds, targets = _random_dataset(13)
+    m = MeanAveragePrecision(average="micro")
+    m.update(_to_jnp(preds, {"boxes", "scores", "labels"}), _to_jnp(targets, {"boxes", "labels", "iscrowd"}))
+    out = m.compute()
+    # micro == macro with all labels collapsed to one class
+    for p in preds:
+        p["labels"] = np.zeros_like(p["labels"])
+    for t in targets:
+        t["labels"] = np.zeros_like(t["labels"])
+    p_ref, r_ref = coco_eval_oracle(preds, targets, IOU_THRS, REC_THRS, MAX_DETS, [0])
+    ref = summarize(p_ref, r_ref, IOU_THRS, MAX_DETS)
+    assert np.isclose(float(out["map"]), ref["map"], atol=1e-5)
+
+
+def test_map_segm_vs_oracle():
+    rng = np.random.default_rng(7)
+    H = W = 32
+    preds, targets = [], []
+    for _ in range(4):
+        nd, ng = int(rng.integers(1, 6)), int(rng.integers(1, 5))
+
+        def masks(n):
+            out = np.zeros((n, H, W), bool)
+            for k in range(n):
+                x, y = rng.integers(0, W - 8, 2)
+                w, h = rng.integers(3, 12, 2)
+                out[k, y : y + h, x : x + w] = True
+            return out
+
+        preds.append(dict(masks=masks(nd), scores=np.round(rng.random(nd), 2), labels=rng.integers(0, 2, nd)))
+        targets.append(dict(masks=masks(ng), labels=rng.integers(0, 2, ng), iscrowd=np.zeros(ng, int)))
+    classes = [0, 1]
+    p_ref, r_ref = coco_eval_oracle(preds, targets, IOU_THRS, REC_THRS, MAX_DETS, classes, masks=True)
+    ref = summarize(p_ref, r_ref, IOU_THRS, MAX_DETS)
+    m = MeanAveragePrecision(iou_type="segm")
+    m.update(_to_jnp(preds, {"masks", "scores", "labels"}), _to_jnp(targets, {"masks", "labels", "iscrowd"}))
+    out = m.compute()
+    # f32 mask IoU can differ from the float64 oracle by 1 ulp exactly at
+    # threshold ties; random rectangle masks avoid that by construction here
+    assert np.isclose(float(out["map"]), ref["map"], atol=1e-4)
+    assert np.isclose(float(out["mar_100"]), ref["mar_100"], atol=1e-4)
+
+
+def test_map_empty_and_merge():
+    # no updates at all -> all -1 / empty classes
+    m = MeanAveragePrecision()
+    m.update([], [])
+    out = m.compute()
+    assert np.asarray(out["classes"]).size == 0
+
+    # streaming across updates == one update
+    preds, targets = _random_dataset(21)
+    m1 = MeanAveragePrecision()
+    for p, t in zip(preds, targets):
+        m1.update(_to_jnp([p], {"boxes", "scores", "labels"}), _to_jnp([t], {"boxes", "labels", "iscrowd"}))
+    m2 = MeanAveragePrecision()
+    m2.update(_to_jnp(preds, {"boxes", "scores", "labels"}), _to_jnp(targets, {"boxes", "labels", "iscrowd"}))
+    assert np.isclose(float(m1.compute()["map"]), float(m2.compute()["map"]), atol=1e-6)
+
+
+def test_map_extended_summary_shapes():
+    preds, targets = _random_dataset(31, n_img=3)
+    m = MeanAveragePrecision(extended_summary=True)
+    m.update(_to_jnp(preds, {"boxes", "scores", "labels"}), _to_jnp(targets, {"boxes", "labels", "iscrowd"}))
+    out = m.compute()
+    T, R, A, M = 10, 101, 4, 3
+    C = np.asarray(out["classes"]).size
+    # padded class axis is a power-of-two bucket >= C
+    assert out["precision"].shape[0] == T and out["precision"].shape[1] == R
+    assert out["precision"].shape[3] == A and out["precision"].shape[4] == M
+    assert out["precision"].shape[2] >= C
+    assert out["recall"].shape[0] == T
+
+
+def test_map_mixed_iou_types_use_matching_areas():
+    # regression: with iou_type=("bbox","segm") the segm pass must use mask
+    # pixel areas, not box areas, for the small/medium/large splits
+    H = W = 64
+    mask_p = np.zeros((1, H, W), bool)
+    mask_p[0, :20, :20] = True  # 400 px -> "small"
+    mask_t = np.zeros((1, H, W), bool)
+    mask_t[0, :20, :18] = True
+    big_box = np.array([[0.0, 0.0, 60.0, 60.0]])  # 3600 px -> "medium" as a box
+    preds = [dict(boxes=jnp.asarray(big_box), masks=jnp.asarray(mask_p),
+                  scores=jnp.array([0.9]), labels=jnp.array([0]))]
+    target = [dict(boxes=jnp.asarray(big_box), masks=jnp.asarray(mask_t), labels=jnp.array([0]))]
+    m = MeanAveragePrecision(iou_type=("bbox", "segm"))
+    m.update(preds, target)
+    out = m.compute()
+    # segm: the 400-px mask is "small", so segm_map_small is defined (> -1)
+    assert float(out["segm_map_small"]) > -1.0
+    assert float(out["segm_map_medium"]) == -1.0
+    # bbox: the 3600-px box is "medium"
+    assert float(out["bbox_map_medium"]) > -1.0
+    assert float(out["bbox_map_small"]) == -1.0
+
+
+def test_map_sparse_large_label_ids():
+    # regression: raw label ids must not size internal one-hot tensors
+    preds = [dict(boxes=jnp.array([[10.0, 10.0, 50.0, 50.0]]), scores=jnp.array([0.8]),
+                  labels=jnp.array([10**6]))]
+    target = [dict(boxes=jnp.array([[12.0, 12.0, 52.0, 52.0]]), labels=jnp.array([10**6]))]
+    m = MeanAveragePrecision()
+    m.update(preds, target)
+    out = m.compute()
+    assert float(out["map_50"]) == 1.0
+    assert np.asarray(out["classes"]).reshape(-1).tolist() == [10**6]

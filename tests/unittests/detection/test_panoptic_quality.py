@@ -1,0 +1,164 @@
+"""Panoptic Quality tests — oracle values from the reference doctests plus a
+loop-based python PQ reimplementation for random inputs."""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection import ModifiedPanopticQuality, PanopticQuality
+from torchmetrics_tpu.functional.detection import modified_panoptic_quality, panoptic_quality
+
+PREDS = jnp.array(
+    [[[[6, 0], [0, 0], [6, 0], [6, 0]],
+      [[0, 0], [0, 0], [6, 0], [0, 1]],
+      [[0, 0], [0, 0], [6, 0], [0, 1]],
+      [[0, 0], [7, 0], [6, 0], [1, 0]],
+      [[0, 0], [7, 0], [7, 0], [7, 0]]]]
+)
+TARGET = jnp.array(
+    [[[[6, 0], [0, 1], [6, 0], [0, 1]],
+      [[0, 1], [0, 1], [6, 0], [0, 1]],
+      [[0, 1], [0, 1], [6, 0], [1, 0]],
+      [[0, 1], [7, 0], [1, 0], [1, 0]],
+      [[0, 1], [7, 0], [7, 0], [7, 0]]]]
+)
+
+
+def pq_oracle(preds, target, things, stuffs, modified=False):
+    """Plain-python PQ over one batch (colors as tuples, dict counting)."""
+    void = (1 + max([0, *things, *stuffs]), 0)
+    cats = sorted(things) and list(things) or []
+    cont = {c: i for i, c in enumerate(things)}
+    cont.update({c: i + len(things) for i, c in enumerate(stuffs)})
+    n_cat = len(cont)
+    iou_sum = np.zeros(n_cat)
+    tp = np.zeros(n_cat, int)
+    fp = np.zeros(n_cat, int)
+    fn = np.zeros(n_cat, int)
+    preds = np.asarray(preds).reshape(np.asarray(preds).shape[0], -1, 2)
+    target = np.asarray(target).reshape(np.asarray(target).shape[0], -1, 2)
+    for b in range(preds.shape[0]):
+        def canon(arr):
+            out = []
+            for c, i in arr:
+                if c in stuffs:
+                    out.append((c, 0))
+                elif c in things:
+                    out.append((c, i))
+                else:
+                    out.append(void)
+            return out
+
+        p = canon(preds[b])
+        t = canon(target[b])
+        p_areas, t_areas, inter = {}, {}, {}
+        for pc, tc in zip(p, t):
+            p_areas[pc] = p_areas.get(pc, 0) + 1
+            t_areas[tc] = t_areas.get(tc, 0) + 1
+            inter[(pc, tc)] = inter.get((pc, tc), 0) + 1
+        pm, tm = set(), set()
+        for (pc, tc), ia in inter.items():
+            if tc == void or pc == void or pc[0] != tc[0]:
+                continue
+            union = (
+                p_areas[pc] - inter.get((pc, void), 0) + t_areas[tc] - inter.get((void, tc), 0) - ia
+            )
+            iou = ia / union
+            ci = cont[tc[0]]
+            if modified and tc[0] in stuffs:
+                if iou > 0:
+                    iou_sum[ci] += iou
+            elif iou > 0.5:
+                pm.add(pc)
+                tm.add(tc)
+                iou_sum[ci] += iou
+                tp[ci] += 1
+        for tc, a in t_areas.items():
+            if tc == void or tc in tm or (modified and tc[0] in stuffs):
+                continue
+            if inter.get((void, tc), 0) / a <= 0.5:
+                fn[cont[tc[0]]] += 1
+        for pc, a in p_areas.items():
+            if pc == void or pc in pm or (modified and pc[0] in stuffs):
+                continue
+            if inter.get((pc, void), 0) / a <= 0.5:
+                fp[cont[pc[0]]] += 1
+        if modified:
+            for tc in t_areas:
+                if tc != void and tc[0] in stuffs:
+                    tp[cont[tc[0]]] += 1
+    denom = tp + 0.5 * fp + 0.5 * fn
+    pq = np.where(denom > 0, iou_sum / np.maximum(denom, 1e-12), 0)
+    return pq[denom > 0].mean() if (denom > 0).any() else 0.0
+
+
+def test_pq_reference_doctest():
+    assert np.isclose(float(panoptic_quality(PREDS, TARGET, things={0, 1}, stuffs={6, 7})), 0.5463, atol=1e-4)
+
+
+def test_modified_pq_reference_doctest():
+    p = jnp.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+    t = jnp.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+    assert np.isclose(float(modified_panoptic_quality(p, t, things={0, 1}, stuffs={6, 7})), 0.7667, atol=1e-4)
+
+
+def test_pq_random_vs_oracle():
+    rng = np.random.default_rng(2)
+    for seed in range(4):
+        rng = np.random.default_rng(seed)
+        cats = rng.integers(0, 5, (2, 64))
+        insts = rng.integers(0, 3, (2, 64))
+        p = np.stack([cats, insts], -1)
+        cats2 = rng.integers(0, 5, (2, 64))
+        insts2 = rng.integers(0, 3, (2, 64))
+        t = np.stack([cats2, insts2], -1)
+        things, stuffs = {0, 1, 2}, {3, 4}
+        got = float(panoptic_quality(jnp.asarray(p), jnp.asarray(t), things=things, stuffs=stuffs,
+                                     allow_unknown_preds_category=True))
+        ref = pq_oracle(p, t, things, stuffs)
+        assert np.isclose(got, ref, atol=1e-5), (seed, got, ref)
+        got_m = float(modified_panoptic_quality(jnp.asarray(p), jnp.asarray(t), things=things, stuffs=stuffs,
+                                                allow_unknown_preds_category=True))
+        ref_m = pq_oracle(p, t, things, stuffs, modified=True)
+        assert np.isclose(got_m, ref_m, atol=1e-5), (seed, got_m, ref_m)
+
+
+def test_pq_class_streaming():
+    m = PanopticQuality(things={0, 1}, stuffs={6, 7})
+    m.update(PREDS, TARGET)
+    m.update(PREDS, TARGET)
+    # same data twice: identical PQ
+    assert np.isclose(float(m.compute()), 0.5463, atol=1e-4)
+
+    m2 = ModifiedPanopticQuality(things={0, 1}, stuffs={6, 7})
+    p = jnp.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+    t = jnp.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+    m2.update(p, t)
+    assert np.isclose(float(m2.compute()), 0.7667, atol=1e-4)
+
+
+def test_pq_validation_errors():
+    import pytest
+
+    with pytest.raises(ValueError):
+        PanopticQuality(things={0}, stuffs={0})
+    with pytest.raises(ValueError):
+        PanopticQuality(things=set(), stuffs=set())
+    with pytest.raises(TypeError):
+        PanopticQuality(things={"a"}, stuffs={1})
+    m = PanopticQuality(things={0}, stuffs={1})
+    with pytest.raises(ValueError):
+        m.update(jnp.zeros((1, 4, 2), jnp.int32), jnp.zeros((1, 5, 2), jnp.int32))
+
+
+def test_pq_large_instance_ids_no_collision():
+    # regression: packed color codes used to collide for inst >= 2**15
+    p = np.stack([np.full((1, 16), 1), np.full((1, 16), 32768)], -1)
+    t = np.stack([np.full((1, 16), 2), np.zeros((1, 16), int)], -1)
+    got = float(panoptic_quality(jnp.asarray(p), jnp.asarray(t), things={1}, stuffs={2}))
+    assert got == 0.0  # disjoint categories: no match at all
+    # and huge category ids must not allocate huge tables
+    p2 = np.stack([np.full((1, 8), 10**6), np.zeros((1, 8), int)], -1)
+    t2 = np.stack([np.full((1, 8), 10**6), np.zeros((1, 8), int)], -1)
+    got2 = float(panoptic_quality(jnp.asarray(p2), jnp.asarray(t2), things={10**6}, stuffs=set()))
+    assert np.isclose(got2, 1.0)

@@ -18,6 +18,7 @@ from torchmetrics_tpu import (  # noqa: E402
     aggregation,
     classification,
     clustering,
+    detection,
     functional,
     nominal,
     regression,
@@ -25,6 +26,8 @@ from torchmetrics_tpu import (  # noqa: E402
     utilities,
     wrappers,
 )
+from torchmetrics_tpu.detection import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.detection import __all__ as _detection_all  # noqa: E402
 from torchmetrics_tpu.clustering import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.clustering import __all__ as _clustering_all  # noqa: E402
 from torchmetrics_tpu.nominal import *  # noqa: F401,F403,E402
@@ -48,6 +51,7 @@ __all__ = [
     "aggregation",
     "classification",
     "clustering",
+    "detection",
     "functional",
     "nominal",
     "regression",
@@ -58,6 +62,7 @@ __all__ = [
     *_aggregation_all,
     *_classification_all,
     *_clustering_all,
+    *_detection_all,
     *_nominal_all,
     *_regression_all,
     *_retrieval_all,

@@ -1,0 +1,18 @@
+"""Detection metrics (reference ``detection/__init__.py``)."""
+
+from torchmetrics_tpu.detection.ciou import CompleteIntersectionOverUnion
+from torchmetrics_tpu.detection.diou import DistanceIntersectionOverUnion
+from torchmetrics_tpu.detection.giou import GeneralizedIntersectionOverUnion
+from torchmetrics_tpu.detection.iou import IntersectionOverUnion
+from torchmetrics_tpu.detection.mean_ap import MeanAveragePrecision
+from torchmetrics_tpu.detection.panoptic_qualities import ModifiedPanopticQuality, PanopticQuality
+
+__all__ = [
+    "CompleteIntersectionOverUnion",
+    "DistanceIntersectionOverUnion",
+    "GeneralizedIntersectionOverUnion",
+    "IntersectionOverUnion",
+    "MeanAveragePrecision",
+    "ModifiedPanopticQuality",
+    "PanopticQuality",
+]

@@ -1,0 +1,41 @@
+"""Modular Distance IoU metric (reference ``detection/diou.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from torchmetrics_tpu.detection.iou import IntersectionOverUnion
+from torchmetrics_tpu.functional.detection.diou import _diou_compute, _diou_update
+
+Array = jax.Array
+
+
+class DistanceIntersectionOverUnion(IntersectionOverUnion):
+    """Computes Distance Intersection Over Union (DIoU)."""
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+
+    _iou_type: str = "diou"
+    _invalid_val: float = -1.0
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(box_format, iou_threshold, class_metrics, respect_labels, **kwargs)
+
+    @staticmethod
+    def _iou_update_fn(*args: Any, **kwargs: Any) -> Array:
+        return _diou_update(*args, **kwargs)
+
+    @staticmethod
+    def _iou_compute_fn(*args: Any, **kwargs: Any) -> Array:
+        return _diou_compute(*args, **kwargs)

@@ -1,0 +1,118 @@
+"""Modular IoU metric (reference ``detection/iou.py``).
+
+TPU design note: per-image ``(N, M)`` similarity matrices are computed on
+device by the pure-XLA pairwise kernel and appended as masked cat states
+(invalid pairs carry ``_invalid_val``), mirroring the reference's
+list-of-matrices state with ``dist_reduce_fx=None``.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection.helpers import _fix_empty_tensors, _input_validator
+from torchmetrics_tpu.functional.detection._pairwise import box_convert
+from torchmetrics_tpu.functional.detection.iou import _iou_compute, _iou_update
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class IntersectionOverUnion(Metric):
+    """Computes Intersection Over Union (IoU) over per-image box dicts.
+
+    Inputs follow the reference protocol: lists of per-image dicts with
+    ``boxes`` ``(N, 4)`` and ``labels`` ``(N,)`` (plus ``scores`` for preds,
+    unused here). Output is ``{"iou": scalar}`` plus ``iou/cl_{c}`` entries
+    when ``class_metrics=True``.
+    """
+
+    is_differentiable: bool = False
+    higher_is_better: Optional[bool] = True
+    full_state_update: bool = True
+
+    _iou_type: str = "iou"
+    _invalid_val: float = -1.0
+
+    def __init__(
+        self,
+        box_format: str = "xyxy",
+        iou_threshold: Optional[float] = None,
+        class_metrics: bool = False,
+        respect_labels: bool = True,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        allowed_box_formats = ("xyxy", "xywh", "cxcywh")
+        if box_format not in allowed_box_formats:
+            raise ValueError(f"Expected argument `box_format` to be one of {allowed_box_formats} but got {box_format}")
+        self.box_format = box_format
+        self.iou_threshold = iou_threshold
+        if not isinstance(class_metrics, bool):
+            raise ValueError("Expected argument `class_metrics` to be a boolean")
+        self.class_metrics = class_metrics
+        if not isinstance(respect_labels, bool):
+            raise ValueError("Expected argument `respect_labels` to be a boolean")
+        self.respect_labels = respect_labels
+
+        self.add_state("groundtruth_labels", default=[], dist_reduce_fx=None)
+        self.add_state("iou_matrix", default=[], dist_reduce_fx=None)
+
+    @staticmethod
+    def _iou_update_fn(*args: Any, **kwargs: Any) -> Array:
+        return _iou_update(*args, **kwargs)
+
+    @staticmethod
+    def _iou_compute_fn(*args: Any, **kwargs: Any) -> Array:
+        return _iou_compute(*args, **kwargs)
+
+    def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
+        """Update state with per-image prediction and target box dicts."""
+        _input_validator(preds, target, ignore_score=True)
+
+        for p, t in zip(preds, target):
+            det_boxes = self._get_safe_item_values(p["boxes"])
+            gt_boxes = self._get_safe_item_values(t["boxes"])
+            self.groundtruth_labels.append(jnp.asarray(t["labels"]))
+
+            iou_matrix = self._iou_update_fn(det_boxes, gt_boxes, self.iou_threshold, self._invalid_val)
+            if self.respect_labels:
+                label_eq = jnp.asarray(p["labels"])[:, None] == jnp.asarray(t["labels"])[None, :]
+                iou_matrix = jnp.where(label_eq, iou_matrix, self._invalid_val)
+            self.iou_matrix.append(iou_matrix)
+
+    def _get_safe_item_values(self, boxes: Array) -> Array:
+        boxes = _fix_empty_tensors(jnp.asarray(boxes, jnp.float32))
+        if boxes.size > 0:
+            boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
+        return boxes
+
+    def _get_gt_classes(self) -> List[int]:
+        """Unique classes present in the ground truth."""
+        if len(self.groundtruth_labels) > 0:
+            import numpy as np
+
+            return sorted(np.unique(np.concatenate([np.asarray(x) for x in self.groundtruth_labels])).tolist())
+        return []
+
+    def compute(self) -> Dict[str, Array]:
+        """IoU over all valid (label-matched, above-threshold) box pairs."""
+        valid = [mat[mat != self._invalid_val] for mat in self.iou_matrix]
+        flat = jnp.concatenate([v.reshape(-1) for v in valid], axis=0) if valid else jnp.zeros((0,))
+        score = flat.mean() if flat.size > 0 else jnp.asarray(0.0)
+        results: Dict[str, Array] = {f"{self._iou_type}": score}
+
+        if self.class_metrics:
+            for cl in self._get_gt_classes():
+                num = jnp.asarray(0.0)
+                cnt = jnp.asarray(0.0)
+                for mat, gt_lab in zip(self.iou_matrix, self.groundtruth_labels):
+                    scores = mat[:, jnp.asarray(gt_lab) == cl]
+                    sel = scores != self._invalid_val
+                    num = num + jnp.where(sel, scores, 0.0).sum()
+                    cnt = cnt + sel.sum()
+                results[f"{self._iou_type}/cl_{cl}"] = num / jnp.maximum(cnt, 1.0)
+        return results

@@ -1,8 +1,10 @@
 """Stateless functional metrics (L2)."""
 
-from torchmetrics_tpu.functional import classification, clustering, nominal, regression, retrieval
+from torchmetrics_tpu.functional import classification, clustering, detection, nominal, regression, retrieval
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
+from torchmetrics_tpu.functional.detection import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.detection import __all__ as _detection_all
 from torchmetrics_tpu.functional.clustering import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.clustering import __all__ as _clustering_all
 from torchmetrics_tpu.functional.nominal import *  # noqa: F401,F403
@@ -15,11 +17,13 @@ from torchmetrics_tpu.functional.retrieval import __all__ as _retrieval_all
 __all__ = [
     "classification",
     "clustering",
+    "detection",
     "nominal",
     "regression",
     "retrieval",
     *_classification_all,
     *_clustering_all,
+    *_detection_all,
     *_nominal_all,
     *_regression_all,
     *_retrieval_all,

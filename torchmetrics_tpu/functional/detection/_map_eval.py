@@ -1,0 +1,395 @@
+"""Pure-XLA COCO-style mAP evaluation engine.
+
+TPU-native replacement for the reference's host-offload pattern
+(``detection/mean_ap.py:513-588`` delegating to pycocotools C code; the
+tensorizable algorithm is the legacy ``detection/_mean_ap.py:522-866``).
+Everything here is fixed-shape and jit-compiled:
+
+- **Greedy matching** is one ``lax.scan`` over score-sorted detection slots,
+  vectorized over (images, IoU thresholds, area ranges). The per-class
+  decomposition of COCO eval is free: a ground-truth box only participates in
+  its own label's matching, so the match state is ``(I, T, A, G)`` with label
+  equality enforced per step — no class axis needed.
+- **Accumulation** (PR curves, 101-point interpolation) is a ``lax.map`` over
+  classes of sort + cumsum + reverse-cummax + searchsorted — all MXU/VPU
+  friendly primitives.
+
+pycocotools semantics replicated exactly (verified by the differential test
+suite in ``tests/unittests/detection/``):
+
+- detections processed in score order, stable within equal scores;
+- a detection prefers its highest-IoU *non-ignored* available ground truth;
+  ties go to the later ground truth (running ``<`` max), it may fall back to
+  an ignored one; crowd ground truths can be matched repeatedly;
+- crowd IoU uses the detection-area denominator;
+- ground truth ignore = crowd or area outside range; unmatched detections
+  with area outside range are ignored;
+- per-(image, class) detections are capped at ``max(max_detection_thresholds)``
+  for matching; smaller thresholds are post-hoc prefix slices;
+- ``npig == 0`` classes carry the ``-1`` sentinel and drop out of means.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+# COCO area ranges: all / small / medium / large
+AREA_RANGES = ((0.0, 1e10), (0.0, 32.0**2), (32.0**2, 96.0**2), (96.0**2, 1e10))
+
+
+class MatchResult(NamedTuple):
+    """Per-detection-slot matching outcome, all ``(I, D, T, A)`` bool."""
+
+    matched: Array
+    ignored: Array
+
+
+def _last_argmax(values: Array, mask: Array) -> Array:
+    """Index of the *last* occurrence of the masked maximum, -1 if mask empty.
+
+    Replicates pycocotools' running ``if iou < best: continue`` loop, where a
+    later equal IoU replaces the current match.
+    """
+    neg = jnp.where(mask, values, -jnp.inf)
+    best = jnp.max(neg, axis=-1, keepdims=True)
+    idx = jnp.arange(values.shape[-1])
+    winner = mask & (neg == best)
+    m = jnp.max(jnp.where(winner, idx, -1), axis=-1)
+    return m
+
+
+def match_detections(
+    iou: Array,  # (I, D, G) with crowd-adjusted values
+    det_labels: Array,  # (I, D) int32, score-sorted per image
+    det_participates: Array,  # (I, D) bool: valid & class-rank < maxDet
+    det_ignore_area: Array,  # (I, D, A) bool: det area outside range
+    gt_labels: Array,  # (I, G) int32
+    gt_valid: Array,  # (I, G) bool
+    gt_crowd: Array,  # (I, G) bool
+    gt_ignore: Array,  # (I, A, G) bool: crowd | area outside range
+    iou_thresholds: Array,  # (T,)
+) -> MatchResult:
+    """Greedy COCO matching for every (image, threshold, area-range) at once."""
+    num_i, num_d, num_g = iou.shape
+    num_t = iou_thresholds.shape[0]
+    num_a = gt_ignore.shape[1]
+
+    thr = jnp.minimum(iou_thresholds, 1 - 1e-10)  # pycocotools min(t, 1-1e-10)
+
+    def step(gt_match, d):
+        # gt_match: (I, T, A, G) bool
+        iou_d = iou[:, d, :]  # (I, G)
+        lbl = det_labels[:, d]  # (I,)
+        part = det_participates[:, d]  # (I,)
+        ign_area = det_ignore_area[:, d, :]  # (I, A)
+
+        label_match = (gt_labels == lbl[:, None]) & gt_valid  # (I, G)
+        # availability: unmatched, or crowd (rematchable)
+        avail = (~gt_match) | gt_crowd[:, None, None, :]  # (I, T, A, G)
+        meets = iou_d[:, None, :] >= thr[None, :, None]  # (I, T, G)
+        cand = label_match[:, None, None, :] & avail & meets[:, :, None, :]  # (I,T,A,G)
+
+        ig = gt_ignore[:, None, :, :]  # (I, 1, A, G)
+        cand1 = cand & ~ig  # non-ignored candidates
+        cand2 = cand & ig  # ignored fallback
+
+        vals = jnp.broadcast_to(iou_d[:, None, None, :], cand.shape)
+        m1 = _last_argmax(vals, cand1)  # (I, T, A)
+        m2 = _last_argmax(vals, cand2)
+        any1 = jnp.any(cand1, axis=-1)
+        any2 = jnp.any(cand2, axis=-1)
+        m = jnp.where(any1, m1, jnp.where(any2, m2, -1))  # (I, T, A)
+        matched = (m >= 0) & part[:, None, None]
+
+        # matched-to-ignored gt, else unmatched det outside area range
+        m_safe = jnp.maximum(m, 0)
+        gt_ig_at_m = jnp.take_along_axis(
+            jnp.broadcast_to(gt_ignore[:, None, :, :], (num_i, num_t, num_a, num_g)),
+            m_safe[..., None],
+            axis=-1,
+        )[..., 0]
+        ignored = jnp.where(matched, gt_ig_at_m, (~matched) & ign_area[:, None, :])
+
+        # mark the chosen gt as matched (no-op when not matched)
+        hit = jax.nn.one_hot(m_safe, num_g, dtype=bool) & matched[..., None]
+        gt_match = gt_match | hit
+        return gt_match, (matched, ignored)
+
+    init = jnp.zeros((num_i, num_t, num_a, num_g), dtype=bool)
+    _, (matched, ignored) = jax.lax.scan(step, init, jnp.arange(num_d))
+    # scan stacks on axis 0 -> (D, I, T, A); move to (I, D, T, A)
+    return MatchResult(jnp.moveaxis(matched, 0, 1), jnp.moveaxis(ignored, 0, 1))
+
+
+def accumulate(
+    matched: Array,  # (I, D, T, A) bool
+    ignored: Array,  # (I, D, T, A) bool
+    det_scores: Array,  # (I, D) score-sorted per image
+    det_labels: Array,  # (I, D)
+    det_valid: Array,  # (I, D)
+    det_class_rank: Array,  # (I, D) rank of det within its class per image
+    gt_labels: Array,  # (I, G)
+    gt_valid: Array,  # (I, G)
+    gt_ignore: Array,  # (I, A, G)
+    class_ids: Array,  # (C,) evaluated class ids (pad with -1)
+    rec_thresholds: Array,  # (R,)
+    max_dets: Sequence[int],  # static, ascending
+    max_class_dets: int = 0,  # static cap on per-class det count (0 = n_flat)
+):
+    """PR-curve accumulation — pycocotools ``COCOeval.accumulate`` in XLA.
+
+    One global lexicographic (class, -score) sort makes every class's
+    detections a contiguous, score-descending segment; each class then
+    processes only a fixed ``(K, T, A)`` compacted slice instead of the full
+    flattened array — the key to O(total-dets) instead of O(classes x dets)
+    work. Curve rows include ignored detections as flat points, exactly like
+    pycocotools' accumulate.
+
+    Returns ``precision (T, R, C, A, M)``, ``recall (T, C, A, M)`` and
+    ``scores (T, R, C, A, M)`` with ``-1`` sentinels, matching the
+    reference's ``eval['precision'|'recall'|'scores']``.
+    """
+    num_i, num_d = det_scores.shape
+    num_t, num_a = matched.shape[2], matched.shape[3]
+    num_r = rec_thresholds.shape[0]
+    n_flat = num_i * num_d
+    k = int(max_class_dets) or n_flat
+    k = min(k, n_flat)
+
+    scores_f = det_scores.reshape(n_flat)
+    labels_f = det_labels.reshape(n_flat)
+    include = det_valid.reshape(n_flat) & (det_class_rank.reshape(n_flat) < int(max_dets[-1]))
+    rank_f = det_class_rank.reshape(n_flat)
+    matched_f = matched.reshape(n_flat, num_t, num_a)
+    ignored_f = ignored.reshape(n_flat, num_t, num_a)
+
+    max_dets = tuple(int(m) for m in max_dets)
+    big = jnp.int32(2**30)
+
+    # two-pass stable lexicographic sort: score-desc, then class-major.
+    # within a class segment rows are score-desc in image-major tie order —
+    # identical to pycocotools' per-class concatenate + mergesort.
+    order1 = jnp.argsort(jnp.where(include, -scores_f, jnp.inf), stable=True)
+    lab1 = jnp.where(include, labels_f, big)[order1]
+    order2 = jnp.argsort(lab1, stable=True)
+    perm = order1[order2]
+    labels_sorted = lab1[order2]
+
+    scores_g = scores_f[perm]
+    rank_g = rank_f[perm]
+    matched_g = matched_f[perm]
+    ignored_g = ignored_f[perm]
+
+    def per_class(cid):
+        start = jnp.searchsorted(labels_sorted, cid, side="left")
+        end = jnp.searchsorted(labels_sorted, cid, side="right")
+        idx = start + jnp.arange(k)
+        sel_row = idx < end  # real rows of this class
+        idx_c = jnp.minimum(idx, n_flat - 1)
+
+        score_s = jnp.take(scores_g, idx_c)
+        rank_s = jnp.take(rank_g, idx_c)
+        match_s = jnp.take(matched_g, idx_c, axis=0)  # (K, T, A)
+        ign_s = jnp.take(ignored_g, idx_c, axis=0)
+
+        # non-ignored gt count per area range: (A,)
+        gt_in_class = gt_valid & (gt_labels == cid)  # (I, G)
+        npig = jnp.sum(gt_in_class[:, None, :] & ~gt_ignore, axis=(0, 2))  # (A,)
+
+        idxs = jnp.arange(k)
+
+        def per_maxdet(m):
+            sel_m = sel_row & (rank_s < m)
+            use = sel_m[:, None, None] & ~ign_s  # (K, T, A)
+            tp = jnp.cumsum((use & match_s).astype(jnp.float32), axis=0)
+            fp = jnp.cumsum((use & ~match_s).astype(jnp.float32), axis=0)
+            # Rows excluded by the maxdet cap add 0, so rc/pr repeat the
+            # previous point — duplicated curve points change neither the
+            # envelope nor searchsorted hits (pycocotools keeps ignored rows
+            # in its curves the same way).
+            rc = tp / jnp.maximum(npig[None, None, :].astype(jnp.float32), 1.0)
+            pr = tp / jnp.maximum(tp + fp, 1e-12)  # np.spacing(1) guard
+            pr_env = jax.lax.cummax(pr[::-1], axis=0)[::-1]  # right-to-left max
+
+            # sampled 'scores': searchsorted may land on an excluded row;
+            # the true pycocotools sample is the NEXT selected row (the same
+            # curve point) — forward-gather it.
+            next_sel = jax.lax.cummin(jnp.where(sel_m, idxs, k)[::-1])[::-1]  # (K,)
+            score_at_next = jnp.where(next_sel < k, score_s[jnp.minimum(next_sel, k - 1)], 0.0)
+
+            def sample(rc_ta, pr_ta):
+                # rc_ta, pr_ta: (K,) for one (t, a)
+                inds = jnp.searchsorted(rc_ta, rec_thresholds, side="left")
+                ok = inds < k
+                inds_c = jnp.minimum(inds, k - 1)
+                q = jnp.where(ok, pr_ta[inds_c], 0.0)
+                s = jnp.where(ok, score_at_next[inds_c], 0.0)
+                return q, s
+
+            rc_flat = rc.reshape(k, num_t * num_a).T
+            pr_flat = pr_env.reshape(k, num_t * num_a).T
+            q, s = jax.vmap(sample)(rc_flat, pr_flat)  # (T*A, R)
+            q = q.reshape(num_t, num_a, num_r)
+            s = s.reshape(num_t, num_a, num_r)
+
+            total = tp[-1]  # (T, A) final tp count
+            recall_m = jnp.where(
+                npig[None, :] > 0, total / jnp.maximum(npig[None, :].astype(jnp.float32), 1.0), -1.0
+            )
+            q = jnp.where(npig[None, :, None] > 0, q, -1.0)
+            s = jnp.where(npig[None, :, None] > 0, s, -1.0)
+            return q, s, recall_m
+
+        qs, ss, rs = zip(*[per_maxdet(m) for m in max_dets])
+        # (M, T, A, R), (M, T, A)
+        return jnp.stack(qs), jnp.stack(ss), jnp.stack(rs)
+
+    q_all, s_all, r_all = jax.lax.map(per_class, class_ids)
+    # q_all: (C, M, T, A, R) -> precision (T, R, C, A, M)
+    precision = jnp.transpose(q_all, (2, 4, 0, 3, 1))
+    scores = jnp.transpose(s_all, (2, 4, 0, 3, 1))
+    recall = jnp.transpose(r_all, (2, 0, 3, 1))  # (C, M, T, A) -> (T, C, A, M)
+    return precision, recall, scores
+
+
+def compute_class_ranks(det_labels: Array, det_valid: Array, num_classes: int) -> Array:
+    """Per-image, per-detection rank within its own class (score-sorted input).
+
+    One-hot cumsum over the detection axis — the XLA-friendly replacement for
+    per-(image, class) list slicing.
+    """
+    oh = jax.nn.one_hot(jnp.where(det_valid, det_labels, num_classes), num_classes + 1, dtype=jnp.int32)
+    csum = jnp.cumsum(oh, axis=1)
+    rank = jnp.take_along_axis(csum, jnp.clip(det_labels, 0, num_classes)[..., None], axis=-1)[..., 0] - 1
+    return jnp.where(det_valid, rank, 10**9)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("max_dets", "num_classes", "max_class_dets"),
+)
+def evaluate_map(
+    det_boxes: Array,  # (I, D, 4) xyxy
+    det_scores: Array,  # (I, D)
+    det_labels: Array,  # (I, D) int32
+    det_valid: Array,  # (I, D) bool
+    det_area: Array,  # (I, D)
+    gt_boxes: Array,  # (I, G, 4) xyxy
+    gt_labels: Array,  # (I, G)
+    gt_valid: Array,  # (I, G)
+    gt_crowd: Array,  # (I, G)
+    gt_area: Array,  # (I, G)
+    class_ids: Array,  # (C,) pad with -1
+    iou_thresholds: Array,  # (T,)
+    rec_thresholds: Array,  # (R,)
+    max_dets: Sequence[int],
+    num_classes: int,
+    area_ranges: Array = None,  # (A, 2)
+    iou_override: Array = None,  # (I, D, G) precomputed (segm mode)
+    max_class_dets: int = 0,  # static cap on any class's total det count
+):
+    """Full COCO evaluation: sort, IoU, match, accumulate — one jit program."""
+    from torchmetrics_tpu.functional.detection._pairwise import pairwise_iou_crowd
+
+    if area_ranges is None:
+        area_ranges = jnp.asarray(AREA_RANGES, jnp.float32)
+
+    # per-image stable sort by descending score, padding last
+    key = jnp.where(det_valid, -det_scores, jnp.inf)
+    order = jnp.argsort(key, axis=1, stable=True)
+    det_boxes = jnp.take_along_axis(det_boxes, order[..., None], axis=1)
+    det_scores = jnp.take_along_axis(det_scores, order, axis=1)
+    det_labels = jnp.take_along_axis(det_labels, order, axis=1)
+    det_valid = jnp.take_along_axis(det_valid, order, axis=1)
+    det_area = jnp.take_along_axis(det_area, order, axis=1)
+
+    rank = compute_class_ranks(det_labels, det_valid, num_classes)
+
+    if iou_override is not None:
+        iou = jnp.take_along_axis(iou_override, order[..., None], axis=1)
+    else:
+        iou = jax.vmap(pairwise_iou_crowd)(det_boxes, gt_boxes, gt_crowd)
+    iou = jnp.where(det_valid[:, :, None] & gt_valid[:, None, :], iou, 0.0)
+
+    lo = area_ranges[:, 0][None, None, :]
+    hi = area_ranges[:, 1][None, None, :]
+    det_ignore_area = (det_area[..., None] < lo) | (det_area[..., None] > hi)  # (I, D, A)
+    gt_out = (gt_area[..., None] < lo) | (gt_area[..., None] > hi)  # (I, G, A)
+    gt_ignore = (gt_crowd[..., None].astype(bool) | gt_out) & gt_valid[..., None]
+    gt_ignore = jnp.moveaxis(gt_ignore, 2, 1)  # (I, A, G)
+
+    participates = det_valid & (rank < int(max_dets[-1]))
+    res = match_detections(
+        iou,
+        det_labels,
+        participates,
+        det_ignore_area,
+        gt_labels,
+        gt_valid,
+        gt_crowd.astype(bool),
+        gt_ignore,
+        iou_thresholds,
+    )
+    precision, recall, scores = accumulate(
+        res.matched,
+        res.ignored,
+        det_scores,
+        det_labels,
+        det_valid,
+        rank,
+        gt_labels,
+        gt_valid,
+        gt_ignore,
+        class_ids,
+        rec_thresholds,
+        max_dets,
+        max_class_dets=max_class_dets,
+    )
+    return precision, recall, scores
+
+
+def summarize(
+    precision: np.ndarray,  # (T, R, C, A, M)
+    recall: np.ndarray,  # (T, C, A, M)
+    iou_thresholds: Sequence[float],
+    max_dets: Sequence[int],
+) -> dict:
+    """pycocotools ``summarize`` on the accumulated tensors (host-side, tiny)."""
+    iou_thresholds = list(iou_thresholds)
+
+    def _summ_ap(t_idx=None, a_idx=0, m_idx=None):
+        m_idx = len(max_dets) - 1 if m_idx is None else m_idx
+        s = precision[:, :, :, a_idx, m_idx] if t_idx is None else precision[t_idx : t_idx + 1, :, :, a_idx, m_idx]
+        s = s[s > -1]
+        return float(s.mean()) if s.size else -1.0
+
+    def _summ_ar(a_idx=0, m_idx=None):
+        m_idx = len(max_dets) - 1 if m_idx is None else m_idx
+        s = recall[:, :, a_idx, m_idx]
+        s = s[s > -1]
+        return float(s.mean()) if s.size else -1.0
+
+    def _t(v):
+        return iou_thresholds.index(v) if v in iou_thresholds else None
+
+    out = {
+        "map": _summ_ap(),
+        "map_50": _summ_ap(t_idx=_t(0.5)) if _t(0.5) is not None else -1.0,
+        "map_75": _summ_ap(t_idx=_t(0.75)) if _t(0.75) is not None else -1.0,
+        "map_small": _summ_ap(a_idx=1),
+        "map_medium": _summ_ap(a_idx=2),
+        "map_large": _summ_ap(a_idx=3),
+        "mar_small": _summ_ar(a_idx=1),
+        "mar_medium": _summ_ar(a_idx=2),
+        "mar_large": _summ_ar(a_idx=3),
+    }
+    for i, m in enumerate(max_dets):
+        out[f"mar_{m}"] = _summ_ar(m_idx=i)
+    return out

@@ -1,0 +1,39 @@
+"""Generalized IoU functional API (reference ``functional/detection/giou.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.detection._pairwise import pairwise_giou
+
+Array = jax.Array
+
+
+def _giou_update(
+    preds: Array, target: Array, iou_threshold: Optional[float], replacement_val: float = 0
+) -> Array:
+    iou = pairwise_giou(jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32))
+    if iou_threshold is not None:
+        iou = jnp.where(iou < iou_threshold, replacement_val, iou)
+    return iou
+
+
+def _giou_compute(iou: Array, aggregate: bool = True) -> Array:
+    if not aggregate:
+        return iou
+    return jnp.diagonal(iou).mean() if iou.size > 0 else jnp.asarray(0.0)
+
+
+def generalized_intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    """Compute Generalized Intersection over Union between two sets of ``xyxy`` boxes."""
+    iou = _giou_update(preds, target, iou_threshold, replacement_val)
+    return _giou_compute(iou, aggregate)

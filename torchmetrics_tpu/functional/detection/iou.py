@@ -1,0 +1,56 @@
+"""IoU functional API (reference ``functional/detection/iou.py``).
+
+The reference delegates to ``torchvision.ops.box_iou`` (C++/CUDA); here the
+pairwise kernel is pure XLA (``_pairwise.pairwise_iou``) and runs on TPU.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.detection._pairwise import pairwise_iou
+
+Array = jax.Array
+
+
+def _iou_update(
+    preds: Array, target: Array, iou_threshold: Optional[float], replacement_val: float = 0
+) -> Array:
+    iou = pairwise_iou(jnp.asarray(preds, jnp.float32), jnp.asarray(target, jnp.float32))
+    if iou_threshold is not None:
+        iou = jnp.where(iou < iou_threshold, replacement_val, iou)
+    return iou
+
+
+def _iou_compute(iou: Array, aggregate: bool = True) -> Array:
+    if not aggregate:
+        return iou
+    return jnp.diagonal(iou).mean() if iou.size > 0 else jnp.asarray(0.0)
+
+
+def intersection_over_union(
+    preds: Array,
+    target: Array,
+    iou_threshold: Optional[float] = None,
+    replacement_val: float = 0,
+    aggregate: bool = True,
+) -> Array:
+    """Compute Intersection over Union between two sets of ``xyxy`` boxes.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.detection import intersection_over_union
+        >>> preds = jnp.array([[296.55, 93.96, 314.97, 152.79],
+        ...                    [328.94, 97.05, 342.49, 122.98],
+        ...                    [356.62, 95.47, 372.33, 147.55]])
+        >>> target = jnp.array([[300.00, 100.00, 315.00, 150.00],
+        ...                     [330.00, 100.00, 350.00, 125.00],
+        ...                     [350.00, 100.00, 375.00, 150.00]])
+        >>> intersection_over_union(preds, target)
+        Array(0.5879, dtype=float32)
+    """
+    iou = _iou_update(preds, target, iou_threshold, replacement_val)
+    return _iou_compute(iou, aggregate)

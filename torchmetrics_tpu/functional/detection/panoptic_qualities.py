@@ -1,0 +1,317 @@
+"""Panoptic Quality in pure XLA (reference ``functional/detection/panoptic_qualities.py``
+and ``_panoptic_quality_common.py``).
+
+TPU-native design: the reference counts segment areas through python dicts
+keyed by ``(category, instance)`` color tuples (``_get_color_areas``, host
+loops per sample). Here colors are packed into single int codes, segments are
+enumerated with the *fixed-size* ``jnp.unique(..., size=S)``, and all
+area/intersection statistics are ``segment_sum`` scatters over static shapes
+— one jit-compiled program per (points, segments) bucket, no host loops.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Collection, Dict, Optional, Set, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.data import _bucket_size as _bucket
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def _parse_categories(things: Collection[int], stuffs: Collection[int]) -> Tuple[Set[int], Set[int]]:
+    """Validate the ``things`` / ``stuffs`` category sets."""
+    things_parsed = set(things)
+    if len(things_parsed) < len(things):
+        rank_zero_warn("The provided `things` categories contained duplicates, which have been removed.", UserWarning)
+    stuffs_parsed = set(stuffs)
+    if len(stuffs_parsed) < len(stuffs):
+        rank_zero_warn("The provided `stuffs` categories contained duplicates, which have been removed.", UserWarning)
+    if not all(isinstance(v, (int, np.integer)) for v in things_parsed):
+        raise TypeError(f"Expected argument `things` to contain `int` categories, but got {things}")
+    if not all(isinstance(v, (int, np.integer)) for v in stuffs_parsed):
+        raise TypeError(f"Expected argument `stuffs` to contain `int` categories, but got {stuffs}")
+    if things_parsed & stuffs_parsed:
+        raise ValueError(
+            f"Expected arguments `things` and `stuffs` to have distinct keys, but got {things} and {stuffs}"
+        )
+    if not (things_parsed | stuffs_parsed):
+        raise ValueError("At least one of `things` and `stuffs` must be non-empty.")
+    return things_parsed, stuffs_parsed
+
+
+def _validate_inputs(preds: Array, target: Array) -> None:
+    if preds.shape != target.shape:
+        raise ValueError(
+            f"Expected argument `preds` and `target` to have the same shape, but got {preds.shape} and {target.shape}"
+        )
+    if preds.ndim < 3:
+        raise ValueError(
+            "Expected argument `preds` to have at least one spatial dimension (B, *spatial_dims, 2), "
+            f"got {preds.shape}"
+        )
+    if preds.shape[-1] != 2:
+        raise ValueError(
+            "Expected argument `preds` to have exactly 2 channels in the last dimension (category, instance), "
+            f"got {preds.shape} instead"
+        )
+
+
+def _get_void_color(things: Set[int], stuffs: Set[int]) -> Tuple[int, int]:
+    """An unused (category, instance) color."""
+    return 1 + max([0, *list(things), *list(stuffs)]), 0
+
+
+def _get_category_id_to_continuous_id(things: Set[int], stuffs: Set[int]) -> Dict[int, int]:
+    """things -> [0, len(things)), stuffs -> [len(things), ...) (iteration order)."""
+    mapping = {thing_id: idx for idx, thing_id in enumerate(things)}
+    mapping.update({stuff_id: idx + len(things) for idx, stuff_id in enumerate(stuffs)})
+    return mapping
+
+
+def _prepocess_inputs(
+    things: Set[int],
+    stuffs: Set[int],
+    inputs: Array,
+    void_color: Tuple[int, int],
+    allow_unknown_category: bool,
+) -> Array:
+    """Flatten spatial dims, zero stuff instance ids, map unknown cats to void."""
+    out = jnp.asarray(inputs, jnp.int32)
+    out = out.reshape(out.shape[0], -1, 2)
+    cats = out[:, :, 0]
+    stuff_list = jnp.asarray(sorted(stuffs) or [-(10**9)], jnp.int32)
+    thing_list = jnp.asarray(sorted(things) or [-(10**9)], jnp.int32)
+    mask_stuffs = jnp.isin(cats, stuff_list)
+    mask_things = jnp.isin(cats, thing_list)
+    inst = jnp.where(mask_stuffs, 0, out[:, :, 1])
+    known = mask_things | mask_stuffs
+    if not allow_unknown_category and not bool(jnp.all(known)):
+        raise ValueError(f"Unknown categories found: {np.asarray(cats)[~np.asarray(known)]}")
+    cats = jnp.where(known, cats, void_color[0])
+    inst = jnp.where(known, inst, void_color[1])
+    return jnp.stack([cats, inst], axis=-1)
+
+
+@functools.partial(jax.jit, static_argnames=("num_segs", "num_cats"))
+def _pq_update_sample(
+    pred_codes: Array,  # (N,) dense color codes (indices into code_cat)
+    target_codes: Array,  # (N,)
+    void_code: Array,  # scalar dense code of the void color
+    code_cat: Array,  # (n_codes,) dense code -> category id
+    code_cont: Array,  # (n_codes,) dense code -> continuous category id, -1 unknown
+    modified_mask: Array,  # (num_cats,) bool: continuous ids using modified (stuff) rule
+    num_segs: int,
+    num_cats: int,
+):
+    """Per-sample segment statistics -> (iou_sum, tp, fp, fn) per continuous cat."""
+    n = pred_codes.shape[0]
+    s = num_segs
+
+    p_uniq = jnp.unique(pred_codes, size=s, fill_value=void_code)
+    t_uniq = jnp.unique(target_codes, size=s, fill_value=void_code)
+    # first-occurrence slot per code (duplicated fill slots get no pixels)
+    p_idx = jnp.searchsorted(p_uniq, pred_codes)
+    t_idx = jnp.searchsorted(t_uniq, target_codes)
+
+    ones = jnp.ones(n, jnp.float32)
+    p_area = jax.ops.segment_sum(ones, p_idx, num_segments=s)
+    t_area = jax.ops.segment_sum(ones, t_idx, num_segments=s)
+    inter = jax.ops.segment_sum(ones, p_idx * s + t_idx, num_segments=s * s).reshape(s, s)
+
+    p_cat = code_cat[jnp.clip(p_uniq, 0, code_cat.shape[0] - 1)]
+    t_cat = code_cat[jnp.clip(t_uniq, 0, code_cat.shape[0] - 1)]
+    p_is_void = p_uniq == void_code
+    t_is_void = t_uniq == void_code
+    p_real = (p_area > 0) & ~p_is_void
+    t_real = (t_area > 0) & ~t_is_void
+
+    # void overlaps (all slots holding the void code; fill slots hold 0 pixels)
+    pred_void_area = jnp.sum(jnp.where(t_is_void[None, :], inter, 0.0), axis=1)  # (S,)
+    void_target_area = jnp.sum(jnp.where(p_is_void[:, None], inter, 0.0), axis=0)  # (S,)
+
+    union = (
+        p_area[:, None]
+        - pred_void_area[:, None]
+        + t_area[None, :]
+        - void_target_area[None, :]
+        - inter
+    )
+    same_cat = (p_cat[:, None] == t_cat[None, :]) & p_real[:, None] & t_real[None, :]
+    iou = jnp.where(same_cat & (union > 0), inter / jnp.maximum(union, 1.0), 0.0)
+
+    t_cont = code_cont[jnp.clip(t_uniq, 0, code_cont.shape[0] - 1)]  # (S,)
+    p_cont = code_cont[jnp.clip(p_uniq, 0, code_cont.shape[0] - 1)]
+    t_modified = jnp.where(t_cont >= 0, modified_mask[jnp.maximum(t_cont, 0)], False)
+
+    # standard rule: iou > 0.5 matches (each segment matches at most once)
+    tp_pair = same_cat & (iou > 0.5) & ~t_modified[None, :]
+    matched_p = jnp.any(tp_pair, axis=1)
+    matched_t = jnp.any(tp_pair, axis=0)
+
+    seg_cont_t = jnp.maximum(t_cont, 0)
+    iou_std = jax.ops.segment_sum(jnp.sum(jnp.where(tp_pair, iou, 0.0), axis=0), seg_cont_t, num_segments=num_cats)
+    tp = jax.ops.segment_sum(matched_t.astype(jnp.int32), seg_cont_t, num_segments=num_cats)
+
+    # modified rule (stuffs): accumulate any iou > 0; tp := #target segments
+    mod_pair = same_cat & (iou > 0) & t_modified[None, :]
+    iou_mod = jax.ops.segment_sum(jnp.sum(jnp.where(mod_pair, iou, 0.0), axis=0), seg_cont_t, num_segments=num_cats)
+    tp_mod = jax.ops.segment_sum(
+        (t_real & t_modified).astype(jnp.int32), seg_cont_t, num_segments=num_cats
+    )
+
+    # false negatives: unmatched real target segments mostly outside void
+    fn_seg = t_real & ~matched_t & (void_target_area <= 0.5 * t_area) & ~t_modified
+    fn = jax.ops.segment_sum(fn_seg.astype(jnp.int32), seg_cont_t, num_segments=num_cats)
+
+    # false positives: unmatched real pred segments mostly outside void
+    p_modified = jnp.where(p_cont >= 0, modified_mask[jnp.maximum(p_cont, 0)], False)
+    fp_seg = p_real & ~matched_p & (pred_void_area <= 0.5 * p_area) & (p_cont >= 0) & ~p_modified
+    fp = jax.ops.segment_sum(
+        fp_seg.astype(jnp.int32), jnp.maximum(p_cont, 0), num_segments=num_cats
+    )
+
+    return iou_std + iou_mod, tp + tp_mod, fp, fn
+
+
+def _panoptic_quality_update(
+    flatten_preds: Array,
+    flatten_target: Array,
+    cat_id_to_continuous_id: Dict[int, int],
+    void_color: Tuple[int, int],
+    modified_metric_stuffs: Optional[Set[int]] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Batch statistics: sum of per-sample (iou_sum, tp, fp, fn)."""
+    num_cats = len(cat_id_to_continuous_id)
+    modified_mask = np.zeros(num_cats, bool)
+    for cat in modified_metric_stuffs or ():
+        modified_mask[cat_id_to_continuous_id[cat]] = True
+
+    # dense color codes: arbitrary (category, instance) pairs are remapped to
+    # indices into the unique-color table — no bit packing, no collisions,
+    # no overflow for large instance/category ids
+    preds_np = np.asarray(flatten_preds)
+    target_np = np.asarray(flatten_target)
+    all_colors = np.concatenate(
+        [preds_np.reshape(-1, 2), target_np.reshape(-1, 2), np.asarray([void_color], np.int32)]
+    )
+    uniq_colors, inverse = np.unique(all_colors, axis=0, return_inverse=True)
+    inverse = inverse.astype(np.int32)
+    n_p = preds_np.shape[0] * preds_np.shape[1]
+    pred_codes_b = jnp.asarray(inverse[:n_p].reshape(preds_np.shape[:2]))
+    target_codes_b = jnp.asarray(inverse[n_p : 2 * n_p].reshape(target_np.shape[:2]))
+    void_code = jnp.asarray(inverse[-1], jnp.int32)
+    code_cat = jnp.asarray(uniq_colors[:, 0].astype(np.int32))
+    # sparse-safe continuous-id lookup per dense code (dict on host, not a
+    # table indexed by raw category id)
+    code_cont = jnp.asarray(
+        np.asarray([cat_id_to_continuous_id.get(int(c), -1) for c in uniq_colors[:, 0]], np.int32)
+    )
+
+    iou_sum = jnp.zeros(num_cats, jnp.float32)
+    tp = jnp.zeros(num_cats, jnp.int32)
+    fp = jnp.zeros(num_cats, jnp.int32)
+    fn = jnp.zeros(num_cats, jnp.int32)
+    for b in range(pred_codes_b.shape[0]):
+        n_seg = max(
+            int(np.unique(np.asarray(pred_codes_b[b])).size),
+            int(np.unique(np.asarray(target_codes_b[b])).size),
+        )
+        res = _pq_update_sample(
+            pred_codes_b[b],
+            target_codes_b[b],
+            void_code,
+            code_cat,
+            code_cont,
+            jnp.asarray(modified_mask),
+            num_segs=_bucket(n_seg),
+            num_cats=num_cats,
+        )
+        iou_sum = iou_sum + res[0]
+        tp = tp + res[1]
+        fp = fp + res[2]
+        fn = fn + res[3]
+    return iou_sum, tp, fp, fn
+
+
+def _panoptic_quality_compute(iou_sum: Array, true_positives: Array, false_positives: Array, false_negatives: Array) -> Array:
+    """PQ = mean over categories of iou_sum / (tp + fp/2 + fn/2)."""
+    denominator = true_positives + 0.5 * false_positives + 0.5 * false_negatives
+    pq = jnp.where(denominator > 0, iou_sum / jnp.maximum(denominator, 1e-12), 0.0)
+    n_valid = jnp.sum(denominator > 0)
+    return jnp.sum(pq) / jnp.maximum(n_valid, 1)
+
+
+def panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+    **kwargs: Any,
+) -> Array:
+    """Compute Panoptic Quality for panoptic segmentations.
+
+    Inputs are ``(B, *spatial, 2)`` int tensors of (category_id, instance_id)
+    pairs. Unknown target categories are ignored (mapped to void).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.detection import panoptic_quality
+        >>> preds = jnp.array([[[[6, 0], [0, 0], [6, 0], [6, 0]],
+        ...                     [[0, 0], [0, 0], [6, 0], [0, 1]],
+        ...                     [[0, 0], [0, 0], [6, 0], [0, 1]],
+        ...                     [[0, 0], [7, 0], [6, 0], [1, 0]],
+        ...                     [[0, 0], [7, 0], [7, 0], [7, 0]]]])
+        >>> target = jnp.array([[[[6, 0], [0, 1], [6, 0], [0, 1]],
+        ...                      [[0, 1], [0, 1], [6, 0], [0, 1]],
+        ...                      [[0, 1], [0, 1], [6, 0], [1, 0]],
+        ...                      [[0, 1], [7, 0], [1, 0], [1, 0]],
+        ...                      [[0, 1], [7, 0], [7, 0], [7, 0]]]])
+        >>> round(float(panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})), 4)
+        0.5463
+    """
+    things, stuffs = _parse_categories(things, stuffs)
+    _validate_inputs(preds, target)
+    void_color = _get_void_color(things, stuffs)
+    cat_id_to_continuous_id = _get_category_id_to_continuous_id(things, stuffs)
+    flatten_preds = _prepocess_inputs(things, stuffs, preds, void_color, allow_unknown_preds_category)
+    flatten_target = _prepocess_inputs(things, stuffs, target, void_color, True)
+    iou_sum, tp, fp, fn = _panoptic_quality_update(flatten_preds, flatten_target, cat_id_to_continuous_id, void_color)
+    return _panoptic_quality_compute(iou_sum, tp, fp, fn)
+
+
+def modified_panoptic_quality(
+    preds: Array,
+    target: Array,
+    things: Collection[int],
+    stuffs: Collection[int],
+    allow_unknown_preds_category: bool = False,
+    **kwargs: Any,
+) -> Array:
+    """Compute Modified Panoptic Quality: stuff categories use the relaxed
+    (iou > 0, per-target-segment) rule of Porzi et al.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.detection import modified_panoptic_quality
+        >>> preds = jnp.array([[[0, 0], [0, 1], [6, 0], [7, 0], [0, 2], [1, 0]]])
+        >>> target = jnp.array([[[0, 1], [0, 0], [6, 0], [7, 0], [6, 0], [255, 0]]])
+        >>> round(float(modified_panoptic_quality(preds, target, things={0, 1}, stuffs={6, 7})), 4)
+        0.7667
+    """
+    things, stuffs = _parse_categories(things, stuffs)
+    _validate_inputs(preds, target)
+    void_color = _get_void_color(things, stuffs)
+    cat_id_to_continuous_id = _get_category_id_to_continuous_id(things, stuffs)
+    flatten_preds = _prepocess_inputs(things, stuffs, preds, void_color, allow_unknown_preds_category)
+    flatten_target = _prepocess_inputs(things, stuffs, target, void_color, True)
+    iou_sum, tp, fp, fn = _panoptic_quality_update(
+        flatten_preds, flatten_target, cat_id_to_continuous_id, void_color, modified_metric_stuffs=stuffs
+    )
+    return _panoptic_quality_compute(iou_sum, tp, fp, fn)

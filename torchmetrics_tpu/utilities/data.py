@@ -131,3 +131,15 @@ def allclose(a: Array, b: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool
     if a.shape != b.shape:
         return False
     return bool(jnp.allclose(a, b, rtol=rtol, atol=atol))
+
+
+def _bucket_size(n: int, minimum: int = 8) -> int:
+    """Round ``n`` up to the next power of two (>= ``minimum``).
+
+    Static-shape bucketing for jit: padding dynamic extents to power-of-two
+    buckets bounds the number of distinct compiled programs.
+    """
+    b = minimum
+    while b < n:
+        b *= 2
+    return b
