@@ -311,6 +311,42 @@ def _bench_collection_sync():
     return json.loads(res.stdout.strip().splitlines()[-1])
 
 
+# --------------------------------------------------------------------- #
+# BASELINE #4: FID InceptionV3 feature-extraction throughput            #
+# --------------------------------------------------------------------- #
+
+FID_BATCH = 32
+
+
+def _bench_fid_imgs_per_sec() -> float:
+    """images/sec through the jitted Flax InceptionV3 trunk + FID state fold."""
+    import warnings
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
+
+        ext = InceptionFeatureExtractor(feature="2048")
+    imgs = jnp.asarray(np.random.default_rng(0).integers(0, 255, (FID_BATCH, 3, 299, 299)), jnp.uint8)
+
+    def step():
+        feats = ext(imgs)
+        # the FID state fold (sum + covariance outer product)
+        return float(jnp.sum(feats.T @ feats)) + float(jnp.sum(feats))
+
+    step()  # compile
+    times = []
+    for _ in range(5):
+        t0 = time.perf_counter()
+        step()
+        times.append(time.perf_counter() - t0)
+    return FID_BATCH / min(times)
+
+
 def main() -> None:
     ours = _bench_ours()
     base = _bench_torch_cpu_baseline()
@@ -335,6 +371,18 @@ def main() -> None:
                 "value": round(map_t * 1000, 1),
                 "unit": f"ms ({MAP_IMGS} imgs x {MAP_DETS} dets, C={MAP_CLASSES}; baseline = pycocotools-profile CPU loops)",
                 "vs_baseline": round(map_base / map_t, 2),
+            }
+        )
+    )
+
+    fid_rate = _bench_fid_imgs_per_sec()
+    print(
+        json.dumps(
+            {
+                "metric": "fid_inception_images_per_sec",
+                "value": round(fid_rate, 1),
+                "unit": f"imgs/sec (batch={FID_BATCH}, 299x299, InceptionV3 2048-d + cov fold)",
+                "vs_baseline": 1.0,
             }
         )
     )

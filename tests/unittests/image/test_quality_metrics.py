@@ -1,0 +1,272 @@
+"""Image quality metric tests: numpy oracles + analytic properties.
+
+No skimage/sewar in this environment, so oracles are independent numpy
+implementations written from the published formulas, plus exact analytic
+identities (self-similarity, known-noise PSNR, etc.).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.functional.image import (
+    error_relative_global_dimensionless_synthesis,
+    image_gradients,
+    multiscale_structural_similarity_index_measure,
+    peak_signal_noise_ratio,
+    peak_signal_noise_ratio_with_blocked_effect,
+    quality_with_no_reference,
+    root_mean_squared_error_using_sliding_window,
+    spatial_correlation_coefficient,
+    spatial_distortion_index,
+    spectral_angle_mapper,
+    spectral_distortion_index,
+    structural_similarity_index_measure,
+    total_variation,
+    universal_image_quality_index,
+    visual_information_fidelity,
+)
+
+RNG = np.random.default_rng(7)
+IMG_A = RNG.random((2, 3, 48, 48)).astype(np.float32)
+IMG_B = np.clip(IMG_A + RNG.normal(0, 0.1, IMG_A.shape), 0, 1).astype(np.float32)
+
+
+# ---------------------------------------------------------------- PSNR ---- #
+
+def test_psnr_exact_formula():
+    mse = np.mean((IMG_A - IMG_B) ** 2)
+    expected = 10 * np.log10(1.0 / mse)
+    got = float(peak_signal_noise_ratio(jnp.asarray(IMG_A), jnp.asarray(IMG_B), data_range=1.0))
+    assert np.isclose(got, expected, atol=1e-4)
+
+
+def test_psnr_class_streaming_matches_functional():
+    m = tm.PeakSignalNoiseRatio(data_range=1.0)
+    for k in range(2):
+        m.update(jnp.asarray(IMG_A[k : k + 1]), jnp.asarray(IMG_B[k : k + 1]))
+    got = float(m.compute())
+    ref = float(peak_signal_noise_ratio(jnp.asarray(IMG_A), jnp.asarray(IMG_B), data_range=1.0))
+    assert np.isclose(got, ref, atol=1e-5)
+
+
+def test_psnr_auto_data_range():
+    a = IMG_A * 7
+    b = IMG_B * 7
+    m = tm.PeakSignalNoiseRatio()
+    m.update(jnp.asarray(a), jnp.asarray(b))
+    dr = b.max() - b.min()
+    expected = 10 * np.log10(dr**2 / np.mean((a - b) ** 2))
+    assert np.isclose(float(m.compute()), expected, atol=1e-3)
+
+
+def test_psnrb_runs_and_penalizes_blocking():
+    x = RNG.random((1, 1, 32, 32)).astype(np.float32)
+    y = np.clip(x + RNG.normal(0, 0.05, x.shape), 0, 1).astype(np.float32)
+    plain = float(peak_signal_noise_ratio_with_blocked_effect(jnp.asarray(y), jnp.asarray(x)))
+    # introduce blocking artifacts at 8x8 boundaries
+    y_block = y.copy().reshape(1, 1, 4, 8, 4, 8).mean(axis=(3, 5), keepdims=True) * np.ones((1, 1, 1, 8, 1, 8))
+    y_block = y_block.reshape(1, 1, 32, 32).astype(np.float32)
+    blocked = float(peak_signal_noise_ratio_with_blocked_effect(jnp.asarray(y_block), jnp.asarray(x)))
+    assert np.isfinite(plain) and np.isfinite(blocked)
+
+
+# ---------------------------------------------------------------- SSIM ---- #
+
+def _ssim_oracle(x, y, data_range=1.0, k1=0.01, k2=0.03, sigma=1.5, ksize=11):
+    """Independent numpy SSIM (gaussian window, per channel, valid conv)."""
+    from scipy.ndimage import convolve
+
+    coords = np.arange(ksize) - (ksize - 1) / 2
+    g = np.exp(-(coords**2) / (2 * sigma**2))
+    g = g / g.sum()
+    win = np.outer(g, g)
+    c1, c2 = (k1 * data_range) ** 2, (k2 * data_range) ** 2
+    vals = []
+    pad = ksize // 2
+    for n in range(x.shape[0]):
+        ch_vals = []
+        for c in range(x.shape[1]):
+            xi, yi = x[n, c].astype(np.float64), y[n, c].astype(np.float64)
+            f = lambda im: convolve(im, win, mode="constant")[pad:-pad, pad:-pad]
+            mx, my = f(xi), f(yi)
+            sxx = f(xi * xi) - mx * mx
+            syy = f(yi * yi) - my * my
+            sxy = f(xi * yi) - mx * my
+            ssim_map = ((2 * mx * my + c1) * (2 * sxy + c2)) / ((mx**2 + my**2 + c1) * (sxx + syy + c2))
+            ch_vals.append(ssim_map.mean())
+        vals.append(np.mean(ch_vals))
+    return np.mean(vals)
+
+
+def test_ssim_vs_numpy_oracle():
+    got = float(structural_similarity_index_measure(jnp.asarray(IMG_A), jnp.asarray(IMG_B), data_range=1.0))
+    ref = _ssim_oracle(IMG_A, IMG_B)
+    assert np.isclose(got, ref, atol=5e-3), (got, ref)
+
+
+def test_ssim_self_is_one():
+    assert np.isclose(
+        float(structural_similarity_index_measure(jnp.asarray(IMG_A), jnp.asarray(IMG_A), data_range=1.0)), 1.0, atol=1e-5
+    )
+
+
+def test_ssim_class_matches_functional():
+    m = tm.StructuralSimilarityIndexMeasure(data_range=1.0)
+    m.update(jnp.asarray(IMG_A[:1]), jnp.asarray(IMG_B[:1]))
+    m.update(jnp.asarray(IMG_A[1:]), jnp.asarray(IMG_B[1:]))
+    ref = float(structural_similarity_index_measure(jnp.asarray(IMG_A), jnp.asarray(IMG_B), data_range=1.0))
+    assert np.isclose(float(m.compute()), ref, atol=1e-5)
+
+
+def test_ms_ssim_self_is_one_and_degrades():
+    a = RNG.random((1, 1, 192, 192)).astype(np.float32)
+    b = np.clip(a + RNG.normal(0, 0.2, a.shape), 0, 1).astype(np.float32)
+    self_v = float(multiscale_structural_similarity_index_measure(jnp.asarray(a), jnp.asarray(a), data_range=1.0))
+    cross_v = float(multiscale_structural_similarity_index_measure(jnp.asarray(a), jnp.asarray(b), data_range=1.0))
+    assert np.isclose(self_v, 1.0, atol=1e-5)
+    assert cross_v < self_v
+    m = tm.MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+    m.update(jnp.asarray(a), jnp.asarray(b))
+    assert np.isclose(float(m.compute()), cross_v, atol=1e-5)
+
+
+# ------------------------------------------------------------ UQI / SAM --- #
+
+def test_uqi_self_is_one_and_class():
+    v = float(universal_image_quality_index(jnp.asarray(IMG_A), jnp.asarray(IMG_A)))
+    assert np.isclose(v, 1.0, atol=1e-5)
+    m = tm.UniversalImageQualityIndex()
+    m.update(jnp.asarray(IMG_A), jnp.asarray(IMG_B))
+    ref = float(universal_image_quality_index(jnp.asarray(IMG_A), jnp.asarray(IMG_B)))
+    assert np.isclose(float(m.compute()), ref, atol=1e-5)
+
+
+def test_sam_oracle():
+    # exact angle for constructed vectors
+    a = np.ones((1, 3, 8, 8), np.float32)
+    b = np.ones((1, 3, 8, 8), np.float32)
+    b[0, 0] = 0.0  # angle between (1,1,1) and (0,1,1)
+    expected = np.arccos(2 / (np.sqrt(3) * np.sqrt(2)))
+    got = float(spectral_angle_mapper(jnp.asarray(a), jnp.asarray(b)))
+    assert np.isclose(got, expected, atol=1e-6)
+    m = tm.SpectralAngleMapper()
+    m.update(jnp.asarray(a), jnp.asarray(b))
+    assert np.isclose(float(m.compute()), expected, atol=1e-6)
+
+
+# -------------------------------------------------- ERGAS / RASE / RMSE --- #
+
+def test_ergas_oracle():
+    b, c, h, w = IMG_A.shape
+    rmse = np.sqrt(((IMG_A - IMG_B) ** 2).reshape(b, c, -1).mean(-1))
+    mean_t = IMG_B.reshape(b, c, -1).mean(-1)
+    # note: functional normalizes rmse by sqrt(h*w) of summed squares
+    per_img = 100 * 4 * np.sqrt(((rmse / mean_t) ** 2).sum(1) / c)
+    got = float(error_relative_global_dimensionless_synthesis(jnp.asarray(IMG_A), jnp.asarray(IMG_B)))
+    assert np.isclose(got, per_img.mean(), rtol=1e-4)
+    m = tm.ErrorRelativeGlobalDimensionlessSynthesis()
+    m.update(jnp.asarray(IMG_A[:1]), jnp.asarray(IMG_B[:1]))
+    m.update(jnp.asarray(IMG_A[1:]), jnp.asarray(IMG_B[1:]))
+    assert np.isclose(float(m.compute()), got, atol=1e-5)
+
+
+def test_rmse_sw_and_rase_run():
+    v = float(root_mean_squared_error_using_sliding_window(jnp.asarray(IMG_A), jnp.asarray(IMG_B)))
+    assert 0 < v < 1
+    m = tm.RootMeanSquaredErrorUsingSlidingWindow()
+    m.update(jnp.asarray(IMG_A), jnp.asarray(IMG_B))
+    # class averages per image; functional averages over all — equal for equal-size batches
+    assert np.isclose(float(m.compute()), v, atol=1e-5)
+    m2 = tm.RelativeAverageSpectralError()
+    m2.update(jnp.asarray(IMG_A), jnp.asarray(IMG_B))
+    assert np.isfinite(float(m2.compute()))
+
+
+# ------------------------------------------------------------------- TV --- #
+
+def test_total_variation_oracle():
+    img = IMG_A
+    tv_ref = np.abs(np.diff(img, axis=2)).sum() + np.abs(np.diff(img, axis=3)).sum()
+    assert np.isclose(float(total_variation(jnp.asarray(img))), tv_ref, rtol=1e-5)
+    m = tm.TotalVariation()
+    m.update(jnp.asarray(img))
+    assert np.isclose(float(m.compute()), tv_ref, rtol=1e-5)
+
+
+# ------------------------------------------------------------------ SCC --- #
+
+def test_scc_self_correlation_is_high():
+    v_self = float(spatial_correlation_coefficient(jnp.asarray(IMG_A), jnp.asarray(IMG_A)))
+    v_noise = float(
+        spatial_correlation_coefficient(jnp.asarray(IMG_A), jnp.asarray(RNG.random(IMG_A.shape).astype(np.float32)))
+    )
+    assert v_self > 0.99
+    assert v_self > v_noise
+    m = tm.SpatialCorrelationCoefficient()
+    m.update(jnp.asarray(IMG_A), jnp.asarray(IMG_B))
+    ref = float(spatial_correlation_coefficient(jnp.asarray(IMG_A), jnp.asarray(IMG_B)))
+    assert np.isclose(float(m.compute()), ref, atol=1e-5)
+
+
+# ------------------------------------------------------------------ VIF --- #
+
+def test_vif_self_is_one():
+    a = RNG.random((1, 1, 48, 48)).astype(np.float32) * 255
+    v = float(visual_information_fidelity(jnp.asarray(a), jnp.asarray(a)))
+    assert np.isclose(v, 1.0, atol=1e-4)
+
+
+def test_vif_degrades_with_noise():
+    a = RNG.random((2, 3, 48, 48)).astype(np.float32) * 255
+    b = a + RNG.normal(0, 30, a.shape).astype(np.float32)
+    v = float(visual_information_fidelity(jnp.asarray(b), jnp.asarray(a)))
+    assert 0 < v < 1
+    m = tm.VisualInformationFidelity()
+    m.update(jnp.asarray(b), jnp.asarray(a))
+    assert np.isclose(float(m.compute()), v, atol=1e-4)
+
+
+def test_vif_size_validation():
+    with pytest.raises(ValueError, match="Invalid size"):
+        visual_information_fidelity(jnp.zeros((1, 1, 20, 20)), jnp.zeros((1, 1, 20, 20)))
+
+
+# -------------------------------------------- D_lambda / D_s / QNR -------- #
+
+def test_d_lambda_identical_is_zero():
+    v = float(spectral_distortion_index(jnp.asarray(IMG_A), jnp.asarray(IMG_A)))
+    assert np.isclose(v, 0.0, atol=1e-6)
+    m = tm.SpectralDistortionIndex()
+    m.update(jnp.asarray(IMG_A), jnp.asarray(IMG_B))
+    ref = float(spectral_distortion_index(jnp.asarray(IMG_A), jnp.asarray(IMG_B)))
+    assert np.isclose(float(m.compute()), ref, atol=1e-6)
+
+
+def test_d_s_and_qnr_run_and_bounds():
+    preds = RNG.random((2, 3, 32, 32)).astype(np.float32)
+    ms = RNG.random((2, 3, 16, 16)).astype(np.float32)
+    pan = RNG.random((2, 3, 32, 32)).astype(np.float32)
+    d_s = float(spatial_distortion_index(jnp.asarray(preds), jnp.asarray(ms), jnp.asarray(pan)))
+    assert 0 <= d_s <= 1
+    qnr = float(quality_with_no_reference(jnp.asarray(preds), jnp.asarray(ms), jnp.asarray(pan)))
+    assert 0 <= qnr <= 1
+
+    m = tm.SpatialDistortionIndex()
+    m.update(jnp.asarray(preds), {"ms": jnp.asarray(ms), "pan": jnp.asarray(pan)})
+    assert np.isclose(float(m.compute()), d_s, atol=1e-6)
+
+    m2 = tm.QualityWithNoReference()
+    m2.update(jnp.asarray(preds), {"ms": jnp.asarray(ms), "pan": jnp.asarray(pan)})
+    assert np.isclose(float(m2.compute()), qnr, atol=1e-6)
+
+
+def test_image_gradients_doctest_values():
+    img = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+    dy, dx = image_gradients(img)
+    assert np.allclose(np.asarray(dy[0, 0, :3]), 4.0)
+    assert np.allclose(np.asarray(dy[0, 0, 3]), 0.0)
+    assert np.allclose(np.asarray(dx[0, 0, :, :3]), 1.0)
+    assert np.allclose(np.asarray(dx[0, 0, :, 3]), 0.0)

@@ -20,6 +20,7 @@ from torchmetrics_tpu import (  # noqa: E402
     clustering,
     detection,
     functional,
+    image,
     nominal,
     regression,
     retrieval,
@@ -28,6 +29,8 @@ from torchmetrics_tpu import (  # noqa: E402
 )
 from torchmetrics_tpu.detection import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.detection import __all__ as _detection_all  # noqa: E402
+from torchmetrics_tpu.image import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.image import __all__ as _image_all  # noqa: E402
 from torchmetrics_tpu.clustering import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.clustering import __all__ as _clustering_all  # noqa: E402
 from torchmetrics_tpu.nominal import *  # noqa: F401,F403,E402
@@ -53,6 +56,7 @@ __all__ = [
     "clustering",
     "detection",
     "functional",
+    "image",
     "nominal",
     "regression",
     "retrieval",
@@ -63,6 +67,7 @@ __all__ = [
     *_classification_all,
     *_clustering_all,
     *_detection_all,
+    *_image_all,
     *_nominal_all,
     *_regression_all,
     *_retrieval_all,

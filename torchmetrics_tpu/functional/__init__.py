@@ -1,6 +1,8 @@
 """Stateless functional metrics (L2)."""
 
-from torchmetrics_tpu.functional import classification, clustering, detection, nominal, regression, retrieval
+from torchmetrics_tpu.functional import classification, clustering, detection, image, nominal, regression, retrieval
+from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.image import __all__ as _image_all
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.classification import __all__ as _classification_all
 from torchmetrics_tpu.functional.detection import *  # noqa: F401,F403
@@ -18,10 +20,12 @@ __all__ = [
     "classification",
     "clustering",
     "detection",
+    "image",
     "nominal",
     "regression",
     "retrieval",
     *_classification_all,
+    *_image_all,
     *_clustering_all,
     *_detection_all,
     *_nominal_all,

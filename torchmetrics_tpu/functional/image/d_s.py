@@ -1,0 +1,119 @@
+"""Spatial Distortion Index D_s (reference ``functional/image/d_s.py``).
+
+The reference degrades the panchromatic image with torchvision's resize;
+here the degradation is a uniform filter + ``jax.image.resize`` (bilinear,
+half-pixel centers — the same sampling convention torchvision uses with
+``antialias=False``).
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.helper import _uniform_filter2d
+from torchmetrics_tpu.functional.image.misc import universal_image_quality_index
+
+Array = jax.Array
+
+
+def _spatial_distortion_index_update(
+    preds: Array, ms: Array, pan: Array, pan_lr: Optional[Array] = None
+) -> Tuple[Array, Array, Array, Optional[Array]]:
+    """Validate D_s inputs (shape/rank/divisibility rules of the reference)."""
+    preds = jnp.asarray(preds, jnp.float32)
+    ms = jnp.asarray(ms, jnp.float32)
+    pan = jnp.asarray(pan, jnp.float32)
+    pan_lr = None if pan_lr is None else jnp.asarray(pan_lr, jnp.float32)
+
+    if preds.ndim != 4:
+        raise ValueError(f"Expected `preds` to have BxCxHxW shape. Got preds: {preds.shape}.")
+    if ms.ndim != 4:
+        raise ValueError(f"Expected `ms` to have BxCxHxW shape. Got ms: {ms.shape}.")
+    if pan.ndim != 4:
+        raise ValueError(f"Expected `pan` to have BxCxHxW shape. Got pan: {pan.shape}.")
+    if pan_lr is not None and pan_lr.ndim != 4:
+        raise ValueError(f"Expected `pan_lr` to have BxCxHxW shape. Got pan_lr: {pan_lr.shape}.")
+    if preds.shape[:2] != ms.shape[:2]:
+        raise ValueError(
+            f"Expected `preds` and `ms` to have the same batch and channel sizes."
+            f" Got preds: {preds.shape} and ms: {ms.shape}."
+        )
+    if preds.shape[:2] != pan.shape[:2]:
+        raise ValueError(
+            f"Expected `preds` and `pan` to have the same batch and channel sizes."
+            f" Got preds: {preds.shape} and pan: {pan.shape}."
+        )
+    preds_h, preds_w = preds.shape[-2:]
+    ms_h, ms_w = ms.shape[-2:]
+    pan_h, pan_w = pan.shape[-2:]
+    if (preds_h, preds_w) != (pan_h, pan_w):
+        raise ValueError(f"Expected `preds` and `pan` to have the same size. Got {preds.shape} and {pan.shape}")
+    if preds_h % ms_h != 0 or preds_w % ms_w != 0:
+        raise ValueError(
+            f"Expected dimensions of `preds` to be multiples of those of `ms`. Got preds: {preds.shape}, ms: {ms.shape}."
+        )
+    if pan_lr is not None and pan_lr.shape[-2:] != (ms_h, ms_w):
+        raise ValueError(f"Expected `ms` and `pan_lr` to have the same size. Got {ms.shape} and {pan_lr.shape}.")
+    return preds, ms, pan, pan_lr
+
+
+def _spatial_distortion_index_compute(
+    preds: Array,
+    ms: Array,
+    pan: Array,
+    pan_lr: Optional[Array] = None,
+    norm_order: int = 1,
+    window_size: int = 7,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Compute D_s from validated inputs."""
+    length = preds.shape[1]
+    ms_h, ms_w = ms.shape[-2:]
+    if window_size >= ms_h or window_size >= ms_w:
+        raise ValueError(
+            f"Expected `window_size` to be smaller than dimension of `ms`. Got window_size: {window_size}."
+        )
+
+    if pan_lr is None:
+        pad = (window_size - 1) // 2
+        pan_p = jnp.pad(pan, ((0, 0), (0, 0), (pad, window_size - 1 - pad), (pad, window_size - 1 - pad)), mode="edge")
+        pan_degraded = _uniform_filter2d(pan_p, (window_size, window_size))
+        pan_degraded = jax.image.resize(
+            pan_degraded, (*pan.shape[:2], ms_h, ms_w), method="bilinear"
+        )
+    else:
+        pan_degraded = pan_lr
+
+    m1 = jnp.stack(
+        [universal_image_quality_index(ms[:, i : i + 1], pan_degraded[:, i : i + 1]) for i in range(length)]
+    )
+    m2 = jnp.stack(
+        [universal_image_quality_index(preds[:, i : i + 1], pan[:, i : i + 1]) for i in range(length)]
+    )
+    diff = jnp.abs(m1 - m2) ** norm_order
+    if reduction == "elementwise_mean":
+        red = jnp.mean(diff)
+    elif reduction == "sum":
+        red = jnp.sum(diff)
+    else:
+        red = diff
+    return red ** (1 / norm_order)
+
+
+def spatial_distortion_index(
+    preds: Array,
+    ms: Array,
+    pan: Array,
+    pan_lr: Optional[Array] = None,
+    norm_order: int = 1,
+    window_size: int = 7,
+    reduction: str = "elementwise_mean",
+) -> Array:
+    """Spatial Distortion Index (D_s) for pan-sharpening quality."""
+    if norm_order <= 0:
+        raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+    preds, ms, pan, pan_lr = _spatial_distortion_index_update(preds, ms, pan, pan_lr)
+    return _spatial_distortion_index_compute(preds, ms, pan, pan_lr, norm_order, window_size, reduction)

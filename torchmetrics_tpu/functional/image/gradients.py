@@ -1,0 +1,37 @@
+"""Image gradients (reference ``functional/image/gradients.py``)."""
+
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def image_gradients(img: Array) -> Tuple[Array, Array]:
+    """Compute (dy, dx) finite-difference gradients of ``(N, C, H, W)`` images.
+
+    The last row of ``dy`` and last column of ``dx`` are zero, matching the
+    reference (and TensorFlow's) convention.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.image import image_gradients
+        >>> img = jnp.arange(16, dtype=jnp.float32).reshape(1, 1, 4, 4)
+        >>> dy, dx = image_gradients(img)
+        >>> dy[0, 0, :, :]
+        Array([[4., 4., 4., 4.],
+               [4., 4., 4., 4.],
+               [4., 4., 4., 4.],
+               [0., 0., 0., 0.]], dtype=float32)
+    """
+    img = jnp.asarray(img)
+    if img.ndim != 4:
+        raise RuntimeError(f"expected 4D tensor as input, got {img.ndim}D input instead")
+    dy = img[..., 1:, :] - img[..., :-1, :]
+    dx = img[..., :, 1:] - img[..., :, :-1]
+    dy = jnp.pad(dy, ((0, 0), (0, 0), (0, 1), (0, 0)))
+    dx = jnp.pad(dx, ((0, 0), (0, 0), (0, 0), (0, 1)))
+    return dy, dx

@@ -132,13 +132,19 @@ def root_mean_squared_error_using_sliding_window(
     preds: Array,
     target: Array,
     window_size: int = 8,
+    reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
     """RMSE over sliding windows (N,C,H,W)."""
     preds, target = _check_image_pair(preds, target)
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
     rmse_map, _ = _rmse_sw_maps(preds, target, window_size)
-    return jnp.mean(rmse_map)
+    per_image = rmse_map.reshape(rmse_map.shape[0], -1).mean(axis=-1)
+    if reduction == "elementwise_mean":
+        return jnp.mean(per_image)
+    if reduction == "sum":
+        return jnp.sum(per_image)
+    return per_image
 
 
 def total_variation(img: Array, reduction: Optional[str] = "sum") -> Array:
@@ -171,6 +177,7 @@ def spatial_correlation_coefficient(
     target: Array,
     hp_filter: Optional[Array] = None,
     window_size: int = 8,
+    reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
     """Spatial correlation coefficient with a high-pass Laplacian pre-filter."""
     preds, target = _check_image_pair(preds, target)
@@ -193,7 +200,12 @@ def spatial_correlation_coefficient(
 
     denom = jnp.sqrt(jnp.clip(var_x, min=0.0)) * jnp.sqrt(jnp.clip(var_y, min=0.0))
     scc_map = jnp.where(denom > 1e-10, cov_xy / jnp.where(denom > 1e-10, denom, 1.0), 0.0)
-    return jnp.mean(scc_map)
+    per_image = scc_map.reshape(scc_map.shape[0], -1).mean(axis=-1)
+    if reduction == "elementwise_mean":
+        return jnp.mean(per_image)
+    if reduction == "sum":
+        return jnp.sum(per_image)
+    return per_image
 
 
 def spectral_distortion_index(
@@ -202,11 +214,24 @@ def spectral_distortion_index(
     p: int = 1,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """D_lambda spectral distortion index for pan-sharpening (N,C,H,W)."""
+    """D_lambda spectral distortion index for pan-sharpening (N,C,H,W).
+
+    ``preds`` and ``target`` may differ in spatial size (the reference only
+    requires matching batch/channel dims — UQI is computed within each image
+    between channel pairs).
+    """
     uqi = universal_image_quality_index
-    preds, target = _check_image_pair(preds, target)
-    if preds.ndim != 4:
-        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape, got {preds.shape}")
+    preds = jnp.asarray(preds, jnp.float32)
+    target = jnp.asarray(target, jnp.float32)
+    if preds.ndim != 4 or target.ndim != 4:
+        raise ValueError(
+            f"Expected `preds` and `target` to have BxCxHxW shape, got {preds.shape} and {target.shape}"
+        )
+    if preds.shape[:2] != target.shape[:2]:
+        raise ValueError(
+            "Expected `preds` and `target` to have same batch and channel sizes."
+            f"Got preds: {preds.shape} and target: {target.shape}."
+        )
     length = preds.shape[1]
     if length < 2:
         raise ValueError("Expected at least 2 spectral bands")

@@ -1,0 +1,48 @@
+"""Image metrics (reference ``image/__init__.py``)."""
+
+from torchmetrics_tpu.image.d_lambda import SpectralDistortionIndex
+from torchmetrics_tpu.image.d_s import SpatialDistortionIndex
+from torchmetrics_tpu.image.ergas import ErrorRelativeGlobalDimensionlessSynthesis
+from torchmetrics_tpu.image.fid import FrechetInceptionDistance
+from torchmetrics_tpu.image.inception import InceptionScore
+from torchmetrics_tpu.image.kid import KernelInceptionDistance
+from torchmetrics_tpu.image.lpip import LearnedPerceptualImagePatchSimilarity
+from torchmetrics_tpu.image.mifid import MemorizationInformedFrechetInceptionDistance
+from torchmetrics_tpu.image.perceptual_path_length import PerceptualPathLength
+from torchmetrics_tpu.image.psnr import PeakSignalNoiseRatio, PeakSignalNoiseRatioWithBlockedEffect
+from torchmetrics_tpu.image.qnr import QualityWithNoReference
+from torchmetrics_tpu.image.rase import RelativeAverageSpectralError
+from torchmetrics_tpu.image.rmse_sw import RootMeanSquaredErrorUsingSlidingWindow
+from torchmetrics_tpu.image.sam import SpectralAngleMapper
+from torchmetrics_tpu.image.scc import SpatialCorrelationCoefficient
+from torchmetrics_tpu.image.ssim import (
+    MultiScaleStructuralSimilarityIndexMeasure,
+    StructuralSimilarityIndexMeasure,
+)
+from torchmetrics_tpu.image.tv import TotalVariation
+from torchmetrics_tpu.image.uqi import UniversalImageQualityIndex
+from torchmetrics_tpu.image.vif import VisualInformationFidelity
+
+__all__ = [
+    "ErrorRelativeGlobalDimensionlessSynthesis",
+    "FrechetInceptionDistance",
+    "InceptionScore",
+    "KernelInceptionDistance",
+    "LearnedPerceptualImagePatchSimilarity",
+    "MemorizationInformedFrechetInceptionDistance",
+    "MultiScaleStructuralSimilarityIndexMeasure",
+    "PeakSignalNoiseRatio",
+    "PeakSignalNoiseRatioWithBlockedEffect",
+    "PerceptualPathLength",
+    "QualityWithNoReference",
+    "RelativeAverageSpectralError",
+    "RootMeanSquaredErrorUsingSlidingWindow",
+    "SpatialCorrelationCoefficient",
+    "SpatialDistortionIndex",
+    "SpectralAngleMapper",
+    "SpectralDistortionIndex",
+    "StructuralSimilarityIndexMeasure",
+    "TotalVariation",
+    "UniversalImageQualityIndex",
+    "VisualInformationFidelity",
+]

@@ -1,0 +1,109 @@
+"""LPIPS network in Flax (reference ``functional/image/lpips.py`` port layout).
+
+VGG16 trunk + learned 1x1 linear heads over unit-normalized feature
+differences. Pretrained trunk/head weights cannot be downloaded in this
+environment; parameters initialize randomly and can be loaded from a
+converted ``.npz`` (same flattened format as ``_inception.load_params_npz``).
+The LPIPS *computation graph* (scaling, normalization, head weighting,
+spatial averaging) matches the reference.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Sequence
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+# ImageNet scaling constants used by LPIPS (reference ScalingLayer)
+_SHIFT = (-0.030, -0.088, -0.188)
+_SCALE = (0.458, 0.448, 0.450)
+
+_VGG16_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512)
+# taps after relu1_2, relu2_2, relu3_3, relu4_3, relu5_3
+_VGG_TAPS = (1, 3, 6, 9, 12)
+_VGG_CHANNELS = (64, 128, 256, 512, 512)
+
+
+class VGG16Features(nn.Module):
+    """VGG16 conv trunk returning the 5 LPIPS feature taps."""
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        taps = []
+        conv_idx = 0
+        for v in _VGG16_CFG:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(v, (3, 3), padding=((1, 1), (1, 1)))(x)
+                x = nn.relu(x)
+                if conv_idx in _VGG_TAPS:
+                    taps.append(x)
+                conv_idx += 1
+        return taps
+
+
+def _normalize_tensor(x: Array, eps: float = 1e-10) -> Array:
+    norm = jnp.sqrt(jnp.sum(x**2, axis=-1, keepdims=True))
+    return x / (norm + eps)
+
+
+class LPIPSNet(nn.Module):
+    """Full LPIPS: trunk + per-tap linear heads, spatial-averaged and summed."""
+
+    @nn.compact
+    def __call__(self, img0: Array, img1: Array) -> Array:
+        # imgs: (N, 3, H, W) in [-1, 1] -> NHWC, ImageNet scaling
+        shift = jnp.asarray(_SHIFT).reshape(1, 1, 1, 3)
+        scale = jnp.asarray(_SCALE).reshape(1, 1, 1, 3)
+        x0 = (jnp.transpose(img0, (0, 2, 3, 1)) - shift) / scale
+        x1 = (jnp.transpose(img1, (0, 2, 3, 1)) - shift) / scale
+
+        trunk = VGG16Features(name="net")
+        feats0 = trunk(x0)
+        feats1 = trunk(x1)
+
+        total = 0.0
+        for i, (f0, f1) in enumerate(zip(feats0, feats1)):
+            d = (_normalize_tensor(f0) - _normalize_tensor(f1)) ** 2
+            lin = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}")(d)
+            total = total + jnp.mean(lin, axis=(1, 2, 3))
+        return total
+
+
+class LPIPSExtractor:
+    """Stateful wrapper with jit-compiled forward and optional weight loading."""
+
+    def __init__(self, net_type: str = "vgg", weights_path: str = None, seed: int = 0) -> None:
+        if net_type not in ("vgg", "alex", "squeeze"):
+            raise ValueError(f"Argument `net_type` must be one of 'vgg', 'alex' or 'squeeze', but got {net_type}")
+        if net_type != "vgg":
+            from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                f"net_type='{net_type}' falls back to the VGG trunk in this implementation;"
+                " pass a custom `net` callable for other trunks."
+            )
+        self.net = LPIPSNet()
+        dummy = jnp.zeros((1, 3, 64, 64), jnp.float32)
+        if weights_path:
+            from torchmetrics_tpu.image._inception import load_params_npz
+
+            self.variables = {"params": load_params_npz(weights_path)}
+        else:
+            from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(
+                "LPIPS network initialized with random weights (no `weights_path` given; this environment"
+                " cannot download pretrained checkpoints). Scores will not match the published LPIPS metric;"
+                " pass converted weights or a custom `net` callable for real use."
+            )
+            self.variables = self.net.init(jax.random.PRNGKey(seed), dummy, dummy)
+        self._forward = jax.jit(lambda v, a, b: self.net.apply(v, a, b))
+
+    def __call__(self, img0: Array, img1: Array) -> Array:
+        return self._forward(self.variables, img0, img1)

@@ -1,0 +1,53 @@
+"""Modular SpectralDistortionIndex (reference ``image/d_lambda.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.misc import spectral_distortion_index
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class SpectralDistortionIndex(Metric):
+    """D_lambda spectral distortion index over streaming batches."""
+
+    higher_is_better: bool = False
+    is_differentiable: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, p: int = 1, reduction: str = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(p, int) and p > 0):
+            raise ValueError(f"Expected `p` to be a positive integer. Got p: {p}.")
+        allowed_reductions = ("elementwise_mean", "sum", "none")
+        if reduction not in allowed_reductions:
+            raise ValueError(f"Expected argument `reduction` be one of {allowed_reductions} but got {reduction}")
+        self.p = p
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append batch images."""
+        preds = jnp.asarray(preds, jnp.float32)
+        target = jnp.asarray(target, jnp.float32)
+        if preds.shape != target.shape:
+            raise ValueError(
+                f"Expected `preds` and `target` to have the same shape, got {preds.shape} and {target.shape}"
+            )
+        self.preds.append(preds)
+        self.target.append(target)
+
+    def compute(self) -> Array:
+        """D_lambda over all accumulated images."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return spectral_distortion_index(preds, target, self.p, self.reduction)

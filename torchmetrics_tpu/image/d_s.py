@@ -1,0 +1,79 @@
+"""Modular SpatialDistortionIndex (reference ``image/d_s.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.d_s import (
+    _spatial_distortion_index_compute,
+    _spatial_distortion_index_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class SpatialDistortionIndex(Metric):
+    """D_s spatial distortion index over streaming batches.
+
+    ``update(preds, target)`` takes ``target`` as a dict with keys ``ms``,
+    ``pan`` and optionally ``pan_lr`` (the reference protocol).
+    """
+
+    higher_is_better: bool = False
+    is_differentiable: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        norm_order: int = 1,
+        window_size: int = 7,
+        reduction: str = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(norm_order, int) and norm_order > 0):
+            raise ValueError(f"Expected `norm_order` to be a positive integer. Got norm_order: {norm_order}.")
+        if not (isinstance(window_size, int) and window_size > 0):
+            raise ValueError(f"Expected `window_size` to be a positive integer. Got window_size: {window_size}.")
+        allowed_reductions = ("elementwise_mean", "sum", "none")
+        if reduction not in allowed_reductions:
+            raise ValueError(f"Expected argument `reduction` be one of {allowed_reductions} but got {reduction}")
+        self.norm_order = norm_order
+        self.window_size = window_size
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("ms", default=[], dist_reduce_fx="cat")
+        self.add_state("pan", default=[], dist_reduce_fx="cat")
+        self.add_state("pan_lr", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Dict[str, Array]) -> None:
+        """Append a batch of (preds, {ms, pan[, pan_lr]})."""
+        if "ms" not in target:
+            raise ValueError(f"Expected `target` to contain the key `ms`. Got target: {target.keys()}.")
+        if "pan" not in target:
+            raise ValueError(f"Expected `target` to contain the key `pan`. Got target: {target.keys()}.")
+        preds, ms, pan, pan_lr = _spatial_distortion_index_update(
+            preds, target["ms"], target["pan"], target.get("pan_lr")
+        )
+        self.preds.append(preds)
+        self.ms.append(ms)
+        self.pan.append(pan)
+        if pan_lr is not None:
+            self.pan_lr.append(pan_lr)
+
+    def compute(self) -> Array:
+        """D_s over all accumulated images."""
+        preds = dim_zero_cat(self.preds)
+        ms = dim_zero_cat(self.ms)
+        pan = dim_zero_cat(self.pan)
+        pan_lr = dim_zero_cat(self.pan_lr) if len(self.pan_lr) > 0 else None
+        return _spatial_distortion_index_compute(
+            preds, ms, pan, pan_lr, self.norm_order, self.window_size, self.reduction
+        )

@@ -1,0 +1,41 @@
+"""Modular ERGAS (reference ``image/ergas.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.misc import error_relative_global_dimensionless_synthesis
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class ErrorRelativeGlobalDimensionlessSynthesis(Metric):
+    """ERGAS over streaming batches (cat states, computed at epoch end)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, ratio: float = 4, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.ratio = ratio
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append batch images."""
+        self.preds.append(jnp.asarray(preds, jnp.float32))
+        self.target.append(jnp.asarray(target, jnp.float32))
+
+    def compute(self) -> Array:
+        """ERGAS over all accumulated images."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return error_relative_global_dimensionless_synthesis(preds, target, self.ratio, self.reduction)

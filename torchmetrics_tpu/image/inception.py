@@ -1,0 +1,84 @@
+"""Inception Score (reference ``image/inception.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class InceptionScore(Metric):
+    """Inception Score of generated images: ``exp(E_x KL(p(y|x) || p(y)))``.
+
+    ``feature`` is ``'logits_unbiased'`` (built-in InceptionV3) or a callable
+    returning per-image class logits.
+    """
+
+    higher_is_better: bool = True
+    is_differentiable: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        feature: Union[str, int, Callable] = "logits_unbiased",
+        splits: int = 10,
+        normalize: bool = False,
+        weights_path: str = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(feature, (str, int)):
+            valid_input = ("logits_unbiased", 64, 192, 768, 2048)
+            if feature not in valid_input:
+                raise ValueError(
+                    f"Input to argument `feature` must be one of {valid_input}, but got {feature}."
+                )
+            from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
+
+            self.inception = InceptionFeatureExtractor(feature=feature, weights_path=weights_path)
+        elif callable(feature):
+            self.inception = feature
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        self.normalize = normalize
+        self.splits = splits
+        self.add_state("features", default=[], dist_reduce_fx=None)
+
+    def update(self, imgs: Array) -> None:
+        """Extract and store per-image logits."""
+        features = jnp.asarray(self.inception(imgs), jnp.float32)
+        self.features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """(mean, std) of the per-split inception scores."""
+        features = dim_zero_cat(self.features)
+        # random permutation like the reference (torch.randperm) for split
+        # de-correlation; seeded for determinism under jit-free host code
+        import numpy as np
+
+        idx = np.random.permutation(features.shape[0])
+        features = features[jnp.asarray(idx)]
+
+        prob = jax.nn.softmax(features, axis=1)
+        log_prob = jax.nn.log_softmax(features, axis=1)
+
+        n = prob.shape[0]
+        split_size = n // self.splits
+        kl_means = []
+        for k in range(self.splits):
+            p = prob[k * split_size : (k + 1) * split_size]
+            lp = log_prob[k * split_size : (k + 1) * split_size]
+            mean_prob = jnp.mean(p, axis=0, keepdims=True)
+            kl = p * (lp - jnp.log(jnp.maximum(mean_prob, 1e-10)))
+            kl_means.append(jnp.exp(jnp.sum(kl, axis=1).mean()))
+        kl_arr = jnp.stack(kl_means)
+        return kl_arr.mean(), kl_arr.std(ddof=1) if kl_arr.size > 1 else jnp.asarray(0.0)

@@ -1,0 +1,138 @@
+"""Kernel Inception Distance (reference ``image/kid.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def poly_kernel(f1: Array, f2: Array, degree: int = 3, gamma: Optional[float] = None, coef: float = 1.0) -> Array:
+    """Polynomial kernel matrix between two feature sets."""
+    if gamma is None:
+        gamma = 1.0 / f1.shape[1]
+    return (f1 @ f2.T * gamma + coef) ** degree
+
+
+def maximum_mean_discrepancy(k_xx: Array, k_xy: Array, k_yy: Array) -> Array:
+    """Unbiased MMD² estimate from kernel matrices."""
+    m = k_xx.shape[0]
+    diag_x = jnp.diagonal(k_xx)
+    diag_y = jnp.diagonal(k_yy)
+    kt_xx_sum = (k_xx.sum(axis=-1) - diag_x).sum()
+    kt_yy_sum = (k_yy.sum(axis=-1) - diag_y).sum()
+    k_xy_sum = k_xy.sum()
+    value = (kt_xx_sum + kt_yy_sum) / (m * (m - 1))
+    value = value - 2 * k_xy_sum / (m**2)
+    return value
+
+
+class KernelInceptionDistance(Metric):
+    """KID: polynomial-kernel MMD between real and generated features.
+
+    States are per-image feature cat-lists (the estimator needs raw feature
+    subsets). ``feature`` is an int tap or a callable like for FID.
+    """
+
+    higher_is_better: bool = False
+    is_differentiable: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        feature: Union[str, int, Callable] = 2048,
+        subsets: int = 100,
+        subset_size: int = 1000,
+        degree: int = 3,
+        gamma: Optional[float] = None,
+        coef: float = 1.0,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        weights_path: str = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(feature, (str, int)):
+            from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
+
+            self.inception = InceptionFeatureExtractor(feature=feature, weights_path=weights_path)
+        elif callable(feature):
+            self.inception = feature
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+
+        if not (isinstance(subsets, int) and subsets > 0):
+            raise ValueError("Argument `subsets` expected to be integer larger than 0")
+        if not (isinstance(subset_size, int) and subset_size > 0):
+            raise ValueError("Argument `subset_size` expected to be integer larger than 0")
+        if not (isinstance(degree, int) and degree > 0):
+            raise ValueError("Argument `degree` expected to be integer larger than 0")
+        if gamma is not None and not (isinstance(gamma, float) and gamma > 0):
+            raise ValueError("Argument `gamma` expected to be `None` or float larger than 0")
+        if not (isinstance(coef, float) and coef > 0):
+            raise ValueError("Argument `coef` expected to be float larger than 0")
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+
+        self.subsets = subsets
+        self.subset_size = subset_size
+        self.degree = degree
+        self.gamma = gamma
+        self.coef = coef
+        self.reset_real_features = reset_real_features
+        self.normalize = normalize
+
+        self.add_state("real_features", default=[], dist_reduce_fx=None)
+        self.add_state("fake_features", default=[], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Extract and store features for a batch."""
+        features = jnp.asarray(self.inception(imgs), jnp.float32)
+        if real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    def compute(self) -> Tuple[Array, Array]:
+        """(mean, std) of MMD² over random feature subsets."""
+        real_features = dim_zero_cat(self.real_features)
+        fake_features = dim_zero_cat(self.fake_features)
+        n_samples_real = real_features.shape[0]
+        if n_samples_real < self.subset_size:
+            raise ValueError("Argument `subset_size` should be smaller than the number of samples")
+        n_samples_fake = fake_features.shape[0]
+        if n_samples_fake < self.subset_size:
+            raise ValueError("Argument `subset_size` should be smaller than the number of samples")
+
+        kid_scores = []
+        for _ in range(self.subsets):
+            perm = np.random.permutation(n_samples_real)[: self.subset_size]
+            f_real = real_features[jnp.asarray(perm)]
+            perm = np.random.permutation(n_samples_fake)[: self.subset_size]
+            f_fake = fake_features[jnp.asarray(perm)]
+
+            k_xx = poly_kernel(f_real, f_real, self.degree, self.gamma, self.coef)
+            k_xy = poly_kernel(f_real, f_fake, self.degree, self.gamma, self.coef)
+            k_yy = poly_kernel(f_fake, f_fake, self.degree, self.gamma, self.coef)
+            kid_scores.append(maximum_mean_discrepancy(k_xx, k_xy, k_yy))
+        kid = jnp.stack(kid_scores)
+        return kid.mean(), kid.std(ddof=1) if kid.size > 1 else jnp.asarray(0.0)
+
+    def reset(self) -> None:
+        """Reset; keeps real features when ``reset_real_features=False``."""
+        if not self.reset_real_features:
+            real = self.real_features
+            super().reset()
+            self.real_features = real
+        else:
+            super().reset()

@@ -1,0 +1,77 @@
+"""Modular LPIPS metric (reference ``image/lpip.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class LearnedPerceptualImagePatchSimilarity(Metric):
+    """LPIPS over streaming image pairs.
+
+    Args:
+        net_type: 'vgg' | 'alex' | 'squeeze' for the built-in trunk, or pass
+            ``net`` — any callable ``(img1, img2) -> (N,)`` distances.
+        reduction: 'mean' or 'sum' over accumulated scores.
+        normalize: if True inputs are [0, 1] and get rescaled to [-1, 1].
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        net_type: str = "alex",
+        reduction: str = "mean",
+        normalize: bool = False,
+        net: Optional[Callable] = None,
+        weights_path: str = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_net_type = ("vgg", "alex", "squeeze")
+        if net is None and net_type not in valid_net_type:
+            raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+        if net is not None:
+            self.net = net
+        else:
+            from torchmetrics_tpu.image._lpips import LPIPSExtractor
+
+            self.net = LPIPSExtractor(net_type=net_type, weights_path=weights_path)
+
+        valid_reduction = ("mean", "sum")
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        self.reduction = reduction
+        if not isinstance(normalize, bool):
+            raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+        self.normalize = normalize
+
+        self.add_state("sum_scores", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, img1: Array, img2: Array) -> None:
+        """Accumulate LPIPS distances for a batch of image pairs."""
+        img1 = jnp.asarray(img1, jnp.float32)
+        img2 = jnp.asarray(img2, jnp.float32)
+        if self.normalize:
+            img1 = 2 * img1 - 1
+            img2 = 2 * img2 - 1
+        loss = jnp.asarray(self.net(img1, img2)).reshape(-1)
+        self.sum_scores = self.sum_scores + jnp.sum(loss)
+        self.total = self.total + loss.shape[0]
+
+    def compute(self) -> Array:
+        """Aggregate LPIPS over all batches."""
+        if self.reduction == "mean":
+            return self.sum_scores / self.total
+        return self.sum_scores

@@ -1,0 +1,96 @@
+"""Memorization-Informed FID (reference ``image/mifid.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.image.fid import _compute_fid
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+def _compute_cosine_distance(features1: Array, features2: Array, cosine_distance_eps: float = 0.1) -> Array:
+    """Mean of per-fake-sample thresholded minimal cosine distance to real set."""
+    f1 = features1 / jnp.maximum(jnp.linalg.norm(features1, axis=1, keepdims=True), 1e-12)
+    f2 = features2 / jnp.maximum(jnp.linalg.norm(features2, axis=1, keepdims=True), 1e-12)
+    d = 1.0 - jnp.abs(f1 @ f2.T)
+    mean_min_d = jnp.mean(jnp.min(d, axis=1))
+    return jnp.where(mean_min_d < cosine_distance_eps, mean_min_d, 1.0)
+
+
+class MemorizationInformedFrechetInceptionDistance(Metric):
+    """MiFID: FID penalized by train-set memorization (cosine distance)."""
+
+    higher_is_better: bool = False
+    is_differentiable: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        feature: Union[int, Callable] = 2048,
+        reset_real_features: bool = True,
+        normalize: bool = False,
+        cosine_distance_eps: float = 0.1,
+        weights_path: str = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if isinstance(feature, int):
+            valid_int_input = (64, 192, 768, 2048)
+            if feature not in valid_int_input:
+                raise ValueError(
+                    f"Integer input to argument `feature` must be one of {valid_int_input}, but got {feature}."
+                )
+            from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
+
+            self.inception = InceptionFeatureExtractor(feature=feature, weights_path=weights_path)
+        elif callable(feature):
+            self.inception = feature
+        else:
+            raise TypeError("Got unknown input to argument `feature`")
+        if not isinstance(reset_real_features, bool):
+            raise ValueError("Argument `reset_real_features` expected to be a bool")
+        if not isinstance(normalize, bool):
+            raise ValueError("Argument `normalize` expected to be a bool")
+        if not (isinstance(cosine_distance_eps, float) and 1 >= cosine_distance_eps > 0):
+            raise ValueError("Argument `cosine_distance_eps` expected to be a float greater than 0 and less or equal to 1")
+        self.reset_real_features = reset_real_features
+        self.normalize = normalize
+        self.cosine_distance_eps = cosine_distance_eps
+
+        self.add_state("real_features", default=[], dist_reduce_fx=None)
+        self.add_state("fake_features", default=[], dist_reduce_fx=None)
+
+    def update(self, imgs: Array, real: bool) -> None:
+        """Extract and store features for a batch."""
+        features = jnp.asarray(self.inception(imgs), jnp.float32)
+        if real:
+            self.real_features.append(features)
+        else:
+            self.fake_features.append(features)
+
+    def compute(self) -> Array:
+        """MiFID = FID / (memorization distance + eps)."""
+        real_features = dim_zero_cat(self.real_features)
+        fake_features = dim_zero_cat(self.fake_features)
+
+        mu1, sigma1 = jnp.mean(real_features, axis=0), jnp.cov(real_features.T)
+        mu2, sigma2 = jnp.mean(fake_features, axis=0), jnp.cov(fake_features.T)
+        fid = _compute_fid(mu1, sigma1, mu2, sigma2)
+        distance = _compute_cosine_distance(fake_features, real_features, self.cosine_distance_eps)
+        return fid / (distance + 1e-15)
+
+    def reset(self) -> None:
+        """Reset; keeps real features when ``reset_real_features=False``."""
+        if not self.reset_real_features:
+            real = self.real_features
+            super().reset()
+            self.real_features = real
+        else:
+            super().reset()

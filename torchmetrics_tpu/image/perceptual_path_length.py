@@ -1,0 +1,171 @@
+"""Perceptual Path Length (reference ``image/perceptual_path_length.py``).
+
+PPL measures the smoothness of a generator's latent space: perceptual
+distances between images generated from epsilon-separated latent
+interpolations, divided by epsilon².
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+def _validate_generator_model(generator: Any, conditional: bool = False) -> None:
+    if not hasattr(generator, "sample"):
+        raise NotImplementedError(
+            "The generator must have a `sample` method with signature `sample(num_samples: int)`"
+        )
+    if not callable(generator):
+        raise NotImplementedError("The generator must be callable: `generator(z[, labels]) -> images`")
+    if conditional and not hasattr(generator, "num_classes"):
+        raise AttributeError("The generator must have a `num_classes` attribute when `conditional=True`")
+
+
+def _interpolate(latents1: Array, latents2: Array, epsilon: float, interpolation_method: str) -> Array:
+    """Move ``latents1`` an epsilon step towards ``latents2``."""
+    eps = epsilon
+    if interpolation_method == "lerp":
+        return latents1 + (latents2 - latents1) * eps
+    if interpolation_method in ("slerp_any", "slerp_unit"):
+        a = latents1 / jnp.maximum(jnp.linalg.norm(latents1, axis=-1, keepdims=True), 1e-12)
+        b = latents2 / jnp.maximum(jnp.linalg.norm(latents2, axis=-1, keepdims=True), 1e-12)
+        d = jnp.sum(a * b, axis=-1, keepdims=True)
+        p = eps * jnp.arccos(jnp.clip(d, -1 + 1e-7, 1 - 1e-7))
+        c = b - d * a
+        c = c / jnp.maximum(jnp.linalg.norm(c, axis=-1, keepdims=True), 1e-12)
+        interp = a * jnp.cos(p) + c * jnp.sin(p)
+        if interpolation_method == "slerp_any":
+            interp = interp * jnp.linalg.norm(latents1, axis=-1, keepdims=True)
+        return interp
+    raise ValueError(f"Interpolation method {interpolation_method} not supported.")
+
+
+def perceptual_path_length(
+    generator: Any,
+    num_samples: int = 10_000,
+    conditional: bool = False,
+    batch_size: int = 64,
+    interpolation_method: str = "lerp",
+    epsilon: float = 1e-4,
+    resize: Optional[int] = 64,
+    lower_discard: Optional[float] = 0.01,
+    upper_discard: Optional[float] = 0.99,
+    sim_net: Union[Callable, None] = None,
+    seed: int = 42,
+) -> Tuple[Array, Array, Array]:
+    """Compute PPL: returns (mean, std, raw distances)."""
+    _validate_generator_model(generator, conditional)
+    if not (isinstance(num_samples, int) and num_samples > 0):
+        raise ValueError(f"Argument `num_samples` must be a positive integer, but got {num_samples}.")
+    if not (isinstance(batch_size, int) and batch_size > 0):
+        raise ValueError(f"Argument `batch_size` must be a positive integer, but got {batch_size}.")
+    if interpolation_method not in ("lerp", "slerp_any", "slerp_unit"):
+        raise ValueError(f"Argument `interpolation_method` must be one of 'lerp', 'slerp_any', 'slerp_unit'.")
+    if not (isinstance(epsilon, float) and epsilon > 0):
+        raise ValueError(f"Argument `epsilon` must be a positive float, but got {epsilon}.")
+    for name, v in (("lower_discard", lower_discard), ("upper_discard", upper_discard)):
+        if v is not None and not (isinstance(v, float) and 0 <= v <= 1):
+            raise ValueError(f"Argument `{name}` must be a float in [0, 1] or None, but got {v}.")
+
+    if sim_net is None:
+        from torchmetrics_tpu.image._lpips import LPIPSExtractor
+
+        sim_net = LPIPSExtractor(net_type="vgg")
+
+    rng = np.random.default_rng(seed)
+    distances = []
+    num_batches = int(np.ceil(num_samples / batch_size))
+    for _ in range(num_batches):
+        latents1 = jnp.asarray(generator.sample(batch_size))
+        latents2 = jnp.asarray(generator.sample(batch_size))
+        latents2_eps = _interpolate(latents1, latents2, epsilon, interpolation_method)
+
+        if conditional:
+            labels = jnp.asarray(rng.integers(0, generator.num_classes, batch_size))
+            imgs1 = generator(latents1, labels)
+            imgs2 = generator(latents2_eps, labels)
+        else:
+            imgs1 = generator(latents1)
+            imgs2 = generator(latents2_eps)
+        imgs1 = jnp.asarray(imgs1, jnp.float32)
+        imgs2 = jnp.asarray(imgs2, jnp.float32)
+        if resize is not None:
+            shape = (imgs1.shape[0], imgs1.shape[1], resize, resize)
+            imgs1 = jax.image.resize(imgs1, shape, method="bilinear")
+            imgs2 = jax.image.resize(imgs2, shape, method="bilinear")
+        d = jnp.asarray(sim_net(imgs1, imgs2)).reshape(-1) / (epsilon**2)
+        distances.append(d)
+    distances = jnp.concatenate(distances)[:num_samples]
+
+    lower = jnp.quantile(distances, lower_discard) if lower_discard is not None else -jnp.inf
+    upper = jnp.quantile(distances, upper_discard) if upper_discard is not None else jnp.inf
+    keep = (distances >= lower) & (distances <= upper)
+    kept = jnp.where(keep, distances, 0.0)
+    n = jnp.maximum(jnp.sum(keep), 1)
+    mean = jnp.sum(kept) / n
+    var = jnp.sum(jnp.where(keep, (distances - mean) ** 2, 0.0)) / jnp.maximum(n - 1, 1)
+    return mean, jnp.sqrt(var), distances
+
+
+class PerceptualPathLength(Metric):
+    """PPL as a Metric: stateless wrapper calling :func:`perceptual_path_length`."""
+
+    is_differentiable: bool = False
+    higher_is_better: bool = False
+    full_state_update: bool = True
+
+    def __init__(
+        self,
+        num_samples: int = 10_000,
+        conditional: bool = False,
+        batch_size: int = 128,
+        interpolation_method: str = "lerp",
+        epsilon: float = 1e-4,
+        resize: Optional[int] = 64,
+        lower_discard: Optional[float] = 0.01,
+        upper_discard: Optional[float] = 0.99,
+        sim_net: Union[Callable, None] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.num_samples = num_samples
+        self.conditional = conditional
+        self.batch_size = batch_size
+        self.interpolation_method = interpolation_method
+        self.epsilon = epsilon
+        self.resize = resize
+        self.lower_discard = lower_discard
+        self.upper_discard = upper_discard
+        self.sim_net = sim_net
+        self.add_state("_generator_holder", default=[], dist_reduce_fx=None)
+
+    def update(self, generator: Any) -> None:
+        """Store the generator to evaluate at ``compute`` time."""
+        _validate_generator_model(generator, self.conditional)
+        self._generator = generator
+        self._generator_holder.append(jnp.zeros(1))
+
+    def compute(self) -> Tuple[Array, Array, Array]:
+        """Run the PPL evaluation with the stored generator."""
+        if not hasattr(self, "_generator"):
+            raise RuntimeError("No generator provided; call `update(generator)` first.")
+        return perceptual_path_length(
+            self._generator,
+            num_samples=self.num_samples,
+            conditional=self.conditional,
+            batch_size=self.batch_size,
+            interpolation_method=self.interpolation_method,
+            epsilon=self.epsilon,
+            resize=self.resize,
+            lower_discard=self.lower_discard,
+            upper_discard=self.upper_discard,
+            sim_net=self.sim_net,
+        )

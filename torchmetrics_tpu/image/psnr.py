@@ -1,0 +1,146 @@
+"""Modular PSNR (reference ``image/psnr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.psnr import _psnr_compute, _psnr_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class PeakSignalNoiseRatio(Metric):
+    """Peak Signal-to-Noise Ratio over streaming batches.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.image import PeakSignalNoiseRatio
+        >>> psnr = PeakSignalNoiseRatio()
+        >>> preds = jnp.array([[0.0, 1.0], [2.0, 3.0]])
+        >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
+        >>> psnr(preds, target)
+        Array(2.5527055, dtype=float32)
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(
+        self,
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        base: float = 10.0,
+        reduction: str = "elementwise_mean",
+        dim: Optional[Union[int, Tuple[int, ...]]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if dim is None and reduction != "elementwise_mean":
+            from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+            rank_zero_warn(f"The `reduction={reduction}` will not have any effect when `dim` is None.")
+
+        if dim is None:
+            self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("sum_squared_error", default=[], dist_reduce_fx="cat")
+            self.add_state("total", default=[], dist_reduce_fx="cat")
+
+        if data_range is None:
+            if dim is not None:
+                # the min/max tracking over the target cannot be meaningfully
+                # reduced per-dim
+                raise ValueError("The `data_range` must be given when `dim` is not None.")
+            self.data_range = None
+            self.add_state("min_target", default=jnp.asarray(jnp.inf), dist_reduce_fx="min")
+            self.add_state("max_target", default=jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+            self.clamping_fn = None
+        elif isinstance(data_range, tuple):
+            self.add_state("data_range", default=jnp.asarray(data_range[1] - data_range[0]), dist_reduce_fx="mean")
+            self.clamping_fn = lambda x: jnp.clip(x, data_range[0], data_range[1])
+        else:
+            self.add_state("data_range", default=jnp.asarray(float(data_range)), dist_reduce_fx="mean")
+            self.clamping_fn = None
+        self.base = base
+        self.reduction = reduction
+        self.dim = tuple(dim) if isinstance(dim, (list, tuple)) else dim
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate squared error and element counts."""
+        preds = jnp.asarray(preds, jnp.float32)
+        target = jnp.asarray(target, jnp.float32)
+        if self.clamping_fn is not None:
+            preds = self.clamping_fn(preds)
+            target = self.clamping_fn(target)
+        sum_squared_error, num_obs = _psnr_update(preds, target, dim=self.dim)
+        if self.dim is None:
+            if self.data_range is None:
+                self.min_target = jnp.minimum(jnp.min(target), self.min_target)
+                self.max_target = jnp.maximum(jnp.max(target), self.max_target)
+            self.sum_squared_error = self.sum_squared_error + sum_squared_error
+            self.total = self.total + num_obs
+        else:
+            self.sum_squared_error.append(sum_squared_error)
+            self.total.append(num_obs)
+
+    def compute(self) -> Array:
+        """PSNR over all accumulated batches."""
+        data_range = self.data_range if getattr(self, "data_range", None) is not None else (
+            self.max_target - self.min_target
+        )
+        if self.dim is None:
+            sum_squared_error = self.sum_squared_error
+            total = self.total
+        else:
+            sum_squared_error = dim_zero_cat(self.sum_squared_error)
+            total = dim_zero_cat(self.total)
+        psnr = _psnr_compute(sum_squared_error, total, data_range, base=self.base)
+        if self.dim is not None and psnr.ndim > 0:
+            if self.reduction == "elementwise_mean":
+                return jnp.mean(psnr)
+            if self.reduction == "sum":
+                return jnp.sum(psnr)
+        return psnr
+
+
+class PeakSignalNoiseRatioWithBlockedEffect(Metric):
+    """PSNR-B: PSNR with a blocking-effect penalty (single-channel images)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, block_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(block_size, int) or block_size < 1:
+            raise ValueError("Argument `block_size` should be a positive integer")
+        self.block_size = block_size
+        self.add_state("sum_squared_error", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("bef", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("data_range", default=jnp.asarray(0.0), dist_reduce_fx="max")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate squared error, blocking-effect factor, and data range."""
+        from torchmetrics_tpu.functional.image.psnr import _psnrb_compute_bef
+
+        preds = jnp.asarray(preds, jnp.float32)
+        target = jnp.asarray(target, jnp.float32)
+        sum_squared_error, num_obs = _psnr_update(preds, target)
+        self.sum_squared_error = self.sum_squared_error + sum_squared_error
+        self.total = self.total + num_obs
+        self.bef = self.bef + _psnrb_compute_bef(preds, block_size=self.block_size)
+        self.data_range = jnp.maximum(self.data_range, jnp.max(target) - jnp.min(target))
+
+    def compute(self) -> Array:
+        """PSNR-B over all accumulated batches."""
+        mse = self.sum_squared_error / self.total
+        return 10.0 * jnp.log10(self.data_range**2 / (mse + self.bef))

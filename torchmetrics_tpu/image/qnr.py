@@ -1,0 +1,74 @@
+"""Modular QualityWithNoReference (reference ``image/qnr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.d_s import _spatial_distortion_index_update
+from torchmetrics_tpu.functional.image.qnr import quality_with_no_reference
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class QualityWithNoReference(Metric):
+    """QNR over streaming batches. ``target`` is a dict with ``ms``/``pan``."""
+
+    higher_is_better: bool = True
+    is_differentiable: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        alpha: float = 1,
+        beta: float = 1,
+        norm_order: int = 1,
+        window_size: int = 7,
+        reduction: str = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(alpha, (int, float)) and alpha >= 0):
+            raise ValueError(f"Expected `alpha` to be a non-negative real number. Got alpha: {alpha}.")
+        if not (isinstance(beta, (int, float)) and beta >= 0):
+            raise ValueError(f"Expected `beta` to be a non-negative real number. Got beta: {beta}.")
+        self.alpha = alpha
+        self.beta = beta
+        self.norm_order = norm_order
+        self.window_size = window_size
+        self.reduction = reduction
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("ms", default=[], dist_reduce_fx="cat")
+        self.add_state("pan", default=[], dist_reduce_fx="cat")
+        self.add_state("pan_lr", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Dict[str, Array]) -> None:
+        """Append a batch of (preds, {ms, pan[, pan_lr]})."""
+        if "ms" not in target:
+            raise ValueError(f"Expected `target` to contain the key `ms`. Got target: {target.keys()}.")
+        if "pan" not in target:
+            raise ValueError(f"Expected `target` to contain the key `pan`. Got target: {target.keys()}.")
+        preds, ms, pan, pan_lr = _spatial_distortion_index_update(
+            preds, target["ms"], target["pan"], target.get("pan_lr")
+        )
+        self.preds.append(preds)
+        self.ms.append(ms)
+        self.pan.append(pan)
+        if pan_lr is not None:
+            self.pan_lr.append(pan_lr)
+
+    def compute(self) -> Array:
+        """QNR over all accumulated images."""
+        preds = dim_zero_cat(self.preds)
+        ms = dim_zero_cat(self.ms)
+        pan = dim_zero_cat(self.pan)
+        pan_lr = dim_zero_cat(self.pan_lr) if len(self.pan_lr) > 0 else None
+        return quality_with_no_reference(
+            preds, ms, pan, pan_lr, self.alpha, self.beta, self.norm_order, self.window_size, self.reduction
+        )

@@ -1,0 +1,42 @@
+"""Modular RelativeAverageSpectralError (reference ``image/rase.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.misc import relative_average_spectral_error
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class RelativeAverageSpectralError(Metric):
+    """RASE over streaming batches (cat states, computed at epoch end)."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(window_size, int) or window_size < 1:
+            raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
+        self.window_size = window_size
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Append batch images."""
+        self.preds.append(jnp.asarray(preds, jnp.float32))
+        self.target.append(jnp.asarray(target, jnp.float32))
+
+    def compute(self) -> Array:
+        """RASE over all accumulated images."""
+        preds = dim_zero_cat(self.preds)
+        target = dim_zero_cat(self.target)
+        return relative_average_spectral_error(preds, target, self.window_size)

@@ -1,0 +1,40 @@
+"""Modular SpectralAngleMapper (reference ``image/sam.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.misc import spectral_angle_mapper
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class SpectralAngleMapper(Metric):
+    """Spectral Angle Mapper (radians) over streaming batches."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, reduction: Optional[str] = "elementwise_mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.reduction = reduction
+        self.add_state("sum_sam", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numel", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-pixel spectral angles."""
+        vals = spectral_angle_mapper(preds, target, reduction=None)
+        self.sum_sam = self.sum_sam + jnp.sum(vals)
+        self.numel = self.numel + vals.size
+
+    def compute(self) -> Array:
+        """Aggregate SAM over all batches."""
+        if self.reduction == "sum":
+            return self.sum_sam
+        return self.sum_sam / self.numel

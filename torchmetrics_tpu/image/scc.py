@@ -1,0 +1,40 @@
+"""Modular SpatialCorrelationCoefficient (reference ``image/scc.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.misc import spatial_correlation_coefficient
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class SpatialCorrelationCoefficient(Metric):
+    """Spatial Correlation Coefficient over streaming batches."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+
+    def __init__(self, high_pass_filter: Array = None, window_size: int = 8, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.high_pass_filter = high_pass_filter
+        self.window_size = window_size
+        self.add_state("scc_score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-image SCC values."""
+        vals = spatial_correlation_coefficient(
+            preds, target, hp_filter=self.high_pass_filter, window_size=self.window_size, reduction=None
+        )
+        self.scc_score = self.scc_score + jnp.sum(vals)
+        self.total = self.total + vals.shape[0]
+
+    def compute(self) -> Array:
+        """Aggregate SCC over all batches."""
+        return self.scc_score / self.total

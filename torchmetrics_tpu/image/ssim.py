@@ -1,0 +1,201 @@
+"""Modular SSIM / MS-SSIM (reference ``image/ssim.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.ssim import (
+    _ssim_check_inputs,
+    multiscale_structural_similarity_index_measure,
+    structural_similarity_index_measure,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class StructuralSimilarityIndexMeasure(Metric):
+    """Structural Similarity Index Measure over streaming batches.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.image import StructuralSimilarityIndexMeasure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 32, 32))
+        >>> ssim = StructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> ssim(preds, preds)
+        Array(1., dtype=float32)
+    """
+
+    higher_is_better: bool = True
+    is_differentiable: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        return_full_image: bool = False,
+        return_contrast_sensitivity: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", default=[], dist_reduce_fx="cat")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        if return_full_image:
+            self.add_state("image_return", default=[], dist_reduce_fx="cat")
+
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.return_full_image = return_full_image
+        self.return_contrast_sensitivity = return_contrast_sensitivity
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-image SSIM values."""
+        preds, target = _ssim_check_inputs(preds, target)
+        out = structural_similarity_index_measure(
+            preds,
+            target,
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            None,  # keep per-image values; reduce in compute
+            self.data_range,
+            self.k1,
+            self.k2,
+            self.return_full_image,
+            self.return_contrast_sensitivity,
+        )
+        if isinstance(out, tuple):
+            similarity, extra = out
+            if self.return_full_image:
+                self.image_return.append(extra)
+        else:
+            similarity = out
+
+        if self.reduction in ("elementwise_mean", "sum"):
+            self.similarity = self.similarity + jnp.sum(similarity)
+            self.total = self.total + similarity.shape[0]
+        else:
+            self.similarity.append(similarity)
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        """Aggregate SSIM over all batches."""
+        if self.reduction == "elementwise_mean":
+            similarity = self.similarity / self.total
+        elif self.reduction == "sum":
+            similarity = self.similarity
+        else:
+            similarity = dim_zero_cat(self.similarity)
+        if self.return_full_image:
+            return similarity, dim_zero_cat(self.image_return)
+        return similarity
+
+
+class MultiScaleStructuralSimilarityIndexMeasure(Metric):
+    """Multi-scale SSIM over streaming batches.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.image import MultiScaleStructuralSimilarityIndexMeasure
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
+        >>> ms_ssim = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> ms_ssim(preds, preds)
+        Array(1., dtype=float32)
+    """
+
+    higher_is_better: bool = True
+    is_differentiable: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        gaussian_kernel: bool = True,
+        kernel_size: Union[int, Sequence[int]] = 11,
+        sigma: Union[float, Sequence[float]] = 1.5,
+        reduction: Optional[str] = "elementwise_mean",
+        data_range: Optional[Union[float, Tuple[float, float]]] = None,
+        k1: float = 0.01,
+        k2: float = 0.03,
+        betas: Tuple[float, ...] = (0.0448, 0.2856, 0.3001, 0.2363, 0.1333),
+        normalize: Optional[str] = "relu",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        valid_reduction = ("elementwise_mean", "sum", "none", None)
+        if reduction not in valid_reduction:
+            raise ValueError(f"Argument `reduction` must be one of {valid_reduction}, but got {reduction}")
+        if reduction in ("elementwise_mean", "sum"):
+            self.add_state("similarity", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        else:
+            self.add_state("similarity", default=[], dist_reduce_fx="cat")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+        if not isinstance(kernel_size, (Sequence, int)):
+            raise ValueError("Argument `kernel_size` expected to be an sequence or an int")
+        if normalize and normalize not in ("relu", "simple"):
+            raise ValueError("Argument `normalize` to be expected either `None` or one of 'relu' or 'simple'")
+
+        self.gaussian_kernel = gaussian_kernel
+        self.sigma = sigma
+        self.kernel_size = kernel_size
+        self.reduction = reduction
+        self.data_range = data_range
+        self.k1 = k1
+        self.k2 = k2
+        self.betas = tuple(float(b) for b in betas)
+        self.normalize = normalize
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-image MS-SSIM values."""
+        preds, target = _ssim_check_inputs(preds, target)
+        similarity = multiscale_structural_similarity_index_measure(
+            preds,
+            target,
+            self.gaussian_kernel,
+            self.sigma,
+            self.kernel_size,
+            None,
+            self.data_range,
+            self.k1,
+            self.k2,
+            self.betas,
+            self.normalize,
+        )
+        if self.reduction in ("elementwise_mean", "sum"):
+            self.similarity = self.similarity + jnp.sum(similarity)
+            self.total = self.total + similarity.shape[0]
+        else:
+            self.similarity.append(similarity)
+
+    def compute(self) -> Array:
+        """Aggregate MS-SSIM over all batches."""
+        if self.reduction == "elementwise_mean":
+            return self.similarity / self.total
+        if self.reduction == "sum":
+            return self.similarity
+        return dim_zero_cat(self.similarity)

@@ -1,0 +1,49 @@
+"""Modular TotalVariation (reference ``image/tv.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.misc import total_variation
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class TotalVariation(Metric):
+    """Total Variation over streaming batches."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = False
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, reduction: Optional[str] = "sum", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if reduction is not None and reduction not in ("sum", "mean", "none"):
+            raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+        self.reduction = reduction
+        self.add_state("score_list", default=[], dist_reduce_fx="cat")
+        self.add_state("score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("num_elements", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, img: Array) -> None:
+        """Accumulate per-image total variation."""
+        vals = total_variation(img, reduction=None)
+        if self.reduction in (None, "none"):
+            self.score_list.append(vals)
+        else:
+            self.score = self.score + jnp.sum(vals)
+            self.num_elements = self.num_elements + vals.shape[0]
+
+    def compute(self) -> Array:
+        """Aggregate total variation."""
+        if self.reduction in (None, "none"):
+            return dim_zero_cat(self.score_list)
+        if self.reduction == "mean":
+            return self.score / self.num_elements
+        return self.score

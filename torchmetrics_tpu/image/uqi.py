@@ -1,0 +1,57 @@
+"""Modular UniversalImageQualityIndex (reference ``image/uqi.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.misc import universal_image_quality_index
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class UniversalImageQualityIndex(Metric):
+    """Universal Image Quality Index over streaming batches.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.image import UniversalImageQualityIndex
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 32, 32))
+        >>> uqi = UniversalImageQualityIndex()
+        >>> uqi(preds, preds)
+        Array(1., dtype=float32)
+    """
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        kernel_size: Sequence[int] = (11, 11),
+        sigma: Sequence[float] = (1.5, 1.5),
+        reduction: Optional[str] = "elementwise_mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.kernel_size = kernel_size
+        self.sigma = sigma
+        self.reduction = reduction
+        self.add_state("sum_uqi", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numel", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-image UQI values."""
+        vals = universal_image_quality_index(preds, target, self.kernel_size, self.sigma, reduction=None)
+        self.sum_uqi = self.sum_uqi + jnp.sum(vals)
+        self.numel = self.numel + vals.shape[0]
+
+    def compute(self) -> Array:
+        """Aggregate UQI over all batches."""
+        if self.reduction == "sum":
+            return self.sum_uqi
+        return self.sum_uqi / self.numel

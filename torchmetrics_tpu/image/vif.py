@@ -1,0 +1,42 @@
+"""Modular VisualInformationFidelity (reference ``image/vif.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.image.vif import _vif_per_channel
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class VisualInformationFidelity(Metric):
+    """Pixel-based VIF over streaming batches."""
+
+    is_differentiable: bool = True
+    higher_is_better: bool = True
+    full_state_update: bool = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, sigma_n_sq: float = 2.0, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(sigma_n_sq, (float, int)) or sigma_n_sq < 0:
+            raise ValueError(f"Argument `sigma_n_sq` is expected to be a positive float or int, but got {sigma_n_sq}")
+        self.sigma_n_sq = float(sigma_n_sq)
+        self.add_state("vif_score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Array, target: Array) -> None:
+        """Accumulate per-channel VIF sums."""
+        preds = jnp.asarray(preds, jnp.float32)
+        target = jnp.asarray(target, jnp.float32)
+        per_channel = jax.vmap(_vif_per_channel, in_axes=(1, 1, None))(preds, target, self.sigma_n_sq)
+        self.vif_score = self.vif_score + jnp.sum(per_channel)
+        self.total = self.total + per_channel.size
+
+    def compute(self) -> Array:
+        """Aggregate VIF over all batches."""
+        return self.vif_score / self.total
