@@ -1,0 +1,30 @@
+"""Load the reference TorchMetrics (mounted read-only at /root/reference) as a
+CPU test oracle.
+
+The reference needs ``lightning_utilities``, which is not installed in this
+image; a minimal stub lives next to this file. ``load_reference()`` inserts
+both paths and imports the reference package, or returns ``None`` when the
+checkout is unavailable (so tests can skip).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+
+_REFERENCE_SRC = "/root/reference/src"
+_STUB_DIR = os.path.dirname(os.path.abspath(__file__))
+
+
+def load_reference():
+    if not os.path.isdir(_REFERENCE_SRC):
+        return None
+    for path in (_STUB_DIR, _REFERENCE_SRC):
+        if path not in sys.path:
+            sys.path.insert(0, path)
+    try:
+        import torchmetrics  # noqa: F401
+
+        return torchmetrics
+    except Exception:
+        return None

@@ -1,0 +1,12 @@
+from collections import OrderedDict
+from typing import Any, Callable
+
+def apply_to_collection(data: Any, dtype, function: Callable, *args, **kwargs) -> Any:
+    if isinstance(data, dtype):
+        return function(data, *args, **kwargs)
+    if isinstance(data, (list, tuple)):
+        out = [apply_to_collection(d, dtype, function, *args, **kwargs) for d in data]
+        return type(data)(out) if not isinstance(data, tuple) else tuple(out)
+    if isinstance(data, (dict, OrderedDict)):
+        return type(data)({k: apply_to_collection(v, dtype, function, *args, **kwargs) for k, v in data.items()})
+    return data

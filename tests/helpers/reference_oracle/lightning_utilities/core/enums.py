@@ -1,0 +1,30 @@
+from enum import Enum
+from typing import Optional
+
+class StrEnum(str, Enum):
+    @classmethod
+    def from_str(cls, value: str, source: str = "key") -> Optional["StrEnum"]:
+        if source in ("key", "any"):
+            for st in cls:
+                if st.name.lower() == value.lower().replace("-", "_").replace(" ", "_"):
+                    return st
+        if source in ("value", "any"):
+            for st in cls:
+                if st.value.lower() == value.lower():
+                    return st
+        return None
+
+    @classmethod
+    def try_from_str(cls, value: str, source: str = "key"):
+        try:
+            return cls.from_str(value, source)
+        except Exception:
+            return None
+
+    def __eq__(self, other) -> bool:
+        if isinstance(other, Enum):
+            other = other.value
+        return self.value.lower() == str(other).lower()
+
+    def __hash__(self) -> int:
+        return hash(self.value.lower())

@@ -1,0 +1,48 @@
+import importlib, importlib.util
+from functools import lru_cache
+
+@lru_cache()
+def package_available(name: str) -> bool:
+    return importlib.util.find_spec(name) is not None
+
+@lru_cache()
+def module_available(name: str) -> bool:
+    parts = name.split(".")
+    for i in range(1, len(parts) + 1):
+        if importlib.util.find_spec(".".join(parts[:i])) is None:
+            return False
+    return True
+
+def compare_version(package, op, version, use_base_version=False):
+    try:
+        from packaging.version import Version
+        pkg = importlib.import_module(package)
+        pkg_version = Version(getattr(pkg, "__version__", "0.0.0"))
+        if use_base_version:
+            pkg_version = Version(pkg_version.base_version)
+        return op(pkg_version, Version(version))
+    except Exception:
+        return False
+
+class RequirementCache:
+    def __init__(self, requirement=None, module=None):
+        self.requirement = requirement
+        self.module = module
+    def __bool__(self):
+        try:
+            if self.module is not None:
+                return module_available(self.module)
+            from packaging.requirements import Requirement
+            req = Requirement(self.requirement)
+            import importlib.metadata as md
+            try:
+                ver = md.version(req.name)
+            except md.PackageNotFoundError:
+                return False
+            from packaging.version import Version
+            return ver is not None and (not req.specifier or req.specifier.contains(Version(ver).base_version, prereleases=True))
+        except Exception:
+            return False
+    def __str__(self):
+        return f"RequirementCache({self.requirement})"
+    __repr__ = __str__

@@ -1,0 +1,118 @@
+"""Edit-distance family tests: device-kernel parity with the reference
+implementation (CPU oracle) and pure-Python Levenshtein."""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.helpers.reference_oracle import load_reference
+from torchmetrics_tpu.functional.text import (
+    char_error_rate,
+    edit_distance,
+    match_error_rate,
+    word_error_rate,
+    word_information_lost,
+    word_information_preserved,
+)
+from torchmetrics_tpu.functional.text.helper import _edit_distance_host, _edit_distance_tokens
+from torchmetrics_tpu.text import (
+    CharErrorRate,
+    EditDistance,
+    MatchErrorRate,
+    WordErrorRate,
+    WordInfoLost,
+    WordInfoPreserved,
+)
+
+_REF = load_reference()
+
+PREDS = ["this is the prediction", "there is an other sample", "kitten sitting", ""]
+TARGET = ["this is the reference", "there is another one", "sitting kitten", "non empty"]
+
+BATCHES = [
+    (["hello world", "foo bar baz"], ["hello there world", "foo baz"]),
+    (["a b c d e f", "x"], ["a c b d f e", "x y z"]),
+]
+
+
+def test_device_kernel_matches_host_dp():
+    cases = [
+        (list("kitten"), list("sitting")),
+        ([], list("abc")),
+        (list("abc"), []),
+        (list("same"), list("same")),
+        ("the quick brown fox".split(), "the slow brown dog".split()),
+    ]
+    device = _edit_distance_tokens([a for a, _ in cases], [b for _, b in cases])
+    for i, (a, b) in enumerate(cases):
+        assert int(device[i]) == _edit_distance_host(a, b)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize(
+    ("ours", "theirs"),
+    [
+        (word_error_rate, "word_error_rate"),
+        (char_error_rate, "char_error_rate"),
+        (match_error_rate, "match_error_rate"),
+        (word_information_lost, "word_information_lost"),
+        (word_information_preserved, "word_information_preserved"),
+    ],
+)
+def test_functional_matches_reference(ours, theirs):
+    import torchmetrics.functional.text as ref_text
+
+    ref_fn = getattr(ref_text, theirs)
+    expected = float(ref_fn(PREDS[:3], TARGET[:3]))
+    got = float(ours(PREDS[:3], TARGET[:3]))
+    assert got == pytest.approx(expected, abs=1e-6)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize("substitution_cost", [1, 2])
+@pytest.mark.parametrize("reduction", ["mean", "sum", "none"])
+def test_edit_distance_matches_reference(substitution_cost, reduction):
+    import numpy as np
+    import torchmetrics.functional.text as ref_text
+
+    expected = ref_text.edit_distance(PREDS[:3], TARGET[:3], substitution_cost, reduction)
+    got = edit_distance(PREDS[:3], TARGET[:3], substitution_cost, reduction)
+    assert np.allclose(np.asarray(got, dtype=float), np.asarray(expected, dtype=float), atol=1e-6)
+
+
+@pytest.mark.parametrize(
+    ("metric_cls", "fn"),
+    [
+        (WordErrorRate, word_error_rate),
+        (CharErrorRate, char_error_rate),
+        (MatchErrorRate, match_error_rate),
+        (WordInfoLost, word_information_lost),
+        (WordInfoPreserved, word_information_preserved),
+    ],
+)
+def test_class_accumulation_equals_functional_on_concat(metric_cls, fn):
+    metric = metric_cls()
+    all_preds, all_targets = [], []
+    for preds, target in BATCHES:
+        metric.update(preds, target)
+        all_preds.extend(preds)
+        all_targets.extend(target)
+    assert float(metric.compute()) == pytest.approx(float(fn(all_preds, all_targets)), abs=1e-6)
+
+
+def test_edit_distance_class_reduction_none():
+    metric = EditDistance(reduction="none")
+    metric.update(["ab"], ["ac"])
+    metric.update(["abcd", "xy"], ["abed", "yx"])
+    result = metric.compute()
+    assert result.shape == (3,)
+    assert [int(x) for x in result] == [1, 1, 2]
+
+
+def test_input_validation():
+    with pytest.raises(ValueError, match="same length"):
+        word_error_rate(["a"], ["a", "b"])
+    with pytest.raises(ValueError, match="reduction"):
+        EditDistance(reduction="bad")
+    with pytest.raises(ValueError, match="substitution_cost"):
+        EditDistance(substitution_cost=-1)

@@ -1,0 +1,198 @@
+"""Perplexity / SQuAD / BERTScore / InfoLM tests."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.helpers.reference_oracle import load_reference
+from torchmetrics_tpu.functional.text import bert_score, infolm, perplexity, squad
+from torchmetrics_tpu.text import BERTScore, InfoLM, Perplexity, SQuAD
+
+_REF = load_reference()
+
+
+class TestPerplexity:
+    def _data(self, ignore=False):
+        key1, key2 = jax.random.split(jax.random.PRNGKey(0))
+        preds = jax.random.normal(key1, (2, 8, 5))
+        target = jax.random.randint(key2, (2, 8), 0, 5)
+        if ignore:
+            target = target.at[0, 3].set(-100)
+        return preds, target
+
+    @pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+    @pytest.mark.parametrize("ignore", [False, True])
+    def test_matches_reference(self, ignore):
+        import torch
+        import torchmetrics.functional.text as ref_text
+
+        preds, target = self._data(ignore)
+        expected = float(
+            ref_text.perplexity(
+                torch.tensor(np.asarray(preds)),
+                torch.tensor(np.asarray(target), dtype=torch.int64),
+                ignore_index=-100 if ignore else None,
+            )
+        )
+        got = float(perplexity(preds, target, ignore_index=-100 if ignore else None))
+        assert got == pytest.approx(expected, rel=1e-4)
+
+    def test_class_accumulation(self):
+        preds, target = self._data()
+        metric = Perplexity()
+        metric.update(preds[:1], target[:1])
+        metric.update(preds[1:], target[1:])
+        assert float(metric.compute()) == pytest.approx(float(perplexity(preds, target)), rel=1e-5)
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="3 dimensions"):
+            perplexity(jnp.zeros((2, 8)), jnp.zeros((2, 8), dtype=jnp.int32))
+        with pytest.raises(TypeError, match="floating point"):
+            perplexity(jnp.zeros((2, 8, 5), dtype=jnp.int32), jnp.zeros((2, 8), dtype=jnp.int32))
+
+    def test_uniform_distribution_gives_vocab_size(self):
+        vocab = 7
+        preds = jnp.zeros((2, 4, vocab))
+        target = jnp.zeros((2, 4), dtype=jnp.int32)
+        assert float(perplexity(preds, target)) == pytest.approx(vocab, rel=1e-5)
+
+
+class TestSQuAD:
+    PREDS = [
+        {"prediction_text": "1976", "id": "id1"},
+        {"prediction_text": "the big apple", "id": "id2"},
+    ]
+    TARGET = [
+        {"answers": {"answer_start": [97], "text": ["1976"]}, "id": "id1"},
+        {"answers": {"answer_start": [1], "text": ["The Big Apple!", "New York"]}, "id": "id2"},
+    ]
+
+    @pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+    def test_matches_reference(self):
+        import torchmetrics.functional.text as ref_text
+
+        expected = ref_text.squad(self.PREDS, self.TARGET)
+        got = squad(self.PREDS, self.TARGET)
+        for key in expected:
+            assert float(got[key]) == pytest.approx(float(expected[key]), abs=1e-5)
+
+    def test_class_accumulation(self):
+        metric = SQuAD()
+        metric.update(self.PREDS[:1], self.TARGET[:1])
+        metric.update(self.PREDS[1:], self.TARGET[1:])
+        got = metric.compute()
+        expected = squad(self.PREDS, self.TARGET)
+        for key in expected:
+            assert float(got[key]) == pytest.approx(float(expected[key]), abs=1e-5)
+
+    def test_validation(self):
+        with pytest.raises(KeyError, match="prediction_text"):
+            squad([{"id": "1"}], self.TARGET[:1])
+        with pytest.raises(KeyError, match="answers"):
+            squad(self.PREDS[:1], [{"id": "1"}])
+
+
+class TestBERTScore:
+    def test_identical_sentences_score_one(self):
+        res = bert_score(["hello there", "a big dog"], ["hello there", "a big dog"])
+        assert np.allclose(np.asarray(res["f1"]), 1.0, atol=1e-5)
+
+    def test_disjoint_lower_than_identical(self):
+        same = bert_score(["alpha beta gamma"], ["alpha beta gamma"])
+        diff = bert_score(["alpha beta gamma"], ["delta epsilon zeta"])
+        assert float(diff["f1"][0]) < float(same["f1"][0])
+
+    def test_idf_changes_scores(self):
+        preds = ["the cat", "the dog", "the bird"]
+        target = ["the cat", "a dog", "the fish"]
+        plain = bert_score(preds, target, idf=False)
+        weighted = bert_score(preds, target, idf=True)
+        assert not np.allclose(np.asarray(plain["f1"]), np.asarray(weighted["f1"]))
+
+    def test_user_model_plugs_in(self):
+        def fwd(model, ids, mask):
+            # bag-of-ids embedding: deterministic, shape (B, L, D)
+            return jax.nn.one_hot(ids % 16, 16) * mask[..., None]
+
+        res = bert_score(["x y"], ["x y"], user_forward_fn=fwd, model=object())
+        assert float(res["f1"][0]) == pytest.approx(1.0, abs=1e-5)
+
+    def test_class_matches_functional(self):
+        preds = ["hello there", "general kenobi"]
+        target = ["hello there", "master yoda"]
+        metric = BERTScore()
+        metric.update(preds[:1], target[:1])
+        metric.update(preds[1:], target[1:])
+        got = metric.compute()
+        expected = bert_score(preds, target)
+        assert np.allclose(np.asarray(got["f1"]), np.asarray(expected["f1"]), atol=1e-5)
+
+    def test_mismatched_lengths_raise(self):
+        with pytest.raises(ValueError, match="same"):
+            bert_score(["a", "b"], ["a"])
+
+
+class TestInfoLM:
+    def test_identical_corpus_zero_distance(self):
+        preds = ["the cat sat", "a dog barked"]
+        score = infolm(preds, preds, information_measure="l2_distance", idf=False)
+        assert float(score) == pytest.approx(0.0, abs=1e-6)
+
+    def test_symmetric_measures_nonnegative(self):
+        preds = ["he read the book because he was interested in world history"]
+        target = ["he was interested in world history because he read the book"]
+        for measure in ("l1_distance", "l2_distance", "l_infinity_distance", "fisher_rao_distance"):
+            score = infolm(preds, target, information_measure=measure, idf=False)
+            assert float(score) >= 0.0, measure
+
+    def test_alpha_beta_validation(self):
+        with pytest.raises(ValueError, match="alpha"):
+            infolm(["a"], ["a"], information_measure="alpha_divergence", alpha=1.0)
+        with pytest.raises(ValueError, match="beta"):
+            infolm(["a"], ["a"], information_measure="beta_divergence", beta=0.0)
+        with pytest.raises(ValueError, match="information_measure"):
+            infolm(["a"], ["a"], information_measure="bogus")
+
+    def test_sentence_level_scores(self):
+        corpus, sentences = infolm(
+            ["a b", "c d"], ["a b", "c d"], information_measure="l1_distance", idf=False,
+            return_sentence_level_score=True,
+        )
+        assert sentences.shape == (2,)
+        assert float(corpus) == pytest.approx(float(jnp.mean(sentences)))
+
+    def test_class_accumulation(self):
+        preds = ["the cat sat", "a dog barked"]
+        target = ["the cat sat on the mat", "a dog barked loudly"]
+        metric = InfoLM(information_measure="l2_distance", idf=False)
+        metric.update(preds[:1], target[:1])
+        metric.update(preds[1:], target[1:])
+        got = float(metric.compute())
+        expected = float(infolm(preds, target, information_measure="l2_distance", idf=False))
+        assert got == pytest.approx(expected, rel=1e-4)
+
+    def test_forward_accumulates_all_batches(self):
+        # forward()'s stash/reset/merge dance must not drop earlier batches:
+        # the sentence buffers are registered cat states, not plain attributes
+        preds = ["the cat sat", "a dog barked"]
+        target = ["the cat sat on the mat", "a dog barked loudly"]
+        metric = InfoLM(information_measure="l2_distance", idf=False)
+        metric(preds[:1], target[:1])
+        metric(preds[1:], target[1:])
+        got = float(metric.compute())
+        expected = float(infolm(preds, target, information_measure="l2_distance", idf=False))
+        assert got == pytest.approx(expected, rel=1e-4)
+
+    def test_default_model_distinguishes_corpora(self):
+        # the default hash model must be context-sensitive: disjoint corpora
+        # score strictly above zero (a context-free table scores everything 0)
+        score = infolm(
+            ["completely different sentence entirely"],
+            ["quantum flux capacitor banana"],
+            information_measure="l2_distance",
+            idf=False,
+        )
+        assert float(score) > 1e-4

@@ -1,0 +1,169 @@
+"""BLEU / SacreBLEU / CHRF / TER / EED / ROUGE tests vs the reference oracle."""
+
+from __future__ import annotations
+
+import pytest
+
+from tests.helpers.reference_oracle import load_reference
+from torchmetrics_tpu.functional.text import (
+    bleu_score,
+    chrf_score,
+    extended_edit_distance,
+    rouge_score,
+    sacre_bleu_score,
+    translation_edit_rate,
+)
+from torchmetrics_tpu.text import (
+    BLEUScore,
+    CHRFScore,
+    ExtendedEditDistance,
+    ROUGEScore,
+    SacreBLEUScore,
+    TranslationEditRate,
+)
+
+_REF = load_reference()
+
+PREDS = ["the cat is on the mat", "the dog sat", "Hello, World! 42.5 dollars"]
+TARGETS = [
+    ["there is a cat on the mat", "a cat is on the mat"],
+    ["the dog sat here", "a dog sat"],
+    ["Hello World: 42.5 dollars!", "hello, world! 42 dollars"],
+]
+SINGLE = ["this is the prediction", "here is an other sample"]
+SINGLE_T = ["this is the reference", "here is another one"]
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize("n_gram", [2, 4])
+@pytest.mark.parametrize("smooth", [False, True])
+def test_bleu_matches_reference(n_gram, smooth):
+    import torchmetrics.functional.text as ref_text
+
+    expected = float(ref_text.bleu_score(PREDS, TARGETS, n_gram=n_gram, smooth=smooth))
+    got = float(bleu_score(PREDS, TARGETS, n_gram=n_gram, smooth=smooth))
+    assert got == pytest.approx(expected, abs=1e-5)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize("tokenize", ["none", "13a", "char", "intl"])
+@pytest.mark.parametrize("lowercase", [False, True])
+def test_sacre_bleu_matches_reference(tokenize, lowercase):
+    import torchmetrics.functional.text as ref_text
+
+    expected = float(ref_text.sacre_bleu_score(PREDS, TARGETS, tokenize=tokenize, lowercase=lowercase))
+    got = float(sacre_bleu_score(PREDS, TARGETS, tokenize=tokenize, lowercase=lowercase))
+    assert got == pytest.approx(expected, abs=1e-5)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize(("n_char_order", "n_word_order"), [(6, 2), (6, 0), (4, 1)])
+def test_chrf_matches_reference(n_char_order, n_word_order):
+    import torchmetrics.functional.text as ref_text
+
+    expected = float(ref_text.chrf_score(PREDS, TARGETS, n_char_order=n_char_order, n_word_order=n_word_order))
+    got = float(chrf_score(PREDS, TARGETS, n_char_order=n_char_order, n_word_order=n_word_order))
+    assert got == pytest.approx(expected, abs=1e-5)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize(
+    "kwargs",
+    [
+        {},
+        {"lowercase": False},
+        {"normalize": True},
+        {"no_punctuation": True},
+    ],
+)
+def test_ter_matches_reference(kwargs):
+    import torchmetrics.functional.text as ref_text
+
+    expected = float(ref_text.translation_edit_rate(PREDS, TARGETS, **kwargs))
+    got = float(translation_edit_rate(PREDS, TARGETS, **kwargs))
+    assert got == pytest.approx(expected, abs=1e-5)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+def test_ter_shift_case():
+    import torchmetrics.functional.text as ref_text
+
+    preds = ["b a c d e f g", "the house the is big"]
+    target = [["a b c d e f g"], ["the house is big"]]
+    assert float(translation_edit_rate(preds, target)) == pytest.approx(
+        float(ref_text.translation_edit_rate(preds, target)), abs=1e-6
+    )
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+def test_eed_matches_reference():
+    import torchmetrics.functional.text as ref_text
+
+    expected = float(ref_text.extended_edit_distance(SINGLE, SINGLE_T))
+    got = float(extended_edit_distance(SINGLE, SINGLE_T))
+    assert got == pytest.approx(expected, abs=1e-5)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+def test_eed_multi_reference_and_params():
+    import torchmetrics.functional.text as ref_text
+
+    expected = float(ref_text.extended_edit_distance(PREDS, TARGETS, alpha=1.5, rho=0.4))
+    got = float(extended_edit_distance(PREDS, TARGETS, alpha=1.5, rho=0.4))
+    assert got == pytest.approx(expected, abs=1e-5)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize("accumulate", ["best", "avg"])
+def test_rouge_matches_reference(accumulate):
+    import numpy as np
+    import torchmetrics.functional.text as ref_text
+
+    keys = ("rouge1", "rouge2", "rougeL")  # Lsum needs nltk punkt in the reference
+    expected = ref_text.rouge_score(PREDS, TARGETS, rouge_keys=keys, accumulate=accumulate)
+    got = rouge_score(PREDS, TARGETS, rouge_keys=keys, accumulate=accumulate)
+    for key in expected:
+        assert float(got[key]) == pytest.approx(float(expected[key]), abs=1e-5), key
+
+
+def test_rouge_lsum_self_consistency():
+    # identical summaries score 1.0 on every Lsum stat
+    text = "The cat sat on the mat. The dog barked loudly. Rain fell all day."
+    res = rouge_score([text], [[text]], rouge_keys="rougeLsum")
+    assert float(res["rougeLsum_fmeasure"]) == pytest.approx(1.0)
+
+
+@pytest.mark.parametrize(
+    ("metric_cls", "fn", "kwargs"),
+    [
+        (BLEUScore, bleu_score, {}),
+        (SacreBLEUScore, sacre_bleu_score, {}),
+        (CHRFScore, chrf_score, {}),
+        (TranslationEditRate, translation_edit_rate, {}),
+        (ExtendedEditDistance, extended_edit_distance, {}),
+    ],
+)
+def test_class_accumulation_equals_functional(metric_cls, fn, kwargs):
+    metric = metric_cls(**kwargs)
+    metric.update(PREDS[:1], TARGETS[:1])
+    metric.update(PREDS[1:], TARGETS[1:])
+    assert float(metric.compute()) == pytest.approx(float(fn(PREDS, TARGETS)), abs=1e-5)
+
+
+def test_rouge_class_accumulation():
+    metric = ROUGEScore(rouge_keys=("rouge1", "rougeL"))
+    metric.update(PREDS[:1], TARGETS[:1])
+    metric.update(PREDS[1:], TARGETS[1:])
+    got = metric.compute()
+    expected = rouge_score(PREDS, TARGETS, rouge_keys=("rouge1", "rougeL"))
+    for key in expected:
+        assert float(got[key]) == pytest.approx(float(expected[key]), abs=1e-6)
+
+
+def test_bleu_validation():
+    with pytest.raises(ValueError, match="Corpus has different size"):
+        bleu_score(["a", "b"], [["a"]])
+    with pytest.raises(ValueError, match="weights"):
+        bleu_score(["a"], [["a"]], n_gram=4, weights=[0.5, 0.5])
+    with pytest.raises(ValueError, match="tokenize"):
+        sacre_bleu_score(PREDS, TARGETS, tokenize="ja-mecab")

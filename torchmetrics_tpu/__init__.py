@@ -24,6 +24,7 @@ from torchmetrics_tpu import (  # noqa: E402
     nominal,
     regression,
     retrieval,
+    text,
     utilities,
     wrappers,
 )
@@ -44,6 +45,8 @@ from torchmetrics_tpu.classification import __all__ as _classification_all  # no
 from torchmetrics_tpu.collections import MetricCollection  # noqa: E402
 from torchmetrics_tpu.regression import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.regression import __all__ as _regression_all  # noqa: E402
+from torchmetrics_tpu.text import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.text import __all__ as _text_all  # noqa: E402
 from torchmetrics_tpu.wrappers import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.wrappers import __all__ as _wrappers_all  # noqa: E402
 
@@ -60,6 +63,7 @@ __all__ = [
     "nominal",
     "regression",
     "retrieval",
+    "text",
     "utilities",
     "wrappers",
     "__version__",
@@ -71,5 +75,6 @@ __all__ = [
     *_nominal_all,
     *_regression_all,
     *_retrieval_all,
+    *_text_all,
     *_wrappers_all,
 ]

@@ -1,6 +1,15 @@
 """Stateless functional metrics (L2)."""
 
-from torchmetrics_tpu.functional import classification, clustering, detection, image, nominal, regression, retrieval
+from torchmetrics_tpu.functional import (
+    classification,
+    clustering,
+    detection,
+    image,
+    nominal,
+    regression,
+    retrieval,
+    text,
+)
 from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.image import __all__ as _image_all
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
@@ -15,6 +24,8 @@ from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
 from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.retrieval import __all__ as _retrieval_all
+from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.text import __all__ as _text_all
 
 __all__ = [
     "classification",
@@ -24,6 +35,7 @@ __all__ = [
     "nominal",
     "regression",
     "retrieval",
+    "text",
     *_classification_all,
     *_image_all,
     *_clustering_all,
@@ -31,4 +43,5 @@ __all__ = [
     *_nominal_all,
     *_regression_all,
     *_retrieval_all,
+    *_text_all,
 ]

@@ -1,0 +1,193 @@
+"""BERTScore (reference ``functional/text/bert.py``).
+
+States are padded token-id/attention-mask matrices (device cat state); compute
+embeds every sentence with a pluggable encoder and runs the greedy cosine
+matching (``functional/text/bert.py:243-263``) as one batched einsum + masked
+max on device.
+
+Pretrained transformers cannot be downloaded in this environment, so the
+default encoder is a deterministic hash-embedding lookup (seeded random
+per-token vectors). Scores are self-consistent (identical sentences → 1.0,
+disjoint sentences → near 0) but do not match published BERTScore numbers;
+pass ``user_model``/``user_forward_fn`` for real use.
+"""
+
+from __future__ import annotations
+
+import math
+from collections import Counter
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+_DEFAULT_MAX_LENGTH = 128
+_EMBED_DIM = 128
+
+
+class _HashTokenizer:
+    """Whitespace tokenizer with stable hash ids (no external vocab files)."""
+
+    def __init__(self, max_length: int = _DEFAULT_MAX_LENGTH) -> None:
+        self.max_length = max_length
+
+    def __call__(self, text: Sequence[str], max_length: Optional[int] = None) -> Dict[str, np.ndarray]:
+        max_length = max_length or self.max_length
+        ids = np.zeros((len(text), max_length), dtype=np.int64)
+        mask = np.zeros((len(text), max_length), dtype=np.int64)
+        for i, sentence in enumerate(text):
+            tokens = sentence.lower().split()[:max_length]
+            for j, tok in enumerate(tokens):
+                # stable across processes (unlike built-in hash with PYTHONHASHSEED)
+                h = 0
+                for ch in tok:
+                    h = (h * 1000003 + ord(ch)) & 0x7FFFFFFF
+                ids[i, j] = h
+                mask[i, j] = 1
+        return {"input_ids": ids, "attention_mask": mask}
+
+
+def _hash_embedding(input_ids: Array, attention_mask: Array) -> Array:
+    """Deterministic pseudo-random unit embedding per token id."""
+    def embed_one(token_id: Array) -> Array:
+        key = jax.random.fold_in(jax.random.PRNGKey(0), token_id)
+        vec = jax.random.normal(key, (_EMBED_DIM,))
+        return vec / jnp.linalg.norm(vec)
+
+    flat = jax.vmap(embed_one)(input_ids.reshape(-1))
+    return flat.reshape(*input_ids.shape, _EMBED_DIM) * attention_mask[..., None]
+
+
+def _compute_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
+    """Inverse-document-frequency weights over the reference corpus."""
+    num_docs = input_ids.shape[0]
+    doc_freq: Counter = Counter()
+    for i in range(num_docs):
+        doc_freq.update(set(int(t) for t, m in zip(input_ids[i], attention_mask[i]) if m))
+    return {tok: math.log((num_docs + 1) / (freq + 1)) for tok, freq in doc_freq.items()}
+
+
+def _idf_weights(input_ids: np.ndarray, attention_mask: np.ndarray, idf_map: Dict[int, float]) -> np.ndarray:
+    weights = np.zeros(input_ids.shape, dtype=np.float32)
+    for i in range(input_ids.shape[0]):
+        for j in range(input_ids.shape[1]):
+            if attention_mask[i, j]:
+                weights[i, j] = idf_map.get(int(input_ids[i, j]), math.log((input_ids.shape[0] + 1) / 1))
+    return weights
+
+
+@jax.jit
+def _greedy_cosine_matching(
+    pred_emb: Array, pred_mask: Array, tgt_emb: Array, tgt_mask: Array, pred_w: Array, tgt_w: Array
+) -> Tuple[Array, Array, Array]:
+    """Weighted greedy matching: each token pairs with its best cosine match."""
+    norm = lambda e: e / jnp.maximum(jnp.linalg.norm(e, axis=-1, keepdims=True), 1e-12)
+    sim = jnp.einsum("bpd,btd->bpt", norm(pred_emb), norm(tgt_emb))
+    neg = -1e9
+    sim_p = jnp.where(tgt_mask[:, None, :] > 0, sim, neg)
+    sim_t = jnp.where(pred_mask[:, :, None] > 0, sim, neg)
+    best_for_pred = jnp.max(sim_p, axis=2)  # (B, Lp)
+    best_for_tgt = jnp.max(sim_t, axis=1)  # (B, Lt)
+    precision = jnp.sum(best_for_pred * pred_w, axis=1) / jnp.maximum(jnp.sum(pred_w, axis=1), 1e-12)
+    recall = jnp.sum(best_for_tgt * tgt_w, axis=1) / jnp.maximum(jnp.sum(tgt_w, axis=1), 1e-12)
+    f1 = 2 * precision * recall / jnp.maximum(precision + recall, 1e-12)
+    return precision, recall, f1
+
+
+def bert_score(
+    preds: Union[str, Sequence[str], Dict[str, np.ndarray]],
+    target: Union[str, Sequence[str], Dict[str, np.ndarray]],
+    model_name_or_path: Optional[str] = None,
+    num_layers: Optional[int] = None,
+    all_layers: bool = False,
+    model: Optional[Any] = None,
+    user_tokenizer: Optional[Any] = None,
+    user_forward_fn: Optional[Callable[..., Array]] = None,
+    verbose: bool = False,
+    idf: bool = False,
+    device: Optional[str] = None,
+    max_length: int = _DEFAULT_MAX_LENGTH,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    return_hash: bool = False,
+    lang: str = "en",
+    rescale_with_baseline: bool = False,
+    baseline_path: Optional[str] = None,
+    baseline_url: Optional[str] = None,
+) -> Dict[str, Union[Array, List[float], str]]:
+    """BERTScore: greedy cosine matching of contextual embeddings.
+
+    ``user_forward_fn(model, input_ids, attention_mask) -> embeddings`` and
+    ``user_tokenizer(text, max_length) -> {"input_ids", "attention_mask"}``
+    plug in a real encoder; the default hash-embedding encoder only provides
+    self-consistent scores.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import bert_score
+        >>> score = bert_score(["hello there"], ["hello there"])
+        >>> round(float(score["f1"][0]), 2)
+        1.0
+    """
+    if rescale_with_baseline:
+        raise ValueError("`rescale_with_baseline` requires downloadable baseline files, unavailable in this build.")
+
+    tokenizer = user_tokenizer if user_tokenizer is not None else _HashTokenizer(max_length)
+    if user_tokenizer is None and model_name_or_path is not None:
+        rank_zero_warn(
+            "Pretrained checkpoints cannot be downloaded in this environment; `model_name_or_path`"
+            f" ({model_name_or_path!r}) is ignored and a hash-embedding encoder is used. Scores will be"
+            " self-consistent but will not match published BERTScore values."
+        )
+
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+
+    if isinstance(preds, dict):
+        pred_enc = {k: np.asarray(v) for k, v in preds.items()}
+    else:
+        pred_enc = {k: np.asarray(v) for k, v in tokenizer(list(preds), max_length).items()}
+    if isinstance(target, dict):
+        tgt_enc = {k: np.asarray(v) for k, v in target.items()}
+    else:
+        tgt_enc = {k: np.asarray(v) for k, v in tokenizer(list(target), max_length).items()}
+
+    if pred_enc["input_ids"].shape[0] != tgt_enc["input_ids"].shape[0]:
+        raise ValueError("Number of predicted and reference sententes must be the same!")
+
+    if idf:
+        idf_map = _compute_idf(tgt_enc["input_ids"], tgt_enc["attention_mask"])
+        pred_w = _idf_weights(pred_enc["input_ids"], pred_enc["attention_mask"], idf_map)
+        tgt_w = _idf_weights(tgt_enc["input_ids"], tgt_enc["attention_mask"], idf_map)
+    else:
+        pred_w = pred_enc["attention_mask"].astype(np.float32)
+        tgt_w = tgt_enc["attention_mask"].astype(np.float32)
+
+    pred_ids = jnp.asarray(pred_enc["input_ids"])
+    pred_mask = jnp.asarray(pred_enc["attention_mask"])
+    tgt_ids = jnp.asarray(tgt_enc["input_ids"])
+    tgt_mask = jnp.asarray(tgt_enc["attention_mask"])
+
+    if user_forward_fn is not None:
+        pred_emb = user_forward_fn(model, pred_ids, pred_mask)
+        tgt_emb = user_forward_fn(model, tgt_ids, tgt_mask)
+    elif model is not None and callable(model):
+        pred_emb = model(pred_ids, pred_mask)
+        tgt_emb = model(tgt_ids, tgt_mask)
+    else:
+        pred_emb = _hash_embedding(pred_ids, pred_mask)
+        tgt_emb = _hash_embedding(tgt_ids, tgt_mask)
+
+    precision, recall, f1 = _greedy_cosine_matching(
+        pred_emb, pred_mask, tgt_emb, tgt_mask, jnp.asarray(pred_w), jnp.asarray(tgt_w)
+    )
+    output: Dict[str, Union[Array, List[float], str]] = {"precision": precision, "recall": recall, "f1": f1}
+    if return_hash:
+        output["hash"] = f"tpu_hash_embed_dim{_EMBED_DIM}_len{max_length}"
+    return output

@@ -1,0 +1,127 @@
+"""BLEU score (reference ``functional/text/bleu.py``).
+
+N-gram counting is host work (strings); the accumulated count vectors are
+device state and the final geometric-mean/brevity-penalty math runs on device.
+"""
+
+from __future__ import annotations
+
+from collections import Counter
+from typing import Callable, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+
+def _count_ngram(tokens: Sequence[str], n_gram: int) -> Counter:
+    """Count all n-grams of order 1..n_gram in a token sequence."""
+    counter: Counter = Counter()
+    for n in range(1, n_gram + 1):
+        for j in range(len(tokens) - n + 1):
+            counter[tuple(tokens[j : j + n])] += 1
+    return counter
+
+
+def _tokenize_fn(sentence: str) -> Sequence[str]:
+    return sentence.split()
+
+
+def _bleu_score_update(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    tokenizer: Callable[[str], Sequence[str]] = _tokenize_fn,
+) -> Tuple[np.ndarray, np.ndarray, float, float]:
+    """Return batch (numerator, denominator, preds_len, target_len) statistics.
+
+    Multi-reference clipping: prediction n-gram counts are clipped against the
+    elementwise max over all references; reference length is the one closest to
+    the prediction length (ties break toward the shorter), matching
+    ``functional/text/bleu.py:60-106``.
+    """
+    target_tok = [[tokenizer(line) if line else [] for line in refs] for refs in target]
+    preds_tok = [tokenizer(line) if line else [] for line in preds]
+    numerator = np.zeros(n_gram)
+    denominator = np.zeros(n_gram)
+    preds_len = 0.0
+    target_len = 0.0
+
+    for pred, refs in zip(preds_tok, target_tok):
+        preds_len += len(pred)
+        ref_lens = [len(ref) for ref in refs]
+        diffs = [abs(len(pred) - x) for x in ref_lens]
+        target_len += ref_lens[diffs.index(min(diffs))]
+        preds_counter = _count_ngram(pred, n_gram)
+        target_counter: Counter = Counter()
+        for ref in refs:
+            target_counter |= _count_ngram(ref, n_gram)
+        clipped = preds_counter & target_counter
+        for ngram, cnt in clipped.items():
+            numerator[len(ngram) - 1] += cnt
+        for ngram, cnt in preds_counter.items():
+            denominator[len(ngram) - 1] += cnt
+
+    return numerator, denominator, preds_len, target_len
+
+
+def _bleu_score_compute(
+    preds_len: Array,
+    target_len: Array,
+    numerator: Array,
+    denominator: Array,
+    n_gram: int,
+    weights: Sequence[float],
+    smooth: bool,
+) -> Array:
+    """Corpus BLEU from accumulated statistics (device math)."""
+    if float(jnp.min(numerator)) == 0.0:
+        return jnp.asarray(0.0)
+    if smooth:
+        precision = (numerator + 1.0) / (denominator + 1.0)
+        precision = precision.at[0].set(numerator[0] / denominator[0])
+    else:
+        precision = numerator / denominator
+    log_precision = jnp.asarray(weights) * jnp.log(precision)
+    geometric_mean = jnp.exp(jnp.sum(log_precision))
+    brevity = jnp.where(preds_len > target_len, 1.0, jnp.exp(1 - target_len / preds_len))
+    return brevity * geometric_mean
+
+
+def bleu_score(
+    preds: Union[str, Sequence[str]],
+    target: Sequence[Union[str, Sequence[str]]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """BLEU score of machine-translated text against one or more references.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import bleu_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> float(bleu_score(preds, target))  # doctest: +ELLIPSIS
+        0.7598...
+    """
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    numerator, denominator, preds_len, target_len = _bleu_score_update(preds_, target_, n_gram)
+    return _bleu_score_compute(
+        jnp.asarray(preds_len),
+        jnp.asarray(target_len),
+        jnp.asarray(numerator),
+        jnp.asarray(denominator),
+        n_gram,
+        weights,
+        smooth,
+    )

@@ -1,0 +1,38 @@
+"""Character error rate (reference ``functional/text/cer.py``)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance_tokens, _validate_text_inputs
+
+Array = jax.Array
+
+
+def _cer_update(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Tuple[Array, Array]:
+    """Return (total character edits, total reference characters) for the batch."""
+    preds_list, target_list = _validate_text_inputs(preds, target)
+    pred_chars = [list(p) for p in preds_list]
+    tgt_chars = [list(t) for t in target_list]
+    errors = jnp.sum(_edit_distance_tokens(pred_chars, tgt_chars))
+    total = jnp.asarray(float(sum(len(t) for t in tgt_chars)))
+    return errors, total
+
+
+def _cer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def char_error_rate(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Array:
+    """Character error rate for automatic-speech-recognition output.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import char_error_rate
+        >>> float(char_error_rate(preds=["this is the prediction"], target=["this is the reference"]))  # doctest: +ELLIPSIS
+        0.3181...
+    """
+    errors, total = _cer_update(preds, target)
+    return _cer_compute(errors, total)

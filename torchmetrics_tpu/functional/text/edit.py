@@ -1,0 +1,61 @@
+"""Levenshtein edit distance (reference ``functional/text/edit.py``)."""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance_tokens, _validate_text_inputs
+
+Array = jax.Array
+
+
+def _edit_distance_update(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    substitution_cost: int = 1,
+) -> Array:
+    """Per-sample character-level edit distances via the batched device kernel."""
+    preds_list, target_list = _validate_text_inputs(preds, target)
+    if not all(isinstance(x, str) for x in preds_list):
+        raise ValueError(f"Expected all values in argument `preds` to be string type, but got {preds_list}")
+    if not all(isinstance(x, str) for x in target_list):
+        raise ValueError(f"Expected all values in argument `target` to be string type, but got {target_list}")
+    return _edit_distance_tokens(
+        [list(p) for p in preds_list], [list(t) for t in target_list], substitution_cost=substitution_cost
+    )
+
+
+def _edit_distance_compute(
+    edit_scores: Array,
+    num_elements: Union[Array, int],
+    reduction: Optional[str] = "mean",
+) -> Array:
+    if edit_scores.size == 0:
+        return jnp.asarray(0, dtype=jnp.int32)
+    if reduction == "mean":
+        return jnp.sum(edit_scores) / num_elements
+    if reduction == "sum":
+        return jnp.sum(edit_scores)
+    if reduction is None or reduction == "none":
+        return edit_scores
+    raise ValueError("Expected argument `reduction` to either be 'sum', 'mean', 'none' or None")
+
+
+def edit_distance(
+    preds: Union[str, Sequence[str]],
+    target: Union[str, Sequence[str]],
+    substitution_cost: int = 1,
+    reduction: Optional[str] = "mean",
+) -> Array:
+    """Character-level Levenshtein edit distance.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import edit_distance
+        >>> float(edit_distance(["rain"], ["shine"]))
+        3.0
+    """
+    distance = _edit_distance_update(preds, target, substitution_cost)
+    return _edit_distance_compute(distance, num_elements=distance.shape[0], reduction=reduction)

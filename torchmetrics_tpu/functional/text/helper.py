@@ -1,0 +1,179 @@
+"""Shared text-metric machinery (reference ``functional/text/helper.py``).
+
+TPU-first design: tokenization happens on the host (strings are not device
+work, see SURVEY §2.12), but the O(L₁·L₂) dynamic programs that dominate the
+edit-distance family run on device as a *batched* kernel. Each DP row update
+is fully vectorized: the ordinarily-sequential ``new_row[j-1] + 1`` insertion
+chain unrolls to ``min_{k<=j}(candidate[k] + (j-k))``, a min-plus prefix scan
+computed with ``jax.lax.associative_scan`` — so one row costs O(log L) depth
+instead of O(L), and the whole batch is one ``vmap``-ed XLA program instead of
+the reference's per-sample Python loop (``functional/text/wer.py:44-49``).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import List, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+_PAD_ID = -1
+
+
+def _validate_text_inputs(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Tuple[List[str], List[str]]:
+    """Normalize ``(preds, target)`` to equal-length lists of strings."""
+    preds_list = [preds] if isinstance(preds, str) else list(preds)
+    target_list = [target] if isinstance(target, str) else list(target)
+    if len(preds_list) != len(target_list):
+        raise ValueError(
+            f"Arguments `preds` and `target` must have the same length, but got {len(preds_list)} and {len(target_list)}"
+        )
+    return preds_list, target_list
+
+
+def _bucket_len(n: int, minimum: int = 8) -> int:
+    """Round up to a power of two to bound jit recompilations across batches."""
+    size = minimum
+    while size < n:
+        size *= 2
+    return size
+
+
+def _encode_batch(
+    preds_tokens: Sequence[Sequence[str]], target_tokens: Sequence[Sequence[str]]
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray]:
+    """Map token sequences to padded integer id matrices + length vectors.
+
+    A fresh vocabulary is built per batch (ids only need to be consistent
+    within one kernel launch; equality is all the DP consumes).
+    """
+    vocab: dict = {}
+
+    def ids(tokens: Sequence[str]) -> List[int]:
+        out = []
+        for tok in tokens:
+            if tok not in vocab:
+                vocab[tok] = len(vocab)
+            out.append(vocab[tok])
+        return out
+
+    pred_ids = [ids(t) for t in preds_tokens]
+    tgt_ids = [ids(t) for t in target_tokens]
+    max_p = _bucket_len(max((len(t) for t in pred_ids), default=1))
+    max_t = _bucket_len(max((len(t) for t in tgt_ids), default=1))
+
+    def pad(seqs: List[List[int]], width: int) -> np.ndarray:
+        out = np.full((len(seqs), width), _PAD_ID, dtype=np.int32)
+        for i, s in enumerate(seqs):
+            out[i, : len(s)] = s
+        return out
+
+    return (
+        pad(pred_ids, max_p),
+        np.asarray([len(s) for s in pred_ids], dtype=np.int32),
+        pad(tgt_ids, max_t),
+        np.asarray([len(s) for s in tgt_ids], dtype=np.int32),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("substitution_cost",))
+def _levenshtein_batch(
+    pred_ids: Array, pred_len: Array, tgt_ids: Array, tgt_len: Array, substitution_cost: int = 1
+) -> Array:
+    """Batched Levenshtein distance, one fused XLA program.
+
+    ``row[j]`` holds the edit distance between the first ``i`` prediction
+    tokens and the first ``j`` target tokens. Row recurrence for token ``a_i``::
+
+        candidate[j] = min(row[j] + 1, row[j-1] + c·[a_i != tgt[j-1]])
+        new_row[j]   = min_{k<=j} candidate[k] + (j - k)     (insertion chain)
+
+    The second line is ``cummin(candidate - j) + j`` — an associative scan.
+    Padded prediction positions pass the row through unchanged; the answer is
+    ``row[tgt_len]`` so padded target positions never contribute.
+    """
+    n_t = tgt_ids.shape[1]
+    offsets = jnp.arange(n_t + 1, dtype=jnp.float32)
+
+    def one_pair(p_ids: Array, p_len: Array, t_ids: Array, t_len: Array) -> Array:
+        init_row = offsets  # empty prediction: j insertions
+
+        def step(row: Array, xs: Tuple[Array, Array]) -> Tuple[Array, None]:
+            token, idx = xs
+            sub_cost = jnp.where(t_ids == token, 0.0, float(substitution_cost))
+            candidate = jnp.minimum(row[1:] + 1.0, row[:-1] + sub_cost)
+            candidate = jnp.concatenate([row[:1] + 1.0, candidate])
+            new_row = jax.lax.associative_scan(jnp.minimum, candidate - offsets) + offsets
+            return jnp.where(idx < p_len, new_row, row), None
+
+        row, _ = jax.lax.scan(step, init_row, (p_ids, jnp.arange(p_ids.shape[0])))
+        return row[t_len]
+
+    return jax.vmap(one_pair)(pred_ids, pred_len, tgt_ids, tgt_len)
+
+
+@jax.jit
+def _lcs_batch(pred_ids: Array, pred_len: Array, tgt_ids: Array, tgt_len: Array) -> Array:
+    """Batched longest-common-subsequence length via prefix-max row updates.
+
+    ``new_row[j] = max(candidate[j], new_row[j-1])`` unrolls to a cummax, so
+    the LCS table (ref ``functional/text/rouge.py:95-116``) becomes a scan of
+    vectorized rows instead of a Python double loop.
+    """
+
+    def one_pair(p_ids: Array, p_len: Array, t_ids: Array, t_len: Array) -> Array:
+        n_t = t_ids.shape[0]
+        valid_t = jnp.arange(n_t) < t_len
+        init_row = jnp.zeros(n_t + 1, dtype=jnp.float32)
+
+        def step(row: Array, xs: Tuple[Array, Array]) -> Tuple[Array, None]:
+            token, idx = xs
+            eq = jnp.where((t_ids == token) & valid_t, 1.0, 0.0)
+            candidate = jnp.maximum(row[1:], row[:-1] + eq)
+            candidate = jnp.concatenate([row[:1], candidate])
+            new_row = jax.lax.associative_scan(jnp.maximum, candidate)
+            return jnp.where(idx < p_len, new_row, row), None
+
+        row, _ = jax.lax.scan(step, init_row, (p_ids, jnp.arange(p_ids.shape[0])))
+        return row[t_len]
+
+    return jax.vmap(one_pair)(pred_ids, pred_len, tgt_ids, tgt_len)
+
+
+def _edit_distance_tokens(
+    preds_tokens: Sequence[Sequence[str]],
+    target_tokens: Sequence[Sequence[str]],
+    substitution_cost: int = 1,
+) -> Array:
+    """Per-sample Levenshtein distances for pre-tokenized batches (device path)."""
+    if not preds_tokens:
+        return jnp.zeros((0,), dtype=jnp.float32)
+    p_ids, p_len, t_ids, t_len = _encode_batch(preds_tokens, target_tokens)
+    return _levenshtein_batch(
+        jnp.asarray(p_ids), jnp.asarray(p_len), jnp.asarray(t_ids), jnp.asarray(t_len), substitution_cost
+    )
+
+
+def _lcs_tokens(
+    preds_tokens: Sequence[Sequence[str]], target_tokens: Sequence[Sequence[str]]
+) -> Array:
+    """Per-sample LCS lengths for pre-tokenized batches (device path)."""
+    if not preds_tokens:
+        return jnp.zeros((0,), dtype=jnp.float32)
+    p_ids, p_len, t_ids, t_len = _encode_batch(preds_tokens, target_tokens)
+    return _lcs_batch(jnp.asarray(p_ids), jnp.asarray(p_len), jnp.asarray(t_ids), jnp.asarray(t_len))
+
+
+def _edit_distance_host(prediction_tokens: Sequence[str], reference_tokens: Sequence[str]) -> int:
+    """Single-pair host Levenshtein (used by host-only algorithms like TER)."""
+    prev = list(range(len(reference_tokens) + 1))
+    for i, p_tok in enumerate(prediction_tokens, start=1):
+        cur = [i] + [0] * len(reference_tokens)
+        for j, r_tok in enumerate(reference_tokens, start=1):
+            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (p_tok != r_tok))
+        prev = cur
+    return prev[-1]

@@ -1,0 +1,266 @@
+"""InfoLM (reference ``functional/text/infolm.py``).
+
+Information measures between masked-LM token distributions of prediction and
+reference sentences. The per-position mask-and-predict loop runs as a
+``lax.scan`` over sequence positions with the measure math fully on device.
+
+A real pretrained masked LM cannot be downloaded here; the default model is a
+deterministic hash-logit function (self-consistent scores only). Pass a
+``model`` callable ``(input_ids, attention_mask) -> logits`` for real use.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.text.bert import _HashTokenizer
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+_ALLOWED_INFORMATION_MEASURE = (
+    "kl_divergence",
+    "alpha_divergence",
+    "beta_divergence",
+    "ab_divergence",
+    "renyi_divergence",
+    "l1_distance",
+    "l2_distance",
+    "l_infinity_distance",
+    "fisher_rao_distance",
+)
+
+_DEFAULT_VOCAB = 2048
+_DEFAULT_SPECIAL_TOKENS = {"pad_token_id": 0, "cls_token_id": 101, "sep_token_id": 102, "mask_token_id": 103}
+
+
+class _InformationMeasure:
+    """Vectorized information measures between discrete distributions.
+
+    ``alpha``/``beta`` validation matches the reference (``infolm.py:104-139``).
+    """
+
+    def __init__(self, information_measure: str, alpha: Optional[float] = None, beta: Optional[float] = None) -> None:
+        if information_measure not in _ALLOWED_INFORMATION_MEASURE:
+            raise ValueError(
+                f"Argument `information_measure` expected to be one of {_ALLOWED_INFORMATION_MEASURE}"
+                f" but got {information_measure!r}."
+            )
+        self.information_measure = information_measure
+        if information_measure in ("alpha_divergence", "ab_divergence", "renyi_divergence"):
+            if not isinstance(alpha, float) or alpha in (0, 1):
+                raise ValueError(f"Parameter `alpha` is expected to be a float differing from 0 and 1 but got {alpha}.")
+        if information_measure in ("beta_divergence", "ab_divergence"):
+            if not isinstance(beta, float) or beta == 0:
+                raise ValueError(f"Parameter `beta` is expected to be a non-zero float but got {beta}.")
+        if information_measure == "ab_divergence" and (alpha is None or beta is None or (alpha + beta) == 0):
+            raise ValueError("Parameters `alpha` and `beta` cannot sum to 0 for AB divergence.")
+        self.alpha = alpha
+        self.beta = beta
+
+    def __call__(self, preds_distribution: Array, target_distribution: Array) -> Array:
+        fn = getattr(self, f"_calculate_{self.information_measure}")
+        return jnp.nan_to_num(fn(preds_distribution, target_distribution))
+
+    @staticmethod
+    def _calculate_kl_divergence(p: Array, t: Array) -> Array:
+        return jnp.sum(t * jnp.log(p / t), axis=-1)
+
+    def _calculate_alpha_divergence(self, p: Array, t: Array) -> Array:
+        denom = self.alpha * (self.alpha - 1)
+        return (1 - jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / denom
+
+    def _calculate_ab_divergence(self, p: Array, t: Array) -> Array:
+        a = jnp.log(jnp.sum(t ** (self.beta + self.alpha), axis=-1)) / (self.beta * (self.beta + self.alpha))
+        b = jnp.log(jnp.sum(p ** (self.beta + self.alpha), axis=-1)) / (self.alpha * (self.beta + self.alpha))
+        c = jnp.log(jnp.sum(t**self.alpha * p**self.beta, axis=-1)) / (self.alpha * self.beta)
+        return a + b - c
+
+    def _calculate_beta_divergence(self, p: Array, t: Array) -> Array:
+        self.alpha = 1.0
+        return self._calculate_ab_divergence(p, t)
+
+    def _calculate_renyi_divergence(self, p: Array, t: Array) -> Array:
+        return jnp.log(jnp.sum(t**self.alpha * p ** (1 - self.alpha), axis=-1)) / (self.alpha - 1)
+
+    @staticmethod
+    def _calculate_l1_distance(p: Array, t: Array) -> Array:
+        return jnp.sum(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _calculate_l2_distance(p: Array, t: Array) -> Array:
+        return jnp.sqrt(jnp.sum((t - p) ** 2, axis=-1))
+
+    @staticmethod
+    def _calculate_l_infinity_distance(p: Array, t: Array) -> Array:
+        return jnp.max(jnp.abs(t - p), axis=-1)
+
+    @staticmethod
+    def _calculate_fisher_rao_distance(p: Array, t: Array) -> Array:
+        return 2 * jnp.arccos(jnp.clip(jnp.sum(jnp.sqrt(p * t), axis=-1), 0, 1))
+
+
+def _default_hash_model(input_ids: Array, attention_mask: Array) -> Array:
+    """Deterministic pseudo-logits that are *context-sensitive*: each position
+    gets its own random row plus the mean row of every valid token in the
+    sentence, so the distribution read at a masked position still depends on
+    the surrounding words (a context-free table would collapse every masked
+    position to one constant distribution and score all corpora as 0)."""
+
+    def logits_one(token_id: Array) -> Array:
+        key = jax.random.fold_in(jax.random.PRNGKey(7), token_id % _DEFAULT_VOCAB)
+        return jax.random.normal(key, (_DEFAULT_VOCAB,))
+
+    flat = jax.vmap(logits_one)(input_ids.reshape(-1))
+    rows = flat.reshape(*input_ids.shape, _DEFAULT_VOCAB)
+    mask = attention_mask.astype(jnp.float32)
+    context = jnp.sum(rows * mask[..., None], axis=1, keepdims=True) / jnp.maximum(
+        jnp.sum(mask, axis=1)[:, None, None], 1.0
+    )
+    return rows + context
+
+
+def _get_token_mask(input_ids: Array, pad_token_id: int, sep_token_id: int, cls_token_id: int) -> Array:
+    mask = ~jnp.isin(input_ids, jnp.asarray([pad_token_id, sep_token_id, cls_token_id]))
+    return mask.astype(jnp.float32)
+
+
+def _get_sentence_distribution(
+    model_fn: Callable[[Array, Array], Array],
+    input_ids: Array,
+    attention_mask: Array,
+    temperature: float,
+    idf_weights: Optional[Array],
+    special_tokens_map: Dict[str, int],
+) -> Array:
+    """Per-sentence token distribution: mask each position, softmax the MLM
+    logits there, average over non-special positions (``infolm.py:367-421``)."""
+    seq_len = input_ids.shape[1]
+    token_mask = _get_token_mask(
+        input_ids,
+        special_tokens_map["pad_token_id"],
+        special_tokens_map["sep_token_id"],
+        special_tokens_map["cls_token_id"],
+    )
+
+    def one_position(mask_idx: Array) -> Array:
+        masked_ids = input_ids.at[:, mask_idx].set(special_tokens_map["mask_token_id"])
+        logits = model_fn(masked_ids, attention_mask)[:, mask_idx, :]
+        prob = jax.nn.softmax(logits / temperature, axis=-1)
+        if idf_weights is not None:
+            prob = prob * idf_weights[:, mask_idx][:, None]
+        return prob
+
+    # (L, B, V) stacked per-position distributions
+    probs = jax.lax.map(one_position, jnp.arange(seq_len))
+    probs = jnp.einsum("bsv,bs->bsv", jnp.swapaxes(probs, 0, 1), token_mask)
+    if idf_weights is not None:
+        denom = jnp.sum(token_mask * idf_weights, axis=1)[:, None]
+    else:
+        denom = jnp.sum(token_mask, axis=1)[:, None]
+    return jnp.sum(probs, axis=1) / jnp.maximum(denom, 1e-12)
+
+
+def _compute_idf_array(input_ids: np.ndarray, attention_mask: np.ndarray) -> np.ndarray:
+    """Token-level IDF weights over the given corpus."""
+    num_docs = max(input_ids.shape[0], 1)
+    doc_freq: Dict[int, int] = {}
+    for i in range(input_ids.shape[0]):
+        for tok in set(int(t) for t, m in zip(input_ids[i], attention_mask[i]) if m):
+            doc_freq[tok] = doc_freq.get(tok, 0) + 1
+    out = np.zeros(input_ids.shape, dtype=np.float32)
+    for i in range(input_ids.shape[0]):
+        for j in range(input_ids.shape[1]):
+            if attention_mask[i, j]:
+                out[i, j] = np.log((num_docs + 1) / (doc_freq.get(int(input_ids[i, j]), 0) + 1))
+    return out
+
+
+def infolm(
+    preds: Union[str, Sequence[str], Dict[str, np.ndarray]],
+    target: Union[str, Sequence[str], Dict[str, np.ndarray]],
+    model_name_or_path: Optional[str] = None,
+    temperature: float = 0.25,
+    information_measure: str = "kl_divergence",
+    idf: bool = True,
+    alpha: Optional[float] = None,
+    beta: Optional[float] = None,
+    device: Optional[str] = None,
+    max_length: Optional[int] = None,
+    batch_size: int = 64,
+    num_threads: int = 0,
+    verbose: bool = True,
+    return_sentence_level_score: bool = False,
+    model: Optional[Callable[[Array, Array], Array]] = None,
+    tokenizer: Optional[Any] = None,
+    special_tokens_map: Optional[Dict[str, int]] = None,
+) -> Union[Array, Tuple[Array, Array]]:
+    """InfoLM: information measure between masked-LM token distributions.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import infolm
+        >>> preds = ['he read the book because he was interested in world history']
+        >>> target = ['he was interested in world history because he read the book']
+        >>> score = infolm(preds, target, information_measure='l2_distance', idf=False)
+        >>> bool(score >= 0)
+        True
+    """
+    if isinstance(preds, str):
+        preds = [preds]
+    if isinstance(target, str):
+        target = [target]
+
+    max_length = max_length or 64
+    measure = _InformationMeasure(information_measure, alpha, beta)
+    special = dict(_DEFAULT_SPECIAL_TOKENS)
+    if special_tokens_map:
+        special.update(special_tokens_map)
+
+    tok = tokenizer if tokenizer is not None else _HashTokenizer(max_length)
+    if tokenizer is None and model_name_or_path is not None:
+        rank_zero_warn(
+            "Pretrained checkpoints cannot be downloaded in this environment; `model_name_or_path`"
+            f" ({model_name_or_path!r}) is ignored and a hash-logit model is used. Scores are"
+            " self-consistent but do not match published InfoLM values."
+        )
+    model_fn = model if model is not None else _default_hash_model
+
+    def encode(data) -> Tuple[np.ndarray, np.ndarray]:
+        if isinstance(data, dict):
+            return np.asarray(data["input_ids"]), np.asarray(data["attention_mask"])
+        enc = tok(list(data), max_length)
+        return np.asarray(enc["input_ids"]), np.asarray(enc["attention_mask"])
+
+    pred_ids, pred_mask = encode(preds)
+    tgt_ids, tgt_mask = encode(target)
+    if pred_ids.shape[0] != tgt_ids.shape[0]:
+        raise ValueError("Number of predicted and reference sententes must be the same!")
+    if model is None:
+        # keep hash ids inside the toy vocab, away from special ids
+        remap = lambda ids: np.where(ids > 0, (ids % (_DEFAULT_VOCAB - 200)) + 200, ids)
+        pred_ids = remap(pred_ids)
+        tgt_ids = remap(tgt_ids)
+
+    if idf:
+        pred_idf = jnp.asarray(_compute_idf_array(pred_ids, pred_mask))
+        tgt_idf = jnp.asarray(_compute_idf_array(tgt_ids, tgt_mask))
+    else:
+        pred_idf = tgt_idf = None
+
+    preds_distribution = _get_sentence_distribution(
+        model_fn, jnp.asarray(pred_ids), jnp.asarray(pred_mask), temperature, pred_idf, special
+    )
+    target_distribution = _get_sentence_distribution(
+        model_fn, jnp.asarray(tgt_ids), jnp.asarray(tgt_mask), temperature, tgt_idf, special
+    )
+
+    sentence_scores = measure(preds_distribution, target_distribution)
+    corpus = jnp.mean(sentence_scores)
+    if return_sentence_level_score:
+        return corpus, sentence_scores
+    return corpus

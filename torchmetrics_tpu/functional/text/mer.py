@@ -1,0 +1,40 @@
+"""Match error rate (reference ``functional/text/mer.py``)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance_tokens, _validate_text_inputs
+
+Array = jax.Array
+
+
+def _mer_update(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Tuple[Array, Array]:
+    """Return (total edits, sum of max(len(pred), len(target)) words)."""
+    preds_list, target_list = _validate_text_inputs(preds, target)
+    pred_tokens = [p.split() for p in preds_list]
+    tgt_tokens = [t.split() for t in target_list]
+    errors = jnp.sum(_edit_distance_tokens(pred_tokens, tgt_tokens))
+    total = jnp.asarray(float(sum(max(len(p), len(t)) for p, t in zip(pred_tokens, tgt_tokens))))
+    return errors, total
+
+
+def _mer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def match_error_rate(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Array:
+    """Match error rate for automatic-speech-recognition output.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import match_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> float(match_error_rate(preds=preds, target=target))  # doctest: +ELLIPSIS
+        0.444...
+    """
+    errors, total = _mer_update(preds, target)
+    return _mer_compute(errors, total)

@@ -1,0 +1,75 @@
+"""Perplexity (reference ``functional/text/perplexity.py``).
+
+Pure device math: log-softmax gather + masked sum, jit-safe with an
+``ignore_index`` mask instead of boolean filtering.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_shape_and_type_consistency(preds: Array, target: Array) -> None:
+    if preds.ndim != 3:
+        raise ValueError(
+            "Input tensor `preds` is expected to have 3 dimensions, [batch_size, seq_len, vocab_size],"
+            f" but got {preds.ndim}."
+        )
+    if target.ndim != 2:
+        raise ValueError(
+            f"Input tensor `target` is expected to have 2 dimensions, [batch_size, seq_len], but got {target.ndim}."
+        )
+    if preds.shape[:2] != target.shape:
+        raise ValueError(
+            "Input tensors `preds` and `target` are expected to have equaling first two dimensions,"
+            f" [batch_size, seq_len], but got {preds.shape[:2]} and {target.shape}."
+        )
+    if not jnp.issubdtype(preds.dtype, jnp.floating):
+        raise TypeError(f"Input tensor `preds` is expected to be of floating point type but got {preds.dtype}.")
+    if not jnp.issubdtype(target.dtype, jnp.integer):
+        raise TypeError(f"Input tensor `target` is expected to be of integer type but got {target.dtype}.")
+
+
+@functools.partial(jax.jit, static_argnames=("ignore_index",))
+def _perplexity_update_kernel(preds: Array, target: Array, ignore_index: Optional[int]) -> Tuple[Array, Array]:
+    log_probs = jax.nn.log_softmax(preds.reshape(-1, preds.shape[-1]).astype(jnp.float32), axis=-1)
+    target = target.reshape(-1)
+    if ignore_index is not None:
+        mask = target != ignore_index
+        safe_target = jnp.where(mask, target, 0)
+    else:
+        mask = jnp.ones_like(target, dtype=bool)
+        safe_target = target
+    picked = jnp.take_along_axis(log_probs, safe_target[:, None], axis=1)[:, 0]
+    total_log_probs = -jnp.sum(jnp.where(mask, picked, 0.0))
+    count = jnp.sum(mask)
+    return total_log_probs, count
+
+
+def _perplexity_update(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Tuple[Array, Array]:
+    _check_shape_and_type_consistency(preds, target)
+    return _perplexity_update_kernel(preds, target, ignore_index)
+
+
+def _perplexity_compute(total: Array, count: Array) -> Array:
+    return jnp.exp(total / count)
+
+
+def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) -> Array:
+    """Perplexity of a language-model prediction.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> preds = jax.random.uniform(jax.random.PRNGKey(22), (2, 8, 5))
+        >>> target = jax.random.randint(jax.random.PRNGKey(89), (2, 8), 0, 5)
+        >>> float(perplexity(preds, target, ignore_index=-100))  # doctest: +ELLIPSIS
+        5.2...
+    """
+    total, count = _perplexity_update(preds, target, ignore_index)
+    return _perplexity_compute(total, count)

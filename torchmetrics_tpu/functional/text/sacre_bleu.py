@@ -1,0 +1,193 @@
+"""SacreBLEU (reference ``functional/text/sacre_bleu.py``).
+
+BLEU with standardized tokenizers. The ``intl`` tokenizer is implemented with
+``unicodedata`` categories instead of the third-party ``regex`` package the
+reference requires; ``ja-mecab``/``ko-mecab``/``flores*`` need external
+tokenizer models unavailable here and raise.
+"""
+
+from __future__ import annotations
+
+import re
+import unicodedata
+from functools import partial
+from typing import Optional, Sequence, Union
+
+import jax
+
+from torchmetrics_tpu.functional.text.bleu import _bleu_score_update, _bleu_score_compute
+import jax.numpy as jnp
+
+Array = jax.Array
+
+AVAILABLE_TOKENIZERS = ("none", "13a", "zh", "intl", "char")
+
+_13A_REGEX = (
+    # language-dependent part (assuming Western languages)
+    (re.compile(r"([\{-\~\[-\` -\&\(-\+\:-\@\/])"), r" \1 "),
+    # tokenize period and comma unless preceded by a digit
+    (re.compile(r"([^0-9])([\.,])"), r"\1 \2 "),
+    # tokenize period and comma unless followed by a digit
+    (re.compile(r"([\.,])([^0-9])"), r" \1 \2"),
+    # tokenize dash when preceded by a digit
+    (re.compile(r"([0-9])(-)"), r"\1 \2 "),
+)
+
+_CJK_RANGES = (
+    (0x3400, 0x4DB5),
+    (0x4E00, 0x9FA5),
+    (0x9FA6, 0x9FBB),
+    (0xF900, 0xFA2D),
+    (0xFA30, 0xFA6A),
+    (0xFA70, 0xFAD9),
+    (0x20000, 0x2A6D6),
+    (0x2F800, 0x2FA1D),
+    (0xFF00, 0xFFEF),
+    (0x2E80, 0x2EFF),
+    (0x3000, 0x303F),
+    (0x31C0, 0x31EF),
+    (0x2F00, 0x2FDF),
+    (0x2FF0, 0x2FFF),
+    (0x3100, 0x312F),
+    (0x31A0, 0x31BF),
+    (0xFE10, 0xFE1F),
+    (0xFE30, 0xFE4F),
+    (0x2600, 0x26FF),
+    (0x2700, 0x27BF),
+    (0x3200, 0x32FF),
+    (0x3300, 0x33FF),
+)
+
+
+def _is_chinese_char(char: str) -> bool:
+    cp = ord(char)
+    return any(lo <= cp <= hi for lo, hi in _CJK_RANGES)
+
+
+class _SacreBLEUTokenizer:
+    """Standardized sacrebleu-style tokenization (mteval-v13a / zh / intl / char)."""
+
+    def __init__(self, tokenize: str, lowercase: bool = False) -> None:
+        self._check_tokenizers_validity(tokenize)
+        self.tokenize_fn = getattr(self, f"_tokenize_{tokenize.replace('intl', 'international').replace('none', 'base')}")
+        self.lowercase = lowercase
+
+    def __call__(self, line: str) -> Sequence[str]:
+        tokenized = self.tokenize_fn(line)
+        return self._lower(tokenized, self.lowercase).split()
+
+    @classmethod
+    def tokenize(cls, line: str, tokenize: str, lowercase: bool = False) -> Sequence[str]:
+        cls._check_tokenizers_validity(tokenize)
+        fn = getattr(cls, f"_tokenize_{tokenize.replace('intl', 'international').replace('none', 'base')}")
+        return cls._lower(fn(line), lowercase).split()
+
+    @classmethod
+    def _tokenize_regex(cls, line: str) -> str:
+        for pattern, repl in _13A_REGEX:
+            line = pattern.sub(repl, line)
+        return " ".join(line.split())
+
+    @classmethod
+    def _tokenize_base(cls, line: str) -> str:
+        return line
+
+    @classmethod
+    def _tokenize_13a(cls, line: str) -> str:
+        line = line.replace("<skipped>", "").replace("-\n", "").replace("\n", " ")
+        if "&" in line:
+            line = line.replace("&quot;", '"').replace("&amp;", "&").replace("&lt;", "<").replace("&gt;", ">")
+        return cls._tokenize_regex(f" {line} ")
+
+    @classmethod
+    def _tokenize_zh(cls, line: str) -> str:
+        line = line.strip()
+        out = []
+        for char in line:
+            if _is_chinese_char(char):
+                out.append(f" {char} ")
+            else:
+                out.append(char)
+        return cls._tokenize_regex("".join(out))
+
+    @classmethod
+    def _tokenize_international(cls, line: str) -> str:
+        # Mirror mteval-v14's three substitutions using unicodedata categories:
+        # split punctuation off non-digits, and isolate symbols.
+        out = []
+        chars = list(line)
+        n = len(chars)
+        for i, ch in enumerate(chars):
+            cat = unicodedata.category(ch)
+            if cat.startswith("P"):
+                prev_is_digit = i > 0 and unicodedata.category(chars[i - 1]).startswith("N")
+                next_is_digit = i + 1 < n and unicodedata.category(chars[i + 1]).startswith("N")
+                if not prev_is_digit and not next_is_digit:
+                    out.append(f" {ch} ")
+                elif not prev_is_digit:
+                    out.append(f" {ch}")
+                elif not next_is_digit:
+                    out.append(f"{ch} ")
+                else:
+                    out.append(ch)
+            elif cat.startswith("S"):
+                out.append(f" {ch} ")
+            else:
+                out.append(ch)
+        return " ".join("".join(out).split())
+
+    @classmethod
+    def _tokenize_char(cls, line: str) -> str:
+        return " ".join(char for char in line)
+
+    @staticmethod
+    def _lower(line: str, lowercase: bool) -> str:
+        return line.lower() if lowercase else line
+
+    @classmethod
+    def _check_tokenizers_validity(cls, tokenize: str) -> None:
+        if tokenize not in AVAILABLE_TOKENIZERS:
+            raise ValueError(
+                f"Argument `tokenize` expected to be one of {AVAILABLE_TOKENIZERS} but got {tokenize!r}."
+                " (`ja-mecab`/`ko-mecab`/`flores*` need external tokenizer models unavailable in this build.)"
+            )
+
+
+def sacre_bleu_score(
+    preds: Sequence[str],
+    target: Sequence[Sequence[str]],
+    n_gram: int = 4,
+    smooth: bool = False,
+    tokenize: str = "13a",
+    lowercase: bool = False,
+    weights: Optional[Sequence[float]] = None,
+) -> Array:
+    """SacreBLEU: BLEU with a standardized tokenizer.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import sacre_bleu_score
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> float(sacre_bleu_score(preds, target))  # doctest: +ELLIPSIS
+        0.7598...
+    """
+    preds_ = [preds] if isinstance(preds, str) else list(preds)
+    target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+    if len(preds_) != len(target_):
+        raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+    if weights is not None and len(weights) != n_gram:
+        raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+    if weights is None:
+        weights = [1.0 / n_gram] * n_gram
+
+    tokenize_fn = partial(_SacreBLEUTokenizer.tokenize, tokenize=tokenize, lowercase=lowercase)
+    numerator, denominator, preds_len, target_len = _bleu_score_update(preds_, target_, n_gram, tokenize_fn)
+    return _bleu_score_compute(
+        jnp.asarray(preds_len),
+        jnp.asarray(target_len),
+        jnp.asarray(numerator),
+        jnp.asarray(denominator),
+        n_gram,
+        weights,
+        smooth,
+    )

@@ -1,0 +1,44 @@
+"""Word error rate (reference ``functional/text/wer.py``)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance_tokens, _validate_text_inputs
+
+Array = jax.Array
+
+
+def _wer_update(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Tuple[Array, Array]:
+    """Return (total edit operations, total reference words) for the batch.
+
+    The per-sample distances come from one batched device kernel rather than
+    the reference's per-sample Python DP (``functional/text/wer.py:44-49``).
+    """
+    preds_list, target_list = _validate_text_inputs(preds, target)
+    pred_tokens = [p.split() for p in preds_list]
+    tgt_tokens = [t.split() for t in target_list]
+    errors = jnp.sum(_edit_distance_tokens(pred_tokens, tgt_tokens))
+    total = jnp.asarray(float(sum(len(t) for t in tgt_tokens)))
+    return errors, total
+
+
+def _wer_compute(errors: Array, total: Array) -> Array:
+    return errors / total
+
+
+def word_error_rate(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Array:
+    """Word error rate for automatic-speech-recognition output.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import word_error_rate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> float(word_error_rate(preds=preds, target=target))
+        0.5
+    """
+    errors, total = _wer_update(preds, target)
+    return _wer_compute(errors, total)

@@ -1,0 +1,49 @@
+"""Word information lost (reference ``functional/text/wil.py``)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.helper import _edit_distance_tokens, _validate_text_inputs
+
+Array = jax.Array
+
+
+def _word_info_lost_update(
+    preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]
+) -> Tuple[Array, Array, Array]:
+    """Return (edits - sum(max-lens), total target words, total pred words).
+
+    ``errors - total`` equals minus the hit count H, so the compute step's
+    ``(errors/N_t)·(errors/N_p)`` recovers ``(H/N_t)·(H/N_p)`` — the reference's
+    formulation (``functional/text/wil.py:55-71``).
+    """
+    preds_list, target_list = _validate_text_inputs(preds, target)
+    pred_tokens = [p.split() for p in preds_list]
+    tgt_tokens = [t.split() for t in target_list]
+    errors = jnp.sum(_edit_distance_tokens(pred_tokens, tgt_tokens))
+    total = float(sum(max(len(p), len(t)) for p, t in zip(pred_tokens, tgt_tokens)))
+    target_total = jnp.asarray(float(sum(len(t) for t in tgt_tokens)))
+    preds_total = jnp.asarray(float(sum(len(p) for p in pred_tokens)))
+    return errors - total, target_total, preds_total
+
+
+def _word_info_lost_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return 1 - ((errors / target_total) * (errors / preds_total))
+
+
+def word_information_lost(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Array:
+    """Word information lost for automatic-speech-recognition output.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import word_information_lost
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> float(word_information_lost(preds=preds, target=target))  # doctest: +ELLIPSIS
+        0.6528...
+    """
+    errors, target_total, preds_total = _word_info_lost_update(preds, target)
+    return _word_info_lost_compute(errors, target_total, preds_total)

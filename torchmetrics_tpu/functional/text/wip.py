@@ -1,0 +1,35 @@
+"""Word information preserved (reference ``functional/text/wip.py``)."""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple, Union
+
+import jax
+
+from torchmetrics_tpu.functional.text.wil import _word_info_lost_update
+
+Array = jax.Array
+
+
+def _word_info_preserved_update(
+    preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]
+) -> Tuple[Array, Array, Array]:
+    return _word_info_lost_update(preds, target)
+
+
+def _word_info_preserved_compute(errors: Array, target_total: Array, preds_total: Array) -> Array:
+    return (errors / target_total) * (errors / preds_total)
+
+
+def word_information_preserved(preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> Array:
+    """Word information preserved for automatic-speech-recognition output.
+
+    Example:
+        >>> from torchmetrics_tpu.functional.text import word_information_preserved
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> float(word_information_preserved(preds=preds, target=target))  # doctest: +ELLIPSIS
+        0.3472...
+    """
+    errors, target_total, preds_total = _word_info_preserved_update(preds, target)
+    return _word_info_preserved_compute(errors, target_total, preds_total)

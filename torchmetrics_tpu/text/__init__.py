@@ -1,0 +1,37 @@
+"""Modular text metrics (reference ``torchmetrics/text/__init__.py``)."""
+
+from torchmetrics_tpu.text.bert import BERTScore
+from torchmetrics_tpu.text.bleu import BLEUScore
+from torchmetrics_tpu.text.cer import CharErrorRate
+from torchmetrics_tpu.text.chrf import CHRFScore
+from torchmetrics_tpu.text.edit import EditDistance
+from torchmetrics_tpu.text.eed import ExtendedEditDistance
+from torchmetrics_tpu.text.infolm import InfoLM
+from torchmetrics_tpu.text.mer import MatchErrorRate
+from torchmetrics_tpu.text.perplexity import Perplexity
+from torchmetrics_tpu.text.rouge import ROUGEScore
+from torchmetrics_tpu.text.sacre_bleu import SacreBLEUScore
+from torchmetrics_tpu.text.squad import SQuAD
+from torchmetrics_tpu.text.ter import TranslationEditRate
+from torchmetrics_tpu.text.wer import WordErrorRate
+from torchmetrics_tpu.text.wil import WordInfoLost
+from torchmetrics_tpu.text.wip import WordInfoPreserved
+
+__all__ = [
+    "BERTScore",
+    "BLEUScore",
+    "CharErrorRate",
+    "CHRFScore",
+    "EditDistance",
+    "ExtendedEditDistance",
+    "InfoLM",
+    "MatchErrorRate",
+    "Perplexity",
+    "ROUGEScore",
+    "SacreBLEUScore",
+    "SQuAD",
+    "TranslationEditRate",
+    "WordErrorRate",
+    "WordInfoLost",
+    "WordInfoPreserved",
+]

@@ -1,0 +1,110 @@
+"""BERTScore (reference ``text/bert.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.text.bert import _DEFAULT_MAX_LENGTH, _HashTokenizer, bert_score
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class BERTScore(Metric):
+    """BERTScore: greedy cosine matching of contextual token embeddings.
+
+    States are padded token-id/attention-mask matrices (device cat state,
+    fixed width ``max_length``) mirroring ``text/bert.py:194-197``; compute
+    embeds and matches in one batched device program.
+
+    Example:
+        >>> from torchmetrics_tpu.text import BERTScore
+        >>> bertscore = BERTScore()
+        >>> score = bertscore(["hello there"], ["hello there"])
+        >>> round(float(score["f1"][0]), 2)
+        1.0
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        num_layers: Optional[int] = None,
+        all_layers: bool = False,
+        model: Optional[Any] = None,
+        user_tokenizer: Optional[Any] = None,
+        user_forward_fn: Optional[Callable[..., Array]] = None,
+        verbose: bool = False,
+        idf: bool = False,
+        device: Optional[str] = None,
+        max_length: int = _DEFAULT_MAX_LENGTH,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        return_hash: bool = False,
+        lang: str = "en",
+        rescale_with_baseline: bool = False,
+        baseline_path: Optional[str] = None,
+        baseline_url: Optional[str] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.model = model
+        self.user_tokenizer = user_tokenizer
+        self.user_forward_fn = user_forward_fn
+        self.idf = idf
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.return_hash = return_hash
+        self.rescale_with_baseline = rescale_with_baseline
+        self._tokenizer = user_tokenizer if user_tokenizer is not None else _HashTokenizer(max_length)
+
+        self.add_state("preds_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", default=[], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [target]
+        if len(preds) != len(target):
+            raise ValueError("Number of predicted and reference sententes must be the same!")
+        pred_enc = self._tokenizer(list(preds), self.max_length)
+        tgt_enc = self._tokenizer(list(target), self.max_length)
+        self.preds_input_ids.append(jnp.asarray(np.asarray(pred_enc["input_ids"])))
+        self.preds_attention_mask.append(jnp.asarray(np.asarray(pred_enc["attention_mask"])))
+        self.target_input_ids.append(jnp.asarray(np.asarray(tgt_enc["input_ids"])))
+        self.target_attention_mask.append(jnp.asarray(np.asarray(tgt_enc["attention_mask"])))
+
+    def compute(self) -> Dict[str, Union[Array, List[float], str]]:
+        return bert_score(
+            preds={
+                "input_ids": np.asarray(dim_zero_cat(self.preds_input_ids)),
+                "attention_mask": np.asarray(dim_zero_cat(self.preds_attention_mask)),
+            },
+            target={
+                "input_ids": np.asarray(dim_zero_cat(self.target_input_ids)),
+                "attention_mask": np.asarray(dim_zero_cat(self.target_attention_mask)),
+            },
+            model_name_or_path=self.model_name_or_path,
+            model=self.model,
+            user_tokenizer=self.user_tokenizer,
+            user_forward_fn=self.user_forward_fn,
+            idf=self.idf,
+            max_length=self.max_length,
+            batch_size=self.batch_size,
+            return_hash=self.return_hash,
+            rescale_with_baseline=self.rescale_with_baseline,
+        )

@@ -1,0 +1,74 @@
+"""BLEUScore (reference ``text/bleu.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.bleu import _bleu_score_compute, _bleu_score_update, _tokenize_fn
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class BLEUScore(Metric):
+    """BLEU score of machine-translated text against references.
+
+    States are the fixed-shape per-order (numerator, denominator) count
+    vectors plus scalar length accumulators, all ``psum``-reducible.
+
+    Example:
+        >>> from torchmetrics_tpu.text import BLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> bleu = BLEUScore()
+        >>> round(float(bleu(preds, target)), 4)
+        0.7598
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.n_gram = n_gram
+        self.smooth = smooth
+        if weights is not None and len(weights) != n_gram:
+            raise ValueError(f"List of weights has different weights than `n_gram`: {len(weights)} != {n_gram}")
+        self.weights = weights if weights is not None else [1.0 / n_gram] * n_gram
+
+        self.add_state("preds_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("target_len", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("numerator", default=jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+        self.add_state("denominator", default=jnp.zeros(self.n_gram), dist_reduce_fx="sum")
+
+    _tokenizer = staticmethod(_tokenize_fn)
+
+    def update(self, preds: Sequence[str], target: Sequence[Sequence[str]]) -> None:
+        preds_ = [preds] if isinstance(preds, str) else list(preds)
+        target_ = [[tgt] if isinstance(tgt, str) else tgt for tgt in target]
+        if len(preds_) != len(target_):
+            raise ValueError(f"Corpus has different size {len(preds_)} != {len(target_)}")
+        numerator, denominator, preds_len, target_len = _bleu_score_update(
+            preds_, target_, self.n_gram, self._tokenizer
+        )
+        self.preds_len = self.preds_len + preds_len
+        self.target_len = self.target_len + target_len
+        self.numerator = self.numerator + jnp.asarray(numerator)
+        self.denominator = self.denominator + jnp.asarray(denominator)
+
+    def compute(self) -> Array:
+        return _bleu_score_compute(
+            self.preds_len, self.target_len, self.numerator, self.denominator, self.n_gram, self.weights, self.smooth
+        )

@@ -1,0 +1,99 @@
+"""CHRFScore (reference ``text/chrf.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.chrf import _chrf_score_compute, _chrf_score_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CHRFScore(Metric):
+    """chrF / chrF++ score.
+
+    States are six fixed-shape per-order count vectors (pred/target/matching ×
+    char/word) that reduce under a single ``psum``, plus an optional cat-list
+    of sentence-level scores.
+
+    Example:
+        >>> from torchmetrics_tpu.text import CHRFScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat']]
+        >>> chrf = CHRFScore()
+        >>> round(float(chrf(preds, target)), 4)
+        0.5384
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        n_char_order: int = 6,
+        n_word_order: int = 2,
+        beta: float = 2.0,
+        lowercase: bool = False,
+        whitespace: bool = False,
+        return_sentence_level_score: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(n_char_order, int) or n_char_order < 1:
+            raise ValueError("Expected argument `n_char_order` to be an integer greater than or equal to 1.")
+        if not isinstance(n_word_order, int) or n_word_order < 0:
+            raise ValueError("Expected argument `n_word_order` to be an integer greater than or equal to 0.")
+        if beta < 0:
+            raise ValueError("Expected argument `beta` to be greater than 0.")
+        self.n_char_order = n_char_order
+        self.n_word_order = n_word_order
+        self.beta = beta
+        self.lowercase = lowercase
+        self.whitespace = whitespace
+        self.return_sentence_level_score = return_sentence_level_score
+        self.n_order = float(n_char_order + n_word_order)
+
+        self.add_state("total_preds_char_n_grams", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_preds_word_n_grams", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("total_target_char_n_grams", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_target_word_n_grams", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        self.add_state("total_matching_char_n_grams", default=jnp.zeros(n_char_order), dist_reduce_fx="sum")
+        self.add_state("total_matching_word_n_grams", default=jnp.zeros(n_word_order), dist_reduce_fx="sum")
+        if self.return_sentence_level_score:
+            self.add_state("sentence_chrf_score", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[Sequence[str], Sequence[Sequence[str]]]) -> None:
+        p_char, p_word, t_char, t_word, m_char, m_word, sentence_scores = _chrf_score_update(
+            preds, target, self.n_char_order, self.n_word_order, self.beta, self.lowercase, self.whitespace
+        )
+        self.total_preds_char_n_grams = self.total_preds_char_n_grams + jnp.asarray(p_char)
+        self.total_preds_word_n_grams = self.total_preds_word_n_grams + jnp.asarray(p_word)
+        self.total_target_char_n_grams = self.total_target_char_n_grams + jnp.asarray(t_char)
+        self.total_target_word_n_grams = self.total_target_word_n_grams + jnp.asarray(t_word)
+        self.total_matching_char_n_grams = self.total_matching_char_n_grams + jnp.asarray(m_char)
+        self.total_matching_word_n_grams = self.total_matching_word_n_grams + jnp.asarray(m_word)
+        if self.return_sentence_level_score:
+            self.sentence_chrf_score.append(jnp.asarray(sentence_scores))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        corpus = _chrf_score_compute(
+            self.total_preds_char_n_grams,
+            self.total_preds_word_n_grams,
+            self.total_target_char_n_grams,
+            self.total_target_word_n_grams,
+            self.total_matching_char_n_grams,
+            self.total_matching_word_n_grams,
+            self.n_order,
+            self.beta,
+        )
+        if self.return_sentence_level_score:
+            return corpus, dim_zero_cat(self.sentence_chrf_score)
+        return corpus

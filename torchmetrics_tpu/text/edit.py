@@ -1,0 +1,61 @@
+"""EditDistance (reference ``text/edit.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.edit import _edit_distance_compute, _edit_distance_update
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class EditDistance(Metric):
+    """Character-level Levenshtein edit distance with configurable reduction.
+
+    Example:
+        >>> from torchmetrics_tpu.text import EditDistance
+        >>> metric = EditDistance()
+        >>> float(metric(["rain"], ["shine"]))
+        3.0
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, substitution_cost: int = 1, reduction: Optional[str] = "mean", **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not (isinstance(substitution_cost, int) and substitution_cost >= 0):
+            raise ValueError(
+                f"Expected argument `substitution_cost` to be a positive integer, but got {substitution_cost}"
+            )
+        allowed_reduction = (None, "mean", "sum", "none")
+        if reduction not in allowed_reduction:
+            raise ValueError(f"Expected argument `reduction` to be one of {allowed_reduction}, but got {reduction}")
+        self.substitution_cost = substitution_cost
+        self.reduction = reduction
+
+        if self.reduction == "none" or self.reduction is None:
+            self.add_state("edit_scores_list", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("edit_scores", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+            self.add_state("num_elements", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        distances = _edit_distance_update(preds, target, self.substitution_cost)
+        if self.reduction == "none" or self.reduction is None:
+            self.edit_scores_list.append(distances)
+        else:
+            self.edit_scores = self.edit_scores + jnp.sum(distances)
+            self.num_elements = self.num_elements + distances.shape[0]
+
+    def compute(self) -> Array:
+        if self.reduction == "none" or self.reduction is None:
+            return _edit_distance_compute(dim_zero_cat(self.edit_scores_list), 1, self.reduction)
+        return _edit_distance_compute(self.edit_scores.reshape(1), self.num_elements, self.reduction)

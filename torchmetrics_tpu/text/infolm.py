@@ -1,0 +1,116 @@
+"""InfoLM (reference ``text/infolm.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.functional.text.bert import _HashTokenizer
+from torchmetrics_tpu.functional.text.infolm import infolm as _infolm_fn
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class InfoLM(Metric):
+    """InfoLM: information measures between masked-LM token distributions.
+
+    Tokenization happens at ``update`` time (host work) and the padded
+    token-id/attention-mask matrices are registered cat states — so forward's
+    reduce-state dance, distributed sync, and state_dict all see the buffers
+    (mirroring ``text/bert.py:194-197``); the distribution + measure math runs
+    on device at compute time.
+
+    Example:
+        >>> from torchmetrics_tpu.text import InfoLM
+        >>> metric = InfoLM(information_measure='l2_distance', idf=False)
+        >>> preds = ['he read the book because he was interested in world history']
+        >>> target = ['he was interested in world history because he read the book']
+        >>> bool(metric(preds, target) >= 0)
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        temperature: float = 0.25,
+        information_measure: str = "kl_divergence",
+        idf: bool = True,
+        alpha: Optional[float] = None,
+        beta: Optional[float] = None,
+        device: Optional[str] = None,
+        max_length: Optional[int] = None,
+        batch_size: int = 64,
+        num_threads: int = 0,
+        verbose: bool = True,
+        return_sentence_level_score: bool = False,
+        model: Optional[Callable[[Array, Array], Array]] = None,
+        tokenizer: Optional[Any] = None,
+        special_tokens_map: Optional[Dict[str, int]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model_name_or_path = model_name_or_path
+        self.temperature = temperature
+        self.information_measure = information_measure
+        self.idf = idf
+        self.alpha = alpha
+        self.beta = beta
+        self.max_length = max_length
+        self.batch_size = batch_size
+        self.return_sentence_level_score = return_sentence_level_score
+        self._model = model
+        self._user_tokenizer = tokenizer
+        self._special_tokens_map = special_tokens_map
+        self._tokenizer_fn = tokenizer if tokenizer is not None else _HashTokenizer(max_length or 64)
+
+        self.add_state("preds_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("preds_attention_mask", default=[], dist_reduce_fx="cat")
+        self.add_state("target_input_ids", default=[], dist_reduce_fx="cat")
+        self.add_state("target_attention_mask", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [target]
+        if len(preds) != len(target):
+            raise ValueError("Number of predicted and reference sententes must be the same!")
+        width = self.max_length or 64
+        pred_enc = self._tokenizer_fn(list(preds), width)
+        tgt_enc = self._tokenizer_fn(list(target), width)
+        self.preds_input_ids.append(jnp.asarray(np.asarray(pred_enc["input_ids"])))
+        self.preds_attention_mask.append(jnp.asarray(np.asarray(pred_enc["attention_mask"])))
+        self.target_input_ids.append(jnp.asarray(np.asarray(tgt_enc["input_ids"])))
+        self.target_attention_mask.append(jnp.asarray(np.asarray(tgt_enc["attention_mask"])))
+
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+        return _infolm_fn(
+            {
+                "input_ids": np.asarray(dim_zero_cat(self.preds_input_ids)),
+                "attention_mask": np.asarray(dim_zero_cat(self.preds_attention_mask)),
+            },
+            {
+                "input_ids": np.asarray(dim_zero_cat(self.target_input_ids)),
+                "attention_mask": np.asarray(dim_zero_cat(self.target_attention_mask)),
+            },
+            model_name_or_path=self.model_name_or_path,
+            temperature=self.temperature,
+            information_measure=self.information_measure,
+            idf=self.idf,
+            alpha=self.alpha,
+            beta=self.beta,
+            max_length=self.max_length,
+            return_sentence_level_score=self.return_sentence_level_score,
+            model=self._model,
+            tokenizer=self._user_tokenizer,
+            special_tokens_map=self._special_tokens_map,
+        )

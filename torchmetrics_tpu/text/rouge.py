@@ -1,0 +1,100 @@
+"""ROUGEScore (reference ``text/rouge.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.rouge import (
+    ALLOWED_ACCUMULATE_VALUES,
+    ALLOWED_ROUGE_KEYS,
+    _rouge_score_compute,
+    _rouge_score_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class ROUGEScore(Metric):
+    """ROUGE-N / ROUGE-L / ROUGE-LSum, accumulated as per-sample cat states.
+
+    Example:
+        >>> from torchmetrics_tpu.text import ROUGEScore
+        >>> rouge = ROUGEScore(rouge_keys="rouge1")
+        >>> result = rouge(["My name is John"], ["Is your name John"])
+        >>> round(float(result["rouge1_fmeasure"]), 2)
+        0.75
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        use_stemmer: bool = False,
+        normalizer: Optional[Callable[[str], str]] = None,
+        tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
+        accumulate: str = "best",
+        rouge_keys: Union[str, Tuple[str, ...]] = ("rouge1", "rouge2", "rougeL", "rougeLsum"),
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if use_stemmer:
+            raise ValueError("`use_stemmer=True` requires nltk's PorterStemmer, which is unavailable in this build.")
+        if not isinstance(rouge_keys, tuple):
+            rouge_keys = (rouge_keys,)
+        for key in rouge_keys:
+            if key not in ALLOWED_ROUGE_KEYS:
+                raise ValueError(f"Got unknown rouge key {key}. Expected to be one of {list(ALLOWED_ROUGE_KEYS.keys())}")
+        if accumulate not in ALLOWED_ACCUMULATE_VALUES:
+            raise ValueError(
+                f"Got unknown accumulate value {accumulate}. Expected to be one of {ALLOWED_ACCUMULATE_VALUES}"
+            )
+        self.rouge_keys = rouge_keys
+        self.rouge_keys_values = [ALLOWED_ROUGE_KEYS[key] for key in rouge_keys]
+        self.normalizer = normalizer
+        self.tokenizer = tokenizer
+        self.accumulate = accumulate
+        for rouge_key in self.rouge_keys_values:
+            for score in ("fmeasure", "precision", "recall"):
+                self.add_state(f"rouge{rouge_key}_{score}", default=[], dist_reduce_fx="cat")
+
+    def update(
+        self,
+        preds: Union[str, Sequence[str]],
+        target: Union[str, Sequence[str], Sequence[Sequence[str]]],
+    ) -> None:
+        if isinstance(target, list) and all(isinstance(tgt, str) for tgt in target):
+            target = [target] if isinstance(preds, str) else [[tgt] for tgt in target]
+        if isinstance(preds, str):
+            preds = [preds]
+        if isinstance(target, str):
+            target = [[target]]
+        output = _rouge_score_update(
+            preds,
+            target,
+            self.rouge_keys_values,
+            self.accumulate,
+            None,
+            self.normalizer,
+            self.tokenizer,
+        )
+        for rouge_key, metrics in output.items():
+            for metric in metrics:
+                for score_name, score in metric.items():
+                    getattr(self, f"rouge{rouge_key}_{score_name}").append(score.reshape(1))
+
+    def compute(self) -> Dict[str, Array]:
+        update_output = {}
+        for rouge_key in self.rouge_keys_values:
+            for score in ("fmeasure", "precision", "recall"):
+                state = getattr(self, f"rouge{rouge_key}_{score}")
+                update_output[f"rouge{rouge_key}_{score}"] = dim_zero_cat(state) if state else jnp.zeros(0)
+        return _rouge_score_compute(update_output)

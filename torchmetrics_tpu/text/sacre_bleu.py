@@ -1,0 +1,33 @@
+"""SacreBLEUScore (reference ``text/sacre_bleu.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence
+
+from torchmetrics_tpu.functional.text.sacre_bleu import _SacreBLEUTokenizer
+from torchmetrics_tpu.text.bleu import BLEUScore
+
+
+class SacreBLEUScore(BLEUScore):
+    """BLEU with standardized sacrebleu-style tokenization.
+
+    Example:
+        >>> from torchmetrics_tpu.text import SacreBLEUScore
+        >>> preds = ['the cat is on the mat']
+        >>> target = [['there is a cat on the mat', 'a cat is on the mat']]
+        >>> sacre_bleu = SacreBLEUScore()
+        >>> round(float(sacre_bleu(preds, target)), 4)
+        0.7598
+    """
+
+    def __init__(
+        self,
+        n_gram: int = 4,
+        smooth: bool = False,
+        tokenize: str = "13a",
+        lowercase: bool = False,
+        weights: Optional[Sequence[float]] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(n_gram=n_gram, smooth=smooth, weights=weights, **kwargs)
+        self._tokenizer = _SacreBLEUTokenizer(tokenize, lowercase)

@@ -1,0 +1,44 @@
+"""WordErrorRate (reference ``text/wer.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Sequence, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.text.wer import _wer_compute, _wer_update
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class WordErrorRate(Metric):
+    """Word error rate for automatic-speech-recognition output.
+
+    Example:
+        >>> from torchmetrics_tpu.text import WordErrorRate
+        >>> preds = ["this is the prediction", "there is an other sample"]
+        >>> target = ["this is the reference", "there is another one"]
+        >>> wer = WordErrorRate()
+        >>> float(wer(preds, target))
+        0.5
+    """
+
+    is_differentiable = False
+    higher_is_better = False
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("errors", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: Union[str, Sequence[str]], target: Union[str, Sequence[str]]) -> None:
+        errors, total = _wer_update(preds, target)
+        self.errors = self.errors + errors
+        self.total = self.total + total
+
+    def compute(self) -> Array:
+        return _wer_compute(self.errors, self.total)
