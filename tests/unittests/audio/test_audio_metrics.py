@@ -1,0 +1,244 @@
+"""Audio domain tests: SNR family, SDR, PIT — differential vs the reference
+torchmetrics oracle on CPU, plus class-accumulation and validation checks."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.helpers.reference_oracle import load_reference
+from torchmetrics_tpu.audio import (
+    ComplexScaleInvariantSignalNoiseRatio,
+    PermutationInvariantTraining,
+    ScaleInvariantSignalDistortionRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalDistortionRatio,
+    SignalNoiseRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
+from torchmetrics_tpu.functional.audio import (
+    complex_scale_invariant_signal_noise_ratio,
+    permutation_invariant_training,
+    pit_permutate,
+    scale_invariant_signal_distortion_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_distortion_ratio,
+    signal_noise_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+
+_REF = load_reference()
+
+
+def _pair(shape=(3, 800), seed=0):
+    k1, k2 = jax.random.split(jax.random.PRNGKey(seed))
+    return jax.random.normal(k1, shape), jax.random.normal(k2, shape)
+
+
+def _to_torch(x):
+    import torch
+
+    return torch.tensor(np.asarray(x))
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize("zero_mean", [False, True])
+def test_snr_matches_reference(zero_mean):
+    import torchmetrics.functional.audio as ref_audio
+
+    preds, target = _pair()
+    expected = ref_audio.signal_noise_ratio(_to_torch(preds), _to_torch(target), zero_mean)
+    got = signal_noise_ratio(preds, target, zero_mean)
+    assert np.allclose(np.asarray(got), expected.numpy(), atol=1e-3)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize("zero_mean", [False, True])
+def test_si_sdr_matches_reference(zero_mean):
+    import torchmetrics.functional.audio as ref_audio
+
+    preds, target = _pair(seed=1)
+    expected = ref_audio.scale_invariant_signal_distortion_ratio(_to_torch(preds), _to_torch(target), zero_mean)
+    got = scale_invariant_signal_distortion_ratio(preds, target, zero_mean)
+    assert np.allclose(np.asarray(got), expected.numpy(), atol=1e-3)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+def test_si_snr_matches_reference():
+    import torchmetrics.functional.audio as ref_audio
+
+    preds, target = _pair(seed=2)
+    expected = ref_audio.scale_invariant_signal_noise_ratio(_to_torch(preds), _to_torch(target))
+    got = scale_invariant_signal_noise_ratio(preds, target)
+    assert np.allclose(np.asarray(got), expected.numpy(), atol=1e-3)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+def test_c_si_snr_matches_reference():
+    import torchmetrics.functional.audio as ref_audio
+
+    preds, target = _pair(shape=(1, 65, 20, 2), seed=3)
+    expected = ref_audio.complex_scale_invariant_signal_noise_ratio(_to_torch(preds), _to_torch(target))
+    got = complex_scale_invariant_signal_noise_ratio(preds, target)
+    assert np.allclose(np.asarray(got), expected.numpy(), atol=1e-3)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize("scale_invariant", [True, False])
+def test_sa_sdr_matches_reference(scale_invariant):
+    import torchmetrics.functional.audio as ref_audio
+
+    preds, target = _pair(shape=(4, 2, 800), seed=4)
+    expected = ref_audio.source_aggregated_signal_distortion_ratio(
+        _to_torch(preds), _to_torch(target), scale_invariant
+    )
+    got = source_aggregated_signal_distortion_ratio(preds, target, scale_invariant)
+    assert np.allclose(np.asarray(got), expected.numpy(), atol=1e-3)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize("filter_length", [128, 512])
+def test_sdr_matches_reference_within_db_tolerance(filter_length):
+    import torchmetrics.functional.audio as ref_audio
+
+    # float32 device solve vs the reference's float64: compare in dB with tolerance
+    preds, target = _pair(shape=(2, 4000), seed=5)
+    expected = ref_audio.signal_distortion_ratio(_to_torch(preds), _to_torch(target), filter_length=filter_length)
+    got = signal_distortion_ratio(preds, target, filter_length=filter_length)
+    assert np.allclose(np.asarray(got), expected.numpy(), atol=5e-2)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize("spk_num", [2, 3, 4])
+@pytest.mark.parametrize("eval_func", ["max", "min"])
+def test_pit_matches_reference(spk_num, eval_func):
+    import torchmetrics.functional.audio as ref_audio
+
+    preds, target = _pair(shape=(4, spk_num, 200), seed=6)
+    ref_metric, ref_perm = ref_audio.permutation_invariant_training(
+        _to_torch(preds),
+        _to_torch(target),
+        ref_audio.scale_invariant_signal_distortion_ratio,
+        mode="speaker-wise",
+        eval_func=eval_func,
+    )
+    got_metric, got_perm = permutation_invariant_training(
+        preds, target, scale_invariant_signal_distortion_ratio, mode="speaker-wise", eval_func=eval_func
+    )
+    assert np.allclose(np.asarray(got_metric), ref_metric.numpy(), atol=1e-3)
+    assert np.array_equal(np.asarray(got_perm), ref_perm.numpy())
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+def test_pit_permutation_wise_matches_reference():
+    import torchmetrics.functional.audio as ref_audio
+
+    preds, target = _pair(shape=(3, 2, 400), seed=7)
+    ref_metric, ref_perm = ref_audio.permutation_invariant_training(
+        _to_torch(preds),
+        _to_torch(target),
+        ref_audio.source_aggregated_signal_distortion_ratio,
+        mode="permutation-wise",
+    )
+    got_metric, got_perm = permutation_invariant_training(
+        preds, target, source_aggregated_signal_distortion_ratio, mode="permutation-wise"
+    )
+    assert np.allclose(np.asarray(got_metric), ref_metric.numpy(), atol=1e-3)
+    assert np.array_equal(np.asarray(got_perm), ref_perm.numpy())
+
+
+def test_pit_permutate_roundtrip():
+    preds, _ = _pair(shape=(2, 3, 50), seed=8)
+    perm = jnp.asarray([[2, 0, 1], [1, 2, 0]])
+    permuted = pit_permutate(preds, perm)
+    for b in range(2):
+        for s in range(3):
+            assert np.allclose(np.asarray(permuted[b, s]), np.asarray(preds[b, perm[b, s]]))
+
+
+def test_pit_jit_compatible():
+    preds, target = _pair(shape=(2, 2, 100), seed=9)
+
+    @jax.jit
+    def run(p, t):
+        best, perm = permutation_invariant_training(p, t, scale_invariant_signal_distortion_ratio)
+        return best, perm
+
+    best, perm = run(preds, target)
+    ebest, eperm = permutation_invariant_training(preds, target, scale_invariant_signal_distortion_ratio)
+    assert np.allclose(np.asarray(best), np.asarray(ebest), atol=1e-5)
+    assert np.array_equal(np.asarray(perm), np.asarray(eperm))
+
+
+@pytest.mark.parametrize(
+    ("metric_cls", "fn", "shape"),
+    [
+        (SignalNoiseRatio, signal_noise_ratio, (3, 400)),
+        (ScaleInvariantSignalNoiseRatio, scale_invariant_signal_noise_ratio, (3, 400)),
+        (ScaleInvariantSignalDistortionRatio, scale_invariant_signal_distortion_ratio, (3, 400)),
+        (SourceAggregatedSignalDistortionRatio, source_aggregated_signal_distortion_ratio, (3, 2, 400)),
+    ],
+)
+def test_class_accumulation_is_mean_of_samples(metric_cls, fn, shape):
+    preds, target = _pair(shape=shape, seed=10)
+    metric = metric_cls()
+    metric.update(preds[:1], target[:1])
+    metric.update(preds[1:], target[1:])
+    expected = float(jnp.mean(fn(preds, target)))
+    assert float(metric.compute()) == pytest.approx(expected, rel=1e-4)
+
+
+def test_sdr_class_and_complex_class():
+    preds, target = _pair(shape=(2, 2000), seed=11)
+    sdr = SignalDistortionRatio()
+    sdr.update(preds, target)
+    assert np.isfinite(float(sdr.compute()))
+
+    cpreds, ctarget = _pair(shape=(1, 33, 10, 2), seed=12)
+    cm = ComplexScaleInvariantSignalNoiseRatio()
+    cm.update(cpreds, ctarget)
+    assert np.isfinite(float(cm.compute()))
+
+
+def test_pit_class():
+    preds, target = _pair(shape=(4, 2, 200), seed=13)
+    pit = PermutationInvariantTraining(scale_invariant_signal_distortion_ratio, mode="speaker-wise")
+    pit.update(preds[:2], target[:2])
+    pit.update(preds[2:], target[2:])
+    best, _ = permutation_invariant_training(preds, target, scale_invariant_signal_distortion_ratio)
+    assert float(pit.compute()) == pytest.approx(float(jnp.mean(best)), rel=1e-4)
+
+
+def test_validation_errors():
+    with pytest.raises(ValueError, match="eval_func"):
+        permutation_invariant_training(jnp.zeros((2, 2, 10)), jnp.zeros((2, 2, 10)), signal_noise_ratio, eval_func="bad")
+    with pytest.raises(ValueError, match="mode"):
+        permutation_invariant_training(jnp.zeros((2, 2, 10)), jnp.zeros((2, 2, 10)), signal_noise_ratio, mode="bad")
+    with pytest.raises(RuntimeError, match="shape"):
+        complex_scale_invariant_signal_noise_ratio(jnp.zeros((5, 10)), jnp.zeros((5, 10)))
+
+    from torchmetrics_tpu.utilities.imports import _PESQ_AVAILABLE
+
+    if not _PESQ_AVAILABLE:
+        with pytest.raises(ModuleNotFoundError):
+            from torchmetrics_tpu.functional.audio import perceptual_evaluation_speech_quality
+
+            perceptual_evaluation_speech_quality(jnp.zeros(100), jnp.zeros(100), 8000, "nb")
+
+
+def test_pit_supports_host_backed_metric():
+    # a metric that leaves the device (np.asarray) must still work in
+    # speaker-wise mode via the loop fallback
+    def host_metric(p, t):
+        diff = np.asarray(p) - np.asarray(t)
+        return jnp.asarray(-np.mean(diff**2, axis=-1))
+
+    preds, target = _pair(shape=(3, 2, 64), seed=21)
+    best, perm = permutation_invariant_training(preds, target, host_metric)
+    ref_best, ref_perm = permutation_invariant_training(
+        preds, target, lambda p, t: -jnp.mean((p - t) ** 2, axis=-1)
+    )
+    assert np.allclose(np.asarray(best), np.asarray(ref_best), atol=1e-5)
+    assert np.array_equal(np.asarray(perm), np.asarray(ref_perm))

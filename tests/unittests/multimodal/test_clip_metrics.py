@@ -1,0 +1,109 @@
+"""CLIPScore / CLIP-IQA tests: semantics of the scoring math with both the
+deterministic default encoder and a user-supplied model."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.functional.multimodal import clip_image_quality_assessment, clip_score
+from torchmetrics_tpu.functional.multimodal.clip_iqa import _clip_iqa_format_prompts
+from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment, CLIPScore
+
+
+class _EchoModel:
+    """Test double: image features = pooled pixels, text features = per-char code."""
+
+    def get_image_features(self, images):
+        return jnp.mean(images, axis=(2, 3))  # (B, 3)
+
+    def get_text_features(self, text):
+        out = []
+        for t in text:
+            code = [float(ord(c)) for c in t[:3].ljust(3)]
+            out.append(jnp.asarray(code))
+        return jnp.stack(out)
+
+
+def _img(seed=42, shape=(3, 64, 64)):
+    return jax.random.uniform(jax.random.PRNGKey(seed), shape)
+
+
+class TestCLIPScore:
+    def test_deterministic(self):
+        img = _img()
+        a = float(clip_score(img, "a photo of a cat"))
+        b = float(clip_score(img, "a photo of a cat"))
+        assert a == b and np.isfinite(a)
+
+    def test_same_text_scores_higher_than_unrelated(self):
+        # with the echo model, identical feature directions give max cosine
+        img = jnp.ones((3, 8, 8))
+        model = _EchoModel()
+        # text whose 3-char code is parallel to (1,1,1) scores highest
+        high = float(clip_score(img, chr(90) * 3, model=model))
+        low = float(clip_score(img, chr(65) + chr(90) + chr(65), model=model))
+        assert high >= low
+
+    def test_mismatched_lengths_raise(self):
+        with pytest.raises(ValueError, match="same"):
+            clip_score([_img(), _img(1)], "one caption")
+
+    def test_class_accumulation(self):
+        imgs = [_img(i) for i in range(4)]
+        texts = [f"caption {i}" for i in range(4)]
+        metric = CLIPScore()
+        metric.update(imgs[:2], texts[:2])
+        metric.update(imgs[2:], texts[2:])
+        expected = float(clip_score(imgs, texts))
+        assert float(metric.compute()) == pytest.approx(expected, rel=1e-4)
+
+    def test_score_clamped_at_zero(self):
+        img = _img()
+        assert float(clip_score(img, "anything")) >= 0.0
+
+
+class TestCLIPIQA:
+    def test_probabilities_in_range(self):
+        probs = clip_image_quality_assessment(_img(shape=(2, 3, 32, 32)))
+        assert probs.shape == (2,)
+        assert bool(((probs >= 0) & (probs <= 1)).all())
+
+    def test_multiple_prompts_dict(self):
+        probs = clip_image_quality_assessment(_img(shape=(2, 3, 32, 32)), prompts=("quality", "brightness"))
+        assert set(probs.keys()) == {"quality", "brightness"}
+        for v in probs.values():
+            assert v.shape == (2,)
+
+    def test_custom_prompt_pairs(self):
+        probs = clip_image_quality_assessment(
+            _img(shape=(1, 3, 32, 32)), prompts=(("Great picture.", "Terrible picture."),)
+        )
+        assert float(probs) == pytest.approx(float(probs))
+
+    def test_prompt_validation(self):
+        with pytest.raises(ValueError, match="must be a tuple"):
+            _clip_iqa_format_prompts("quality")
+        with pytest.raises(ValueError, match="must be one of"):
+            _clip_iqa_format_prompts(("bogus",))
+        with pytest.raises(ValueError, match="length 2"):
+            _clip_iqa_format_prompts((("a", "b", "c"),))
+
+    def test_class_accumulates_batches(self):
+        metric = CLIPImageQualityAssessment()
+        metric.update(_img(0, (2, 3, 32, 32)))
+        metric.update(_img(1, (3, 3, 32, 32)))
+        probs = metric.compute()
+        assert probs.shape == (5,)
+
+    def test_opposite_anchors_give_complementary_probs(self):
+        # P(pos) + P(neg) = 1 by construction of the pairwise softmax
+        probs_pair = clip_image_quality_assessment(
+            _img(shape=(1, 3, 32, 32)),
+            prompts=(("Good photo.", "Bad photo."), ("Bad photo.", "Good photo.")),
+        )
+        p = float(probs_pair["user_defined_0"][0])
+        q = float(probs_pair["user_defined_1"][0])
+        assert p + q == pytest.approx(1.0, abs=1e-5)

@@ -1,0 +1,86 @@
+"""Pairwise kernels tested against scipy/sklearn-style numpy oracles."""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+from scipy.spatial.distance import cdist
+
+from torchmetrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_euclidean_distance,
+    pairwise_linear_similarity,
+    pairwise_manhattan_distance,
+    pairwise_minkowski_distance,
+)
+
+
+@pytest.fixture
+def data():
+    kx, ky = jax.random.split(jax.random.PRNGKey(0))
+    x = jax.random.normal(kx, (7, 5))
+    y = jax.random.normal(ky, (4, 5))
+    return x, y
+
+
+def test_cosine_vs_scipy(data):
+    x, y = data
+    expected = 1 - cdist(np.asarray(x), np.asarray(y), metric="cosine")
+    assert np.allclose(np.asarray(pairwise_cosine_similarity(x, y)), expected, atol=1e-5)
+
+
+def test_euclidean_vs_scipy(data):
+    x, y = data
+    expected = cdist(np.asarray(x), np.asarray(y), metric="euclidean")
+    assert np.allclose(np.asarray(pairwise_euclidean_distance(x, y)), expected, atol=1e-4)
+
+
+def test_manhattan_vs_scipy(data):
+    x, y = data
+    expected = cdist(np.asarray(x), np.asarray(y), metric="cityblock")
+    assert np.allclose(np.asarray(pairwise_manhattan_distance(x, y)), expected, atol=1e-5)
+
+
+@pytest.mark.parametrize("exponent", [1, 2, 3])
+def test_minkowski_vs_scipy(data, exponent):
+    x, y = data
+    expected = cdist(np.asarray(x), np.asarray(y), metric="minkowski", p=exponent)
+    assert np.allclose(np.asarray(pairwise_minkowski_distance(x, y, exponent)), expected, atol=1e-4)
+
+
+def test_linear_is_gram_matrix(data):
+    x, y = data
+    expected = np.asarray(x) @ np.asarray(y).T
+    assert np.allclose(np.asarray(pairwise_linear_similarity(x, y)), expected, atol=1e-5)
+
+
+def test_self_similarity_zero_diagonal(data):
+    x, _ = data
+    mat = np.asarray(pairwise_euclidean_distance(x))
+    assert np.allclose(np.diag(mat), 0.0)
+    cos = np.asarray(pairwise_cosine_similarity(x))
+    assert np.allclose(np.diag(cos), 0.0)  # defaults to zeroed diagonal
+    cos_keep = np.asarray(pairwise_cosine_similarity(x, zero_diagonal=False))
+    assert np.allclose(np.diag(cos_keep), 1.0, atol=1e-5)
+
+
+@pytest.mark.parametrize("reduction", ["mean", "sum"])
+def test_reductions(data, reduction):
+    x, y = data
+    full = np.asarray(pairwise_manhattan_distance(x, y))
+    reduced = np.asarray(pairwise_manhattan_distance(x, y, reduction=reduction))
+    expected = full.mean(axis=-1) if reduction == "mean" else full.sum(axis=-1)
+    assert np.allclose(reduced, expected, atol=1e-5)
+
+
+def test_validation(data):
+    x, y = data
+    with pytest.raises(ValueError, match="2D tensor"):
+        pairwise_cosine_similarity(x[0])
+    with pytest.raises(ValueError, match="same as the last dimension"):
+        pairwise_euclidean_distance(x, y[:, :3])
+    with pytest.raises(ValueError, match="reduction"):
+        pairwise_manhattan_distance(x, y, reduction="bad")
+    with pytest.raises(ValueError, match="exponent"):
+        pairwise_minkowski_distance(x, y, exponent=0.5)

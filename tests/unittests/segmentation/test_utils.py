@@ -1,0 +1,131 @@
+"""Segmentation morphology utilities vs scipy.ndimage oracles."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from scipy import ndimage
+
+from torchmetrics_tpu.functional.segmentation import (
+    binary_erosion,
+    check_if_binarized,
+    distance_transform,
+    generate_binary_structure,
+    mask_edges,
+    surface_distance,
+)
+
+
+def _random_mask(shape=(16, 16), seed=0, p=0.5):
+    return (jax.random.uniform(jax.random.PRNGKey(seed), shape) < p).astype(jnp.int32)
+
+
+@pytest.mark.parametrize("rank", [1, 2, 3])
+@pytest.mark.parametrize("connectivity", [1, 2])
+def test_generate_binary_structure_matches_scipy(rank, connectivity):
+    got = np.asarray(generate_binary_structure(rank, connectivity))
+    expected = ndimage.generate_binary_structure(rank, connectivity)
+    assert np.array_equal(got, expected)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_binary_erosion_matches_scipy(seed):
+    mask = _random_mask(seed=seed)
+    got = np.asarray(binary_erosion(mask[None, None])[0, 0])
+    expected = ndimage.binary_erosion(np.asarray(mask)).astype(np.uint8)
+    assert np.array_equal(got, expected)
+
+
+def test_binary_erosion_custom_structure_and_border():
+    mask = _random_mask(seed=7)
+    structure = jnp.ones((3, 3), dtype=jnp.int32)
+    got = np.asarray(binary_erosion(mask[None, None], structure=structure)[0, 0])
+    expected = ndimage.binary_erosion(np.asarray(mask), structure=np.ones((3, 3))).astype(np.uint8)
+    assert np.array_equal(got, expected)
+    # border_value=1 treats outside as foreground
+    got_b1 = np.asarray(binary_erosion(mask[None, None], border_value=1)[0, 0])
+    expected_b1 = ndimage.binary_erosion(np.asarray(mask), border_value=1).astype(np.uint8)
+    assert np.array_equal(got_b1, expected_b1)
+
+
+def test_binary_erosion_3d():
+    mask = _random_mask(shape=(6, 6, 6), seed=1)
+    got = np.asarray(binary_erosion(mask[None, None])[0, 0])
+    expected = ndimage.binary_erosion(np.asarray(mask)).astype(np.uint8)
+    assert np.array_equal(got, expected)
+
+
+@pytest.mark.parametrize("metric", ["euclidean", "chessboard", "taxicab"])
+def test_distance_transform_matches_scipy(metric):
+    mask = _random_mask(seed=3, p=0.7)
+    got = np.asarray(distance_transform(mask, metric=metric))
+    if metric == "euclidean":
+        expected = ndimage.distance_transform_edt(np.asarray(mask))
+    else:
+        expected = ndimage.distance_transform_cdt(np.asarray(mask), metric=metric)
+    assert np.allclose(got, expected, atol=1e-4)
+
+
+def test_distance_transform_scipy_engine_and_sampling():
+    mask = _random_mask(seed=4, p=0.7)
+    a = np.asarray(distance_transform(mask, sampling=[2.0, 1.0]))
+    b = np.asarray(distance_transform(mask, sampling=[2.0, 1.0], engine="scipy"))
+    assert np.allclose(a, b, atol=1e-4)
+
+
+def test_distance_transform_is_jittable():
+    mask = _random_mask(seed=5)
+    jit_dt = jax.jit(lambda m: distance_transform(m))
+    assert np.allclose(np.asarray(jit_dt(mask)), np.asarray(distance_transform(mask)), atol=1e-5)
+
+
+def test_mask_edges_erosion_path():
+    mask = jnp.zeros((5, 5), dtype=bool).at[1:4, 1:4].set(True)
+    edge_p, edge_t = mask_edges(mask, mask, crop=False)
+    # a 3x3 block's edge is its 8-pixel ring
+    assert int(np.asarray(edge_p).sum()) == 8
+    assert np.array_equal(np.asarray(edge_p), np.asarray(edge_t))
+
+
+def test_mask_edges_spacing_contour():
+    mask = jnp.zeros((6, 6), dtype=bool).at[1:5, 1:5].set(True)
+    edge_p, edge_t, areas_p, areas_t = mask_edges(mask, mask, crop=False, spacing=(1, 1))
+    assert np.asarray(edge_p).any()
+    # contour length of a 4x4 square with unit spacing is positive and symmetric
+    assert float(np.asarray(areas_p).sum()) > 0
+    assert np.allclose(np.asarray(areas_p), np.asarray(areas_t))
+
+
+def test_surface_distance_euclidean():
+    preds = jnp.ones((5, 5), dtype=bool).at[1:4, 1:4].set(False)
+    target = jnp.zeros((5, 5), dtype=bool).at[0:5, 0:4].set(True).at[1:4, 1:3].set(False)
+    dist = np.asarray(surface_distance(preds, target, spacing=[1, 1]))
+    assert dist.shape[0] == int(np.asarray(preds).sum())
+    assert (dist >= 0).all()
+
+
+def test_surface_distance_empty_masks():
+    empty = jnp.zeros((4, 4), dtype=bool)
+    full = jnp.ones((4, 4), dtype=bool)
+    assert np.isinf(np.asarray(surface_distance(full, empty))).all()
+    # empty preds vs non-empty target: reference returns inf per *target* pixel
+    empty_vs_full = np.asarray(surface_distance(empty, full))
+    assert empty_vs_full.shape == (16,) and np.isinf(empty_vs_full).all()
+
+
+def test_validation():
+    with pytest.raises(ValueError, match="binarized"):
+        check_if_binarized(jnp.asarray([0.5]))
+    with pytest.raises(ValueError, match="rank 4 or 5"):
+        binary_erosion(jnp.zeros((5, 5)))
+    with pytest.raises(ValueError, match="rank 2"):
+        distance_transform(jnp.zeros((2, 5, 5)))
+    with pytest.raises(ValueError, match="metric"):
+        distance_transform(jnp.zeros((5, 5)), metric="bad")
+    with pytest.raises(NotImplementedError, match="3D"):
+        cube = jnp.zeros((4, 4, 4), dtype=bool).at[1:3, 1:3, 1:3].set(True)
+        mask_edges(cube, cube, spacing=(1, 1, 1))
+    with pytest.raises(ValueError, match="bool"):
+        surface_distance(jnp.zeros((4, 4)), jnp.zeros((4, 4), dtype=bool))

@@ -16,11 +16,13 @@ _logger.setLevel(__logging.INFO)
 
 from torchmetrics_tpu import (  # noqa: E402
     aggregation,
+    audio,
     classification,
     clustering,
     detection,
     functional,
     image,
+    multimodal,
     nominal,
     regression,
     retrieval,
@@ -38,11 +40,15 @@ from torchmetrics_tpu.nominal import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.nominal import __all__ as _nominal_all  # noqa: E402
 from torchmetrics_tpu.retrieval import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.retrieval import __all__ as _retrieval_all  # noqa: E402
+from torchmetrics_tpu.audio import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.audio import __all__ as _audio_all  # noqa: E402
 from torchmetrics_tpu.aggregation import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.aggregation import __all__ as _aggregation_all  # noqa: E402
 from torchmetrics_tpu.classification import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.classification import __all__ as _classification_all  # noqa: E402
 from torchmetrics_tpu.collections import MetricCollection  # noqa: E402
+from torchmetrics_tpu.multimodal import *  # noqa: F401,F403,E402
+from torchmetrics_tpu.multimodal import __all__ as _multimodal_all  # noqa: E402
 from torchmetrics_tpu.regression import *  # noqa: F401,F403,E402
 from torchmetrics_tpu.regression import __all__ as _regression_all  # noqa: E402
 from torchmetrics_tpu.text import *  # noqa: F401,F403,E402
@@ -55,11 +61,13 @@ __all__ = [
     "Metric",
     "MetricCollection",
     "aggregation",
+    "audio",
     "classification",
     "clustering",
     "detection",
     "functional",
     "image",
+    "multimodal",
     "nominal",
     "regression",
     "retrieval",
@@ -68,10 +76,12 @@ __all__ = [
     "wrappers",
     "__version__",
     *_aggregation_all,
+    *_audio_all,
     *_classification_all,
     *_clustering_all,
     *_detection_all,
     *_image_all,
+    *_multimodal_all,
     *_nominal_all,
     *_regression_all,
     *_retrieval_all,

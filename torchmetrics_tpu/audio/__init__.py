@@ -1,0 +1,29 @@
+"""Modular audio metrics (reference ``torchmetrics/audio/__init__.py``)."""
+
+from torchmetrics_tpu.audio.pit import PermutationInvariantTraining
+from torchmetrics_tpu.audio.sdr import (
+    ScaleInvariantSignalDistortionRatio,
+    SignalDistortionRatio,
+    SourceAggregatedSignalDistortionRatio,
+)
+from torchmetrics_tpu.audio.snr import (
+    ComplexScaleInvariantSignalNoiseRatio,
+    ScaleInvariantSignalNoiseRatio,
+    SignalNoiseRatio,
+)
+from torchmetrics_tpu.audio.pesq import PerceptualEvaluationSpeechQuality
+from torchmetrics_tpu.audio.srmr import SpeechReverberationModulationEnergyRatio
+from torchmetrics_tpu.audio.stoi import ShortTimeObjectiveIntelligibility
+
+__all__ = [
+    "ComplexScaleInvariantSignalNoiseRatio",
+    "PerceptualEvaluationSpeechQuality",
+    "PermutationInvariantTraining",
+    "ScaleInvariantSignalDistortionRatio",
+    "ScaleInvariantSignalNoiseRatio",
+    "ShortTimeObjectiveIntelligibility",
+    "SignalDistortionRatio",
+    "SignalNoiseRatio",
+    "SourceAggregatedSignalDistortionRatio",
+    "SpeechReverberationModulationEnergyRatio",
+]

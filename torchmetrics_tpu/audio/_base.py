@@ -1,0 +1,40 @@
+"""Shared mean-aggregating base for audio metrics.
+
+Every reference audio class keeps the same state pair (value sum + sample
+count, e.g. ``audio/snr.py:88-89``); this base centralizes it.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class _AveragingAudioMetric(Metric):
+    """Accumulates a per-sample metric as (sum, count) and computes the mean."""
+
+    is_differentiable = True
+    higher_is_better = True
+    full_state_update = False
+
+    def __init__(self, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        self.add_state("measure_sum", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("total", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def _measure(self, preds: Array, target: Array) -> Array:
+        raise NotImplementedError
+
+    def update(self, preds: Array, target: Array) -> None:
+        values = self._measure(preds, target)
+        self.measure_sum = self.measure_sum + jnp.sum(values)
+        self.total = self.total + values.size
+
+    def compute(self) -> Array:
+        return self.measure_sum / self.total

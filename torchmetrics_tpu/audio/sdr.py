@@ -1,0 +1,88 @@
+"""SDR metric classes (reference ``audio/sdr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax
+
+from torchmetrics_tpu.audio._base import _AveragingAudioMetric
+from torchmetrics_tpu.functional.audio.sdr import signal_distortion_ratio
+from torchmetrics_tpu.functional.audio.snr import (
+    scale_invariant_signal_distortion_ratio,
+    source_aggregated_signal_distortion_ratio,
+)
+
+Array = jax.Array
+
+
+class SignalDistortionRatio(_AveragingAudioMetric):
+    """Mean SDR in dB (distortion-filter formulation, device Toeplitz solve).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.audio import SignalDistortionRatio
+        >>> preds = jax.random.normal(jax.random.PRNGKey(0), (8000,))
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> sdr = SignalDistortionRatio()
+        >>> float(sdr(preds, target)) < 0
+        True
+    """
+
+    def __init__(
+        self,
+        use_cg_iter: Optional[int] = None,
+        filter_length: int = 512,
+        zero_mean: bool = False,
+        load_diag: Optional[float] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.use_cg_iter = use_cg_iter
+        self.filter_length = filter_length
+        self.zero_mean = zero_mean
+        self.load_diag = load_diag
+
+    def _measure(self, preds: Array, target: Array) -> Array:
+        return signal_distortion_ratio(
+            preds, target, self.use_cg_iter, self.filter_length, self.zero_mean, self.load_diag
+        )
+
+
+class ScaleInvariantSignalDistortionRatio(_AveragingAudioMetric):
+    """Mean SI-SDR in dB.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalDistortionRatio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> si_sdr = ScaleInvariantSignalDistortionRatio()
+        >>> round(float(si_sdr(preds, target)), 4)
+        18.4031
+    """
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be an bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+
+    def _measure(self, preds: Array, target: Array) -> Array:
+        return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+
+
+class SourceAggregatedSignalDistortionRatio(_AveragingAudioMetric):
+    """Mean SA-SDR over ``(..., spk, time)`` inputs."""
+
+    def __init__(self, scale_invariant: bool = True, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(scale_invariant, bool):
+            raise ValueError(f"Expected argument `scale_invariant` to be a bool, but got {scale_invariant}")
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be a bool, but got {zero_mean}")
+        self.scale_invariant = scale_invariant
+        self.zero_mean = zero_mean
+
+    def _measure(self, preds: Array, target: Array) -> Array:
+        return source_aggregated_signal_distortion_ratio(preds, target, self.scale_invariant, self.zero_mean)

@@ -1,0 +1,69 @@
+"""SNR metric classes (reference ``audio/snr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from torchmetrics_tpu.audio._base import _AveragingAudioMetric
+from torchmetrics_tpu.functional.audio.snr import (
+    complex_scale_invariant_signal_noise_ratio,
+    scale_invariant_signal_noise_ratio,
+    signal_noise_ratio,
+)
+
+Array = jax.Array
+
+
+class SignalNoiseRatio(_AveragingAudioMetric):
+    """Mean signal-to-noise ratio in dB.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import SignalNoiseRatio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> snr = SignalNoiseRatio()
+        >>> round(float(snr(preds, target)), 4)
+        16.1805
+    """
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be an bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+
+    def _measure(self, preds: Array, target: Array) -> Array:
+        return signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)
+
+
+class ScaleInvariantSignalNoiseRatio(_AveragingAudioMetric):
+    """Mean scale-invariant signal-to-noise ratio in dB.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.audio import ScaleInvariantSignalNoiseRatio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> si_snr = ScaleInvariantSignalNoiseRatio()
+        >>> round(float(si_snr(preds, target)), 4)
+        15.0918
+    """
+
+    def _measure(self, preds: Array, target: Array) -> Array:
+        return scale_invariant_signal_noise_ratio(preds=preds, target=target)
+
+
+class ComplexScaleInvariantSignalNoiseRatio(_AveragingAudioMetric):
+    """Mean C-SI-SNR over complex spectra inputs ``(..., freq, time, 2)``."""
+
+    def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not isinstance(zero_mean, bool):
+            raise ValueError(f"Expected argument `zero_mean` to be an bool, but got {zero_mean}")
+        self.zero_mean = zero_mean
+
+    def _measure(self, preds: Array, target: Array) -> Array:
+        return complex_scale_invariant_signal_noise_ratio(preds=preds, target=target, zero_mean=self.zero_mean)

@@ -1,0 +1,60 @@
+"""SpeechReverberationModulationEnergyRatio (reference ``audio/srmr.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from torchmetrics_tpu.audio._base import _AveragingAudioMetric
+from torchmetrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
+from torchmetrics_tpu.utilities.imports import _GAMMATONE_AVAILABLE
+
+Array = jax.Array
+
+
+class SpeechReverberationModulationEnergyRatio(_AveragingAudioMetric):
+    """Mean SRMR score (requires the ``gammatone`` filterbank package).
+
+    Raises:
+        ModuleNotFoundError: if the ``gammatone`` package is not installed.
+    """
+
+    is_differentiable = False
+
+    def __init__(
+        self,
+        fs: int,
+        n_cochlear_filters: int = 23,
+        low_freq: float = 125,
+        min_cf: float = 4,
+        max_cf: float = 128,
+        norm: bool = False,
+        fast: bool = False,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        if not _GAMMATONE_AVAILABLE:
+            raise ModuleNotFoundError(
+                "SpeechReverberationModulationEnergyRatio metric requires that gammatone is installed."
+                " Install as `pip install torchmetrics[audio]` or `pip install git+https://github.com/detly/gammatone`."
+            )
+        self.fs = fs
+        self.n_cochlear_filters = n_cochlear_filters
+        self.low_freq = low_freq
+        self.min_cf = min_cf
+        self.max_cf = max_cf
+        self.norm = norm
+        self.fast = fast
+
+    def update(self, preds: Array) -> None:  # type: ignore[override]
+        values = speech_reverberation_modulation_energy_ratio(
+            preds, self.fs, self.n_cochlear_filters, self.low_freq, self.min_cf, self.max_cf, self.norm, self.fast
+        )
+        import jax.numpy as jnp
+
+        self.measure_sum = self.measure_sum + jnp.sum(values)
+        self.total = self.total + values.size
+
+    def _measure(self, preds: Array, target: Array) -> Array:
+        raise NotImplementedError

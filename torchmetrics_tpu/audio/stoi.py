@@ -1,0 +1,38 @@
+"""ShortTimeObjectiveIntelligibility (reference ``audio/stoi.py``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+
+from torchmetrics_tpu.audio._base import _AveragingAudioMetric
+from torchmetrics_tpu.functional.audio.stoi import short_time_objective_intelligibility
+from torchmetrics_tpu.utilities.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+
+class ShortTimeObjectiveIntelligibility(_AveragingAudioMetric):
+    """Mean STOI score (host DSP via the ``pystoi`` package, like the reference).
+
+    Raises:
+        ModuleNotFoundError: if the ``pystoi`` package is not installed.
+    """
+
+    is_differentiable = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(self, fs: int, extended: bool = False, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if not _PYSTOI_AVAILABLE:
+            raise ModuleNotFoundError(
+                "STOI metric requires that `pystoi` is installed."
+                " Either install as `pip install torchmetrics[audio]` or `pip install pystoi`."
+            )
+        self.fs = fs
+        self.extended = extended
+
+    def _measure(self, preds: Array, target: Array) -> Array:
+        return short_time_objective_intelligibility(preds, target, self.fs, self.extended)

@@ -1,15 +1,21 @@
 """Stateless functional metrics (L2)."""
 
 from torchmetrics_tpu.functional import (
+    audio,
     classification,
     clustering,
     detection,
     image,
+    multimodal,
     nominal,
+    pairwise,
     regression,
+    segmentation,
     retrieval,
     text,
 )
+from torchmetrics_tpu.functional.audio import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.audio import __all__ as _audio_all
 from torchmetrics_tpu.functional.image import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.image import __all__ as _image_all
 from torchmetrics_tpu.functional.classification import *  # noqa: F401,F403
@@ -24,24 +30,35 @@ from torchmetrics_tpu.functional.regression import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.regression import __all__ as _regression_all
 from torchmetrics_tpu.functional.retrieval import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.retrieval import __all__ as _retrieval_all
+from torchmetrics_tpu.functional.multimodal import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.multimodal import __all__ as _multimodal_all
+from torchmetrics_tpu.functional.pairwise import *  # noqa: F401,F403
+from torchmetrics_tpu.functional.pairwise import __all__ as _pairwise_all
 from torchmetrics_tpu.functional.text import *  # noqa: F401,F403
 from torchmetrics_tpu.functional.text import __all__ as _text_all
 
 __all__ = [
+    "audio",
     "classification",
     "clustering",
     "detection",
     "image",
+    "multimodal",
     "nominal",
+    "pairwise",
     "regression",
     "retrieval",
+    "segmentation",
     "text",
     *_classification_all,
+    *_audio_all,
     *_image_all,
     *_clustering_all,
     *_detection_all,
     *_nominal_all,
     *_regression_all,
+    *_multimodal_all,
+    *_pairwise_all,
     *_retrieval_all,
     *_text_all,
 ]

@@ -1,0 +1,62 @@
+"""PESQ (reference ``functional/audio/pesq.py``).
+
+PESQ is an inherently sequential ITU-T P.862 DSP pipeline; like the reference,
+it delegates to the C-backed ``pesq`` package on the host (CPU), gated behind
+a requirement flag. Metric state (sum, count) lives on device either way.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.imports import _PESQ_AVAILABLE
+
+Array = jax.Array
+
+__doctest_requires__ = {("perceptual_evaluation_speech_quality",): ["pesq"]}
+
+
+def perceptual_evaluation_speech_quality(
+    preds: Array,
+    target: Array,
+    fs: int,
+    mode: str,
+    keep_same_device: bool = False,
+    n_processes: int = 1,
+) -> Array:
+    """PESQ score via the host ``pesq`` package (CPU DSP, like the reference).
+
+    Raises:
+        ModuleNotFoundError: if the ``pesq`` package is not installed.
+    """
+    if not _PESQ_AVAILABLE:
+        raise ModuleNotFoundError(
+            "PESQ metric requires that pesq is installed. Either install as `pip install torchmetrics[audio]`"
+            " or `pip install pesq`."
+        )
+    import pesq as pesq_backend
+
+    if fs not in (8000, 16000):
+        raise ValueError(f"Expected argument `fs` to either be 8000 or 16000 but got {fs}")
+    if mode not in ("wb", "nb"):
+        raise ValueError(f"Expected argument `mode` to either be 'wb' or 'nb' but got {mode}")
+    _check_same_shape(preds, target)
+
+    preds_np = np.asarray(preds, dtype=np.float32)
+    target_np = np.asarray(target, dtype=np.float32)
+    if preds_np.ndim == 1:
+        pesq_val_np = pesq_backend.pesq(fs, target_np, preds_np, mode)
+        return jnp.asarray(pesq_val_np, dtype=jnp.float32)
+
+    preds_np = preds_np.reshape(-1, preds_np.shape[-1])
+    target_np = target_np.reshape(-1, target_np.shape[-1])
+    if n_processes == 1:
+        scores = [pesq_backend.pesq(fs, t, p, mode) for t, p in zip(target_np, preds_np)]
+    else:
+        scores = pesq_backend.pesq_batch(fs, target_np, preds_np, mode, n_processor=n_processes)
+    return jnp.asarray(np.asarray(scores, dtype=np.float32)).reshape(preds.shape[:-1])

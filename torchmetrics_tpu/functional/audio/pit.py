@@ -1,0 +1,145 @@
+"""Permutation-invariant training (reference ``functional/audio/pit.py``).
+
+The assignment problem runs fully on device for realistic speaker counts: the
+pairwise metric matrix is evaluated with a double ``vmap`` (one batched launch
+instead of the reference's spk² Python loop, ``pit.py:206-211``), and the best
+permutation is an exhaustive masked reduction over a host-precomputed static
+permutation table (≤6 speakers → ≤720 rows — trivial device work). Beyond
+that, a host scipy Hungarian fallback matches the reference's behavior
+(``pit.py:42-62``).
+"""
+
+from __future__ import annotations
+
+from itertools import permutations
+from typing import Any, Callable, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+Array = jax.Array
+
+_MAX_EXHAUSTIVE_SPK = 6
+
+# permutation tables are static per speaker count
+_ps_cache: dict = {}
+
+
+def _gen_permutations(spk_num: int) -> Array:
+    if spk_num not in _ps_cache:
+        _ps_cache[spk_num] = jnp.asarray(np.asarray(list(permutations(range(spk_num))), dtype=np.int32))
+    return _ps_cache[spk_num]
+
+
+def _find_best_perm_by_exhaustive_method(metric_mtx: Array, eval_func: str) -> Tuple[Array, Array]:
+    """Score every permutation at once: gather + mean + arg-reduce on device."""
+    batch_size, spk_num = metric_mtx.shape[:2]
+    ps = _gen_permutations(spk_num)  # [perm_num, spk_num]
+    # metric_of_ps[b, p] = mean_j metric_mtx[b, j, ps[p, j]]
+    gathered = metric_mtx[:, jnp.arange(spk_num)[None, :], ps]  # [B, perm, spk]
+    metric_of_ps = jnp.mean(gathered, axis=-1)  # [B, perm_num]
+    if eval_func == "max":
+        best_indexes = jnp.argmax(metric_of_ps, axis=1)
+        best_metric = jnp.max(metric_of_ps, axis=1)
+    else:
+        best_indexes = jnp.argmin(metric_of_ps, axis=1)
+        best_metric = jnp.min(metric_of_ps, axis=1)
+    best_perm = ps[best_indexes, :]
+    return best_metric, best_perm
+
+
+def _find_best_perm_by_linear_sum_assignment(metric_mtx: Array, eval_func: str) -> Tuple[Array, Array]:
+    """Host scipy Hungarian for large speaker counts (device transfer + back)."""
+    from scipy.optimize import linear_sum_assignment
+
+    mmtx = np.asarray(metric_mtx)
+    best_perm = jnp.asarray(
+        np.asarray([linear_sum_assignment(pwm, eval_func == "max")[1] for pwm in mmtx], dtype=np.int32)
+    )
+    best_metric = jnp.mean(jnp.take_along_axis(metric_mtx, best_perm[:, :, None], axis=2), axis=(-1, -2))
+    return best_metric, best_perm
+
+
+def permutation_invariant_training(
+    preds: Array,
+    target: Array,
+    metric_func: Callable,
+    mode: str = "speaker-wise",
+    eval_func: str = "max",
+    **kwargs: Any,
+) -> Tuple[Array, Array]:
+    """PIT: best metric value over speaker permutations, per sample.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import (
+        ...     permutation_invariant_training, scale_invariant_signal_distortion_ratio)
+        >>> preds = jnp.array([[[-0.0579,  0.3560, -0.9604], [-0.1719,  0.3205,  0.2951]]])
+        >>> target = jnp.array([[[ 1.0958, -0.1648,  0.5228], [-0.4100,  1.1942, -0.5103]]])
+        >>> best_metric, best_perm = permutation_invariant_training(
+        ...     preds, target, scale_invariant_signal_distortion_ratio,
+        ...     mode="speaker-wise", eval_func="max")
+        >>> best_perm.tolist()
+        [[0, 1]]
+    """
+    if preds.shape[0:2] != target.shape[0:2]:
+        raise RuntimeError(
+            "Predictions and targets are expected to have the same shape at the batch and speaker dimensions"
+        )
+    if eval_func not in ["max", "min"]:
+        raise ValueError(f'eval_func can only be "max" or "min" but got {eval_func}')
+    if mode not in ["speaker-wise", "permutation-wise"]:
+        raise ValueError(f'mode can only be "speaker-wise" or "permutation-wise" but got {mode}')
+    if target.ndim < 2:
+        raise ValueError(f"Inputs must be of shape [batch, spk, ...], got {target.shape} and {preds.shape} instead")
+
+    batch_size, spk_num = target.shape[0:2]
+
+    if mode == "permutation-wise":
+        perms = _gen_permutations(spk_num)  # [perm_num, spk_num]
+        perm_num = perms.shape[0]
+        ppreds = preds[:, perms.reshape(-1), ...].reshape(batch_size * perm_num, *preds.shape[1:])
+        ptarget = jnp.repeat(target, repeats=perm_num, axis=0)
+        metric_of_ps = metric_func(ppreds, ptarget, **kwargs)
+        metric_of_ps = jnp.mean(metric_of_ps.reshape(batch_size, perm_num, -1), axis=-1)
+        if eval_func == "max":
+            best_indexes = jnp.argmax(metric_of_ps, axis=1)
+            best_metric = jnp.max(metric_of_ps, axis=1)
+        else:
+            best_indexes = jnp.argmin(metric_of_ps, axis=1)
+            best_metric = jnp.min(metric_of_ps, axis=1)
+        return best_metric, perms[best_indexes, :]
+
+    # speaker-wise: pairwise metric matrix in one batched evaluation —
+    # metric_mtx[b, t, p] = metric(preds[b, p], target[b, t])
+    def pair_metric(pred_one: Array, target_one: Array) -> Array:
+        return metric_func(pred_one, target_one, **kwargs)
+
+    try:
+        # fast path: vmap over target speakers (rows) then pred speakers
+        # (cols) — one fused launch for device-pure metric functions
+        per_row = jax.vmap(
+            lambda t_spk: jax.vmap(lambda p_spk: pair_metric(preds[:, p_spk, ...], target[:, t_spk, ...]))(
+                jnp.arange(spk_num)
+            )
+        )
+        metric_mtx = per_row(jnp.arange(spk_num))  # [spk_t, spk_p, batch]
+    except Exception:
+        # host-backed metric functions (pesq/stoi/np-based) cannot trace under
+        # vmap — fall back to the reference's plain pairwise loop
+        rows = [
+            jnp.stack([pair_metric(preds[:, p, ...], target[:, t, ...]) for p in range(spk_num)])
+            for t in range(spk_num)
+        ]
+        metric_mtx = jnp.stack(rows)  # [spk_t, spk_p, batch]
+    metric_mtx = jnp.moveaxis(metric_mtx, -1, 0)  # [batch, spk_t, spk_p]
+
+    if spk_num <= _MAX_EXHAUSTIVE_SPK:
+        return _find_best_perm_by_exhaustive_method(metric_mtx, eval_func)
+    return _find_best_perm_by_linear_sum_assignment(metric_mtx, eval_func)
+
+
+def pit_permutate(preds: Array, perm: Array) -> Array:
+    """Reorder ``preds`` rows by the per-sample permutations from PIT."""
+    return jnp.take_along_axis(preds, perm.reshape(*perm.shape, *([1] * (preds.ndim - 2))), axis=1)

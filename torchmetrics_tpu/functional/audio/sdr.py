@@ -1,0 +1,95 @@
+"""Signal-to-distortion ratio (reference ``functional/audio/sdr.py``).
+
+The optimal distortion filter solves a symmetric-Toeplitz system built from
+FFT auto/cross-correlations. Everything — rFFT correlation, Toeplitz assembly
+via gather, and the dense solve — runs on device inside one jittable program.
+The reference upcasts to float64 for the solve; XLA TPU runs float32, so a
+small diagonal load stabilizes near-singular systems and parity tests use dB
+tolerances.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+
+def _symmetric_toeplitz(vector: Array) -> Array:
+    """Symmetric Toeplitz matrix from its first row, batched over leading dims.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio.sdr import _symmetric_toeplitz
+        >>> _symmetric_toeplitz(jnp.array([0, 1, 2, 3]))
+        Array([[0, 1, 2, 3],
+               [1, 0, 1, 2],
+               [2, 1, 0, 1],
+               [3, 2, 1, 0]], dtype=int32)
+    """
+    v_len = vector.shape[-1]
+    idx = jnp.abs(jnp.arange(v_len)[:, None] - jnp.arange(v_len)[None, :])
+    return vector[..., idx]
+
+
+def _compute_autocorr_crosscorr(target: Array, preds: Array, corr_len: int):
+    """FFT-based autocorrelation of ``target`` and cross-correlation with ``preds``."""
+    n_fft = 2 ** math.ceil(math.log2(preds.shape[-1] + target.shape[-1] - 1))
+    t_fft = jnp.fft.rfft(target, n=n_fft, axis=-1)
+    r_0 = jnp.fft.irfft(t_fft.real**2 + t_fft.imag**2, n=n_fft)[..., :corr_len]
+    p_fft = jnp.fft.rfft(preds, n=n_fft, axis=-1)
+    b = jnp.fft.irfft(jnp.conj(t_fft) * p_fft, n=n_fft, axis=-1)[..., :corr_len]
+    return r_0, b
+
+
+def signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    use_cg_iter: Optional[int] = None,
+    filter_length: int = 512,
+    zero_mean: bool = False,
+    load_diag: Optional[float] = None,
+) -> Array:
+    """SDR in dB: allows a ``filter_length``-tap distortion filter on the target.
+
+    ``use_cg_iter`` is accepted for API parity; the dense device solve is used
+    either way (XLA's batched LU beats an un-preconditioned CG here).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.audio import signal_distortion_ratio
+        >>> preds = jax.random.normal(jax.random.PRNGKey(0), (8000,))
+        >>> target = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> float(signal_distortion_ratio(preds, target)) < 0
+        True
+    """
+    _check_same_shape(preds, target)
+    preds = jnp.asarray(preds, dtype=jnp.float32)
+    target = jnp.asarray(target, dtype=jnp.float32)
+
+    if zero_mean:
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+
+    target = target / jnp.clip(jnp.linalg.norm(target, axis=-1, keepdims=True), min=1e-6)
+    preds = preds / jnp.clip(jnp.linalg.norm(preds, axis=-1, keepdims=True), min=1e-6)
+
+    r_0, b = _compute_autocorr_crosscorr(target, preds, corr_len=filter_length)
+
+    if load_diag is None:
+        # float32 stabilization absent the reference's float64 upcast
+        load_diag = 1e-7
+    r_0 = r_0.at[..., 0].add(load_diag)
+
+    r = _symmetric_toeplitz(r_0)
+    sol = jnp.linalg.solve(r, b[..., None])[..., 0]
+
+    coh = jnp.einsum("...l,...l->...", b, sol)
+    ratio = coh / (1 - coh)
+    return 10.0 * jnp.log10(ratio)

@@ -1,0 +1,110 @@
+"""SNR family (reference ``functional/audio/snr.py``): pure device math."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+
+Array = jax.Array
+
+_EPS = jnp.finfo(jnp.float32).eps
+
+
+def signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """Signal-to-noise ratio in dB, per sample over the trailing time axis.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import signal_noise_ratio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(signal_noise_ratio(preds, target)), 4)
+        16.1805
+    """
+    _check_same_shape(preds, target)
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    noise = target - preds
+    snr_value = (jnp.sum(target**2, axis=-1) + _EPS) / (jnp.sum(noise**2, axis=-1) + _EPS)
+    return 10 * jnp.log10(snr_value)
+
+
+def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """SI-SDR in dB, per sample.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import scale_invariant_signal_distortion_ratio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(scale_invariant_signal_distortion_ratio(preds, target)), 4)
+        18.4031
+    """
+    _check_same_shape(preds, target)
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    alpha = (jnp.sum(preds * target, axis=-1, keepdims=True) + _EPS) / (
+        jnp.sum(target**2, axis=-1, keepdims=True) + _EPS
+    )
+    target_scaled = alpha * target
+    noise = target_scaled - preds
+    val = (jnp.sum(target_scaled**2, axis=-1) + _EPS) / (jnp.sum(noise**2, axis=-1) + _EPS)
+    return 10 * jnp.log10(val)
+
+
+def scale_invariant_signal_noise_ratio(preds: Array, target: Array) -> Array:
+    """SI-SNR in dB, per sample.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import scale_invariant_signal_noise_ratio
+        >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
+        >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
+        >>> round(float(scale_invariant_signal_noise_ratio(preds, target)), 4)
+        15.0918
+    """
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=True)
+
+
+def complex_scale_invariant_signal_noise_ratio(preds: Array, target: Array, zero_mean: bool = False) -> Array:
+    """C-SI-SNR over complex spectra given as ``(..., freq, time, 2)`` real
+    tensors or complex ``(..., freq, time)`` tensors."""
+    if jnp.iscomplexobj(preds):
+        preds = jnp.stack([preds.real, preds.imag], axis=-1)
+    if jnp.iscomplexobj(target):
+        target = jnp.stack([target.real, target.imag], axis=-1)
+    if (preds.ndim < 3 or preds.shape[-1] != 2) or (target.ndim < 3 or target.shape[-1] != 2):
+        raise RuntimeError(
+            "Predictions and targets are expected to have the shape (..., frequency, time, 2),"
+            f" but got {preds.shape} and {target.shape}."
+        )
+    preds = preds.reshape(*preds.shape[:-3], -1)
+    target = target.reshape(*target.shape[:-3], -1)
+    return scale_invariant_signal_distortion_ratio(preds=preds, target=target, zero_mean=zero_mean)
+
+
+def source_aggregated_signal_distortion_ratio(
+    preds: Array,
+    target: Array,
+    scale_invariant: bool = True,
+    zero_mean: bool = False,
+) -> Array:
+    """SA-SDR over ``(..., spk, time)`` inputs: one shared scale across speakers."""
+    _check_same_shape(preds, target)
+    if preds.ndim < 2:
+        raise RuntimeError(f"The preds and target should have the shape (..., spk, time), but {preds.shape} found")
+    if zero_mean:
+        target = target - jnp.mean(target, axis=-1, keepdims=True)
+        preds = preds - jnp.mean(preds, axis=-1, keepdims=True)
+    if scale_invariant:
+        alpha = (jnp.sum(preds * target, axis=(-2, -1), keepdims=True) + _EPS) / (
+            jnp.sum(target**2, axis=(-2, -1), keepdims=True) + _EPS
+        )
+        target = alpha * target
+    distortion = target - preds
+    val = (jnp.sum(target**2, axis=(-2, -1)) + _EPS) / (jnp.sum(distortion**2, axis=(-2, -1)) + _EPS)
+    return 10 * jnp.log10(val)

@@ -1,0 +1,40 @@
+"""SRMR (reference ``functional/audio/srmr.py``).
+
+Speech-to-reverberation modulation energy ratio needs the ``gammatone`` and
+``torchaudio`` filterbank stacks, unavailable in this build; the entry point
+exists for API parity and raises with install guidance.
+"""
+
+from __future__ import annotations
+
+import jax
+
+from torchmetrics_tpu.utilities.imports import _GAMMATONE_AVAILABLE
+
+Array = jax.Array
+
+
+def speech_reverberation_modulation_energy_ratio(
+    preds: Array,
+    fs: int,
+    n_cochlear_filters: int = 23,
+    low_freq: float = 125,
+    min_cf: float = 4,
+    max_cf: float = 128,
+    norm: bool = False,
+    fast: bool = False,
+) -> Array:
+    """SRMR score (requires the ``gammatone`` filterbank package).
+
+    Raises:
+        ModuleNotFoundError: if the ``gammatone`` package is not installed.
+    """
+    if not _GAMMATONE_AVAILABLE:
+        raise ModuleNotFoundError(
+            "speech_reverberation_modulation_energy_ratio requires that gammatone is installed."
+            " Install as `pip install torchmetrics[audio]` or `pip install git+https://github.com/detly/gammatone`."
+        )
+    raise NotImplementedError(
+        "SRMR's gammatone-filterbank pipeline is not yet ported; install `gammatone` and use the reference"
+        " implementation, or open an issue for the JAX port."
+    )

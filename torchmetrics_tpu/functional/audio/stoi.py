@@ -1,0 +1,50 @@
+"""STOI (reference ``functional/audio/stoi.py``).
+
+Delegates to the host ``pystoi`` package (CPU DSP), gated behind a
+requirement flag, mirroring the reference's CPU-transfer behavior.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.imports import _PYSTOI_AVAILABLE
+
+Array = jax.Array
+
+__doctest_requires__ = {("short_time_objective_intelligibility",): ["pystoi"]}
+
+
+def short_time_objective_intelligibility(
+    preds: Array,
+    target: Array,
+    fs: int,
+    extended: bool = False,
+    keep_same_device: bool = False,
+) -> Array:
+    """STOI score via the host ``pystoi`` package.
+
+    Raises:
+        ModuleNotFoundError: if the ``pystoi`` package is not installed.
+    """
+    if not _PYSTOI_AVAILABLE:
+        raise ModuleNotFoundError(
+            "ShortTimeObjectiveIntelligibility metric requires that `pystoi` is installed."
+            " Either install as `pip install torchmetrics[audio]` or `pip install pystoi`."
+        )
+    from pystoi import stoi as stoi_backend
+
+    _check_same_shape(preds, target)
+
+    preds_np = np.asarray(preds, dtype=np.float32)
+    target_np = np.asarray(target, dtype=np.float32)
+    if preds_np.ndim == 1:
+        return jnp.asarray(stoi_backend(target_np, preds_np, fs, extended), dtype=jnp.float32)
+
+    preds_flat = preds_np.reshape(-1, preds_np.shape[-1])
+    target_flat = target_np.reshape(-1, target_np.shape[-1])
+    scores = [stoi_backend(t, p, fs, extended) for t, p in zip(target_flat, preds_flat)]
+    return jnp.asarray(np.asarray(scores, dtype=np.float32)).reshape(preds.shape[:-1])

@@ -1,0 +1,73 @@
+"""Deterministic CLIP-style dual encoder used when no pretrained weights exist.
+
+Pretrained CLIP checkpoints cannot be downloaded in this environment, so the
+default encoder is a fixed random-projection model: images are average-pooled
+to a patch grid and linearly projected; text is the mean of hashed token
+embeddings. Both are deterministic, context-sensitive, and device-resident —
+scores are self-consistent (same image/text pair always scores the same,
+matching content correlates) but do NOT match published CLIP numbers. Pass a
+real encoder for production use (``model`` argument on the metrics).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+_EMBED_DIM = 128
+_GRID = 8
+
+
+class RandomProjectionClipEncoder:
+    """Fixed-seed dual encoder exposing ``get_image_features``/``get_text_features``."""
+
+    embed_dim = _EMBED_DIM
+
+    def __init__(self, seed: int = 0, warn: bool = True) -> None:
+        self._proj = jax.random.normal(jax.random.PRNGKey(seed), (3 * _GRID * _GRID, _EMBED_DIM)) / (
+            3 * _GRID * _GRID
+        ) ** 0.5
+        if warn:
+            rank_zero_warn(
+                "CLIP encoder initialized with random projections (pretrained checkpoints cannot be"
+                " downloaded in this environment). Scores are deterministic and self-consistent but will"
+                " not match published CLIPScore/CLIP-IQA values; pass a real `model` for production use."
+            )
+
+    def get_image_features(self, images: Array) -> Array:
+        """images: float (B, 3, H, W), any range — normalized internally."""
+        images = jnp.asarray(images, dtype=jnp.float32)
+        mean = jnp.mean(images, axis=(1, 2, 3), keepdims=True)
+        std = jnp.std(images, axis=(1, 2, 3), keepdims=True) + 1e-6
+        images = (images - mean) / std
+        b, c, h, w = images.shape
+        # adaptive average-pool to a fixed patch grid so any resolution maps in
+        ph, pw = max(h // _GRID, 1), max(w // _GRID, 1)
+        pooled = jax.lax.reduce_window(
+            images, 0.0, jax.lax.add, (1, 1, ph, pw), (1, 1, ph, pw), "VALID"
+        ) / (ph * pw)
+        pooled = pooled[:, :, :_GRID, :_GRID]
+        pad_h = _GRID - pooled.shape[2]
+        pad_w = _GRID - pooled.shape[3]
+        pooled = jnp.pad(pooled, ((0, 0), (0, 0), (0, pad_h), (0, pad_w)))
+        return pooled.reshape(b, -1) @ self._proj
+
+    def get_text_features(self, text: Sequence[str]) -> Array:
+        feats: List[Array] = []
+        for sentence in text:
+            tokens = sentence.lower().split() or [""]
+            vecs = []
+            for tok in tokens:
+                h = 0
+                for ch in tok:
+                    h = (h * 1000003 + ord(ch)) & 0x7FFFFFFF
+                key = jax.random.fold_in(jax.random.PRNGKey(11), h)
+                vecs.append(jax.random.normal(key, (_EMBED_DIM,)))
+            feats.append(jnp.mean(jnp.stack(vecs), axis=0))
+        return jnp.stack(feats)

@@ -1,0 +1,72 @@
+"""CLIPScore (reference ``functional/multimodal/clip_score.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.multimodal._encoder import RandomProjectionClipEncoder
+
+Array = jax.Array
+
+
+def _get_clip_model(model_name_or_path: Optional[str], model: Optional[Any]) -> Any:
+    if model is not None:
+        return model
+    return RandomProjectionClipEncoder()
+
+
+def _clip_score_update(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model: Any,
+) -> Tuple[Array, int]:
+    """Per-pair 100·cosine(image_emb, text_emb) (ref ``clip_score.py:45-90``)."""
+    if not isinstance(images, list):
+        if images.ndim == 3:
+            images = [images]
+        else:
+            images = list(images)
+    if not all(i.ndim == 3 for i in images):
+        raise ValueError("Expected all images to be 3d but found image that has either more or less")
+    if not isinstance(text, list):
+        text = [text]
+    if len(text) != len(images):
+        raise ValueError(
+            f"Expected the number of images and text examples to be the same but got {len(images)} and {len(text)}"
+        )
+    img_batch = jnp.stack([jnp.asarray(i, dtype=jnp.float32) for i in images])
+    img_features = model.get_image_features(img_batch)
+    img_features = img_features / jnp.linalg.norm(img_features, axis=-1, keepdims=True)
+    txt_features = model.get_text_features(text)
+    txt_features = txt_features / jnp.linalg.norm(txt_features, axis=-1, keepdims=True)
+    score = 100 * jnp.sum(img_features * txt_features, axis=-1)
+    return score, len(text)
+
+
+def clip_score(
+    images: Union[Array, List[Array]],
+    text: Union[str, List[str]],
+    model_name_or_path: Optional[str] = None,
+    model: Optional[Any] = None,
+) -> Array:
+    """CLIPScore: mean 100·cosine similarity between image and caption embeddings.
+
+    ``model`` may be any object exposing ``get_image_features(images)`` and
+    ``get_text_features(list_of_str)``; the default is the deterministic
+    random-projection encoder (self-consistent scores only).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.multimodal import clip_score
+        >>> img = jax.random.uniform(jax.random.PRNGKey(42), (3, 224, 224))
+        >>> score = clip_score(img, "a photo of a cat")
+        >>> bool(score == score)  # deterministic, finite
+        True
+    """
+    clip_model = _get_clip_model(model_name_or_path, model)
+    score, _ = _clip_score_update(images, text, clip_model)
+    score = jnp.mean(score)
+    return jnp.maximum(score, jnp.zeros_like(score))

@@ -1,0 +1,163 @@
+"""Pairwise distance/similarity matrix kernels (reference
+``functional/pairwise/{cosine,euclidean,linear,manhattan,minkowski}.py``).
+
+All five are single fused XLA programs: the Gram-matrix forms (cosine, linear,
+euclidean) ride the MXU via one matmul; the elementwise forms (manhattan,
+minkowski) broadcast ``[N,1,d] - [1,M,d]`` and reduce — XLA fuses the abs/pow
+into the reduction so no ``[N,M,d]`` intermediate is materialized in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def _check_input(x: Array, y: Optional[Array], zero_diagonal: Optional[bool]) -> Tuple[Array, Array, bool]:
+    x = jnp.asarray(x, dtype=jnp.float32)
+    if x.ndim != 2:
+        raise ValueError(f"Expected argument `x` to be a 2D tensor of shape `[N, d]` but got {x.shape}")
+    if y is not None:
+        y = jnp.asarray(y, dtype=jnp.float32)
+        if y.ndim != 2 or y.shape[1] != x.shape[1]:
+            raise ValueError(
+                "Expected argument `y` to be a 2D tensor of shape `[M, d]` where"
+                " `d` should be same as the last dimension of `x`"
+            )
+        zero_diagonal = False if zero_diagonal is None else zero_diagonal
+    else:
+        y = x
+        zero_diagonal = True if zero_diagonal is None else zero_diagonal
+    return x, y, zero_diagonal
+
+
+def _reduce_distance_matrix(distmat: Array, reduction: Optional[str] = None) -> Array:
+    if reduction == "mean":
+        return jnp.mean(distmat, axis=-1)
+    if reduction == "sum":
+        return jnp.sum(distmat, axis=-1)
+    if reduction is None or reduction == "none":
+        return distmat
+    raise ValueError(f"Expected reduction to be one of `['mean', 'sum', None]` but got {reduction}")
+
+
+def _zero_diagonal(distance: Array, zero_diagonal: bool) -> Array:
+    if zero_diagonal:
+        n = min(distance.shape)
+        distance = distance.at[jnp.arange(n), jnp.arange(n)].set(0)
+    return distance
+
+
+def pairwise_cosine_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise cosine similarity between rows of ``x`` and ``y`` (or ``x`` with itself).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.pairwise import pairwise_cosine_similarity
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> y = jnp.array([[1., 0.], [2., 1.]])
+        >>> pairwise_cosine_similarity(x, y).shape
+        (3, 2)
+    """
+    x, y, zd = _check_input(x, y, zero_diagonal)
+    x = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-38)
+    y = y / jnp.maximum(jnp.linalg.norm(y, axis=1, keepdims=True), 1e-38)
+    distance = x @ y.T
+    return _reduce_distance_matrix(_zero_diagonal(distance, zd), reduction)
+
+
+def pairwise_euclidean_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise euclidean distance matrix via the Gram-matrix identity
+    ``||x-y||² = ||x||² + ||y||² - 2x·y`` (one MXU matmul).
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.pairwise import pairwise_euclidean_distance
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> pairwise_euclidean_distance(x).shape
+        (3, 3)
+    """
+    x, y, zd = _check_input(x, y, zero_diagonal)
+    x_norm = jnp.sum(x * x, axis=1, keepdims=True)
+    y_norm = jnp.sum(y * y, axis=1)
+    distance = x_norm + y_norm[None, :] - 2 * (x @ y.T)
+    distance = jnp.sqrt(jnp.maximum(distance, 0.0))
+    return _reduce_distance_matrix(_zero_diagonal(distance, zd), reduction)
+
+
+def pairwise_manhattan_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise manhattan (L1) distance matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.pairwise import pairwise_manhattan_distance
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> float(pairwise_manhattan_distance(x)[0, 1])
+        3.0
+    """
+    x, y, zd = _check_input(x, y, zero_diagonal)
+    distance = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]), axis=-1)
+    return _reduce_distance_matrix(_zero_diagonal(distance, zd), reduction)
+
+
+def pairwise_minkowski_distance(
+    x: Array,
+    y: Optional[Array] = None,
+    exponent: float = 2,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise minkowski distance matrix with the given exponent.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.pairwise import pairwise_minkowski_distance
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> pairwise_minkowski_distance(x, exponent=3).shape
+        (3, 3)
+    """
+    if not (isinstance(exponent, (float, int)) and exponent >= 1):
+        raise ValueError(f"Argument `exponent` must be a float or int greater than 1, but got {exponent}")
+    x, y, zd = _check_input(x, y, zero_diagonal)
+    distance = jnp.sum(jnp.abs(x[:, None, :] - y[None, :, :]) ** exponent, axis=-1) ** (1.0 / exponent)
+    return _reduce_distance_matrix(_zero_diagonal(distance, zd), reduction)
+
+
+def pairwise_linear_similarity(
+    x: Array,
+    y: Optional[Array] = None,
+    reduction: Optional[str] = None,
+    zero_diagonal: Optional[bool] = None,
+) -> Array:
+    """Pairwise linear similarity (inner product) matrix.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.pairwise import pairwise_linear_similarity
+        >>> x = jnp.array([[2., 3.], [3., 5.], [5., 8.]])
+        >>> y = jnp.array([[1., 0.], [2., 1.]])
+        >>> float(pairwise_linear_similarity(x, y)[0, 0])
+        2.0
+    """
+    x, y, zd = _check_input(x, y, zero_diagonal)
+    distance = x @ y.T
+    return _reduce_distance_matrix(_zero_diagonal(distance, zd), reduction)

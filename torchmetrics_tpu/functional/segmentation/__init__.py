@@ -1,0 +1,23 @@
+"""Functional segmentation utilities (reference ``functional/segmentation/``).
+
+The reference snapshot exports no public segmentation metrics yet; its
+morphology utilities (``utils.py:107-386``) are the build target here.
+"""
+
+from torchmetrics_tpu.functional.segmentation.utils import (
+    binary_erosion,
+    check_if_binarized,
+    distance_transform,
+    generate_binary_structure,
+    mask_edges,
+    surface_distance,
+)
+
+__all__ = [
+    "binary_erosion",
+    "check_if_binarized",
+    "distance_transform",
+    "generate_binary_structure",
+    "mask_edges",
+    "surface_distance",
+]

@@ -1,0 +1,66 @@
+"""CLIPImageQualityAssessment class (reference ``multimodal/clip_iqa.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.multimodal._encoder import RandomProjectionClipEncoder
+from torchmetrics_tpu.functional.multimodal.clip_iqa import (
+    _clip_iqa_compute,
+    _clip_iqa_format_prompts,
+    _clip_iqa_get_anchor_vectors,
+    _clip_iqa_update,
+)
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+Array = jax.Array
+
+
+class CLIPImageQualityAssessment(Metric):
+    """CLIP-IQA: P(image matches positive prompt) per prompt pair.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment
+        >>> metric = CLIPImageQualityAssessment()
+        >>> imgs = jax.random.uniform(jax.random.PRNGKey(42), (2, 3, 64, 64))
+        >>> probs = metric(imgs)
+        >>> bool(((probs >= 0) & (probs <= 1)).all())
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = False
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 1.0
+
+    def __init__(
+        self,
+        model_name_or_path: str = "clip_iqa",
+        data_range: float = 1.0,
+        prompts: Tuple = ("quality",),
+        model: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.data_range = data_range
+        self.prompts_list, self.prompts_names = _clip_iqa_format_prompts(prompts)
+        self.model = model if model is not None else RandomProjectionClipEncoder()
+        self.anchors = _clip_iqa_get_anchor_vectors(self.model, self.prompts_list)
+        self.add_state("probs_list", default=[], dist_reduce_fx="cat")
+
+    def update(self, images: Array) -> None:
+        img_features = _clip_iqa_update(images, self.model, self.data_range)
+        probs = _clip_iqa_compute(img_features, self.anchors, self.prompts_names, format_as_dict=False)
+        self.probs_list.append(probs.reshape(images.shape[0], -1))
+
+    def compute(self) -> Union[Array, Dict[str, Array]]:
+        probs = dim_zero_cat(self.probs_list)
+        if len(self.prompts_names) == 1:
+            return probs.squeeze()
+        return {p: probs[:, i] for i, p in enumerate(self.prompts_names)}

@@ -1,0 +1,56 @@
+"""CLIPScore class (reference ``multimodal/clip_score.py``)."""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional, Union
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.multimodal.clip_score import _clip_score_update, _get_clip_model
+from torchmetrics_tpu.metric import Metric
+
+Array = jax.Array
+
+
+class CLIPScore(Metric):
+    """CLIPScore: mean 100·cosine similarity between images and captions.
+
+    ``model`` may be any object exposing ``get_image_features`` /
+    ``get_text_features``; the default is the deterministic random-projection
+    encoder (pretrained CLIP cannot be downloaded in this environment).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.multimodal import CLIPScore
+        >>> metric = CLIPScore()
+        >>> img = jax.random.uniform(jax.random.PRNGKey(42), (3, 224, 224))
+        >>> score = metric(img, "a photo of a cat")
+        >>> bool(score == score)
+        True
+    """
+
+    is_differentiable = False
+    higher_is_better = True
+    full_state_update = True
+    plot_lower_bound: float = 0.0
+    plot_upper_bound: float = 100.0
+
+    def __init__(
+        self,
+        model_name_or_path: Optional[str] = None,
+        model: Optional[Any] = None,
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(**kwargs)
+        self.model = _get_clip_model(model_name_or_path, model)
+        self.add_state("score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
+        self.add_state("n_samples", default=jnp.asarray(0), dist_reduce_fx="sum")
+
+    def update(self, images: Union[Array, List[Array]], text: Union[str, List[str]]) -> None:
+        score, n_samples = _clip_score_update(images, text, self.model)
+        self.score = self.score + jnp.sum(score)
+        self.n_samples = self.n_samples + n_samples
+
+    def compute(self) -> Array:
+        return jnp.maximum(self.score / self.n_samples, jnp.asarray(0.0))
