@@ -30,6 +30,18 @@ WARMUP = 5
 ITERS = 50
 
 
+
+def _min_time(run, reps: int = 3) -> float:
+    """Warm once (compile), then return the fastest of ``reps`` timed runs."""
+    run()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        run()
+        times.append(time.perf_counter() - t0)
+    return min(times)
+
+
 def _bench_ours() -> float:
     import jax
     import jax.numpy as jnp
@@ -160,13 +172,7 @@ def _bench_map_ours(data) -> float:
         # through the axon device tunnel)
         return float(jnp.sum(P))
 
-    run()  # compile + warm
-    times = []
-    for _ in range(3):
-        t0 = time.perf_counter()
-        run()
-        times.append(time.perf_counter() - t0)
-    return min(times)
+    return _min_time(run)
 
 
 def _bench_map_cpu_baseline(data) -> float:
@@ -312,6 +318,77 @@ def _bench_collection_sync():
 
 
 # --------------------------------------------------------------------- #
+# BASELINE #5: text — BERTScore + WER throughput                        #
+# --------------------------------------------------------------------- #
+
+TEXT_SAMPLES = 256
+
+
+def _text_corpus():
+    import numpy as np
+
+    rng = np.random.default_rng(0)
+    vocab = [f"word{i}" for i in range(500)]
+    preds, target = [], []
+    for _ in range(TEXT_SAMPLES):
+        n = int(rng.integers(8, 24))
+        sent = [vocab[int(i)] for i in rng.integers(0, len(vocab), n)]
+        ref = list(sent)
+        for j in range(len(ref)):
+            if rng.random() < 0.2:
+                ref[j] = vocab[int(rng.integers(0, len(vocab)))]
+        preds.append(" ".join(sent))
+        target.append(" ".join(ref))
+    return preds, target
+
+
+def _bench_bertscore_samples_per_sec(preds, target) -> float:
+    from torchmetrics_tpu.functional.text import bert_score
+
+    def run():
+        out = bert_score(preds, target)
+        return float(out["f1"][0])
+
+    return TEXT_SAMPLES / _min_time(run)
+
+
+CER_SAMPLES = 256
+CER_CHARS = 250  # long-form ASR transcript scale — where the DP cost matters
+
+
+def _bench_cer():
+    """Batched device Levenshtein on long transcripts vs the reference's
+    per-sample python DP (its actual implementation strategy)."""
+    import numpy as np
+
+    from torchmetrics_tpu.functional.text import char_error_rate
+    from torchmetrics_tpu.functional.text.helper import _edit_distance_host
+
+    rng = np.random.default_rng(0)
+    alphabet = "abcdefghijklmnopqrstuvwxyz "
+    preds, target = [], []
+    for _ in range(CER_SAMPLES):
+        sent = "".join(alphabet[i] for i in rng.integers(0, len(alphabet), CER_CHARS))
+        ref = list(sent)
+        for j in range(len(ref)):
+            if rng.random() < 0.1:
+                ref[j] = alphabet[int(rng.integers(0, len(alphabet)))]
+        preds.append(sent)
+        target.append("".join(ref))
+
+    def run():
+        return float(char_error_rate(preds, target))
+
+    ours = CER_SAMPLES / _min_time(run)
+
+    t0 = time.perf_counter()
+    for p, t in zip(preds, target):
+        _edit_distance_host(list(p), list(t))
+    base = CER_SAMPLES / (time.perf_counter() - t0)
+    return ours, base
+
+
+# --------------------------------------------------------------------- #
 # BASELINE #4: FID InceptionV3 feature-extraction throughput            #
 # --------------------------------------------------------------------- #
 
@@ -338,13 +415,7 @@ def _bench_fid_imgs_per_sec() -> float:
         # the FID state fold (sum + covariance outer product)
         return float(jnp.sum(feats.T @ feats)) + float(jnp.sum(feats))
 
-    step()  # compile
-    times = []
-    for _ in range(5):
-        t0 = time.perf_counter()
-        step()
-        times.append(time.perf_counter() - t0)
-    return FID_BATCH / min(times)
+    return FID_BATCH / _min_time(step, reps=5)
 
 
 def main() -> None:
@@ -383,6 +454,30 @@ def main() -> None:
                 "value": round(fid_rate, 1),
                 "unit": f"imgs/sec (batch={FID_BATCH}, 299x299, InceptionV3 2048-d + cov fold)",
                 "vs_baseline": 1.0,
+            }
+        )
+    )
+
+    text_preds, text_target = _text_corpus()
+    bert_rate = _bench_bertscore_samples_per_sec(text_preds, text_target)
+    cer_rate, cer_base = _bench_cer()
+    print(
+        json.dumps(
+            {
+                "metric": "bertscore_samples_per_sec",
+                "value": round(bert_rate, 1),
+                "unit": f"samples/sec ({TEXT_SAMPLES} sentence pairs, batched greedy cosine matching)",
+                "vs_baseline": 1.0,
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "cer_long_transcript_samples_per_sec",
+                "value": round(cer_rate, 1),
+                "unit": f"samples/sec ({CER_SAMPLES} pairs x {CER_CHARS} chars; baseline = reference's per-sample python DP)",
+                "vs_baseline": round(cer_rate / cer_base, 2),
             }
         )
     )

@@ -35,7 +35,12 @@ BATCHES = [
 ]
 
 
-def test_device_kernel_matches_host_dp():
+def test_device_kernel_matches_host_dp(monkeypatch):
+    # force the device path — the adaptive dispatch would otherwise route
+    # these tiny cases to the host DP and the kernel would go untested
+    import torchmetrics_tpu.functional.text.helper as helper_mod
+
+    monkeypatch.setattr(helper_mod, "_HOST_DISPATCH_MAX_CELLS", 0)
     cases = [
         (list("kitten"), list("sitting")),
         ([], list("abc")),
@@ -46,6 +51,21 @@ def test_device_kernel_matches_host_dp():
     device = _edit_distance_tokens([a for a, _ in cases], [b for _, b in cases])
     for i, (a, b) in enumerate(cases):
         assert int(device[i]) == _edit_distance_host(a, b)
+
+
+def test_device_kernel_substitution_cost_and_fuzz(monkeypatch):
+    import numpy as np
+
+    import torchmetrics_tpu.functional.text.helper as helper_mod
+
+    monkeypatch.setattr(helper_mod, "_HOST_DISPATCH_MAX_CELLS", 0)
+    rng = np.random.default_rng(0)
+    for cost in (1, 2, 3):
+        preds = [[str(x) for x in rng.integers(0, 5, rng.integers(0, 20))] for _ in range(16)]
+        tgts = [[str(x) for x in rng.integers(0, 5, rng.integers(0, 20))] for _ in range(16)]
+        device = _edit_distance_tokens(preds, tgts, substitution_cost=cost)
+        for i, (a, b) in enumerate(zip(preds, tgts)):
+            assert int(device[i]) == _edit_distance_host(a, b, cost), (a, b, cost)
 
 
 @pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")

@@ -144,14 +144,34 @@ def _lcs_batch(pred_ids: Array, pred_len: Array, tgt_ids: Array, tgt_len: Array)
     return jax.vmap(one_pair)(pred_ids, pred_len, tgt_ids, tgt_len)
 
 
+# Below this many total DP cells the per-launch dispatch/fetch overhead beats
+# the device win — a tiny host DP is faster (measured ~500k-cell crossover
+# through the remote-TPU tunnel; on-host backends only lower the crossover).
+_HOST_DISPATCH_MAX_CELLS = 500_000
+
+
 def _edit_distance_tokens(
     preds_tokens: Sequence[Sequence[str]],
     target_tokens: Sequence[Sequence[str]],
     substitution_cost: int = 1,
 ) -> Array:
-    """Per-sample Levenshtein distances for pre-tokenized batches (device path)."""
+    """Per-sample Levenshtein distances for pre-tokenized batches.
+
+    Adaptive dispatch: small workloads run the host DP (dispatch-latency
+    bound), large ones the batched device kernel (compute bound, 30-80×
+    faster than the per-sample DP at transcript scale).
+    """
     if not preds_tokens:
         return jnp.zeros((0,), dtype=jnp.float32)
+    total_cells = sum(len(p) * len(t) for p, t in zip(preds_tokens, target_tokens))
+    if total_cells <= _HOST_DISPATCH_MAX_CELLS:
+        return jnp.asarray(
+            [
+                float(_edit_distance_host(p, t, substitution_cost))
+                for p, t in zip(preds_tokens, target_tokens)
+            ],
+            dtype=jnp.float32,
+        )
     p_ids, p_len, t_ids, t_len = _encode_batch(preds_tokens, target_tokens)
     return _levenshtein_batch(
         jnp.asarray(p_ids), jnp.asarray(p_len), jnp.asarray(t_ids), jnp.asarray(t_len), substitution_cost
@@ -168,12 +188,16 @@ def _lcs_tokens(
     return _lcs_batch(jnp.asarray(p_ids), jnp.asarray(p_len), jnp.asarray(t_ids), jnp.asarray(t_len))
 
 
-def _edit_distance_host(prediction_tokens: Sequence[str], reference_tokens: Sequence[str]) -> int:
-    """Single-pair host Levenshtein (used by host-only algorithms like TER)."""
+def _edit_distance_host(
+    prediction_tokens: Sequence[str], reference_tokens: Sequence[str], substitution_cost: int = 1
+) -> int:
+    """Single-pair host Levenshtein (small inputs and host-only algorithms like TER)."""
     prev = list(range(len(reference_tokens) + 1))
     for i, p_tok in enumerate(prediction_tokens, start=1):
         cur = [i] + [0] * len(reference_tokens)
         for j, r_tok in enumerate(reference_tokens, start=1):
-            cur[j] = min(prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (p_tok != r_tok))
+            cur[j] = min(
+                prev[j] + 1, cur[j - 1] + 1, prev[j - 1] + (substitution_cost if p_tok != r_tok else 0)
+            )
         prev = cur
     return prev[-1]
