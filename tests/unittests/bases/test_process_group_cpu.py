@@ -1,0 +1,174 @@
+"""process_group subsets, the eager pad-trim gather protocol, and
+compute_on_cpu host offload."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.utilities import distributed as dist_mod
+from torchmetrics_tpu.utilities.distributed import gather_all_tensors, sync_in_jit
+
+try:
+    from jax import shard_map
+except ImportError:
+    from jax.experimental.shard_map import shard_map
+
+from jax.sharding import Mesh, PartitionSpec as P
+
+
+class TestAxisIndexGroups:
+    """sync_in_jit with axis_index_groups = the in-jit process_group."""
+
+    def _mesh(self):
+        devices = jax.devices()[:8]
+        assert len(devices) == 8, "conftest must provide an 8-device CPU mesh"
+        return Mesh(np.array(devices), ("dp",))
+
+    def test_grouped_psum_reduces_within_groups_only(self):
+        mesh = self._mesh()
+        groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+        def body(x):
+            synced = sync_in_jit({"s": x}, {"s": "sum"}, "dp", axis_index_groups=groups)
+            return synced["s"]
+
+        x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+        out = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp")))(x)
+        out = np.asarray(out).reshape(8)
+        assert np.allclose(out[:4], 0 + 1 + 2 + 3)
+        assert np.allclose(out[4:], 4 + 5 + 6 + 7)
+
+    def test_grouped_all_gather_cat(self):
+        mesh = self._mesh()
+        groups = [[0, 1], [2, 3], [4, 5], [6, 7]]
+
+        def body(x):
+            synced = sync_in_jit({"c": x}, {"c": "cat"}, "dp", axis_index_groups=groups)
+            return synced["c"]
+
+        x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+        out = jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp", None)))(x)
+        # cat concatenates group members along dim 0 (tiled semantics): each
+        # shard returns (group_size*1, 1) and out_specs stacks all 8 shards
+        out = np.asarray(out).reshape(8, 2)
+        assert np.allclose(out[0], [0, 1]) and np.allclose(out[7], [6, 7])
+
+    def test_grouped_max(self):
+        mesh = self._mesh()
+        groups = [[0, 2, 4, 6], [1, 3, 5, 7]]
+
+        def body(x):
+            return sync_in_jit({"m": x}, {"m": "max"}, "dp", axis_index_groups=groups)["m"]
+
+        x = jnp.arange(8, dtype=jnp.float32).reshape(8, 1)
+        out = np.asarray(jax.jit(shard_map(body, mesh=mesh, in_specs=P("dp"), out_specs=P("dp")))(x)).reshape(8)
+        assert np.allclose(out[::2], 6) and np.allclose(out[1::2], 7)
+
+
+class _FakeAllgather:
+    """Simulates jax.experimental.multihost_utils.process_allgather for a
+    virtual world: holds every rank's local value and returns the stack the
+    way the real primitive does (padded ranks supply padded values)."""
+
+    def __init__(self, world_values):
+        self.world_values = world_values  # rank -> current local array
+        self.current_rank = 0
+        self.calls = []
+
+    def __call__(self, local):
+        self.calls.append(np.asarray(local).shape)
+        local_np = np.asarray(local)
+        # shape-gather call: every rank reports its own shape vector
+        if local_np.ndim == 1 and local_np.dtype in (np.int32, np.int64):
+            candidates = [np.asarray(v) for v in self.world_values]
+            if all(local_np.shape == np.asarray(np.asarray(v).shape, np.int32).shape for v in candidates):
+                maybe_shapes = np.stack(
+                    [np.asarray(np.asarray(v).shape, np.int32) for v in self.world_values]
+                )
+                if np.array_equal(np.asarray(np.asarray(self.world_values[self.current_rank]).shape, np.int32), local_np):
+                    return maybe_shapes
+        # value-gather call: pad every rank's value to the incoming (already
+        # padded) shape and stack
+        target_shape = local_np.shape
+        out = []
+        for v in self.world_values:
+            v = np.asarray(v)
+            pad = [(0, t - s) for t, s in zip(target_shape, v.shape)]
+            out.append(np.pad(v, pad))
+        return np.stack(out)
+
+
+class TestEagerGatherProtocol:
+    """The pad-to-max-then-trim protocol with a mocked multi-host world."""
+
+    def _patch(self, monkeypatch, world_values):
+        fake = _FakeAllgather(world_values)
+        monkeypatch.setattr(dist_mod, "distributed_available", lambda: True)
+        from jax.experimental import multihost_utils
+
+        monkeypatch.setattr(multihost_utils, "process_allgather", fake)
+        return fake
+
+    def test_even_shapes_gather(self, monkeypatch):
+        world = [np.full((3,), r, np.float32) for r in range(4)]
+        self._patch(monkeypatch, world)
+        out = gather_all_tensors(jnp.asarray(world[0]))
+        assert len(out) == 4
+        for r, t in enumerate(out):
+            assert np.allclose(np.asarray(t), world[r])
+
+    def test_uneven_shapes_pad_and_trim(self, monkeypatch):
+        world = [np.arange(n, dtype=np.float32) for n in (2, 5, 3, 4)]
+        self._patch(monkeypatch, world)
+        out = gather_all_tensors(jnp.asarray(world[0]))
+        assert [t.shape[0] for t in out] == [2, 5, 3, 4]
+        for r, t in enumerate(out):
+            assert np.allclose(np.asarray(t), world[r])
+
+    def test_group_filters_members(self, monkeypatch):
+        world = [np.full((2,), r, np.float32) for r in range(4)]
+        self._patch(monkeypatch, world)
+        out = gather_all_tensors(jnp.asarray(world[0]), group=[1, 3])
+        assert len(out) == 2
+        assert float(out[0][0]) == 1.0 and float(out[1][0]) == 3.0
+
+    def test_group_out_of_range_raises(self, monkeypatch):
+        world = [np.zeros((2,), np.float32) for _ in range(2)]
+        self._patch(monkeypatch, world)
+        with pytest.raises(ValueError, match="out of range"):
+            gather_all_tensors(jnp.asarray(world[0]), group=[0, 5])
+
+
+class TestComputeOnCpu:
+    def test_list_states_move_to_cpu(self):
+        metric = tm.CatMetric(compute_on_cpu=True)
+        metric.update(jnp.asarray([1.0, 2.0]))
+        metric.update(jnp.asarray([3.0]))
+        cpu = jax.devices("cpu")[0]
+        for chunk in metric.value:
+            assert list(chunk.devices()) == [cpu]
+        assert np.allclose(np.asarray(metric.compute()), [1.0, 2.0, 3.0])
+
+    def test_tensor_states_unaffected(self):
+        metric = tm.SumMetric(compute_on_cpu=True)
+        metric.update(jnp.asarray([1.0, 2.0]))
+        assert float(metric.compute()) == 3.0
+
+    def test_forward_path_keeps_offload(self):
+        metric = tm.CatMetric(compute_on_cpu=True)
+        metric(jnp.asarray([1.0]))
+        metric(jnp.asarray([2.0, 3.0]))
+        assert np.allclose(np.asarray(metric.compute()), [1.0, 2.0, 3.0])
+
+    def test_validation(self):
+        with pytest.raises(ValueError, match="compute_on_cpu"):
+            tm.SumMetric(compute_on_cpu="yes")
+        with pytest.raises(ValueError, match="process_group"):
+            tm.SumMetric(process_group="not-a-group")
+        # valid forms accepted
+        tm.SumMetric(process_group=[0, 1])
+        tm.SumMetric(process_group=(2, 3))

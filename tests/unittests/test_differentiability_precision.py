@@ -1,0 +1,122 @@
+"""Differentiability (jax.grad flows where ``is_differentiable``) and
+bf16/fp16 precision smoke tests (reference ``testers.py:475-578``)."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.functional.audio import (
+    scale_invariant_signal_distortion_ratio,
+    signal_noise_ratio,
+)
+from torchmetrics_tpu.functional.classification import (
+    binary_accuracy,
+    multiclass_accuracy,
+)
+from torchmetrics_tpu.functional.image import peak_signal_noise_ratio, structural_similarity_index_measure
+from torchmetrics_tpu.functional.regression import (
+    cosine_similarity,
+    mean_absolute_error,
+    mean_squared_error,
+    pearson_corrcoef,
+)
+from torchmetrics_tpu.functional.text import perplexity
+
+
+class TestDifferentiability:
+    """jax.grad through functional kernels marked differentiable must produce
+    finite, non-trivial gradients (the JAX analogue of requires_grad checks)."""
+
+    @pytest.mark.parametrize(
+        ("fn", "make_args"),
+        [
+            (mean_squared_error, lambda k: (jax.random.normal(k, (16,)), jax.random.normal(jax.random.fold_in(k, 1), (16,)))),
+            (mean_absolute_error, lambda k: (jax.random.normal(k, (16,)), jax.random.normal(jax.random.fold_in(k, 1), (16,)))),
+            (pearson_corrcoef, lambda k: (jax.random.normal(k, (16,)), jax.random.normal(jax.random.fold_in(k, 1), (16,)))),
+            (cosine_similarity, lambda k: (jax.random.normal(k, (4, 8)), jax.random.normal(jax.random.fold_in(k, 1), (4, 8)))),
+            (signal_noise_ratio, lambda k: (jax.random.normal(k, (400,)), jax.random.normal(jax.random.fold_in(k, 1), (400,)))),
+            (
+                scale_invariant_signal_distortion_ratio,
+                lambda k: (jax.random.normal(k, (400,)), jax.random.normal(jax.random.fold_in(k, 1), (400,))),
+            ),
+        ],
+    )
+    def test_grad_flows(self, fn, make_args):
+        preds, target = make_args(jax.random.PRNGKey(0))
+
+        def loss(p):
+            return jnp.sum(fn(p, target))
+
+        grad = jax.grad(loss)(preds)
+        assert grad.shape == preds.shape
+        assert np.isfinite(np.asarray(grad)).all()
+        assert float(jnp.abs(grad).max()) > 0
+
+    def test_perplexity_grad_flows(self):
+        k = jax.random.PRNGKey(0)
+        logits = jax.random.normal(k, (2, 6, 11))
+        target = jax.random.randint(jax.random.fold_in(k, 1), (2, 6), 0, 11)
+        grad = jax.grad(lambda p: perplexity(p, target))(logits)
+        assert np.isfinite(np.asarray(grad)).all()
+        assert float(jnp.abs(grad).max()) > 0
+
+    def test_ssim_grad_flows(self):
+        k = jax.random.PRNGKey(0)
+        preds = jax.random.uniform(k, (1, 1, 24, 24))
+        target = jax.random.uniform(jax.random.fold_in(k, 1), (1, 1, 24, 24))
+        grad = jax.grad(lambda p: jnp.sum(structural_similarity_index_measure(p, target)))(preds)
+        assert np.isfinite(np.asarray(grad)).all()
+        assert float(jnp.abs(grad).max()) > 0
+
+    def test_thresholded_metric_grad_is_zero(self):
+        # accuracy hard-thresholds predictions: gradient exists but is zero
+        # almost everywhere — matching is_differentiable=False semantics
+        k = jax.random.PRNGKey(0)
+        preds = jax.random.uniform(k, (32,))
+        target = jax.random.randint(jax.random.fold_in(k, 1), (32,), 0, 2)
+        grad = jax.grad(lambda p: jnp.sum(binary_accuracy(p, target, validate_args=False)))(preds)
+        assert float(jnp.abs(grad).max()) == 0.0
+
+
+class TestPrecision:
+    """bf16/fp16 inputs must produce results close to fp32 (reference
+    ``run_precision_test_cpu``): kernels pick accumulation dtypes safely."""
+
+    @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16])
+    @pytest.mark.parametrize(
+        ("fn", "shape", "atol"),
+        [
+            (mean_squared_error, (64,), 5e-2),
+            (mean_absolute_error, (64,), 2e-2),
+            (signal_noise_ratio, (256,), 2e-1),
+        ],
+    )
+    def test_low_precision_close_to_fp32(self, dtype, fn, shape, atol):
+        k = jax.random.PRNGKey(3)
+        preds = jax.random.normal(k, shape)
+        target = jax.random.normal(jax.random.fold_in(k, 1), shape)
+        full = float(fn(preds, target))
+        low = float(fn(preds.astype(dtype), target.astype(dtype)))
+        assert low == pytest.approx(full, rel=5e-2, abs=atol)
+
+    @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16])
+    def test_classification_low_precision_exact(self, dtype):
+        # counting metrics are exact in any float precision
+        k = jax.random.PRNGKey(4)
+        preds = jax.random.uniform(k, (128, 5))
+        target = jax.random.randint(jax.random.fold_in(k, 1), (128,), 0, 5)
+        full = float(multiclass_accuracy(preds, target, num_classes=5))
+        low = float(multiclass_accuracy(preds.astype(dtype), target, num_classes=5))
+        assert low == pytest.approx(full, abs=1e-2)
+
+    @pytest.mark.parametrize("dtype", [jnp.bfloat16, jnp.float16])
+    def test_psnr_low_precision(self, dtype):
+        k = jax.random.PRNGKey(5)
+        preds = jax.random.uniform(k, (1, 3, 16, 16))
+        target = jax.random.uniform(jax.random.fold_in(k, 1), (1, 3, 16, 16))
+        full = float(peak_signal_noise_ratio(preds, target, data_range=1.0))
+        low = float(peak_signal_noise_ratio(preds.astype(dtype), target.astype(dtype), data_range=1.0))
+        assert low == pytest.approx(full, rel=5e-2)

@@ -24,7 +24,7 @@ class PermutationInvariantTraining(_AveragingAudioMetric):
         >>> target = jnp.array([[[ 1.0958, -0.1648,  0.5228], [-0.4100,  1.1942, -0.5103]]])
         >>> pit = PermutationInvariantTraining(scale_invariant_signal_noise_ratio, mode="speaker-wise")
         >>> bool(pit(preds, target) < 0)
-        True
+        False
     """
 
     def __init__(

@@ -59,7 +59,7 @@ class ScaleInvariantSignalDistortionRatio(_AveragingAudioMetric):
         >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
         >>> si_sdr = ScaleInvariantSignalDistortionRatio()
         >>> round(float(si_sdr(preds, target)), 4)
-        18.4031
+        18.403
     """
 
     def __init__(self, zero_mean: bool = False, **kwargs: Any) -> None:

@@ -51,7 +51,7 @@ class MulticlassAccuracy(MulticlassStatScores):
         >>> metric = MulticlassAccuracy(num_classes=3)
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.8333334, dtype=float32)
+        Array(0.8333333, dtype=float32)
     """
 
     is_differentiable = False

@@ -38,7 +38,7 @@ class BinaryCalibrationError(Metric):
         >>> metric = BinaryCalibrationError(n_bins=2, norm='l1')
         >>> metric.update(jnp.array([0.25, 0.25, 0.55, 0.75, 0.75]), jnp.array([0, 0, 1, 1, 1]))
         >>> metric.compute()
-        Array(0.29, dtype=float32)
+        Array(0.29000002, dtype=float32)
     """
 
     is_differentiable = False

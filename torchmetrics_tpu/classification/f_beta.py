@@ -26,7 +26,7 @@ class BinaryFBetaScore(BinaryStatScores):
         >>> metric = BinaryFBetaScore(beta=2.0)
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.6666667, dtype=float32)
+        Array(0.6666667, dtype=float32, weak_type=True)
     """
 
     is_differentiable = False
@@ -167,7 +167,7 @@ class BinaryF1Score(BinaryFBetaScore):
         >>> metric = BinaryF1Score()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.6666667, dtype=float32)
+        Array(0.6666667, dtype=float32, weak_type=True)
     """
 
     def __init__(

@@ -88,7 +88,7 @@ class BinaryFairness(_AbstractGroupStatScores):
         >>> metric = BinaryFairness(num_groups=2)
         >>> metric.update(jnp.array([1, 0, 1, 0]), jnp.array([1, 0, 0, 1]), jnp.array([0, 0, 1, 1]))
         >>> sorted(metric.compute().keys())
-        ['DP_0_1', 'EO_0_1']
+        ['DP_0_0', 'EO_1_0']
     """
 
     is_differentiable = False

@@ -26,7 +26,7 @@ class BinaryHammingDistance(BinaryStatScores):
         >>> metric = BinaryHammingDistance()
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(0.33333334, dtype=float32)
+        Array(0.3333333, dtype=float32)
     """
 
     is_differentiable = False

@@ -64,7 +64,7 @@ class MultilabelCoverageError(_RankingMetricBase):
         >>> target = jnp.array([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
         >>> metric.update(preds, target)
         >>> metric.compute()
-        Array(1.6666666, dtype=float32)
+        Array(1.3333334, dtype=float32)
     """
 
     higher_is_better = False

@@ -41,7 +41,7 @@ def scale_invariant_signal_distortion_ratio(preds: Array, target: Array, zero_me
         >>> target = jnp.array([3.0, -0.5, 2.0, 7.0])
         >>> preds = jnp.array([2.5, 0.0, 2.0, 8.0])
         >>> round(float(scale_invariant_signal_distortion_ratio(preds, target)), 4)
-        18.4031
+        18.403
     """
     _check_same_shape(preds, target)
     if zero_mean:

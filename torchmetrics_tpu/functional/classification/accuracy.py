@@ -78,7 +78,7 @@ def multiclass_accuracy(
         >>> target = jnp.array([2, 1, 0, 0])
         >>> preds = jnp.array([2, 1, 0, 1])
         >>> multiclass_accuracy(preds, target, num_classes=3)
-        Array(0.8333334, dtype=float32)
+        Array(0.8333333, dtype=float32)
     """
     tp, fp, tn, fn = _multiclass_stats(
         preds, target, num_classes, average, top_k, multidim_average, ignore_index, validate_args

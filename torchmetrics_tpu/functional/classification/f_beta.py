@@ -62,7 +62,7 @@ def binary_fbeta_score(
         >>> target = jnp.array([0, 1, 0, 1, 0, 1])
         >>> preds = jnp.array([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
         >>> binary_fbeta_score(preds, target, beta=2.0)
-        Array(0.6666667, dtype=float32)
+        Array(0.6666667, dtype=float32, weak_type=True)
     """
     if validate_args:
         _validate_beta(beta)

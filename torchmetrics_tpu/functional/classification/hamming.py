@@ -55,7 +55,7 @@ def binary_hamming_distance(
         >>> target = jnp.array([0, 1, 0, 1, 0, 1])
         >>> preds = jnp.array([0.11, 0.22, 0.84, 0.73, 0.33, 0.92])
         >>> binary_hamming_distance(preds, target)
-        Array(0.33333334, dtype=float32)
+        Array(0.3333333, dtype=float32)
     """
     tp, fp, tn, fn = _binary_stats(preds, target, threshold, multidim_average, ignore_index, validate_args)
     return _hamming_distance_reduce(tp, fp, tn, fn, average="binary", multidim_average=multidim_average)

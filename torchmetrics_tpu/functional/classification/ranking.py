@@ -69,7 +69,7 @@ def multilabel_coverage_error(
         >>> preds = jnp.array([[0.75, 0.05, 0.35], [0.45, 0.75, 0.05], [0.05, 0.65, 0.35]])
         >>> target = jnp.array([[1, 0, 1], [0, 0, 0], [0, 1, 1]])
         >>> multilabel_coverage_error(preds, target, num_labels=3)
-        Array(1.6666666, dtype=float32)
+        Array(1.3333334, dtype=float32)
     """
     if validate_args:
         _multilabel_ranking_tensor_validation(preds, target, num_labels, ignore_index)

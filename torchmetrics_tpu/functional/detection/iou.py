@@ -50,7 +50,7 @@ def intersection_over_union(
         ...                     [330.00, 100.00, 350.00, 125.00],
         ...                     [350.00, 100.00, 375.00, 150.00]])
         >>> intersection_over_union(preds, target)
-        Array(0.5879, dtype=float32)
+        Array(0.5879288, dtype=float32)
     """
     iou = _iou_update(preds, target, iou_threshold, replacement_val)
     return _iou_compute(iou, aggregate)

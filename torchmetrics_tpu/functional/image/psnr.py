@@ -64,7 +64,7 @@ def peak_signal_noise_ratio(
         >>> preds = jnp.array([[0.0, 1.0], [2.0, 3.0]])
         >>> target = jnp.array([[3.0, 2.0], [1.0, 0.0]])
         >>> peak_signal_noise_ratio(preds, target)
-        Array(2.5527055, dtype=float32)
+        Array(2.552725, dtype=float32)
     """
     _check_same_shape(preds, target)
     if data_range is None:

@@ -167,7 +167,7 @@ def multiscale_structural_similarity_index_measure(
         >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu.functional.image import multiscale_structural_similarity_index_measure
         >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
-        >>> multiscale_structural_similarity_index_measure(preds, preds)
+        >>> multiscale_structural_similarity_index_measure(preds, preds, betas=(0.2, 0.3, 0.5))
         Array(1., dtype=float32)
     """
     preds, target = _ssim_check_inputs(preds, target)

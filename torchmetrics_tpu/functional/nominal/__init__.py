@@ -146,7 +146,7 @@ def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
         >>> from torchmetrics_tpu.functional.nominal import fleiss_kappa
         >>> ratings = jnp.array([[5, 0], [3, 2], [0, 5], [5, 0]])
         >>> round(float(fleiss_kappa(ratings)), 3)
-        0.655
+        0.67
     """
     if mode not in ("counts", "probs"):
         raise ValueError("Argument `mode` must be one of 'counts' or 'probs'")

@@ -42,8 +42,8 @@ def word_information_lost(preds: Union[str, Sequence[str]], target: Union[str, S
         >>> from torchmetrics_tpu.functional.text import word_information_lost
         >>> preds = ["this is the prediction", "there is an other sample"]
         >>> target = ["this is the reference", "there is another one"]
-        >>> float(word_information_lost(preds=preds, target=target))  # doctest: +ELLIPSIS
-        0.6528...
+        >>> round(float(word_information_lost(preds=preds, target=target)), 4)
+        0.6528
     """
     errors, target_total, preds_total = _word_info_lost_update(preds, target)
     return _word_info_lost_compute(errors, target_total, preds_total)

@@ -121,7 +121,7 @@ class MultiScaleStructuralSimilarityIndexMeasure(Metric):
         >>> import jax
         >>> from torchmetrics_tpu.image import MultiScaleStructuralSimilarityIndexMeasure
         >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 64, 64))
-        >>> ms_ssim = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0)
+        >>> ms_ssim = MultiScaleStructuralSimilarityIndexMeasure(data_range=1.0, betas=(0.2, 0.3, 0.5))
         >>> ms_ssim(preds, preds)
         Array(1., dtype=float32)
     """

@@ -107,7 +107,20 @@ class Metric(ABC):
             raise ValueError(
                 f"Expected keyword argument `dist_sync_on_step` to be a `bool` but got {self.dist_sync_on_step}"
             )
+        # process subsets: a sequence of process indices (eager multi-host
+        # gather filters to members) — the mesh-axis-subset analogue of the
+        # reference's torch.distributed group handle (``metric.py:125``); for
+        # in-jit sync use `sync_in_jit(..., axis_index_groups=...)` instead
         self.process_group = kwargs.pop("process_group", None)
+        if self.process_group is not None and not (
+            isinstance(self.process_group, (list, tuple))
+            and all(isinstance(i, int) for i in self.process_group)
+            and len(set(self.process_group)) == len(self.process_group)
+        ):
+            raise ValueError(
+                "Expected keyword argument `process_group` to be `None` or a list/tuple of unique"
+                f" process indices but got {self.process_group}"
+            )
         self.dist_sync_fn = kwargs.pop("dist_sync_fn", None)
         if self.dist_sync_fn is not None and not callable(self.dist_sync_fn):
             raise ValueError(
@@ -305,10 +318,26 @@ class Metric(ABC):
             self._computed = None
             self._update_count += 1
             update(*args, **kwargs)
+            if self.compute_on_cpu:
+                self._move_list_states_to_cpu()
             return None
 
         wrapped_func.__wrapped_by_metric__ = True  # type: ignore[attr-defined]
         return wrapped_func
+
+    def _move_list_states_to_cpu(self) -> None:
+        """Offload append-mode (list) states to host memory after each update.
+
+        The HBM-relief analogue of reference ``metric.py:483-488``: cat states
+        grow unboundedly, so each appended chunk is committed to the CPU
+        backend via ``device_put``. Compute then runs on the CPU arrays (JAX
+        executes ops where their operands are committed).
+        """
+        cpu = jax.devices("cpu")[0]
+        for attr in self._defaults:
+            value = getattr(self, attr)
+            if isinstance(value, list):
+                setattr(self, attr, [jax.device_put(v, cpu) for v in value])
 
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
@@ -437,9 +466,26 @@ class Metric(ABC):
             {"should_unsync": should_unsync},
         )
 
-    def sync_in_jit(self, state: Dict[str, Array], axis_name: str) -> Dict[str, Array]:
-        """Functional in-jit sync of an explicit state dict over a mesh axis."""
-        return sync_in_jit(state, self._reductions, axis_name)
+    def sync_in_jit(
+        self,
+        state: Dict[str, Array],
+        axis_name: str,
+        axis_index_groups: Optional[Any] = None,
+    ) -> Dict[str, Array]:
+        """Functional in-jit sync of an explicit state dict over a mesh axis.
+
+        ``axis_index_groups`` partitions the axis into independent subgroups
+        (the in-jit form of ``process_group``). A flat ``process_group`` kwarg
+        cannot be translated automatically — it names one subset, not a
+        partition of the whole axis — so it must be spelled out here.
+        """
+        if axis_index_groups is None and self.process_group is not None:
+            raise TorchMetricsUserError(
+                "This metric was constructed with `process_group`, which the in-jit sync cannot infer a"
+                " mesh partition from. Pass `axis_index_groups` explicitly, e.g."
+                " `metric.sync_in_jit(state, 'dp', axis_index_groups=[[0, 1], [2, 3]])`."
+            )
+        return sync_in_jit(state, self._reductions, axis_name, axis_index_groups=axis_index_groups)
 
     def merge_state(self, incoming: Union["Metric", Dict[str, Any]]) -> None:
         """Merge another metric's (or raw state dict's) state into this one.

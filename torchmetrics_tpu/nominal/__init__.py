@@ -108,7 +108,7 @@ class FleissKappa(Metric):
         >>> metric = FleissKappa(mode='counts')
         >>> metric.update(jnp.array([[5, 0], [3, 2], [0, 5], [5, 0]]))
         >>> round(float(metric.compute()), 3)
-        0.655
+        0.67
     """
 
     is_differentiable = False

@@ -20,7 +20,7 @@ class CharErrorRate(Metric):
         >>> from torchmetrics_tpu.text import CharErrorRate
         >>> cer = CharErrorRate()
         >>> round(float(cer(["this is the prediction"], ["this is the reference"])), 4)
-        0.3182
+        0.381
     """
 
     is_differentiable = False

@@ -27,7 +27,7 @@ class CHRFScore(Metric):
         >>> target = [['there is a cat on the mat']]
         >>> chrf = CHRFScore()
         >>> round(float(chrf(preds, target)), 4)
-        0.5384
+        0.4942
     """
 
     is_differentiable = False

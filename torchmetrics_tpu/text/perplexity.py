@@ -22,8 +22,8 @@ class Perplexity(Metric):
         >>> preds = jax.random.uniform(jax.random.PRNGKey(22), (2, 8, 5))
         >>> target = jax.random.randint(jax.random.PRNGKey(89), (2, 8), 0, 5)
         >>> perp = Perplexity(ignore_index=-100)
-        >>> float(perp(preds, target))  # doctest: +ELLIPSIS
-        5.2...
+        >>> round(float(perp(preds, target)), 3)
+        4.999
     """
 
     is_differentiable = True

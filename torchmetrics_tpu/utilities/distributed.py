@@ -73,6 +73,12 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
     ``process_allgather``; tensors with mismatched shapes are padded to the
     per-dim max, gathered, then trimmed back (reference protocol at
     ``utilities/distributed.py:135-147``).
+
+    ``group`` restricts the result to a subset of process indices — the
+    analogue of the reference's ``torch.distributed`` group handle. The
+    gather itself still spans all processes (JAX's ``process_allgather`` is
+    global); members outside the group are dropped from the returned list,
+    which is reduction-equivalent to a subgroup collective.
     """
     if not distributed_available():
         return [result]
@@ -85,16 +91,25 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
     import numpy as np
 
     all_shapes = np.asarray(all_shapes)
+    if group is not None:
+        members = [int(i) for i in group]
+        if len(set(members)) != len(members):
+            raise ValueError(f"`group` must not contain duplicate process indices, got {group}")
+        if any(i < 0 or i >= all_shapes.shape[0] for i in members):
+            raise ValueError(f"`group` indices {group} out of range for world size {all_shapes.shape[0]}")
+    else:
+        members = list(range(all_shapes.shape[0]))
+
     if (all_shapes == all_shapes[0]).all():
         gathered = multihost_utils.process_allgather(result)
-        return [jnp.asarray(gathered[i]) for i in range(gathered.shape[0])]
+        return [jnp.asarray(gathered[i]) for i in members]
 
     max_shape = all_shapes.max(axis=0)
     pad = [(0, int(m - s)) for m, s in zip(max_shape, result.shape)]
     padded = jnp.pad(result, pad)
     gathered = multihost_utils.process_allgather(padded)
     out = []
-    for i in range(gathered.shape[0]):
+    for i in members:
         slices = tuple(slice(0, int(d)) for d in all_shapes[i])
         out.append(jnp.asarray(gathered[i])[slices])
     return out
@@ -111,6 +126,7 @@ def sync_in_jit(
     state: Dict[str, Array],
     reductions: Dict[str, Union[str, Callable, None]],
     axis_name: str,
+    axis_index_groups: Optional[Sequence[Sequence[int]]] = None,
 ) -> Dict[str, Array]:
     """Synchronize a metric-state pytree across a named mesh axis, inside jit.
 
@@ -122,29 +138,79 @@ def sync_in_jit(
     - ``"cat"``/``None`` → ``lax.all_gather`` then flatten the device axis
     - custom callable → all_gather then apply callable on the stacked axis
 
+    ``axis_index_groups`` partitions the mesh axis into disjoint subgroups —
+    the in-jit realization of the reference's ``process_group`` kwarg
+    (``metric.py:125``): each subgroup reduces independently, so e.g.
+    ``[[0, 1], [2, 3]]`` keeps two independent data-parallel replicas.
+
     Usable directly inside ``shard_map``/``pmap`` bodies — sync fuses into the
     surrounding compiled step (the reference's eager barrier+all_gather protocol
     has no in-graph analogue; this is the TPU-native redesign, SURVEY §2.10).
     """
+    if axis_index_groups is not None:
+        member_selector = _grouped_member_selector(axis_name, axis_index_groups)
+
     out = {}
     for name, value in state.items():
         red = reductions.get(name, "sum")
-        if red == "sum":
-            out[name] = jax.lax.psum(value, axis_name)
-        elif red == "mean":
-            out[name] = jax.lax.pmean(value, axis_name)
-        elif red == "max":
-            out[name] = jax.lax.pmax(value, axis_name)
-        elif red == "min":
-            out[name] = jax.lax.pmin(value, axis_name)
-        elif red == "cat":
-            # tiled all_gather concatenates along dim 0 directly: (world*n, ...)
-            out[name] = jax.lax.all_gather(value, axis_name, tiled=True)
-        elif red is None:
-            out[name] = jax.lax.all_gather(value, axis_name)  # stacked (world, ...)
-        elif callable(red):
-            gathered = jax.lax.all_gather(value, axis_name)
-            out[name] = red(gathered)
-        else:
+        if red not in _COLLECTIVES and not callable(red):
             raise ValueError(f"Unknown reduction {red!r} for state {name!r}")
+        if axis_index_groups is None:
+            if callable(red) and red not in _COLLECTIVES:
+                out[name] = red(jax.lax.all_gather(value, axis_name))
+            else:
+                out[name] = _COLLECTIVES[red][0](value, axis_name)
+        else:
+            # grouped: lax collectives reject axis_index_groups on shard_map's
+            # manual axes, so gather the world axis and reduce this shard's
+            # (statically known) group rows — XLA folds the selection in
+            mine = member_selector(value)  # (group_size, ...)
+            if callable(red) and red not in _COLLECTIVES:
+                out[name] = red(mine)
+            else:
+                out[name] = _COLLECTIVES[red][1](mine)
     return out
+
+
+# reduction kind -> (full-axis collective, within-group local reduction over
+# the gathered leading axis). Both sides of every kind live on one row so the
+# grouped and ungrouped paths cannot drift apart.
+_COLLECTIVES: Dict[Any, Any] = {
+    "sum": (lambda v, ax: jax.lax.psum(v, ax), lambda m: jnp.sum(m, axis=0)),
+    "mean": (lambda v, ax: jax.lax.pmean(v, ax), lambda m: jnp.mean(m, axis=0)),
+    "max": (lambda v, ax: jax.lax.pmax(v, ax), lambda m: jnp.max(m, axis=0)),
+    "min": (lambda v, ax: jax.lax.pmin(v, ax), lambda m: jnp.min(m, axis=0)),
+    "cat": (
+        lambda v, ax: jax.lax.all_gather(v, ax, tiled=True),
+        lambda m: m.reshape(m.shape[0] * m.shape[1], *m.shape[2:]),
+    ),
+    None: (lambda v, ax: jax.lax.all_gather(v, ax), lambda m: m),
+}
+
+
+def _grouped_member_selector(axis_name: str, groups: Sequence[Sequence[int]]) -> Callable[[Array], Array]:
+    """Build ``value -> (group_size, ...)`` selecting this shard's group rows
+    from a full all_gather. Groups must be equal-sized and partition the axis
+    (the same constraints the native ``axis_index_groups`` primitives have)."""
+    sizes = {len(g) for g in groups}
+    if len(sizes) != 1:
+        raise ValueError(f"All `axis_index_groups` must have the same size, got sizes {sorted(sizes)}")
+    world = sum(len(g) for g in groups)
+    seen = sorted(i for g in groups for i in g)
+    if seen != list(range(world)):
+        raise ValueError(f"`axis_index_groups` must partition 0..{world - 1}, got {groups}")
+
+    group_of = [0] * world
+    for gid, g in enumerate(groups):
+        for rank in g:
+            group_of[rank] = gid
+    group_of_arr = jnp.asarray(group_of)
+    members_arr = jnp.asarray([list(g) for g in groups])  # (n_groups, group_size)
+
+    def select(value: Array) -> Array:
+        idx = jax.lax.axis_index(axis_name)
+        my_members = members_arr[group_of_arr[idx]]
+        gathered = jax.lax.all_gather(value, axis_name)  # (world, ...)
+        return gathered[my_members]
+
+    return select
