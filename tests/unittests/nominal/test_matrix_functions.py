@@ -1,0 +1,94 @@
+"""Nominal *_matrix functions and operating-point dispatchers vs the reference."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.helpers.reference_oracle import load_reference
+from torchmetrics_tpu.functional.classification import (
+    precision_at_fixed_recall,
+    recall_at_fixed_precision,
+    sensitivity_at_specificity,
+    specificity_at_sensitivity,
+)
+from torchmetrics_tpu.functional.nominal import (
+    cramers_v_matrix,
+    pearsons_contingency_coefficient_matrix,
+    theils_u_matrix,
+    tschuprows_t_matrix,
+)
+
+_REF = load_reference()
+
+
+@pytest.fixture
+def cat_matrix():
+    return jax.random.randint(jax.random.PRNGKey(42), (200, 5), 0, 4)
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize(
+    ("ours", "theirs"),
+    [
+        (cramers_v_matrix, "cramers_v_matrix"),
+        (tschuprows_t_matrix, "tschuprows_t_matrix"),
+        (pearsons_contingency_coefficient_matrix, "pearsons_contingency_coefficient_matrix"),
+        (theils_u_matrix, "theils_u_matrix"),
+    ],
+)
+def test_matrix_functions_match_reference(cat_matrix, ours, theirs):
+    import torch
+    import torchmetrics.functional.nominal as ref_nominal
+
+    ref_fn = getattr(ref_nominal, theirs)
+    expected = ref_fn(torch.tensor(np.asarray(cat_matrix))).numpy()
+    got = np.asarray(ours(cat_matrix))
+    assert np.allclose(got, expected, atol=1e-4), np.abs(got - expected).max()
+
+
+@pytest.mark.skipif(_REF is None, reason="reference checkout unavailable")
+@pytest.mark.parametrize(
+    ("ours", "theirs", "kw"),
+    [
+        (recall_at_fixed_precision, "recall_at_fixed_precision", {"min_precision": 0.5}),
+        (precision_at_fixed_recall, "precision_at_fixed_recall", {"min_recall": 0.5}),
+        (specificity_at_sensitivity, "specificity_at_sensitivity", {"min_sensitivity": 0.5}),
+        (sensitivity_at_specificity, "sensitivity_at_specificity", {"min_specificity": 0.5}),
+    ],
+)
+@pytest.mark.parametrize("task_cfg", [("binary", {}), ("multiclass", {"num_classes": 4}), ("multilabel", {"num_labels": 3})])
+def test_operating_point_dispatchers_match_reference(ours, theirs, kw, task_cfg):
+    import torch
+    import torchmetrics.functional.classification as ref_cls
+
+    task, extra = task_cfg
+    k = jax.random.PRNGKey(0)
+    if task == "binary":
+        preds = jax.random.uniform(k, (64,))
+        target = jax.random.randint(jax.random.fold_in(k, 1), (64,), 0, 2)
+    elif task == "multiclass":
+        preds = jax.nn.softmax(jax.random.normal(k, (64, 4)), axis=-1)
+        target = jax.random.randint(jax.random.fold_in(k, 1), (64,), 0, 4)
+    else:
+        preds = jax.random.uniform(k, (64, 3))
+        target = jax.random.randint(jax.random.fold_in(k, 1), (64, 3), 0, 2)
+
+    ref_fn = getattr(ref_cls, theirs)
+    expected = ref_fn(
+        torch.tensor(np.asarray(preds)), torch.tensor(np.asarray(target)), task=task, **kw, **extra
+    )
+    got = ours(preds, target, task=task, **kw, **extra)
+    for g, e in zip(got, expected):
+        assert np.allclose(np.asarray(g), e.numpy(), atol=1e-5)
+
+
+def test_dispatcher_validation():
+    preds = jnp.asarray([0.2, 0.8])
+    target = jnp.asarray([0, 1])
+    with pytest.raises(ValueError, match="num_classes"):
+        recall_at_fixed_precision(preds, target, task="multiclass", min_precision=0.5)
+    with pytest.raises(ValueError, match="num_labels"):
+        precision_at_fixed_recall(preds, target, task="multilabel", min_recall=0.5)

@@ -131,6 +131,13 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
     stat_scores,
 )
 
+from torchmetrics_tpu.functional.classification._dispatch_operating_point import (
+    precision_at_fixed_recall,
+    recall_at_fixed_precision,
+    sensitivity_at_specificity,
+    specificity_at_sensitivity,
+)
+
 __all__ = [
     "binary_calibration_error",
     "calibration_error",
@@ -226,4 +233,8 @@ __all__ = [
     "multiclass_stat_scores",
     "multilabel_stat_scores",
     "stat_scores",
+    "precision_at_fixed_recall",
+    "recall_at_fixed_precision",
+    "sensitivity_at_specificity",
+    "specificity_at_sensitivity",
 ]

@@ -1,0 +1,161 @@
+"""Task dispatchers for the operating-point metrics (reference
+``functional/classification/recall_fixed_precision.py:401``,
+``precision_fixed_recall.py``, ``specificity_sensitivity.py``): thin routers
+to the Binary/Multiclass/Multilabel kernels on ``task``."""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple, Union
+
+import jax
+
+from torchmetrics_tpu.functional.classification.recall_fixed_precision import (
+    binary_precision_at_fixed_recall,
+    binary_recall_at_fixed_precision,
+    multiclass_precision_at_fixed_recall,
+    multiclass_recall_at_fixed_precision,
+    multilabel_precision_at_fixed_recall,
+    multilabel_recall_at_fixed_precision,
+)
+from torchmetrics_tpu.functional.classification.specificity_sensitivity import (
+    binary_sensitivity_at_specificity,
+    binary_specificity_at_sensitivity,
+    multiclass_sensitivity_at_specificity,
+    multiclass_specificity_at_sensitivity,
+    multilabel_sensitivity_at_specificity,
+    multilabel_specificity_at_sensitivity,
+)
+from torchmetrics_tpu.utilities.enums import ClassificationTask
+
+Array = jax.Array
+_Thresholds = Optional[Union[int, List[float], Array]]
+
+
+def _dispatch(task, constraint, binary_fn, multiclass_fn, multilabel_fn, preds, target,
+              thresholds, num_classes, num_labels, ignore_index, validate_args):
+    task = ClassificationTask.from_str(task) if isinstance(task, str) else task
+    if task == ClassificationTask.BINARY:
+        return binary_fn(preds, target, constraint, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTICLASS:
+        if not isinstance(num_classes, int):
+            raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
+        return multiclass_fn(preds, target, num_classes, constraint, thresholds, ignore_index, validate_args)
+    if task == ClassificationTask.MULTILABEL:
+        if not isinstance(num_labels, int):
+            raise ValueError(f"`num_labels` is expected to be `int` but `{type(num_labels)} was passed.`")
+        return multilabel_fn(preds, target, num_labels, constraint, thresholds, ignore_index, validate_args)
+    raise ValueError(f"Not handled value: {task}")
+
+
+def recall_at_fixed_precision(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_precision: float,
+    thresholds: _Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Optional[Tuple[Array, Array]]:
+    """Highest recall attainable at a given minimum precision, per task.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import recall_at_fixed_precision
+        >>> preds = jnp.array([0.1, 0.4, 0.6, 0.9])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> [round(float(x), 2) for x in recall_at_fixed_precision(preds, target, task="binary", min_precision=0.5)]
+        [1.0, 0.4]
+    """
+    return _dispatch(
+        task, min_precision,
+        binary_recall_at_fixed_precision, multiclass_recall_at_fixed_precision, multilabel_recall_at_fixed_precision,
+        preds, target, thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )
+
+
+def precision_at_fixed_recall(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_recall: float,
+    thresholds: _Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Optional[Tuple[Array, Array]]:
+    """Highest precision attainable at a given minimum recall, per task.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import precision_at_fixed_recall
+        >>> preds = jnp.array([0.1, 0.4, 0.6, 0.9])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> [round(float(x), 2) for x in precision_at_fixed_recall(preds, target, task="binary", min_recall=0.5)]
+        [1.0, 0.4]
+    """
+    return _dispatch(
+        task, min_recall,
+        binary_precision_at_fixed_recall, multiclass_precision_at_fixed_recall, multilabel_precision_at_fixed_recall,
+        preds, target, thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )
+
+
+def specificity_at_sensitivity(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_sensitivity: float,
+    thresholds: _Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Optional[Tuple[Array, Array]]:
+    """Highest specificity attainable at a given minimum sensitivity, per task.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import specificity_at_sensitivity
+        >>> preds = jnp.array([0.1, 0.4, 0.6, 0.9])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> [round(float(x), 2) for x in specificity_at_sensitivity(
+        ...     preds, target, task="binary", min_sensitivity=0.5)]
+        [1.0, 0.6]
+    """
+    return _dispatch(
+        task, min_sensitivity,
+        binary_specificity_at_sensitivity, multiclass_specificity_at_sensitivity, multilabel_specificity_at_sensitivity,
+        preds, target, thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )
+
+
+def sensitivity_at_specificity(
+    preds: Array,
+    target: Array,
+    task: str,
+    min_specificity: float,
+    thresholds: _Thresholds = None,
+    num_classes: Optional[int] = None,
+    num_labels: Optional[int] = None,
+    ignore_index: Optional[int] = None,
+    validate_args: bool = True,
+) -> Optional[Tuple[Array, Array]]:
+    """Highest sensitivity attainable at a given minimum specificity, per task.
+
+    Example:
+        >>> import jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.classification import sensitivity_at_specificity
+        >>> preds = jnp.array([0.1, 0.4, 0.6, 0.9])
+        >>> target = jnp.array([0, 1, 1, 1])
+        >>> [round(float(x), 2) for x in sensitivity_at_specificity(
+        ...     preds, target, task="binary", min_specificity=0.5)]
+        [1.0, 0.4]
+    """
+    return _dispatch(
+        task, min_specificity,
+        binary_sensitivity_at_specificity, multiclass_sensitivity_at_specificity, multilabel_sensitivity_at_specificity,
+        preds, target, thresholds, num_classes, num_labels, ignore_index, validate_args,
+    )

@@ -166,8 +166,19 @@ def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
     return (p_bar - pe_bar) / jnp.clip(1 - pe_bar, min=1e-30)
 
 
+from torchmetrics_tpu.functional.nominal._matrix import (  # noqa: E402
+    cramers_v_matrix,
+    pearsons_contingency_coefficient_matrix,
+    theils_u_matrix,
+    tschuprows_t_matrix,
+)
+
 __all__ = [
     "cramers_v",
+    "cramers_v_matrix",
+    "pearsons_contingency_coefficient_matrix",
+    "theils_u_matrix",
+    "tschuprows_t_matrix",
     "fleiss_kappa",
     "pearsons_contingency_coefficient",
     "theils_u",

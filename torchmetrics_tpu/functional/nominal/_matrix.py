@@ -1,0 +1,119 @@
+"""Column-pairwise nominal-association matrices (reference
+``functional/nominal/{cramers,tschuprows,pearson,theils_u}.py`` ``*_matrix``
+functions): association statistics between every pair of categorical columns
+of a ``(N, num_features)`` data matrix."""
+
+from __future__ import annotations
+
+import itertools
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+from torchmetrics_tpu.functional.nominal import (
+    _nominal_input_validation,
+    cramers_v,
+    pearsons_contingency_coefficient,
+    theils_u,
+    tschuprows_t,
+)
+
+Array = jax.Array
+
+
+def _pairwise_matrix(
+    matrix: Array, pair_fn: Callable[[Array, Array], Array], symmetric: bool = True
+) -> Array:
+    matrix = jnp.asarray(matrix)
+    num_variables = matrix.shape[1]
+    import numpy as np
+
+    out = np.ones((num_variables, num_variables), dtype=np.float32)
+    for i, j in itertools.combinations(range(num_variables), 2):
+        x, y = matrix[:, i], matrix[:, j]
+        out[i, j] = float(pair_fn(x, y))
+        out[j, i] = out[i, j] if symmetric else float(pair_fn(y, x))
+    return jnp.asarray(out)
+
+
+def cramers_v_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Cramér's V between all pairs of columns of a categorical data matrix.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import cramers_v_matrix
+        >>> matrix = jax.random.randint(jax.random.PRNGKey(42), (200, 5), 0, 4)
+        >>> cramers_v_matrix(matrix).shape
+        (5, 5)
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _pairwise_matrix(
+        matrix, lambda x, y: cramers_v(x, y, bias_correction, nan_strategy, nan_replace_value)
+    )
+
+
+def tschuprows_t_matrix(
+    matrix: Array,
+    bias_correction: bool = True,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Tschuprow's T between all pairs of columns of a categorical data matrix.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import tschuprows_t_matrix
+        >>> matrix = jax.random.randint(jax.random.PRNGKey(42), (200, 5), 0, 4)
+        >>> tschuprows_t_matrix(matrix).shape
+        (5, 5)
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _pairwise_matrix(
+        matrix, lambda x, y: tschuprows_t(x, y, bias_correction, nan_strategy, nan_replace_value)
+    )
+
+
+def pearsons_contingency_coefficient_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Pearson's contingency coefficient between all column pairs.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import pearsons_contingency_coefficient_matrix
+        >>> matrix = jax.random.randint(jax.random.PRNGKey(42), (200, 5), 0, 4)
+        >>> pearsons_contingency_coefficient_matrix(matrix).shape
+        (5, 5)
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _pairwise_matrix(
+        matrix, lambda x, y: pearsons_contingency_coefficient(x, y, nan_strategy, nan_replace_value)
+    )
+
+
+def theils_u_matrix(
+    matrix: Array,
+    nan_strategy: str = "replace",
+    nan_replace_value: Optional[float] = 0.0,
+) -> Array:
+    """Theil's U between all column pairs (asymmetric: ``out[i, j] = U(x_i | x_j)``).
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.functional.nominal import theils_u_matrix
+        >>> matrix = jax.random.randint(jax.random.PRNGKey(42), (200, 5), 0, 4)
+        >>> theils_u_matrix(matrix).shape
+        (5, 5)
+    """
+    _nominal_input_validation(nan_strategy, nan_replace_value)
+    return _pairwise_matrix(
+        matrix, lambda x, y: theils_u(x, y, nan_strategy, nan_replace_value), symmetric=False
+    )
