@@ -21,11 +21,15 @@ from torchmetrics_tpu.functional.image.ssim import (
     multiscale_structural_similarity_index_measure,
     structural_similarity_index_measure,
 )
+from torchmetrics_tpu.functional.image.lpips import learned_perceptual_image_patch_similarity
 from torchmetrics_tpu.functional.image.vif import visual_information_fidelity
+from torchmetrics_tpu.image.perceptual_path_length import perceptual_path_length
 
 __all__ = [
     "error_relative_global_dimensionless_synthesis",
     "image_gradients",
+    "learned_perceptual_image_patch_similarity",
+    "perceptual_path_length",
     "multiscale_structural_similarity_index_measure",
     "peak_signal_noise_ratio",
     "peak_signal_noise_ratio_with_blocked_effect",

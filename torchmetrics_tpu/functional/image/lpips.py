@@ -1,0 +1,66 @@
+"""Functional LPIPS (reference ``functional/image/lpips.py:399``).
+
+One-shot form of :class:`~torchmetrics_tpu.image.LearnedPerceptualImagePatchSimilarity`:
+runs the perceptual trunk on a single batch pair and reduces the distances.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+
+def learned_perceptual_image_patch_similarity(
+    img1: Array,
+    img2: Array,
+    net_type: str = "alex",
+    reduction: str = "mean",
+    normalize: bool = False,
+    net: Optional[Callable] = None,
+) -> Array:
+    """Learned Perceptual Image Patch Similarity between two image batches.
+
+    Both inputs are ``(N, 3, H, W)``. With ``normalize=False`` inputs are
+    expected in ``[-1, 1]``; with ``normalize=True`` in ``[0, 1]``.
+
+    Args:
+        img1: first set of images.
+        img2: second set of images.
+        net_type: backbone for the built-in trunk: ``'alex'``, ``'vgg'`` or
+            ``'squeeze'``.
+        reduction: ``'mean'`` or ``'sum'`` over the batch dimension.
+        normalize: whether inputs are in ``[0, 1]`` (rescaled internally).
+        net: optional custom callable ``(img1, img2) -> (N,)`` distances,
+            overriding ``net_type``.
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.image import learned_perceptual_image_patch_similarity
+        >>> k1, k2 = jax.random.split(jax.random.PRNGKey(123))
+        >>> img1 = jax.random.uniform(k1, (5, 3, 64, 64)) * 2 - 1
+        >>> img2 = jax.random.uniform(k2, (5, 3, 64, 64)) * 2 - 1
+        >>> d = learned_perceptual_image_patch_similarity(img1, img2, net_type='squeeze')
+        >>> bool(d >= 0)
+        True
+    """
+    valid_net_type = ("vgg", "alex", "squeeze")
+    if net is None:
+        if net_type not in valid_net_type:
+            raise ValueError(f"Argument `net_type` must be one of {valid_net_type}, but got {net_type}.")
+        from torchmetrics_tpu.image._lpips import LPIPSExtractor
+
+        net = LPIPSExtractor(net_type=net_type)
+    if reduction not in ("mean", "sum"):
+        raise ValueError(f"Argument `reduction` must be one of ('mean', 'sum'), but got {reduction}")
+    if not isinstance(normalize, bool):
+        raise ValueError(f"Argument `normalize` should be a bool but got {normalize}")
+
+    if normalize:
+        img1 = 2 * img1 - 1
+        img2 = 2 * img2 - 1
+    scores = jnp.asarray(net(img1, img2)).reshape(-1)
+    return scores.mean() if reduction == "mean" else scores.sum()
