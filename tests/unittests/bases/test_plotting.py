@@ -1,0 +1,159 @@
+"""Plotting surface tests (reference ``tests/unittests/utilities/test_plot.py``).
+
+Covers the scalar/series plotting path bound on every metric, confusion-matrix
+heatmaps (single panel and multilabel grids), and the curve-plot bindings on
+the ROC / precision-recall curve classes.
+"""
+
+import matplotlib
+
+matplotlib.use("Agg")
+
+import jax
+import jax.numpy as jnp
+import matplotlib.pyplot as plt
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.classification import (
+    BinaryPrecisionRecallCurve,
+    BinaryROC,
+    MulticlassAccuracy,
+    MulticlassConfusionMatrix,
+    MulticlassPrecisionRecallCurve,
+    MulticlassROC,
+    MultilabelConfusionMatrix,
+    MultilabelPrecisionRecallCurve,
+    MultilabelROC,
+)
+from torchmetrics_tpu.utilities.plot import plot_confusion_matrix, plot_curve, plot_single_or_multi_val
+
+
+@pytest.fixture(autouse=True)
+def _close_figures():
+    yield
+    plt.close("all")
+
+
+class TestPlotSingleOrMultiVal:
+    def test_scalar(self):
+        fig, ax = plot_single_or_multi_val(jnp.asarray(0.7))
+        assert fig is not None
+
+    def test_vector_bar(self):
+        fig, ax = plot_single_or_multi_val(jnp.asarray([0.1, 0.5, 0.9]))
+        assert len(ax.patches) == 3
+
+    def test_dict(self):
+        fig, ax = plot_single_or_multi_val({"acc": jnp.asarray(0.7), "f1": jnp.asarray(0.6)})
+        assert len(ax.get_legend_handles_labels()[1]) == 2
+
+    def test_sequence_of_scalars(self):
+        fig, ax = plot_single_or_multi_val([jnp.asarray(0.1), jnp.asarray(0.2), jnp.asarray(0.3)])
+        assert ax.get_xlabel() == "Step"
+
+    def test_sequence_of_dicts(self):
+        vals = [{"a": jnp.asarray(0.1), "b": jnp.asarray(0.2)} for _ in range(3)]
+        fig, ax = plot_single_or_multi_val(vals)
+        assert len(ax.get_legend_handles_labels()[1]) == 2
+
+    def test_bounds_drawn(self):
+        fig, ax = plot_single_or_multi_val(jnp.asarray(0.7), lower_bound=0.0, upper_bound=1.0)
+        assert len(ax.collections) >= 1  # hlines
+
+    def test_metric_binding(self):
+        m = MulticlassAccuracy(num_classes=3)
+        key = jax.random.PRNGKey(0)
+        vals = [
+            m(jax.random.uniform(jax.random.fold_in(key, i), (16, 3)),
+              jax.random.randint(jax.random.fold_in(key, 100 + i), (16,), 0, 3))
+            for i in range(4)
+        ]
+        fig, ax = m.plot(vals)
+        assert fig is not None
+
+
+class TestPlotConfusionMatrix:
+    def test_single_panel(self):
+        fig, ax = plot_confusion_matrix(np.arange(9).reshape(3, 3))
+        assert len(ax.texts) == 9
+
+    def test_labels(self):
+        fig, ax = plot_confusion_matrix(np.arange(9).reshape(3, 3), labels=["a", "b", "c"])
+        assert [t.get_text() for t in ax.get_xticklabels()] == ["a", "b", "c"]
+
+    def test_wrong_label_count_raises(self):
+        with pytest.raises(ValueError, match="Expected number of elements"):
+            plot_confusion_matrix(np.zeros((3, 3)), labels=["a"])
+
+    def test_multilabel_grid(self):
+        fig, axs = plot_confusion_matrix(np.arange(12).reshape(3, 2, 2))
+        assert len(axs) == 3
+
+    def test_multilabel_single_label(self):
+        fig, axs = plot_confusion_matrix(np.zeros((1, 2, 2)))
+        assert len(axs) == 1
+
+    def test_multilabel_wrong_label_count_raises(self):
+        with pytest.raises(ValueError, match="Expected number of elements"):
+            plot_confusion_matrix(np.zeros((3, 2, 2)), labels=["a"])
+
+    def test_metric_binding(self):
+        key = jax.random.PRNGKey(0)
+        m = MulticlassConfusionMatrix(num_classes=3)
+        m(jax.random.uniform(key, (40, 3)), jax.random.randint(key, (40,), 0, 3))
+        fig, ax = m.plot()
+        assert fig is not None
+
+        ml = MultilabelConfusionMatrix(num_labels=4)
+        ml(jax.random.uniform(key, (40, 4)), jax.random.randint(key, (40, 4), 0, 2))
+        fig, axs = ml.plot()
+        assert fig is not None
+
+
+class TestPlotCurves:
+    @pytest.mark.parametrize("thresholds", [None, 10])
+    @pytest.mark.parametrize("score", [False, True])
+    @pytest.mark.parametrize("cls", [BinaryROC, BinaryPrecisionRecallCurve])
+    def test_binary(self, cls, thresholds, score):
+        key = jax.random.PRNGKey(0)
+        m = cls(thresholds=thresholds)
+        m.update(jax.random.uniform(key, (30,)), jax.random.randint(key, (30,), 0, 2))
+        fig, ax = m.plot(score=score)
+        assert len(ax.lines) == 1
+        if score:
+            assert "AUC" in (ax.get_legend_handles_labels()[1] or [""])[0]
+
+    @pytest.mark.parametrize("thresholds", [None, 10])
+    @pytest.mark.parametrize("cls", [MulticlassROC, MulticlassPrecisionRecallCurve])
+    def test_multiclass(self, cls, thresholds):
+        key = jax.random.PRNGKey(0)
+        preds = jax.random.uniform(key, (30, 4))
+        preds = preds / preds.sum(-1, keepdims=True)
+        m = cls(num_classes=4, thresholds=thresholds)
+        m.update(preds, jax.random.randint(key, (30,), 0, 4))
+        fig, ax = m.plot(score=True)
+        assert len(ax.lines) == 4
+
+    @pytest.mark.parametrize("thresholds", [None, 10])
+    @pytest.mark.parametrize("cls", [MultilabelROC, MultilabelPrecisionRecallCurve])
+    def test_multilabel(self, cls, thresholds):
+        key = jax.random.PRNGKey(0)
+        m = cls(num_labels=3, thresholds=thresholds)
+        m.update(jax.random.uniform(key, (30, 3)), jax.random.randint(key, (30, 3), 0, 2))
+        fig, ax = m.plot(score=True)
+        assert len(ax.lines) == 3
+
+    def test_plot_curve_axis_labels(self):
+        key = jax.random.PRNGKey(0)
+        m = BinaryROC(thresholds=10)
+        m.update(jax.random.uniform(key, (30,)), jax.random.randint(key, (30,), 0, 2))
+        fig, ax = m.plot()
+        assert ax.get_xlabel() == "False positive rate"
+        assert ax.get_ylabel() == "True positive rate"
+        assert ax.get_title() == "BinaryROC"
+
+    def test_plot_curve_precomputed(self):
+        curve = (jnp.linspace(0, 1, 5), jnp.linspace(0, 1, 5), jnp.linspace(1, 0, 5))
+        fig, ax = plot_curve(curve, score=jnp.asarray(0.5), label_names=("x", "y"))
+        assert "AUC=0.500" in ax.get_legend_handles_labels()[1][0]

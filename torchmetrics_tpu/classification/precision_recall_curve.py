@@ -32,9 +32,29 @@ from torchmetrics_tpu.functional.classification.precision_recall_curve import (
     _multilabel_precision_recall_curve_tensor_validation,
     _multilabel_precision_recall_curve_update,
 )
+from torchmetrics_tpu.functional.classification.auroc import _reduce_auroc
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.compute import _auc_compute_without_check
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 from torchmetrics_tpu.utilities.enums import ClassificationTask
+from torchmetrics_tpu.utilities.plot import plot_curve
+
+
+def _plot_prc(metric, curve, score, ax, multi: bool):
+    """Shared PRC ``plot`` body (reference ``classification/precision_recall_curve.py:213-223``)."""
+    curve_computed = curve or metric.compute()
+    # x-axis is recall, y-axis is precision
+    curve_computed = (curve_computed[1], curve_computed[0], curve_computed[2])
+    if score is True and not curve:
+        if multi:
+            score = _reduce_auroc(curve_computed[0], curve_computed[1], average=None)
+        else:
+            score = _auc_compute_without_check(curve_computed[0], curve_computed[1], 1.0)
+    elif score is True:
+        score = None
+    return plot_curve(
+        curve_computed, score=score, ax=ax, label_names=("Recall", "Precision"), name=type(metric).__name__
+    )
 
 Array = jax.Array
 
@@ -100,6 +120,10 @@ class BinaryPrecisionRecallCurve(Metric):
     def compute(self):
         return _binary_precision_recall_curve_compute(self._final_state(), self.thresholds)
 
+    def plot(self, curve=None, score=None, ax=None):
+        """Plot the precision-recall curve, optionally annotated with its AUC score."""
+        return _plot_prc(self, curve, score, ax, multi=False)
+
 
 class MulticlassPrecisionRecallCurve(Metric):
     """Multiclass (one-vs-rest) precision-recall curves."""
@@ -159,6 +183,10 @@ class MulticlassPrecisionRecallCurve(Metric):
         return _multiclass_precision_recall_curve_compute(
             self._final_state(), self.num_classes, self.thresholds, self.average
         )
+
+    def plot(self, curve=None, score=None, ax=None):
+        """Plot per-class precision-recall curves, optionally AUC-annotated."""
+        return _plot_prc(self, curve, score, ax, multi=True)
 
 
 class MultilabelPrecisionRecallCurve(Metric):
@@ -220,6 +248,10 @@ class MultilabelPrecisionRecallCurve(Metric):
         return _multilabel_precision_recall_curve_compute(
             self._final_state(), self.num_labels, self.thresholds, self.ignore_index
         )
+
+    def plot(self, curve=None, score=None, ax=None):
+        """Plot per-label precision-recall curves, optionally AUC-annotated."""
+        return _plot_prc(self, curve, score, ax, multi=True)
 
 
 class PrecisionRecallCurve(_ClassificationTaskWrapper):

@@ -17,9 +17,31 @@ from torchmetrics_tpu.functional.classification.roc import (
     _multiclass_roc_compute,
     _multilabel_roc_compute,
 )
+from torchmetrics_tpu.functional.classification.auroc import _reduce_auroc
+from torchmetrics_tpu.utilities.compute import _auc_compute_without_check
 from torchmetrics_tpu.utilities.enums import ClassificationTask
+from torchmetrics_tpu.utilities.plot import plot_curve
 
 Array = jax.Array
+
+
+def _plot_roc(metric, curve, score, ax, multi: bool):
+    """Shared ROC ``plot`` body (reference ``classification/roc.py:159-170``)."""
+    curve_computed = curve or metric.compute()
+    if score is True and not curve:
+        if multi:
+            score = _reduce_auroc(curve_computed[0], curve_computed[1], average=None)
+        else:
+            score = _auc_compute_without_check(curve_computed[0], curve_computed[1], 1.0)
+    elif score is True:
+        score = None
+    return plot_curve(
+        curve_computed,
+        score=score,
+        ax=ax,
+        label_names=("False positive rate", "True positive rate"),
+        name=type(metric).__name__,
+    )
 
 
 class BinaryROC(BinaryPrecisionRecallCurve):
@@ -38,6 +60,10 @@ class BinaryROC(BinaryPrecisionRecallCurve):
     def compute(self):
         return _binary_roc_compute(self._final_state(), self.thresholds)
 
+    def plot(self, curve=None, score=None, ax=None):
+        """Plot the ROC curve, optionally annotated with its AUC score."""
+        return _plot_roc(self, curve, score, ax, multi=False)
+
 
 class MulticlassROC(MulticlassPrecisionRecallCurve):
     """One-vs-rest ROC curves for multiclass tasks."""
@@ -45,12 +71,20 @@ class MulticlassROC(MulticlassPrecisionRecallCurve):
     def compute(self):
         return _multiclass_roc_compute(self._final_state(), self.num_classes, self.thresholds, self.average)
 
+    def plot(self, curve=None, score=None, ax=None):
+        """Plot per-class ROC curves, optionally AUC-annotated."""
+        return _plot_roc(self, curve, score, ax, multi=True)
+
 
 class MultilabelROC(MultilabelPrecisionRecallCurve):
     """Per-label ROC curves."""
 
     def compute(self):
         return _multilabel_roc_compute(self._final_state(), self.num_labels, self.thresholds, self.ignore_index)
+
+    def plot(self, curve=None, score=None, ax=None):
+        """Plot per-label ROC curves, optionally AUC-annotated."""
+        return _plot_roc(self, curve, score, ax, multi=True)
 
 
 class ROC(_ClassificationTaskWrapper):

@@ -202,16 +202,25 @@ def plot_curve(
     if not _MATPLOTLIB_AVAILABLE:
         raise ModuleNotFoundError(_error_msg)
     fig, ax = _get_ax(ax)
-    x, y = np.asarray(curve[0]), np.asarray(curve[1])
-    if x.ndim == 1:
-        label = f"AUC={float(np.asarray(score)):0.3f}" if score is not None else legend_name
-        ax.plot(x, y, linestyle="-", linewidth=2, label=label)
-    else:
-        for i in range(x.shape[0]):
+    if isinstance(curve[0], (list, tuple)):  # ragged per-class curves (thresholds=None)
+        xs = [np.asarray(c) for c in curve[0]]
+        ys = [np.asarray(c) for c in curve[1]]
+        for i, (xi, yi) in enumerate(zip(xs, ys)):
             label = f"{legend_name or 'class'}_{i}"
             if score is not None and np.asarray(score).ndim == 1:
                 label += f" AUC={float(np.asarray(score)[i]):0.3f}"
-            ax.plot(x[i], y[i], label=label)
+            ax.plot(xi, yi, label=label)
+    else:
+        x, y = np.asarray(curve[0]), np.asarray(curve[1])
+        if x.ndim == 1:
+            label = f"AUC={float(np.asarray(score)):0.3f}" if score is not None else legend_name
+            ax.plot(x, y, linestyle="-", linewidth=2, label=label)
+        else:
+            for i in range(x.shape[0]):
+                label = f"{legend_name or 'class'}_{i}"
+                if score is not None and np.asarray(score).ndim == 1:
+                    label += f" AUC={float(np.asarray(score)[i]):0.3f}"
+                ax.plot(x[i], y[i], label=label)
     handles, labels = ax.get_legend_handles_labels()
     if handles and labels:
         ax.legend()
