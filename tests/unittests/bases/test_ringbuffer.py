@@ -1,0 +1,341 @@
+"""Fixed-capacity ring-buffer cat states (SURVEY §5/§7 unbounded-state design).
+
+Covers the RingBuffer container itself (wrap-around, drop accounting, pickle),
+the pure ``ring_push`` kernel under jit, the ``cat_state_capacity`` Metric
+kwarg end-to-end on a real cat-state metric, and the in-jit all_gather sync of
+buffer states over an 8-device CPU mesh.
+"""
+
+import pickle
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import Metric
+from torchmetrics_tpu.classification import BinaryAUROC
+from torchmetrics_tpu.utilities import RingBuffer, ring_push
+from torchmetrics_tpu.utilities.data import dim_zero_cat
+
+
+class TestRingBufferContainer:
+    def test_append_and_values(self):
+        rb = RingBuffer(8)
+        rb.append(jnp.arange(3.0))
+        rb.append(jnp.arange(3.0, 5.0))
+        assert len(rb) == 5
+        np.testing.assert_array_equal(np.sort(np.asarray(rb.values())), np.arange(5.0))
+
+    def test_lazy_init_from_first_batch(self):
+        rb = RingBuffer(4)
+        assert not rb.initialized
+        rb.append(jnp.ones((2, 3), jnp.int32))
+        assert rb.item_shape == (3,)
+        assert rb.data.dtype == jnp.int32
+
+    def test_scalar_rows(self):
+        rb = RingBuffer(4)
+        rb.append(jnp.asarray(1.5))
+        rb.append(jnp.asarray(2.5))
+        assert len(rb) == 2
+
+    def test_wraparound_keeps_newest(self):
+        rb = RingBuffer(4)
+        with pytest.warns(UserWarning, match="capacity"):
+            for i in range(6):
+                rb.append(jnp.asarray(float(i)))
+        assert len(rb) == 4
+        assert rb.num_dropped == 2
+        np.testing.assert_array_equal(np.sort(np.asarray(rb.values())), [2.0, 3.0, 4.0, 5.0])
+
+    def test_oversized_batch_keeps_tail(self):
+        rb = RingBuffer(3)
+        with pytest.warns(UserWarning, match="capacity"):
+            rb.append(jnp.arange(10.0))
+        np.testing.assert_array_equal(np.sort(np.asarray(rb.values())), [7.0, 8.0, 9.0])
+
+    def test_shape_mismatch_raises(self):
+        rb = RingBuffer(4)
+        rb.append(jnp.ones((2, 3)))
+        with pytest.raises(ValueError, match="rows of shape"):
+            rb.append(jnp.ones((2, 5)))
+
+    def test_merge_buffers(self):
+        a = RingBuffer(8)
+        a.append(jnp.arange(2.0))
+        b = RingBuffer(8)
+        b.append(jnp.arange(2.0, 4.0))
+        a.extend(b)
+        np.testing.assert_array_equal(np.sort(np.asarray(a.values())), np.arange(4.0))
+
+    def test_copy_is_independent(self):
+        a = RingBuffer(4)
+        a.append(jnp.arange(2.0))
+        b = a.copy()
+        b.append(jnp.asarray([9.0]))
+        assert len(a) == 2 and len(b) == 3
+
+    def test_pickle_roundtrip(self):
+        rb = RingBuffer(4)
+        rb.append(jnp.arange(3.0))
+        rb2 = pickle.loads(pickle.dumps(rb))
+        np.testing.assert_array_equal(np.asarray(rb2.values()), np.asarray(rb.values()))
+        rb2.append(jnp.asarray([7.0]))  # still usable after rehydration
+        assert len(rb2) == 4
+
+    def test_masked_accessor(self):
+        rb = RingBuffer(4)
+        rb.append(jnp.arange(2.0))
+        data, valid = rb.masked()
+        assert data.shape == (4,) and valid.shape == (4,)
+        assert int(valid.sum()) == 2
+
+    def test_invalid_capacity(self):
+        with pytest.raises(ValueError, match="capacity"):
+            RingBuffer(0)
+
+
+class TestRingPushKernel:
+    def test_jit_static_shapes(self):
+        @jax.jit
+        def step(data, valid, count, batch):
+            return ring_push(data, valid, count, batch)
+
+        data = jnp.zeros((8, 2))
+        valid = jnp.zeros((8,), bool)
+        count = jnp.zeros((), jnp.int32)
+        for i in range(5):
+            data, valid, count = step(data, valid, count, jnp.full((3, 2), float(i)))
+        assert int(count) == 15
+        assert int(valid.sum()) == 8
+
+    def test_scan_compatible(self):
+        def body(carry, batch):
+            return ring_push(*carry, batch), None
+
+        data = jnp.zeros((16,))
+        valid = jnp.zeros((16,), bool)
+        count = jnp.zeros((), jnp.int32)
+        batches = jnp.arange(20.0).reshape(10, 2)
+        (data, valid, count), _ = jax.lax.scan(body, (data, valid, count), batches)
+        assert int(count) == 20
+        kept = np.sort(np.asarray(data)[np.asarray(valid)])
+        np.testing.assert_array_equal(kept, np.arange(4.0, 20.0))
+
+
+class _CatMetric(Metric):
+    full_state_update = False
+
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("vals", default=[], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.vals.append(x)
+
+    def compute(self):
+        return dim_zero_cat(self.vals).sum()
+
+
+class TestMetricIntegration:
+    def test_cat_state_capacity_replaces_list(self):
+        m = _CatMetric(cat_state_capacity=64)
+        assert isinstance(m.vals, RingBuffer)
+        for i in range(5):
+            m.update(jnp.full((4,), float(i)))
+        assert float(m.compute()) == pytest.approx(sum(4.0 * i for i in range(5)))
+
+    def test_without_capacity_stays_list(self):
+        m = _CatMetric()
+        assert isinstance(m.vals, list)
+
+    def test_invalid_capacity_kwarg(self):
+        with pytest.raises(ValueError, match="cat_state_capacity"):
+            _CatMetric(cat_state_capacity=-1)
+
+    def test_reset(self):
+        m = _CatMetric(cat_state_capacity=16)
+        m.update(jnp.ones((4,)))
+        m.reset()
+        assert isinstance(m.vals, RingBuffer) and len(m.vals) == 0
+
+    def test_forward_dual_mode(self):
+        m = _CatMetric(cat_state_capacity=64)
+        batch_val = m(jnp.asarray([1.0, 2.0]))
+        assert float(batch_val) == 3.0
+        batch_val = m(jnp.asarray([4.0]))
+        assert float(batch_val) == 4.0
+        assert float(m.compute()) == 7.0
+
+    def test_pickle_mid_stream(self):
+        m = _CatMetric(cat_state_capacity=32)
+        m.update(jnp.arange(4.0))
+        m2 = pickle.loads(pickle.dumps(m))
+        m2.update(jnp.asarray([10.0]))
+        assert float(m2.compute()) == pytest.approx(16.0)
+
+    def test_state_dict_roundtrip(self):
+        m = _CatMetric(cat_state_capacity=32)
+        m.persistent(True)
+        m.update(jnp.arange(4.0))
+        sd = m.state_dict()
+        m2 = _CatMetric(cat_state_capacity=32)
+        m2.load_state_dict(sd)
+        assert isinstance(m2.vals, RingBuffer)
+        assert float(m2.compute()) == pytest.approx(6.0)
+
+    def test_merge_state(self):
+        a = _CatMetric(cat_state_capacity=32)
+        a.update(jnp.arange(3.0))
+        b = _CatMetric(cat_state_capacity=32)
+        b.update(jnp.asarray([10.0]))
+        a.merge_state(b)
+        assert float(a.compute()) == pytest.approx(13.0)
+
+    def test_bounded_memory_on_real_metric(self):
+        # exact-mode AUROC keeps cat states; capacity bounds them
+        m = BinaryAUROC(thresholds=None, cat_state_capacity=128)
+        key = jax.random.PRNGKey(0)
+        with pytest.warns(UserWarning, match="capacity"):
+            for i in range(10):
+                k = jax.random.fold_in(key, i)
+                preds = jax.random.uniform(k, (32,))
+                target = (preds > 0.5).astype(jnp.int32)
+                m.update(preds, target)
+        assert isinstance(m.preds, RingBuffer)
+        assert len(m.preds) == 128
+        auroc = float(m.compute())
+        assert auroc == pytest.approx(1.0)  # perfectly separable targets
+
+    def test_set_dtype(self):
+        m = _CatMetric(cat_state_capacity=8)
+        m.update(jnp.ones((2,), jnp.float32))
+        m.set_dtype(jnp.bfloat16)
+        assert m.vals.data.dtype == jnp.bfloat16
+
+    def test_state_dict_loads_into_list_state_metric(self):
+        # a ring-buffer checkpoint must stay portable to a metric built
+        # without cat_state_capacity (list-backed cat state)
+        m = _CatMetric(cat_state_capacity=32)
+        m.persistent(True)
+        m.update(jnp.arange(4.0))
+        sd = m.state_dict()
+        plain = _CatMetric()
+        plain.persistent(True)
+        plain.load_state_dict(sd)
+        assert isinstance(plain.vals, list)
+        plain.update(jnp.asarray([10.0]))
+        assert float(plain.compute()) == pytest.approx(16.0)
+
+    def test_add_state_rejects_non_cat_ring(self):
+        class Bad(Metric):
+            def __init__(self):
+                super().__init__()
+                self.add_state("x", default=RingBuffer(8), dist_reduce_fx="sum")
+
+            def update(self):
+                pass
+
+            def compute(self):
+                return None
+
+        with pytest.raises(ValueError, match="dist_reduce_fx='cat'"):
+            Bad()
+
+    def test_add_state_rejects_nonempty_ring_default(self):
+        class Bad(Metric):
+            def __init__(self):
+                super().__init__()
+                rb = RingBuffer(8)
+                rb.append(jnp.ones((2,)))
+                self.add_state("x", default=rb, dist_reduce_fx="cat")
+
+            def update(self):
+                pass
+
+            def compute(self):
+                return None
+
+        with pytest.raises(ValueError, match="must be empty"):
+            Bad()
+
+    def test_collection_compute_groups(self):
+        from torchmetrics_tpu import MetricCollection
+        from torchmetrics_tpu.classification import BinaryAUROC, BinaryAveragePrecision
+
+        col = MetricCollection(
+            {
+                "auroc": BinaryAUROC(thresholds=None, cat_state_capacity=64),
+                "ap": BinaryAveragePrecision(thresholds=None, cat_state_capacity=64),
+            }
+        )
+        key = jax.random.PRNGKey(0)
+        for i in range(3):
+            k = jax.random.fold_in(key, i)
+            preds = jax.random.uniform(k, (16,))
+            col.update(preds, (preds > 0.5).astype(jnp.int32))
+        res = col.compute()
+        assert res["auroc"] == pytest.approx(1.0)
+        # both metrics share one state group yet keep independent buffers
+        assert len(col["auroc"].preds) == 48
+        oracle = BinaryAveragePrecision(thresholds=None)
+        for i in range(3):
+            k = jax.random.fold_in(key, i)
+            preds = jax.random.uniform(k, (16,))
+            oracle.update(preds, (preds > 0.5).astype(jnp.int32))
+        assert float(res["ap"]) == pytest.approx(float(oracle.compute()))
+
+
+class TestInJitSync:
+    def test_all_gather_over_mesh(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        from torchmetrics_tpu.utilities.distributed import sync_in_jit
+
+        devices = np.array(jax.devices()[:8])
+        mesh = Mesh(devices, ("dp",))
+        n_dev = len(devices)
+
+        def step(local_rows):
+            rb = RingBuffer(4, item_shape=(), dtype=jnp.float32)
+            data, valid, count = ring_push(rb.data, rb.valid, rb.count, local_rows[0])
+            rb = RingBuffer(4, _data=data, _valid=valid, _count=count)
+            synced = sync_in_jit({"vals": rb}, {"vals": "cat"}, "dp")
+            out = synced["vals"]
+            return jnp.sum(jnp.where(out.valid, out.data, 0.0))[None], out.count[None]
+
+        rows = jnp.arange(float(n_dev) * 2).reshape(n_dev, 2)
+        total, count = jax.jit(
+            shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P("dp"))
+        )(rows)
+        # every shard sees the sum of all shards' two rows
+        expected = float(jnp.sum(rows))
+        assert np.allclose(np.asarray(total), expected)
+        assert int(np.asarray(count)[0]) == 2 * n_dev
+
+    def test_grouped_sync(self):
+        from jax.sharding import Mesh, PartitionSpec as P
+        from jax.experimental.shard_map import shard_map
+
+        from torchmetrics_tpu.utilities.distributed import sync_in_jit
+
+        devices = np.array(jax.devices()[:8])
+        mesh = Mesh(devices, ("dp",))
+        groups = [[0, 1, 2, 3], [4, 5, 6, 7]]
+
+        def step(local_rows):
+            rb = RingBuffer(2, item_shape=(), dtype=jnp.float32)
+            data, valid, count = ring_push(rb.data, rb.valid, rb.count, local_rows[0])
+            rb = RingBuffer(2, _data=data, _valid=valid, _count=count)
+            synced = sync_in_jit({"vals": rb}, {"vals": "cat"}, "dp", axis_index_groups=groups)
+            out = synced["vals"]
+            return jnp.sum(jnp.where(out.valid, out.data, 0.0))[None]
+
+        rows = jnp.arange(16.0).reshape(8, 2)
+        total = jax.jit(shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P("dp")))(rows)
+        # group 0 sums rows 0-7, group 1 sums rows 8-15
+        assert np.allclose(np.asarray(total)[:4], float(np.arange(8).sum()))
+        assert np.allclose(np.asarray(total)[4:], float(np.arange(8, 16).sum()))

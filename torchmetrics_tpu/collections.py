@@ -22,6 +22,7 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 import jax.numpy as jnp
 
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
 
 __all__ = ["MetricCollection"]
 
@@ -31,6 +32,12 @@ def _state_equal(a: Any, b: Any) -> bool:
         return len(a) == len(b) and all(_state_equal(x, y) for x, y in zip(a, b))
     if isinstance(a, list) != isinstance(b, list):
         return False
+    if isinstance(a, RingBuffer) or isinstance(b, RingBuffer):
+        if not (isinstance(a, RingBuffer) and isinstance(b, RingBuffer)):
+            return False
+        if a.capacity != b.capacity or len(a) != len(b):
+            return False
+        return len(a) == 0 or _state_equal(a.values(), b.values())
     a, b = jnp.asarray(a), jnp.asarray(b)
     if a.shape != b.shape or a.dtype != b.dtype:
         return False
@@ -199,7 +206,12 @@ class MetricCollection:
                 member = self._modules[name]
                 for attr in head._defaults:
                     state = getattr(head, attr)
-                    setattr(member, attr, list(state) if isinstance(state, list) else state)
+                    if isinstance(state, RingBuffer):
+                        # mutable container: members need their own copy, or the
+                        # next update would append once per aliased member
+                        setattr(member, attr, state.copy())
+                    else:
+                        setattr(member, attr, list(state) if isinstance(state, list) else state)
                 member._update_count = head._update_count
                 member._computed = None
 

@@ -45,6 +45,7 @@ from torchmetrics_tpu.utilities.distributed import (
 )
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
+from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
 
 Array = jax.Array
 
@@ -137,6 +138,16 @@ class Metric(ABC):
             raise ValueError(
                 f"Expected keyword argument `compute_with_cache` to be a `bool` but got {self.compute_with_cache}"
             )
+        # TPU-native extension (SURVEY §5/§7): bound append-mode ("cat") states
+        # to a fixed-capacity device ring buffer instead of an unbounded list
+        self.cat_state_capacity = kwargs.pop("cat_state_capacity", None)
+        if self.cat_state_capacity is not None and not (
+            isinstance(self.cat_state_capacity, int) and self.cat_state_capacity > 0
+        ):
+            raise ValueError(
+                "Expected keyword argument `cat_state_capacity` to be `None` or a positive integer"
+                f" but got {self.cat_state_capacity}"
+            )
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -194,16 +205,32 @@ class Metric(ABC):
         if not name.isidentifier():
             raise ValueError(f"Argument `name` must be a valid python attribute name, but got {name}")
         is_list = isinstance(default, list)
-        if not (_is_array(default) or (is_list and len(default) == 0)):
+        is_ring = isinstance(default, RingBuffer)
+        if not (_is_array(default) or (is_list and len(default) == 0) or is_ring):
             raise ValueError("state variable must be a jax array or any empty list (where you can append arrays)")
         if dist_reduce_fx is not None and not (dist_reduce_fx in _STR_REDUCTIONS or callable(dist_reduce_fx)):
             raise ValueError(
                 "`dist_reduce_fx` must be callable or one of ['mean', 'sum', 'cat', 'min', 'max', None]"
             )
-        if not is_list:
-            default = jnp.asarray(default)
-        setattr(self, name, list(default) if is_list else default)
-        self._defaults[name] = list(default) if is_list else default
+        if is_ring:
+            if dist_reduce_fx != "cat":
+                raise ValueError(
+                    f"RingBuffer states require `dist_reduce_fx='cat'`, but state {name!r} declared"
+                    f" {dist_reduce_fx!r}"
+                )
+            if len(default):
+                raise ValueError(f"RingBuffer default for state {name!r} must be empty")
+        if is_list and self.cat_state_capacity is not None and dist_reduce_fx == "cat":
+            default = RingBuffer(self.cat_state_capacity)
+            is_list, is_ring = False, True
+        if is_ring:
+            setattr(self, name, default.copy())
+            self._defaults[name] = default.copy_empty()
+        else:
+            if not is_list:
+                default = jnp.asarray(default)
+            setattr(self, name, list(default) if is_list else default)
+            self._defaults[name] = list(default) if is_list else default
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
 
@@ -299,6 +326,8 @@ class Metric(ABC):
                 reduced = jnp.maximum(global_state, local_state)
             elif reduce_fn == "min":
                 reduced = jnp.minimum(global_state, local_state)
+            elif reduce_fn == "cat" and isinstance(global_state, RingBuffer):
+                reduced = global_state.copy().extend(local_state)
             elif (reduce_fn == "cat" or reduce_fn is None) and isinstance(global_state, list):
                 reduced = global_state + list(local_state)
             elif reduce_fn is None and _is_array(global_state):
@@ -336,7 +365,9 @@ class Metric(ABC):
         cpu = jax.devices("cpu")[0]
         for attr in self._defaults:
             value = getattr(self, attr)
-            if isinstance(value, list):
+            if isinstance(value, RingBuffer):
+                value.to_device(cpu)
+            elif isinstance(value, list):
                 setattr(self, attr, [jax.device_put(v, cpu) for v in value])
 
     def _wrap_compute(self, compute: Callable) -> Callable:
@@ -398,8 +429,12 @@ class Metric(ABC):
         """Reference ``metric.py:427-457``: pre-concat lists, gather, reduce."""
         input_dict = {attr: getattr(self, attr) for attr in self._reductions}
         for attr, reduction_fn in self._reductions.items():
+            # ring buffers gather their live rows like a pre-concatenated list
+            if isinstance(input_dict[attr], RingBuffer):
+                rb = input_dict[attr]
+                input_dict[attr] = [rb.values()] if rb.num_valid else []
             # pre-concatenate list states to minimize number of all_gathers
-            if isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
+            elif isinstance(input_dict[attr], list) and len(input_dict[attr]) >= 1:
                 input_dict[attr] = [dim_zero_cat(input_dict[attr])]
 
         output_dict: Dict[str, Any] = {}
@@ -414,7 +449,7 @@ class Metric(ABC):
             if isinstance(gathered, list) and len(gathered) == 0:
                 setattr(self, attr, [])
                 continue
-            if _is_array(gathered[0]) and not isinstance(getattr(self, attr), list):
+            if _is_array(gathered[0]) and not isinstance(getattr(self, attr), (list, RingBuffer)):
                 shapes = {g.shape for g in gathered}
                 gathered = jnp.stack(gathered) if len(shapes) == 1 else gathered
             fn = _STR_REDUCTIONS.get(reduction_fn, reduction_fn) if isinstance(reduction_fn, str) else reduction_fn
@@ -519,7 +554,9 @@ class Metric(ABC):
         self._forward_cache = None
         self._computed = None
         for attr, default in self._defaults.items():
-            if isinstance(default, list):
+            if isinstance(default, RingBuffer):
+                setattr(self, attr, default.copy_empty())
+            elif isinstance(default, list):
                 setattr(self, attr, [])
             else:
                 setattr(self, attr, jnp.array(default))
@@ -535,7 +572,9 @@ class Metric(ABC):
         cache: Dict[str, Union[Array, List]] = {}
         for attr in self._defaults:
             current = getattr(self, attr)
-            if isinstance(current, list):
+            if isinstance(current, RingBuffer):
+                cache[attr] = current.copy()
+            elif isinstance(current, list):
                 cache[attr] = [jnp.array(v) for v in current]
             else:
                 cache[attr] = jnp.array(current)
@@ -557,7 +596,9 @@ class Metric(ABC):
             if not self._persistent[key]:
                 continue
             current = getattr(self, key)
-            if isinstance(current, list):
+            if isinstance(current, RingBuffer):
+                destination[prefix + key] = np.asarray(current.values())
+            elif isinstance(current, list):
                 destination[prefix + key] = [np.asarray(v) for v in current]
             else:
                 destination[prefix + key] = np.asarray(current)
@@ -568,8 +609,23 @@ class Metric(ABC):
         for key in self._defaults:
             if prefix + key in state_dict:
                 val = state_dict[prefix + key]
-                if isinstance(val, list):
+                if isinstance(self._defaults[key], RingBuffer):
+                    rb = self._defaults[key].copy_empty()
+                    if isinstance(val, list):
+                        for v in val:
+                            rb.append(jnp.asarray(v))
+                    else:
+                        arr = jnp.asarray(val)
+                        if arr.size:
+                            rb.append(arr)
+                    setattr(self, key, rb)
+                elif isinstance(val, list):
                     setattr(self, key, [jnp.asarray(v) for v in val])
+                elif isinstance(self._defaults[key], list):
+                    # a ring-buffer checkpoint (one concatenated array) loaded
+                    # into a list-state metric: rewrap so `.append` keeps working
+                    arr = jnp.asarray(val)
+                    setattr(self, key, [arr] if arr.size else [])
                 else:
                     setattr(self, key, jnp.asarray(val))
             elif strict and self._persistent[key]:
@@ -580,7 +636,9 @@ class Metric(ABC):
         state = {k: v for k, v in self.__dict__.items() if k not in ("update", "compute", "_update_signature")}
         for attr in self._defaults:
             cur = state.get(attr)
-            if isinstance(cur, list):
+            if isinstance(cur, RingBuffer):
+                pass  # RingBuffer pickles itself (numpy-ifies its arrays)
+            elif isinstance(cur, list):
                 state[attr] = [np.asarray(v) for v in cur]
             elif cur is not None:
                 state[attr] = np.asarray(cur)
@@ -588,7 +646,11 @@ class Metric(ABC):
             block = state.get(key)
             if isinstance(block, dict):
                 state[key] = {
-                    k: ([np.asarray(x) for x in v] if isinstance(v, list) else np.asarray(v))
+                    k: (
+                        v
+                        if isinstance(v, RingBuffer)
+                        else [np.asarray(x) for x in v] if isinstance(v, list) else np.asarray(v)
+                    )
                     for k, v in block.items()
                 }
         state["_computed"] = None
@@ -599,7 +661,9 @@ class Metric(ABC):
         self.__dict__.update(state)
         for attr in self._defaults:
             cur = getattr(self, attr, None)
-            if isinstance(cur, list):
+            if isinstance(cur, RingBuffer):
+                pass  # already rehydrated by RingBuffer.__setstate__
+            elif isinstance(cur, list):
                 setattr(self, attr, [jnp.asarray(v) for v in cur])
             elif cur is not None:
                 setattr(self, attr, jnp.asarray(cur))
@@ -618,7 +682,9 @@ class Metric(ABC):
         """Move all states to ``device`` (a ``jax.Device`` or sharding)."""
         for attr in self._defaults:
             current = getattr(self, attr)
-            if isinstance(current, list):
+            if isinstance(current, RingBuffer):
+                current.to_device(device)
+            elif isinstance(current, list):
                 setattr(self, attr, [jax.device_put(v, device) for v in current])
             else:
                 setattr(self, attr, jax.device_put(current, device))
@@ -629,7 +695,10 @@ class Metric(ABC):
         self._dtype_policy = dst_type
         for attr in self._defaults:
             current = getattr(self, attr)
-            if isinstance(current, list):
+            if isinstance(current, RingBuffer):
+                if current.data is not None and jnp.issubdtype(current.data.dtype, jnp.floating):
+                    current.data = current.data.astype(dst_type)
+            elif isinstance(current, list):
                 setattr(
                     self,
                     attr,

@@ -15,6 +15,7 @@ from torchmetrics_tpu.utilities.data import (
 from torchmetrics_tpu.utilities.distributed import class_reduce, gather_all_tensors, reduce, sync_in_jit
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError, TorchMetricsUserWarning
 from torchmetrics_tpu.utilities.prints import rank_zero_debug, rank_zero_info, rank_zero_warn
+from torchmetrics_tpu.utilities.ringbuffer import RingBuffer, ring_push
 
 __all__ = [
     "_check_same_shape",
@@ -34,6 +35,8 @@ __all__ = [
     "class_reduce",
     "gather_all_tensors",
     "reduce",
+    "RingBuffer",
+    "ring_push",
     "sync_in_jit",
     "TorchMetricsUserError",
     "TorchMetricsUserWarning",

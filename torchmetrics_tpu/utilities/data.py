@@ -15,13 +15,19 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
+
 Array = jax.Array
 
 
 def dim_zero_cat(x: Union[Array, List[Array]]) -> Array:
-    """Concatenate a (possibly list-valued) state along dim 0."""
+    """Concatenate a (possibly list- or ring-buffer-valued) state along dim 0."""
     if isinstance(x, (jnp.ndarray, np.ndarray)) or hasattr(x, "shape"):
         return x
+    if isinstance(x, RingBuffer):
+        if not len(x):
+            raise ValueError("No samples to concatenate")
+        return x.values()
     if not x:  # empty list state
         raise ValueError("No samples to concatenate")
     x = [jnp.atleast_1d(jnp.asarray(el)) for el in x]

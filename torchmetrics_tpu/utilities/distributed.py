@@ -21,6 +21,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 import jax
 import jax.numpy as jnp
 
+from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
+
 Array = jax.Array
 
 
@@ -155,6 +157,22 @@ def sync_in_jit(
         red = reductions.get(name, "sum")
         if red not in _COLLECTIVES and not callable(red):
             raise ValueError(f"Unknown reduction {red!r} for state {name!r}")
+        if isinstance(value, RingBuffer):
+            # fixed-capacity cat state: gather storage+mask (static shapes), sum
+            # the cursor — result is a world-capacity buffer on every shard
+            if red not in ("cat", None):
+                raise ValueError(f"RingBuffer state {name!r} requires a 'cat' reduction, got {red!r}")
+            data, valid = value.masked()
+            if axis_index_groups is None:
+                g_data = jax.lax.all_gather(data, axis_name, tiled=True)
+                g_valid = jax.lax.all_gather(valid, axis_name, tiled=True)
+                g_count = jax.lax.psum(value.count, axis_name)
+            else:
+                g_data = member_selector(data).reshape(-1, *data.shape[1:])
+                g_valid = member_selector(valid).reshape(-1)
+                g_count = jnp.sum(member_selector(value.count), axis=0)
+            out[name] = type(value)(int(g_data.shape[0]), _data=g_data, _valid=g_valid, _count=g_count)
+            continue
         if axis_index_groups is None:
             if callable(red) and red not in _COLLECTIVES:
                 out[name] = red(jax.lax.all_gather(value, axis_name))

@@ -1,0 +1,234 @@
+"""Fixed-capacity device ring buffers for append-mode ("cat") metric states.
+
+The reference's list states grow without bound (``metric.py:195-272`` registers
+plain python lists; ``metric.py:483-488`` relieves memory only by moving them to
+CPU). On TPU the idiomatic design (SURVEY §5/§7) is a *fixed-capacity* ring
+buffer: one preallocated ``(capacity, *item_shape)`` device array plus a
+validity mask, updated with XLA scatter — static shapes, jit-compatible,
+bounded HBM, and shardable/gatherable like any other array state.
+
+Two layers:
+
+- :class:`RingBuffer` — a mutable host-side container that quacks like the
+  list states metrics already use (``.append``, iteration via ``values()``),
+  registered as a pytree so it can also flow through ``jit``/``shard_map``.
+- Pure kernels (:func:`ring_push`) for fully functional in-jit use.
+
+Metrics opt in per-instance with the ``cat_state_capacity`` constructor kwarg
+(consumed by the ``Metric`` base class): every list state declared with
+``dist_reduce_fx="cat"`` is transparently replaced by a ring buffer of that
+capacity. Once more rows than ``capacity`` have been appended, the oldest rows
+are overwritten (a one-time warning is emitted) — the deliberate bounded-memory
+trade-off for streaming quantile/curve/retrieval states at scale.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
+
+Array = jax.Array
+
+
+def ring_push(data: Array, valid: Array, count: Array, batch: Array) -> Tuple[Array, Array, Array]:
+    """Pure ring-buffer push: scatter ``batch`` rows in at the write cursor.
+
+    All shapes are static (``batch``'s leading dim is a trace-time constant), so
+    this compiles to a single XLA scatter — usable inside ``jit``/``scan``.
+
+    Args:
+        data: ``(capacity, *item_shape)`` storage.
+        valid: ``(capacity,)`` bool validity mask.
+        count: scalar int32, total rows ever pushed (the write cursor is
+            ``count % capacity``).
+        batch: ``(n, *item_shape)`` rows to insert. If ``n > capacity`` only
+            the last ``capacity`` rows survive.
+
+    Returns:
+        Updated ``(data, valid, count)``; ``count`` grows by the full ``n``.
+    """
+    capacity = data.shape[0]
+    n = batch.shape[0]
+    if n > capacity:
+        batch = batch[-capacity:]
+        offset = n - capacity
+    else:
+        offset = 0
+    idx = (count + offset + jnp.arange(batch.shape[0], dtype=jnp.int32)) % capacity
+    data = data.at[idx].set(batch.astype(data.dtype))
+    valid = valid.at[idx].set(True)
+    return data, valid, count + jnp.int32(n)
+
+
+class RingBuffer:
+    """Fixed-capacity device buffer standing in for an append-mode list state.
+
+    Storage is allocated lazily on the first :meth:`append` (item shape and
+    dtype are taken from the first batch), so it can be declared before the
+    metric has seen data — exactly like an empty list state.
+    """
+
+    def __init__(
+        self,
+        capacity: int,
+        item_shape: Optional[Sequence[int]] = None,
+        dtype: Any = None,
+        _data: Optional[Array] = None,
+        _valid: Optional[Array] = None,
+        _count: Optional[Array] = None,
+    ) -> None:
+        if not (isinstance(capacity, int) and capacity > 0):
+            raise ValueError(f"Argument `capacity` must be a positive integer, but got {capacity}")
+        self.capacity = capacity
+        if _data is not None:
+            self.data = _data
+            self.valid = _valid
+            self.count = _count
+        elif item_shape is not None and dtype is not None:
+            self.data = jnp.zeros((capacity, *item_shape), dtype)
+            self.valid = jnp.zeros((capacity,), bool)
+            self.count = jnp.zeros((), jnp.int32)
+        else:
+            self.data = None
+            self.valid = None
+            self.count = jnp.zeros((), jnp.int32)
+        # host-side mirror of `count` so the overflow check never forces a
+        # device sync; None when unknown (buffer built from device arrays)
+        self._host_count: Optional[int] = 0 if _count is None else None
+        self._warned_overflow = False
+
+    # ------------------------------------------------------------- properties
+    @property
+    def initialized(self) -> bool:
+        return self.data is not None
+
+    @property
+    def item_shape(self) -> Optional[Tuple[int, ...]]:
+        return None if self.data is None else self.data.shape[1:]
+
+    @property
+    def num_valid(self) -> int:
+        """Number of live rows (concrete; host-side)."""
+        return 0 if self.valid is None else int(jnp.sum(self.valid))
+
+    @property
+    def num_dropped(self) -> int:
+        """Rows overwritten because more than ``capacity`` were appended."""
+        total = self._host_count if self._host_count is not None else int(self.count)
+        return max(0, total - self.capacity)
+
+    def __len__(self) -> int:
+        return self.num_valid
+
+    def __repr__(self) -> str:
+        shape = None if self.data is None else tuple(self.data.shape)
+        return f"RingBuffer(capacity={self.capacity}, shape={shape}, valid={self.num_valid})"
+
+    # ----------------------------------------------------------------- update
+    def append(self, x: Any) -> "RingBuffer":
+        """Insert the rows of ``x`` (its leading axis; scalars become one row)."""
+        batch = jnp.atleast_1d(jnp.asarray(x))
+        if self.data is None:
+            self.data = jnp.zeros((self.capacity, *batch.shape[1:]), batch.dtype)
+            self.valid = jnp.zeros((self.capacity,), bool)
+        if batch.shape[1:] != self.data.shape[1:]:
+            raise ValueError(
+                f"RingBuffer expects rows of shape {self.data.shape[1:]}, but got a batch of shape {batch.shape}"
+            )
+        if self._host_count is None:  # one-time readback for device-built buffers
+            self._host_count = int(self.count)
+        will_drop = self._host_count + batch.shape[0] > self.capacity
+        self._host_count += batch.shape[0]
+        if will_drop and not self._warned_overflow:
+            rank_zero_warn(
+                f"RingBuffer capacity ({self.capacity}) exceeded; oldest rows are being overwritten."
+                " Increase `cat_state_capacity` if the metric should see every sample.",
+                UserWarning,
+            )
+            self._warned_overflow = True
+        self.data, self.valid, self.count = ring_push(self.data, self.valid, self.count, batch)
+        return self
+
+    def extend(self, values: Any) -> "RingBuffer":
+        """Append an iterable of batches, another :class:`RingBuffer`, or one array."""
+        if isinstance(values, RingBuffer):
+            if values.num_valid:
+                self.append(values.values())
+        elif isinstance(values, (list, tuple)):
+            for v in values:
+                self.append(v)
+        else:
+            self.append(values)
+        return self
+
+    # ------------------------------------------------------------------ reads
+    def values(self) -> Array:
+        """The live rows as one ``(num_valid, *item_shape)`` array (host path).
+
+        Row order follows storage order, not insertion order, once the buffer
+        has wrapped or been merged — cat states are order-agnostic reductions.
+        """
+        if self.data is None:
+            return jnp.zeros((0,), jnp.float32)
+        mask = np.asarray(self.valid)
+        return self.data[jnp.asarray(np.nonzero(mask)[0])]
+
+    def masked(self) -> Tuple[Array, Array]:
+        """``(data, valid)`` with static shapes — the jit-safe accessor."""
+        if self.data is None:
+            raise ValueError("RingBuffer has no storage yet (nothing appended)")
+        return self.data, self.valid
+
+    # ------------------------------------------------------------ lifecycle
+    def copy(self) -> "RingBuffer":
+        out = RingBuffer(self.capacity, _data=self.data, _valid=self.valid, _count=self.count)
+        out._host_count = self._host_count
+        out._warned_overflow = self._warned_overflow
+        return out
+
+    def copy_empty(self) -> "RingBuffer":
+        """A fresh buffer with the same capacity (storage re-lazied)."""
+        return RingBuffer(self.capacity)
+
+    def to_device(self, device: Any) -> "RingBuffer":
+        if self.data is not None:
+            self.data = jax.device_put(self.data, device)
+            self.valid = jax.device_put(self.valid, device)
+        self.count = jax.device_put(self.count, device)
+        return self
+
+    # -------------------------------------------------------------- pickling
+    def __getstate__(self) -> dict:
+        state = dict(self.__dict__)
+        for key in ("data", "valid", "count"):
+            if state[key] is not None:
+                state[key] = np.asarray(state[key])
+        return state
+
+    def __setstate__(self, state: dict) -> None:
+        self.__dict__.update(state)
+        for key in ("data", "valid", "count"):
+            if getattr(self, key) is not None:
+                setattr(self, key, jnp.asarray(getattr(self, key)))
+
+
+def _ringbuffer_flatten(rb: RingBuffer):
+    if rb.data is None:
+        raise ValueError("Cannot trace an uninitialized RingBuffer (append at least one batch first)")
+    return (rb.data, rb.valid, rb.count), rb.capacity
+
+
+def _ringbuffer_unflatten(capacity, leaves):
+    data, valid, count = leaves
+    # leaf shapes may legitimately differ from `capacity` after an in-jit
+    # all_gather (world concat); trust the leaves
+    cap = int(data.shape[0]) if hasattr(data, "shape") and data.shape else capacity
+    return RingBuffer(cap, _data=data, _valid=valid, _count=count)
+
+
+jax.tree_util.register_pytree_node(RingBuffer, _ringbuffer_flatten, _ringbuffer_unflatten)
