@@ -32,11 +32,15 @@ class BasicConv2d(nn.Module):
     kernel_size: Sequence[int]
     strides: Sequence[int] = (1, 1)
     padding: Any = "VALID"
+    dtype: Any = jnp.float32  # compute dtype; params stay float32
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        x = nn.Conv(self.out_channels, self.kernel_size, self.strides, padding=self.padding, use_bias=False)(x)
-        x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9)(x)
+        x = nn.Conv(
+            self.out_channels, self.kernel_size, self.strides, padding=self.padding, use_bias=False,
+            dtype=self.dtype,
+        )(x)
+        x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9, dtype=self.dtype)(x)
         return nn.relu(x)
 
 
@@ -47,84 +51,89 @@ def _pad(k: int) -> Any:
 
 class InceptionA(nn.Module):
     pool_features: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        b1 = BasicConv2d(64, (1, 1))(x)
-        b5 = BasicConv2d(48, (1, 1))(x)
-        b5 = BasicConv2d(64, (5, 5), padding=_pad(5))(b5)
-        b3 = BasicConv2d(64, (1, 1))(x)
-        b3 = BasicConv2d(96, (3, 3), padding=_pad(3))(b3)
-        b3 = BasicConv2d(96, (3, 3), padding=_pad(3))(b3)
+        b1 = BasicConv2d(64, (1, 1), dtype=self.dtype)(x)
+        b5 = BasicConv2d(48, (1, 1), dtype=self.dtype)(x)
+        b5 = BasicConv2d(64, (5, 5), padding=_pad(5), dtype=self.dtype)(b5)
+        b3 = BasicConv2d(64, (1, 1), dtype=self.dtype)(x)
+        b3 = BasicConv2d(96, (3, 3), padding=_pad(3), dtype=self.dtype)(b3)
+        b3 = BasicConv2d(96, (3, 3), padding=_pad(3), dtype=self.dtype)(b3)
         bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=_pad(3), count_include_pad=False)
-        bp = BasicConv2d(self.pool_features, (1, 1))(bp)
+        bp = BasicConv2d(self.pool_features, (1, 1), dtype=self.dtype)(bp)
         return jnp.concatenate([b1, b5, b3, bp], axis=-1)
 
 
 class InceptionB(nn.Module):
+    dtype: Any = jnp.float32
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        b3 = BasicConv2d(384, (3, 3), strides=(2, 2))(x)
-        bd = BasicConv2d(64, (1, 1))(x)
-        bd = BasicConv2d(96, (3, 3), padding=_pad(3))(bd)
-        bd = BasicConv2d(96, (3, 3), strides=(2, 2))(bd)
+        b3 = BasicConv2d(384, (3, 3), strides=(2, 2), dtype=self.dtype)(x)
+        bd = BasicConv2d(64, (1, 1), dtype=self.dtype)(x)
+        bd = BasicConv2d(96, (3, 3), padding=_pad(3), dtype=self.dtype)(bd)
+        bd = BasicConv2d(96, (3, 3), strides=(2, 2), dtype=self.dtype)(bd)
         bp = nn.max_pool(x, (3, 3), strides=(2, 2))
         return jnp.concatenate([b3, bd, bp], axis=-1)
 
 
 class InceptionC(nn.Module):
     channels_7x7: int
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
         c7 = self.channels_7x7
-        b1 = BasicConv2d(192, (1, 1))(x)
-        b7 = BasicConv2d(c7, (1, 1))(x)
-        b7 = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)))(b7)
-        b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)))(b7)
-        bd = BasicConv2d(c7, (1, 1))(x)
-        bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)))(bd)
-        bd = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)))(bd)
-        bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)))(bd)
-        bd = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)))(bd)
+        b1 = BasicConv2d(192, (1, 1), dtype=self.dtype)(x)
+        b7 = BasicConv2d(c7, (1, 1), dtype=self.dtype)(x)
+        b7 = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype)(b7)
+        b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype)(b7)
+        bd = BasicConv2d(c7, (1, 1), dtype=self.dtype)(x)
+        bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype)(bd)
+        bd = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype)(bd)
+        bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype)(bd)
+        bd = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype)(bd)
         bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=_pad(3), count_include_pad=False)
-        bp = BasicConv2d(192, (1, 1))(bp)
+        bp = BasicConv2d(192, (1, 1), dtype=self.dtype)(bp)
         return jnp.concatenate([b1, b7, bd, bp], axis=-1)
 
 
 class InceptionD(nn.Module):
+    dtype: Any = jnp.float32
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        b3 = BasicConv2d(192, (1, 1))(x)
-        b3 = BasicConv2d(320, (3, 3), strides=(2, 2))(b3)
-        b7 = BasicConv2d(192, (1, 1))(x)
-        b7 = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)))(b7)
-        b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)))(b7)
-        b7 = BasicConv2d(192, (3, 3), strides=(2, 2))(b7)
+        b3 = BasicConv2d(192, (1, 1), dtype=self.dtype)(x)
+        b3 = BasicConv2d(320, (3, 3), strides=(2, 2), dtype=self.dtype)(b3)
+        b7 = BasicConv2d(192, (1, 1), dtype=self.dtype)(x)
+        b7 = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype)(b7)
+        b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype)(b7)
+        b7 = BasicConv2d(192, (3, 3), strides=(2, 2), dtype=self.dtype)(b7)
         bp = nn.max_pool(x, (3, 3), strides=(2, 2))
         return jnp.concatenate([b3, b7, bp], axis=-1)
 
 
 class InceptionE(nn.Module):
     pool_type: str = "avg"  # FID variant uses max pooling in the last block
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        b1 = BasicConv2d(320, (1, 1))(x)
-        b3 = BasicConv2d(384, (1, 1))(x)
-        b3a = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)))(b3)
-        b3b = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)))(b3)
+        b1 = BasicConv2d(320, (1, 1), dtype=self.dtype)(x)
+        b3 = BasicConv2d(384, (1, 1), dtype=self.dtype)(x)
+        b3a = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)), dtype=self.dtype)(b3)
+        b3b = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)), dtype=self.dtype)(b3)
         b3 = jnp.concatenate([b3a, b3b], axis=-1)
-        bd = BasicConv2d(448, (1, 1))(x)
-        bd = BasicConv2d(384, (3, 3), padding=_pad(3))(bd)
-        bda = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)))(bd)
-        bdb = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)))(bd)
+        bd = BasicConv2d(448, (1, 1), dtype=self.dtype)(x)
+        bd = BasicConv2d(384, (3, 3), padding=_pad(3), dtype=self.dtype)(bd)
+        bda = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)), dtype=self.dtype)(bd)
+        bdb = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)), dtype=self.dtype)(bd)
         bd = jnp.concatenate([bda, bdb], axis=-1)
         if self.pool_type == "avg":
             bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=_pad(3), count_include_pad=False)
         else:
             bp = nn.max_pool(x, (3, 3), strides=(1, 1), padding=_pad(3))
-        bp = BasicConv2d(192, (1, 1))(bp)
+        bp = BasicConv2d(192, (1, 1), dtype=self.dtype)(bp)
         return jnp.concatenate([b1, b3, bd, bp], axis=-1)
 
 
@@ -132,33 +141,34 @@ class InceptionV3(nn.Module):
     """FID-style InceptionV3 returning a dict of the standard feature taps."""
 
     num_classes: int = 1008
+    dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x: Array) -> Dict[str, Array]:
         # x: (N, H, W, 3), float in [-1, 1] (TF preprocessing)
         out = {}
-        x = BasicConv2d(32, (3, 3), strides=(2, 2))(x)
-        x = BasicConv2d(32, (3, 3))(x)
-        x = BasicConv2d(64, (3, 3), padding=_pad(3))(x)
+        x = BasicConv2d(32, (3, 3), strides=(2, 2), dtype=self.dtype)(x)
+        x = BasicConv2d(32, (3, 3), dtype=self.dtype)(x)
+        x = BasicConv2d(64, (3, 3), padding=_pad(3), dtype=self.dtype)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        out["64"] = jnp.mean(x, axis=(1, 2))
-        x = BasicConv2d(80, (1, 1))(x)
-        x = BasicConv2d(192, (3, 3))(x)
+        out["64"] = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        x = BasicConv2d(80, (1, 1), dtype=self.dtype)(x)
+        x = BasicConv2d(192, (3, 3), dtype=self.dtype)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
-        out["192"] = jnp.mean(x, axis=(1, 2))
-        x = InceptionA(pool_features=32)(x)
-        x = InceptionA(pool_features=64)(x)
-        x = InceptionA(pool_features=64)(x)
-        x = InceptionB()(x)
-        x = InceptionC(channels_7x7=128)(x)
-        x = InceptionC(channels_7x7=160)(x)
-        x = InceptionC(channels_7x7=160)(x)
-        x = InceptionC(channels_7x7=192)(x)
-        out["768"] = jnp.mean(x, axis=(1, 2))
-        x = InceptionD()(x)
-        x = InceptionE(pool_type="avg")(x)
-        x = InceptionE(pool_type="max")(x)
-        pooled = jnp.mean(x, axis=(1, 2))
+        out["192"] = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        x = InceptionA(pool_features=32, dtype=self.dtype)(x)
+        x = InceptionA(pool_features=64, dtype=self.dtype)(x)
+        x = InceptionA(pool_features=64, dtype=self.dtype)(x)
+        x = InceptionB(dtype=self.dtype)(x)
+        x = InceptionC(channels_7x7=128, dtype=self.dtype)(x)
+        x = InceptionC(channels_7x7=160, dtype=self.dtype)(x)
+        x = InceptionC(channels_7x7=160, dtype=self.dtype)(x)
+        x = InceptionC(channels_7x7=192, dtype=self.dtype)(x)
+        out["768"] = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
+        x = InceptionD(dtype=self.dtype)(x)
+        x = InceptionE(pool_type="avg", dtype=self.dtype)(x)
+        x = InceptionE(pool_type="max", dtype=self.dtype)(x)
+        pooled = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         out["2048"] = pooled
         out["logits_unbiased"] = nn.Dense(self.num_classes, use_bias=False, name="fc")(pooled)
         return out
@@ -184,11 +194,17 @@ class InceptionFeatureExtractor:
     ``weights_path`` points at a converted ``.npz``; without it the trunk is
     randomly initialized (useful for pipeline tests, not for real FID values
     — a warning is emitted once).
+
+    ``compute_dtype`` defaults to bfloat16: convolutions run on the MXU at
+    twice the fp32 rate while parameters, BatchNorm statistics, and the
+    pooled feature taps stay float32 (the flax mixed-precision recipe), so
+    downstream FID/KID covariance folds see full-precision features. Pass
+    ``jnp.float32`` for bit-exact fp32 trunks.
     """
 
-    def __init__(self, feature="2048", weights_path: str = None, seed: int = 0) -> None:
+    def __init__(self, feature="2048", weights_path: str = None, seed: int = 0, compute_dtype=None) -> None:
         self.feature = str(feature)
-        self.net = InceptionV3()
+        self.net = InceptionV3(dtype=compute_dtype if compute_dtype is not None else jnp.bfloat16)
         dummy = jnp.zeros((1, 299, 299, 3), jnp.float32)
         if weights_path:
             self.variables = {"params": load_params_npz(weights_path)}
@@ -205,14 +221,21 @@ class InceptionFeatureExtractor:
                 " FID comparisons; pass a converted checkpoint or a custom feature extractor callable."
             )
             self.variables = self.net.init(jax.random.PRNGKey(seed), dummy)
-        self._forward = jax.jit(lambda v, x: self.net.apply(v, x))
+
+        feature = self.feature
+
+        def _fwd(variables, imgs):
+            # preprocessing fused into the compiled trunk; returning only the
+            # selected tap lets XLA dead-code-eliminate the other heads
+            if imgs.dtype == jnp.uint8:
+                imgs = imgs.astype(jnp.float32) / 255.0
+            imgs = jnp.transpose(imgs, (0, 2, 3, 1))  # NCHW -> NHWC
+            imgs = jax.image.resize(imgs, (imgs.shape[0], 299, 299, imgs.shape[3]), method="bilinear")
+            imgs = imgs * 2.0 - 1.0  # TF inception preprocessing
+            return self.net.apply(variables, imgs)[feature].astype(jnp.float32)
+
+        self._forward = jax.jit(_fwd)
 
     def __call__(self, imgs: Array) -> Array:
         """``imgs``: (N, 3, H, W) uint8 [0, 255] or float [0, 1]."""
-        imgs = jnp.asarray(imgs)
-        if imgs.dtype == jnp.uint8:
-            imgs = imgs.astype(jnp.float32) / 255.0
-        imgs = jnp.transpose(imgs, (0, 2, 3, 1))  # NCHW -> NHWC
-        imgs = jax.image.resize(imgs, (imgs.shape[0], 299, 299, imgs.shape[3]), method="bilinear")
-        imgs = imgs * 2.0 - 1.0  # TF inception preprocessing
-        return self._forward(self.variables, imgs)[self.feature]
+        return self._forward(self.variables, jnp.asarray(imgs))
