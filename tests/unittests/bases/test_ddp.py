@@ -107,3 +107,105 @@ def test_jit_update_compute_fused():
     out = fused(p, t)
     ref = multiclass_accuracy(p, t, num_classes=5)
     assert np.allclose(np.asarray(out), np.asarray(ref))
+
+
+def test_binned_curve_confmat_sync_equals_single_device(mesh):
+    """Binned PRC confusion state psum'd over the mesh == one-shot curve."""
+    from torchmetrics_tpu.functional.classification.precision_recall_curve import (
+        _adjust_threshold_arg,
+        _binary_precision_recall_curve_compute,
+        _binary_precision_recall_curve_update,
+    )
+
+    thresholds = _adjust_threshold_arg(10)
+    rng = np.random.default_rng(5)
+    preds = jnp.asarray(rng.random((8, 32)), jnp.float32)
+    target = jnp.asarray(rng.integers(0, 2, (8, 32)))
+
+    def step(p, t):
+        state = {"confmat": _binary_precision_recall_curve_update(p.reshape(-1), t.reshape(-1), thresholds)}
+        return sync_in_jit(state, {"confmat": "sum"}, axis_name="dp")
+
+    out = jax.jit(shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P()))(preds, target)
+    p_sync, r_sync, t_sync = _binary_precision_recall_curve_compute(out["confmat"], thresholds)
+
+    single = _binary_precision_recall_curve_update(preds.reshape(-1), target.reshape(-1), thresholds)
+    p_one, r_one, t_one = _binary_precision_recall_curve_compute(single, thresholds)
+    np.testing.assert_allclose(np.asarray(p_sync), np.asarray(p_one), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(r_sync), np.asarray(r_one), atol=1e-6)
+
+
+def test_pearson_moment_merge_over_mesh(mesh):
+    """Pearson's parallel-moment state merged across shards == global stats."""
+    from scipy.stats import pearsonr
+
+    from torchmetrics_tpu.functional.regression.pearson import (
+        _final_aggregation,
+        _pearson_corrcoef_update,
+    )
+
+    rng = np.random.default_rng(11)
+    x = rng.normal(size=(8, 64)).astype(np.float32)
+    y = (0.7 * x + 0.5 * rng.normal(size=(8, 64))).astype(np.float32)
+
+    def step(p, t):
+        mx, my, vx, vy, cxy, n = _pearson_corrcoef_update(
+            p.reshape(-1), t.reshape(-1), jnp.zeros(()), jnp.zeros(()), jnp.zeros(()),
+            jnp.zeros(()), jnp.zeros(()), jnp.zeros(()), num_outputs=1,
+        )
+        state = {"mx": mx[None], "my": my[None], "vx": vx[None], "vy": vy[None], "cxy": cxy[None], "n": n[None]}
+        return sync_in_jit(state, dict.fromkeys(state, "cat"), axis_name="dp")
+
+    out = jax.jit(
+        shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+    )(jnp.asarray(x), jnp.asarray(y))
+    mx, my, vx, vy, cxy, n = (out[k].reshape(-1) for k in ("mx", "my", "vx", "vy", "cxy", "n"))
+    _, _, vx_m, vy_m, cxy_m, n_m = _final_aggregation(mx, my, vx, vy, cxy, n)
+    from torchmetrics_tpu.functional.regression.pearson import _pearson_corrcoef_compute
+
+    corr = _pearson_corrcoef_compute(vx_m, vy_m, cxy_m, n_m)
+    want = pearsonr(x.reshape(-1), y.reshape(-1)).statistic
+    np.testing.assert_allclose(float(corr), want, atol=1e-4)
+
+
+def test_samplewise_state_cat_over_mesh(mesh):
+    """samplewise stat-scores gathered over the mesh == single-device rows."""
+    from torchmetrics_tpu.functional.classification.stat_scores import (
+        _binary_stat_scores_format,
+        _binary_stat_scores_update,
+    )
+
+    rng = np.random.default_rng(13)
+    preds = jnp.asarray(rng.random((8, 4, 16)), jnp.float32)  # 8 shards x 4 samples
+    target = jnp.asarray(rng.integers(0, 2, (8, 4, 16)))
+
+    def step(p, t):
+        pf, tf, valid = _binary_stat_scores_format(p[0], t[0], 0.5, None)
+        tp, fp, tn, fn = _binary_stat_scores_update(pf, tf, valid, "samplewise")
+        state = {"rows": jnp.stack([tp, fp, tn, fn], axis=-1)}
+        return sync_in_jit(state, {"rows": "cat"}, axis_name="dp")["rows"]
+
+    out = jax.jit(
+        shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P(), check_vma=False)
+    )(preds, target)
+    flat_p = preds.reshape(32, 16)
+    flat_t = target.reshape(32, 16)
+    pf, tf, valid = _binary_stat_scores_format(flat_p, flat_t, 0.5, None)
+    tp, fp, tn, fn = _binary_stat_scores_update(pf, tf, valid, "samplewise")
+    want = jnp.stack([tp, fp, tn, fn], axis=-1)
+    np.testing.assert_allclose(np.asarray(out).reshape(32, 4), np.asarray(want), atol=0)
+
+
+def test_grouped_metric_sync_independent_replicas(mesh):
+    """axis_index_groups partitions the mesh into independent sync domains."""
+
+    def step(x):
+        local = {"total": jnp.sum(x)}
+        synced = sync_in_jit(local, {"total": "sum"}, axis_name="dp",
+                             axis_index_groups=[[0, 1, 2, 3], [4, 5, 6, 7]])
+        return synced["total"][None]
+
+    data = jnp.arange(8 * 2, dtype=jnp.float32).reshape(8, 2)
+    out = jax.jit(shard_map(step, mesh=mesh, in_specs=P("dp"), out_specs=P("dp")))(data)
+    assert np.allclose(np.asarray(out)[:4], float(data[:4].sum()))
+    assert np.allclose(np.asarray(out)[4:], float(data[4:].sum()))
