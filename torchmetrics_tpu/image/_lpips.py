@@ -31,6 +31,8 @@ _VGG_CHANNELS = (64, 128, 256, 512, 512)
 class VGG16Features(nn.Module):
     """VGG16 conv trunk returning the 5 LPIPS feature taps."""
 
+    dtype: Any = jnp.float32  # compute dtype; params stay float32
+
     @nn.compact
     def __call__(self, x: Array) -> List[Array]:
         taps = []
@@ -39,7 +41,7 @@ class VGG16Features(nn.Module):
             if v == "M":
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             else:
-                x = nn.Conv(v, (3, 3), padding=((1, 1), (1, 1)))(x)
+                x = nn.Conv(v, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype)(x)
                 x = nn.relu(x)
                 if conv_idx in _VGG_TAPS:
                     taps.append(x)
@@ -55,6 +57,8 @@ def _normalize_tensor(x: Array, eps: float = 1e-10) -> Array:
 class LPIPSNet(nn.Module):
     """Full LPIPS: trunk + per-tap linear heads, spatial-averaged and summed."""
 
+    dtype: Any = jnp.float32
+
     @nn.compact
     def __call__(self, img0: Array, img1: Array) -> Array:
         # imgs: (N, 3, H, W) in [-1, 1] -> NHWC, ImageNet scaling
@@ -63,12 +67,14 @@ class LPIPSNet(nn.Module):
         x0 = (jnp.transpose(img0, (0, 2, 3, 1)) - shift) / scale
         x1 = (jnp.transpose(img1, (0, 2, 3, 1)) - shift) / scale
 
-        trunk = VGG16Features(name="net")
+        trunk = VGG16Features(name="net", dtype=self.dtype)
         feats0 = trunk(x0)
         feats1 = trunk(x1)
 
         total = 0.0
         for i, (f0, f1) in enumerate(zip(feats0, feats1)):
+            # distances accumulate in float32 regardless of trunk dtype
+            f0, f1 = f0.astype(jnp.float32), f1.astype(jnp.float32)
             d = (_normalize_tensor(f0) - _normalize_tensor(f1)) ** 2
             lin = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}")(d)
             total = total + jnp.mean(lin, axis=(1, 2, 3))
@@ -78,7 +84,7 @@ class LPIPSNet(nn.Module):
 class LPIPSExtractor:
     """Stateful wrapper with jit-compiled forward and optional weight loading."""
 
-    def __init__(self, net_type: str = "vgg", weights_path: str = None, seed: int = 0) -> None:
+    def __init__(self, net_type: str = "vgg", weights_path: str = None, seed: int = 0, compute_dtype=None) -> None:
         if net_type not in ("vgg", "alex", "squeeze"):
             raise ValueError(f"Argument `net_type` must be one of 'vgg', 'alex' or 'squeeze', but got {net_type}")
         if net_type != "vgg":
@@ -88,7 +94,9 @@ class LPIPSExtractor:
                 f"net_type='{net_type}' falls back to the VGG trunk in this implementation;"
                 " pass a custom `net` callable for other trunks."
             )
-        self.net = LPIPSNet()
+        # bfloat16 trunk by default: VGG convs hit the MXU at twice the fp32
+        # rate; params and the per-tap distance heads stay float32
+        self.net = LPIPSNet(dtype=compute_dtype if compute_dtype is not None else jnp.bfloat16)
         dummy = jnp.zeros((1, 3, 64, 64), jnp.float32)
         if weights_path:
             from torchmetrics_tpu.image._inception import load_params_npz
