@@ -51,6 +51,24 @@ def _uniform_filter2d(x: Array, kernel_size: Sequence[int]) -> Array:
     return _depthwise_conv2d(x, jnp.outer(kh, kw))
 
 
+def _uniform_filter2d_same(x: Array, window_size: int, mode: str = "symmetric") -> Array:
+    """Same-size uniform (mean) filter with the reference's padding protocol.
+
+    Pads ``ceil((ws-1)/2)`` on the leading edge and ``floor((ws-1)/2)`` on the
+    trailing edge of both spatial dims, then runs a VALID mean conv — the
+    output keeps the input's spatial shape. ``mode='symmetric'`` matches the
+    reference's scipy-style edge-inclusive reflection (``helper.py:76-92``);
+    ``mode='constant'`` matches its zero-padded variance windows
+    (``scc.py:113-120``).
+    """
+    lead = (window_size - 1) - (window_size - 1) // 2
+    trail = (window_size - 1) // 2
+    pad = ((0, 0), (0, 0), (lead, trail), (lead, trail))
+    x = jnp.pad(x, pad, mode=mode)
+    k = jnp.full((window_size, window_size), 1.0 / window_size**2, x.dtype)
+    return _depthwise_conv2d(x, k)
+
+
 def _reflection_pad2d(x: Array, pad: int) -> Array:
     return jnp.pad(x, ((0, 0), (0, 0), (pad, pad), (pad, pad)), mode="reflect")
 

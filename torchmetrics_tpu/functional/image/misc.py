@@ -15,6 +15,7 @@ from torchmetrics_tpu.functional.image.helper import (
     _depthwise_conv2d,
     _gaussian_kernel_1d,
     _uniform_filter2d,
+    _uniform_filter2d_same,
 )
 
 Array = jax.Array
@@ -113,18 +114,28 @@ def relative_average_spectral_error(
     target: Array,
     window_size: int = 8,
 ) -> Array:
-    """RASE: relative average spectral error via sliding-window RMSE (N,C,H,W)."""
+    """RASE via sliding-window RMSE (N,C,H,W) — reference ``rase.py:24-67``.
+
+    Follows the reference's exact protocol: batch-averaged RMSE and
+    window-mean maps (the latter divided by ``window_size**2`` a second time,
+    mirroring ``rase.py:45``), channel-mean folding, and a ``round(ws/2)``
+    border crop before the final spatial mean.
+    """
     preds, target = _check_image_pair(preds, target)
     rmse_map, target_mu = _rmse_sw_maps(preds, target, window_size)
-    # mean target intensity over all bands per window
-    rase_map = 100 / target_mu.mean(axis=1) * jnp.sqrt(jnp.mean(rmse_map**2, axis=1))
-    return jnp.mean(rase_map)
+    n = preds.shape[0]
+    rmse_mean = jnp.sum(rmse_map, axis=0) / n  # (C, H, W)
+    target_mean = jnp.sum(target_mu / window_size**2, axis=0) / n
+    target_mean = target_mean.mean(axis=0)  # mean over channels -> (H, W)
+    rase_map = 100 / target_mean * jnp.sqrt(jnp.mean(rmse_mean**2, axis=0))
+    crop = round(window_size / 2)
+    return jnp.mean(rase_map[crop:-crop, crop:-crop])
 
 
 def _rmse_sw_maps(preds: Array, target: Array, window_size: int) -> Tuple[Array, Array]:
-    mu_t = _uniform_filter2d(target, (window_size, window_size))
+    mu_t = _uniform_filter2d_same(target, window_size, mode="symmetric")
     diff2 = (preds - target) ** 2
-    mse_map = _uniform_filter2d(diff2, (window_size, window_size))
+    mse_map = _uniform_filter2d_same(diff2, window_size, mode="symmetric")
     return jnp.sqrt(mse_map), mu_t
 
 
@@ -134,12 +145,18 @@ def root_mean_squared_error_using_sliding_window(
     window_size: int = 8,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """RMSE over sliding windows (N,C,H,W)."""
+    """RMSE over sliding windows (N,C,H,W) — reference ``rmse_sw.py:21-80``.
+
+    Border windows are cropped by ``round(ws/2)`` before averaging, matching
+    the reference's crop-slide protocol.
+    """
     preds, target = _check_image_pair(preds, target)
     if not isinstance(window_size, int) or window_size < 1:
         raise ValueError(f"Argument `window_size` is expected to be a positive integer, but got {window_size}")
     rmse_map, _ = _rmse_sw_maps(preds, target, window_size)
-    per_image = rmse_map.reshape(rmse_map.shape[0], -1).mean(axis=-1)
+    crop = round(window_size / 2)
+    cropped = rmse_map[:, :, crop:-crop, crop:-crop]
+    per_image = cropped.reshape(cropped.shape[0], -1).mean(axis=-1)
     if reduction == "elementwise_mean":
         return jnp.mean(per_image)
     if reduction == "sum":
@@ -179,33 +196,46 @@ def spatial_correlation_coefficient(
     window_size: int = 8,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
-    """Spatial correlation coefficient with a high-pass Laplacian pre-filter."""
+    """Spatial correlation coefficient — reference ``scc.py:76-221``.
+
+    Mirrors the reference's sewar-derived protocol: a symmetric-padded,
+    flipped-kernel signal convolution scaled by 2 for the high-pass Laplacian
+    (``scc.py:104-107``), zero-padded same-size variance/covariance windows
+    (``scc.py:109-127``), and zeroed correlation where the local variances
+    vanish.
+    """
     preds, target = _check_image_pair(preds, target)
     if preds.ndim == 3:
         preds = preds[:, None]
         target = target[:, None]
     if hp_filter is None:
         hp_filter = jnp.array([[-1.0, -1.0, -1.0], [-1.0, 8.0, -1.0], [-1.0, -1.0, -1.0]])
-    pad = hp_filter.shape[0] // 2
-    preds_p = jnp.pad(preds, ((0, 0), (0, 0), (pad, pad), (pad, pad)), mode="reflect")
-    target_p = jnp.pad(target, ((0, 0), (0, 0), (pad, pad), (pad, pad)), mode="reflect")
-    preds_hp = _depthwise_conv2d(preds_p, hp_filter)
-    target_hp = _depthwise_conv2d(target_p, hp_filter)
+    hp_filter = jnp.asarray(hp_filter, jnp.float32)
+    kh, kw = hp_filter.shape
+    # signal convolution: flipped kernel, symmetric (edge-inclusive) padding
+    lead_h, trail_h = (kh - 1) // 2, kh - 1 - (kh - 1) // 2
+    lead_w, trail_w = (kw - 1) // 2, kw - 1 - (kw - 1) // 2
+    pad = ((0, 0), (0, 0), (lead_h, trail_h), (lead_w, trail_w))
+    preds_p = jnp.pad(preds, pad, mode="symmetric")
+    target_p = jnp.pad(target, pad, mode="symmetric")
+    flipped = hp_filter[::-1, ::-1]
+    preds_hp = _depthwise_conv2d(preds_p, flipped) * 2.0
+    target_hp = _depthwise_conv2d(target_p, flipped) * 2.0
 
-    mu_x = _uniform_filter2d(preds_hp, (window_size, window_size))
-    mu_y = _uniform_filter2d(target_hp, (window_size, window_size))
-    var_x = _uniform_filter2d(preds_hp**2, (window_size, window_size)) - mu_x**2
-    var_y = _uniform_filter2d(target_hp**2, (window_size, window_size)) - mu_y**2
-    cov_xy = _uniform_filter2d(preds_hp * target_hp, (window_size, window_size)) - mu_x * mu_y
+    mu_x = _uniform_filter2d_same(preds_hp, window_size, mode="constant")
+    mu_y = _uniform_filter2d_same(target_hp, window_size, mode="constant")
+    var_x = _uniform_filter2d_same(preds_hp**2, window_size, mode="constant") - mu_x**2
+    var_y = _uniform_filter2d_same(target_hp**2, window_size, mode="constant") - mu_y**2
+    cov_xy = _uniform_filter2d_same(preds_hp * target_hp, window_size, mode="constant") - mu_x * mu_y
 
     denom = jnp.sqrt(jnp.clip(var_x, min=0.0)) * jnp.sqrt(jnp.clip(var_y, min=0.0))
-    scc_map = jnp.where(denom > 1e-10, cov_xy / jnp.where(denom > 1e-10, denom, 1.0), 0.0)
+    scc_map = jnp.where(denom > 0, cov_xy / jnp.where(denom > 0, denom, 1.0), 0.0)
+    if reduction in ("none", None):
+        return scc_map.reshape(scc_map.shape[0], -1).mean(axis=-1)
     per_image = scc_map.reshape(scc_map.shape[0], -1).mean(axis=-1)
-    if reduction == "elementwise_mean":
-        return jnp.mean(per_image)
     if reduction == "sum":
         return jnp.sum(per_image)
-    return per_image
+    return jnp.mean(scc_map)
 
 
 def spectral_distortion_index(
