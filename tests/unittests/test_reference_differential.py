@@ -301,3 +301,89 @@ def test_image(name, kwargs):
 def test_total_variation():
     _cmp("total_variation", {"reduction": "sum"}, args_np=(_img_a,))
     _cmp("total_variation", {"reduction": "mean"}, args_np=(_img_a,))
+
+
+def test_psnrb():
+    a = RNG.uniform(size=(2, 1, 32, 32)).astype(np.float32)
+    b = np.clip(a + 0.1 * RNG.normal(size=a.shape), 0, 1).astype(np.float32)
+    _cmp("peak_signal_noise_ratio_with_blocked_effect", {}, args_np=(a, b), atol=1e-3)
+
+
+def test_vif():
+    a = RNG.uniform(size=(2, 1, 48, 48)).astype(np.float32) * 255
+    b = np.clip(a + 5 * RNG.normal(size=a.shape), 0, 255).astype(np.float32)
+    _cmp("visual_information_fidelity", {}, args_np=(a, b), atol=1e-3)
+
+
+def test_d_s_and_qnr():
+    # pan-sharpening quartet: preds (upsampled), ms (low-res), pan (high-res)
+    H = 32
+    preds = RNG.uniform(size=(2, 3, H, H)).astype(np.float32)
+    ms = RNG.uniform(size=(2, 3, H // 4, H // 4)).astype(np.float32)
+    pan = RNG.uniform(size=(2, 3, H, H)).astype(np.float32)
+    # pass pan_lr explicitly: the reference's internal downsample needs
+    # torchvision, which this image does not ship
+    pan_lr = RNG.uniform(size=(2, 3, H // 4, H // 4)).astype(np.float32)
+    for name in ("spatial_distortion_index", "quality_with_no_reference"):
+        ours = getattr(tm.functional, name)(
+            jnp.asarray(preds), jnp.asarray(ms), jnp.asarray(pan), jnp.asarray(pan_lr), window_size=4
+        )
+        ref = _ref_fn(name)(
+            torch.as_tensor(preds), torch.as_tensor(ms), torch.as_tensor(pan), torch.as_tensor(pan_lr),
+            window_size=4,
+        )
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-3, err_msg=name)
+
+
+def test_exact_mode_curves():
+    for task_args in (("roc", {}), ("precision_recall_curve", {})):
+        name, extra = task_args
+        ours = getattr(tm.functional, name)(jnp.asarray(_bp), jnp.asarray(_bt), task="binary", **extra)
+        ref = _ref_fn(name)(torch.as_tensor(_bp), torch.as_tensor(_bt), task="binary", **extra)
+        for o, r in zip(ours, ref):
+            np.testing.assert_allclose(np.asarray(o), r.numpy(), atol=1e-5, err_msg=name)
+
+
+def test_operating_point_metrics():
+    cases = [
+        ("binary_recall_at_fixed_precision", {"min_precision": 0.5}),
+        ("binary_precision_at_fixed_recall", {"min_recall": 0.5}),
+        ("binary_specificity_at_sensitivity", {"min_sensitivity": 0.5}),
+        ("binary_sensitivity_at_specificity", {"min_specificity": 0.5}),
+    ]
+    for name, kwargs in cases:
+        ours = getattr(tm.functional, name)(jnp.asarray(_bp), jnp.asarray(_bt), **kwargs)
+        ref = _ref_fn(name)(torch.as_tensor(_bp), torch.as_tensor(_bt), **kwargs)
+        for o, r in zip(ours, ref):
+            np.testing.assert_allclose(np.asarray(o), float(r), atol=1e-5, err_msg=name)
+
+
+def test_multiclass_calibration_error():
+    for norm in ("l1", "max"):
+        ours = tm.functional.calibration_error(
+            jnp.asarray(_mcp), jnp.asarray(_mct), task="multiclass", num_classes=NC, norm=norm
+        )
+        ref = _ref_fn("calibration_error")(
+            torch.as_tensor(_mcp), torch.as_tensor(_mct), task="multiclass", num_classes=NC, norm=norm
+        )
+        np.testing.assert_allclose(np.asarray(ours), float(ref), atol=1e-5, err_msg=norm)
+
+
+def test_dice():
+    ours = tm.functional.dice(jnp.asarray(_mcp), jnp.asarray(_mct), num_classes=NC, average="micro")
+    ref = _ref_fn("dice")(torch.as_tensor(_mcp), torch.as_tensor(_mct), num_classes=NC, average="micro")
+    np.testing.assert_allclose(np.asarray(ours), float(ref), atol=1e-5)
+
+
+def test_spearman_with_ties():
+    x = RNG.integers(0, 10, N).astype(np.float32)  # heavy ties
+    y = RNG.integers(0, 10, N).astype(np.float32)
+    _cmp("spearman_corrcoef", {}, args_np=(x, y), atol=1e-5)
+
+
+def test_image_gradients():
+    img = RNG.uniform(size=(2, 3, 16, 16)).astype(np.float32)
+    dy_o, dx_o = tm.functional.image_gradients(jnp.asarray(img))
+    dy_r, dx_r = _ref_fn("image_gradients")(torch.as_tensor(img))
+    np.testing.assert_allclose(np.asarray(dy_o), dy_r.numpy(), atol=1e-6)
+    np.testing.assert_allclose(np.asarray(dx_o), dx_r.numpy(), atol=1e-6)
