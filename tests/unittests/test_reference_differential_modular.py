@@ -1,0 +1,239 @@
+"""Differential sweep of the MODULAR layer vs the reference package.
+
+Where ``test_reference_differential.py`` compares functional kernels, this
+module streams identical batch sequences through both frameworks' *class*
+metrics — exercising update/state/compute semantics, retrieval grouping,
+collections, and wrappers end to end.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.reference_oracle import load_reference
+
+torchmetrics = load_reference()
+if torchmetrics is None:
+    pytest.skip("reference checkout unavailable", allow_module_level=True)
+
+import torch  # noqa: E402
+
+import torchmetrics_tpu as tm  # noqa: E402
+
+RNG = np.random.default_rng(7)
+NC = 4
+BATCHES = 4
+B = 32
+
+
+def _stream_binary():
+    for i in range(BATCHES):
+        r = np.random.default_rng(100 + i)
+        yield r.uniform(size=B).astype(np.float32), r.integers(0, 2, B)
+
+
+def _stream_multiclass():
+    for i in range(BATCHES):
+        r = np.random.default_rng(200 + i)
+        logits = r.normal(size=(B, NC)).astype(np.float32)
+        probs = np.exp(logits) / np.exp(logits).sum(-1, keepdims=True)
+        yield probs.astype(np.float32), r.integers(0, NC, B)
+
+
+def _run_pair(ours, ref, stream, to_kwargs=None):
+    for preds, target in stream:
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target))
+    o, r = ours.compute(), ref.compute()
+    if isinstance(o, (tuple, list)):
+        for oo, rr in zip(o, r):
+            np.testing.assert_allclose(np.asarray(oo), rr.detach().numpy(), atol=1e-5)
+    else:
+        np.testing.assert_allclose(np.asarray(o), r.detach().numpy(), atol=1e-5)
+
+
+CLASS_CASES = [
+    ("BinaryAUROC", {}, _stream_binary),
+    ("BinaryAveragePrecision", {}, _stream_binary),
+    ("BinaryAUROC", {"thresholds": 16}, _stream_binary),
+    ("BinaryF1Score", {}, _stream_binary),
+    ("BinaryMatthewsCorrCoef", {}, _stream_binary),
+    ("BinaryCalibrationError", {}, _stream_binary),
+    ("MulticlassAccuracy", {"num_classes": NC, "average": "macro"}, _stream_multiclass),
+    ("MulticlassAUROC", {"num_classes": NC}, _stream_multiclass),
+    ("MulticlassConfusionMatrix", {"num_classes": NC}, _stream_multiclass),
+    ("MulticlassCohenKappa", {"num_classes": NC}, _stream_multiclass),
+    ("MulticlassF1Score", {"num_classes": NC, "average": "weighted"}, _stream_multiclass),
+]
+
+
+@pytest.mark.parametrize(("name", "kwargs", "stream"), CLASS_CASES, ids=lambda v: str(v)[:44])
+def test_streaming_classification(name, kwargs, stream):
+    if not callable(stream):
+        pytest.skip("bad id")
+    _run_pair(getattr(tm, name)(**kwargs), getattr(torchmetrics.classification, name)(**kwargs), stream())
+
+
+REGRESSION_CASES = [
+    ("MeanSquaredError", {}),
+    ("MeanAbsoluteError", {}),
+    ("PearsonCorrCoef", {}),
+    ("SpearmanCorrCoef", {}),
+    ("R2Score", {}),
+    ("ExplainedVariance", {}),
+    ("ConcordanceCorrCoef", {}),
+    ("KendallRankCorrCoef", {}),
+]
+
+
+@pytest.mark.parametrize(("name", "kwargs"), REGRESSION_CASES, ids=lambda v: str(v)[:40])
+def test_streaming_regression(name, kwargs):
+    ours = getattr(tm, name)(**kwargs)
+    ref = getattr(torchmetrics.regression, name)(**kwargs)
+
+    def stream():
+        for i in range(BATCHES):
+            r = np.random.default_rng(300 + i)
+            x = r.normal(size=B).astype(np.float32)
+            yield x, (0.6 * x + 0.4 * r.normal(size=B)).astype(np.float32)
+
+    # Pearson/Spearman stream moments/cat — the interesting merge paths
+    _run_pair(ours, ref, stream())
+
+
+def test_streaming_retrieval_grouping():
+    """Modular retrieval metrics group by `indexes` across batches."""
+    cases = [
+        ("RetrievalMAP", {}),
+        ("RetrievalMRR", {}),
+        ("RetrievalPrecision", {"top_k": 2}),
+        ("RetrievalNormalizedDCG", {}),
+        ("RetrievalRPrecision", {}),
+    ]
+    for name, kwargs in cases:
+        ours = getattr(tm, name)(**kwargs)
+        ref = getattr(torchmetrics.retrieval, name)(**kwargs)
+        for i in range(BATCHES):
+            r = np.random.default_rng(400 + i)
+            idx = r.integers(0, 6, B)
+            preds = r.uniform(size=B).astype(np.float32)
+            target = r.integers(0, 2, B)
+            ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+            ref.update(torch.as_tensor(preds), torch.as_tensor(target), indexes=torch.as_tensor(idx))
+        np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-5, err_msg=name)
+
+
+def test_metric_collection_parity():
+    ours = tm.MetricCollection(
+        {
+            "acc": tm.MulticlassAccuracy(num_classes=NC),
+            "f1": tm.MulticlassF1Score(num_classes=NC),
+            "kappa": tm.MulticlassCohenKappa(num_classes=NC),
+        }
+    )
+    ref = torchmetrics.MetricCollection(
+        {
+            "acc": torchmetrics.classification.MulticlassAccuracy(num_classes=NC),
+            "f1": torchmetrics.classification.MulticlassF1Score(num_classes=NC),
+            "kappa": torchmetrics.classification.MulticlassCohenKappa(num_classes=NC),
+        }
+    )
+    for preds, target in _stream_multiclass():
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target))
+    o, r = ours.compute(), ref.compute()
+    for k in r:
+        np.testing.assert_allclose(np.asarray(o[k]), r[k].numpy(), atol=1e-5, err_msg=k)
+
+
+def test_aggregation_parity():
+    cases = [("SumMetric", "SumMetric"), ("MeanMetric", "MeanMetric"), ("MaxMetric", "MaxMetric"),
+             ("MinMetric", "MinMetric"), ("CatMetric", "CatMetric")]
+    for ours_name, ref_name in cases:
+        ours = getattr(tm, ours_name)()
+        ref = getattr(torchmetrics.aggregation, ref_name)()
+        for i in range(BATCHES):
+            r = np.random.default_rng(500 + i)
+            vals = r.normal(size=8).astype(np.float32)
+            ours.update(jnp.asarray(vals))
+            ref.update(torch.as_tensor(vals))
+        np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-6, err_msg=ours_name)
+
+
+def test_running_mean_parity():
+    ours = tm.RunningMean(window=3)
+    ref = torchmetrics.wrappers.Running(torchmetrics.aggregation.MeanMetric(), window=3)
+    for i in range(6):
+        v = float(i * 1.5)
+        ours.update(jnp.asarray(v))
+        ref.update(torch.tensor(v))
+    np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-6)
+
+
+def test_multioutput_wrapper_parity():
+    ours = tm.MultioutputWrapper(tm.MeanSquaredError(), num_outputs=2)
+    ref = torchmetrics.wrappers.MultioutputWrapper(torchmetrics.regression.MeanSquaredError(), num_outputs=2)
+    for i in range(BATCHES):
+        r = np.random.default_rng(600 + i)
+        a = r.normal(size=(B, 2)).astype(np.float32)
+        b = r.normal(size=(B, 2)).astype(np.float32)
+        ours.update(jnp.asarray(a), jnp.asarray(b))
+        ref.update(torch.as_tensor(a), torch.as_tensor(b))
+    o = np.asarray([np.asarray(x) for x in ours.compute()]).ravel()
+    r = np.asarray([x.numpy() for x in ref.compute()]).ravel()
+    np.testing.assert_allclose(o, r, atol=1e-5)
+
+
+def test_minmax_wrapper_parity():
+    ours = tm.MinMaxMetric(tm.BinaryAccuracy())
+    ref = torchmetrics.wrappers.MinMaxMetric(torchmetrics.classification.BinaryAccuracy())
+    for preds, target in _stream_binary():
+        ours.forward(jnp.asarray(preds), jnp.asarray(target))
+        ref.forward(torch.as_tensor(preds), torch.as_tensor(target))
+    o, r = ours.compute(), ref.compute()
+    for k in ("raw", "min", "max"):
+        np.testing.assert_allclose(float(o[k]), float(r[k]), atol=1e-6, err_msg=k)
+
+
+def test_classwise_wrapper_parity():
+    ours = tm.ClasswiseWrapper(tm.MulticlassAccuracy(num_classes=NC, average=None))
+    ref = torchmetrics.wrappers.ClasswiseWrapper(
+        torchmetrics.classification.MulticlassAccuracy(num_classes=NC, average=None)
+    )
+    for preds, target in _stream_multiclass():
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target))
+    o, r = ours.compute(), ref.compute()
+    assert set(o) == set(r)
+    for k in r:
+        np.testing.assert_allclose(float(o[k]), float(r[k]), atol=1e-5, err_msg=k)
+
+
+def test_nominal_streaming():
+    import torchmetrics.nominal
+
+    for name in ("CramersV", "TheilsU", "TschuprowsT", "PearsonsContingencyCoefficient"):
+        ours = getattr(tm, name)(num_classes=4)
+        ref = getattr(torchmetrics.nominal, name)(num_classes=4)
+        for i in range(BATCHES):
+            r = np.random.default_rng(700 + i)
+            a = r.integers(0, 4, B)
+            b = r.integers(0, 4, B)
+            ours.update(jnp.asarray(a), jnp.asarray(b))
+            ref.update(torch.as_tensor(a), torch.as_tensor(b))
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5, err_msg=name)
+
+
+def test_clustering_streaming():
+    import torchmetrics.clustering
+
+    for name in ("AdjustedRandScore", "NormalizedMutualInfoScore"):
+        ours = getattr(tm, name)()
+        ref = getattr(torchmetrics.clustering, name)()
+        for i in range(BATCHES):
+            r = np.random.default_rng(800 + i)
+            ours.update(jnp.asarray(r.integers(0, 4, B)), jnp.asarray(r.integers(0, 4, B)))
+            r = np.random.default_rng(800 + i)
+            ref.update(torch.as_tensor(r.integers(0, 4, B)), torch.as_tensor(r.integers(0, 4, B)))
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5, err_msg=name)

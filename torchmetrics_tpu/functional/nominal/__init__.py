@@ -44,6 +44,75 @@ def _chi2(confmat: Array) -> Array:
     return jnp.sum(jnp.where(expected > 0, (confmat - expected) ** 2 / jnp.clip(expected, min=1e-30), 0.0))
 
 
+
+def _drop_empty_rows_and_cols(confmat: Array) -> Array:
+    """Drop all-zero rows/columns (reference ``functional/nominal/utils.py:61``).
+
+    Host-side (dynamic shape) — used at compute time on accumulated class
+    confmats where unseen categories leave empty rows.
+    """
+    import numpy as np
+
+    cm = np.asarray(confmat)
+    cm = cm[cm.sum(axis=1) != 0][:, cm.sum(axis=0) != 0]
+    return jnp.asarray(cm)
+
+
+def _confmat_from_pairs(preds: Array, target: Array, num_classes: int) -> Array:
+    """(num_classes, num_classes) co-occurrence counts; rows=preds, cols=target."""
+    p_oh = jax.nn.one_hot(preds, num_classes, dtype=jnp.float32)
+    t_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.float32)
+    return jnp.einsum("nc,nd->cd", p_oh, t_oh)
+
+
+def _cramers_v_from_confmat(confmat: Array, bias_correction: bool) -> Array:
+    n = confmat.sum()
+    r, k = confmat.shape
+    chi2 = _chi2(confmat)
+    phi2 = chi2 / n
+    if bias_correction:
+        phi2 = jnp.clip(phi2 - (r - 1) * (k - 1) / (n - 1), min=0.0)
+        r = r - (r - 1) ** 2 / float(n - 1)
+        k = k - (k - 1) ** 2 / float(n - 1)
+    denom = min(r - 1, k - 1) if not bias_correction else jnp.minimum(r - 1, k - 1)
+    return jnp.sqrt(phi2 / jnp.clip(jnp.asarray(denom, jnp.float32), min=1e-30))
+
+
+def _tschuprows_t_from_confmat(confmat: Array, bias_correction: bool) -> Array:
+    n = confmat.sum()
+    r, k = confmat.shape
+    chi2 = _chi2(confmat)
+    phi2 = chi2 / n
+    if bias_correction:
+        phi2 = jnp.clip(phi2 - (r - 1) * (k - 1) / (n - 1), min=0.0)
+        r = r - (r - 1) ** 2 / float(n - 1)
+        k = k - (k - 1) ** 2 / float(n - 1)
+    return jnp.sqrt(phi2 / jnp.sqrt(jnp.clip(jnp.asarray((r - 1) * (k - 1), jnp.float32), min=1e-30)))
+
+
+def _pearsons_contingency_from_confmat(confmat: Array) -> Array:
+    n = confmat.sum()
+    chi2 = _chi2(confmat)
+    return jnp.sqrt(chi2 / (chi2 + n))
+
+
+def _theils_u_from_confmat(confmat: Array) -> Array:
+    """Theil's U from a (preds, target)-oriented contingency matrix."""
+    n = confmat.sum()
+    p_joint = confmat / n
+    p_x = p_joint.sum(axis=1)  # preds marginal
+    p_y = p_joint.sum(axis=0)
+    h_x = -jnp.sum(jnp.where(p_x > 0, p_x * jnp.log(jnp.clip(p_x, min=1e-30)), 0.0))
+    h_xy = -jnp.sum(
+        jnp.where(
+            p_joint > 0,
+            p_joint * (jnp.log(jnp.clip(p_joint, min=1e-30)) - jnp.log(jnp.clip(p_y[None, :], min=1e-30))),
+            0.0,
+        )
+    )
+    return jnp.where(h_x == 0, jnp.asarray(0.0), (h_x - h_xy) / jnp.clip(h_x, min=1e-30))
+
+
 def cramers_v(
     preds: Array,
     target: Array,
@@ -62,16 +131,7 @@ def cramers_v(
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
     confmat = calculate_contingency_matrix(preds, target)
-    n = confmat.sum()
-    r, k = confmat.shape
-    chi2 = _chi2(confmat)
-    phi2 = chi2 / n
-    if bias_correction:
-        phi2 = jnp.clip(phi2 - (r - 1) * (k - 1) / (n - 1), min=0.0)
-        r = r - (r - 1) ** 2 / float(n - 1)
-        k = k - (k - 1) ** 2 / float(n - 1)
-    denom = min(r - 1, k - 1) if not bias_correction else jnp.minimum(r - 1, k - 1)
-    return jnp.sqrt(phi2 / jnp.clip(jnp.asarray(denom, jnp.float32), min=1e-30))
+    return _cramers_v_from_confmat(confmat, bias_correction)
 
 
 def tschuprows_t(
@@ -85,15 +145,7 @@ def tschuprows_t(
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
     confmat = calculate_contingency_matrix(preds, target)
-    n = confmat.sum()
-    r, k = confmat.shape
-    chi2 = _chi2(confmat)
-    phi2 = chi2 / n
-    if bias_correction:
-        phi2 = jnp.clip(phi2 - (r - 1) * (k - 1) / (n - 1), min=0.0)
-        r = r - (r - 1) ** 2 / float(n - 1)
-        k = k - (k - 1) ** 2 / float(n - 1)
-    return jnp.sqrt(phi2 / jnp.sqrt(jnp.clip(jnp.asarray((r - 1) * (k - 1), jnp.float32), min=1e-30)))
+    return _tschuprows_t_from_confmat(confmat, bias_correction)
 
 
 def pearsons_contingency_coefficient(
@@ -106,9 +158,7 @@ def pearsons_contingency_coefficient(
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
     confmat = calculate_contingency_matrix(preds, target)
-    n = confmat.sum()
-    chi2 = _chi2(confmat)
-    return jnp.sqrt(chi2 / (chi2 + n))
+    return _pearsons_contingency_from_confmat(confmat)
 
 
 def theils_u(
@@ -120,18 +170,9 @@ def theils_u(
     """Theil's U (uncertainty coefficient): U(preds | target), asymmetric."""
     _nominal_input_validation(nan_strategy, nan_replace_value)
     preds, target = _handle_nan(preds, target, nan_strategy, nan_replace_value)
-    confmat = calculate_contingency_matrix(target, preds)  # rows=preds? see below
     # rows: preds categories (x), cols: target categories (y)
-    n = confmat.sum()
-    p_joint = confmat / n
-    p_x = p_joint.sum(axis=1)  # preds marginal
-    p_y = p_joint.sum(axis=0)
-    h_x = -jnp.sum(jnp.where(p_x > 0, p_x * jnp.log(jnp.clip(p_x, min=1e-30)), 0.0))
-    # H(X|Y) = -sum p(x,y) log(p(x,y)/p(y))
-    h_xy = -jnp.sum(
-        jnp.where(p_joint > 0, p_joint * (jnp.log(jnp.clip(p_joint, min=1e-30)) - jnp.log(jnp.clip(p_y[None, :], min=1e-30))), 0.0)
-    )
-    return jnp.where(h_x == 0, jnp.asarray(0.0), (h_x - h_xy) / jnp.clip(h_x, min=1e-30))
+    confmat = calculate_contingency_matrix(target, preds)
+    return _theils_u_from_confmat(confmat)
 
 
 def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:

@@ -8,7 +8,14 @@ import jax
 import jax.numpy as jnp
 
 from torchmetrics_tpu.functional.nominal import (
+    _confmat_from_pairs,
+    _cramers_v_from_confmat,
+    _drop_empty_rows_and_cols,
+    _handle_nan,
     _nominal_input_validation,
+    _pearsons_contingency_from_confmat,
+    _theils_u_from_confmat,
+    _tschuprows_t_from_confmat,
     cramers_v,
     fleiss_kappa,
     pearsons_contingency_coefficient,
@@ -22,7 +29,14 @@ Array = jax.Array
 
 
 class _NominalPairMetric(Metric):
-    """Base: cat-list (preds, target) categorical streams."""
+    """Base for categorical-pair association metrics.
+
+    With ``num_classes`` (the reference's required ctor arg, e.g.
+    ``nominal/cramers.py:89-105``) the state is one fixed
+    ``(num_classes, num_classes)`` co-occurrence matrix — static shape,
+    "sum"-reducible, jit/mesh friendly. Without it, raw (preds, target)
+    streams accumulate as cat states and categories are inferred at compute.
+    """
 
     is_differentiable = False
     higher_is_better = True
@@ -32,25 +46,41 @@ class _NominalPairMetric(Metric):
 
     def __init__(
         self,
+        num_classes: Optional[int] = None,
         nan_strategy: str = "replace",
         nan_replace_value: Optional[float] = 0.0,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         _nominal_input_validation(nan_strategy, nan_replace_value)
+        if num_classes is not None and not (isinstance(num_classes, int) and num_classes > 1):
+            raise ValueError(f"Argument `num_classes` must be an integer larger than 1, but got {num_classes}")
+        self.num_classes = num_classes
         self.nan_strategy = nan_strategy
         self.nan_replace_value = nan_replace_value
-        self.add_state("preds", default=[], dist_reduce_fx="cat")
-        self.add_state("target", default=[], dist_reduce_fx="cat")
+        if num_classes is not None:
+            self.add_state("confmat", default=jnp.zeros((num_classes, num_classes)), dist_reduce_fx="sum")
+        else:
+            self.add_state("preds", default=[], dist_reduce_fx="cat")
+            self.add_state("target", default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
-        self.preds.append(jnp.asarray(preds).reshape(-1))
-        self.target.append(jnp.asarray(target).reshape(-1))
+        if self.num_classes is not None:
+            p, t = _handle_nan(preds, target, self.nan_strategy, self.nan_replace_value)
+            self.confmat = self.confmat + _confmat_from_pairs(p, t, self.num_classes)
+        else:
+            self.preds.append(jnp.asarray(preds).reshape(-1))
+            self.target.append(jnp.asarray(target).reshape(-1))
 
     def _compute_fn(self, preds, target):
         raise NotImplementedError
 
+    def _compute_from_confmat(self, confmat):
+        raise NotImplementedError
+
     def compute(self) -> Array:
+        if self.num_classes is not None:
+            return self._compute_from_confmat(_drop_empty_rows_and_cols(self.confmat))
         return self._compute_fn(dim_zero_cat(self.preds), dim_zero_cat(self.target))
 
 
@@ -66,23 +96,29 @@ class CramersV(_NominalPairMetric):
         Array(1., dtype=float32)
     """
 
-    def __init__(self, bias_correction: bool = True, **kwargs: Any) -> None:
-        super().__init__(**kwargs)
+    def __init__(self, num_classes: Optional[int] = None, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, **kwargs)
         self.bias_correction = bias_correction
 
     def _compute_fn(self, preds, target):
         return cramers_v(preds, target, self.bias_correction, self.nan_strategy, self.nan_replace_value)
 
+    def _compute_from_confmat(self, confmat):
+        return _cramers_v_from_confmat(confmat, self.bias_correction)
+
 
 class TschuprowsT(_NominalPairMetric):
     """Tschuprow's T."""
 
-    def __init__(self, bias_correction: bool = True, **kwargs: Any) -> None:
-        super().__init__(**kwargs)
+    def __init__(self, num_classes: Optional[int] = None, bias_correction: bool = True, **kwargs: Any) -> None:
+        super().__init__(num_classes=num_classes, **kwargs)
         self.bias_correction = bias_correction
 
     def _compute_fn(self, preds, target):
         return tschuprows_t(preds, target, self.bias_correction, self.nan_strategy, self.nan_replace_value)
+
+    def _compute_from_confmat(self, confmat):
+        return _tschuprows_t_from_confmat(confmat, self.bias_correction)
 
 
 class PearsonsContingencyCoefficient(_NominalPairMetric):
@@ -91,12 +127,18 @@ class PearsonsContingencyCoefficient(_NominalPairMetric):
     def _compute_fn(self, preds, target):
         return pearsons_contingency_coefficient(preds, target, self.nan_strategy, self.nan_replace_value)
 
+    def _compute_from_confmat(self, confmat):
+        return _pearsons_contingency_from_confmat(confmat)
+
 
 class TheilsU(_NominalPairMetric):
     """Theil's U (uncertainty coefficient)."""
 
     def _compute_fn(self, preds, target):
         return theils_u(preds, target, self.nan_strategy, self.nan_replace_value)
+
+    def _compute_from_confmat(self, confmat):
+        return _theils_u_from_confmat(confmat)
 
 
 class FleissKappa(Metric):

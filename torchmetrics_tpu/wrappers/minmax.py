@@ -30,8 +30,11 @@ class MinMaxMetric(WrapperMetric):
         if not isinstance(base_metric, Metric):
             raise ValueError(f"Expected base metric to be an instance of `Metric` but received {base_metric}")
         self._base_metric = base_metric
-        self.add_state("min_val", default=jnp.array(jnp.inf), dist_reduce_fx="min")
-        self.add_state("max_val", default=jnp.array(-jnp.inf), dist_reduce_fx="max")
+        # plain attributes, NOT managed states (reference minmax.py:78-79):
+        # every compute() — including the batch-only computes inside forward's
+        # dual-update path — permanently folds into the running min/max
+        self.min_val = jnp.array(jnp.inf)
+        self.max_val = jnp.array(-jnp.inf)
 
     def update(self, *args: Any, **kwargs: Any) -> None:
         self._base_metric.update(*args, **kwargs)
@@ -46,6 +49,9 @@ class MinMaxMetric(WrapperMetric):
         return {"raw": val, "max": self.max_val, "min": self.min_val}
 
     def reset(self) -> None:
+        # min/max deliberately survive reset: forward's dual-update path calls
+        # reset() between the global and batch computes, and the reference's
+        # reset (minmax.py:103-106) leaves the unregistered min/max untouched
         super().reset()
         self._base_metric.reset()
 
