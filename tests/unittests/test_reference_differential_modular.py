@@ -237,3 +237,103 @@ def test_clustering_streaming():
             r = np.random.default_rng(800 + i)
             ref.update(torch.as_tensor(r.integers(0, 4, B)), torch.as_tensor(r.integers(0, 4, B)))
         np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5, err_msg=name)
+
+
+def test_metric_arithmetic_parity():
+    """CompositionalMetric algebra: (a + b) / 2 and 1 - m track the reference."""
+    ours_a, ours_b = tm.BinaryAccuracy(), tm.BinaryF1Score()
+    ref_a = torchmetrics.classification.BinaryAccuracy()
+    ref_b = torchmetrics.classification.BinaryF1Score()
+    ours_mix = (ours_a + ours_b) / 2
+    ref_mix = (ref_a + ref_b) / 2
+    ours_inv = 1 - ours_a
+    ref_inv = 1 - ref_a
+    for preds, target in _stream_binary():
+        for m in (ours_a, ours_b):
+            m.update(jnp.asarray(preds), jnp.asarray(target))
+        for m in (ref_a, ref_b):
+            m.update(torch.as_tensor(preds), torch.as_tensor(target))
+    np.testing.assert_allclose(float(ours_mix.compute()), float(ref_mix.compute()), atol=1e-6)
+    np.testing.assert_allclose(float(ours_inv.compute()), float(ref_inv.compute()), atol=1e-6)
+
+
+def test_tracker_parity():
+    ours = tm.MetricTracker(tm.BinaryAccuracy(), maximize=True)
+    ref = torchmetrics.wrappers.MetricTracker(torchmetrics.classification.BinaryAccuracy(), maximize=True)
+    for step, (preds, target) in enumerate(_stream_binary()):
+        ours.increment()
+        ref.increment()
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target))
+    best_o, which_o = ours.best_metric(return_step=True)
+    best_r, which_r = ref.best_metric(return_step=True)
+    np.testing.assert_allclose(float(best_o), float(best_r), atol=1e-6)
+    assert int(which_o) == int(which_r)
+
+
+def test_multitask_wrapper_parity():
+    ours = tm.MultitaskWrapper({"cls": tm.BinaryAccuracy(), "reg": tm.MeanSquaredError()})
+    ref = torchmetrics.wrappers.MultitaskWrapper(
+        {"cls": torchmetrics.classification.BinaryAccuracy(), "reg": torchmetrics.regression.MeanSquaredError()}
+    )
+    for i in range(BATCHES):
+        r = np.random.default_rng(900 + i)
+        bp = r.uniform(size=B).astype(np.float32)
+        bt = r.integers(0, 2, B)
+        x = r.normal(size=B).astype(np.float32)
+        y = r.normal(size=B).astype(np.float32)
+        ours.update(
+            {"cls": jnp.asarray(bp), "reg": jnp.asarray(x)},
+            {"cls": jnp.asarray(bt), "reg": jnp.asarray(y)},
+        )
+        ref.update(
+            {"cls": torch.as_tensor(bp), "reg": torch.as_tensor(x)},
+            {"cls": torch.as_tensor(bt), "reg": torch.as_tensor(y)},
+        )
+    o, r = ours.compute(), ref.compute()
+    for k in r:
+        np.testing.assert_allclose(float(o[k]), float(r[k]), atol=1e-6, err_msg=k)
+
+
+def test_streaming_image_classes():
+    import torchmetrics.image
+
+    cases = [
+        ("PeakSignalNoiseRatio", {"data_range": 1.0}),
+        ("StructuralSimilarityIndexMeasure", {"data_range": 1.0}),
+        ("UniversalImageQualityIndex", {}),
+        ("SpectralAngleMapper", {}),
+    ]
+    for name, kwargs in cases:
+        ours = getattr(tm, name)(**kwargs)
+        ref = getattr(torchmetrics.image, name)(**kwargs)
+        for i in range(3):
+            r = np.random.default_rng(950 + i)
+            a = r.uniform(size=(2, 3, 24, 24)).astype(np.float32)
+            b = np.clip(a + 0.1 * r.normal(size=a.shape), 0, 1).astype(np.float32)
+            ours.update(jnp.asarray(a), jnp.asarray(b))
+            ref.update(torch.as_tensor(a), torch.as_tensor(b))
+        np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=1e-4, err_msg=name)
+
+
+def test_streaming_text_classes():
+    import torchmetrics.text
+
+    cases = [
+        ("WordErrorRate", "WordErrorRate"),
+        ("CharErrorRate", "CharErrorRate"),
+        ("MatchErrorRate", "MatchErrorRate"),
+        ("WordInfoLost", "WordInfoLost"),
+        ("EditDistance", "EditDistance"),
+    ]
+    batches = [
+        (["hello world", "the quick brown fox"], ["hello there world", "the quick fox"]),
+        (["jax on tpu", "metrics framework"], ["jax on tpus", "a metrics framework"]),
+    ]
+    for ours_name, ref_name in cases:
+        ours = getattr(tm, ours_name)()
+        ref = getattr(torchmetrics.text, ref_name)()
+        for preds, target in batches:
+            ours.update(preds, target)
+            ref.update(preds, target)
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5, err_msg=ours_name)
