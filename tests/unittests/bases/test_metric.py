@@ -245,3 +245,22 @@ class TestSyncGuards:
         m.sync()
         with pytest.raises(TorchMetricsUserError, match="shouldn't be synced"):
             m(1.0)
+
+
+def test_check_forward_full_state_property(capsys):
+    """The utilities checker validates the flag and prints timing guidance."""
+    import jax
+
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+    from torchmetrics_tpu.utilities import check_forward_full_state_property
+
+    k = jax.random.PRNGKey(0)
+    check_forward_full_state_property(
+        MulticlassConfusionMatrix,
+        init_args={"num_classes": 3},
+        input_args={"preds": jax.random.randint(k, (50,), 0, 3), "target": jax.random.randint(k, (50,), 0, 3)},
+        num_update_to_compare=[3],
+        reps=1,
+    )
+    out = capsys.readouterr().out
+    assert "Recommended setting `full_state_update=False`" in out

@@ -1,6 +1,6 @@
 """Utility layer (L1): math, data ops, distributed sync, checks, enums."""
 
-from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.checks import _check_same_shape, check_forward_full_state_property
 from torchmetrics_tpu.utilities.compute import _auc_compute, _safe_divide, _safe_matmul, _safe_xlogy, interp
 from torchmetrics_tpu.utilities.data import (
     dim_zero_cat,
@@ -19,6 +19,7 @@ from torchmetrics_tpu.utilities.ringbuffer import RingBuffer, ring_push
 
 __all__ = [
     "_check_same_shape",
+    "check_forward_full_state_property",
     "_auc_compute",
     "_safe_divide",
     "_safe_matmul",

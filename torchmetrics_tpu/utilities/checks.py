@@ -10,11 +10,18 @@ reference.
 
 from __future__ import annotations
 
-from typing import Optional, Tuple
+from typing import Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
+
+from torchmetrics_tpu.utilities.prints import rank_zero_only
+
+
+@rank_zero_only
+def rank_zero_print(*args, **kwargs) -> None:
+    print(*args, **kwargs)
 
 Array = jax.Array
 
@@ -57,3 +64,62 @@ def _check_label_range(x: Array, num_classes: int, name: str = "target", allow_i
 def _num_samples_check(preds: Array, target: Array) -> None:
     if preds.shape[0] != target.shape[0]:
         raise RuntimeError("Predictions and targets must have the same number of samples.")
+
+
+def check_forward_full_state_property(
+    metric_class,
+    init_args: Optional[dict] = None,
+    input_args: Optional[dict] = None,
+    num_update_to_compare: Sequence[int] = (10, 100, 1000),
+    reps: int = 5,
+) -> None:
+    """Check whether ``full_state_update=False`` is safe for a metric class.
+
+    Reference ``utilities/checks.py:636``: runs ``forward`` under both the
+    conservative double-update path (``full_state_update=True``) and the fast
+    single-update path, verifies the batch values agree, then reports timing
+    for each so authors can pick the flag with evidence.
+    """
+    import time as _time
+
+    import jax as _jax
+
+    init_args = init_args or {}
+    input_args = input_args or {}
+
+    class FullState(metric_class):
+        full_state_update = True
+
+    class PartState(metric_class):
+        full_state_update = False
+
+    full_state = FullState(**init_args)
+    part_state = PartState(**init_args)
+    equal = True
+    for _ in range(num_update_to_compare[0]):
+        out1 = full_state(**input_args)
+        out2 = part_state(**input_args)
+        equal = equal and _jax.tree_util.tree_all(
+            _jax.tree_util.tree_map(lambda a, b: bool(jnp.allclose(a, b)), out1, out2)
+        )
+    res1 = full_state.compute()
+    res2 = part_state.compute()
+    equal = equal and _jax.tree_util.tree_all(
+        _jax.tree_util.tree_map(lambda a, b: bool(jnp.allclose(a, b)), res1, res2)
+    )
+    if not equal:
+        rank_zero_print(
+            "Full state and reduced state did not match; recommended setting `full_state_update=True`."
+        )
+        return
+
+    for metric, name in ((full_state, "Full"), (part_state, "Partial")):
+        for num in num_update_to_compare:
+            metric.reset()
+            start = _time.perf_counter()
+            for _ in range(reps):
+                for _ in range(num):
+                    metric(**input_args)
+            end = _time.perf_counter()
+            rank_zero_print(f"{name} state for {num} steps took: {(end - start) / reps}")
+    rank_zero_print("Recommended setting `full_state_update=False`")
