@@ -205,18 +205,27 @@ def accumulate(
 
         idxs = jnp.arange(k)
 
+        # (T*A, K) layout: the cumulative scans run along the MINORMOST axis so
+        # the VPU sees full 128-lane rows instead of the 40-lane (T, A) minor
+        # dims of the (K, T, A) layout — the accumulate stage is bandwidth
+        # bound and this halves its traffic
+        match_ta = match_s.reshape(k, num_t * num_a).T  # (TA, K)
+        ign_ta = ign_s.reshape(k, num_t * num_a).T
+        npig_f = jnp.maximum(npig.astype(jnp.float32), 1.0)
+        npig_ta = jnp.broadcast_to(npig_f[None, :], (num_t, num_a)).reshape(num_t * num_a)  # (TA,)
+
         def per_maxdet(m):
             sel_m = sel_row & (rank_s < m)
-            use = sel_m[:, None, None] & ~ign_s  # (K, T, A)
-            tp = jnp.cumsum((use & match_s).astype(jnp.float32), axis=0)
-            fp = jnp.cumsum((use & ~match_s).astype(jnp.float32), axis=0)
+            use = sel_m[None, :] & ~ign_ta  # (TA, K)
+            tp = jnp.cumsum((use & match_ta).astype(jnp.float32), axis=1)
+            fp = jnp.cumsum((use & ~match_ta).astype(jnp.float32), axis=1)
             # Rows excluded by the maxdet cap add 0, so rc/pr repeat the
             # previous point — duplicated curve points change neither the
             # envelope nor searchsorted hits (pycocotools keeps ignored rows
             # in its curves the same way).
-            rc = tp / jnp.maximum(npig[None, None, :].astype(jnp.float32), 1.0)
+            rc = tp / npig_ta[:, None]
             pr = tp / jnp.maximum(tp + fp, 1e-12)  # np.spacing(1) guard
-            pr_env = jax.lax.cummax(pr[::-1], axis=0)[::-1]  # right-to-left max
+            pr_env = jax.lax.cummax(pr[:, ::-1], axis=1)[:, ::-1]  # right-to-left max
 
             # sampled 'scores': searchsorted may land on an excluded row;
             # the true pycocotools sample is the NEXT selected row (the same
@@ -225,21 +234,21 @@ def accumulate(
             score_at_next = jnp.where(next_sel < k, score_s[jnp.minimum(next_sel, k - 1)], 0.0)
 
             def sample(rc_ta, pr_ta):
-                # rc_ta, pr_ta: (K,) for one (t, a)
-                inds = jnp.searchsorted(rc_ta, rec_thresholds, side="left")
+                # rc_ta, pr_ta: (K,) for one (t, a). compare_all lowers to a
+                # fused broadcast-compare + reduction — ~4x faster than the
+                # default per-query binary-search scan under vmap on TPU
+                inds = jnp.searchsorted(rc_ta, rec_thresholds, side="left", method="compare_all")
                 ok = inds < k
                 inds_c = jnp.minimum(inds, k - 1)
                 q = jnp.where(ok, pr_ta[inds_c], 0.0)
                 s = jnp.where(ok, score_at_next[inds_c], 0.0)
                 return q, s
 
-            rc_flat = rc.reshape(k, num_t * num_a).T
-            pr_flat = pr_env.reshape(k, num_t * num_a).T
-            q, s = jax.vmap(sample)(rc_flat, pr_flat)  # (T*A, R)
+            q, s = jax.vmap(sample)(rc, pr_env)  # (T*A, R)
             q = q.reshape(num_t, num_a, num_r)
             s = s.reshape(num_t, num_a, num_r)
 
-            total = tp[-1]  # (T, A) final tp count
+            total = tp[:, -1].reshape(num_t, num_a)  # final tp count
             recall_m = jnp.where(
                 npig[None, :] > 0, total / jnp.maximum(npig[None, :].astype(jnp.float32), 1.0), -1.0
             )
@@ -251,7 +260,10 @@ def accumulate(
         # (M, T, A, R), (M, T, A)
         return jnp.stack(qs), jnp.stack(ss), jnp.stack(rs)
 
-    q_all, s_all, r_all = jax.lax.map(per_class, class_ids)
+    # all classes in parallel: per-class work is (K, T, A)-shaped, so the
+    # batched form peaks at C x K x T x A floats (tens of MB) and keeps the
+    # VPU busy instead of running C sequential micro-kernels
+    q_all, s_all, r_all = jax.vmap(per_class)(class_ids)
     # q_all: (C, M, T, A, R) -> precision (T, R, C, A, M)
     precision = jnp.transpose(q_all, (2, 4, 0, 3, 1))
     scores = jnp.transpose(s_all, (2, 4, 0, 3, 1))
