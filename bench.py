@@ -197,13 +197,18 @@ def _bench_map_ours(data) -> float:
     from torchmetrics_tpu.utilities.data import _bucket_size
 
     counts = np.zeros(MAP_CLASSES, np.int64)
+    max_cr = 1
     for i in range(MAP_IMGS):
-        counts += np.minimum(np.bincount(det_l[i], minlength=MAP_CLASSES), 100)
+        per_img = np.minimum(np.bincount(det_l[i], minlength=MAP_CLASSES), 100)
+        counts += per_img
+        max_cr = max(max_cr, int(per_img.max()))
     max_cd = _bucket_size(int(counts.max()), minimum=1)
+    max_cr = _bucket_size(max_cr, minimum=1)
 
     def run():
         P, R, S = evaluate_map(
-            *args, class_ids, iou_t, rec_t, (1, 10, 100), MAP_CLASSES, max_class_dets=max_cd
+            *args, class_ids, iou_t, rec_t, (1, 10, 100), MAP_CLASSES, max_class_dets=max_cd,
+            max_class_rank=max_cr
         )
         # scalar fetch forces completion (block_until_ready is unreliable
         # through the axon device tunnel)

@@ -220,3 +220,32 @@ def test_map_sparse_large_label_ids():
     out = m.compute()
     assert float(out["map_50"]) == 1.0
     assert np.asarray(out["classes"]).reshape(-1).tolist() == [10**6]
+
+
+def test_rank_parallel_matcher_equivalence():
+    """match_detections_ranked is bit-identical to the slot-scan matcher."""
+    import numpy as np
+
+    import torchmetrics_tpu.functional.detection._map_eval as M
+
+    rng = np.random.default_rng(42)
+    I, D, G, C, T, A = 6, 20, 8, 4, 3, 2
+    iou = jnp.asarray(rng.uniform(0, 1, (I, D, G)).astype(np.float32))
+    dl = jnp.asarray(rng.integers(0, C, (I, D)).astype(np.int32))
+    dv = jnp.asarray(rng.random((I, D)) < 0.9)
+    rank = M.compute_class_ranks(dl, dv, C)
+    part = dv & (rank < 10)
+    dia = jnp.asarray(rng.random((I, D, A)) < 0.2)
+    gl = jnp.asarray(rng.integers(0, C, (I, G)).astype(np.int32))
+    gv = jnp.asarray(rng.random((I, G)) < 0.9)
+    gc = jnp.asarray(rng.random((I, G)) < 0.25)
+    gig = (gc[:, None, :] | jnp.asarray(rng.random((I, A, G)) < 0.2)) & gv[:, None, :]
+    thr = jnp.asarray(np.sort(rng.uniform(0.2, 0.9, T)).astype(np.float32))
+
+    slot = M.match_detections(iou, dl, part, dia, gl, gv, gc, gig, thr)
+    max_rank = int(jnp.max(jnp.where(part, rank, -1))) + 1
+    ranked = M.match_detections_ranked(
+        iou, dl, part, dia, gl, gv, gc, gig, thr, rank, C, max(max_rank, 1)
+    )
+    assert bool(jnp.array_equal(slot.matched, ranked.matched))
+    assert bool(jnp.array_equal(slot.ignored, ranked.ignored))

@@ -282,9 +282,14 @@ class MeanAveragePrecision(Metric):
                 np.minimum(np.bincount(dl[i][dv[i]], minlength=num_classes), cap) for i in range(dl.shape[0])
             ]
             max_cd = int(np.sum(per_img_class, axis=0).max()) if per_img_class else 1
+            # deepest per-(image, class) stack: the sequential depth of the
+            # rank-parallel matcher
+            max_cr = int(np.max(per_img_class)) if per_img_class else 1
         else:
             max_cd = 1
+            max_cr = 1
         max_cd = _bucket(max(max_cd, 1))
+        max_cr = _bucket(max(max_cr, 1))
 
         precision, recall, scores = evaluate_map(
             jnp.asarray(db),
@@ -304,6 +309,7 @@ class MeanAveragePrecision(Metric):
             int(num_classes),
             iou_override=iou_override,
             max_class_dets=max_cd,
+            max_class_rank=max_cr,
         )
         return np.asarray(precision), np.asarray(recall), np.asarray(scores), classes
 

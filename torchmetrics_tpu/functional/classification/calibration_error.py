@@ -22,7 +22,11 @@ def _binning_bucketize(confidences: Array, accuracies: Array, bin_boundaries: Ar
     """Per-bin (accuracy, confidence, proportion) via one-hot bucket reduction."""
     n_bins = bin_boundaries.shape[0] - 1
     # bucket index in [0, n_bins-1]
-    idx = jnp.clip(jnp.searchsorted(bin_boundaries[1:-1], confidences, side="right"), 0, n_bins - 1)
+    # compare_all: fused broadcast-compare beats the per-query binary-search
+    # lowering on TPU for small boundary vectors
+    idx = jnp.clip(
+        jnp.searchsorted(bin_boundaries[1:-1], confidences, side="right", method="compare_all"), 0, n_bins - 1
+    )
     oh = jax.nn.one_hot(idx, n_bins, dtype=jnp.float32)
     counts = oh.sum(axis=0)
     conf_bin = _safe_divide(oh.T @ confidences.astype(jnp.float32), counts)

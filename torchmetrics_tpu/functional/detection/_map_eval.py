@@ -128,6 +128,100 @@ def match_detections(
     return MatchResult(jnp.moveaxis(matched, 0, 1), jnp.moveaxis(ignored, 0, 1))
 
 
+def match_detections_ranked(
+    iou: Array,  # (I, D, G)
+    det_labels: Array,  # (I, D) int32, score-sorted per image
+    det_participates: Array,  # (I, D)
+    det_ignore_area: Array,  # (I, D, A)
+    gt_labels: Array,  # (I, G)
+    gt_valid: Array,  # (I, G)
+    gt_crowd: Array,  # (I, G)
+    gt_ignore: Array,  # (I, A, G)
+    iou_thresholds: Array,  # (T,)
+    det_rank: Array,  # (I, D) per-class rank (score order within class)
+    num_classes: int,
+    max_rank: int,
+) -> MatchResult:
+    """Greedy matching scanned over class-RANK instead of detection slots.
+
+    Classes never compete for the same ground truth (label equality gates every
+    candidate), so all classes' rank-``r`` detections can match simultaneously:
+    the sequential depth drops from ``D`` to ``max_rank`` — the largest
+    per-(image, class) detection count — typically ~an order of magnitude
+    shorter on multi-class workloads. Per-class score order (the order
+    pycocotools matches in) is exactly rank order, and cross-class order is
+    irrelevant, so results are bit-identical to :func:`match_detections`
+    whenever ``max_rank`` covers every participating detection.
+    """
+    num_i, num_d, num_g = iou.shape
+    num_t = iou_thresholds.shape[0]
+    num_a = gt_ignore.shape[1]
+    n_cls = num_classes
+
+    thr = jnp.minimum(iou_thresholds, 1 - 1e-10)
+
+    # slot table: pos[i, c, r] = detection slot of class c's rank-r det (or
+    # num_d when that (class, rank) cell is empty)
+    lbl_c = jnp.clip(det_labels, 0, n_cls - 1)
+    in_table = det_participates & (det_rank < max_rank) & (det_labels >= 0) & (det_labels < n_cls)
+    width = n_cls * max_rank
+    flat = jnp.where(in_table, lbl_c * max_rank + jnp.minimum(det_rank, max_rank - 1), width)
+    i_idx = jnp.arange(num_i)[:, None]
+    d_idx = jnp.broadcast_to(jnp.arange(num_d, dtype=jnp.int32)[None, :], (num_i, num_d))
+    pos = jnp.full((num_i, width + 1), num_d, jnp.int32).at[i_idx, flat].set(d_idx)
+    pos = pos[:, :width].reshape(num_i, n_cls, max_rank)
+
+    label_match = (gt_labels[:, None, :] == jnp.arange(n_cls)[None, :, None]) & gt_valid[:, None, :]  # (I,C,G)
+    ig5 = gt_ignore[:, None, None, :, :]  # (I, 1, 1, A, G)
+
+    # pad slot num_d with neutral rows so gathers stay in-bounds
+    iou_pad = jnp.concatenate([iou, jnp.zeros((num_i, 1, num_g), iou.dtype)], axis=1)
+    part_pad = jnp.concatenate([det_participates, jnp.zeros((num_i, 1), bool)], axis=1)
+
+    def step(gt_match, r):
+        slots = pos[:, :, r]  # (I, C)
+        iou_r = jnp.take_along_axis(iou_pad, slots[..., None], axis=1)  # (I, C, G)
+        part_r = jnp.take_along_axis(part_pad, slots, axis=1)  # (I, C)
+
+        avail = (~gt_match) | gt_crowd[:, None, None, :]  # (I, T, A, G)
+        meets = iou_r[:, :, None, :] >= thr[None, None, :, None]  # (I, C, T, G)
+        cand = label_match[:, :, None, None, :] & avail[:, None] & meets[:, :, :, None, :]  # (I,C,T,A,G)
+        cand1 = cand & ~ig5
+        cand2 = cand & ig5
+        vals = jnp.broadcast_to(iou_r[:, :, None, None, :], cand.shape)
+        m1 = _last_argmax(vals, cand1)  # (I, C, T, A)
+        m2 = _last_argmax(vals, cand2)
+        m = jnp.where(jnp.any(cand1, axis=-1), m1, jnp.where(jnp.any(cand2, axis=-1), m2, -1))
+        matched = (m >= 0) & part_r[:, :, None, None]
+
+        m_safe = jnp.maximum(m, 0)
+        gt_ig_at_m = jnp.take_along_axis(
+            jnp.broadcast_to(gt_ignore[:, None, None, :, :], (num_i, n_cls, num_t, num_a, num_g)),
+            m_safe[..., None],
+            axis=-1,
+        )[..., 0]
+        ignored = jnp.where(matched, gt_ig_at_m, False)
+
+        # classes claim disjoint gts, so the per-class hits OR together exactly
+        hit = jax.nn.one_hot(m_safe, num_g, dtype=bool) & matched[..., None]  # (I,C,T,A,G)
+        gt_match = gt_match | jnp.any(hit, axis=1)
+        return gt_match, (matched, ignored)
+
+    init = jnp.zeros((num_i, num_t, num_a, num_g), dtype=bool)
+    _, (matched_r, ignored_r) = jax.lax.scan(step, init, jnp.arange(max_rank))
+    # (R, I, C, T, A) -> per original detection slot via (rank, class) gather
+    rank_c = jnp.minimum(det_rank, max_rank - 1).astype(jnp.int32)
+    matched_out = matched_r[rank_c, i_idx, lbl_c]  # (I, D, T, A)
+    ignored_out = ignored_r[rank_c, i_idx, lbl_c]
+    sel = in_table[..., None, None]
+    matched_out = matched_out & sel
+    # unmatched (or untabled) detections are ignored iff their area is out of
+    # range — identical to the slot-scan path's fallback
+    area_ign = jnp.broadcast_to(det_ignore_area[:, :, None, :], matched_out.shape)
+    ignored_out = jnp.where(matched_out, ignored_out & sel, area_ign)
+    return MatchResult(matched_out, ignored_out)
+
+
 def accumulate(
     matched: Array,  # (I, D, T, A) bool
     ignored: Array,  # (I, D, T, A) bool
@@ -285,7 +379,7 @@ def compute_class_ranks(det_labels: Array, det_valid: Array, num_classes: int) -
 
 @functools.partial(
     jax.jit,
-    static_argnames=("max_dets", "num_classes", "max_class_dets"),
+    static_argnames=("max_dets", "num_classes", "max_class_dets", "max_class_rank"),
 )
 def evaluate_map(
     det_boxes: Array,  # (I, D, 4) xyxy
@@ -306,6 +400,7 @@ def evaluate_map(
     area_ranges: Array = None,  # (A, 2)
     iou_override: Array = None,  # (I, D, G) precomputed (segm mode)
     max_class_dets: int = 0,  # static cap on any class's total det count
+    max_class_rank: int = 0,  # static cap on per-(image, class) det count; >0 enables rank-parallel matching
 ):
     """Full COCO evaluation: sort, IoU, match, accumulate — one jit program."""
     from torchmetrics_tpu.functional.detection._pairwise import pairwise_iou_crowd
@@ -338,17 +433,36 @@ def evaluate_map(
     gt_ignore = jnp.moveaxis(gt_ignore, 2, 1)  # (I, A, G)
 
     participates = det_valid & (rank < int(max_dets[-1]))
-    res = match_detections(
-        iou,
-        det_labels,
-        participates,
-        det_ignore_area,
-        gt_labels,
-        gt_valid,
-        gt_crowd.astype(bool),
-        gt_ignore,
-        iou_thresholds,
-    )
+    # rank-parallel matching trades sequential depth (D -> max_rank) for a
+    # per-step class axis; it only wins when the (C x max_rank) table is no
+    # wider than the slot axis it replaces (few-class workloads)
+    if 0 < max_class_rank and num_classes * max_class_rank <= det_labels.shape[1]:
+        res = match_detections_ranked(
+            iou,
+            det_labels,
+            participates,
+            det_ignore_area,
+            gt_labels,
+            gt_valid,
+            gt_crowd.astype(bool),
+            gt_ignore,
+            iou_thresholds,
+            rank,
+            num_classes,
+            int(max_class_rank),
+        )
+    else:
+        res = match_detections(
+            iou,
+            det_labels,
+            participates,
+            det_ignore_area,
+            gt_labels,
+            gt_valid,
+            gt_crowd.astype(bool),
+            gt_ignore,
+            iou_thresholds,
+        )
     precision, recall, scores = accumulate(
         res.matched,
         res.ignored,
