@@ -230,7 +230,8 @@ class InceptionFeatureExtractor:
             if imgs.dtype == jnp.uint8:
                 imgs = imgs.astype(jnp.float32) / 255.0
             imgs = jnp.transpose(imgs, (0, 2, 3, 1))  # NCHW -> NHWC
-            imgs = jax.image.resize(imgs, (imgs.shape[0], 299, 299, imgs.shape[3]), method="bilinear")
+            if imgs.shape[1:3] != (299, 299):  # identity resize is not free under XLA
+                imgs = jax.image.resize(imgs, (imgs.shape[0], 299, 299, imgs.shape[3]), method="bilinear")
             imgs = imgs * 2.0 - 1.0  # TF inception preprocessing
             return self.net.apply(variables, imgs)[feature].astype(jnp.float32)
 
