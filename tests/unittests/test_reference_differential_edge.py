@@ -1,0 +1,152 @@
+"""Edge-semantics differential sweep vs the reference package.
+
+Covers the behavioral corners the main sweeps skip: aggregation
+nan-strategies, multi-output regression, weighted MeanMetric streaming,
+retrieval empty-target actions, and degenerate inputs.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.reference_oracle import load_reference
+
+torchmetrics = load_reference()
+if torchmetrics is None:
+    pytest.skip("reference checkout unavailable", allow_module_level=True)
+
+import torch  # noqa: E402
+
+import torchmetrics_tpu as tm  # noqa: E402
+
+
+class TestAggregationNanStrategies:
+    VALS = np.asarray([1.0, 2.0, np.nan, 4.0], np.float32)
+
+    @pytest.mark.parametrize("strategy", ["ignore", 0.0, 10.0])
+    def test_mean_metric(self, strategy):
+        # NB: reference float strategies write the replacement through a
+        # 0-stride broadcast of the default scalar weight, so ALL weights
+        # become the replacement (0.0 -> 0/0 = nan); we replicate exactly
+        ours = tm.MeanMetric(nan_strategy=strategy)
+        ref = torchmetrics.aggregation.MeanMetric(nan_strategy=strategy)
+        ours.update(jnp.asarray(self.VALS))
+        # copy: the reference's float strategies mutate the input IN-PLACE
+        # (x[nans] = value on a tensor sharing the numpy buffer)
+        ref.update(torch.as_tensor(self.VALS.copy()))
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-6)
+
+    def test_mean_metric_array_weight_replacement(self):
+        # with an explicit array weight only the masked entries are replaced
+        w = np.asarray([1.0, 1.0, 2.0, 1.0], np.float32)
+        ours = tm.MeanMetric(nan_strategy=3.0)
+        ref = torchmetrics.aggregation.MeanMetric(nan_strategy=3.0)
+        ours.update(jnp.asarray(self.VALS), jnp.asarray(w))
+        ref.update(torch.as_tensor(self.VALS.copy()), torch.as_tensor(w.copy()))
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-6)
+
+    @pytest.mark.parametrize("strategy", ["ignore", 0.0])
+    @pytest.mark.parametrize("cls", ["SumMetric", "MaxMetric", "MinMetric"])
+    def test_other_aggregators(self, cls, strategy):
+        ours = getattr(tm, cls)(nan_strategy=strategy)
+        ref = getattr(torchmetrics.aggregation, cls)(nan_strategy=strategy)
+        ours.update(jnp.asarray(self.VALS))
+        ref.update(torch.as_tensor(self.VALS.copy()))
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-6)
+
+    def test_error_strategy_raises(self):
+        ours = tm.MeanMetric(nan_strategy="error")
+        with pytest.raises(RuntimeError):
+            ours.update(jnp.asarray(self.VALS))
+
+    def test_weighted_mean_streaming(self):
+        ours = tm.MeanMetric()
+        ref = torchmetrics.aggregation.MeanMetric()
+        for i in range(3):
+            r = np.random.default_rng(i)
+            v = r.normal(size=6).astype(np.float32)
+            w = r.uniform(0.1, 2.0, size=6).astype(np.float32)
+            ours.update(jnp.asarray(v), jnp.asarray(w))
+            ref.update(torch.as_tensor(v), torch.as_tensor(w))
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-6)
+
+
+class TestMultioutputRegression:
+    @pytest.mark.parametrize(
+        ("name", "kwargs"),
+        [
+            ("MeanSquaredError", {"num_outputs": 3}),
+            ("PearsonCorrCoef", {"num_outputs": 3}),
+            ("SpearmanCorrCoef", {"num_outputs": 3}),
+            ("ConcordanceCorrCoef", {"num_outputs": 3}),
+            ("KendallRankCorrCoef", {"num_outputs": 3}),
+            ("R2Score", {"num_outputs": 3, "multioutput": "raw_values"}),
+            ("R2Score", {"num_outputs": 3, "multioutput": "variance_weighted"}),
+            ("ExplainedVariance", {"multioutput": "raw_values"}),
+            ("ExplainedVariance", {"multioutput": "variance_weighted"}),
+        ],
+        ids=str,
+    )
+    def test_streaming(self, name, kwargs):
+        ours = getattr(tm, name)(**kwargs)
+        ref = getattr(torchmetrics.regression, name)(**kwargs)
+        for i in range(3):
+            r = np.random.default_rng(40 + i)
+            x = r.normal(size=(16, 3)).astype(np.float32)
+            y = (0.5 * x + 0.5 * r.normal(size=(16, 3))).astype(np.float32)
+            ours.update(jnp.asarray(x), jnp.asarray(y))
+            ref.update(torch.as_tensor(x), torch.as_tensor(y))
+        atol = 1e-3 if name == "ConcordanceCorrCoef" else 1e-5  # fp32 moment accumulation
+        np.testing.assert_allclose(np.asarray(ours.compute()), ref.compute().numpy(), atol=atol)
+
+
+class TestRetrievalEmptyTargets:
+    @pytest.mark.parametrize("action", ["neg", "pos", "skip"])
+    def test_empty_target_action(self, action):
+        ours = tm.RetrievalMAP(empty_target_action=action)
+        ref = torchmetrics.retrieval.RetrievalMAP(empty_target_action=action)
+        # query 0 has no positives; query 1 does
+        idx = np.asarray([0, 0, 0, 1, 1, 1])
+        preds = np.asarray([0.9, 0.5, 0.3, 0.8, 0.4, 0.2], np.float32)
+        target = np.asarray([0, 0, 0, 1, 0, 1])
+        ours.update(jnp.asarray(preds), jnp.asarray(target), indexes=jnp.asarray(idx))
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target), indexes=torch.as_tensor(idx))
+        np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-6)
+
+    def test_error_action_raises(self):
+        ours = tm.RetrievalMAP(empty_target_action="error")
+        ours.update(jnp.asarray([0.9, 0.5]), jnp.asarray([0, 0]), indexes=jnp.asarray([0, 0]))
+        with pytest.raises(Exception):
+            ours.compute()
+
+
+class TestDegenerateInputs:
+    def test_single_sample_metrics(self):
+        p = np.asarray([0.7], np.float32)
+        t = np.asarray([1])
+        for name in ("accuracy", "precision", "recall"):
+            ours = getattr(tm.functional, name)(jnp.asarray(p), jnp.asarray(t), task="binary")
+            ref = getattr(torchmetrics.functional, name)(torch.as_tensor(p), torch.as_tensor(t), task="binary")
+            np.testing.assert_allclose(float(ours), float(ref), err_msg=name)
+
+    def test_all_one_class(self):
+        p = np.asarray([0.9, 0.8, 0.7], np.float32)
+        t = np.asarray([1, 1, 1])
+        ours = tm.functional.accuracy(jnp.asarray(p), jnp.asarray(t), task="binary")
+        ref = torchmetrics.functional.accuracy(torch.as_tensor(p), torch.as_tensor(t), task="binary")
+        np.testing.assert_allclose(float(ours), float(ref))
+
+    def test_perfect_and_inverse_predictions(self):
+        t = np.asarray([0, 1, 0, 1])
+        for p in (np.asarray([0.1, 0.9, 0.2, 0.8], np.float32), np.asarray([0.9, 0.1, 0.8, 0.2], np.float32)):
+            ours = tm.functional.matthews_corrcoef(jnp.asarray(p), jnp.asarray(t), task="binary")
+            ref = torchmetrics.functional.matthews_corrcoef(torch.as_tensor(p), torch.as_tensor(t), task="binary")
+            np.testing.assert_allclose(float(ours), float(ref), atol=1e-6)
+
+    def test_constant_scores_auroc(self):
+        p = np.full(8, 0.5, np.float32)
+        t = np.asarray([0, 1, 0, 1, 0, 1, 0, 1])
+        ours = tm.functional.auroc(jnp.asarray(p), jnp.asarray(t), task="binary")
+        ref = torchmetrics.functional.auroc(torch.as_tensor(p), torch.as_tensor(t), task="binary")
+        np.testing.assert_allclose(float(ours), float(ref), atol=1e-6)

@@ -67,6 +67,7 @@ class BaseAggregator(Metric):
     ) -> Tuple[Array, Array]:
         """Convert input to float arrays and apply the NaN strategy."""
         x = jnp.asarray(x, dtype=jnp.float32) if not hasattr(x, "dtype") else jnp.asarray(x).astype(jnp.float32)
+        weight_was_scalar = weight is None or jnp.ndim(weight) == 0
         if weight is not None:
             weight = jnp.asarray(weight, dtype=jnp.float32)
         else:
@@ -88,7 +89,16 @@ class BaseAggregator(Metric):
                 weight = weight.reshape(-1)[keep]
             else:
                 x = jnp.where(nans, float(self.nan_strategy), x)
-                weight = jnp.where(nans, float(self.nan_strategy), weight)
+                if weight_was_scalar:
+                    # reference parity quirk: it broadcasts the scalar weight
+                    # BEFORE the nan check (aggregation.py:563), so its
+                    # in-place `weight[nans] = value` writes the one underlying
+                    # element through the 0-stride view and EVERY weight
+                    # becomes the replacement value (nan_strategy=0.0 thus
+                    # yields 0/0 = nan from MeanMetric)
+                    weight = jnp.full_like(weight, float(self.nan_strategy))
+                else:
+                    weight = jnp.where(nans, float(self.nan_strategy), weight)
         return x, weight
 
     def update(self, value: Union[float, Array]) -> None:
@@ -190,9 +200,9 @@ class MeanMetric(BaseAggregator):
         self.weight = self.weight + jnp.sum(weight)
 
     def compute(self) -> Array:
-        from torchmetrics_tpu.utilities.compute import _safe_divide
-
-        return _safe_divide(self.value, self.weight)
+        # raw division (reference aggregation.py:573): zero total weight —
+        # e.g. the nan_strategy=0.0 broadcast-replacement quirk — yields nan
+        return self.value / self.weight
 
 
 def _make_running(name: str, base_cls: type, doc: str) -> type:
