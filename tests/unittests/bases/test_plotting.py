@@ -157,3 +157,27 @@ class TestPlotCurves:
         curve = (jnp.linspace(0, 1, 5), jnp.linspace(0, 1, 5), jnp.linspace(1, 0, 5))
         fig, ax = plot_curve(curve, score=jnp.asarray(0.5), label_names=("x", "y"))
         assert "AUC=0.500" in ax.get_legend_handles_labels()[1][0]
+
+
+class TestTrackerPlot:
+    def test_scalar_metric(self):
+        from torchmetrics_tpu import MetricTracker
+        from torchmetrics_tpu.classification import BinaryAccuracy
+
+        tr = MetricTracker(BinaryAccuracy())
+        for ep in ([1, 1], [1, 0], [0, 1]):
+            tr.increment()
+            tr.update(jnp.asarray(ep, jnp.float32), jnp.asarray([1, 1]))
+        fig, ax = tr.plot()
+        assert ax.get_xlabel() == "Step"
+
+    def test_collection(self):
+        from torchmetrics_tpu import MetricCollection, MetricTracker
+        from torchmetrics_tpu.classification import BinaryAccuracy, BinaryF1Score
+
+        tr = MetricTracker(MetricCollection({"a": BinaryAccuracy(), "f": BinaryF1Score()}))
+        for ep in ([1, 1], [1, 0]):
+            tr.increment()
+            tr.update(jnp.asarray(ep, jnp.float32), jnp.asarray([1, 1]))
+        fig, ax = tr.plot()
+        assert len(ax.get_legend_handles_labels()[1]) == 2

@@ -135,6 +135,15 @@ class MetricTracker(WrapperMetric):
         if self._steps:
             self._steps[-1].reset()
 
+    def plot(self, val=None, ax=None):
+        """Plot all tracked steps as a series (reference ``tracker.py:273``)."""
+        from torchmetrics_tpu.utilities.plot import plot_single_or_multi_val
+
+        val = val if val is not None else self.compute_all()
+        if hasattr(val, "ndim") and val.ndim == 1:
+            val = list(val)  # stacked per-step scalars -> step series
+        return plot_single_or_multi_val(val, ax=ax, name=type(self).__name__)
+
     def reset_all(self) -> None:
         """Forget all tracked steps."""
         self._steps = []
