@@ -387,3 +387,50 @@ def test_image_gradients():
     dy_r, dx_r = _ref_fn("image_gradients")(torch.as_tensor(img))
     np.testing.assert_allclose(np.asarray(dy_o), dy_r.numpy(), atol=1e-6)
     np.testing.assert_allclose(np.asarray(dx_o), dx_r.numpy(), atol=1e-6)
+
+
+def test_multiclass_multilabel_operating_points():
+    cases = [
+        ("multiclass_recall_at_fixed_precision", {"num_classes": NC, "min_precision": 0.3}),
+        ("multiclass_precision_at_fixed_recall", {"num_classes": NC, "min_recall": 0.5}),
+        ("multiclass_sensitivity_at_specificity", {"num_classes": NC, "min_specificity": 0.5}),
+        ("multiclass_specificity_at_sensitivity", {"num_classes": NC, "min_sensitivity": 0.5}),
+    ]
+    for name, kwargs in cases:
+        ours = getattr(tm.functional, name)(jnp.asarray(_mcp), jnp.asarray(_mct), **kwargs)
+        ref = _ref_fn(name)(torch.as_tensor(_mcp), torch.as_tensor(_mct), **kwargs)
+        for o, r in zip(ours, ref):
+            np.testing.assert_allclose(np.asarray(o), r.numpy(), atol=1e-5, err_msg=name)
+    ml_cases = [
+        ("multilabel_recall_at_fixed_precision", {"num_labels": NL, "min_precision": 0.3}),
+        ("multilabel_specificity_at_sensitivity", {"num_labels": NL, "min_sensitivity": 0.5}),
+    ]
+    for name, kwargs in ml_cases:
+        ours = getattr(tm.functional, name)(jnp.asarray(_mlp), jnp.asarray(_mlt), **kwargs)
+        ref = _ref_fn(name)(torch.as_tensor(_mlp), torch.as_tensor(_mlt), **kwargs)
+        for o, r in zip(ours, ref):
+            np.testing.assert_allclose(np.asarray(o), r.numpy(), atol=1e-5, err_msg=name)
+
+
+def test_group_fairness():
+    groups = RNG.integers(0, 3, N)
+    ours = tm.functional.binary_fairness(jnp.asarray(_bp), jnp.asarray(_bt), jnp.asarray(groups), task="all")
+    ref = _ref_fn("binary_fairness")(
+        torch.as_tensor(_bp), torch.as_tensor(_bt), torch.as_tensor(groups), task="all"
+    )
+    assert set(ours) == set(ref)
+    for k in ref:
+        np.testing.assert_allclose(np.asarray(ours[k]), ref[k].numpy(), atol=1e-5, err_msg=k)
+
+
+def test_binary_groups_stat_rates():
+    groups = RNG.integers(0, 3, N)
+    ours = tm.functional.binary_groups_stat_rates(
+        jnp.asarray(_bp), jnp.asarray(_bt), jnp.asarray(groups), num_groups=3
+    )
+    ref = _ref_fn("binary_groups_stat_rates")(
+        torch.as_tensor(_bp), torch.as_tensor(_bt), torch.as_tensor(groups), num_groups=3
+    )
+    assert set(ours) == set(ref)
+    for k in ref:
+        np.testing.assert_allclose(np.asarray(ours[k]), ref[k].numpy(), atol=1e-5, err_msg=k)
