@@ -1,0 +1,93 @@
+"""Pallas TPU kernel for large-cardinality confusion matrices.
+
+The default confusion-matrix path materializes two ``(N, C)`` one-hot
+matrices and contracts them on the MXU — ideal for small ``C`` but ``O(N·C)``
+HBM traffic once ``C`` reaches the hundreds (C=1000 at N=1M would stream
+~8 GB of one-hots). This kernel tiles the batch through VMEM instead: each
+grid step builds one ``(TILE, C)`` one-hot pair *on-chip* via iota compares
+and accumulates its ``(C, C)`` outer product into a resident VMEM
+accumulator, so HBM sees only the ``N`` index vectors and one ``(C, C)``
+result. Same MXU contraction, bounded memory.
+
+Used automatically by ``multiclass_confusion_matrix`` for large ``C`` on TPU
+(reference algorithm: ``functional/classification/confusion_matrix.py:333-336``
+fused-index bincount); the einsum path remains the default elsewhere.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+Array = jax.Array
+
+_TILE = 512
+_LANE = 128
+
+
+def _confmat_kernel(p_ref, t_ref, w_ref, o_ref, *, num_classes_padded: int):
+    from jax.experimental import pallas as pl
+
+    i = pl.program_id(0)
+
+    @pl.when(i == 0)
+    def _init():
+        o_ref[:] = jnp.zeros_like(o_ref)
+
+    p = p_ref[:]  # (TILE,) int32
+    t = t_ref[:]
+    w = w_ref[:]  # (TILE,) float32; padded rows carry weight 0
+
+    classes = jax.lax.broadcasted_iota(jnp.int32, (_TILE, num_classes_padded), 1)
+    p_oh = (p[:, None] == classes).astype(jnp.float32)
+    t_oh = (t[:, None] == classes).astype(jnp.float32) * w[:, None]
+    # (C, TILE) x (TILE, C) on the MXU, accumulated in the resident block
+    o_ref[:] += jax.lax.dot_general(
+        p_oh, t_oh, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("num_classes", "interpret"))
+def confusion_matrix_pallas(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    weights: Optional[Array] = None,
+    interpret: bool = False,
+) -> Array:
+    """``(C, C)`` count matrix with rows=target, cols=preds.
+
+    ``weights`` (default ones) folds per-sample validity/weighting; padded
+    tail rows are zero-weighted so any ``N`` works.
+    """
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    preds = jnp.ravel(preds).astype(jnp.int32)
+    target = jnp.ravel(target).astype(jnp.int32)
+    n = preds.shape[0]
+    w = jnp.ones((n,), jnp.float32) if weights is None else jnp.ravel(weights).astype(jnp.float32)
+
+    c_pad = max(_LANE, -(-num_classes // _LANE) * _LANE)
+    g = max(1, -(-n // _TILE))
+    pad = g * _TILE - n
+    preds = jnp.pad(preds, (0, pad), constant_values=c_pad - 1)
+    target = jnp.pad(target, (0, pad), constant_values=c_pad - 1)
+    w = jnp.pad(w, (0, pad))
+
+    out = pl.pallas_call(
+        functools.partial(_confmat_kernel, num_classes_padded=c_pad),
+        grid=(g,),
+        in_specs=[
+            pl.BlockSpec((_TILE,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE,), lambda i: (i,), memory_space=pltpu.VMEM),
+            pl.BlockSpec((_TILE,), lambda i: (i,), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((c_pad, c_pad), lambda i: (0, 0), memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((c_pad, c_pad), jnp.float32),
+        interpret=interpret,
+    )(target, preds, w)  # rows=target, cols=preds like the einsum path
+    return out[:num_classes, :num_classes]

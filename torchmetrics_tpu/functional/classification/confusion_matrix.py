@@ -90,8 +90,40 @@ def _binary_confusion_matrix_format(
     return preds, target, valid
 
 
+_PALLAS_MIN_CLASSES = 256  # below this the one-hot einsum is at least as fast
+_PALLAS_OK = [None]  # probed once: does Mosaic compile on this backend?
+
+
+def _pallas_available() -> bool:
+    if _PALLAS_OK[0] is None:
+        try:
+            from torchmetrics_tpu.functional.classification._pallas_confmat import confusion_matrix_pallas
+
+            with jax.ensure_compile_time_eval():  # probe eagerly even mid-trace
+                out = confusion_matrix_pallas(
+                    jnp.zeros((8,), jnp.int32), jnp.zeros((8,), jnp.int32), _PALLAS_MIN_CLASSES
+                )
+                _PALLAS_OK[0] = bool(out[0, 0] == 8)
+        except Exception:  # lowering/compile unsupported on this backend
+            _PALLAS_OK[0] = False
+    return _PALLAS_OK[0]
+
+
 def _confusion_matrix_update(preds: Array, target: Array, valid: Array, num_classes: int) -> Array:
-    """One-hot einsum confusion matrix: rows=true class, cols=pred class."""
+    """Confusion-matrix counts: rows=true class, cols=pred class.
+
+    Small ``C``: one-hot einsum (a single MXU contraction). Large ``C`` on
+    backends with working Mosaic lowering: the Pallas tiled-histogram kernel
+    (``_pallas_confmat.py``) that never materializes the ``(N, C)`` one-hots
+    in HBM.
+    """
+    if num_classes >= _PALLAS_MIN_CLASSES and _pallas_available():
+        from torchmetrics_tpu.functional.classification._pallas_confmat import confusion_matrix_pallas
+
+        out = confusion_matrix_pallas(
+            jnp.ravel(preds), jnp.ravel(target), num_classes, weights=jnp.ravel(valid).astype(jnp.float32)
+        )
+        return out.astype(jnp.int32)
     t_oh = jax.nn.one_hot(target, num_classes, dtype=jnp.float32) * valid[..., None]
     p_oh = jax.nn.one_hot(preds, num_classes, dtype=jnp.float32)
     return jnp.einsum("nc,nd->cd", t_oh, p_oh).astype(jnp.int32)
