@@ -337,3 +337,52 @@ def test_streaming_text_classes():
             ours.update(preds, target)
             ref.update(preds, target)
         np.testing.assert_allclose(float(ours.compute()), float(ref.compute()), atol=1e-5, err_msg=ours_name)
+
+
+def test_collection_prefix_postfix_clone():
+    ours = tm.MetricCollection(
+        {"acc": tm.MulticlassAccuracy(num_classes=NC)}, prefix="train_", postfix="_v1"
+    )
+    ref = torchmetrics.MetricCollection(
+        {"acc": torchmetrics.classification.MulticlassAccuracy(num_classes=NC)}, prefix="train_", postfix="_v1"
+    )
+    for preds, target in _stream_multiclass():
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target))
+    o, r = ours.compute(), ref.compute()
+    assert set(o) == set(r) == {"train_acc_v1"}
+    np.testing.assert_allclose(float(o["train_acc_v1"]), float(r["train_acc_v1"]), atol=1e-6)
+
+    o2 = ours.clone(prefix="val_")
+    r2 = ref.clone(prefix="val_")
+    assert set(o2.compute()) == set(r2.compute()) == {"val_acc_v1"}
+
+
+def test_collection_add_metrics():
+    ours = tm.MetricCollection([tm.MulticlassAccuracy(num_classes=NC)])
+    ref = torchmetrics.MetricCollection([torchmetrics.classification.MulticlassAccuracy(num_classes=NC)])
+    ours.add_metrics({"f1": tm.MulticlassF1Score(num_classes=NC)})
+    ref.add_metrics({"f1": torchmetrics.classification.MulticlassF1Score(num_classes=NC)})
+    for preds, target in _stream_multiclass():
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+        ref.update(torch.as_tensor(preds), torch.as_tensor(target))
+    o, r = ours.compute(), ref.compute()
+    assert set(o) == set(r)
+    for k in r:
+        np.testing.assert_allclose(float(o[k]), float(r[k]), atol=1e-6, err_msg=k)
+
+
+def test_bootstrapper_structure():
+    # RNG streams differ across frameworks, so compare the statistical
+    # structure: mean/std keys, shapes, and mean within a sane band
+    ours = tm.BootStrapper(tm.BinaryAccuracy(), num_bootstraps=20, mean=True, std=True)
+    for preds, target in _stream_binary():
+        ours.update(jnp.asarray(preds), jnp.asarray(target))
+    out = ours.compute()
+    assert set(out) == {"mean", "std"}
+    base = tm.BinaryAccuracy()
+    for preds, target in _stream_binary():
+        base.update(jnp.asarray(preds), jnp.asarray(target))
+    point = float(base.compute())
+    assert abs(float(out["mean"]) - point) < 0.15
+    assert 0.0 <= float(out["std"]) < 0.3
