@@ -1,0 +1,93 @@
+"""Legacy Dice API (mdmc_average / top_k / multiclass) vs the reference oracle.
+
+The reference's `dice` routes through its legacy input-formatting pipeline
+(`utilities/checks.py:315-456`, `functional/classification/stat_scores.py:861-996`);
+these tests pin our re-implementation to it across every input case.
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.reference_oracle import load_reference
+
+torchmetrics = load_reference()
+if torchmetrics is None:
+    pytest.skip("reference checkout unavailable", allow_module_level=True)
+
+import torch  # noqa: E402
+
+from torchmetrics.functional.classification import dice as ref_dice  # noqa: E402
+
+from torchmetrics_tpu.classification import Dice  # noqa: E402
+from torchmetrics_tpu.functional.classification import dice  # noqa: E402
+
+RNG = np.random.default_rng(5)
+N, C, X = 20, 4, 6
+
+CASES = {
+    "binary_prob": (RNG.random(N).astype(np.float32), RNG.integers(0, 2, N)),
+    "binary_label": (RNG.integers(0, 2, N), RNG.integers(0, 2, N)),
+    "mc_label": (RNG.integers(0, C, N), RNG.integers(0, C, N)),
+    "mc_prob": (RNG.random((N, C)).astype(np.float32), RNG.integers(0, C, N)),
+    "ml_prob": (RNG.random((N, C)).astype(np.float32), RNG.integers(0, 2, (N, C))),
+    "mdmc_label": (RNG.integers(0, C, (N, X)), RNG.integers(0, C, (N, X))),
+    "mdmc_prob": (RNG.random((N, C, X)).astype(np.float32), RNG.integers(0, C, (N, X))),
+}
+
+
+def _num_classes(cname, average):
+    return C if average != "micro" or cname.startswith(("mc", "mdmc")) else None
+
+
+@pytest.mark.parametrize("cname", list(CASES))
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none", "samples"])
+@pytest.mark.parametrize("mdmc", ["global", "samplewise"])
+def test_dice_functional_matrix(cname, average, mdmc):
+    p, t = CASES[cname]
+    for top_k in (None, 2):
+        for ignore_index in (None, 1):
+            kw = dict(
+                average=average,
+                mdmc_average=mdmc,
+                top_k=top_k,
+                ignore_index=ignore_index,
+                num_classes=_num_classes(cname, average),
+            )
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                try:
+                    expected = ref_dice(torch.as_tensor(p), torch.as_tensor(t), **kw).numpy()
+                except Exception:
+                    with pytest.raises(Exception):
+                        np.asarray(dice(jnp.asarray(p), jnp.asarray(t), **kw))
+                    continue
+            got = np.asarray(dice(jnp.asarray(p), jnp.asarray(t), **kw))
+            np.testing.assert_allclose(got, expected, atol=1e-5, err_msg=str(kw))
+
+
+@pytest.mark.parametrize("cname", ["mc_label", "mc_prob", "mdmc_label", "mdmc_prob"])
+@pytest.mark.parametrize("average", ["micro", "macro"])
+def test_dice_modular_streaming(cname, average):
+    p, t = CASES[cname]
+    kw = dict(average=average, mdmc_average="global", num_classes=C)
+    rm_cls = torchmetrics.classification.Dice(**kw)
+    ours = Dice(**kw)
+    for s in (slice(0, 10), slice(10, 20)):
+        rm_cls.update(torch.as_tensor(p[s]), torch.as_tensor(t[s]))
+        ours.update(jnp.asarray(p[s]), jnp.asarray(t[s]))
+    np.testing.assert_allclose(np.asarray(ours.compute()), rm_cls.compute().numpy(), atol=1e-5)
+
+
+def test_dice_modular_samplewise():
+    p, t = CASES["mdmc_label"]
+    kw = dict(average="macro", mdmc_average="samplewise", num_classes=C)
+    rm_cls = torchmetrics.classification.Dice(**kw)
+    ours = Dice(**kw)
+    for s in (slice(0, 10), slice(10, 20)):
+        rm_cls.update(torch.as_tensor(p[s]), torch.as_tensor(t[s]))
+        ours.update(jnp.asarray(p[s]), jnp.asarray(t[s]))
+    np.testing.assert_allclose(np.asarray(ours.compute()), rm_cls.compute().numpy(), atol=1e-5)

@@ -181,3 +181,45 @@ def test_prc_empty_target_action():
     m2.update(p, t, idx)
     prec, rec, ks = m2.compute()
     assert np.allclose(np.asarray(prec), [1.0, 0.5])  # only query 0 counted
+
+
+def test_auroc_max_fpr_vs_sklearn():
+    rng = np.random.default_rng(7)
+    p = rng.random(50).astype(np.float32)
+    t = rng.integers(0, 2, 50)
+    for mf in (0.25, 0.5, 0.9):
+        ours = float(FR.retrieval_auroc(jnp.asarray(p), jnp.asarray(t), max_fpr=mf))
+        ref = float(roc_auc_score(t, p, max_fpr=mf))
+        assert np.allclose(ours, ref, atol=1e-5), (mf, ours, ref)
+
+
+def test_auroc_max_fpr_with_ties():
+    rng = np.random.default_rng(8)
+    p = np.round(rng.random(40), 1).astype(np.float32)
+    t = rng.integers(0, 2, 40)
+    for mf in (0.3, 0.7):
+        ours = float(FR.retrieval_auroc(jnp.asarray(p), jnp.asarray(t), max_fpr=mf))
+        ref = float(roc_auc_score(t, p, max_fpr=mf))
+        assert np.allclose(ours, ref, atol=1e-5), (mf, ours, ref)
+
+
+def test_aggregation_kwarg():
+    rng = np.random.default_rng(9)
+    idx = jnp.asarray(rng.integers(0, 5, 64))
+    p = jnp.asarray(rng.random(64).astype(np.float32))
+    t = jnp.asarray(rng.integers(0, 2, 64))
+    per_query = None
+    # median: torch picks the lower middle value for even counts, not the mean
+    lower_median = lambda v: np.sort(v)[(v.size - 1) // 2]
+    for agg, np_red in (("mean", np.mean), ("median", lower_median), ("min", np.min), ("max", np.max)):
+        m = RetrievalMAP(aggregation=agg)
+        m.update(p, t, idx)
+        val = float(m.compute())
+        if per_query is None:
+            # recover per-query values through a callable aggregation
+            mq = RetrievalMAP(aggregation=lambda v, dim: v)
+            mq.update(p, t, idx)
+            per_query = np.asarray(mq.compute())
+        assert np.allclose(val, np_red(per_query), atol=1e-6), agg
+    with pytest.raises(ValueError):
+        RetrievalMAP(aggregation="bogus")

@@ -1,4 +1,4 @@
-"""Modular Dice score (reference ``classification/dice.py``) — stat-scores state."""
+"""Modular Dice score (reference ``classification/dice.py``) — legacy stat-scores state."""
 
 from __future__ import annotations
 
@@ -7,20 +7,23 @@ from typing import Any, Optional
 import jax
 import jax.numpy as jnp
 
-from torchmetrics_tpu.functional.classification.dice import _dice_compute
-from torchmetrics_tpu.functional.classification.stat_scores import (
-    _binary_stat_scores_format,
-    _binary_stat_scores_update,
-    _multiclass_stat_scores_format,
-    _multiclass_stat_scores_update,
+from torchmetrics_tpu.functional.classification.dice import (
+    _dice_compute,
+    _legacy_stat_scores_update,
 )
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.data import dim_zero_cat
 
 Array = jax.Array
 
 
 class Dice(Metric):
     """Dice score: ``2·tp / (2·tp + fp + fn)``.
+
+    Mirrors the reference's legacy-API class (``classification/dice.py:146-253``):
+    ``average`` must be micro/macro/samples, ``mdmc_average`` picks how
+    multi-dim multi-class inputs are folded, and the state is a sum-reduced
+    stat-scores tensor (or cat lists for samplewise modes).
 
     Example:
         >>> import jax.numpy as jnp
@@ -43,36 +46,79 @@ class Dice(Metric):
         num_classes: Optional[int] = None,
         threshold: float = 0.5,
         average: Optional[str] = "micro",
+        mdmc_average: Optional[str] = "global",
         ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        multiclass: Optional[bool] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+        allowed_average = ("micro", "macro", "samples", "none", None)
         if average not in allowed_average:
             raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+        if average not in ("micro", "macro", "samples"):
+            raise ValueError(f"The `reduce` {average} is not valid.")
+        if mdmc_average not in (None, "samplewise", "global"):
+            raise ValueError(f"The `mdmc_reduce` {mdmc_average} is not valid.")
+        if average == "macro" and (not num_classes or num_classes < 1):
+            raise ValueError("When you set `average` as 'macro', you have to provide the number of classes.")
+        if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
+            raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+        if top_k is not None and (not isinstance(top_k, int) or top_k <= 0):
+            raise ValueError(f"The `top_k` should be an integer larger than 0, got {top_k}")
+
+        self.reduce = average
+        self.mdmc_reduce = mdmc_average
         self.zero_division = zero_division
         self.num_classes = num_classes
         self.threshold = threshold
         self.average = average
         self.ignore_index = ignore_index
-        n = num_classes if num_classes is not None else 1
-        self.add_state("tp", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
-        self.add_state("fp", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
-        self.add_state("fn", jnp.zeros(n, dtype=jnp.int32), dist_reduce_fx="sum")
+        self.top_k = top_k
+        self.multiclass = multiclass
+
+        self._streaming = mdmc_average != "samplewise" and average != "samples"
+        if self._streaming:
+            shape = () if average == "micro" else (num_classes,)
+            for s in ("tp", "fp", "tn", "fn"):
+                self.add_state(s, jnp.zeros(shape, dtype=jnp.int32), dist_reduce_fx="sum")
+        else:
+            for s in ("tp", "fp", "tn", "fn"):
+                self.add_state(s, default=[], dist_reduce_fx="cat")
 
     def update(self, preds: Array, target: Array) -> None:
-        if self.num_classes is None:
-            p, t, valid = _binary_stat_scores_format(preds, target, self.threshold, self.ignore_index)
-            tp, fp, tn, fn = _binary_stat_scores_update(p, t, valid)
-            tp, fp, fn = tp[None], fp[None], fn[None]
+        tp, fp, tn, fn = _legacy_stat_scores_update(
+            preds,
+            target,
+            reduce=self.reduce,
+            mdmc_reduce=self.mdmc_reduce,
+            threshold=self.threshold,
+            num_classes=self.num_classes,
+            top_k=self.top_k,
+            multiclass=self.multiclass,
+            ignore_index=self.ignore_index,
+        )
+        if self._streaming:
+            self.tp = self.tp + tp
+            self.fp = self.fp + fp
+            self.tn = self.tn + tn
+            self.fn = self.fn + fn
         else:
-            p, t = _multiclass_stat_scores_format(preds, target)
-            tp, fp, tn, fn = _multiclass_stat_scores_update(
-                p, t, self.num_classes, 1, "global", self.ignore_index
-            )
-        self.tp = self.tp + tp
-        self.fp = self.fp + fp
-        self.fn = self.fn + fn
+            self.tp.append(jnp.atleast_1d(tp))
+            self.fp.append(jnp.atleast_1d(fp))
+            self.tn.append(jnp.atleast_1d(tn))
+            self.fn.append(jnp.atleast_1d(fn))
+
+    def _get_final_stats(self):
+        if self._streaming:
+            return self.tp, self.fp, self.tn, self.fn
+        return (
+            dim_zero_cat(self.tp),
+            dim_zero_cat(self.fp),
+            dim_zero_cat(self.tn),
+            dim_zero_cat(self.fn),
+        )
 
     def compute(self) -> Array:
-        return _dice_compute(self.tp, self.fp, self.fn, self.average, self.zero_division)
+        tp, fp, tn, fn = self._get_final_stats()
+        return _dice_compute(tp, fp, fn, self.average, self.mdmc_reduce, self.zero_division)

@@ -1,24 +1,303 @@
 """Dice score (reference ``functional/classification/dice.py``).
 
-Dice = 2·tp / (2·tp + fp + fn), built on the stat-scores state.
+Dice = 2·tp / (2·tp + fp + fn), computed over the reference's *legacy*
+classification pipeline: case detection (`utilities/checks.py:75-128`),
+legacy input formatting to binary ``(N, C[, X])`` tensors
+(`utilities/checks.py:315-456`), legacy stat scores with
+``reduce``/``mdmc_reduce`` (`functional/classification/stat_scores.py:861-996`)
+and ``_reduce_stat_scores`` (`:1021-1074`). Host-side control flow picks the
+case; all tensor math is jnp.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
-from torchmetrics_tpu.functional.classification.stat_scores import (
-    _binary_stat_scores_format,
-    _binary_stat_scores_update,
-    _multiclass_stat_scores_format,
-    _multiclass_stat_scores_update,
-)
-from torchmetrics_tpu.utilities.compute import _safe_divide
+from torchmetrics_tpu.utilities.data import select_topk, to_onehot
 
 Array = jax.Array
+
+_MC_CASES = ("multi-class", "multi-dim multi-class")
+
+
+def _input_squeeze(preds: Array, target: Array) -> Tuple[Array, Array]:
+    """Remove excess size-1 dims, keeping the batch dim (ref ``checks.py:303-312``)."""
+    if preds.shape[0] == 1:
+        return jnp.squeeze(preds)[None], jnp.squeeze(target)[None]
+    return jnp.squeeze(preds), jnp.squeeze(target)
+
+
+def _legacy_case(preds: Array, target: Array) -> str:
+    preds_float = jnp.issubdtype(preds.dtype, jnp.floating)
+    if preds.ndim == target.ndim:
+        if preds.shape != target.shape:
+            raise ValueError("The `preds` and `target` should have the same shape")
+        if preds_float and target.size and int(jnp.max(target)) > 1:
+            raise ValueError(
+                "If `preds` and `target` are of shape (N, ...) and `preds` are floats, `target` should be binary."
+            )
+        if preds.ndim == 1:
+            return "binary" if preds_float else "multi-class"
+        return "multi-label" if preds_float else "multi-dim multi-class"
+    if preds.ndim == target.ndim + 1:
+        if not preds_float:
+            raise ValueError("If `preds` have one dimension more than `target`, `preds` should be a float tensor.")
+        if preds.shape[2:] != target.shape[1:]:
+            raise ValueError(
+                "If `preds` have one dimension more than `target`, the shape of `preds` should be"
+                " (N, C, ...), and the shape of `target` should be (N, ...)."
+            )
+        return "multi-class" if preds.ndim == 2 else "multi-dim multi-class"
+    raise ValueError(
+        "Either `preds` and `target` both should have the (same) shape (N, ...), or `target` should be (N, ...)"
+        " and `preds` should be (N, C, ...)."
+    )
+
+
+def _check_legacy_inputs(
+    preds: Array,
+    target: Array,
+    threshold: float,
+    num_classes: Optional[int],
+    multiclass: Optional[bool],
+    top_k: Optional[int],
+    ignore_index: Optional[int],
+    case: str,
+) -> None:
+    """Legacy input consistency checks (ref ``checks.py:47-300``), host-side."""
+    if not (preds.size and target.size):
+        return
+    preds_float = jnp.issubdtype(preds.dtype, jnp.floating)
+    tmax = int(jnp.max(target))
+    # basic validation (ref ``checks.py:47-72``)
+    if jnp.issubdtype(target.dtype, jnp.floating):
+        raise ValueError("The `target` has to be an integer tensor.")
+    tmin = int(jnp.min(target))
+    if (ignore_index is None and tmin < 0) or (ignore_index is not None and ignore_index >= 0 and tmin < 0):
+        raise ValueError("The `target` has to be a non-negative tensor.")
+    if not preds_float and int(jnp.min(preds)) < 0:
+        raise ValueError("If `preds` are integers, they have to be non-negative.")
+    if preds.shape[0] != target.shape[0]:
+        raise ValueError("The `preds` and `target` should have the same first dimension.")
+    if multiclass is False and tmax > 1:
+        raise ValueError("If you set `multiclass=False`, then `target` should not exceed 1.")
+    if multiclass is False and not preds_float and int(jnp.max(preds)) > 1:
+        raise ValueError("If you set `multiclass=False` and `preds` are integers, then `preds` should not exceed 1.")
+
+    implied_classes = (int(np.prod(preds.shape[1:])) if preds.ndim > 1 else 1) if preds.shape == target.shape else (
+        preds.shape[1] if preds.ndim > 1 else 0
+    )
+    # C-dimension consistency (ref ``checks.py:277-288``)
+    if preds.shape != target.shape:
+        if multiclass is False and implied_classes != 2:
+            raise ValueError(
+                "You have set `multiclass=False`, but have more than 2 classes in your data,"
+                " based on the C dimension of `preds`."
+            )
+        if tmax >= implied_classes:
+            raise ValueError(
+                "The highest label in `target` should be smaller than the size of the `C` dimension of `preds`."
+            )
+    # num_classes consistency (ref ``checks.py:131-186,290-294``)
+    if num_classes:
+        if case == "binary":
+            if num_classes > 2:
+                raise ValueError("Your data is binary, but `num_classes` is larger than 2.")
+            if num_classes == 2 and not multiclass:
+                raise ValueError(
+                    "Your data is binary and `num_classes=2`, but `multiclass` is not True."
+                )
+            if num_classes == 1 and multiclass:
+                raise ValueError(
+                    "You have binary data and have set `multiclass=True`, but `num_classes` is 1."
+                )
+        elif case in _MC_CASES:
+            if num_classes == 1 and multiclass is not False:
+                raise ValueError(
+                    "You have set `num_classes=1`, but predictions are integers."
+                )
+            if num_classes > 1:
+                if multiclass is False and implied_classes != num_classes:
+                    raise ValueError(
+                        "You have set `multiclass=False`, but the implied number of classes "
+                        " (from shape of inputs) does not match `num_classes`."
+                    )
+                if num_classes <= tmax:
+                    raise ValueError("The highest label in `target` should be smaller than `num_classes`.")
+                if preds.shape != target.shape and num_classes != implied_classes:
+                    raise ValueError("The size of C dimension of `preds` does not match `num_classes`.")
+        elif case == "multi-label":
+            if multiclass and num_classes != 2:
+                raise ValueError(
+                    "Your have set `multiclass=True`, but `num_classes` is not equal to 2."
+                )
+            if not multiclass and num_classes != implied_classes:
+                raise ValueError("The implied number of classes (from shape of inputs) does not match num_classes.")
+    # top_k consistency (ref ``checks.py:189-204``)
+    if top_k is not None:
+        if case == "binary":
+            raise ValueError("You can not use `top_k` parameter with binary data.")
+        if not isinstance(top_k, int) or top_k <= 0:
+            raise ValueError("The `top_k` has to be an integer larger than 0.")
+        if not preds_float:
+            raise ValueError("You have set `top_k`, but you do not have probability predictions.")
+        if multiclass is False:
+            raise ValueError("If you set `multiclass=False`, you can not set `top_k`.")
+        if case == "multi-label" and multiclass:
+            raise ValueError(
+                "If you want to transform multi-label data to 2 class multi-dimensional"
+                "multi-class data using `multiclass=True`, you can not use `top_k`."
+            )
+        if top_k >= implied_classes:
+            raise ValueError("The `top_k` has to be strictly smaller than the `C` dimension of `preds`.")
+
+
+def _legacy_input_format(
+    preds: Array,
+    target: Array,
+    threshold: float = 0.5,
+    top_k: Optional[int] = None,
+    num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, str]:
+    """Legacy formatter → binary ``(N, C)`` or ``(N, C, X)`` tensors (ref ``checks.py:315-456``)."""
+    preds, target = _input_squeeze(jnp.asarray(preds), jnp.asarray(target))
+    case = _legacy_case(preds, target)
+    _check_legacy_inputs(preds, target, threshold, num_classes, multiclass, top_k, ignore_index, case)
+
+    if case in ("binary", "multi-label") and not top_k:
+        preds = (preds >= threshold).astype(jnp.int32)
+        num_classes = num_classes if not multiclass else 2
+    if case == "multi-label" and top_k:
+        preds = select_topk(preds, top_k)
+
+    if case in _MC_CASES or multiclass:
+        if jnp.issubdtype(preds.dtype, jnp.floating):
+            num_classes = preds.shape[1]
+            preds = select_topk(preds, top_k or 1)
+        else:
+            if num_classes is None:
+                num_classes = int(max(int(jnp.max(preds)), int(jnp.max(target)))) + 1
+            preds = to_onehot(preds, max(2, num_classes))
+        target = to_onehot(target, max(2, num_classes or 2))
+        if multiclass is False:
+            preds, target = preds[:, 1, ...], target[:, 1, ...]
+
+    if preds.size and target.size:
+        if (case in _MC_CASES and multiclass is not False) or multiclass:
+            target = target.reshape(target.shape[0], target.shape[1], -1)
+            preds = preds.reshape(preds.shape[0], preds.shape[1], -1)
+        else:
+            target = target.reshape(target.shape[0], -1)
+            preds = preds.reshape(preds.shape[0], -1)
+
+    if preds.ndim > 2 and preds.shape[-1] == 1:
+        preds, target = preds.squeeze(-1), target.squeeze(-1)
+    return preds.astype(jnp.int32), target.astype(jnp.int32), case
+
+
+def _legacy_stat_scores(preds: Array, target: Array, reduce: str) -> Tuple[Array, Array, Array, Array]:
+    """tp/fp/tn/fn sums over the reduce-specific axes (ref ``stat_scores.py:861-906``)."""
+    if reduce == "micro":
+        dim = (0, 1) if preds.ndim == 2 else (1, 2)
+    elif reduce == "macro":
+        dim = 0 if preds.ndim == 2 else 2
+    else:  # "samples"
+        dim = 1
+    true_pred, false_pred = target == preds, target != preds
+    pos_pred, neg_pred = preds == 1, preds == 0
+    tp = (true_pred & pos_pred).sum(axis=dim)
+    fp = (false_pred & pos_pred).sum(axis=dim)
+    tn = (true_pred & neg_pred).sum(axis=dim)
+    fn = (false_pred & neg_pred).sum(axis=dim)
+    return tp.astype(jnp.int32), fp.astype(jnp.int32), tn.astype(jnp.int32), fn.astype(jnp.int32)
+
+
+def _del_column(t: Array, idx: int) -> Array:
+    return jnp.concatenate([t[:, :idx], t[:, idx + 1 :]], axis=1)
+
+
+def _legacy_stat_scores_update(
+    preds: Array,
+    target: Array,
+    reduce: str = "micro",
+    mdmc_reduce: Optional[str] = None,
+    num_classes: Optional[int] = None,
+    top_k: Optional[int] = None,
+    threshold: float = 0.5,
+    multiclass: Optional[bool] = None,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Array, Array, Array, Array]:
+    """Legacy update (ref ``stat_scores.py:909-996``): format → mdmc flatten → ignore_index → sums."""
+    preds, target, _case = _legacy_input_format(
+        preds,
+        target,
+        threshold=threshold,
+        top_k=top_k,
+        num_classes=num_classes,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    if ignore_index is not None and ignore_index >= preds.shape[1]:
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {preds.shape[1]} classes")
+    if ignore_index is not None and preds.shape[1] == 1:
+        raise ValueError("You can not use `ignore_index` with binary data.")
+
+    if preds.ndim == 3:
+        if not mdmc_reduce:
+            raise ValueError(
+                "When your inputs are multi-dimensional multi-class, you have to set the `mdmc_reduce` parameter"
+            )
+        if mdmc_reduce == "global":
+            preds = jnp.swapaxes(preds, 1, 2).reshape(-1, preds.shape[1])
+            target = jnp.swapaxes(target, 1, 2).reshape(-1, target.shape[1])
+
+    if ignore_index is not None and reduce != "macro":
+        preds = _del_column(preds, ignore_index)
+        target = _del_column(target, ignore_index)
+
+    tp, fp, tn, fn = _legacy_stat_scores(preds, target, reduce=reduce)
+
+    if ignore_index is not None and reduce == "macro":
+        tp = tp.at[..., ignore_index].set(-1)
+        fp = fp.at[..., ignore_index].set(-1)
+        tn = tn.at[..., ignore_index].set(-1)
+        fn = fn.at[..., ignore_index].set(-1)
+    return tp, fp, tn, fn
+
+
+def _reduce_stat_scores(
+    numerator: Array,
+    denominator: Array,
+    weights: Optional[Array],
+    average: Optional[str],
+    mdmc_average: Optional[str],
+    zero_division: float = 0.0,
+) -> Array:
+    """Score reduction with zero-division and negative-denominator masking (ref ``:1021-1074``)."""
+    numerator = numerator.astype(jnp.float32)
+    denominator = denominator.astype(jnp.float32)
+    zero_div_mask = denominator == 0
+    ignore_mask = denominator < 0
+    weights = jnp.ones_like(denominator) if weights is None else weights.astype(jnp.float32)
+    numerator = jnp.where(zero_div_mask, float(zero_division), numerator)
+    denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
+    weights = jnp.where(ignore_mask, 0.0, weights)
+    if average not in ("micro", "none", None):
+        weights = weights / weights.sum(axis=-1, keepdims=True)
+    scores = weights * (numerator / denominator)
+    scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
+    if mdmc_average == "samplewise" and scores.ndim > 0:
+        scores = scores.mean(axis=0)
+        ignore_mask = ignore_mask.sum(axis=0).astype(bool)
+    if average in ("none", None):
+        return jnp.where(ignore_mask, jnp.nan, scores)
+    return scores.sum()
 
 
 def _dice_compute(
@@ -26,21 +305,30 @@ def _dice_compute(
     fp: Array,
     fn: Array,
     average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = None,
     zero_division: float = 0.0,
 ) -> Array:
-    if average == "micro":
-        tp = tp.sum()
-        fp = fp.sum()
-        fn = fn.sum()
     numerator = 2 * tp
     denominator = 2 * tp + fp + fn
-    dice = _safe_divide(numerator, denominator, zero_division)
-    if average == "macro":
-        return dice.mean()
-    if average == "weighted":
-        weights = tp + fn
-        return jnp.sum(_safe_divide(weights, weights.sum()) * dice)
-    return dice
+    if average == "macro" and mdmc_average != "samplewise":
+        # absent classes (no tp/fp/fn) are dropped from the macro mean; the
+        # negative-denominator ignore mask realises the reference's boolean
+        # indexing with a fixed shape
+        cond = (tp + fp + fn == 0) | (tp < 0)
+        numerator = jnp.where(cond, -1, numerator)
+        denominator = jnp.where(cond, -1, denominator)
+    if average in ("none", None) and mdmc_average != "samplewise":
+        cond = ((tp | fn | fp) == 0) | (tp < 0)
+        numerator = jnp.where(cond, -1, numerator)
+        denominator = jnp.where(cond, -1, denominator)
+    return _reduce_stat_scores(
+        numerator=numerator,
+        denominator=denominator,
+        weights=None if average != "weighted" else tp + fn,
+        average=average,
+        mdmc_average=mdmc_average,
+        zero_division=zero_division,
+    )
 
 
 def dice(
@@ -48,11 +336,14 @@ def dice(
     target: Array,
     zero_division: float = 0.0,
     average: Optional[str] = "micro",
+    mdmc_average: Optional[str] = "global",
     threshold: float = 0.5,
+    top_k: Optional[int] = None,
     num_classes: Optional[int] = None,
+    multiclass: Optional[bool] = None,
     ignore_index: Optional[int] = None,
 ) -> Array:
-    """Dice score.
+    """Dice score (legacy task-inferring API, ref ``functional/classification/dice.py:67-209``).
 
     Example:
         >>> import jax.numpy as jnp
@@ -62,14 +353,30 @@ def dice(
         >>> dice(preds, target, average='micro')
         Array(0.25, dtype=float32)
     """
-    preds = jnp.asarray(preds)
-    target = jnp.asarray(target)
-    if num_classes is None and (preds.ndim > target.ndim or (jnp.issubdtype(preds.dtype, jnp.integer) and bool(jnp.max(preds) > 1))):
-        num_classes = int(max(int(jnp.max(preds)) if preds.ndim == target.ndim else preds.shape[1], int(jnp.max(target)))) + 1
-    if num_classes is None or num_classes == 2 and preds.shape == target.shape and not bool(jnp.max(target) > 1):
-        p, t, valid = _binary_stat_scores_format(preds, target, threshold, ignore_index)
-        tp, fp, tn, fn = _binary_stat_scores_update(p, t, valid)
-    else:
-        p, t = _multiclass_stat_scores_format(preds, target)
-        tp, fp, tn, fn = _multiclass_stat_scores_update(p, t, num_classes, 1, "global", ignore_index)
-    return _dice_compute(tp, fp, fn, average)
+    allowed_average = ("micro", "macro", "weighted", "samples", "none", None)
+    if average not in allowed_average:
+        raise ValueError(f"The `average` has to be one of {allowed_average}, got {average}.")
+    if average in ("macro", "weighted", "none", None) and (not num_classes or num_classes < 1):
+        raise ValueError(f"When you set `average` as {average}, you have to provide the number of classes.")
+    allowed_mdmc_average = (None, "samplewise", "global")
+    if mdmc_average not in allowed_mdmc_average:
+        raise ValueError(f"The `mdmc_average` has to be one of {allowed_mdmc_average}, got {mdmc_average}.")
+    if num_classes and ignore_index is not None and (not ignore_index < num_classes or num_classes == 1):
+        raise ValueError(f"The `ignore_index` {ignore_index} is not valid for inputs with {num_classes} classes")
+    if top_k is not None and (not isinstance(top_k, int) or top_k <= 0):
+        raise ValueError(f"The `top_k` should be an integer larger than 0, got {top_k}")
+
+    preds, target = _input_squeeze(jnp.asarray(preds), jnp.asarray(target))
+    reduce = "macro" if average in ("weighted", "none", None) else average
+    tp, fp, _, fn = _legacy_stat_scores_update(
+        preds,
+        target,
+        reduce=reduce,
+        mdmc_reduce=mdmc_average,
+        threshold=threshold,
+        num_classes=num_classes,
+        top_k=top_k,
+        multiclass=multiclass,
+        ignore_index=ignore_index,
+    )
+    return _dice_compute(tp, fp, fn, average, mdmc_average, zero_division)

@@ -76,9 +76,18 @@ def retrieval_r_precision(preds: Array, target: Array) -> Array:
     return _full(preds, target, _mk.r_precision_masked)
 
 
-def retrieval_auroc(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:
-    """Per-query AUROC via the Mann-Whitney rank statistic."""
-    return _full(preds, target, _mk.auroc_masked, top_k=top_k)
+def retrieval_auroc(
+    preds: Array, target: Array, top_k: Optional[int] = None, max_fpr: Optional[float] = None
+) -> Array:
+    """Per-query AUROC via the Mann-Whitney rank statistic.
+
+    ``max_fpr`` computes the McClish-corrected partial AUC, matching the
+    reference's delegation to ``binary_auroc(..., max_fpr=...)``
+    (``functional/retrieval/auroc.py``).
+    """
+    if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+        raise ValueError(f"Arguments `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+    return _full(preds, target, _mk.auroc_masked, top_k=top_k, max_fpr=max_fpr)
 
 
 def retrieval_normalized_dcg(preds: Array, target: Array, top_k: Optional[int] = None) -> Array:

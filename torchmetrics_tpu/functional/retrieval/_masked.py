@@ -111,17 +111,24 @@ def r_precision_masked(preds: Array, target: Array, mask: Array) -> Array:
     return jnp.where(total_rel > 0, rel / jnp.maximum(total_rel, 1).astype(jnp.float32), 0.0)
 
 
-def auroc_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:
+def auroc_masked(
+    preds: Array, target: Array, mask: Array, top_k: Optional[int] = None, max_fpr: Optional[float] = None
+) -> Array:
     """Rank-statistic AUROC (Mann-Whitney U), mask-aware; ties get average rank.
 
     With ``top_k``, only the k highest-scoring valid docs are considered
     (reference ``functional/retrieval/auroc.py`` truncates to ``topk`` first).
+    With ``max_fpr``, the McClish-corrected partial AUC is computed from the
+    masked ROC staircase instead (reference routes through
+    ``binary_auroc(..., max_fpr=...)``).
     """
     if top_k is not None:
         # keep only entries ranked within top_k by preds
         p_sortkey = jnp.where(mask, preds, NEG_INF)
         rank_desc = jnp.argsort(jnp.argsort(-p_sortkey, stable=True), stable=True)  # 0-indexed rank
         mask = mask & (rank_desc < top_k)
+    if max_fpr is not None and max_fpr != 1:
+        return _partial_auroc_masked(preds, target, mask, max_fpr)
     p = jnp.where(mask, preds, NEG_INF)
     rel = (target > 0) & mask
     irrel = (target == 0) & mask
@@ -134,6 +141,53 @@ def auroc_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] 
     rank_sum = jnp.sum(jnp.where(rel, ranks, 0.0))
     auc = (rank_sum - n_pos * (n_pos + 1) / 2) / jnp.maximum(n_pos * n_neg, 1.0)
     return jnp.where((n_pos > 0) & (n_neg > 0), auc, 0.0)
+
+
+def _partial_auroc_masked(preds: Array, target: Array, mask: Array, max_fpr: float) -> Array:
+    """McClish-corrected partial AUC over the masked ROC staircase.
+
+    Fixed-shape (jittable) realisation of the reference's
+    ``_binary_auroc_compute`` with ``max_fpr``: sort by score desc, cumsum
+    tp/fp keeping only tie-run boundaries, prepend (0,0), clip the curve at
+    ``max_fpr`` with linear interpolation, trapezoid, then rescale
+    ``0.5 * (1 + (area - min) / (max - min))``.
+    """
+    p = jnp.where(mask, preds, NEG_INF)
+    order = jnp.argsort(-p, stable=True)
+    p_s = p[order]
+    w_s = mask[order].astype(jnp.float32)
+    t_s = ((target > 0) & mask)[order].astype(jnp.float32) * w_s
+    tps = jnp.cumsum(t_s)
+    fps = jnp.cumsum(w_s - t_s)
+    n_pos, n_neg = tps[-1], fps[-1]
+    # keep only the last point of each tie run (distinct thresholds); padded
+    # entries (weight 0) collapse into their predecessor's point harmlessly
+    is_boundary = jnp.concatenate([p_s[:-1] != p_s[1:], jnp.asarray([True])])
+    tpr = jnp.where(is_boundary, _safe_div(tps, n_pos), 0.0)
+    fpr = jnp.where(is_boundary, _safe_div(fps, n_neg), 0.0)
+    # re-sort so masked-out (0,0) points lead and boundaries stay ordered
+    key = jnp.where(is_boundary, fps, -1.0)
+    reorder = jnp.argsort(key, stable=True)
+    tpr, fpr = tpr[reorder], fpr[reorder]
+    # clip the staircase at max_fpr: interpolate tpr where fpr crosses it
+    mfpr = jnp.asarray(max_fpr, dtype=fpr.dtype)
+    prev_fpr = jnp.concatenate([jnp.zeros(1, fpr.dtype), fpr[:-1]])
+    prev_tpr = jnp.concatenate([jnp.zeros(1, tpr.dtype), tpr[:-1]])
+    seg = jnp.where(fpr > prev_fpr, (tpr - prev_tpr) / jnp.maximum(fpr - prev_fpr, 1e-12), 0.0)
+    tpr_at = prev_tpr + seg * (mfpr - prev_fpr)
+    tpr_c = jnp.where(fpr <= mfpr, tpr, jnp.where(prev_fpr < mfpr, tpr_at, 0.0))
+    fpr_c = jnp.minimum(fpr, mfpr)
+    prev_fc = jnp.concatenate([jnp.zeros(1, fpr.dtype), fpr_c[:-1]])
+    prev_tc = jnp.concatenate([jnp.zeros(1, tpr.dtype), tpr_c[:-1]])
+    area = jnp.sum(jnp.where(fpr_c > prev_fc, (fpr_c - prev_fc) * (tpr_c + prev_tc) / 2.0, 0.0))
+    min_area = 0.5 * mfpr * mfpr
+    max_area = mfpr
+    part = 0.5 * (1.0 + (area - min_area) / jnp.maximum(max_area - min_area, 1e-12))
+    return jnp.where((n_pos > 0) & (n_neg > 0), part, 0.0)
+
+
+def _safe_div(a: Array, b: Array) -> Array:
+    return a / jnp.maximum(b, 1.0)
 
 
 def ndcg_masked(preds: Array, target: Array, mask: Array, top_k: Optional[int] = None) -> Array:

@@ -34,6 +34,7 @@ class RetrievalMetric(Metric):
         self,
         empty_target_action: str = "neg",
         ignore_index: Optional[int] = None,
+        aggregation: Any = "mean",
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -46,6 +47,12 @@ class RetrievalMetric(Metric):
         if ignore_index is not None and not isinstance(ignore_index, int):
             raise ValueError("Argument `ignore_index` must be an integer or None.")
         self.ignore_index = ignore_index
+        if not (aggregation in ("mean", "median", "min", "max") or callable(aggregation)):
+            raise ValueError(
+                "Argument `aggregation` must be one of `mean`, `median`, `min`, `max` or a custom callable,"
+                f" but got {aggregation}"
+            )
+        self.aggregation = aggregation
         self.add_state("indexes", default=[], dist_reduce_fx=None)
         self.add_state("preds", default=[], dist_reduce_fx=None)
         self.add_state("target", default=[], dist_reduce_fx=None)
@@ -123,7 +130,24 @@ class RetrievalMetric(Metric):
         return self._aggregate(res)
 
     def _aggregate(self, res: Array) -> Array:
-        return jnp.mean(res) if res.size else jnp.asarray(0.0)
+        """Reduce per-query values per the ``aggregation`` ctor arg.
+
+        Mirrors the reference's ``_retrieval_aggregate``
+        (``utilities/data.py``): string reductions or a user callable taking
+        ``(values, dim)``.
+        """
+        if not res.size:
+            return jnp.asarray(0.0)
+        if self.aggregation == "mean":
+            return jnp.mean(res)
+        if self.aggregation == "median":
+            # torch.median picks the lower middle value for even counts
+            return jnp.sort(res)[(res.size - 1) // 2]
+        if self.aggregation == "min":
+            return jnp.min(res)
+        if self.aggregation == "max":
+            return jnp.max(res)
+        return self.aggregation(res, dim=0)
 
     @abstractmethod
     def _metric(self, preds: Array, target: Array, mask: Array) -> Array:

@@ -85,9 +85,19 @@ class RetrievalNormalizedDCG(_TopKRetrievalMetric):
 
 
 class RetrievalAUROC(_TopKRetrievalMetric):
-    """Mean per-query AUROC."""
+    """Mean per-query AUROC (reference ``retrieval/auroc.py``; ``max_fpr``
+    yields the McClish-corrected partial AUC)."""
 
     _kernel = staticmethod(_mk.auroc_masked)
+
+    def __init__(self, max_fpr: Optional[float] = None, **kwargs: Any) -> None:
+        super().__init__(**kwargs)
+        if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
+            raise ValueError(f"Arguments `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
+        self.max_fpr = max_fpr
+
+    def _metric(self, preds: Array, target: Array, mask: Array) -> Array:
+        return _mk.auroc_masked(preds, target, mask, top_k=self.top_k, max_fpr=self.max_fpr)
 
 
 class RetrievalPrecision(RetrievalMetric):
