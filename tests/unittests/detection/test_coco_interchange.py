@@ -1,0 +1,89 @@
+"""COCO json interchange: tm_to_coco / coco_to_tm round-trip and RLE codec.
+
+Reference surface: ``detection/mean_ap.py:640-800`` (converters) and the
+pycocotools RLE conventions the in-repo codec replaces.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.detection import MeanAveragePrecision
+from torchmetrics_tpu.functional.detection._rle import (
+    ann_to_mask,
+    mask_to_rle_counts,
+    rle_counts_to_mask,
+    rle_string_decode,
+    rle_string_encode,
+)
+
+
+def test_rle_round_trip():
+    rng = np.random.default_rng(0)
+    for _ in range(20):
+        h, w = rng.integers(1, 40, 2)
+        m = (rng.random((h, w)) > rng.random()).astype(np.uint8)
+        counts = mask_to_rle_counts(m)
+        assert sum(counts) == h * w
+        assert np.array_equal(rle_counts_to_mask(counts, [h, w]), m)
+        s = rle_string_encode(counts)
+        assert rle_string_decode(s) == counts
+        assert np.array_equal(ann_to_mask({"counts": s, "size": [int(h), int(w)]}, h, w), m)
+
+
+def test_rle_known_counts():
+    # column-major scan; counts start with the zero-run
+    m = np.array([[0, 1, 1, 1, 0, 0, 0, 0, 0]], dtype=np.uint8)
+    assert mask_to_rle_counts(m) == [1, 3, 5]
+
+
+def _correlated_inputs(rng, iou):
+    preds, target = [], []
+    for _ in range(4):
+        ng = int(rng.integers(2, 5))
+        xy = rng.random((ng, 2)) * 50
+        wh = rng.random((ng, 2)) * 40 + 5
+        tb = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        pb = tb + rng.normal(0, 2, tb.shape).astype(np.float32)
+        lab = rng.integers(0, 3, ng)
+        tm_masks = rng.random((ng, 24, 32)) > 0.5
+        pm = tm_masks.copy()
+        pm[:, :2, :] = ~pm[:, :2, :]
+        p = dict(
+            boxes=jnp.asarray(pb),
+            scores=jnp.asarray(rng.random(ng, dtype=np.float32) * 0.5 + 0.5),
+            labels=jnp.asarray(lab),
+        )
+        t = dict(boxes=jnp.asarray(tb), labels=jnp.asarray(lab))
+        if iou == "segm":
+            p["masks"] = jnp.asarray(pm)
+            t["masks"] = jnp.asarray(tm_masks)
+        preds.append(p)
+        target.append(t)
+    return preds, target
+
+
+@pytest.mark.parametrize("iou", ["bbox", "segm"])
+def test_coco_round_trip(tmp_path, iou):
+    rng = np.random.default_rng(1)
+    preds, target = _correlated_inputs(rng, iou)
+    m = MeanAveragePrecision(iou_type=iou)
+    m.update(preds, target)
+    r1 = {k: np.asarray(v) for k, v in m.compute().items()}
+    assert float(r1["map"]) > 0.3  # correlated preds give a meaningful score
+
+    name = str(tmp_path / f"rt_{iou}")
+    m.tm_to_coco(name)
+    p2, t2 = MeanAveragePrecision.coco_to_tm(f"{name}_preds.json", f"{name}_target.json", iou_type=iou)
+    m2 = MeanAveragePrecision(iou_type=iou, box_format="xywh")
+    m2.update(p2, t2)
+    r2 = {k: np.asarray(v) for k, v in m2.compute().items()}
+    for k in r1:
+        np.testing.assert_allclose(r1[k], r2[k], atol=1e-6, err_msg=f"{iou}/{k}")
+
+
+def test_host_backend_properties_raise_without_packages():
+    m = MeanAveragePrecision()
+    with pytest.raises(ModuleNotFoundError):
+        _ = m.coco  # default backend is the on-device "xla" evaluator

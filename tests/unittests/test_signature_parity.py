@@ -1,0 +1,127 @@
+"""Signature-parity additions vs the reference oracle.
+
+Covers the kwargs the round-2 audit found missing: task-dispatcher `average`
+for precision_recall_curve/roc, rmse_sw `return_rmse_map`, contingency
+`sparse`, `Metric.device/.dtype/.type`, MultitaskWrapper dict protocol.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.reference_oracle import load_reference
+
+torchmetrics = load_reference()
+if torchmetrics is None:
+    pytest.skip("reference checkout unavailable", allow_module_level=True)
+
+import torch  # noqa: E402
+
+import torchmetrics_tpu as tm  # noqa: E402
+
+RNG = np.random.default_rng(77)
+N, C = 60, 4
+PREDS = RNG.random((N, C)).astype(np.float32)
+PREDS /= PREDS.sum(1, keepdims=True)
+TARGET = RNG.integers(0, C, N)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+@pytest.mark.parametrize("thresholds", [None, 10])
+def test_prc_dispatcher_average(average, thresholds):
+    ours = tm.functional.precision_recall_curve(
+        jnp.asarray(PREDS), jnp.asarray(TARGET), task="multiclass", num_classes=C,
+        thresholds=thresholds, average=average,
+    )
+    ref = torchmetrics.functional.precision_recall_curve(
+        torch.tensor(PREDS), torch.tensor(TARGET), task="multiclass", num_classes=C,
+        thresholds=thresholds, average=average,
+    )
+    for o, r in zip(ours, ref):
+        np.testing.assert_allclose(np.asarray(o), r.numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("average", ["micro", "macro"])
+@pytest.mark.parametrize("thresholds", [None, 10])
+def test_roc_dispatcher_average(average, thresholds):
+    ours = tm.functional.roc(
+        jnp.asarray(PREDS), jnp.asarray(TARGET), task="multiclass", num_classes=C,
+        thresholds=thresholds, average=average,
+    )
+    ref = torchmetrics.functional.roc(
+        torch.tensor(PREDS), torch.tensor(TARGET), task="multiclass", num_classes=C,
+        thresholds=thresholds, average=average,
+    )
+    for o, r in zip(ours, ref):
+        np.testing.assert_allclose(np.asarray(o), r.numpy(), atol=1e-5)
+
+
+def test_rmse_sw_return_map():
+    p = RNG.random((4, 3, 16, 16)).astype(np.float32)
+    t = RNG.random((4, 3, 16, 16)).astype(np.float32)
+    ours, ours_map = tm.functional.root_mean_squared_error_using_sliding_window(
+        jnp.asarray(p), jnp.asarray(t), return_rmse_map=True
+    )
+    ref, ref_map = torchmetrics.functional.image.root_mean_squared_error_using_sliding_window(
+        torch.tensor(p), torch.tensor(t), return_rmse_map=True
+    )
+    np.testing.assert_allclose(float(ours), float(ref), atol=1e-5)
+    np.testing.assert_allclose(np.asarray(ours_map), ref_map.numpy(), atol=1e-5)
+
+
+def test_contingency_sparse():
+    from torchmetrics_tpu.functional.clustering.utils import calculate_contingency_matrix
+
+    p = jnp.asarray(RNG.integers(0, 4, 50))
+    t = jnp.asarray(RNG.integers(0, 3, 50))
+    dense = np.asarray(calculate_contingency_matrix(p, t))
+    sparse = calculate_contingency_matrix(p, t, sparse=True)
+    np.testing.assert_allclose(dense, sparse.toarray())
+    with pytest.raises(ValueError):
+        calculate_contingency_matrix(p, t, eps=1.0, sparse=True)
+
+
+def test_metric_device_dtype_properties():
+    m = tm.classification.MulticlassAccuracy(num_classes=3)
+    assert m.device in __import__("jax").devices() or m.device is not None
+    assert m.dtype == jnp.float32
+    assert m.type(jnp.float16) is m  # parity no-op
+    m.set_dtype(jnp.bfloat16)
+    assert m.dtype == jnp.bfloat16
+
+
+def test_multitask_dict_protocol():
+    from torchmetrics_tpu.collections import MetricCollection
+    from torchmetrics_tpu.wrappers import MultitaskWrapper
+
+    w = MultitaskWrapper(
+        {
+            "a": tm.classification.BinaryAccuracy(),
+            "b": MetricCollection([tm.classification.BinaryAccuracy(), tm.classification.BinaryF1Score()]),
+        }
+    )
+    assert list(w.keys()) == ["a", "b_BinaryAccuracy", "b_BinaryF1Score"]
+    assert list(w.keys(flatten=False)) == ["a", "b"]
+    assert [k for k, _ in w.items()] == list(w.keys())
+    assert len(list(w.values())) == 3
+
+
+def test_retrieval_fallout_kwargs_passthrough():
+    # audit false-positive guard: kwargs reach the base class
+    m = tm.retrieval.RetrievalFallOut(ignore_index=-1, top_k=2, aggregation="max")
+    assert m.ignore_index == -1 and m.top_k == 2 and m.aggregation == "max"
+
+
+def test_nominal_nan_strategy_passthrough():
+    m = tm.nominal.CramersV(num_classes=3, nan_strategy="replace", nan_replace_value=0.0)
+    assert m.nan_strategy == "replace"
+    with pytest.raises(ValueError):
+        tm.nominal.TschuprowsT(num_classes=3, nan_strategy="bogus")
+
+
+def test_clustering_kwargs_passthrough():
+    m = tm.clustering.NormalizedMutualInfoScore(average_method="geometric")
+    assert m.average_method == "geometric"
+    v = tm.clustering.VMeasureScore(beta=2.0)
+    assert v.beta == 2.0

@@ -45,8 +45,11 @@ class MeanAveragePrecision(Metric):
     ``iou_type="segm"`` operates on dense boolean masks ``(N, H, W)``; mask
     IoU is a single MXU matmul per image instead of host RLE.
 
-    The ``backend`` argument is accepted for API compatibility and ignored:
-    this implementation *is* the backend (pure XLA).
+    The default ``backend="xla"`` evaluates entirely on device. The host
+    backends (``pycocotools`` / ``faster_coco_eval``) are only consulted by
+    the ``coco``/``cocoeval``/``mask_utils`` properties, which raise
+    ``ModuleNotFoundError`` when the package is not installed; evaluation
+    itself never leaves the device regardless of ``backend``.
 
     Example:
         >>> import jax.numpy as jnp
@@ -363,3 +366,238 @@ class MeanAveragePrecision(Metric):
 
         result_dict["classes"] = jnp.asarray(self._get_classes(), jnp.int32)
         return result_dict
+
+    # ------------------------------------------------------- COCO interchange
+    @property
+    def coco(self) -> object:
+        """The COCO dataset class of the host backend (reference ``mean_ap.py:452-456``).
+
+        Only meaningful for the host backends; the default ``xla`` backend
+        evaluates on device and has no COCO module.
+        """
+        return _load_host_backend_tools(self.backend)[0]
+
+    @property
+    def cocoeval(self) -> object:
+        """The COCOeval class of the host backend (reference ``mean_ap.py:458-462``)."""
+        return _load_host_backend_tools(self.backend)[1]
+
+    @property
+    def mask_utils(self) -> object:
+        """The RLE mask-utils module of the host backend (reference ``mean_ap.py:464-468``)."""
+        return _load_host_backend_tools(self.backend)[2]
+
+    @staticmethod
+    def coco_to_tm(
+        coco_preds: str,
+        coco_target: str,
+        iou_type: Union[str, Tuple[str, ...]] = "bbox",
+        backend: str = "pycocotools",
+    ) -> Tuple[List[Dict[str, Array]], List[Dict[str, Array]]]:
+        """Convert COCO-format json files to this metric's input format.
+
+        Mirrors reference ``detection/mean_ap.py:640-751`` but parses the
+        json directly (host Python) so no C backend is required; masks are
+        decoded with the in-repo RLE codec. Boxes are returned in the files'
+        native ``xywh`` layout, like the reference.
+        """
+        import json
+
+        from torchmetrics_tpu.functional.detection._rle import ann_to_mask
+
+        iou_type = _validate_iou_type_arg(iou_type)
+
+        with open(coco_target) as f:
+            gt_data = json.load(f)
+        with open(coco_preds) as f:
+            dt_data = json.load(f)
+        gt_anns = gt_data["annotations"] if isinstance(gt_data, dict) else gt_data
+        dt_anns = dt_data["annotations"] if isinstance(dt_data, dict) else dt_data
+        img_sizes = {}
+        if isinstance(gt_data, dict):
+            for img in gt_data.get("images", []):
+                img_sizes[img["id"]] = (img.get("height", 0), img.get("width", 0))
+
+        def _mask(ann):
+            h, w = img_sizes.get(ann["image_id"], (0, 0))
+            return ann_to_mask(ann["segmentation"], h, w)
+
+        def _empty_entry(with_scores: bool) -> Dict[str, list]:
+            entry: Dict[str, list] = (
+                {"scores": [], "labels": []} if with_scores else {"labels": [], "iscrowd": [], "area": []}
+            )
+            if "bbox" in iou_type:
+                entry["boxes"] = []
+            if "segm" in iou_type:
+                entry["masks"] = []
+            return entry
+
+        target: Dict[Any, Dict[str, list]] = {}
+        for t in gt_anns:
+            entry = target.setdefault(t["image_id"], _empty_entry(with_scores=False))
+            if "bbox" in iou_type:
+                entry["boxes"].append(t["bbox"])
+            if "segm" in iou_type:
+                entry["masks"].append(_mask(t))
+            entry["labels"].append(t["category_id"])
+            entry["iscrowd"].append(t.get("iscrowd", 0))
+            entry["area"].append(t.get("area", 0))
+
+        preds: Dict[Any, Dict[str, list]] = {}
+        for p in dt_anns:
+            if p["image_id"] not in target:
+                # mirror COCO.loadRes: predictions must correspond to the gt set
+                raise ValueError(
+                    f"Prediction for image_id {p['image_id']!r} does not correspond to any image in the"
+                    " target file. Results do not correspond to the current coco set."
+                )
+            entry = preds.setdefault(p["image_id"], _empty_entry(with_scores=True))
+            if "bbox" in iou_type:
+                entry["boxes"].append(p["bbox"])
+            if "segm" in iou_type:
+                entry["masks"].append(_mask(p))
+            entry["scores"].append(p["score"])
+            entry["labels"].append(p["category_id"])
+        for k in target:  # images without predictions get empty entries
+            preds.setdefault(k, _empty_entry(with_scores=True))
+
+        batched_preds, batched_target = [], []
+        for key in target:
+            bp = {
+                "scores": jnp.asarray(np.asarray(preds[key]["scores"], dtype=np.float32)),
+                "labels": jnp.asarray(np.asarray(preds[key]["labels"], dtype=np.int32)),
+            }
+            if "bbox" in iou_type:
+                bp["boxes"] = jnp.asarray(np.asarray(preds[key]["boxes"], dtype=np.float32).reshape(-1, 4))
+            if "segm" in iou_type:
+                bp["masks"] = jnp.asarray(np.stack(preds[key]["masks"]).astype(np.uint8)) if preds[key][
+                    "masks"
+                ] else jnp.zeros((0, 0, 0), jnp.uint8)
+            batched_preds.append(bp)
+            bt = {
+                "labels": jnp.asarray(np.asarray(target[key]["labels"], dtype=np.int32)),
+                "iscrowd": jnp.asarray(np.asarray(target[key]["iscrowd"], dtype=np.int32)),
+                "area": jnp.asarray(np.asarray(target[key]["area"], dtype=np.float32)),
+            }
+            if "bbox" in iou_type:
+                bt["boxes"] = jnp.asarray(np.asarray(target[key]["boxes"], dtype=np.float32).reshape(-1, 4))
+            if "segm" in iou_type:
+                bt["masks"] = jnp.asarray(np.stack(target[key]["masks"]).astype(np.uint8)) if target[key][
+                    "masks"
+                ] else jnp.zeros((0, 0, 0), jnp.uint8)
+            batched_target.append(bt)
+        return batched_preds, batched_target
+
+    def tm_to_coco(self, name: str = "tm_map_input") -> None:
+        """Dump the cached inputs as ``{name}_preds.json`` / ``{name}_target.json``.
+
+        Mirrors reference ``detection/mean_ap.py:752-800``: call after
+        ``update``/``forward``; boxes are written in COCO ``xywh``, masks as
+        compressed RLE via the in-repo codec.
+        """
+        import json
+
+        target_dataset = self._get_coco_format(
+            labels=self.groundtruth_labels,
+            boxes=self.groundtruth_box if "bbox" in self.iou_type else None,
+            masks=self.groundtruth_mask if "segm" in self.iou_type else None,
+            crowds=self.groundtruth_crowds,
+            area=self.groundtruth_area,
+        )
+        preds_dataset = self._get_coco_format(
+            labels=self.detection_labels,
+            boxes=self.detection_box if "bbox" in self.iou_type else None,
+            masks=self.detection_mask if "segm" in self.iou_type else None,
+            scores=self.detection_scores,
+        )
+        with open(f"{name}_preds.json", "w") as f:
+            f.write(json.dumps(preds_dataset["annotations"], indent=4))
+        with open(f"{name}_target.json", "w") as f:
+            f.write(json.dumps(target_dataset, indent=4))
+
+    def _get_coco_format(
+        self,
+        labels: List[Array],
+        boxes: Optional[List[Array]] = None,
+        masks: Optional[List[Array]] = None,
+        scores: Optional[List[Array]] = None,
+        crowds: Optional[List[Array]] = None,
+        area: Optional[List[Array]] = None,
+    ) -> Dict[str, Any]:
+        """Cached state → COCO dataset dict (reference ``mean_ap.py:842-940``).
+
+        Our box state is xyxy (``_get_safe_item_values``); COCO json is xywh.
+        """
+        from torchmetrics_tpu.functional.detection._rle import mask_to_rle_counts, rle_string_encode
+
+        images, annotations = [], []
+        annotation_id = 1
+        for image_id, image_labels in enumerate(labels):
+            image_labels = np.asarray(image_labels).tolist()
+            images.append({"id": image_id})
+            image_boxes = None
+            if boxes is not None and image_id < len(boxes):
+                xyxy = np.asarray(boxes[image_id], dtype=np.float64).reshape(-1, 4)
+                image_boxes = np.concatenate([xyxy[:, :2], xyxy[:, 2:] - xyxy[:, :2]], axis=1).tolist()
+            image_masks = None
+            if masks is not None and image_id < len(masks):
+                image_masks = np.asarray(masks[image_id]).astype(np.uint8)
+                if image_masks.size:
+                    images[-1]["height"], images[-1]["width"] = int(image_masks.shape[-2]), int(image_masks.shape[-1])
+            for k, image_label in enumerate(image_labels):
+                ann: Dict[str, Any] = {
+                    "id": annotation_id,
+                    "image_id": image_id,
+                    "category_id": int(image_label),
+                    "iscrowd": int(np.asarray(crowds[image_id])[k]) if crowds is not None else 0,
+                }
+                stat_area = float(np.asarray(area[image_id])[k]) if area is not None else 0.0
+                if image_boxes is not None:
+                    ann["bbox"] = [float(v) for v in image_boxes[k]]
+                    if stat_area <= 0:
+                        stat_area = ann["bbox"][2] * ann["bbox"][3]
+                if image_masks is not None and len(image_masks):
+                    m = image_masks[k]
+                    ann["segmentation"] = {
+                        "size": [int(m.shape[0]), int(m.shape[1])],
+                        "counts": rle_string_encode(mask_to_rle_counts(m)),
+                    }
+                    if stat_area <= 0:
+                        stat_area = float(m.sum())
+                ann["area"] = stat_area
+                if scores is not None:
+                    ann["score"] = float(np.asarray(scores[image_id])[k])
+                annotations.append(ann)
+                annotation_id += 1
+        classes = [{"id": int(i), "name": str(i)} for i in self._get_classes()]
+        return {"images": images, "annotations": annotations, "categories": classes}
+
+
+def _load_host_backend_tools(backend: str) -> Tuple[object, object, object]:
+    """Load (COCO, COCOeval, mask_utils) for a host backend (ref ``mean_ap.py:50-71``)."""
+    if backend == "pycocotools":
+        try:
+            import pycocotools.mask as mask_utils
+            from pycocotools.coco import COCO
+            from pycocotools.cocoeval import COCOeval
+        except ImportError as err:
+            raise ModuleNotFoundError(
+                "Backend `pycocotools` in metric `MeanAveragePrecision` requires that `pycocotools` is installed."
+                " Please install with `pip install pycocotools`."
+            ) from err
+        return COCO, COCOeval, mask_utils
+    if backend == "faster_coco_eval":
+        try:
+            from faster_coco_eval import COCO
+            from faster_coco_eval import COCOeval_faster as COCOeval
+            from faster_coco_eval.core import mask as mask_utils
+        except ImportError as err:
+            raise ModuleNotFoundError(
+                "Backend `faster_coco_eval` in metric `MeanAveragePrecision` requires that `faster-coco-eval` is"
+                " installed. Please install with `pip install faster-coco-eval`."
+            ) from err
+        return COCO, COCOeval, mask_utils
+    raise ModuleNotFoundError(
+        f"Backend `{backend}` evaluates on device and exposes no host COCO tools;"
+        " construct the metric with backend='pycocotools' or 'faster_coco_eval' to use them."
+    )

@@ -461,6 +461,7 @@ def precision_recall_curve(
     thresholds: Optional[Union[int, List[float], Array]] = None,
     num_classes: Optional[int] = None,
     num_labels: Optional[int] = None,
+    average: Optional[str] = None,
     ignore_index: Optional[int] = None,
     validate_args: bool = True,
 ) -> Union[Tuple[Array, Array, Array], Tuple[List[Array], List[Array], List[Array]]]:
@@ -474,7 +475,7 @@ def precision_recall_curve(
         if not isinstance(num_classes, int):
             raise ValueError(f"`num_classes` is expected to be `int` but `{type(num_classes)} was passed.`")
         return multiclass_precision_recall_curve(
-            preds, target, num_classes, thresholds, None, ignore_index, validate_args
+            preds, target, num_classes, thresholds, average, ignore_index, validate_args
         )
     if task == ClassificationTask.MULTILABEL:
         if not isinstance(num_labels, int):

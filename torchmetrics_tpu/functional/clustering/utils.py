@@ -35,9 +35,23 @@ def check_cluster_labels(preds: Array, target: Array) -> None:
 
 
 def calculate_contingency_matrix(
-    preds: Array, target: Array, eps: Optional[float] = None
+    preds: Array, target: Array, eps: Optional[float] = None, sparse: bool = False
 ) -> Array:
-    """Contingency matrix ``(num_target_classes, num_pred_classes)``."""
+    """Contingency matrix ``(num_target_classes, num_pred_classes)``.
+
+    ``sparse`` returns a ``scipy.sparse.coo_matrix`` on host, mirroring the
+    reference's sparse mode (``functional/clustering/utils.py``); ``eps`` and
+    ``sparse`` are mutually exclusive there too.
+    """
+    if eps is not None and sparse:
+        raise ValueError("Cannot specify `eps` and return sparse tensor.")
+    if sparse:
+        import numpy as np
+        from scipy.sparse import coo_matrix
+
+        p = np.unique(np.asarray(preds).reshape(-1), return_inverse=True)[1]
+        t = np.unique(np.asarray(target).reshape(-1), return_inverse=True)[1]
+        return coo_matrix((np.ones(len(p)), (t, p)))
     p, kp = _relabel(preds)
     t, kt = _relabel(target)
     t_oh = jax.nn.one_hot(t, kt, dtype=jnp.float32)

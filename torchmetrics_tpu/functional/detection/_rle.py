@@ -1,0 +1,109 @@
+"""Pure-Python COCO RLE mask codec.
+
+The reference delegates RLE encode/decode to ``pycocotools.mask`` (C) /
+``faster_coco_eval`` (C++) (reference ``detection/mean_ap.py:50-71``). The
+TPU build keeps masks dense on device (mask IoU is an MXU matmul); RLE is
+only needed at the COCO-JSON interchange boundary (``coco_to_tm`` /
+``tm_to_coco``), where a host-side Python codec is plenty.
+
+COCO RLE conventions: column-major (Fortran) scan order; ``counts`` starts
+with the number of zeros; the compressed string form packs each count as a
+base-48 LEB128-style varint with 5-bit groups and delta-codes counts[i>2]
+against counts[i-2] (see pycocotools ``rleToString``/``rleFrString``).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Union
+
+import numpy as np
+
+
+def mask_to_rle_counts(mask: np.ndarray) -> List[int]:
+    """Dense (H, W) binary mask → uncompressed COCO counts list."""
+    flat = np.asarray(mask, dtype=np.uint8).flatten(order="F")
+    if flat.size == 0:
+        return []
+    change = np.nonzero(np.diff(flat))[0] + 1
+    runs = np.diff(np.concatenate([[0], change, [flat.size]])).tolist()
+    if flat[0] == 1:  # counts must start with a zero-run
+        runs = [0, *runs]
+    return [int(r) for r in runs]
+
+
+def rle_counts_to_mask(counts: List[int], size: List[int]) -> np.ndarray:
+    """Uncompressed COCO counts list + (H, W) size → dense uint8 mask."""
+    h, w = int(size[0]), int(size[1])
+    flat = np.zeros(h * w, dtype=np.uint8)
+    pos, val = 0, 0
+    for c in counts:
+        if val:
+            flat[pos : pos + c] = 1
+        pos += c
+        val ^= 1
+    return flat.reshape((h, w), order="F")
+
+
+def rle_string_encode(counts: List[int]) -> str:
+    """Counts list → compressed COCO RLE string (pycocotools ``rleToString``)."""
+    out = bytearray()
+    for i, c in enumerate(counts):
+        x = int(c)
+        if i > 2:
+            x -= int(counts[i - 2])
+        more = True
+        while more:
+            chunk = x & 0x1F
+            x >>= 5
+            more = not (x == 0 and not (chunk & 0x10) or x == -1 and (chunk & 0x10))
+            if more:
+                chunk |= 0x20
+            out.append(chunk + 48)
+    return out.decode("ascii")
+
+
+def rle_string_decode(s: Union[str, bytes]) -> List[int]:
+    """Compressed COCO RLE string → counts list (pycocotools ``rleFrString``)."""
+    if isinstance(s, str):
+        s = s.encode("ascii")
+    counts: List[int] = []
+    p = 0
+    while p < len(s):
+        x, k, more = 0, 0, True
+        while more:
+            c = s[p] - 48
+            x |= (c & 0x1F) << (5 * k)
+            more = bool(c & 0x20)
+            p += 1
+            k += 1
+            if not more and (c & 0x10):
+                x |= -1 << (5 * k)
+        if len(counts) > 2:
+            x += counts[-2]
+        counts.append(x)
+    return counts
+
+
+def ann_to_mask(segmentation: Union[Dict, List], height: int, width: int) -> np.ndarray:
+    """COCO annotation ``segmentation`` field → dense (H, W) uint8 mask.
+
+    Supports uncompressed RLE (``counts`` list) and compressed RLE
+    (``counts`` string). Polygon segmentations need a rasterizer and are
+    only supported when ``pycocotools`` is installed.
+    """
+    if isinstance(segmentation, dict):
+        counts = segmentation["counts"]
+        size = segmentation.get("size", [height, width])
+        if isinstance(counts, (str, bytes)):
+            counts = rle_string_decode(counts)
+        return rle_counts_to_mask(list(counts), size)
+    try:
+        from pycocotools import mask as _mask_utils  # noqa: PLC0415
+
+        rles = _mask_utils.frPyObjects(segmentation, height, width)
+        return np.asarray(_mask_utils.decode(_mask_utils.merge(rles)), dtype=np.uint8)
+    except ImportError as err:
+        raise NotImplementedError(
+            "Polygon segmentations require `pycocotools` for rasterization; "
+            "install it or provide RLE-encoded masks."
+        ) from err

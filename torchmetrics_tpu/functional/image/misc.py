@@ -143,12 +143,16 @@ def root_mean_squared_error_using_sliding_window(
     preds: Array,
     target: Array,
     window_size: int = 8,
+    return_rmse_map: bool = False,
+    *,
     reduction: Optional[str] = "elementwise_mean",
 ) -> Array:
     """RMSE over sliding windows (N,C,H,W) — reference ``rmse_sw.py:21-80``.
 
     Border windows are cropped by ``round(ws/2)`` before averaging, matching
-    the reference's crop-slide protocol.
+    the reference's crop-slide protocol. With ``return_rmse_map`` the
+    image-averaged full-resolution RMSE map is returned alongside the scalar
+    (reference ``rmse_sw.py:111-148``).
     """
     preds, target = _check_image_pair(preds, target)
     if not isinstance(window_size, int) or window_size < 1:
@@ -158,10 +162,14 @@ def root_mean_squared_error_using_sliding_window(
     cropped = rmse_map[:, :, crop:-crop, crop:-crop]
     per_image = cropped.reshape(cropped.shape[0], -1).mean(axis=-1)
     if reduction == "elementwise_mean":
-        return jnp.mean(per_image)
-    if reduction == "sum":
-        return jnp.sum(per_image)
-    return per_image
+        out = jnp.mean(per_image)
+    elif reduction == "sum":
+        out = jnp.sum(per_image)
+    else:
+        out = per_image
+    if return_rmse_map:
+        return out, rmse_map.mean(axis=0)
+    return out
 
 
 def total_variation(img: Array, reduction: Optional[str] = "sum") -> Array:

@@ -59,9 +59,18 @@ def perceptual_path_length(
     lower_discard: Optional[float] = 0.01,
     upper_discard: Optional[float] = 0.99,
     sim_net: Union[Callable, None] = None,
+    device: Optional[Any] = None,
     seed: int = 42,
 ) -> Tuple[Array, Array, Array]:
-    """Compute PPL: returns (mean, std, raw distances)."""
+    """Compute PPL: returns (mean, std, raw distances).
+
+    ``device`` is accepted for reference signature parity
+    (``image/perceptual_path_length.py`` runs the generator on an explicit
+    torch device); under JAX, placement follows the arrays' sharding, so a
+    non-None value is validated as a ``jax.Device`` and otherwise ignored.
+    """
+    if device is not None and not isinstance(device, jax.Device):
+        raise ValueError(f"Argument `device` must be a `jax.Device` or None, but got {device!r}.")
     _validate_generator_model(generator, conditional)
     if not (isinstance(num_samples, int) and num_samples > 0):
         raise ValueError(f"Argument `num_samples` must be a positive integer, but got {num_samples}.")

@@ -708,6 +708,35 @@ class Metric(ABC):
                 setattr(self, attr, current.astype(dst_type))
         return self
 
+    @property
+    def device(self) -> Any:
+        """Device of the metric's states (reference ``metric.py:729-731``).
+
+        JAX arrays carry their own placement, so this reports where the first
+        array state lives (the default device before any state exists).
+        """
+        for attr in self._defaults:
+            current = getattr(self, attr, None)
+            if isinstance(current, jax.Array):
+                return list(current.devices())[0]
+            if isinstance(current, list) and current and isinstance(current[0], jax.Array):
+                return list(current[0].devices())[0]
+        return jax.devices()[0]
+
+    @property
+    def dtype(self) -> Any:
+        """Default floating dtype of the metric (reference ``metric.py:734-736``)."""
+        if self._dtype_policy is not None:
+            return jnp.dtype(self._dtype_policy)
+        for attr in self._defaults:
+            current = getattr(self, attr, None)
+            if isinstance(current, jax.Array) and jnp.issubdtype(current.dtype, jnp.floating):
+                return current.dtype
+        return jnp.dtype(jnp.float32)
+
+    def type(self, dst_type: Any) -> "Metric":  # noqa: A003 - parity no-op (reference metric.py:738-744)
+        return self
+
     def float(self) -> "Metric":  # noqa: A003 - parity no-op (reference metric.py:746-768)
         return self
 

@@ -108,8 +108,27 @@ def auc(x: Array, y: Array, reorder: bool = False) -> Array:
 
 
 def interp(x: Array, xp: Array, fp: Array) -> Array:
-    """1-D linear interpolation, ``jnp.interp`` with reference semantics."""
-    return jnp.interp(x, xp, fp)
+    """1-D linear interpolation with the reference's exact semantics.
+
+    Reference ``utilities/compute.py:134-157``: segment index = count of
+    ``xp`` values ≤ x (clamped), slopes taken in ``xp``'s original order,
+    linear extrapolation past the ends. This differs from ``jnp.interp``
+    (which clamps at the boundary and assumes sorted ``xp``) — the macro
+    curve merges call it on non-monotonic ``xp``, where the count-based
+    segment pick is part of the observable behavior.
+    """
+    x, xp, fp = jnp.asarray(x), jnp.asarray(xp), jnp.asarray(fp)
+    scalar_x = x.ndim == 0
+    x1 = jnp.atleast_1d(x)
+    if xp.shape[0] < 2:  # degenerate: no segments to interpolate over
+        out = jnp.broadcast_to(fp[0] if fp.size else jnp.nan, x1.shape)
+        return out[0] if scalar_x else out
+    m = _safe_divide(fp[1:] - fp[:-1], xp[1:] - xp[:-1])
+    b = fp[:-1] - m * xp[:-1]
+    indices = jnp.sum(x1[:, None] >= xp[None, :], axis=1) - 1
+    indices = jnp.clip(indices, 0, m.shape[0] - 1)
+    out = m[indices] * x1 + b[indices]
+    return out[0] if scalar_x else out
 
 
 def normalize_logits_if_needed(tensor: Array, normalization: Optional[str]) -> Array:

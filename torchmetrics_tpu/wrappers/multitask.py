@@ -84,3 +84,33 @@ class MultitaskWrapper(WrapperMetric):
         if postfix is not None:
             mt._postfix = postfix
         return mt
+
+    def items(self, flatten: bool = True):
+        """Iterate over (task name, metric) pairs (reference ``wrappers/multitask.py:106-119``).
+
+        With ``flatten``, MetricCollection members are exploded into
+        ``{task}_{metric}`` entries.
+        """
+        for task_name, metric in self.task_metrics.items():
+            if flatten and isinstance(metric, MetricCollection):
+                for sub_metric_name, sub_metric in metric.items():
+                    yield f"{task_name}_{sub_metric_name}", sub_metric
+            else:
+                yield task_name, metric
+
+    def keys(self, flatten: bool = True):
+        """Iterate over task names (reference ``wrappers/multitask.py:121-134``)."""
+        for task_name, metric in self.task_metrics.items():
+            if flatten and isinstance(metric, MetricCollection):
+                for sub_metric_name in metric:
+                    yield f"{task_name}_{sub_metric_name}"
+            else:
+                yield task_name
+
+    def values(self, flatten: bool = True):
+        """Iterate over task metrics (reference ``wrappers/multitask.py:136-149``)."""
+        for metric in self.task_metrics.values():
+            if flatten and isinstance(metric, MetricCollection):
+                yield from metric.values()
+            else:
+                yield metric
