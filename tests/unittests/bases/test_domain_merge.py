@@ -1,0 +1,116 @@
+"""Replica-merge == single-replica invariant for cat-state domain metrics.
+
+The reference proves its all-gather/reduce path by checking that N-process
+compute equals 1-process compute on the concatenated data
+(``tests/unittests/helpers/testers.py:199-228``). These tests pin the same
+invariant through ``merge_state`` (the framework's merge primitive that
+device sync lowers to) for the domains whose states are append-lists:
+detection, retrieval, legacy Dice, and text.
+"""
+
+import numpy as np
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+from tests.helpers.testers import _assert_allclose as _assert_tree_close
+
+RNG = np.random.default_rng(123)
+
+
+def test_retrieval_map_merge_equals_single():
+    idx = RNG.integers(0, 8, 128)
+    p = RNG.random(128).astype(np.float32)
+    t = RNG.integers(0, 2, 128)
+    single = tm.retrieval.RetrievalMAP()
+    single.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+    a = tm.retrieval.RetrievalMAP()
+    b = tm.retrieval.RetrievalMAP()
+    a.update(jnp.asarray(p[:64]), jnp.asarray(t[:64]), indexes=jnp.asarray(idx[:64]))
+    b.update(jnp.asarray(p[64:]), jnp.asarray(t[64:]), indexes=jnp.asarray(idx[64:]))
+    a.merge_state(b)
+    _assert_tree_close(a.compute(), single.compute())
+
+
+def test_retrieval_aggregation_merge_equals_single():
+    idx = RNG.integers(0, 6, 90)
+    p = RNG.random(90).astype(np.float32)
+    t = RNG.integers(0, 2, 90)
+    for agg in ("median", "max"):
+        single = tm.retrieval.RetrievalNormalizedDCG(aggregation=agg)
+        single.update(jnp.asarray(p), jnp.asarray(t), indexes=jnp.asarray(idx))
+        a = tm.retrieval.RetrievalNormalizedDCG(aggregation=agg)
+        b = tm.retrieval.RetrievalNormalizedDCG(aggregation=agg)
+        a.update(jnp.asarray(p[:30]), jnp.asarray(t[:30]), indexes=jnp.asarray(idx[:30]))
+        b.update(jnp.asarray(p[30:]), jnp.asarray(t[30:]), indexes=jnp.asarray(idx[30:]))
+        a.merge_state(b)
+        _assert_tree_close(a.compute(), single.compute())
+
+
+def _det_inputs(n_img):
+    preds, target = [], []
+    for _ in range(n_img):
+        ng = int(RNG.integers(2, 5))
+        xy = RNG.random((ng, 2)) * 60
+        wh = RNG.random((ng, 2)) * 30 + 4
+        tb = np.concatenate([xy, xy + wh], 1).astype(np.float32)
+        pb = tb + RNG.normal(0, 3, tb.shape).astype(np.float32)
+        preds.append(
+            dict(
+                boxes=jnp.asarray(pb),
+                scores=jnp.asarray(RNG.random(ng, dtype=np.float32)),
+                labels=jnp.asarray(RNG.integers(0, 3, ng)),
+            )
+        )
+        target.append(dict(boxes=jnp.asarray(tb), labels=jnp.asarray(RNG.integers(0, 3, ng))))
+    return preds, target
+
+
+def test_mean_ap_merge_equals_single():
+    preds, target = _det_inputs(6)
+    single = tm.detection.MeanAveragePrecision()
+    single.update(preds, target)
+    a = tm.detection.MeanAveragePrecision()
+    b = tm.detection.MeanAveragePrecision()
+    a.update(preds[:3], target[:3])
+    b.update(preds[3:], target[3:])
+    a.merge_state(b)
+    _assert_tree_close(a.compute(), single.compute())
+
+
+def test_dice_samplewise_merge_equals_single():
+    p = RNG.integers(0, 4, (12, 6))
+    t = RNG.integers(0, 4, (12, 6))
+    kw = dict(average="macro", mdmc_average="samplewise", num_classes=4)
+    single = tm.classification.Dice(**kw)
+    single.update(jnp.asarray(p), jnp.asarray(t))
+    a = tm.classification.Dice(**kw)
+    b = tm.classification.Dice(**kw)
+    a.update(jnp.asarray(p[:6]), jnp.asarray(t[:6]))
+    b.update(jnp.asarray(p[6:]), jnp.asarray(t[6:]))
+    a.merge_state(b)
+    _assert_tree_close(a.compute(), single.compute())
+
+
+def test_wer_merge_equals_single():
+    preds = ["the cat sat on the mat", "hello world", "a b c d", "jax on tpu"]
+    refs = ["the cat sat on a mat", "hello there world", "a b c d", "jax on tpus"]
+    single = tm.text.WordErrorRate()
+    single.update(preds, refs)
+    a = tm.text.WordErrorRate()
+    b = tm.text.WordErrorRate()
+    a.update(preds[:2], refs[:2])
+    b.update(preds[2:], refs[2:])
+    a.merge_state(b)
+    _assert_tree_close(a.compute(), single.compute())
+
+
+def test_mean_ap_forward_matches_update_compute():
+    preds, target = _det_inputs(4)
+    m1 = tm.detection.MeanAveragePrecision()
+    m1.update(preds, target)
+    r1 = m1.compute()
+    m2 = tm.detection.MeanAveragePrecision()
+    for i in range(4):
+        m2.forward([preds[i]], [target[i]])
+    _assert_tree_close(m2.compute(), r1)

@@ -125,3 +125,45 @@ def test_clustering_kwargs_passthrough():
     assert m.average_method == "geometric"
     v = tm.clustering.VMeasureScore(beta=2.0)
     assert v.beta == 2.0
+
+
+def test_nmi_ami_average_method_numerics():
+    import torchmetrics.clustering as ref_clustering
+
+    p = RNG.integers(0, 5, 200)
+    t = RNG.integers(0, 4, 200)
+    for am in ("min", "geometric", "arithmetic", "max"):
+        for cls_name in ("NormalizedMutualInfoScore", "AdjustedMutualInfoScore"):
+            r = getattr(ref_clustering, cls_name)(average_method=am)
+            o = getattr(tm.clustering, cls_name)(average_method=am)
+            r.update(torch.tensor(p), torch.tensor(t))
+            o.update(jnp.asarray(p), jnp.asarray(t))
+            np.testing.assert_allclose(float(o.compute()), float(r.compute()), atol=1e-5, err_msg=f"{cls_name}/{am}")
+
+
+def test_vmeasure_beta_numerics():
+    import torchmetrics.clustering as ref_clustering
+
+    p = RNG.integers(0, 5, 200)
+    t = RNG.integers(0, 4, 200)
+    for beta in (0.5, 1.0, 2.0):
+        r = ref_clustering.VMeasureScore(beta=beta)
+        o = tm.clustering.VMeasureScore(beta=beta)
+        r.update(torch.tensor(p), torch.tensor(t))
+        o.update(jnp.asarray(p), jnp.asarray(t))
+        # float32 entropy accumulation: allow small relative drift
+        np.testing.assert_allclose(float(o.compute()), float(r.compute()), rtol=1e-3, atol=1e-6, err_msg=str(beta))
+
+
+def test_nominal_nan_strategy_numerics():
+    import torchmetrics.nominal as ref_nominal
+
+    p = RNG.integers(0, 5, 200).astype(np.float32)
+    t = RNG.integers(0, 4, 200).astype(np.float32)
+    p[::17] = np.nan
+    for strat in ("replace", "drop"):
+        r = ref_nominal.CramersV(num_classes=5, nan_strategy=strat, nan_replace_value=0.0)
+        o = tm.nominal.CramersV(num_classes=5, nan_strategy=strat, nan_replace_value=0.0)
+        r.update(torch.tensor(p), torch.tensor(t))
+        o.update(jnp.asarray(p), jnp.asarray(t))
+        np.testing.assert_allclose(float(o.compute()), float(r.compute()), atol=1e-5, err_msg=strat)
