@@ -1,0 +1,58 @@
+"""Accelerator-vs-CPU numeric consistency for precision-sensitive kernels.
+
+The TPU MXU rounds f32 matmul/conv operands to bf16 by default; every
+metric kernel that reduces arbitrary floats through a matmul or conv must
+either use segment ops or request ``precision="highest"``. These tests
+pin that: the same computation on the accelerator and on the CPU backend
+must agree to float32 tolerance. They are skipped in the CPU-pinned CI
+mesh (conftest pins ``jax_platforms=cpu``) and run when a real chip is
+the default backend (e.g. the verify drive).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+if jax.default_backend() == "cpu":
+    pytest.skip("single-backend run: nothing to cross-check", allow_module_level=True)
+
+from torchmetrics_tpu.functional.classification import binary_calibration_error
+from torchmetrics_tpu.functional.clustering import (
+    calinski_harabasz_score,
+    davies_bouldin_score,
+    dunn_index,
+)
+from torchmetrics_tpu.functional.image import structural_similarity_index_measure
+from torchmetrics_tpu.functional.pairwise import (
+    pairwise_cosine_similarity,
+    pairwise_linear_similarity,
+)
+
+RNG = np.random.default_rng(0)
+DATA = RNG.random((64, 8), dtype=np.float32)
+LABELS = RNG.integers(0, 5, 64)
+IMGS1 = RNG.random((2, 3, 32, 32), dtype=np.float32)
+IMGS2 = RNG.random((2, 3, 32, 32), dtype=np.float32)
+CONF = RNG.random(200, dtype=np.float32)
+LAB2 = RNG.integers(0, 2, 200)
+
+CASES = {
+    "dunn": (dunn_index, (DATA, LABELS)),
+    "calinski": (calinski_harabasz_score, (DATA, LABELS)),
+    "davies_bouldin": (davies_bouldin_score, (DATA, LABELS)),
+    "pairwise_cosine": (pairwise_cosine_similarity, (DATA, DATA)),
+    "pairwise_linear": (pairwise_linear_similarity, (DATA, DATA)),
+    "calibration": (lambda p, t: binary_calibration_error(p, t, n_bins=15), (CONF, LAB2)),
+    "ssim": (structural_similarity_index_measure, (IMGS1, IMGS2)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(CASES))
+def test_accelerator_matches_cpu(name):
+    fn, args = CASES[name]
+    accel = np.asarray(fn(*[jnp.asarray(a) for a in args]))
+    with jax.default_device(jax.devices("cpu")[0]):
+        host = np.asarray(fn(*[jnp.asarray(np.asarray(a)) for a in args]))
+    np.testing.assert_allclose(accel, host, atol=5e-6, rtol=1e-5, err_msg=name)

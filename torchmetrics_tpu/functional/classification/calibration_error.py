@@ -1,8 +1,9 @@
 """Calibration error: binned ECE/MCE (reference ``functional/classification/calibration_error.py``).
 
-TPU note: the binning is a one-hot bucket matmul (static ``n_bins`` shape)
-instead of torch's ``bucketize``+``scatter_add`` — jit-friendly and
-accumulator-compatible.
+TPU note: the binning is a ``segment_sum`` over bucket indices (static
+``n_bins`` shape) instead of torch's ``bucketize``+``scatter_add`` —
+jit-friendly, accumulator-compatible, and exact in f32 (a one-hot matmul
+would round through bf16 on the MXU).
 """
 
 from __future__ import annotations
@@ -27,10 +28,15 @@ def _binning_bucketize(confidences: Array, accuracies: Array, bin_boundaries: Ar
     idx = jnp.clip(
         jnp.searchsorted(bin_boundaries[1:-1], confidences, side="right", method="compare_all"), 0, n_bins - 1
     )
-    oh = jax.nn.one_hot(idx, n_bins, dtype=jnp.float32)
-    counts = oh.sum(axis=0)
-    conf_bin = _safe_divide(oh.T @ confidences.astype(jnp.float32), counts)
-    acc_bin = _safe_divide(oh.T @ accuracies.astype(jnp.float32), counts)
+    # segment_sum, not a one-hot matmul: float matmuls drop to bf16 on the
+    # TPU MXU by default, which shifts the per-bin means
+    counts = jax.ops.segment_sum(jnp.ones(idx.shape[0], jnp.float32), idx, num_segments=n_bins)
+    conf_bin = _safe_divide(
+        jax.ops.segment_sum(confidences.astype(jnp.float32), idx, num_segments=n_bins), counts
+    )
+    acc_bin = _safe_divide(
+        jax.ops.segment_sum(accuracies.astype(jnp.float32), idx, num_segments=n_bins), counts
+    )
     prop_bin = counts / confidences.shape[0]
     return acc_bin, conf_bin, prop_bin
 

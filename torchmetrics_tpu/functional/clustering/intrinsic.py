@@ -27,10 +27,12 @@ def _cluster_stats(data: Array, labels: Array):
     from torchmetrics_tpu.functional.clustering.utils import _relabel
 
     lab, k = _relabel(labels)
-    oh = jax.nn.one_hot(lab, k, dtype=jnp.float32)  # (N, K)
-    counts = oh.sum(axis=0)  # (K,)
-    centroids = (oh.T @ data) / jnp.maximum(counts[:, None], 1.0)  # (K, D)
-    return lab, k, oh, counts, centroids
+    # segment_sum, not a one-hot matmul: float matmuls drop to bf16 on the
+    # TPU MXU by default, visibly shifting centroids
+    counts = jax.ops.segment_sum(jnp.ones(data.shape[0], jnp.float32), lab, num_segments=k)
+    sums = jax.ops.segment_sum(data, lab, num_segments=k)
+    centroids = sums / jnp.maximum(counts[:, None], 1.0)  # (K, D)
+    return lab, k, counts, centroids
 
 
 def calinski_harabasz_score(data: Array, labels: Array) -> Array:
@@ -47,7 +49,7 @@ def calinski_harabasz_score(data: Array, labels: Array) -> Array:
     data = jnp.asarray(data, jnp.float32)
     _validate_intrinsic_cluster_data(data, labels)
     n = data.shape[0]
-    lab, k, oh, counts, centroids = _cluster_stats(data, labels)
+    lab, k, counts, centroids = _cluster_stats(data, labels)
     mean_all = data.mean(axis=0)
     between = jnp.sum(counts * jnp.sum((centroids - mean_all) ** 2, axis=1))
     within = jnp.sum((data - centroids[lab]) ** 2)
@@ -58,10 +60,10 @@ def davies_bouldin_score(data: Array, labels: Array) -> Array:
     """Average worst-case within-to-between cluster similarity ratio."""
     data = jnp.asarray(data, jnp.float32)
     _validate_intrinsic_cluster_data(data, labels)
-    lab, k, oh, counts, centroids = _cluster_stats(data, labels)
+    lab, k, counts, centroids = _cluster_stats(data, labels)
     # mean intra-cluster distance (scatter) per cluster
     dists = jnp.linalg.norm(data - centroids[lab], axis=1)
-    scatter = (oh.T @ dists) / jnp.maximum(counts, 1.0)  # (K,)
+    scatter = jax.ops.segment_sum(dists, lab, num_segments=k) / jnp.maximum(counts, 1.0)  # (K,)
     # centroid distances
     cdist = jnp.linalg.norm(centroids[:, None, :] - centroids[None, :, :], axis=-1)
     ratio = (scatter[:, None] + scatter[None, :]) / jnp.where(cdist == 0, jnp.inf, cdist)
@@ -70,16 +72,28 @@ def davies_bouldin_score(data: Array, labels: Array) -> Array:
 
 
 def dunn_index(data: Array, labels: Array, p: float = 2.0) -> Array:
-    """Min inter-cluster distance / max intra-cluster diameter."""
+    """Dunn index, centroid form (reference ``functional/clustering/dunn_index.py``).
+
+    Inter-cluster distance = p-norm between cluster centroids; intra-cluster
+    diameter = max p-norm from a point to its own centroid. Centroids come
+    from ``segment_sum`` (exact f32) rather than the reference's per-cluster
+    python loop.
+    """
     data = jnp.asarray(data, jnp.float32)
     _validate_intrinsic_cluster_data(data, labels)
     lab_np = np.asarray(labels)
     uniq = np.unique(lab_np)
-    lab = np.searchsorted(uniq, lab_np)
-    pd = jnp.sum(jnp.abs(data[:, None, :] - data[None, :, :]) ** p, axis=-1) ** (1.0 / p)
-    same = lab[:, None] == lab[None, :]
-    same = jnp.asarray(same)
-    max_intra = jnp.max(jnp.where(same, pd, 0.0))
-    inter = jnp.where(~same, pd, jnp.inf)
-    min_inter = jnp.min(inter)
+    lab = jnp.asarray(np.searchsorted(uniq, lab_np))
+    k = len(uniq)
+    # segment_sum, not a one-hot matmul: float matmuls drop to bf16 on the
+    # MXU by default, which visibly shifts centroids
+    sums = jax.ops.segment_sum(data, lab, num_segments=k)
+    counts = jnp.maximum(jax.ops.segment_sum(jnp.ones(data.shape[0], jnp.float32), lab, num_segments=k), 1.0)
+    centroids = sums / counts[:, None]  # (k, D)
+    diff = centroids[:, None, :] - centroids[None, :, :]
+    inter = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+    off_diag = ~jnp.eye(k, dtype=bool)
+    min_inter = jnp.min(jnp.where(off_diag, inter, jnp.inf))
+    to_centroid = jnp.sum(jnp.abs(data - centroids[lab]) ** p, axis=-1) ** (1.0 / p)
+    max_intra = jnp.max(to_centroid)
     return min_inter / jnp.maximum(max_intra, 1e-30)

@@ -36,6 +36,9 @@ def _depthwise_conv2d(x: Array, kernel: Array) -> Array:
         padding="VALID",
         dimension_numbers=("NCHW", "OIHW", "NCHW"),
         feature_group_count=c,
+        # full-f32 window sums: the MXU's default bf16 rounding shifts
+        # SSIM/UQI statistics off the reference
+        precision=lax.Precision.HIGHEST,
     )
 
 

@@ -100,10 +100,12 @@ def _psnrb_compute_bef(x: Array, block_size: int = 8) -> Array:
     d_bc = jnp.sum((x[..., :, h_bc] - x[..., :, h_bc + 1]) ** 2) + jnp.sum(
         (x[..., v_bc, :] - x[..., v_bc + 1, :]) ** 2
     )
-    n_hb = height * len(h_b)
-    n_hbc = height * len(h_bc)
-    n_vb = width * len(v_b)
-    n_vbc = width * len(v_bc)
+    # the reference's normalization counts (``psnrb.py:58-63``) are analytic
+    # formulas, NOT the actual index counts — replicate them exactly
+    n_hb = height * (width / block_size) - 1
+    n_hbc = (height * (width - 1)) - n_hb
+    n_vb = width * (height / block_size) - 1
+    n_vbc = (width * (height - 1)) - n_vb
     d_b = d_b / (n_hb + n_vb)
     d_bc = d_bc / (n_hbc + n_vbc)
     t = jnp.log2(jnp.asarray(block_size, jnp.float32)) / jnp.log2(jnp.asarray(min(height, width), jnp.float32))
@@ -123,4 +125,6 @@ def peak_signal_noise_ratio_with_blocked_effect(
     sum_squared_error, num_obs = _psnr_update(preds, target)
     bef = _psnrb_compute_bef(preds, block_size=block_size)
     mse = sum_squared_error / num_obs
-    return 10.0 * jnp.log10(data_range**2 / (mse + bef))
+    # low-range data uses a unit numerator (reference ``psnrb.py:84-87``)
+    num = jnp.where(data_range > 2, data_range**2, 1.0)
+    return 10.0 * jnp.log10(num / (mse + bef))

@@ -25,7 +25,12 @@ def _conv2d_valid(x: Array, kernel: Array) -> Array:
     """(N, 1, H, W) valid conv with a 2D kernel."""
     k = kernel[None, None, :, :]
     return lax.conv_general_dilated(
-        x, k, window_strides=(1, 1), padding="VALID", dimension_numbers=("NCHW", "OIHW", "NCHW")
+        x,
+        k,
+        window_strides=(1, 1),
+        padding="VALID",
+        dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        precision=lax.Precision.HIGHEST,
     )
 
 

@@ -75,7 +75,7 @@ def _clip_iqa_compute(
     format_as_dict: bool = True,
 ) -> Union[Array, Dict[str, Array]]:
     """Softmax over each positive/negative anchor pair → P(positive)."""
-    logits_per_image = 100 * img_features @ anchors.T
+    logits_per_image = 100 * jnp.matmul(img_features, anchors.T, precision="highest")
     probs = jax.nn.softmax(logits_per_image.reshape(logits_per_image.shape[0], -1, 2), axis=-1)[:, :, 0]
     if len(prompts_names) == 1:
         return probs.squeeze()

@@ -14,6 +14,8 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from torchmetrics_tpu.utilities.compute import _safe_matmul
+
 Array = jax.Array
 
 
@@ -71,7 +73,7 @@ def pairwise_cosine_similarity(
     x, y, zd = _check_input(x, y, zero_diagonal)
     x = x / jnp.maximum(jnp.linalg.norm(x, axis=1, keepdims=True), 1e-38)
     y = y / jnp.maximum(jnp.linalg.norm(y, axis=1, keepdims=True), 1e-38)
-    distance = x @ y.T
+    distance = _safe_matmul(x, y)
     return _reduce_distance_matrix(_zero_diagonal(distance, zd), reduction)
 
 
@@ -94,7 +96,7 @@ def pairwise_euclidean_distance(
     x, y, zd = _check_input(x, y, zero_diagonal)
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1)
-    distance = x_norm + y_norm[None, :] - 2 * (x @ y.T)
+    distance = x_norm + y_norm[None, :] - 2 * _safe_matmul(x, y)
     distance = jnp.sqrt(jnp.maximum(distance, 0.0))
     return _reduce_distance_matrix(_zero_diagonal(distance, zd), reduction)
 
@@ -159,5 +161,5 @@ def pairwise_linear_similarity(
         2.0
     """
     x, y, zd = _check_input(x, y, zero_diagonal)
-    distance = x @ y.T
+    distance = _safe_matmul(x, y)
     return _reduce_distance_matrix(_zero_diagonal(distance, zd), reduction)

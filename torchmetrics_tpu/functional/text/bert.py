@@ -87,7 +87,7 @@ def _greedy_cosine_matching(
 ) -> Tuple[Array, Array, Array]:
     """Weighted greedy matching: each token pairs with its best cosine match."""
     norm = lambda e: e / jnp.maximum(jnp.linalg.norm(e, axis=-1, keepdims=True), 1e-12)
-    sim = jnp.einsum("bpd,btd->bpt", norm(pred_emb), norm(tgt_emb))
+    sim = jnp.einsum("bpd,btd->bpt", norm(pred_emb), norm(tgt_emb), precision="highest")
     neg = -1e9
     sim_p = jnp.where(tgt_mask[:, None, :] > 0, sim, neg)
     sim_t = jnp.where(pred_mask[:, :, None] > 0, sim, neg)

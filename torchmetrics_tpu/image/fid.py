@@ -32,8 +32,9 @@ def _sqrtm_psd_trace_product(sigma1: Array, sigma2: Array) -> Array:
     """``tr sqrt(sigma1 @ sigma2)`` for symmetric PSD inputs via eigh."""
     # sigma1^(1/2)
     w1, v1 = jnp.linalg.eigh(sigma1)
-    sqrt_s1 = (v1 * jnp.sqrt(jnp.clip(w1, min=0.0))[None, :]) @ v1.T
-    inner = sqrt_s1 @ sigma2 @ sqrt_s1
+    hp = dict(precision="highest")  # keep f32 on the MXU; default bf16 visibly shifts FID
+    sqrt_s1 = jnp.matmul(v1 * jnp.sqrt(jnp.clip(w1, min=0.0))[None, :], v1.T, **hp)
+    inner = jnp.matmul(jnp.matmul(sqrt_s1, sigma2, **hp), sqrt_s1, **hp)
     w = jnp.linalg.eigvalsh((inner + inner.T) / 2.0)
     return jnp.sum(jnp.sqrt(jnp.clip(w, min=0.0)))
 
@@ -61,6 +62,7 @@ class FrechetInceptionDistance(Metric):
     higher_is_better: bool = False
     is_differentiable: bool = False
     full_state_update: bool = False
+    feature_network: str = "inception"
     plot_lower_bound: float = 0.0
 
     def __init__(
@@ -116,7 +118,7 @@ class FrechetInceptionDistance(Metric):
         if features.ndim == 1:
             features = features[None, :]
         f_sum = features.sum(axis=0)
-        f_cov = features.T @ features
+        f_cov = jnp.matmul(features.T, features, precision="highest")
         n = features.shape[0]
         if real:
             self.real_features_sum = self.real_features_sum + f_sum

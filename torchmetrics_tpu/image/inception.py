@@ -23,6 +23,7 @@ class InceptionScore(Metric):
     higher_is_better: bool = True
     is_differentiable: bool = False
     full_state_update: bool = False
+    feature_network: str = "inception"
     plot_lower_bound: float = 0.0
 
     def __init__(

@@ -18,7 +18,7 @@ def poly_kernel(f1: Array, f2: Array, degree: int = 3, gamma: Optional[float] = 
     """Polynomial kernel matrix between two feature sets."""
     if gamma is None:
         gamma = 1.0 / f1.shape[1]
-    return (f1 @ f2.T * gamma + coef) ** degree
+    return (jnp.matmul(f1, f2.T, precision="highest") * gamma + coef) ** degree
 
 
 def maximum_mean_discrepancy(k_xx: Array, k_xy: Array, k_yy: Array) -> Array:
@@ -44,6 +44,7 @@ class KernelInceptionDistance(Metric):
     higher_is_better: bool = False
     is_differentiable: bool = False
     full_state_update: bool = False
+    feature_network: str = "inception"
     plot_lower_bound: float = 0.0
 
     def __init__(

@@ -25,6 +25,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
     is_differentiable: bool = True
     higher_is_better: bool = False
     full_state_update: bool = False
+    feature_network: str = "net"
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
 

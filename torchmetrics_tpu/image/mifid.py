@@ -18,7 +18,7 @@ def _compute_cosine_distance(features1: Array, features2: Array, cosine_distance
     """Mean of per-fake-sample thresholded minimal cosine distance to real set."""
     f1 = features1 / jnp.maximum(jnp.linalg.norm(features1, axis=1, keepdims=True), 1e-12)
     f2 = features2 / jnp.maximum(jnp.linalg.norm(features2, axis=1, keepdims=True), 1e-12)
-    d = 1.0 - jnp.abs(f1 @ f2.T)
+    d = 1.0 - jnp.abs(jnp.matmul(f1, f2.T, precision="highest"))
     mean_min_d = jnp.mean(jnp.min(d, axis=1))
     return jnp.where(mean_min_d < cosine_distance_eps, mean_min_d, 1.0)
 
@@ -29,6 +29,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
     higher_is_better: bool = False
     is_differentiable: bool = False
     full_state_update: bool = False
+    feature_network: str = "inception"
     plot_lower_bound: float = 0.0
 
     def __init__(

@@ -130,6 +130,7 @@ class PerceptualPathLength(Metric):
     is_differentiable: bool = False
     higher_is_better: bool = False
     full_state_update: bool = True
+    feature_network: str = "sim_net"
 
     def __init__(
         self,

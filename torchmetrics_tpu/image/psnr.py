@@ -143,4 +143,6 @@ class PeakSignalNoiseRatioWithBlockedEffect(Metric):
     def compute(self) -> Array:
         """PSNR-B over all accumulated batches."""
         mse = self.sum_squared_error / self.total
-        return 10.0 * jnp.log10(self.data_range**2 / (mse + self.bef))
+        # low-range data uses a unit numerator (reference ``psnrb.py:84-87``)
+        num = jnp.where(self.data_range > 2, self.data_range**2, 1.0)
+        return 10.0 * jnp.log10(num / (mse + self.bef))

@@ -36,6 +36,7 @@ class CLIPImageQualityAssessment(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = False
+    feature_network: str = "model"
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 1.0
 

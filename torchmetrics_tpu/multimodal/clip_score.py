@@ -33,6 +33,7 @@ class CLIPScore(Metric):
     is_differentiable = False
     higher_is_better = True
     full_state_update = True
+    feature_network: str = "model"
     plot_lower_bound: float = 0.0
     plot_upper_bound: float = 100.0
 

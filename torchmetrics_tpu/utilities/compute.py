@@ -17,10 +17,17 @@ Array = jax.Array
 
 
 def _safe_matmul(x: Array, y: Array) -> Array:
-    """Matmul that promotes half-precision inputs to float32 for MXU accumulation."""
+    """Matmul that promotes half-precision inputs to float32 for MXU accumulation.
+
+    ``precision="highest"`` keeps f32 operands at full precision on the TPU
+    MXU (the default silently rounds them to bf16, shifting pairwise
+    similarity values off the reference).
+    """
     if x.dtype in (jnp.float16, jnp.bfloat16) or y.dtype in (jnp.float16, jnp.bfloat16):
-        return (x.astype(jnp.float32) @ y.astype(jnp.float32).T).astype(x.dtype)
-    return x @ y.T
+        return jnp.matmul(
+            x.astype(jnp.float32), y.astype(jnp.float32).T, precision="highest"
+        ).astype(x.dtype)
+    return jnp.matmul(x, y.T, precision="highest")
 
 
 def _safe_xlogy(x: Array, y: Array) -> Array:

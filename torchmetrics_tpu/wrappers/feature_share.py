@@ -41,11 +41,13 @@ class NetworkCache:
         self.network = network
         self._cached = lru_cache(maxsize=max_size)(self._forward)
 
-    def _forward(self, ref: "_HashableRef") -> Any:
-        return self.network(ref.obj)
+    def _forward(self, *refs: "_HashableRef") -> Any:
+        return self.network(*(r.obj for r in refs))
 
-    def __call__(self, x: Any) -> Any:
-        return self._cached(_HashableRef(x))
+    def __call__(self, *args: Any) -> Any:
+        # multi-input extractors (e.g. LPIPS' pairwise net) cache on the
+        # identity tuple of all inputs
+        return self._cached(*(_HashableRef(a) for a in args))
 
     def __getattr__(self, name: str) -> Any:
         return getattr(self.__dict__["network"], name)
