@@ -1,0 +1,126 @@
+"""SSIM / MS-SSIM parameter-matrix differential vs the reference oracle.
+
+Reference surface: ``functional/image/ssim.py`` — gaussian vs uniform
+windows, sigma/kernel sweeps, data_range modes, per-sample reduction, full
+image and contrast-sensitivity returns, MS-SSIM betas and normalize modes.
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.reference_oracle import load_reference
+
+torchmetrics = load_reference()
+if torchmetrics is None:
+    pytest.skip("reference checkout unavailable", allow_module_level=True)
+
+import torch  # noqa: E402
+
+from torchmetrics.functional.image import (  # noqa: E402
+    multiscale_structural_similarity_index_measure as ref_ms_ssim,
+    structural_similarity_index_measure as ref_ssim,
+)
+
+from torchmetrics_tpu.functional.image import (  # noqa: E402
+    multiscale_structural_similarity_index_measure as ms_ssim,
+    structural_similarity_index_measure as ssim,
+)
+
+RNG = np.random.default_rng(21)
+P = RNG.random((3, 3, 48, 48)).astype(np.float32)
+T = np.clip(P + 0.1 * RNG.standard_normal((3, 3, 48, 48)).astype(np.float32), 0, 1)
+P_BIG = RNG.random((1, 1, 192, 192)).astype(np.float32)
+T_BIG = np.clip(P_BIG + 0.05 * RNG.standard_normal(P_BIG.shape).astype(np.float32), 0, 1)
+
+
+def _cmp(kwargs, atol=1e-5):
+    ours = ssim(jnp.asarray(P), jnp.asarray(T), **kwargs)
+    ref = ref_ssim(torch.tensor(P), torch.tensor(T), **kwargs)
+    if isinstance(ours, tuple):
+        for o, r in zip(ours, ref):
+            np.testing.assert_allclose(np.asarray(o), r.numpy(), atol=atol, err_msg=str(kwargs))
+    else:
+        np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=atol, err_msg=str(kwargs))
+
+
+@pytest.mark.parametrize("gaussian_kernel", [True, False])
+@pytest.mark.parametrize("kernel_size", [7, 11])
+def test_ssim_window_matrix(gaussian_kernel, kernel_size):
+    _cmp(dict(gaussian_kernel=gaussian_kernel, kernel_size=kernel_size))
+
+
+@pytest.mark.parametrize("sigma", [0.8, 1.5, 2.5])
+def test_ssim_sigma(sigma):
+    _cmp(dict(sigma=sigma))
+
+
+@pytest.mark.parametrize("data_range", [None, 1.0, 2.0, (0.0, 1.0)])
+def test_ssim_data_range(data_range):
+    _cmp(dict(data_range=data_range))
+
+
+@pytest.mark.parametrize("reduction", ["elementwise_mean", "sum", "none"])
+def test_ssim_reduction(reduction):
+    _cmp(dict(reduction=reduction))
+
+
+def test_ssim_k_constants():
+    _cmp(dict(k1=0.02, k2=0.05))
+
+
+def test_ssim_full_image_and_contrast():
+    _cmp(dict(return_full_image=True))
+    _cmp(dict(return_contrast_sensitivity=True))
+
+
+@pytest.mark.parametrize("normalize", ["relu", None])
+def test_ms_ssim_normalize(normalize):
+    ours = ms_ssim(jnp.asarray(P_BIG), jnp.asarray(T_BIG), normalize=normalize)
+    ref = ref_ms_ssim(torch.tensor(P_BIG), torch.tensor(T_BIG), normalize=normalize)
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-4, err_msg=str(normalize))
+
+
+def test_ms_ssim_custom_betas():
+    betas = (0.3, 0.4, 0.3)
+    ours = ms_ssim(jnp.asarray(P_BIG), jnp.asarray(T_BIG), betas=betas)
+    ref = ref_ms_ssim(torch.tensor(P_BIG), torch.tensor(T_BIG), betas=betas)
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-4)
+
+
+def test_ssim_gaussian_false_uniform_window():
+    _cmp(dict(gaussian_kernel=False, kernel_size=9, reduction="none"))
+
+
+P3D = RNG.random((2, 1, 12, 16, 16)).astype(np.float32)
+T3D = np.clip(P3D + 0.1 * RNG.standard_normal(P3D.shape).astype(np.float32), 0, 1)
+
+
+@pytest.mark.parametrize(
+    "kwargs",
+    [dict(), dict(sigma=1.0), dict(gaussian_kernel=False, kernel_size=5), dict(reduction="none")],
+)
+def test_ssim_3d_volumetric(kwargs):
+    ours = ssim(jnp.asarray(P3D), jnp.asarray(T3D), **kwargs)
+    ref = ref_ssim(torch.tensor(P3D), torch.tensor(T3D), **kwargs)
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-5, err_msg=str(kwargs))
+
+
+def test_ssim_3d_class_streaming():
+    from torchmetrics_tpu.image import StructuralSimilarityIndexMeasure
+
+    m = StructuralSimilarityIndexMeasure()
+    m.update(jnp.asarray(P3D[:1]), jnp.asarray(T3D[:1]))
+    m.update(jnp.asarray(P3D[1:]), jnp.asarray(T3D[1:]))
+    full = float(ssim(jnp.asarray(P3D), jnp.asarray(T3D)))
+    np.testing.assert_allclose(float(m.compute()), full, atol=1e-6)
+
+
+def test_ms_ssim_3d_volumetric():
+    p = RNG.random((1, 1, 96, 96, 96)).astype(np.float32)
+    t = np.clip(p + 0.05 * RNG.standard_normal(p.shape).astype(np.float32), 0, 1)
+    betas = (0.3, 0.4, 0.3)
+    ours = ms_ssim(jnp.asarray(p), jnp.asarray(t), betas=betas)
+    ref = ref_ms_ssim(torch.tensor(p), torch.tensor(t), betas=betas)
+    np.testing.assert_allclose(np.asarray(ours), ref.numpy(), atol=1e-4)

@@ -42,6 +42,21 @@ def _depthwise_conv2d(x: Array, kernel: Array) -> Array:
     )
 
 
+def _depthwise_conv3d(x: Array, kernel: Array) -> Array:
+    """Depthwise valid 3D conv. ``x``: (N, C, D, H, W); ``kernel``: (kd, kh, kw)."""
+    c = x.shape[1]
+    k = jnp.broadcast_to(kernel[None, None], (c, 1, *kernel.shape))
+    return lax.conv_general_dilated(
+        x,
+        k,
+        window_strides=(1, 1, 1),
+        padding="VALID",
+        dimension_numbers=("NCDHW", "OIDHW", "NCDHW"),
+        feature_group_count=c,
+        precision=lax.Precision.HIGHEST,
+    )
+
+
 def _gaussian_filter2d(x: Array, kernel_size: Sequence[int], sigma: Sequence[float]) -> Array:
     kh = _gaussian_kernel_1d(kernel_size[0], sigma[0])
     kw = _gaussian_kernel_1d(kernel_size[1], sigma[1])

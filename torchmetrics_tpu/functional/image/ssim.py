@@ -15,6 +15,7 @@ import jax.numpy as jnp
 from torchmetrics_tpu.functional.image.helper import (
     _check_image_pair,
     _depthwise_conv2d,
+    _depthwise_conv3d,
     _gaussian_kernel_1d,
     _uniform_kernel_1d,
 )
@@ -24,8 +25,10 @@ Array = jax.Array
 
 def _ssim_check_inputs(preds: Array, target: Array) -> Tuple[Array, Array]:
     preds, target = _check_image_pair(preds, target)
-    if preds.ndim != 4:
-        raise ValueError(f"Expected `preds` and `target` to have BxCxHxW shape, got {preds.shape}")
+    if preds.ndim not in (4, 5):
+        raise ValueError(
+            f"Expected `preds` and `target` to have BxCxHxW or BxCxDxHxW shape, got {preds.shape}"
+        )
     return preds, target
 
 
@@ -41,10 +44,16 @@ def _ssim_update(
     return_full_image: bool = False,
     return_contrast_sensitivity: bool = False,
 ):
+    n_sp = preds.ndim - 2  # 2 for BxCxHxW, 3 for volumetric BxCxDxHxW
     if isinstance(kernel_size, int):
-        kernel_size = (kernel_size, kernel_size)
+        kernel_size = (kernel_size,) * n_sp
     if isinstance(sigma, (int, float)):
-        sigma = (float(sigma), float(sigma))
+        sigma = (float(sigma),) * n_sp
+    if len(kernel_size) != n_sp or len(sigma) != n_sp:
+        raise ValueError(
+            f"`kernel_size`/`sigma` must have {n_sp} entries for input of shape {preds.shape},"
+            f" got {kernel_size} and {sigma}"
+        )
     if data_range is None:
         data_range = jnp.maximum(jnp.max(preds) - jnp.min(preds), jnp.max(target) - jnp.min(target))
     elif isinstance(data_range, tuple):
@@ -55,27 +64,34 @@ def _ssim_update(
     c1 = (k1 * data_range) ** 2
     c2 = (k2 * data_range) ** 2
 
+    # the gaussian window size is derived from sigma, NOT `kernel_size`
+    # (reference ``ssim.py:125``); the pad comes from that derived size in
+    # BOTH modes, so uniform-window borders also reflect over it
+    gauss_kernel_size = tuple(int(3.5 * s + 0.5) * 2 + 1 for s in sigma)
     if gaussian_kernel:
-        kh = _gaussian_kernel_1d(kernel_size[0], sigma[0])
-        kw = _gaussian_kernel_1d(kernel_size[1], sigma[1])
+        kernels_1d = [_gaussian_kernel_1d(g, s) for g, s in zip(gauss_kernel_size, sigma)]
     else:
-        kh = _uniform_kernel_1d(kernel_size[0])
-        kw = _uniform_kernel_1d(kernel_size[1])
-    kernel = jnp.outer(kh, kw)
+        kernels_1d = [_uniform_kernel_1d(k) for k in kernel_size]
+    if n_sp == 2:
+        kernel = jnp.outer(kernels_1d[0], kernels_1d[1])
+        conv = _depthwise_conv2d
+    else:
+        kernel = jnp.einsum("i,j,k->ijk", *kernels_1d)
+        conv = _depthwise_conv3d
 
-    pad_h = (kernel_size[0] - 1) // 2
-    pad_w = (kernel_size[1] - 1) // 2
-    preds_p = jnp.pad(preds, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
-    target_p = jnp.pad(target, ((0, 0), (0, 0), (pad_h, pad_h), (pad_w, pad_w)), mode="reflect")
+    pads = tuple((g - 1) // 2 for g in gauss_kernel_size)
+    pad_cfg = ((0, 0), (0, 0)) + tuple((p, p) for p in pads)
+    preds_p = jnp.pad(preds, pad_cfg, mode="reflect")
+    target_p = jnp.pad(target, pad_cfg, mode="reflect")
 
-    mu_x = _depthwise_conv2d(preds_p, kernel)
-    mu_y = _depthwise_conv2d(target_p, kernel)
-    mu_xx = _depthwise_conv2d(preds_p * preds_p, kernel)
-    mu_yy = _depthwise_conv2d(target_p * target_p, kernel)
-    mu_xy = _depthwise_conv2d(preds_p * target_p, kernel)
+    mu_x = conv(preds_p, kernel)
+    mu_y = conv(target_p, kernel)
+    mu_xx = conv(preds_p * preds_p, kernel)
+    mu_yy = conv(target_p * target_p, kernel)
+    mu_xy = conv(preds_p * target_p, kernel)
 
-    sigma_x = mu_xx - mu_x**2
-    sigma_y = mu_yy - mu_y**2
+    sigma_x = jnp.clip(mu_xx - mu_x**2, min=0.0)
+    sigma_y = jnp.clip(mu_yy - mu_y**2, min=0.0)
     sigma_xy = mu_xy - mu_x * mu_y
 
     upper = 2 * sigma_xy + c2
@@ -84,12 +100,14 @@ def _ssim_update(
     cs_map = upper / lower
     ssim_map = luminance * cs_map
 
-    # crop the padded border like the reference (outputs only the valid region)
-    ssim_map = ssim_map[..., pad_h:-pad_h if pad_h else None, pad_w:-pad_w if pad_w else None]
-    ssim_vals = ssim_map.reshape(ssim_map.shape[0], -1).mean(axis=-1)
+    # the per-image mean is over the pad-cropped region; `return_full_image`
+    # hands back the UNCROPPED map (reference ``ssim.py:165-183``)
+    crop = (Ellipsis,) + tuple(slice(p, -p if p else None) for p in pads)
+    ssim_cropped = ssim_map[crop]
+    ssim_vals = ssim_cropped.reshape(ssim_cropped.shape[0], -1).mean(axis=-1)
 
     if return_contrast_sensitivity:
-        cs_map = cs_map[..., pad_h:-pad_h if pad_h else None, pad_w:-pad_w if pad_w else None]
+        cs_map = cs_map[crop]
         return ssim_vals, cs_map.reshape(cs_map.shape[0], -1).mean(axis=-1)
     if return_full_image:
         return ssim_vals, ssim_map
@@ -191,12 +209,12 @@ def multiscale_structural_similarity_index_measure(
         )
         mcs_list.append(cs)
         if i < len(betas) - 1:
-            preds = jax.lax.reduce_window(
-                preds, 0.0, jax.lax.add, (1, 1, 2, 2), (1, 1, 2, 2), "VALID"
-            ) / 4.0
-            target = jax.lax.reduce_window(
-                target, 0.0, jax.lax.add, (1, 1, 2, 2), (1, 1, 2, 2), "VALID"
-            ) / 4.0
+            # avg-pool(2) per scale; volumetric inputs pool depth too
+            # (reference uses avg_pool3d for 5D)
+            window = (1, 1) + (2,) * (preds.ndim - 2)
+            scale = float(2 ** (preds.ndim - 2))
+            preds = jax.lax.reduce_window(preds, 0.0, jax.lax.add, window, window, "VALID") / scale
+            target = jax.lax.reduce_window(target, 0.0, jax.lax.add, window, window, "VALID") / scale
 
     mcs_list[-1] = sim
     mcs_stack = jnp.stack(mcs_list, axis=0)  # (S, N)
