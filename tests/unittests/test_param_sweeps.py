@@ -1,0 +1,224 @@
+"""Parameter-sweep differential coverage vs the reference oracle.
+
+Regression reductions/multioutput/variants, audio zero_mean/filter_length,
+PSNR base/reduction/dim/data-range modes — the kwarg surfaces the per-metric
+suites don't enumerate. dB-valued metrics get 1e-3 tolerance (f32 log noise).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.reference_oracle import load_reference
+
+torchmetrics = load_reference()
+if torchmetrics is None:
+    pytest.skip("reference checkout unavailable", allow_module_level=True)
+
+import torch  # noqa: E402
+
+import torchmetrics.functional.audio  # noqa: E402
+import torchmetrics.functional.image  # noqa: E402
+
+import torchmetrics_tpu as tm  # noqa: E402
+
+RF = torchmetrics.functional
+RFA = torchmetrics.functional.audio
+F = tm.functional
+
+RNG = np.random.default_rng(9)
+a = RNG.random(64).astype(np.float32)
+b = RNG.random(64).astype(np.float32)
+A = RNG.random((64, 3)).astype(np.float32)
+B = RNG.random((64, 3)).astype(np.float32)
+SIG = RNG.standard_normal((3, 256)).astype(np.float32)
+SIG2 = SIG + 0.2 * RNG.standard_normal((3, 256)).astype(np.float32)
+IMG1 = RNG.random((2, 3, 24, 24)).astype(np.float32)
+IMG2 = RNG.random((2, 3, 24, 24)).astype(np.float32)
+
+
+def _cmp(ours_fn, ref_fn, args, kwargs, atol=1e-5):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        ref = ref_fn(*[torch.as_tensor(x) for x in args], **kwargs)
+    ours = ours_fn(*[jnp.asarray(x) for x in args], **kwargs)
+    np.testing.assert_allclose(np.asarray(ours), ref.detach().numpy(), atol=atol, err_msg=str(kwargs))
+
+
+@pytest.mark.parametrize("reduction", ["sum", "mean", "none"])
+def test_cosine_similarity_reductions(reduction):
+    _cmp(F.cosine_similarity, RF.cosine_similarity, (A, B), dict(reduction=reduction))
+
+
+@pytest.mark.parametrize("p", [1.0, 2.0, 3.5])
+def test_minkowski_p(p):
+    _cmp(F.minkowski_distance, RF.minkowski_distance, (a, b), dict(p=p))
+
+
+@pytest.mark.parametrize("power", [0.0, 1.0, 1.5, 2.0, 3.0])
+def test_tweedie_powers(power):
+    _cmp(F.tweedie_deviance_score, RF.tweedie_deviance_score, (a + 0.1, b + 0.1), dict(power=power))
+
+
+@pytest.mark.parametrize("log_prob", [True, False])
+def test_kl_divergence_log_prob(log_prob):
+    p = A / A.sum(1, keepdims=True)
+    q = B / B.sum(1, keepdims=True)
+    pl = np.log(p) if log_prob else p
+    _cmp(F.kl_divergence, RF.kl_divergence, (pl, q), dict(log_prob=log_prob))
+
+
+@pytest.mark.parametrize("multioutput", ["raw_values", "uniform_average"])
+def test_r2_explained_variance_multioutput(multioutput):
+    _cmp(F.r2_score, RF.r2_score, (A, B), dict(multioutput=multioutput))
+    _cmp(F.explained_variance, RF.explained_variance, (A, B), dict(multioutput=multioutput))
+
+
+def test_r2_adjusted():
+    _cmp(F.r2_score, RF.r2_score, (a, b), dict(adjusted=5))
+
+
+@pytest.mark.parametrize("variant", ["a", "b", "c"])
+def test_kendall_variants(variant):
+    _cmp(F.kendall_rank_corrcoef, RF.kendall_rank_corrcoef, (a, b), dict(variant=variant))
+
+
+def test_misc_regression():
+    _cmp(F.mean_squared_error, RF.mean_squared_error, (a, b), dict(squared=False))
+    _cmp(F.weighted_mean_absolute_percentage_error, RF.weighted_mean_absolute_percentage_error, (a, b), {})
+    _cmp(F.symmetric_mean_absolute_percentage_error, RF.symmetric_mean_absolute_percentage_error, (a, b), {})
+    _cmp(F.log_cosh_error, RF.log_cosh_error, (a, b), {})
+    _cmp(F.spearman_corrcoef, RF.spearman_corrcoef, (A, B), {})
+
+
+@pytest.mark.parametrize("zero_mean", [True, False])
+def test_audio_zero_mean(zero_mean):
+    _cmp(F.signal_noise_ratio, RFA.signal_noise_ratio, (SIG2, SIG), dict(zero_mean=zero_mean), atol=1e-3)
+    _cmp(
+        F.scale_invariant_signal_distortion_ratio,
+        RFA.scale_invariant_signal_distortion_ratio,
+        (SIG2, SIG),
+        dict(zero_mean=zero_mean),
+        atol=1e-3,
+    )
+
+
+@pytest.mark.parametrize("filter_length", [128, 512])
+def test_sdr_filter_length(filter_length):
+    long_sig = RNG.standard_normal((2, 2048)).astype(np.float32)
+    long_sig2 = long_sig + 0.2 * RNG.standard_normal((2, 2048)).astype(np.float32)
+    _cmp(
+        F.signal_distortion_ratio,
+        RFA.signal_distortion_ratio,
+        (long_sig2, long_sig),
+        dict(filter_length=filter_length),
+        atol=1e-2,
+    )
+
+
+@pytest.mark.parametrize("scale_invariant", [True, False])
+def test_sa_sdr(scale_invariant):
+    _cmp(
+        F.source_aggregated_signal_distortion_ratio,
+        RFA.source_aggregated_signal_distortion_ratio,
+        (SIG2[None], SIG[None]),
+        dict(scale_invariant=scale_invariant),
+        atol=1e-3,
+    )
+
+
+@pytest.mark.parametrize("base", [10.0, 2.0])
+@pytest.mark.parametrize("reduction", ["elementwise_mean", "sum", "none"])
+def test_psnr_base_reduction(base, reduction):
+    _cmp(
+        F.peak_signal_noise_ratio,
+        RF.peak_signal_noise_ratio,
+        (IMG1, IMG2),
+        dict(base=base, reduction=reduction, data_range=1.0),
+        atol=1e-3,
+    )
+
+
+def test_psnr_dim_and_tuple_range():
+    _cmp(F.peak_signal_noise_ratio, RF.peak_signal_noise_ratio, (IMG1, IMG2), dict(data_range=1.0, dim=(1, 2, 3)), atol=1e-3)
+    _cmp(F.peak_signal_noise_ratio, RF.peak_signal_noise_ratio, (IMG1, IMG2), dict(data_range=(0.0, 1.0)), atol=1e-3)
+
+
+N_C, C_C, L_C = 60, 4, 3
+BP = RNG.random(N_C).astype(np.float32)
+BT = RNG.integers(0, 2, N_C)
+MP = RNG.random((N_C, C_C)).astype(np.float32)
+MP /= MP.sum(1, keepdims=True)
+MT = RNG.integers(0, C_C, N_C)
+LP = RNG.random((N_C, L_C)).astype(np.float32)
+LT = RNG.integers(0, 2, (N_C, L_C))
+
+
+@pytest.mark.parametrize("squared", [True, False])
+@pytest.mark.parametrize("multiclass_mode", ["crammer-singer", "one-vs-all"])
+def test_hinge_modes(squared, multiclass_mode):
+    _cmp(
+        F.hinge_loss,
+        RF.hinge_loss,
+        (MP, MT),
+        dict(task="multiclass", num_classes=C_C, squared=squared, multiclass_mode=multiclass_mode),
+    )
+
+
+@pytest.mark.parametrize("norm", ["l1", "l2", "max"])
+@pytest.mark.parametrize("n_bins", [5, 30])
+def test_calibration_norms(norm, n_bins):
+    _cmp(F.calibration_error, RF.calibration_error, (BP, BT), dict(task="binary", norm=norm, n_bins=n_bins))
+    _cmp(
+        F.calibration_error,
+        RF.calibration_error,
+        (MP, MT),
+        dict(task="multiclass", num_classes=C_C, norm=norm, n_bins=n_bins),
+    )
+
+
+@pytest.mark.parametrize("beta", [0.5, 2.0])
+@pytest.mark.parametrize("average", ["micro", "macro", "weighted", "none"])
+def test_fbeta_sweep(beta, average):
+    _cmp(
+        F.fbeta_score,
+        RF.fbeta_score,
+        (MP, MT),
+        dict(task="multiclass", num_classes=C_C, beta=beta, average=average),
+    )
+
+
+@pytest.mark.parametrize("top_k", [1, 2, 3])
+def test_topk_sweep(top_k):
+    _cmp(F.accuracy, RF.accuracy, (MP, MT), dict(task="multiclass", num_classes=C_C, top_k=top_k))
+    _cmp(F.precision, RF.precision, (MP, MT), dict(task="multiclass", num_classes=C_C, top_k=top_k))
+
+
+@pytest.mark.parametrize("weights", ["linear", "quadratic", None])
+def test_cohen_kappa_weights(weights):
+    _cmp(F.cohen_kappa, RF.cohen_kappa, (MP, MT), dict(task="multiclass", num_classes=C_C, weights=weights))
+
+
+def test_multilabel_misc():
+    import torchmetrics.functional.classification as RFC
+
+    _cmp(F.matthews_corrcoef, RF.matthews_corrcoef, (LP, LT), dict(task="multilabel", num_labels=L_C))
+    _cmp(F.exact_match, RF.exact_match, (LP, LT), dict(task="multilabel", num_labels=L_C))
+    kw = dict(num_labels=L_C)
+    _cmp(F.multilabel_coverage_error, RFC.multilabel_coverage_error, (LP, LT), kw)
+    _cmp(F.multilabel_ranking_average_precision, RFC.multilabel_ranking_average_precision, (LP, LT), kw)
+    _cmp(F.multilabel_ranking_loss, RFC.multilabel_ranking_loss, (LP, LT), kw)
+
+
+@pytest.mark.parametrize("average", ["macro", "weighted", "none"])
+def test_auroc_ap_average(average):
+    _cmp(F.auroc, RF.auroc, (MP, MT), dict(task="multiclass", num_classes=C_C, average=average))
+    _cmp(
+        F.average_precision,
+        RF.average_precision,
+        (MP, MT),
+        dict(task="multiclass", num_classes=C_C, average=average),
+    )

@@ -98,7 +98,11 @@ class MulticlassHingeLoss(Metric):
         self.multiclass_mode = multiclass_mode
         self.ignore_index = ignore_index
         self.validate_args = validate_args
-        self.add_state("measures", jnp.array(0.0), dist_reduce_fx="sum")
+        # one-vs-all accumulates per-class losses (reference keeps a (C,) state)
+        measures_default = (
+            jnp.array(0.0) if multiclass_mode == "crammer-singer" else jnp.zeros(num_classes)
+        )
+        self.add_state("measures", measures_default, dist_reduce_fx="sum")
         self.add_state("total", jnp.array(0.0), dist_reduce_fx="sum")
 
     def update(self, preds: Array, target: Array) -> None:

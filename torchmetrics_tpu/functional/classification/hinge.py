@@ -102,13 +102,14 @@ def _multiclass_hinge_loss_update(
     if multiclass_mode == "crammer-singer":
         margin = jnp.sum(jnp.where(target_oh, preds, 0.0), axis=1)
         margin = margin - jnp.max(jnp.where(target_oh, -jnp.inf, preds), axis=1)
-        measures = jnp.clip(1 - margin, min=0)
+        measures = jnp.clip(1 - margin, min=0)  # (N,)
     else:
+        # one-vs-all keeps per-class losses → (C,) state (ref ``hinge.py:163-176``)
         target_pm = jnp.where(target_oh, 1.0, -1.0)
-        measures = jnp.clip(1 - target_pm * preds, min=0).sum(axis=1)
+        measures = jnp.clip(1 - target_pm * preds, min=0)  # (N, C)
     if squared:
         measures = measures**2
-    return jnp.sum(measures), jnp.asarray(target.shape[0], dtype=jnp.float32)
+    return jnp.sum(measures, axis=0), jnp.asarray(target.shape[0], dtype=jnp.float32)
 
 
 def multiclass_hinge_loss(
