@@ -91,3 +91,28 @@ def test_dice_modular_samplewise():
         rm_cls.update(torch.as_tensor(p[s]), torch.as_tensor(t[s]))
         ours.update(jnp.asarray(p[s]), jnp.asarray(t[s]))
     np.testing.assert_allclose(np.asarray(ours.compute()), rm_cls.compute().numpy(), atol=1e-5)
+
+
+@pytest.mark.parametrize("zero_division", [0, 1])
+@pytest.mark.parametrize("average", ["macro"])
+def test_dice_all_classes_absent_zero_division(average, zero_division):
+    """compute() before any update: reference drops all-absent classes to an
+    empty sum (0.0), not num_classes * zero_division (advisor round-2 finding)."""
+    kw = dict(average=average, num_classes=3, zero_division=zero_division)
+    rm = torchmetrics.classification.Dice(**kw)
+    ours = Dice(**kw)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        np.testing.assert_allclose(np.asarray(ours.compute()), rm.compute().numpy(), atol=1e-6)
+
+
+def test_dice_weighted_zero_weight_rows_keep_zero_division():
+    """weighted average with live-but-absent classes keeps the reference's
+    NaN -> zero_division substitution (only macro's all-ignored row sums to 0)."""
+    kw = dict(average="weighted", num_classes=3, ignore_index=2, zero_division=1)
+    p, t = [0, 1, 2, 0, 1], [2, 2, 2, 2, 2]
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        got = float(dice(jnp.array(p), jnp.array(t), **kw))
+        want = float(ref_dice(torch.tensor(p), torch.tensor(t), **kw))
+    assert got == want == 3.0

@@ -223,3 +223,21 @@ def test_aggregation_kwarg():
         assert np.allclose(val, np_red(per_query), atol=1e-6), agg
     with pytest.raises(ValueError):
         RetrievalMAP(aggregation="bogus")
+
+
+def test_retrieval_auroc_reference_positional_order():
+    """Reference signature order: (empty_target_action, ignore_index, top_k, max_fpr).
+
+    Positional callers ported from the reference must work (advisor round-2 finding).
+    """
+    m = RetrievalAUROC("neg", None, 2, 0.5)
+    assert m.empty_target_action == "neg"
+    assert m.top_k == 2
+    assert m.max_fpr == 0.5
+    m.update(jnp.array([0.2, 0.3, 0.5, 0.1]), jnp.array([1, 0, 1, 1]), jnp.array([0, 0, 0, 0]))
+    assert float(m.compute()) == 1.0
+
+
+def test_retrieval_fall_out_reference_positional_order():
+    m = RetrievalFallOut("pos", None, 2)
+    assert (m.empty_target_action, m.ignore_index, m.top_k) == ("pos", None, 2)

@@ -289,7 +289,14 @@ def _reduce_stat_scores(
     denominator = jnp.where(zero_div_mask | ignore_mask, 1.0, denominator)
     weights = jnp.where(ignore_mask, 0.0, weights)
     if average not in ("micro", "none", None):
-        weights = weights / weights.sum(axis=-1, keepdims=True)
+        # a fully-ignored row (every class absent under macro) must contribute 0,
+        # matching the reference's empty-tensor sum — not num_classes * zero_division
+        # via 0/0.  Only the all-ignored case: a zero weight sum with live classes
+        # (weighted average) keeps the reference's NaN -> zero_division path.
+        all_ignored = ignore_mask.all(axis=-1, keepdims=True)
+        weights = jnp.where(
+            all_ignored, 0.0, weights / jnp.where(all_ignored, 1.0, weights.sum(axis=-1, keepdims=True))
+        )
     scores = weights * (numerator / denominator)
     scores = jnp.where(jnp.isnan(scores), float(zero_division), scores)
     if mdmc_average == "samplewise" and scores.ndim > 0:

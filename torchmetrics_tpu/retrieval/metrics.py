@@ -21,8 +21,22 @@ def _validate_top_k(top_k: Optional[int]) -> None:
 class _TopKRetrievalMetric(RetrievalMetric):
     _kernel = None
 
-    def __init__(self, empty_target_action: str = "neg", ignore_index: Optional[int] = None, top_k: Optional[int] = None, **kwargs: Any) -> None:
-        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        aggregation: Any = "mean",
+        **kwargs: Any,
+    ) -> None:
+        # positional order mirrors the reference (retrieval/<metric>.py):
+        # (empty_target_action, ignore_index, top_k, aggregation)
+        super().__init__(
+            empty_target_action=empty_target_action,
+            ignore_index=ignore_index,
+            aggregation=aggregation,
+            **kwargs,
+        )
         _validate_top_k(top_k)
         self.top_k = top_k
 
@@ -68,8 +82,8 @@ class RetrievalFallOut(_TopKRetrievalMetric):
     _empty_query_has_no = "negatives"
     _kernel = staticmethod(_mk.fall_out_masked)
 
-    def __init__(self, empty_target_action: str = "pos", **kwargs: Any) -> None:
-        super().__init__(empty_target_action=empty_target_action, **kwargs)
+    def __init__(self, empty_target_action: str = "pos", *args: Any, **kwargs: Any) -> None:
+        super().__init__(empty_target_action, *args, **kwargs)
 
 
 class RetrievalHitRate(_TopKRetrievalMetric):
@@ -90,8 +104,22 @@ class RetrievalAUROC(_TopKRetrievalMetric):
 
     _kernel = staticmethod(_mk.auroc_masked)
 
-    def __init__(self, max_fpr: Optional[float] = None, **kwargs: Any) -> None:
-        super().__init__(**kwargs)
+    def __init__(
+        self,
+        empty_target_action: str = "neg",
+        ignore_index: Optional[int] = None,
+        top_k: Optional[int] = None,
+        max_fpr: Optional[float] = None,
+        aggregation: Any = "mean",
+        **kwargs: Any,
+    ) -> None:
+        super().__init__(
+            empty_target_action=empty_target_action,
+            ignore_index=ignore_index,
+            top_k=top_k,
+            aggregation=aggregation,
+            **kwargs,
+        )
         if max_fpr is not None and not (isinstance(max_fpr, float) and 0 < max_fpr <= 1):
             raise ValueError(f"Arguments `max_fpr` should be a float in range (0, 1], but got: {max_fpr}")
         self.max_fpr = max_fpr
@@ -109,9 +137,15 @@ class RetrievalPrecision(RetrievalMetric):
         ignore_index: Optional[int] = None,
         top_k: Optional[int] = None,
         adaptive_k: bool = False,
+        aggregation: Any = "mean",
         **kwargs: Any,
     ) -> None:
-        super().__init__(empty_target_action=empty_target_action, ignore_index=ignore_index, **kwargs)
+        super().__init__(
+            empty_target_action=empty_target_action,
+            ignore_index=ignore_index,
+            aggregation=aggregation,
+            **kwargs,
+        )
         _validate_top_k(top_k)
         if not isinstance(adaptive_k, bool):
             raise ValueError("`adaptive_k` has to be a boolean")
