@@ -92,3 +92,40 @@ def test_dispatcher_validation():
         recall_at_fixed_precision(preds, target, task="multiclass", min_precision=0.5)
     with pytest.raises(ValueError, match="num_labels"):
         precision_at_fixed_recall(preds, target, task="multilabel", min_recall=0.5)
+
+
+def test_fleiss_kappa_unequal_rater_counts_matches_reference():
+    """Row-max rater count + total*num_raters marginal normalization: unequal
+    per-subject rater sums must match the reference (round-2 verdict finding)."""
+    import numpy as np
+    import torch
+    import jax.numpy as jnp
+    from tests.helpers.reference_oracle import load_reference
+
+    torchmetrics = load_reference()
+    if torchmetrics is None:
+        import pytest
+
+        pytest.skip("reference checkout unavailable")
+    from torchmetrics.functional.nominal import fleiss_kappa as ref_fk
+    from torchmetrics_tpu.functional.nominal import fleiss_kappa as our_fk
+    from torchmetrics_tpu.nominal import FleissKappa
+
+    rng = np.random.default_rng(0)
+    counts = rng.integers(0, 6, (12, 5))
+    np.testing.assert_allclose(
+        float(our_fk(jnp.asarray(counts))), float(ref_fk(torch.as_tensor(counts))), atol=1e-6
+    )
+    # probs mode, reference layout (n_samples, n_categories, n_raters)
+    probs = rng.random((20, 4, 4)).astype(np.float32)
+    np.testing.assert_allclose(
+        float(our_fk(jnp.asarray(probs), mode="probs")),
+        float(ref_fk(torch.as_tensor(probs), mode="probs")),
+        atol=1e-6,
+    )
+    # modular streaming over two batches
+    m, rm = FleissKappa(mode="counts"), torchmetrics.nominal.FleissKappa(mode="counts")
+    for s in (slice(0, 6), slice(6, 12)):
+        m.update(jnp.asarray(counts[s]))
+        rm.update(torch.as_tensor(counts[s]))
+    np.testing.assert_allclose(float(m.compute()), float(rm.compute()), atol=1e-6)

@@ -175,12 +175,56 @@ def theils_u(
     return _theils_u_from_confmat(confmat)
 
 
+def _fleiss_kappa_update(ratings: Array, mode: str) -> Array:
+    """Normalize ratings into a per-subject category-count matrix.
+
+    ``mode='probs'`` takes ``(n_subjects, n_categories, n_raters)`` floating
+    probabilities/logits (reference layout, ``functional/nominal/fleiss_kappa.py:19-41``)
+    and argmaxes each rater's column into a category choice.
+    """
+    if mode == "probs":
+        if ratings.ndim != 3 or not jnp.issubdtype(ratings.dtype, jnp.floating):
+            raise ValueError(
+                "If argument `mode` is 'probs', ratings must have 3 dimensions with the format"
+                " [n_samples, n_categories, n_raters] and be floating point."
+            )
+        choice = jnp.argmax(ratings, axis=1)  # (n_subjects, n_raters)
+        import jax.nn as jnn
+
+        return jnn.one_hot(choice, ratings.shape[1], dtype=jnp.int32).sum(axis=1)
+    if ratings.ndim != 2 or jnp.issubdtype(ratings.dtype, jnp.floating):
+        raise ValueError(
+            "If argument `mode` is `counts`, ratings must have 2 dimensions with the format"
+            " [n_samples, n_categories] and be none floating point."
+        )
+    return ratings
+
+
+def _fleiss_kappa_compute(counts: Array) -> Array:
+    """Kappa from a count matrix (reference ``functional/nominal/fleiss_kappa.py:44-58``).
+
+    The rater count is the max row sum and the category marginal is normalized
+    by ``n_subjects * n_raters``, so unequal per-subject rater counts reproduce
+    the reference's numbers exactly.  One deliberate divergence: in probs mode
+    with ``n_categories > n_raters`` the reference crashes (its one-hot reuses
+    the post-argmax ``shape[1]``); we return the intended kappa instead.
+    """
+    counts = counts.astype(jnp.float32)
+    total = counts.shape[0]
+    num_raters = counts.sum(axis=1).max()
+    p_cat = counts.sum(axis=0) / (total * num_raters)
+    p_subject = (jnp.sum(counts**2, axis=1) - num_raters) / (num_raters * (num_raters - 1))
+    p_bar = jnp.mean(p_subject)
+    pe_bar = jnp.sum(p_cat**2)
+    return (p_bar - pe_bar) / (1 - pe_bar + 1e-5)
+
+
 def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
     """Fleiss' kappa for inter-rater agreement.
 
-    ``mode='counts'``: ratings is (n_subjects, n_categories) count matrix;
-    ``mode='probs'``: (n_raters, n_subjects, n_categories) probabilities which
-    are argmaxed into counts.
+    ``mode='counts'``: ratings is an integer (n_subjects, n_categories) count
+    matrix; ``mode='probs'``: (n_subjects, n_categories, n_raters) floating
+    probabilities which are argmaxed into counts.
 
     Example:
         >>> import jax.numpy as jnp
@@ -191,20 +235,7 @@ def fleiss_kappa(ratings: Array, mode: str = "counts") -> Array:
     """
     if mode not in ("counts", "probs"):
         raise ValueError("Argument `mode` must be one of 'counts' or 'probs'")
-    ratings = jnp.asarray(ratings)
-    if mode == "probs":
-        if ratings.ndim != 3:
-            raise ValueError("If argument `mode` is 'probs', ratings must be a 3D tensor")
-        import jax.nn as jnn
-
-        ratings = jnn.one_hot(jnp.argmax(ratings, axis=-1), ratings.shape[-1], dtype=jnp.float32).sum(axis=0)
-    ratings = ratings.astype(jnp.float32)
-    n_raters = ratings.sum(axis=1)[0]
-    p_cat = ratings.sum(axis=0) / ratings.sum()
-    p_subject = (jnp.sum(ratings**2, axis=1) - n_raters) / (n_raters * (n_raters - 1))
-    p_bar = jnp.mean(p_subject)
-    pe_bar = jnp.sum(p_cat**2)
-    return (p_bar - pe_bar) / jnp.clip(1 - pe_bar, min=1e-30)
+    return _fleiss_kappa_compute(_fleiss_kappa_update(jnp.asarray(ratings), mode))
 
 
 from torchmetrics_tpu.functional.nominal._matrix import (  # noqa: E402

@@ -167,15 +167,14 @@ class FleissKappa(Metric):
         self.add_state("ratings", default=[], dist_reduce_fx="cat")
 
     def update(self, ratings: Array) -> None:
-        ratings = jnp.asarray(ratings)
-        if self.mode == "probs":
-            import jax.nn as jnn
+        from torchmetrics_tpu.functional.nominal import _fleiss_kappa_update
 
-            ratings = jnn.one_hot(jnp.argmax(ratings, axis=-1), ratings.shape[-1], dtype=jnp.float32).sum(axis=0)
-        self.ratings.append(ratings)
+        self.ratings.append(_fleiss_kappa_update(jnp.asarray(ratings), self.mode))
 
     def compute(self) -> Array:
-        return fleiss_kappa(dim_zero_cat(self.ratings), mode="counts")
+        from torchmetrics_tpu.functional.nominal import _fleiss_kappa_compute
+
+        return _fleiss_kappa_compute(dim_zero_cat(self.ratings))
 
 
 __all__ = ["CramersV", "FleissKappa", "PearsonsContingencyCoefficient", "TheilsU", "TschuprowsT"]
