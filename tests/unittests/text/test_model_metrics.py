@@ -196,3 +196,20 @@ class TestInfoLM:
             idf=False,
         )
         assert float(score) > 1e-4
+
+
+def test_squad_duplicate_question_ids_match_reference():
+    """Every target entry is scored/counted even when ids repeat (last-wins
+    dict flattening would silently drop rows — round-3 review finding)."""
+    from tests.helpers.reference_oracle import load_reference
+
+    torchmetrics = load_reference()
+    if torchmetrics is None:
+        pytest.skip("reference checkout unavailable")
+    from torchmetrics.functional.text import squad as ref_squad
+
+    preds = [{"prediction_text": "a", "id": "1"}]
+    target = [{"answers": {"text": ["a"]}, "id": "1"}, {"answers": {"text": ["b"]}, "id": "1"}]
+    ours = {k: float(v) for k, v in squad(preds, target).items()}
+    ref = {k: float(v) for k, v in ref_squad(preds, target).items()}
+    assert ours == ref == {"exact_match": 50.0, "f1": 50.0}

@@ -11,6 +11,8 @@ from torchmetrics_tpu.functional.nominal import (
     _confmat_from_pairs,
     _cramers_v_from_confmat,
     _drop_empty_rows_and_cols,
+    _fleiss_kappa_compute,
+    _fleiss_kappa_update,
     _handle_nan,
     _nominal_input_validation,
     _pearsons_contingency_from_confmat,
@@ -167,13 +169,9 @@ class FleissKappa(Metric):
         self.add_state("ratings", default=[], dist_reduce_fx="cat")
 
     def update(self, ratings: Array) -> None:
-        from torchmetrics_tpu.functional.nominal import _fleiss_kappa_update
-
         self.ratings.append(_fleiss_kappa_update(jnp.asarray(ratings), self.mode))
 
     def compute(self) -> Array:
-        from torchmetrics_tpu.functional.nominal import _fleiss_kappa_compute
-
         return _fleiss_kappa_compute(dim_zero_cat(self.ratings))
 
 
