@@ -13,7 +13,7 @@ import pytest
 import torchmetrics_tpu
 
 # modules whose examples need optional host packages absent from this image
-_SKIP_SUBSTRINGS = ("pesq", "stoi", "srmr")
+_SKIP_SUBSTRINGS = ("pesq", "stoi")
 
 
 def _iter_module_names():

@@ -2,22 +2,32 @@
 
 from __future__ import annotations
 
-from typing import Any
+from typing import Any, Optional
 
 import jax
+import jax.numpy as jnp
 
 from torchmetrics_tpu.audio._base import _AveragingAudioMetric
 from torchmetrics_tpu.functional.audio.srmr import speech_reverberation_modulation_energy_ratio
-from torchmetrics_tpu.utilities.imports import _GAMMATONE_AVAILABLE
 
 Array = jax.Array
 
 
 class SpeechReverberationModulationEnergyRatio(_AveragingAudioMetric):
-    """Mean SRMR score (requires the ``gammatone`` filterbank package).
+    """Mean SRMR score over all processed waveforms.
 
-    Raises:
-        ModuleNotFoundError: if the ``gammatone`` package is not installed.
+    Self-contained JAX pipeline (gammatone + modulation filterbanks derived
+    in-repo) — unlike the reference, no ``gammatone``/``torchaudio`` install
+    is required.
+
+    Example:
+        >>> import jax
+        >>> from torchmetrics_tpu.audio import SpeechReverberationModulationEnergyRatio
+        >>> preds = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> metric = SpeechReverberationModulationEnergyRatio(8000)
+        >>> metric.update(preds)
+        >>> bool(metric.compute() > 0)
+        True
     """
 
     is_differentiable = False
@@ -28,17 +38,12 @@ class SpeechReverberationModulationEnergyRatio(_AveragingAudioMetric):
         n_cochlear_filters: int = 23,
         low_freq: float = 125,
         min_cf: float = 4,
-        max_cf: float = 128,
+        max_cf: Optional[float] = None,
         norm: bool = False,
         fast: bool = False,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
-        if not _GAMMATONE_AVAILABLE:
-            raise ModuleNotFoundError(
-                "SpeechReverberationModulationEnergyRatio metric requires that gammatone is installed."
-                " Install as `pip install torchmetrics[audio]` or `pip install git+https://github.com/detly/gammatone`."
-            )
         self.fs = fs
         self.n_cochlear_filters = n_cochlear_filters
         self.low_freq = low_freq
@@ -51,8 +56,6 @@ class SpeechReverberationModulationEnergyRatio(_AveragingAudioMetric):
         values = speech_reverberation_modulation_energy_ratio(
             preds, self.fs, self.n_cochlear_filters, self.low_freq, self.min_cf, self.max_cf, self.norm, self.fast
         )
-        import jax.numpy as jnp
-
         self.measure_sum = self.measure_sum + jnp.sum(values)
         self.total = self.total + values.size
 

@@ -1,17 +1,303 @@
-"""SRMR (reference ``functional/audio/srmr.py``).
+"""Speech-to-Reverberation Modulation energy Ratio (SRMR).
 
-Speech-to-reverberation modulation energy ratio needs the ``gammatone`` and
-``torchaudio`` filterbank stacks, unavailable in this build; the entry point
-exists for API parity and raises with install guidance.
+Parity target: ``/root/reference/src/torchmetrics/functional/audio/srmr.py``
+(itself a torch translation of SRMRpy).  Unlike the reference — which imports
+the ``gammatone`` package for filter design and ``torchaudio`` for IIR
+filtering — this implementation is fully self-contained: the Glasberg–Moore
+ERB spacing and Slaney gammatone biquad-cascade coefficients are derived
+in-repo (standard published formulas), and filtering runs as vectorized
+``lax.scan`` biquads on device.  No optional host packages are needed.
+
+Pipeline (slow path): gammatone ERB filterbank (4 chained biquads per
+cochlear channel) -> Hilbert envelope (FFT) -> 8-band modulation filterbank
+(2nd-order bandpass, Q=2) -> Hamming-windowed frame energies -> energy ratio
+of low (bands 1-4) to high (bands 5..k*) modulation bands, where k* is picked
+from the 90%-energy ERB bandwidth.  The fast path replaces the filterbank +
+envelope with an FFT-weight gammatonegram, mirroring the reference's use of
+``gammatone.fftweight.fft_gtgram`` (experimental there, experimental here).
+
+Numerics note: coefficients are derived in float64 on host; device filtering
+runs in float32 unless x64 is enabled (TPU-first default).
 """
 
 from __future__ import annotations
 
-import jax
+from functools import lru_cache
+from math import ceil, log2, pi
+from typing import Optional, Tuple
 
-from torchmetrics_tpu.utilities.imports import _GAMMATONE_AVAILABLE
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
+
+# Glasberg & Moore (1990) ERB parameters, as used by the gammatone package
+_EAR_Q = 9.26449
+_MIN_BW = 24.7
+
+
+def _erb_centre_freqs(fs: int, n_filters: int, low_freq: float) -> np.ndarray:
+    """ERB-spaced centre frequencies from ``fs/2`` down to ``low_freq`` (descending)."""
+    c = _EAR_Q * _MIN_BW
+    high = fs / 2.0
+    k = np.arange(1, n_filters + 1, dtype=np.float64)
+    return -c + np.exp(k * (np.log(low_freq + c) - np.log(high + c)) / n_filters) * (high + c)
+
+
+def _erb_bandwidths(cfs: np.ndarray) -> np.ndarray:
+    """ERB (Hz) at each centre frequency (order-1 Glasberg–Moore form)."""
+    return cfs / _EAR_Q + _MIN_BW
+
+
+@lru_cache(maxsize=100)
+def _gammatone_coefs(fs: int, n_filters: int, low_freq: float) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Slaney (1993) 4th-order gammatone as four chained biquads.
+
+    Returns ``(numerators [4, N, 3], denominator [N, 3], gain [N])`` in float64.
+    Same algebra as the gammatone package's ``make_erb_filters``.
+    """
+    cfs = _erb_centre_freqs(fs, n_filters, low_freq)
+    t = 1.0 / fs
+    b = 1.019 * 2.0 * pi * _erb_bandwidths(cfs)
+    arg = 2.0 * cfs * pi * t
+    vec = np.exp(2j * arg)
+
+    rt_pos = np.sqrt(3.0 + 2.0**1.5)
+    rt_neg = np.sqrt(3.0 - 2.0**1.5)
+    common = -t * np.exp(-b * t)
+    k11 = np.cos(arg) + rt_pos * np.sin(arg)
+    k12 = np.cos(arg) - rt_pos * np.sin(arg)
+    k13 = np.cos(arg) + rt_neg * np.sin(arg)
+    k14 = np.cos(arg) - rt_neg * np.sin(arg)
+
+    a11, a12, a13, a14 = common * k11, common * k12, common * k13, common * k14
+    gain_arg = np.exp(1j * arg - b * t)
+    gain = np.abs(
+        (vec - gain_arg * k11)
+        * (vec - gain_arg * k12)
+        * (vec - gain_arg * k13)
+        * (vec - gain_arg * k14)
+        * (t * np.exp(b * t) / (-1.0 / np.exp(b * t) + 1.0 + vec * (1.0 - np.exp(b * t)))) ** 4
+    )
+
+    a0 = np.full_like(cfs, t)
+    a2 = np.zeros_like(cfs)
+    numerators = np.stack(
+        [np.stack([a0, a1x, a2], axis=-1) for a1x in (a11, a12, a13, a14)], axis=0
+    )  # [4, N, 3]
+    denominator = np.stack(
+        [np.ones_like(cfs), -2.0 * np.cos(arg) / np.exp(b * t), np.exp(-2.0 * b * t)], axis=-1
+    )  # [N, 3]
+    return numerators, denominator, gain
+
+
+@lru_cache(maxsize=100)
+def _modulation_filterbank(
+    min_cf: float, max_cf: float, n: int, fs: float, q: float
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """2nd-order bandpass modulation filters (SRMRpy design).
+
+    Returns ``(numerators [n, 3], denominators [n, 3], lower_cutoffs [n])``.
+    """
+    spacing = (max_cf / min_cf) ** (1.0 / (n - 1))
+    cfs = min_cf * spacing ** np.arange(n, dtype=np.float64)
+    w0 = 2.0 * pi * cfs / fs
+    wt = np.tan(w0 / 2.0)
+    b0 = wt / q
+    numer = np.stack([b0, np.zeros_like(b0), -b0], axis=-1)
+    denom = np.stack([1.0 + b0 + wt**2, 2.0 * wt**2 - 2.0, 1.0 - b0 + wt**2], axis=-1)
+    lower_cutoffs = cfs - b0 * fs / (2.0 * pi)
+    return numer, denom, lower_cutoffs
+
+
+def _biquad(x: Array, b: Array, a: Array) -> Array:
+    """One biquad over the trailing time axis, vectorized over leading dims.
+
+    ``b``/``a`` are 3-tap rows broadcastable to ``x.shape[:-1]`` (``a[..., 0]``
+    must be 1 — normalize before calling).
+
+    Direct-form II transposed inside a single ``lax.scan``, with all channels
+    vectorized into the carried state.  (An O(log T) ``associative_scan`` over
+    2x2 companion-matrix products was tried and rejected: with poles this
+    close to the unit circle — the 4 Hz modulation band at mfs=8 kHz — the
+    float32 matrix-product tree loses ~40% relative accuracy, while the
+    sequential recurrence stays within 5e-3 of a float64 oracle.)
+    """
+    b0, b1, b2 = b[..., 0], b[..., 1], b[..., 2]
+    a1, a2 = a[..., 1], a[..., 2]
+    zeros = jnp.zeros(x.shape[:-1], dtype=x.dtype)
+
+    def step(carry, xt):
+        z1, z2 = carry
+        y = b0 * xt + z1
+        return (b1 * xt - a1 * y + z2, b2 * xt - a2 * y), y
+
+    _, ys = lax.scan(step, (zeros, zeros), jnp.moveaxis(x, -1, 0))
+    return jnp.moveaxis(ys, 0, -1)
+
+
+def _gammatone_filterbank(wave: Array, fs: int, n_filters: int, low_freq: float) -> Array:
+    """Filter ``wave [B, T]`` into ``[B, N, T]`` cochlear channels."""
+    numerators, denominator, gain = _gammatone_coefs(fs, n_filters, float(low_freq))
+    dtype = wave.dtype
+    den = jnp.asarray(denominator, dtype)[None, :, :]  # [1, N, 3]
+    y = jnp.broadcast_to(wave[:, None, :], (wave.shape[0], n_filters, wave.shape[1]))
+    for section in range(4):
+        num = jnp.asarray(numerators[section], dtype)[None, :, :]
+        y = _biquad(y, num, den)
+    return y / jnp.asarray(gain, dtype)[None, :, None]
+
+
+def _hilbert_envelope(x: Array) -> Array:
+    """|analytic signal| over the trailing axis, FFT length padded to a multiple of 16.
+
+    The FFT-length rounding matches the reference's ``_hilbert`` so envelope
+    values agree sample-for-sample.
+    """
+    time = x.shape[-1]
+    n = time if time % 16 == 0 else ceil(time / 16) * 16  # always even
+    x_fft = jnp.fft.fft(x, n=n, axis=-1)
+    h = np.zeros(n, dtype=np.float64)
+    h[0] = h[n // 2] = 1.0
+    h[1 : n // 2] = 2.0
+    # complex*real elementwise multiply is unimplemented on some TPU runtimes;
+    # build the masked spectrum from two real multiplies instead
+    hj = jnp.asarray(h, x.dtype)
+    masked = lax.complex(x_fft.real * hj, x_fft.imag * hj)
+    analytic = jnp.fft.ifft(masked, axis=-1)[..., :time]
+    return jnp.sqrt(analytic.real**2 + analytic.imag**2)
+
+
+@lru_cache(maxsize=100)
+def _gtgram_fft_weights(nfft: int, fs: int, n_filters: int, low_freq: float, maxlen: int) -> np.ndarray:
+    """FFT-bin weights whose rows sample each gammatone's magnitude response.
+
+    Port of the math behind ``gammatone.fftweight.fft_weights`` (Ellis'
+    gammatonegram approximation).
+    """
+    cfs = _erb_centre_freqs(fs, n_filters, low_freq)
+    t = 1.0 / fs
+    b = 1.019 * 2.0 * pi * _erb_bandwidths(cfs)
+    arg = 2.0 * cfs[:, None] * pi * t
+    ucirc = np.exp(2j * pi * np.arange(nfft // 2 + 1)[None, :] / nfft)
+
+    rt_pos = np.sqrt(3.0 + 2.0**1.5)
+    rt_neg = np.sqrt(3.0 - 2.0**1.5)
+    common = -t * np.exp(-b[:, None] * t)
+    k11 = np.cos(arg) + rt_pos * np.sin(arg)
+    k12 = np.cos(arg) - rt_pos * np.sin(arg)
+    k13 = np.cos(arg) + rt_neg * np.sin(arg)
+    k14 = np.cos(arg) - rt_neg * np.sin(arg)
+    zros = -np.stack([common * k11, common * k12, common * k13, common * k14], axis=0) / t
+
+    vec = np.exp(2j * arg)
+    gain_arg = np.exp(1j * arg - b[:, None] * t)
+    gain = np.abs(
+        (vec - gain_arg * k11)
+        * (vec - gain_arg * k12)
+        * (vec - gain_arg * k13)
+        * (vec - gain_arg * k14)
+        * (t * np.exp(b[:, None] * t) / (-1.0 / np.exp(b[:, None] * t) + 1.0 + vec * (1.0 - np.exp(b[:, None] * t))))
+        ** 4
+    )[:, 0]
+
+    pole = np.exp(1j * arg[:, 0] - b * t)[:, None]
+    weights = (
+        (t**4 / gain[:, None])
+        * np.abs(ucirc - zros[0])
+        * np.abs(ucirc - zros[1])
+        * np.abs(ucirc - zros[2])
+        * np.abs(ucirc - zros[3])
+        * np.abs((pole - ucirc) * (pole.conj() - ucirc)) ** -4
+    )
+    full = np.zeros((n_filters, nfft), dtype=np.float64)
+    full[:, : nfft // 2 + 1] = weights
+    return full[:, :maxlen]
+
+
+def _fft_gtgram(wave: Array, fs: int, n_filters: int, low_freq: float) -> Array:
+    """Gammatonegram envelope ``[B, N, frames]`` for the fast path.
+
+    STFT with a zero-phase half-Hann window (window 0.010 s, hop 0.0025 s),
+    weighted by per-filter FFT-bin gammatone responses.
+    """
+    window_time, hop_time = 0.010, 0.0025
+    nwin = int(window_time * fs)
+    nhop = int(hop_time * fs)
+    nfft = int(2 ** ceil(log2(2 * nwin)))
+
+    # zero-phase window: half-Hann lobes at both ends of the nfft buffer
+    halflen = nwin // 2
+    halff = nfft // 2
+    acthalflen = min(halff, halflen)
+    halfwin = 0.5 * (1.0 + np.cos(pi * np.arange(halflen + 1) / halflen))
+    win = np.zeros(nfft)
+    win[halff : halff + acthalflen] = halfwin[:acthalflen]
+    win[halff : halff - acthalflen : -1] = halfwin[:acthalflen]
+
+    time = wave.shape[-1]
+    n_cols = 1 + (time - nfft) // nhop
+    starts = np.arange(n_cols) * nhop
+    frames = wave[..., starts[:, None] + np.arange(nfft)[None, :]]  # [B, cols, nfft]
+    spec = jnp.fft.fft(frames * jnp.asarray(win, wave.dtype), axis=-1)[..., : nfft // 2 + 1]
+    weights = jnp.asarray(_gtgram_fft_weights(nfft, fs, n_filters, float(low_freq), nfft // 2 + 1), wave.dtype)
+    return jnp.einsum("nf,bcf->bnc", weights, jnp.abs(spec), precision="highest") / nfft
+
+
+def _frame_energy(mod_out: Array, time: int, w_length: int, w_inc: int) -> Array:
+    """Hamming-windowed per-frame energies ``[..., n_frames]`` of ``mod_out [..., T]``."""
+    # pad amount is computed against the original waveform length, exactly as
+    # the reference does — on the fast path t_mod (envelope frames) << time,
+    # and padding relative to t_mod would append hundreds of zero frames that
+    # shift norm=True's dynamic-range clamp
+    pad = max(ceil(time / w_inc) * w_inc - time, w_length - time, 0)
+    padded = jnp.pad(mod_out, [(0, 0)] * (mod_out.ndim - 1) + [(0, pad)])
+    avail = 1 + (padded.shape[-1] - w_length) // w_inc
+    num_frames = max(min(1 + (time - w_length) // w_inc, avail), 0)
+    idx = np.arange(num_frames)[:, None] * w_inc + np.arange(w_length)[None, :]
+    frames = padded[..., idx]  # [..., n_frames, w_length]
+    # periodic Hamming over w_length+1 points, last dropped (reference windowing)
+    window = 0.54 - 0.46 * np.cos(2.0 * pi * np.arange(w_length) / (w_length + 1))
+    return jnp.sum((frames * jnp.asarray(window, frames.dtype)) ** 2, axis=-1)
+
+
+def _normalize_energy(energy: Array, drange: float = 30.0) -> Array:
+    """Clamp band energies into a ``drange``-dB window below the cross-filter peak."""
+    peak = jnp.max(jnp.mean(energy, axis=1, keepdims=True), axis=(2, 3), keepdims=True)
+    floor = peak * 10.0 ** (-drange / 10.0)
+    return jnp.clip(energy, floor, peak)
+
+
+def _srmr_arg_validate(
+    fs: int,
+    n_cochlear_filters: int,
+    low_freq: float,
+    min_cf: float,
+    max_cf: Optional[float],
+    norm: bool,
+    fast: bool,
+) -> None:
+    if not (isinstance(fs, int) and fs > 0):
+        raise ValueError(f"Expected argument `fs` to be an int larger than 0, but got {fs}")
+    if not (isinstance(n_cochlear_filters, int) and n_cochlear_filters > 0):
+        raise ValueError(
+            f"Expected argument `n_cochlear_filters` to be an int larger than 0, but got {n_cochlear_filters}"
+        )
+    if not (isinstance(low_freq, (float, int)) and low_freq > 0):
+        raise ValueError(f"Expected argument `low_freq` to be a float larger than 0, but got {low_freq}")
+    if not (isinstance(min_cf, (float, int)) and min_cf > 0):
+        raise ValueError(f"Expected argument `min_cf` to be a float larger than 0, but got {min_cf}")
+    if max_cf is not None and not (isinstance(max_cf, (float, int)) and max_cf > 0):
+        raise ValueError(f"Expected argument `max_cf` to be a float larger than 0, but got {max_cf}")
+    if not isinstance(norm, bool):
+        raise ValueError("Expected argument `norm` to be a bool value")
+    if not isinstance(fast, bool):
+        raise ValueError("Expected argument `fast` to be a bool value")
 
 
 def speech_reverberation_modulation_energy_ratio(
@@ -20,21 +306,105 @@ def speech_reverberation_modulation_energy_ratio(
     n_cochlear_filters: int = 23,
     low_freq: float = 125,
     min_cf: float = 4,
-    max_cf: float = 128,
+    max_cf: Optional[float] = None,
     norm: bool = False,
     fast: bool = False,
 ) -> Array:
-    """SRMR score (requires the ``gammatone`` filterbank package).
+    """SRMR — non-intrusive speech quality/intelligibility from modulation energies.
 
-    Raises:
-        ModuleNotFoundError: if the ``gammatone`` package is not installed.
+    Args:
+        preds: shape ``(..., time)``
+        fs: sampling rate (Hz)
+        n_cochlear_filters: gammatone filterbank size
+        low_freq: lowest gammatone centre frequency
+        min_cf: centre frequency of the first modulation band
+        max_cf: centre frequency of the last modulation band
+            (``None`` -> 30 Hz when ``norm`` else 128 Hz)
+        norm: clamp modulation energies to a 30 dB dynamic range
+        fast: gammatonegram approximation instead of the exact filterbank
+            (experimental, as in the reference)
+
+    Returns:
+        SRMR scores of shape ``preds.shape[:-1]`` (scalar input -> shape ``(1,)``).
+
+    Example:
+        >>> import jax, jax.numpy as jnp
+        >>> from torchmetrics_tpu.functional.audio import speech_reverberation_modulation_energy_ratio
+        >>> preds = jax.random.normal(jax.random.PRNGKey(1), (8000,))
+        >>> score = speech_reverberation_modulation_energy_ratio(preds, 8000)
+        >>> bool(score.shape == (1,)) and bool(score > 0)
+        True
     """
-    if not _GAMMATONE_AVAILABLE:
-        raise ModuleNotFoundError(
-            "speech_reverberation_modulation_energy_ratio requires that gammatone is installed."
-            " Install as `pip install torchmetrics[audio]` or `pip install git+https://github.com/detly/gammatone`."
-        )
-    raise NotImplementedError(
-        "SRMR's gammatone-filterbank pipeline is not yet ported; install `gammatone` and use the reference"
-        " implementation, or open an issue for the JAX port."
+    _srmr_arg_validate(fs, n_cochlear_filters, low_freq, min_cf, max_cf, norm, fast)
+
+    preds = jnp.asarray(preds)
+    shape = preds.shape
+    preds = preds.reshape(1, -1) if preds.ndim == 1 else preds.reshape(-1, shape[-1])
+    num_batch, time = preds.shape
+
+    if jnp.issubdtype(preds.dtype, jnp.integer):
+        preds = preds.astype(jnp.float32) / jnp.iinfo(preds.dtype).max
+    elif not jnp.issubdtype(preds.dtype, jnp.floating):
+        preds = preds.astype(jnp.float32)
+
+    # scale into [-1, 1] (the reference normalizes for its IIR backend; kept
+    # for numeric parity — the final ratio is scale-free except under `norm`)
+    max_vals = jnp.max(jnp.abs(preds), axis=-1, keepdims=True)
+    preds = preds / jnp.where(max_vals > 1, max_vals, 1.0)
+
+    if fast:
+        rank_zero_warn("`fast=True` is an experimental gammatonegram approximation of SRMR.")
+        mfs = 400.0
+        gt_env = _fft_gtgram(preds, fs, n_cochlear_filters, low_freq)
+    else:
+        mfs = float(fs)
+        gt_env = _hilbert_envelope(_gammatone_filterbank(preds, fs, n_cochlear_filters, low_freq))
+
+    w_length = ceil(0.256 * mfs)
+    w_inc = ceil(0.064 * mfs)
+
+    if max_cf is None:
+        max_cf = 30.0 if norm else 128.0
+    mod_num, mod_den, cutoffs = _modulation_filterbank(float(min_cf), float(max_cf), 8, mfs, 2.0)
+
+    # one biquad per modulation band, vectorized over [B, N, 8]
+    dtype = gt_env.dtype
+    num = jnp.asarray(mod_num / mod_den[:, :1], dtype)  # normalize a0 to 1
+    den = jnp.asarray(mod_den / mod_den[:, :1], dtype)
+    mod_in = jnp.broadcast_to(gt_env[:, :, None, :], (*gt_env.shape[:2], 8, gt_env.shape[-1]))
+    mod_out = _biquad(mod_in, num[None, None, :, :], den[None, None, :, :])
+
+    energy = _frame_energy(mod_out, time, w_length, w_inc)  # [B, N, 8, frames]
+    if norm:
+        energy = _normalize_energy(energy)
+
+    avg_energy = jnp.mean(energy, axis=-1)  # [B, N, 8]
+    total_energy = jnp.sum(avg_energy, axis=(1, 2))
+    ac_perc = jnp.sum(avg_energy, axis=2) * 100.0 / total_energy[:, None]  # [B, N]
+    cum_low_to_high = jnp.cumsum(jnp.flip(ac_perc, axis=-1), axis=-1)
+    # first crossing of the monotone cumulative sum; counting non-crossed
+    # positions instead of argmax-over-bool, which some TPU runtimes lack
+    k90_idx = jnp.sum((cum_low_to_high <= 90.0).astype(jnp.int32), axis=-1)
+
+    erbs_ascending = np.flipud(_erb_bandwidths(_erb_centre_freqs(fs, n_cochlear_filters, low_freq))).copy()
+    bw = jnp.asarray(erbs_ascending, dtype)[k90_idx]  # [B]
+
+    # k* = highest modulation band whose lower cutoff sits below the signal
+    # bandwidth (reference's chained elifs, vectorized)
+    cuts = jnp.asarray(cutoffs, dtype)
+    kstar = (
+        5
+        + (cuts[5] <= bw).astype(jnp.int32)
+        + ((cuts[5] <= bw) & (cuts[6] <= bw)).astype(jnp.int32)
+        + ((cuts[5] <= bw) & (cuts[6] <= bw) & (cuts[7] <= bw)).astype(jnp.int32)
     )
+    if not isinstance(bw, jax.core.Tracer) and bool(jnp.any(bw < cuts[4])):
+        raise ValueError("Something wrong with the cutoffs compared to bw values.")
+
+    band_idx = jnp.arange(8)
+    low_energy = jnp.sum(avg_energy[:, :, :4], axis=(1, 2))
+    high_mask = (band_idx[None, :] >= 4) & (band_idx[None, :] < kstar[:, None])  # [B, 8]
+    high_energy = jnp.sum(avg_energy * high_mask[:, None, :], axis=(1, 2))
+    score = low_energy / high_energy
+
+    return score.reshape(*shape[:-1]) if len(shape) > 1 else score
