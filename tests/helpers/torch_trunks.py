@@ -1,0 +1,278 @@
+"""Pure-torch replicas of the pretrained trunks the reference stack wraps.
+
+torchvision / torch-fidelity / lpips are not installed in this image, so the
+architecture-equivalence tests build the torch side themselves:
+
+- ``TorchFIDInception`` — the torch-fidelity FID InceptionV3
+  (``FeatureExtractorInceptionV3``: TF-checkpoint layout, BN eps 1e-3,
+  count_include_pad=False average pools, max-pool in Mixed_7c's pool branch,
+  1008-way fc) with torchvision-compatible module naming, so a state dict
+  from the real checkpoint maps identically.
+- ``tf1_resize_bilinear_torch`` — torch port of TF1.x
+  ``resize_bilinear(align_corners=False)`` (what
+  ``interpolate_bilinear_2d_like_tensorflow1x`` computes).
+- ``TorchLPIPS`` — VGG16 trunk (torchvision ``features`` naming) + LPIPS
+  scaling layer, unit-normalized feature differences, 1x1 linear heads,
+  spatial averaging (richzhang LPIPS graph, reference
+  ``functional/image/lpips.py``).
+
+These exist to validate the Flax trunks + ``tools/convert_weights.py`` with
+*random* weights; they are never shipped.
+"""
+
+from __future__ import annotations
+
+import torch
+import torch.nn.functional as F
+from torch import nn
+
+
+class BasicConv2d(nn.Module):
+    def __init__(self, in_ch: int, out_ch: int, **conv_kwargs) -> None:
+        super().__init__()
+        self.conv = nn.Conv2d(in_ch, out_ch, bias=False, **conv_kwargs)
+        self.bn = nn.BatchNorm2d(out_ch, eps=0.001)
+
+    def forward(self, x):
+        return F.relu(self.bn(self.conv(x)))
+
+
+def _avg3(x):
+    return F.avg_pool2d(x, kernel_size=3, stride=1, padding=1, count_include_pad=False)
+
+
+class InceptionA(nn.Module):
+    def __init__(self, in_ch: int, pool_features: int) -> None:
+        super().__init__()
+        self.branch1x1 = BasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch5x5_1 = BasicConv2d(in_ch, 48, kernel_size=1)
+        self.branch5x5_2 = BasicConv2d(48, 64, kernel_size=5, padding=2)
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, padding=1)
+        self.branch_pool = BasicConv2d(in_ch, pool_features, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b5 = self.branch5x5_2(self.branch5x5_1(x))
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = self.branch_pool(_avg3(x))
+        return torch.cat([b1, b5, bd, bp], 1)
+
+
+class InceptionB(nn.Module):
+    def __init__(self, in_ch: int) -> None:
+        super().__init__()
+        self.branch3x3 = BasicConv2d(in_ch, 384, kernel_size=3, stride=2)
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 64, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(64, 96, kernel_size=3, padding=1)
+        self.branch3x3dbl_3 = BasicConv2d(96, 96, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3(x)
+        bd = self.branch3x3dbl_3(self.branch3x3dbl_2(self.branch3x3dbl_1(x)))
+        bp = F.max_pool2d(x, kernel_size=3, stride=2)
+        return torch.cat([b3, bd, bp], 1)
+
+
+class InceptionC(nn.Module):
+    def __init__(self, in_ch: int, channels_7x7: int) -> None:
+        super().__init__()
+        c7 = channels_7x7
+        self.branch1x1 = BasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch7x7_1 = BasicConv2d(in_ch, c7, kernel_size=1)
+        self.branch7x7_2 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7_3 = BasicConv2d(c7, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_1 = BasicConv2d(in_ch, c7, kernel_size=1)
+        self.branch7x7dbl_2 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_3 = BasicConv2d(c7, c7, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7dbl_4 = BasicConv2d(c7, c7, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7dbl_5 = BasicConv2d(c7, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch_pool = BasicConv2d(in_ch, 192, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b7 = self.branch7x7_3(self.branch7x7_2(self.branch7x7_1(x)))
+        bd = self.branch7x7dbl_5(
+            self.branch7x7dbl_4(self.branch7x7dbl_3(self.branch7x7dbl_2(self.branch7x7dbl_1(x))))
+        )
+        bp = self.branch_pool(_avg3(x))
+        return torch.cat([b1, b7, bd, bp], 1)
+
+
+class InceptionD(nn.Module):
+    def __init__(self, in_ch: int) -> None:
+        super().__init__()
+        self.branch3x3_1 = BasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch3x3_2 = BasicConv2d(192, 320, kernel_size=3, stride=2)
+        self.branch7x7x3_1 = BasicConv2d(in_ch, 192, kernel_size=1)
+        self.branch7x7x3_2 = BasicConv2d(192, 192, kernel_size=(1, 7), padding=(0, 3))
+        self.branch7x7x3_3 = BasicConv2d(192, 192, kernel_size=(7, 1), padding=(3, 0))
+        self.branch7x7x3_4 = BasicConv2d(192, 192, kernel_size=3, stride=2)
+
+    def forward(self, x):
+        b3 = self.branch3x3_2(self.branch3x3_1(x))
+        b7 = self.branch7x7x3_4(self.branch7x7x3_3(self.branch7x7x3_2(self.branch7x7x3_1(x))))
+        bp = F.max_pool2d(x, kernel_size=3, stride=2)
+        return torch.cat([b3, b7, bp], 1)
+
+
+class InceptionE(nn.Module):
+    def __init__(self, in_ch: int, pool_type: str) -> None:
+        super().__init__()
+        self.pool_type = pool_type
+        self.branch1x1 = BasicConv2d(in_ch, 320, kernel_size=1)
+        self.branch3x3_1 = BasicConv2d(in_ch, 384, kernel_size=1)
+        self.branch3x3_2a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3_2b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch3x3dbl_1 = BasicConv2d(in_ch, 448, kernel_size=1)
+        self.branch3x3dbl_2 = BasicConv2d(448, 384, kernel_size=3, padding=1)
+        self.branch3x3dbl_3a = BasicConv2d(384, 384, kernel_size=(1, 3), padding=(0, 1))
+        self.branch3x3dbl_3b = BasicConv2d(384, 384, kernel_size=(3, 1), padding=(1, 0))
+        self.branch_pool = BasicConv2d(in_ch, 192, kernel_size=1)
+
+    def forward(self, x):
+        b1 = self.branch1x1(x)
+        b3 = self.branch3x3_1(x)
+        b3 = torch.cat([self.branch3x3_2a(b3), self.branch3x3_2b(b3)], 1)
+        bd = self.branch3x3dbl_2(self.branch3x3dbl_1(x))
+        bd = torch.cat([self.branch3x3dbl_3a(bd), self.branch3x3dbl_3b(bd)], 1)
+        if self.pool_type == "avg":
+            bp = _avg3(x)
+        else:
+            bp = F.max_pool2d(x, kernel_size=3, stride=1, padding=1)
+        bp = self.branch_pool(bp)
+        return torch.cat([b1, b3, bd, bp], 1)
+
+
+def tf1_resize_bilinear_torch(x: torch.Tensor, out_h: int, out_w: int) -> torch.Tensor:
+    """TF1.x legacy bilinear resize (align_corners=False), NCHW float."""
+    n, c, h, w = x.shape
+    if (h, w) == (out_h, out_w):
+        return x
+    ys = torch.arange(out_h, dtype=x.dtype) * (h / out_h)
+    xs = torch.arange(out_w, dtype=x.dtype) * (w / out_w)
+    y0 = ys.floor().long().clamp(max=h - 1)
+    x0 = xs.floor().long().clamp(max=w - 1)
+    y1 = (y0 + 1).clamp(max=h - 1)
+    x1 = (x0 + 1).clamp(max=w - 1)
+    fy = (ys - y0).view(1, 1, out_h, 1)
+    fx = (xs - x0).view(1, 1, 1, out_w)
+    rows0, rows1 = x[:, :, y0, :], x[:, :, y1, :]
+    r00, r01 = rows0[:, :, :, x0], rows0[:, :, :, x1]
+    r10, r11 = rows1[:, :, :, x0], rows1[:, :, :, x1]
+    top = r00 + (r01 - r00) * fx
+    bottom = r10 + (r11 - r10) * fx
+    return top + (bottom - top) * fy
+
+
+class TorchFIDInception(nn.Module):
+    """torch-fidelity FeatureExtractorInceptionV3 replica (all feature taps)."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        self.Conv2d_1a_3x3 = BasicConv2d(3, 32, kernel_size=3, stride=2)
+        self.Conv2d_2a_3x3 = BasicConv2d(32, 32, kernel_size=3)
+        self.Conv2d_2b_3x3 = BasicConv2d(32, 64, kernel_size=3, padding=1)
+        self.Conv2d_3b_1x1 = BasicConv2d(64, 80, kernel_size=1)
+        self.Conv2d_4a_3x3 = BasicConv2d(80, 192, kernel_size=3)
+        self.Mixed_5b = InceptionA(192, pool_features=32)
+        self.Mixed_5c = InceptionA(256, pool_features=64)
+        self.Mixed_5d = InceptionA(288, pool_features=64)
+        self.Mixed_6a = InceptionB(288)
+        self.Mixed_6b = InceptionC(768, channels_7x7=128)
+        self.Mixed_6c = InceptionC(768, channels_7x7=160)
+        self.Mixed_6d = InceptionC(768, channels_7x7=160)
+        self.Mixed_6e = InceptionC(768, channels_7x7=192)
+        self.Mixed_7a = InceptionD(768)
+        self.Mixed_7b = InceptionE(1280, pool_type="avg")
+        self.Mixed_7c = InceptionE(2048, pool_type="max")
+        self.fc = nn.Linear(2048, 1008)
+
+    @torch.no_grad()
+    def forward(self, x: torch.Tensor):
+        """``x``: uint8 NCHW. Returns the dict of feature taps."""
+        out = {}
+        x = x.float()
+        x = tf1_resize_bilinear_torch(x, 299, 299)
+        x = (x - 128.0) / 128.0
+        x = self.Conv2d_1a_3x3(x)
+        x = self.Conv2d_2a_3x3(x)
+        x = self.Conv2d_2b_3x3(x)
+        x = F.max_pool2d(x, kernel_size=3, stride=2)
+        out["64"] = x.mean(dim=(2, 3))
+        x = self.Conv2d_3b_1x1(x)
+        x = self.Conv2d_4a_3x3(x)
+        x = F.max_pool2d(x, kernel_size=3, stride=2)
+        out["192"] = x.mean(dim=(2, 3))
+        x = self.Mixed_5b(x)
+        x = self.Mixed_5c(x)
+        x = self.Mixed_5d(x)
+        x = self.Mixed_6a(x)
+        x = self.Mixed_6b(x)
+        x = self.Mixed_6c(x)
+        x = self.Mixed_6d(x)
+        x = self.Mixed_6e(x)
+        out["768"] = x.mean(dim=(2, 3))
+        x = self.Mixed_7a(x)
+        x = self.Mixed_7b(x)
+        x = self.Mixed_7c(x)
+        pooled = x.mean(dim=(2, 3))
+        out["2048"] = pooled
+        out["logits_unbiased"] = pooled.mm(self.fc.weight.T)
+        return out
+
+
+_VGG16_CFG = (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M", 512, 512, 512)
+_VGG_TAP_LAYERS = (3, 8, 15, 22, 29)  # relu1_2, relu2_2, relu3_3, relu4_3, relu5_3
+_VGG_CHANNELS = (64, 128, 256, 512, 512)
+
+
+class TorchLPIPS(nn.Module):
+    """VGG16-LPIPS replica: torchvision `features` naming + richzhang heads."""
+
+    def __init__(self) -> None:
+        super().__init__()
+        layers = []
+        in_ch = 3
+        for v in _VGG16_CFG:
+            if v == "M":
+                layers.append(nn.MaxPool2d(2, 2))
+            else:
+                layers.append(nn.Conv2d(in_ch, v, kernel_size=3, padding=1))
+                layers.append(nn.ReLU(inplace=False))
+                in_ch = v
+        self.features = nn.Sequential(*layers)
+        self.lins = nn.ModuleList([nn.Conv2d(c, 1, kernel_size=1, bias=False) for c in _VGG_CHANNELS])
+        self.register_buffer("shift", torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1))
+        self.register_buffer("scale", torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1))
+
+    def vgg_state_dict(self):
+        """State dict with torchvision vgg16 `features.N` naming."""
+        return {k: v for k, v in self.state_dict().items() if k.startswith("features.")}
+
+    def heads_state_dict(self):
+        """State dict with richzhang `lin{i}.model.1.weight` naming."""
+        return {f"lin{i}.model.1.weight": lin.weight for i, lin in enumerate(self.lins)}
+
+    @torch.no_grad()
+    def forward(self, img0: torch.Tensor, img1: torch.Tensor) -> torch.Tensor:
+        """``img0``/``img1``: NCHW float in [-1, 1]."""
+
+        def taps(x):
+            x = (x - self.shift) / self.scale
+            feats = []
+            for i, layer in enumerate(self.features):
+                x = layer(x)
+                if i in _VGG_TAP_LAYERS:
+                    feats.append(x)
+            return feats
+
+        def unit(x, eps=1e-10):
+            return x / (x.pow(2).sum(dim=1, keepdim=True).sqrt() + eps)
+
+        total = 0.0
+        for f0, f1, lin in zip(taps(img0), taps(img1), self.lins):
+            d = (unit(f0) - unit(f1)).pow(2)
+            total = total + lin(d).mean(dim=(1, 2, 3))
+        return total

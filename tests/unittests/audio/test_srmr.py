@@ -31,9 +31,6 @@ from torchmetrics_tpu.functional.audio.srmr import (
     _modulation_filterbank,
 )
 
-EAR_Q, MIN_BW = 9.26449, 24.7
-
-
 def _oracle_srmr(x, fs, n_cochlear_filters=23, low_freq=125.0, min_cf=4.0, max_cf=None, norm=False):
     """Float64 scipy port of the reference SRMR pipeline (slow path)."""
     x = np.atleast_2d(np.asarray(x, np.float64))

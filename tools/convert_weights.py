@@ -1,0 +1,196 @@
+"""Convert pretrained torch checkpoints to torchmetrics_tpu ``.npz`` files.
+
+The model-based metrics (FID/IS/KID/MiFID via InceptionV3, LPIPS via VGG16)
+accept ``weights_path=<file>.npz`` holding a flattened
+``{collection/module/.../leaf: array}`` mapping (see
+``torchmetrics_tpu.image._inception.load_variables_npz``).  This tool produces
+those files from the torch checkpoints the reference stack downloads:
+
+- InceptionV3: the torch-fidelity FID trunk (``pt_inception-2015-12-05``) or
+  any state dict with torchvision ``Inception3`` naming
+  (``Conv2d_1a_3x3.conv.weight`` ... ``Mixed_7c`` / ``fc``).
+- LPIPS: torchvision VGG16 ``features.N.*`` conv weights plus the
+  richzhang/LPIPS linear heads (``lin{i}.model.1.weight`` or
+  ``lins.{i}.model.1.weight``).
+
+Usage::
+
+    python tools/convert_weights.py inception weights.pth out.npz
+    python tools/convert_weights.py lpips vgg16.pth lpips_heads.pth out.npz
+
+Checkpoints are loaded with ``torch.load(map_location="cpu")``; only numpy
+arrays are written.  The conversion functions are also importable for use in
+tests (architecture-equivalence suites convert randomly-initialized torch
+trunks and assert feature parity with the Flax trunks).
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, Mapping
+
+import numpy as np
+
+# ---------------------------------------------------------------------------
+# InceptionV3 (FID variant): torch naming -> flax module paths
+# ---------------------------------------------------------------------------
+
+# stem convs in forward order
+_INCEPTION_STEM = {
+    "Conv2d_1a_3x3": "BasicConv2d_0",
+    "Conv2d_2a_3x3": "BasicConv2d_1",
+    "Conv2d_2b_3x3": "BasicConv2d_2",
+    "Conv2d_3b_1x1": "BasicConv2d_3",
+    "Conv2d_4a_3x3": "BasicConv2d_4",
+}
+
+_INCEPTION_MIXED = {
+    "Mixed_5b": "InceptionA_0",
+    "Mixed_5c": "InceptionA_1",
+    "Mixed_5d": "InceptionA_2",
+    "Mixed_6a": "InceptionB_0",
+    "Mixed_6b": "InceptionC_0",
+    "Mixed_6c": "InceptionC_1",
+    "Mixed_6d": "InceptionC_2",
+    "Mixed_6e": "InceptionC_3",
+    "Mixed_7a": "InceptionD_0",
+    "Mixed_7b": "InceptionE_0",
+    "Mixed_7c": "InceptionE_1",
+}
+
+# branch name -> BasicConv2d slot inside each flax block (creation order)
+_BRANCHES = {
+    "InceptionA": {
+        "branch1x1": 0,
+        "branch5x5_1": 1,
+        "branch5x5_2": 2,
+        "branch3x3dbl_1": 3,
+        "branch3x3dbl_2": 4,
+        "branch3x3dbl_3": 5,
+        "branch_pool": 6,
+    },
+    "InceptionB": {
+        "branch3x3": 0,
+        "branch3x3dbl_1": 1,
+        "branch3x3dbl_2": 2,
+        "branch3x3dbl_3": 3,
+    },
+    "InceptionC": {
+        "branch1x1": 0,
+        "branch7x7_1": 1,
+        "branch7x7_2": 2,
+        "branch7x7_3": 3,
+        "branch7x7dbl_1": 4,
+        "branch7x7dbl_2": 5,
+        "branch7x7dbl_3": 6,
+        "branch7x7dbl_4": 7,
+        "branch7x7dbl_5": 8,
+        "branch_pool": 9,
+    },
+    "InceptionD": {
+        "branch3x3_1": 0,
+        "branch3x3_2": 1,
+        "branch7x7x3_1": 2,
+        "branch7x7x3_2": 3,
+        "branch7x7x3_3": 4,
+        "branch7x7x3_4": 5,
+    },
+    "InceptionE": {
+        "branch1x1": 0,
+        "branch3x3_1": 1,
+        "branch3x3_2a": 2,
+        "branch3x3_2b": 3,
+        "branch3x3dbl_1": 4,
+        "branch3x3dbl_2": 5,
+        "branch3x3dbl_3a": 6,
+        "branch3x3dbl_3b": 7,
+        "branch_pool": 8,
+    },
+}
+
+
+def _to_numpy(value) -> np.ndarray:
+    if hasattr(value, "detach"):
+        value = value.detach().cpu().numpy()
+    return np.asarray(value)
+
+
+def _emit_basic_conv(out: Dict[str, np.ndarray], flax_prefix: str, torch_prefix: str, sd: Mapping) -> None:
+    """One conv+BN unit: OIHW conv -> HWIO kernel, BN affine + running stats."""
+    out[f"params/{flax_prefix}/Conv_0/kernel"] = _to_numpy(sd[f"{torch_prefix}.conv.weight"]).transpose(2, 3, 1, 0)
+    out[f"params/{flax_prefix}/BatchNorm_0/scale"] = _to_numpy(sd[f"{torch_prefix}.bn.weight"])
+    out[f"params/{flax_prefix}/BatchNorm_0/bias"] = _to_numpy(sd[f"{torch_prefix}.bn.bias"])
+    out[f"batch_stats/{flax_prefix}/BatchNorm_0/mean"] = _to_numpy(sd[f"{torch_prefix}.bn.running_mean"])
+    out[f"batch_stats/{flax_prefix}/BatchNorm_0/var"] = _to_numpy(sd[f"{torch_prefix}.bn.running_var"])
+
+
+def convert_inception_state_dict(sd: Mapping) -> Dict[str, np.ndarray]:
+    """FID InceptionV3 state dict -> flattened npz mapping."""
+    out: Dict[str, np.ndarray] = {}
+    for torch_name, flax_name in _INCEPTION_STEM.items():
+        _emit_basic_conv(out, flax_name, torch_name, sd)
+    for torch_block, flax_block in _INCEPTION_MIXED.items():
+        branches = _BRANCHES[flax_block.rsplit("_", 1)[0]]
+        for branch, slot in branches.items():
+            _emit_basic_conv(out, f"{flax_block}/BasicConv2d_{slot}", f"{torch_block}.{branch}", sd)
+    # logits head: torch Linear [out, in] -> flax Dense kernel [in, out];
+    # the bias is unused (the metrics consume `logits_unbiased`)
+    out["params/fc/kernel"] = _to_numpy(sd["fc.weight"]).transpose(1, 0)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# LPIPS: torchvision VGG16 features + richzhang linear heads
+# ---------------------------------------------------------------------------
+
+# torchvision vgg16 conv layer indices inside `features`
+_VGG16_CONV_IDX = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+
+
+def convert_lpips_state_dicts(vgg_sd: Mapping, heads_sd: Mapping) -> Dict[str, np.ndarray]:
+    """VGG16 trunk + LPIPS head state dicts -> flattened npz mapping."""
+    out: Dict[str, np.ndarray] = {}
+    for flax_idx, torch_idx in enumerate(_VGG16_CONV_IDX):
+        key = f"features.{torch_idx}"
+        if f"{key}.weight" not in vgg_sd:  # richzhang checkpoints use net.slice naming
+            raise KeyError(f"Missing `{key}.weight` — expected torchvision vgg16 `features.N` naming")
+        out[f"params/net/Conv_{flax_idx}/kernel"] = _to_numpy(vgg_sd[f"{key}.weight"]).transpose(2, 3, 1, 0)
+        out[f"params/net/Conv_{flax_idx}/bias"] = _to_numpy(vgg_sd[f"{key}.bias"])
+    for i in range(5):
+        for candidate in (f"lin{i}.model.1.weight", f"lins.{i}.model.1.weight", f"lin{i}.weight"):
+            if candidate in heads_sd:
+                out[f"params/lin{i}/kernel"] = _to_numpy(heads_sd[candidate]).transpose(2, 3, 1, 0)
+                break
+        else:
+            raise KeyError(f"LPIPS head weights for lin{i} not found in heads state dict")
+    return out
+
+
+def _save(out_path: str, flat: Dict[str, np.ndarray]) -> None:
+    np.savez(out_path, **flat)
+    total = sum(v.size for v in flat.values())
+    print(f"wrote {out_path}: {len(flat)} arrays, {total / 1e6:.1f}M parameters")
+
+
+def _load_torch_checkpoint(path: str) -> Mapping:
+    import torch
+
+    ckpt = torch.load(path, map_location="cpu", weights_only=True)
+    if isinstance(ckpt, dict) and "state_dict" in ckpt:
+        ckpt = ckpt["state_dict"]
+    return ckpt
+
+
+def main(argv) -> int:
+    if len(argv) >= 3 and argv[0] == "inception":
+        _save(argv[2], convert_inception_state_dict(_load_torch_checkpoint(argv[1])))
+        return 0
+    if len(argv) >= 4 and argv[0] == "lpips":
+        _save(argv[3], convert_lpips_state_dicts(_load_torch_checkpoint(argv[1]), _load_torch_checkpoint(argv[2])))
+        return 0
+    print(__doc__)
+    return 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main(sys.argv[1:]))

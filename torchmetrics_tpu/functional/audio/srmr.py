@@ -54,14 +54,15 @@ def _erb_bandwidths(cfs: np.ndarray) -> np.ndarray:
     return cfs / _EAR_Q + _MIN_BW
 
 
-@lru_cache(maxsize=100)
-def _gammatone_coefs(fs: int, n_filters: int, low_freq: float) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
-    """Slaney (1993) 4th-order gammatone as four chained biquads.
+def _slaney_sections(cfs: np.ndarray, fs: int) -> Tuple[np.ndarray, ...]:
+    """Shared Slaney (1993) gammatone algebra: per-filter section zeros + gain.
 
-    Returns ``(numerators [4, N, 3], denominator [N, 3], gain [N])`` in float64.
-    Same algebra as the gammatone package's ``make_erb_filters``.
+    Returns ``(k11, k12, k13, k14, gain, b, arg)`` where the ``k1x`` are the
+    cos/sin zero factors of the four cascade sections, ``gain`` the 4th-order
+    passband gain, ``b`` the 1.019*2π*ERB damping and ``arg`` = 2π·cf/fs.
+    Same algebra as the gammatone package's ``make_erb_filters`` (the FFT
+    weight path reuses the identical factors).
     """
-    cfs = _erb_centre_freqs(fs, n_filters, low_freq)
     t = 1.0 / fs
     b = 1.019 * 2.0 * pi * _erb_bandwidths(cfs)
     arg = 2.0 * cfs * pi * t
@@ -69,13 +70,11 @@ def _gammatone_coefs(fs: int, n_filters: int, low_freq: float) -> Tuple[np.ndarr
 
     rt_pos = np.sqrt(3.0 + 2.0**1.5)
     rt_neg = np.sqrt(3.0 - 2.0**1.5)
-    common = -t * np.exp(-b * t)
     k11 = np.cos(arg) + rt_pos * np.sin(arg)
     k12 = np.cos(arg) - rt_pos * np.sin(arg)
     k13 = np.cos(arg) + rt_neg * np.sin(arg)
     k14 = np.cos(arg) - rt_neg * np.sin(arg)
 
-    a11, a12, a13, a14 = common * k11, common * k12, common * k13, common * k14
     gain_arg = np.exp(1j * arg - b * t)
     gain = np.abs(
         (vec - gain_arg * k11)
@@ -84,11 +83,24 @@ def _gammatone_coefs(fs: int, n_filters: int, low_freq: float) -> Tuple[np.ndarr
         * (vec - gain_arg * k14)
         * (t * np.exp(b * t) / (-1.0 / np.exp(b * t) + 1.0 + vec * (1.0 - np.exp(b * t)))) ** 4
     )
+    return k11, k12, k13, k14, gain, b, arg
+
+
+@lru_cache(maxsize=100)
+def _gammatone_coefs(fs: int, n_filters: int, low_freq: float) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Slaney (1993) 4th-order gammatone as four chained biquads.
+
+    Returns ``(numerators [4, N, 3], denominator [N, 3], gain [N])`` in float64.
+    """
+    cfs = _erb_centre_freqs(fs, n_filters, low_freq)
+    t = 1.0 / fs
+    k11, k12, k13, k14, gain, b, arg = _slaney_sections(cfs, fs)
+    common = -t * np.exp(-b * t)
 
     a0 = np.full_like(cfs, t)
     a2 = np.zeros_like(cfs)
     numerators = np.stack(
-        [np.stack([a0, a1x, a2], axis=-1) for a1x in (a11, a12, a13, a14)], axis=0
+        [np.stack([a0, common * k, a2], axis=-1) for k in (k11, k12, k13, k14)], axis=0
     )  # [4, N, 3]
     denominator = np.stack(
         [np.ones_like(cfs), -2.0 * np.cos(arg) / np.exp(b * t), np.exp(-2.0 * b * t)], axis=-1
@@ -182,31 +194,12 @@ def _gtgram_fft_weights(nfft: int, fs: int, n_filters: int, low_freq: float, max
     """
     cfs = _erb_centre_freqs(fs, n_filters, low_freq)
     t = 1.0 / fs
-    b = 1.019 * 2.0 * pi * _erb_bandwidths(cfs)
-    arg = 2.0 * cfs[:, None] * pi * t
+    k11, k12, k13, k14, gain, b, arg = _slaney_sections(cfs, fs)
     ucirc = np.exp(2j * pi * np.arange(nfft // 2 + 1)[None, :] / nfft)
 
-    rt_pos = np.sqrt(3.0 + 2.0**1.5)
-    rt_neg = np.sqrt(3.0 - 2.0**1.5)
-    common = -t * np.exp(-b[:, None] * t)
-    k11 = np.cos(arg) + rt_pos * np.sin(arg)
-    k12 = np.cos(arg) - rt_pos * np.sin(arg)
-    k13 = np.cos(arg) + rt_neg * np.sin(arg)
-    k14 = np.cos(arg) - rt_neg * np.sin(arg)
-    zros = -np.stack([common * k11, common * k12, common * k13, common * k14], axis=0) / t
-
-    vec = np.exp(2j * arg)
-    gain_arg = np.exp(1j * arg - b[:, None] * t)
-    gain = np.abs(
-        (vec - gain_arg * k11)
-        * (vec - gain_arg * k12)
-        * (vec - gain_arg * k13)
-        * (vec - gain_arg * k14)
-        * (t * np.exp(b[:, None] * t) / (-1.0 / np.exp(b[:, None] * t) + 1.0 + vec * (1.0 - np.exp(b[:, None] * t))))
-        ** 4
-    )[:, 0]
-
-    pole = np.exp(1j * arg[:, 0] - b * t)[:, None]
+    common = -t * np.exp(-b * t)
+    zros = -np.stack([common * k11, common * k12, common * k13, common * k14], axis=0)[:, :, None] / t
+    pole = np.exp(1j * arg - b * t)[:, None]
     weights = (
         (t**4 / gain[:, None])
         * np.abs(ucirc - zros[0])

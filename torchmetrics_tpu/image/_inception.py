@@ -187,6 +187,53 @@ def load_params_npz(path: str):
     return tree
 
 
+def load_variables_npz(path: str):
+    """Load a converted ``.npz`` into a full flax variables dict.
+
+    Keys whose first segment is a collection name (``params``/``batch_stats``)
+    are routed there; bare keys land in ``params`` (back-compat with npz files
+    holding only parameters).  Produced by ``tools/convert_weights.py``.
+    """
+    tree = load_params_npz(path)
+    collections = {}
+    for name in ("params", "batch_stats"):
+        if name in tree:
+            collections[name] = tree.pop(name)
+    if tree:  # un-prefixed leftovers are parameters
+        merged = collections.get("params", {})
+        merged.update(tree)
+        collections["params"] = merged
+    return collections
+
+
+def _resize_bilinear_tf1(x: Array, out_h: int, out_w: int) -> Array:
+    """TF1.x ``resize_bilinear(align_corners=False)`` for NHWC batches.
+
+    This is the legacy resize torch-fidelity replicates for FID
+    (``interpolate_bilinear_2d_like_tensorflow1x``; reference
+    ``image/fid.py:83-88``): source coordinate ``dst * (in/out)`` with no
+    half-pixel offset — deliberately NOT ``jax.image.resize``, whose
+    half-pixel sampling produces visibly different 2048-d features.
+    """
+    n, h, w, c = x.shape
+    if (h, w) == (out_h, out_w):
+        return x
+    ys = jnp.arange(out_h, dtype=jnp.float32) * (h / out_h)
+    xs = jnp.arange(out_w, dtype=jnp.float32) * (w / out_w)
+    y0 = jnp.floor(ys).astype(jnp.int32)
+    x0 = jnp.floor(xs).astype(jnp.int32)
+    y1 = jnp.minimum(y0 + 1, h - 1)
+    x1 = jnp.minimum(x0 + 1, w - 1)
+    fy = (ys - y0)[None, :, None, None]
+    fx = (xs - x0)[None, None, :, None]
+    rows0, rows1 = jnp.take(x, y0, axis=1), jnp.take(x, y1, axis=1)
+    r00, r01 = jnp.take(rows0, x0, axis=2), jnp.take(rows0, x1, axis=2)
+    r10, r11 = jnp.take(rows1, x0, axis=2), jnp.take(rows1, x1, axis=2)
+    top = r00 + (r01 - r00) * fx
+    bottom = r10 + (r11 - r10) * fx
+    return top + (bottom - top) * fy
+
+
 class InceptionFeatureExtractor:
     """Stateful wrapper: resize + TF preprocessing + InceptionV3 forward.
 
@@ -207,9 +254,8 @@ class InceptionFeatureExtractor:
         self.net = InceptionV3(dtype=compute_dtype if compute_dtype is not None else jnp.bfloat16)
         dummy = jnp.zeros((1, 299, 299, 3), jnp.float32)
         if weights_path:
-            self.variables = {"params": load_params_npz(weights_path)}
-            # batch_stats layout ships in the same npz under 'batch_stats/'
-            if "batch_stats" not in self.variables:
+            self.variables = load_variables_npz(weights_path)
+            if "batch_stats" not in self.variables:  # params-only checkpoint
                 init_vars = self.net.init(jax.random.PRNGKey(seed), dummy)
                 self.variables = {"params": self.variables["params"], "batch_stats": init_vars["batch_stats"]}
         else:
@@ -225,14 +271,19 @@ class InceptionFeatureExtractor:
         feature = self.feature
 
         def _fwd(variables, imgs):
-            # preprocessing fused into the compiled trunk; returning only the
-            # selected tap lets XLA dead-code-eliminate the other heads
+            # torch-fidelity-exact preprocessing, fused into the compiled
+            # trunk (reference image/fid.py:79-89 + metric update :334):
+            # floats in [0, 1] go through the byte cast (floor to 0..255),
+            # then the TF1.x legacy bilinear resize, then (x - 128) / 128.
             if imgs.dtype == jnp.uint8:
-                imgs = imgs.astype(jnp.float32) / 255.0
+                imgs = imgs.astype(jnp.float32)
+            else:
+                imgs = jnp.floor(jnp.clip(imgs, 0.0, 1.0) * 255.0)
             imgs = jnp.transpose(imgs, (0, 2, 3, 1))  # NCHW -> NHWC
-            if imgs.shape[1:3] != (299, 299):  # identity resize is not free under XLA
-                imgs = jax.image.resize(imgs, (imgs.shape[0], 299, 299, imgs.shape[3]), method="bilinear")
-            imgs = imgs * 2.0 - 1.0  # TF inception preprocessing
+            imgs = _resize_bilinear_tf1(imgs, 299, 299)
+            imgs = (imgs - 128.0) / 128.0
+            # returning only the selected tap lets XLA dead-code-eliminate
+            # the other heads
             return self.net.apply(variables, imgs)[feature].astype(jnp.float32)
 
         self._forward = jax.jit(_fwd)

@@ -99,9 +99,9 @@ class LPIPSExtractor:
         self.net = LPIPSNet(dtype=compute_dtype if compute_dtype is not None else jnp.bfloat16)
         dummy = jnp.zeros((1, 3, 64, 64), jnp.float32)
         if weights_path:
-            from torchmetrics_tpu.image._inception import load_params_npz
+            from torchmetrics_tpu.image._inception import load_variables_npz
 
-            self.variables = {"params": load_params_npz(weights_path)}
+            self.variables = {"params": load_variables_npz(weights_path)["params"]}
         else:
             from torchmetrics_tpu.utilities.prints import rank_zero_warn
 

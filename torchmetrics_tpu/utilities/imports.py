@@ -44,6 +44,7 @@ _PANDAS_AVAILABLE = RequirementCache("pandas")
 _REGEX_AVAILABLE = RequirementCache("regex")
 _PESQ_AVAILABLE = RequirementCache("pesq")
 _PYSTOI_AVAILABLE = RequirementCache("pystoi")
+# kept for reference imports-registry parity; SRMR itself is self-contained
 _GAMMATONE_AVAILABLE = RequirementCache("gammatone")
 _LIBROSA_AVAILABLE = RequirementCache("librosa")
 _PYCOCOTOOLS_AVAILABLE = RequirementCache("pycocotools")
