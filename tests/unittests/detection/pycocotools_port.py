@@ -1,0 +1,316 @@
+"""Faithful numpy port of pycocotools ``cocoeval.py`` (bbox + segm).
+
+A SECOND, structurally independent mAP oracle: unlike ``coco_oracle.py``
+(which reorganizes the protocol into per-image array loops), this file keeps
+upstream pycocotools' own data model and code flow — annotation dicts with
+ids, ``(imgId, catId)``-keyed defaultdicts, ``computeIoU`` on score-sorted
+capped detections, ``evaluateImg`` with ``_ignore`` mergesort + id-based
+match matrices, and ``accumulate`` over the E-list — so that shared-author
+blind spots in one oracle (tie-breaking, area fields, maxDets edges) fail
+against the other.  Port of: pycocotools/cocoeval.py (COCOeval) and
+mask.py's bbox/mask IoU with the crowd denominator.
+"""
+
+from __future__ import annotations
+
+from collections import defaultdict
+
+import numpy as np
+
+
+class Params:
+    def __init__(self):
+        self.iouThrs = np.linspace(0.5, 0.95, 10)
+        self.recThrs = np.linspace(0.0, 1.00, 101)
+        self.maxDets = [1, 10, 100]
+        self.areaRng = [[0, 1e10], [0, 32**2], [32**2, 96**2], [96**2, 1e10]]
+        self.areaRngLbl = ["all", "small", "medium", "large"]
+        self.imgIds = []
+        self.catIds = []
+
+
+def _bb_iou(d, g, iscrowd):
+    """maskUtils.iou for xywh boxes; crowd columns use the det-area denominator."""
+    d = np.asarray(d, np.float64).reshape(-1, 4)
+    g = np.asarray(g, np.float64).reshape(-1, 4)
+    ious = np.zeros((len(d), len(g)))
+    for j in range(len(g)):
+        gx, gy, gw, gh = g[j]
+        ga = gw * gh
+        for i in range(len(d)):
+            dx, dy, dw, dh = d[i]
+            da = dw * dh
+            iw = min(dx + dw, gx + gw) - max(dx, gx)
+            ih = min(dy + dh, gy + gh) - max(dy, gy)
+            if iw <= 0 or ih <= 0:
+                continue
+            inter = iw * ih
+            union = da if iscrowd[j] else da + ga - inter
+            if union > 0:
+                ious[i, j] = inter / union
+    return ious
+
+
+def _mask_iou(d, g, iscrowd):
+    ious = np.zeros((len(d), len(g)))
+    for j in range(len(g)):
+        gm = np.asarray(g[j], bool)
+        for i in range(len(d)):
+            dm = np.asarray(d[i], bool)
+            inter = float(np.logical_and(dm, gm).sum())
+            union = float(dm.sum()) if iscrowd[j] else float(dm.sum() + gm.sum() - inter)
+            if union > 0:
+                ious[i, j] = inter / union
+    return ious
+
+
+class COCOevalPort:
+    """pycocotools.COCOeval over annotation lists (no COCO index classes).
+
+    ``gts``/``dts``: lists of annotation dicts with keys ``id``, ``image_id``,
+    ``category_id``, ``area``, ``iscrowd`` (gt), ``score`` (dt), and either
+    ``bbox`` (xywh) or ``segmentation`` (binary mask array).
+    """
+
+    def __init__(self, gts, dts, img_ids, cat_ids, iou_type="bbox"):
+        self.params = Params()
+        self.params.imgIds = list(img_ids)
+        self.params.catIds = list(cat_ids)
+        self.iouType = iou_type
+        self._gts = defaultdict(list)
+        self._dts = defaultdict(list)
+        for gt in gts:
+            gt["ignore"] = gt["ignore"] if "ignore" in gt else 0
+            gt["ignore"] = ("iscrowd" in gt and gt["iscrowd"]) or gt["ignore"]
+            self._gts[gt["image_id"], gt["category_id"]].append(gt)
+        for dt in dts:
+            self._dts[dt["image_id"], dt["category_id"]].append(dt)
+
+    # --- computeIoU -------------------------------------------------------
+    def computeIoU(self, imgId, catId):
+        p = self.params
+        gt = self._gts[imgId, catId]
+        dt = self._dts[imgId, catId]
+        if len(gt) == 0 and len(dt) == 0:
+            return []
+        inds = np.argsort([-d["score"] for d in dt], kind="mergesort")
+        dt = [dt[i] for i in inds]
+        if len(dt) > p.maxDets[-1]:
+            dt = dt[0 : p.maxDets[-1]]
+        iscrowd = [int(o["iscrowd"]) for o in gt]
+        if self.iouType == "segm":
+            return _mask_iou([d["segmentation"] for d in dt], [g["segmentation"] for g in gt], iscrowd)
+        return _bb_iou([d["bbox"] for d in dt], [g["bbox"] for g in gt], iscrowd)
+
+    # --- evaluateImg ------------------------------------------------------
+    def evaluateImg(self, imgId, catId, aRng, maxDet):
+        p = self.params
+        gt = self._gts[imgId, catId]
+        dt = self._dts[imgId, catId]
+        if len(gt) == 0 and len(dt) == 0:
+            return None
+        for g in gt:
+            g["_ignore"] = 1 if (g["ignore"] or g["area"] < aRng[0] or g["area"] > aRng[1]) else 0
+        gtind = np.argsort([g["_ignore"] for g in gt], kind="mergesort")
+        gt = [gt[i] for i in gtind]
+        dtind = np.argsort([-d["score"] for d in dt], kind="mergesort")
+        dt = [dt[i] for i in dtind[0:maxDet]]
+        iscrowd = [int(o["iscrowd"]) for o in gt]
+        ious = self.ious[imgId, catId]
+        ious = ious[:, gtind] if len(ious) > 0 else ious
+
+        T = len(p.iouThrs)
+        G = len(gt)
+        D = len(dt)
+        gtm = np.zeros((T, G))
+        dtm = np.zeros((T, D))
+        gtIg = np.array([g["_ignore"] for g in gt])
+        dtIg = np.zeros((T, D))
+        if len(ious) != 0:
+            for tind, t in enumerate(p.iouThrs):
+                for dind, d in enumerate(dt):
+                    iou = min([t, 1 - 1e-10])
+                    m = -1
+                    for gind, g in enumerate(gt):
+                        if gtm[tind, gind] > 0 and not iscrowd[gind]:
+                            continue
+                        if m > -1 and gtIg[m] == 0 and gtIg[gind] == 1:
+                            break
+                        if ious[dind, gind] < iou:
+                            continue
+                        iou = ious[dind, gind]
+                        m = gind
+                    if m == -1:
+                        continue
+                    dtIg[tind, dind] = gtIg[m]
+                    dtm[tind, dind] = gt[m]["id"]
+                    gtm[tind, m] = d["id"]
+        a = np.array([d["area"] < aRng[0] or d["area"] > aRng[1] for d in dt]).reshape((1, len(dt)))
+        dtIg = np.logical_or(dtIg, np.logical_and(dtm == 0, np.repeat(a, T, 0)))
+        return {
+            "dtMatches": dtm,
+            "dtScores": [d["score"] for d in dt],
+            "gtIgnore": gtIg,
+            "dtIgnore": dtIg,
+        }
+
+    # --- evaluate + accumulate -------------------------------------------
+    def evaluate(self):
+        p = self.params
+        self.ious = {
+            (imgId, catId): self.computeIoU(imgId, catId) for imgId in p.imgIds for catId in p.catIds
+        }
+        maxDet = p.maxDets[-1]
+        self.evalImgs = [
+            self.evaluateImg(imgId, catId, areaRng, maxDet)
+            for catId in p.catIds
+            for areaRng in p.areaRng
+            for imgId in p.imgIds
+        ]
+
+    def accumulate(self):
+        p = self.params
+        T = len(p.iouThrs)
+        R = len(p.recThrs)
+        K = len(p.catIds)
+        A = len(p.areaRng)
+        M = len(p.maxDets)
+        precision = -np.ones((T, R, K, A, M))
+        recall = -np.ones((T, K, A, M))
+        I0 = len(p.imgIds)
+        A0 = len(p.areaRng)
+        for k in range(K):
+            Nk = k * A0 * I0
+            for a in range(A0):
+                Na = a * I0
+                for m, maxDet in enumerate(p.maxDets):
+                    E = [self.evalImgs[Nk + Na + i] for i in range(I0)]
+                    E = [e for e in E if e is not None]
+                    if len(E) == 0:
+                        continue
+                    dtScores = np.concatenate([np.asarray(e["dtScores"])[0:maxDet] for e in E])
+                    inds = np.argsort(-dtScores, kind="mergesort")
+                    dtm = np.concatenate([e["dtMatches"][:, 0:maxDet] for e in E], axis=1)[:, inds]
+                    dtIg = np.concatenate([e["dtIgnore"][:, 0:maxDet] for e in E], axis=1)[:, inds]
+                    gtIg = np.concatenate([e["gtIgnore"] for e in E])
+                    npig = np.count_nonzero(gtIg == 0)
+                    if npig == 0:
+                        continue
+                    tps = np.logical_and(dtm, np.logical_not(dtIg))
+                    fps = np.logical_and(np.logical_not(dtm), np.logical_not(dtIg))
+                    tp_sum = np.cumsum(tps, axis=1).astype(dtype=float)
+                    fp_sum = np.cumsum(fps, axis=1).astype(dtype=float)
+                    for t, (tp, fp) in enumerate(zip(tp_sum, fp_sum)):
+                        nd = len(tp)
+                        rc = tp / npig
+                        pr = tp / (fp + tp + np.spacing(1))
+                        q = np.zeros((R,))
+                        recall[t, k, a, m] = rc[-1] if nd else 0
+                        pr = pr.tolist()
+                        q = q.tolist()
+                        for i in range(nd - 1, 0, -1):
+                            if pr[i] > pr[i - 1]:
+                                pr[i - 1] = pr[i]
+                        inds_r = np.searchsorted(rc, p.recThrs, side="left")
+                        try:
+                            for ri, pi in enumerate(inds_r):
+                                q[ri] = pr[pi]
+                        except IndexError:
+                            pass
+                        precision[t, :, k, a, m] = np.array(q)
+        self.eval = {"precision": precision, "recall": recall}
+
+    # --- summarize --------------------------------------------------------
+    def _summarize(self, ap=1, iouThr=None, areaRng="all", maxDets=100):
+        p = self.params
+        aind = [i for i, lbl in enumerate(p.areaRngLbl) if lbl == areaRng]
+        mind = [i for i, md in enumerate(p.maxDets) if md == maxDets]
+        if ap == 1:
+            s = self.eval["precision"]
+            if iouThr is not None:
+                t = np.where(np.isclose(iouThr, p.iouThrs))[0]
+                s = s[t]
+            s = s[:, :, :, aind, mind]
+        else:
+            s = self.eval["recall"]
+            if iouThr is not None:
+                t = np.where(np.isclose(iouThr, p.iouThrs))[0]
+                s = s[t]
+            s = s[:, :, aind, mind]
+        return -1.0 if len(s[s > -1]) == 0 else float(np.mean(s[s > -1]))
+
+    def summarize(self):
+        return {
+            "map": self._summarize(1),
+            "map_50": self._summarize(1, iouThr=0.5),
+            "map_75": self._summarize(1, iouThr=0.75),
+            "map_small": self._summarize(1, areaRng="small"),
+            "map_medium": self._summarize(1, areaRng="medium"),
+            "map_large": self._summarize(1, areaRng="large"),
+            "mar_1": self._summarize(0, maxDets=1),
+            "mar_10": self._summarize(0, maxDets=10),
+            "mar_100": self._summarize(0, maxDets=100),
+            "mar_small": self._summarize(0, areaRng="small"),
+            "mar_medium": self._summarize(0, areaRng="medium"),
+            "mar_large": self._summarize(0, areaRng="large"),
+        }
+
+
+def eval_tm_format(preds, targets, iou_type="bbox"):
+    """Run the port on torchmetrics-format per-image dicts (xyxy boxes)."""
+    gts, dts = [], []
+    ann_id = 1
+    cat_ids = set()
+    for img_id, t in enumerate(targets):
+        labels = np.asarray(t["labels"])
+        iscrowd = np.asarray(t.get("iscrowd", np.zeros(len(labels)))).astype(int)
+        provided_area = np.asarray(t["area"], np.float64) if "area" in t else None
+        for j in range(len(labels)):
+            ann = {
+                "id": ann_id,
+                "image_id": img_id,
+                "category_id": int(labels[j]),
+                "iscrowd": int(iscrowd[j]),
+            }
+            if iou_type == "segm":
+                mask = np.asarray(t["masks"])[j]
+                ann["segmentation"] = mask
+                area = float(mask.sum())
+            else:
+                x1, y1, x2, y2 = np.asarray(t["boxes"], np.float64)[j]
+                ann["bbox"] = [x1, y1, x2 - x1, y2 - y1]
+                area = float((x2 - x1) * (y2 - y1))
+            # torchmetrics passes the provided area through when positive
+            # (detection/mean_ap.py: area field preferred over box area)
+            if provided_area is not None and provided_area[j] > 0:
+                area = float(provided_area[j])
+            ann["area"] = area
+            cat_ids.add(int(labels[j]))
+            gts.append(ann)
+            ann_id += 1
+    for img_id, pmap in enumerate(preds):
+        labels = np.asarray(pmap["labels"])
+        scores = np.asarray(pmap["scores"], np.float64)
+        for j in range(len(labels)):
+            ann = {
+                "id": ann_id,
+                "image_id": img_id,
+                "category_id": int(labels[j]),
+                "score": float(scores[j]),
+                "iscrowd": 0,
+            }
+            if iou_type == "segm":
+                mask = np.asarray(pmap["masks"])[j]
+                ann["segmentation"] = mask
+                ann["area"] = float(mask.sum())
+            else:
+                x1, y1, x2, y2 = np.asarray(pmap["boxes"], np.float64)[j]
+                ann["bbox"] = [x1, y1, x2 - x1, y2 - y1]
+                ann["area"] = float((x2 - x1) * (y2 - y1))
+            cat_ids.add(int(labels[j]))
+            dts.append(ann)
+            ann_id += 1
+    ev = COCOevalPort(gts, dts, img_ids=list(range(len(targets))), cat_ids=sorted(cat_ids), iou_type=iou_type)
+    ev.evaluate()
+    ev.accumulate()
+    return ev.summarize()

@@ -1,0 +1,173 @@
+"""Adversarial mAP differential tests against the vendored pycocotools port.
+
+``pycocotools_port.py`` keeps upstream cocoeval.py's own structure (id-based
+match matrices, (imgId, catId) dicts, E-list accumulate), making it
+structurally independent of both the XLA engine and the first oracle
+(``coco_oracle.py``).  Every case here runs all three implementations and
+requires exact agreement on the 12 headline COCO stats — targeting the edge
+semantics the round-2 verdict flagged as shared-author blind-spot risks:
+equal-score ties, crowd-only images, area-boundary detections,
+maxDets < detections, and absent classes.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.unittests.detection.coco_oracle import coco_eval_oracle
+from tests.unittests.detection.pycocotools_port import eval_tm_format
+from tests.unittests.detection.test_mean_ap import IOU_THRS, MAX_DETS, REC_THRS, _random_dataset, _to_jnp
+from torchmetrics_tpu.detection import MeanAveragePrecision
+from torchmetrics_tpu.functional.detection._map_eval import summarize
+
+_STATS = [
+    "map", "map_50", "map_75", "map_small", "map_medium", "map_large",
+    "mar_1", "mar_10", "mar_100", "mar_small", "mar_medium", "mar_large",
+]
+
+
+def _run_all_three(preds, targets, iou_type="bbox"):
+    keys_p = {"boxes", "scores", "labels"} if iou_type == "bbox" else {"masks", "scores", "labels"}
+    keys_t = {"boxes", "labels", "iscrowd", "area"} if iou_type == "bbox" else {"masks", "labels", "iscrowd", "area"}
+    metric = MeanAveragePrecision(iou_type=iou_type)
+    metric.update(_to_jnp(preds, keys_p), _to_jnp(targets, keys_t))
+    got = {k: float(jnp.asarray(v).reshape(-1)[0]) for k, v in metric.compute().items() if k in _STATS}
+
+    port = eval_tm_format(preds, targets, iou_type=iou_type)
+
+    classes = sorted(
+        {int(c) for p in preds for c in np.asarray(p["labels"]).tolist()}
+        | {int(c) for t in targets for c in np.asarray(t["labels"]).tolist()}
+    )
+    p_ref, r_ref = coco_eval_oracle(
+        preds, targets, IOU_THRS, REC_THRS, MAX_DETS, classes, masks=(iou_type == "segm")
+    )
+    first = summarize(p_ref, r_ref, IOU_THRS, MAX_DETS)
+
+    for k in _STATS:
+        assert np.isclose(got[k], port[k], atol=1e-6), ("engine vs port", k, got[k], port[k])
+        assert np.isclose(first[k], port[k], atol=1e-6), ("oracle vs port", k, first[k], port[k])
+    return got
+
+
+@pytest.mark.parametrize("seed", range(3))
+@pytest.mark.parametrize("with_area", [False, True])
+def test_port_agrees_on_random_datasets(seed, with_area):
+    preds, targets = _random_dataset(seed, with_area=with_area)
+    _run_all_three(preds, targets)
+
+
+def test_equal_score_ties():
+    """Many detections sharing one score: ordering must follow the stable
+    mergesort semantics of pycocotools in both match-time and accumulate."""
+    rng = np.random.default_rng(0)
+    preds, targets = [], []
+    for _ in range(4):
+        ng = 6
+        gtb = np.concatenate([rng.random((ng, 2)) * 100, np.zeros((ng, 2))], 1)
+        gtb[:, 2:] = gtb[:, :2] + 10 + rng.random((ng, 2)) * 30
+        # detections: jittered copies of gts, ALL with the same score
+        dtb = gtb + rng.normal(0, 3, gtb.shape)
+        preds.append(dict(boxes=dtb, scores=np.full(ng, 0.5), labels=rng.integers(0, 2, ng)))
+        targets.append(dict(boxes=gtb, labels=rng.integers(0, 2, ng), iscrowd=np.zeros(ng, int)))
+    _run_all_three(preds, targets)
+
+
+def test_crowd_only_images():
+    """Images whose every gt is crowd: no positives, detections ignored on
+    crowd matches but counted as FP when unmatched."""
+    rng = np.random.default_rng(1)
+    preds, targets = [], []
+    for i in range(3):
+        ng, nd = 4, 5
+        gtb = np.concatenate([rng.random((ng, 2)) * 100, np.zeros((ng, 2))], 1)
+        gtb[:, 2:] = gtb[:, :2] + 20
+        dtb = np.concatenate([rng.random((nd, 2)) * 100, np.zeros((nd, 2))], 1)
+        dtb[:, 2:] = dtb[:, :2] + 20
+        crowd = np.ones(ng, int) if i < 2 else np.zeros(ng, int)  # 2 crowd-only + 1 normal
+        preds.append(dict(boxes=dtb, scores=rng.random(nd), labels=np.zeros(nd, int)))
+        targets.append(dict(boxes=gtb, labels=np.zeros(ng, int), iscrowd=crowd))
+    _run_all_three(preds, targets)
+
+
+def test_area_boundary_detections():
+    """gt/det areas exactly ON the 32^2 / 96^2 range boundaries (inclusive on
+    both sides per pycocotools' < / > ignore test)."""
+    boxes = np.array(
+        [
+            [0.0, 0.0, 32.0, 32.0],     # area 1024 == 32^2: in 'small' AND 'medium'
+            [50.0, 50.0, 146.0, 146.0], # area 9216 == 96^2: in 'medium' AND 'large'
+            [200.0, 200.0, 210.0, 210.0],  # 100: small
+            [300.0, 0.0, 400.0, 100.0],    # 10000: large
+        ]
+    )
+    preds = [dict(boxes=boxes + 1.0, scores=np.array([0.9, 0.8, 0.7, 0.6]), labels=np.zeros(4, int))]
+    targets = [dict(boxes=boxes, labels=np.zeros(4, int), iscrowd=np.zeros(4, int))]
+    _run_all_three(preds, targets)
+
+
+def test_max_dets_smaller_than_detections():
+    """More detections than every maxDets entry: per-entry slicing order
+    matters (pycocotools caps at maxDets[-1] during matching, then re-slices
+    per entry during accumulate)."""
+    rng = np.random.default_rng(2)
+    nd, ng = 130, 8  # nd > 100 == maxDets[-1]
+    gtb = np.concatenate([rng.random((ng, 2)) * 200, np.zeros((ng, 2))], 1)
+    gtb[:, 2:] = gtb[:, :2] + 15 + rng.random((ng, 2)) * 40
+    dtb = np.concatenate([gtb + rng.normal(0, 4, gtb.shape)] * 17, 0)[:nd]
+    preds = [dict(boxes=dtb, scores=rng.random(nd).round(2), labels=np.zeros(nd, int))]
+    targets = [dict(boxes=gtb, labels=np.zeros(ng, int), iscrowd=np.zeros(ng, int))]
+    _run_all_three(preds, targets)
+
+
+def test_absent_classes():
+    """Classes present only in gts (never predicted) and only in preds
+    (hallucinated): both must enter the class axis with the right -1 /
+    penalty semantics."""
+    rng = np.random.default_rng(3)
+    ng, nd = 6, 6
+    gtb = np.concatenate([rng.random((ng, 2)) * 100, np.zeros((ng, 2))], 1)
+    gtb[:, 2:] = gtb[:, :2] + 25
+    dtb = gtb + rng.normal(0, 2, gtb.shape)
+    preds = [dict(boxes=dtb, scores=rng.random(nd), labels=np.array([0, 0, 2, 2, 2, 2]))]
+    targets = [dict(boxes=gtb, labels=np.array([0, 0, 1, 1, 1, 1]), iscrowd=np.zeros(ng, int))]
+    _run_all_three(preds, targets)
+
+
+def test_provided_area_overrides_box_area():
+    """Provided gt `area` shifts range membership away from the box-derived
+    area (the exact field-vs-derived blind spot the verdict called out)."""
+    boxes = np.array([[0.0, 0.0, 20.0, 20.0], [100.0, 100.0, 220.0, 220.0]])  # 400 (small), 14400 (large)
+    preds = [dict(boxes=boxes + 0.5, scores=np.array([0.9, 0.8]), labels=np.zeros(2, int))]
+    targets = [
+        dict(
+            boxes=boxes,
+            labels=np.zeros(2, int),
+            iscrowd=np.zeros(2, int),
+            # swap: the small box claims a large area and vice versa
+            area=np.array([50000.0, 500.0]),
+        )
+    ]
+    _run_all_three(preds, targets)
+
+
+def test_segm_masks_case():
+    rng = np.random.default_rng(4)
+    h = w = 48
+    masks_gt, masks_dt = [], []
+    for _ in range(3):
+        m = np.zeros((h, w), bool)
+        y, x = rng.integers(0, 24, 2)
+        hh, ww = rng.integers(8, 24, 2)
+        m[y : y + hh, x : x + ww] = True
+        masks_gt.append(m)
+        d = np.roll(m, rng.integers(-2, 3, 2), axis=(0, 1))
+        masks_dt.append(d)
+    preds = [dict(masks=np.stack(masks_dt), scores=np.array([0.9, 0.6, 0.3]), labels=np.array([0, 0, 1]))]
+    targets = [
+        dict(masks=np.stack(masks_gt), labels=np.array([0, 0, 1]), iscrowd=np.array([0, 1, 0]))
+    ]
+    _run_all_three(preds, targets, iou_type="segm")

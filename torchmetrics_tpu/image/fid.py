@@ -71,6 +71,7 @@ class FrechetInceptionDistance(Metric):
         reset_real_features: bool = True,
         normalize: bool = False,
         weights_path: str = None,
+        compute_dtype: Any = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -84,7 +85,9 @@ class FrechetInceptionDistance(Metric):
             from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
 
             num_features = feature
-            self.inception = InceptionFeatureExtractor(feature=feature, weights_path=weights_path)
+            self.inception = InceptionFeatureExtractor(
+                feature=feature, weights_path=weights_path, compute_dtype=compute_dtype
+            )
         elif callable(feature):
             self.inception = feature
             num_features = getattr(feature, "num_features", None)

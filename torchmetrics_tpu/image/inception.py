@@ -32,6 +32,7 @@ class InceptionScore(Metric):
         splits: int = 10,
         normalize: bool = False,
         weights_path: str = None,
+        compute_dtype: Any = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -43,7 +44,9 @@ class InceptionScore(Metric):
                 )
             from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
 
-            self.inception = InceptionFeatureExtractor(feature=feature, weights_path=weights_path)
+            self.inception = InceptionFeatureExtractor(
+                feature=feature, weights_path=weights_path, compute_dtype=compute_dtype
+            )
         elif callable(feature):
             self.inception = feature
         else:

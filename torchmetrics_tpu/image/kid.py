@@ -58,13 +58,16 @@ class KernelInceptionDistance(Metric):
         reset_real_features: bool = True,
         normalize: bool = False,
         weights_path: str = None,
+        compute_dtype: Any = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         if isinstance(feature, (str, int)):
             from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
 
-            self.inception = InceptionFeatureExtractor(feature=feature, weights_path=weights_path)
+            self.inception = InceptionFeatureExtractor(
+                feature=feature, weights_path=weights_path, compute_dtype=compute_dtype
+            )
         elif callable(feature):
             self.inception = feature
         else:

@@ -36,6 +36,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         normalize: bool = False,
         net: Optional[Callable] = None,
         weights_path: str = None,
+        compute_dtype: Any = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -47,7 +48,7 @@ class LearnedPerceptualImagePatchSimilarity(Metric):
         else:
             from torchmetrics_tpu.image._lpips import LPIPSExtractor
 
-            self.net = LPIPSExtractor(net_type=net_type, weights_path=weights_path)
+            self.net = LPIPSExtractor(net_type=net_type, weights_path=weights_path, compute_dtype=compute_dtype)
 
         valid_reduction = ("mean", "sum")
         if reduction not in valid_reduction:

@@ -39,6 +39,7 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
         normalize: bool = False,
         cosine_distance_eps: float = 0.1,
         weights_path: str = None,
+        compute_dtype: Any = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
@@ -50,7 +51,9 @@ class MemorizationInformedFrechetInceptionDistance(Metric):
                 )
             from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
 
-            self.inception = InceptionFeatureExtractor(feature=feature, weights_path=weights_path)
+            self.inception = InceptionFeatureExtractor(
+                feature=feature, weights_path=weights_path, compute_dtype=compute_dtype
+            )
         elif callable(feature):
             self.inception = feature
         else:
