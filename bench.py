@@ -113,6 +113,69 @@ def _bench_ours() -> float:
     return STREAM_REPS * ITERS / _min_time(run, reps=3)
 
 
+def _bench_class_api() -> tuple:
+    """Class-API hot path, as users call it: eager per-batch ``update()`` vs
+    the compiled ``jit_update()`` recipe (one XLA computation per batch)."""
+    import jax
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    preds = jax.random.uniform(jax.random.PRNGKey(0), (BATCH, NUM_CLASSES), dtype=jnp.float32)
+    target = jax.random.randint(jax.random.PRNGKey(1), (BATCH,), 0, NUM_CLASSES)
+    n_updates = 200
+
+    eager = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+
+    def run_eager():
+        eager.reset()
+        for _ in range(n_updates):
+            eager.update(preds, target)
+        return float(eager.compute())
+
+    jitted = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+
+    def run_jit():
+        jitted.reset()
+        for _ in range(n_updates):
+            jitted.jit_update(preds, target)
+        return float(jitted.compute())
+
+    return n_updates / _min_time(run_eager, reps=3), n_updates / _min_time(run_jit, reps=3)
+
+
+def _bench_class_api_torch_baseline() -> float:
+    """The reference's own class API (MulticlassAccuracy.update) on torch CPU."""
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+    try:
+        from tests.helpers.reference_oracle import load_reference
+
+        torchmetrics = load_reference()
+    except Exception:
+        torchmetrics = None
+    import torch
+
+    g = torch.Generator().manual_seed(0)
+    preds = torch.rand((BATCH, NUM_CLASSES), generator=g)
+    target = torch.randint(0, NUM_CLASSES, (BATCH,), generator=g)
+    n_updates = 50
+    if torchmetrics is not None:
+        metric = torchmetrics.classification.MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
+
+        def run():
+            metric.reset()
+            for _ in range(n_updates):
+                metric.update(preds, target)
+            float(metric.compute())
+    else:  # reference checkout unavailable: plain torch stat-scores loop
+        def run():
+            for _ in range(n_updates):
+                lbl = preds.argmax(dim=1)
+                (lbl == target).sum()
+
+    return n_updates / _min_time(run, reps=3, subtract_rtt=False)
+
+
 def _bench_torch_cpu_baseline() -> float:
     import torch
 
@@ -520,6 +583,31 @@ def main() -> None:
                 "value": round(ours, 2),
                 "unit": f"updates/sec (batch={BATCH}, C={NUM_CLASSES})",
                 "vs_baseline": round(ours / base, 3),
+            }
+        )
+    )
+
+    eager_rate, jit_rate = _bench_class_api()
+    class_base = _bench_class_api_torch_baseline()
+    print(
+        json.dumps(
+            {
+                "metric": "class_api_updates_per_sec",
+                "value": round(eager_rate, 2),
+                "unit": f"updates/sec (eager Metric.update, batch={BATCH}, C={NUM_CLASSES};"
+                " baseline = reference class API on torch CPU)",
+                "vs_baseline": round(eager_rate / class_base, 3),
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "class_api_jit_updates_per_sec",
+                "value": round(jit_rate, 2),
+                "unit": f"updates/sec (Metric.jit_update, batch={BATCH}, C={NUM_CLASSES};"
+                " baseline = reference class API on torch CPU)",
+                "vs_baseline": round(jit_rate / class_base, 3),
             }
         )
     )

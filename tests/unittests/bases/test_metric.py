@@ -264,3 +264,115 @@ def test_check_forward_full_state_property(capsys):
     )
     out = capsys.readouterr().out
     assert "Recommended setting `full_state_update=False`" in out
+
+
+class TestCompiledUpdatePaths:
+    """jit_update / scan_update: compiled class-API streaming (round-3)."""
+
+    def _data(self, steps=6, batch=32, C=5, seed=0):
+        rng = np.random.default_rng(seed)
+        P = jnp.asarray(rng.random((steps, batch, C), dtype=np.float32))
+        T = jnp.asarray(rng.integers(0, C, (steps, batch)))
+        return P, T
+
+    def test_jit_update_matches_update(self):
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+
+        P, T = self._data()
+        ref, fast = MulticlassAccuracy(num_classes=5), MulticlassAccuracy(num_classes=5)
+        for i in range(P.shape[0]):
+            ref.update(P[i], T[i])
+            fast.jit_update(P[i], target=T[i])  # kwargs supported
+        assert fast._update_count == ref._update_count
+        assert float(fast.compute()) == float(ref.compute())
+
+    def test_scan_update_matches_update(self):
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+
+        P, T = self._data()
+        ref, fast = MulticlassAccuracy(num_classes=5), MulticlassAccuracy(num_classes=5)
+        for i in range(P.shape[0]):
+            ref.update(P[i], T[i])
+        fast.scan_update(P, T)
+        assert fast._update_count == ref._update_count
+        assert float(fast.compute()) == float(ref.compute())
+
+    def test_list_state_raises_with_hint(self):
+        from torchmetrics_tpu.classification import BinaryAUROC
+
+        m = BinaryAUROC(thresholds=None)
+        with pytest.raises(TorchMetricsUserError, match="cat_state_capacity"):
+            m.jit_update(jnp.zeros(4), jnp.zeros(4, dtype=jnp.int32))
+
+    def test_ring_buffer_states_warm_up_then_compile(self):
+        from torchmetrics_tpu.classification import BinaryAUROC
+
+        rng = np.random.default_rng(1)
+        p = jnp.asarray(rng.random((3, 64), dtype=np.float32))
+        t = jnp.asarray(rng.integers(0, 2, (3, 64)))
+        ref = BinaryAUROC(thresholds=None, cat_state_capacity=512)
+        jit_m = BinaryAUROC(thresholds=None, cat_state_capacity=512)
+        scan_m = BinaryAUROC(thresholds=None, cat_state_capacity=512)
+        for i in range(3):
+            ref.update(p[i], t[i])
+            jit_m.jit_update(p[i], t[i])
+        scan_m.scan_update(p, t)
+        assert float(jit_m.compute()) == float(ref.compute())
+        assert float(scan_m.compute()) == float(ref.compute())
+        assert scan_m._update_count == 3
+
+    def test_pickle_after_compile_drops_cached_executables(self):
+        import pickle
+
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+
+        P, T = self._data(steps=2)
+        m = MulticlassAccuracy(num_classes=5)
+        m.jit_update(P[0], T[0])
+        m.scan_update(P, T)
+        clone = pickle.loads(pickle.dumps(m))
+        assert "_jit_update_fn" not in clone.__dict__ and "_scan_update_fn" not in clone.__dict__
+        assert float(clone.compute()) == float(m.compute())
+        clone.jit_update(P[0], T[0])  # recompiles cleanly after unpickle
+
+    def test_forward_and_merge_still_work_after_jit_update(self):
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+
+        P, T = self._data(steps=4)
+        a, b = MulticlassAccuracy(num_classes=5), MulticlassAccuracy(num_classes=5)
+        a.jit_update(P[0], T[0])
+        a(P[1], T[1])  # dual-mode forward interleaves fine
+        b.scan_update(P[2:], T[2:])
+        a.merge_state(b)
+        ref = MulticlassAccuracy(num_classes=5)
+        for i in range(4):
+            ref.update(P[i], T[i])
+        assert np.isclose(float(a.compute()), float(ref.compute()), atol=1e-7)
+
+    def test_static_flag_arguments_stay_python(self):
+        """Non-array args (FID's real=True) must not be traced (round-3 review)."""
+        from torchmetrics_tpu.image import FrechetInceptionDistance
+
+        class _Feat:
+            num_features = 8
+
+            def __call__(self, imgs):
+                return jnp.asarray(imgs, jnp.float32).reshape(imgs.shape[0], -1)[:, :8]
+
+        rng = np.random.default_rng(2)
+        # enough samples that the 8-d covariances are full-rank; FID of
+        # rank-deficient fits amplifies float32 rounding chaotically
+        imgs = jnp.asarray(rng.random((64, 3, 2, 2), dtype=np.float32))
+        fid = FrechetInceptionDistance(feature=_Feat())
+        fid.jit_update(imgs, real=True)
+        fid.jit_update(imgs + 0.25, real=False)
+        ref = FrechetInceptionDistance(feature=_Feat())
+        ref.update(imgs, real=True)
+        ref.update(imgs + 0.25, real=False)
+        for name in fid._defaults:
+            np.testing.assert_allclose(
+                np.asarray(getattr(fid, name)), np.asarray(getattr(ref, name)), rtol=1e-6, atol=1e-5
+            )
+        assert np.isclose(float(fid.compute()), float(ref.compute()), rtol=1e-3)
+        # both flag values compiled into separate cache entries
+        assert len(fid.__dict__["_jit_update_fn"]) == 2

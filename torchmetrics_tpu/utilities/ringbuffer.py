@@ -140,17 +140,24 @@ class RingBuffer:
             raise ValueError(
                 f"RingBuffer expects rows of shape {self.data.shape[1:]}, but got a batch of shape {batch.shape}"
             )
-        if self._host_count is None:  # one-time readback for device-built buffers
-            self._host_count = int(self.count)
-        will_drop = self._host_count + batch.shape[0] > self.capacity
-        self._host_count += batch.shape[0]
-        if will_drop and not self._warned_overflow:
-            rank_zero_warn(
-                f"RingBuffer capacity ({self.capacity}) exceeded; oldest rows are being overwritten."
-                " Increase `cat_state_capacity` if the metric should see every sample.",
-                UserWarning,
-            )
-            self._warned_overflow = True
+        from torchmetrics_tpu.utilities.checks import _is_concrete
+
+        if not _is_concrete(self.count):
+            # inside jit the occupancy is unknown at trace time; overflow
+            # bookkeeping resumes on the next eager append
+            self._host_count = None
+        else:
+            if self._host_count is None:  # one-time readback for device-built buffers
+                self._host_count = int(self.count)
+            will_drop = self._host_count + batch.shape[0] > self.capacity
+            self._host_count += batch.shape[0]
+            if will_drop and not self._warned_overflow:
+                rank_zero_warn(
+                    f"RingBuffer capacity ({self.capacity}) exceeded; oldest rows are being overwritten."
+                    " Increase `cat_state_capacity` if the metric should see every sample.",
+                    UserWarning,
+                )
+                self._warned_overflow = True
         self.data, self.valid, self.count = ring_push(self.data, self.valid, self.count, batch)
         return self
 
