@@ -376,3 +376,29 @@ class TestCompiledUpdatePaths:
         assert np.isclose(float(fid.compute()), float(ref.compute()), rtol=1e-3)
         # both flag values compiled into separate cache entries
         assert len(fid.__dict__["_jit_update_fn"]) == 2
+
+    def test_set_dtype_policy_holds_in_compiled_paths(self):
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        rng = np.random.default_rng(3)
+        P = jnp.asarray(rng.random((3, 32), dtype=np.float32))
+        T = jnp.asarray(rng.random((3, 32), dtype=np.float32))
+        m = MeanSquaredError()
+        m.set_dtype(jnp.bfloat16)
+        m.jit_update(P[0], T[0])
+        assert m.sum_squared_error.dtype == jnp.bfloat16
+        m2 = MeanSquaredError()
+        m2.set_dtype(jnp.bfloat16)
+        m2.scan_update(P, T)  # stable bf16 carry through the scan
+        assert m2.sum_squared_error.dtype == jnp.bfloat16
+
+    def test_compositional_metric_rejects_compiled_updates(self):
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+
+        m1, m2 = MulticlassAccuracy(num_classes=5), MulticlassAccuracy(num_classes=5)
+        comp = (m1 + m2) / 2
+        P, T = self._data(steps=1)
+        with pytest.raises(TorchMetricsUserError, match="child"):
+            comp.jit_update(P[0], T[0])
+        # children untouched by the rejected call
+        assert np.asarray(m1.tp).sum() == 0

@@ -347,6 +347,14 @@ class Metric(ABC):
             self._computed = None
             self._update_count += 1
             update(*args, **kwargs)
+            if self._dtype_policy is not None:
+                # torch's in-place `state += batch` keeps a half-precision
+                # buffer half; functional rebinding promotes, so re-apply the
+                # declared dtype to floating array states (set_dtype parity)
+                for attr in self._defaults:
+                    current = getattr(self, attr)
+                    if _is_array(current) and jnp.issubdtype(current.dtype, jnp.floating):
+                        object.__setattr__(self, attr, current.astype(self._dtype_policy))
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
             return None
@@ -529,6 +537,18 @@ class Metric(ABC):
         Lazily-allocated ring buffers learn their row shape from the first
         batch, so the first update must run eagerly before tracing.
         """
+        for attr, value in self.__dict__.items():
+            # metrics that delegate to child metrics (CompositionalMetric,
+            # wrappers) mutate state OUTSIDE self._defaults — tracing their
+            # update would leak tracers into the children
+            if isinstance(value, Metric) or (
+                isinstance(value, (list, tuple)) and any(isinstance(v, Metric) for v in value)
+            ):
+                raise TorchMetricsUserError(
+                    f"`{method_name}` is unsupported on {type(self).__name__}: it delegates to child"
+                    f" metric(s) (`{attr}`) whose states live outside this metric's state registry."
+                    " Call the compiled update on the component metrics directly."
+                )
         names = list(self._defaults)
         warm_up = False
         for name in names:
@@ -550,7 +570,19 @@ class Metric(ABC):
             for n in names:
                 object.__setattr__(self, n, states[n])
             self.update.__wrapped__(*args, **kwargs)
-            return {n: getattr(self, n) for n in names}
+            new_states = {n: getattr(self, n) for n in names}
+            if self._dtype_policy is not None:
+                # mirror _wrap_update's post-update cast so compiled carries
+                # keep the declared dtype (scan requires stable carry types)
+                new_states = {
+                    n: (
+                        v.astype(self._dtype_policy)
+                        if _is_array(v) and jnp.issubdtype(v.dtype, jnp.floating)
+                        else v
+                    )
+                    for n, v in new_states.items()
+                }
+            return new_states
         finally:
             for n, v in saved.items():
                 object.__setattr__(self, n, v)
