@@ -35,7 +35,6 @@ from torchmetrics_tpu.classification import (
 from torchmetrics_tpu.functional.classification.stat_scores import _multiclass_stat_scores_update
 from torchmetrics_tpu.utilities.distributed import sync_in_jit
 
-NDEV = len(jax.devices())
 NUM_CLASSES = 4
 BATCH = 8 * 16  # divisible by the mesh
 FEATURES = 12

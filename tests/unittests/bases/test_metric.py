@@ -402,3 +402,28 @@ class TestCompiledUpdatePaths:
             comp.jit_update(P[0], T[0])
         # children untouched by the rejected call
         assert np.asarray(m1.tp).sum() == 0
+
+    def test_dict_valued_child_metrics_rejected(self):
+        from torchmetrics_tpu.classification import MulticlassAccuracy
+        from torchmetrics_tpu.wrappers import MultitaskWrapper
+
+        w = MultitaskWrapper({"t": MulticlassAccuracy(num_classes=4)})
+        P, T = self._data(steps=1, C=4)
+        with pytest.raises(TorchMetricsUserError, match="child"):
+            w.jit_update({"t": P[0]}, {"t": T[0]})
+        assert np.asarray(w.task_metrics["t"].tp).sum() == 0
+
+    def test_set_dtype_policy_covers_cat_states(self):
+        from torchmetrics_tpu.classification import BinaryAUROC
+
+        rng = np.random.default_rng(4)
+        p = jnp.asarray(rng.random(32, dtype=np.float32))
+        t = jnp.asarray(rng.integers(0, 2, 32))
+        m = BinaryAUROC(thresholds=None)
+        m.set_dtype(jnp.bfloat16)
+        m.update(p, t)
+        assert all(chunk.dtype == jnp.bfloat16 for chunk in m.preds)
+        rb = BinaryAUROC(thresholds=None, cat_state_capacity=64)
+        rb.set_dtype(jnp.bfloat16)
+        rb.update(p, t)
+        assert rb.preds.data.dtype == jnp.bfloat16

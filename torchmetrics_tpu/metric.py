@@ -348,19 +348,39 @@ class Metric(ABC):
             self._update_count += 1
             update(*args, **kwargs)
             if self._dtype_policy is not None:
-                # torch's in-place `state += batch` keeps a half-precision
-                # buffer half; functional rebinding promotes, so re-apply the
-                # declared dtype to floating array states (set_dtype parity)
-                for attr in self._defaults:
-                    current = getattr(self, attr)
-                    if _is_array(current) and jnp.issubdtype(current.dtype, jnp.floating):
-                        object.__setattr__(self, attr, current.astype(self._dtype_policy))
+                self._apply_dtype_policy()
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
             return None
 
         wrapped_func.__wrapped_by_metric__ = True  # type: ignore[attr-defined]
         return wrapped_func
+
+    def _apply_dtype_policy(self) -> None:
+        """Re-cast floating states to the ``set_dtype`` policy after an update.
+
+        torch's in-place ``state += batch`` keeps a half-precision buffer
+        half; functional rebinding promotes, so the declared dtype is
+        re-applied — to plain arrays, appended list chunks, and ring-buffer
+        storage alike (mirroring what ``set_dtype`` itself casts).
+        """
+        dst = self._dtype_policy
+        for attr in self._defaults:
+            current = getattr(self, attr)
+            if isinstance(current, RingBuffer):
+                if current.data is not None and jnp.issubdtype(current.data.dtype, jnp.floating):
+                    current.data = current.data.astype(dst)
+            elif isinstance(current, list):
+                object.__setattr__(
+                    self,
+                    attr,
+                    [
+                        v.astype(dst) if _is_array(v) and jnp.issubdtype(v.dtype, jnp.floating) else v
+                        for v in current
+                    ],
+                )
+            elif _is_array(current) and jnp.issubdtype(current.dtype, jnp.floating):
+                object.__setattr__(self, attr, current.astype(dst))
 
     def _move_list_states_to_cpu(self) -> None:
         """Offload append-mode (list) states to host memory after each update.
@@ -537,13 +557,24 @@ class Metric(ABC):
         Lazily-allocated ring buffers learn their row shape from the first
         batch, so the first update must run eagerly before tracing.
         """
+        def metric_like(v: Any) -> bool:
+            # Metric subclasses AND collection-shaped delegates (MetricCollection,
+            # wrapped collections) — anything with its own update/compute/reset
+            return isinstance(v, Metric) or (
+                hasattr(v, "update") and hasattr(v, "compute") and hasattr(v, "reset")
+            )
+
         for attr, value in self.__dict__.items():
             # metrics that delegate to child metrics (CompositionalMetric,
-            # wrappers) mutate state OUTSIDE self._defaults — tracing their
-            # update would leak tracers into the children
-            if isinstance(value, Metric) or (
-                isinstance(value, (list, tuple)) and any(isinstance(v, Metric) for v in value)
-            ):
+            # wrappers, task dicts) mutate state OUTSIDE self._defaults —
+            # tracing their update would leak tracers into the children
+            if isinstance(value, dict):
+                children = list(value.values())
+            elif isinstance(value, (list, tuple)):
+                children = list(value)
+            else:
+                children = [value]
+            if attr not in ("update", "compute") and any(metric_like(v) for v in children):
                 raise TorchMetricsUserError(
                     f"`{method_name}` is unsupported on {type(self).__name__}: it delegates to child"
                     f" metric(s) (`{attr}`) whose states live outside this metric's state registry."
