@@ -9,6 +9,8 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from torchmetrics_tpu.utilities.compute import _safe_pow, _safe_sqrt
 import numpy as np
 
 Array = jax.Array
@@ -61,11 +63,13 @@ def davies_bouldin_score(data: Array, labels: Array) -> Array:
     data = jnp.asarray(data, jnp.float32)
     _validate_intrinsic_cluster_data(data, labels)
     lab, k, counts, centroids = _cluster_stats(data, labels)
-    # mean intra-cluster distance (scatter) per cluster
-    dists = jnp.linalg.norm(data - centroids[lab], axis=1)
+    # mean intra-cluster distance (scatter) per cluster; _safe_sqrt keeps
+    # single-point clusters (zero distance) at finite gradients
+    dists = _safe_sqrt(jnp.sum((data - centroids[lab]) ** 2, axis=1))
     scatter = jax.ops.segment_sum(dists, lab, num_segments=k) / jnp.maximum(counts, 1.0)  # (K,)
-    # centroid distances
-    cdist = jnp.linalg.norm(centroids[:, None, :] - centroids[None, :, :], axis=-1)
+    # centroid distances (_safe_sqrt: the zero diagonal would otherwise
+    # poison gradients)
+    cdist = _safe_sqrt(jnp.sum((centroids[:, None, :] - centroids[None, :, :]) ** 2, axis=-1))
     ratio = (scatter[:, None] + scatter[None, :]) / jnp.where(cdist == 0, jnp.inf, cdist)
     ratio = jnp.where(jnp.eye(k, dtype=bool), -jnp.inf, ratio)
     return jnp.mean(jnp.max(ratio, axis=1))
@@ -90,10 +94,14 @@ def dunn_index(data: Array, labels: Array, p: float = 2.0) -> Array:
     sums = jax.ops.segment_sum(data, lab, num_segments=k)
     counts = jnp.maximum(jax.ops.segment_sum(jnp.ones(data.shape[0], jnp.float32), lab, num_segments=k), 1.0)
     centroids = sums / counts[:, None]  # (k, D)
-    diff = centroids[:, None, :] - centroids[None, :, :]
-    inter = jnp.sum(jnp.abs(diff) ** p, axis=-1) ** (1.0 / p)
+
+    def _p_norm(vecs: Array) -> Array:
+        # _safe_pow: x**(1/p) has an infinite derivative at 0 (the diagonal /
+        # own-centroid entries)
+        return _safe_pow(jnp.sum(jnp.abs(vecs) ** p, axis=-1), 1.0 / p)
+
+    inter = _p_norm(centroids[:, None, :] - centroids[None, :, :])
     off_diag = ~jnp.eye(k, dtype=bool)
     min_inter = jnp.min(jnp.where(off_diag, inter, jnp.inf))
-    to_centroid = jnp.sum(jnp.abs(data - centroids[lab]) ** p, axis=-1) ** (1.0 / p)
-    max_intra = jnp.max(to_centroid)
+    max_intra = jnp.max(_p_norm(data - centroids[lab]))
     return min_inter / jnp.maximum(max_intra, 1e-30)

@@ -12,6 +12,8 @@ from typing import Optional, Sequence, Tuple, Union
 import jax
 import jax.numpy as jnp
 
+from torchmetrics_tpu.utilities.compute import _safe_pow
+
 from torchmetrics_tpu.functional.image.helper import (
     _check_image_pair,
     _depthwise_conv2d,
@@ -221,7 +223,9 @@ def multiscale_structural_similarity_index_measure(
     if normalize == "relu":
         mcs_stack = jax.nn.relu(mcs_stack)
     betas_arr = jnp.asarray(betas)[:, None]
-    mcs_weighted = mcs_stack ** betas_arr
+    # _safe_pow: finite gradient at the relu zeros, reference-exact forward
+    # values elsewhere (incl. NaN for negative bases under normalize=None)
+    mcs_weighted = _safe_pow(mcs_stack, betas_arr)
     out = jnp.prod(mcs_weighted, axis=0)
     if reduction == "elementwise_mean":
         return jnp.mean(out)

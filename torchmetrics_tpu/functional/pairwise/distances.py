@@ -14,7 +14,7 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from torchmetrics_tpu.utilities.compute import _safe_matmul
+from torchmetrics_tpu.utilities.compute import _safe_matmul, _safe_sqrt
 
 Array = jax.Array
 
@@ -97,7 +97,7 @@ def pairwise_euclidean_distance(
     x_norm = jnp.sum(x * x, axis=1, keepdims=True)
     y_norm = jnp.sum(y * y, axis=1)
     distance = x_norm + y_norm[None, :] - 2 * _safe_matmul(x, y)
-    distance = jnp.sqrt(jnp.maximum(distance, 0.0))
+    distance = _safe_sqrt(jnp.maximum(distance, 0.0))  # finite gradient at exact-duplicate rows
     return _reduce_distance_matrix(_zero_diagonal(distance, zd), reduction)
 
 

@@ -30,6 +30,31 @@ def _safe_matmul(x: Array, y: Array) -> Array:
     return jnp.matmul(x, y.T, precision="highest")
 
 
+def _safe_sqrt(x: Array) -> Array:
+    """``sqrt`` with a finite (zero) gradient at 0.
+
+    Plain ``sqrt`` has an infinite derivative at 0, which turns masked-out
+    zero distances (diagonals, own-centroid terms) into NaN gradients — the
+    classic where-after-sqrt trap.  Negative inputs map to 0 (callers pass
+    sums of squares).
+    """
+    positive = x > 0
+    return jnp.where(positive, jnp.sqrt(jnp.where(positive, x, 1.0)), 0.0)
+
+
+def _safe_pow(base: Array, exp: Array) -> Array:
+    """``base ** exp`` with finite gradients where the true derivative diverges.
+
+    Forward semantics are unchanged — including ``0 ** 0 == 1`` and NaN for
+    negative bases with fractional exponents — but the non-positive branch is
+    computed on a stopped-gradient base, so autodiff at ``base == 0`` with
+    ``exp < 1`` yields 0 instead of inf.
+    """
+    positive = base > 0
+    safe = jnp.where(positive, base, 1.0) ** exp
+    return jnp.where(positive, safe, jax.lax.stop_gradient(base) ** exp)
+
+
 def _safe_xlogy(x: Array, y: Array) -> Array:
     """``x * log(y)`` that is 0 whenever ``x == 0`` (even when ``y == 0``)."""
     x = jnp.asarray(x)
