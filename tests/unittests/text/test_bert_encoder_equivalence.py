@@ -1,0 +1,178 @@
+"""Architecture-equivalence: Flax BertEncoder vs transformers BertModel.
+
+transformers (torch) is installed in this image, so the torch side is the
+REAL HF implementation — not a replica — instantiated with random weights on
+a small config.  Converting its state dict through
+``tools/convert_weights.py`` and matching every hidden state certifies that
+a real pretrained BERT checkpoint reproduces the reference's BERTScore /
+InfoLM encoder outputs (reference ``functional/text/bert.py:40-45``).
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[3] / "tools"))
+from convert_weights import convert_bert_state_dict  # noqa: E402
+
+from torchmetrics_tpu.text._bert_encoder import BertEncoderExtractor, BertMLMExtractor  # noqa: E402
+
+CFG = dict(
+    vocab_size=97,
+    hidden_size=48,
+    num_hidden_layers=3,
+    num_attention_heads=4,
+    intermediate_size=64,
+    max_position_embeddings=64,
+    type_vocab_size=2,
+    hidden_dropout_prob=0.0,
+    attention_probs_dropout_prob=0.0,
+)
+
+
+def _inputs(batch=3, length=12, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(0, CFG["vocab_size"], (batch, length))
+    mask = np.ones((batch, length), dtype=np.int64)
+    mask[0, length // 2 :] = 0  # ragged batch exercises the additive mask
+    mask[2, -2:] = 0
+    return ids, mask
+
+
+@pytest.fixture(scope="module")
+def converted(tmp_path_factory):
+    torch.manual_seed(0)
+    config = transformers.BertConfig(**CFG)
+    model = transformers.BertForMaskedLM(config).eval()
+    npz = tmp_path_factory.mktemp("bert") / "bert.npz"
+    np.savez(npz, **convert_bert_state_dict(model.state_dict(), num_heads=CFG["num_attention_heads"]))
+    return model, str(npz)
+
+
+def test_all_hidden_states_match(converted):
+    model, npz = converted
+    ids, mask = _inputs()
+    with torch.no_grad():
+        want = model.bert(
+            torch.from_numpy(ids), attention_mask=torch.from_numpy(mask), output_hidden_states=True
+        ).hidden_states
+
+    for layer in range(CFG["num_hidden_layers"] + 1):
+        ours = BertEncoderExtractor(npz, num_layers=layer)
+        got = np.asarray(ours(jnp.asarray(ids), jnp.asarray(mask)))
+        np.testing.assert_allclose(got, want[layer].numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_default_layer_is_last(converted):
+    model, npz = converted
+    ids, mask = _inputs(seed=1)
+    with torch.no_grad():
+        want = model.bert(torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)).last_hidden_state
+    got = np.asarray(BertEncoderExtractor(npz)(jnp.asarray(ids), jnp.asarray(mask)))
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-5)
+
+
+def test_mlm_logits_match(converted):
+    model, npz = converted
+    ids, mask = _inputs(seed=2)
+    with torch.no_grad():
+        want = model(torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)).logits
+    got = np.asarray(BertMLMExtractor(npz)(jnp.asarray(ids), jnp.asarray(mask)))
+    np.testing.assert_allclose(got, want.numpy(), rtol=1e-4, atol=1e-4)
+
+
+def test_bert_score_with_converted_encoder(converted):
+    """bert_score through the pluggable-encoder contract on converted weights:
+    identical sentences score 1.0; the encoder is the real computation."""
+    from torchmetrics_tpu.functional.text import bert_score
+
+    _, npz = converted
+    encoder = BertEncoderExtractor(npz)
+    ids, mask = _inputs(seed=3)
+    enc = {"input_ids": ids, "attention_mask": mask}
+    same = bert_score(enc, enc, model=encoder)
+    np.testing.assert_allclose(np.asarray(same["f1"]), 1.0, atol=1e-5)
+
+    other_ids, other_mask = _inputs(seed=4)
+    cross = bert_score(enc, {"input_ids": other_ids, "attention_mask": other_mask}, model=encoder)
+    assert float(np.asarray(cross["f1"]).mean()) < 1.0
+
+
+def test_infolm_with_converted_mlm(converted):
+    """InfoLM's model contract ((ids, mask) -> vocab logits) on converted weights."""
+    from torchmetrics_tpu.functional.text.infolm import infolm
+
+    _, npz = converted
+    mlm = BertMLMExtractor(npz)
+    special = dict(pad_token_id=0, cls_token_id=1, sep_token_id=2, mask_token_id=3)
+    ids, mask = _inputs(seed=10)
+    enc = {"input_ids": ids, "attention_mask": mask}
+    out = infolm(enc, enc, model=mlm, idf=False, special_tokens_map=special)
+    np.testing.assert_allclose(np.asarray(out), 0.0, atol=1e-6)
+
+
+def test_bert_score_dict_updates_pad_to_max_length(converted):
+    """Mixed-width pre-tokenized updates concatenate (padded to max_length)."""
+    from torchmetrics_tpu.text import BERTScore
+
+    _, npz = converted
+    short_ids, short_mask = _inputs(length=8, seed=7)
+    long_ids, long_mask = _inputs(length=20, seed=8)
+    m = BERTScore(weights_path=npz, max_length=16)
+    m.update({"input_ids": short_ids, "attention_mask": short_mask},
+             {"input_ids": short_ids, "attention_mask": short_mask})
+    m.update({"input_ids": long_ids, "attention_mask": long_mask},
+             {"input_ids": long_ids, "attention_mask": long_mask})
+    out = m.compute()
+    np.testing.assert_allclose(np.asarray(out["f1"]), 1.0, atol=1e-5)
+
+
+def test_modular_weights_path_wiring(converted):
+    """BERTScore(weights_path=...) and InfoLM(weights_path=...) construct the
+    converted encoders without a model callable."""
+    from torchmetrics_tpu.text import BERTScore, InfoLM
+
+    _, npz = converted
+    ids, mask = _inputs(seed=5)
+    m = BERTScore(weights_path=npz)
+    m.update({"input_ids": ids, "attention_mask": mask}, {"input_ids": ids, "attention_mask": mask})
+    out = m.compute()
+    np.testing.assert_allclose(np.asarray(out["f1"]), 1.0, atol=1e-5)
+
+    # strings without a matching tokenizer must be rejected loudly (hash ids
+    # would fall outside the converted vocab)
+    i = InfoLM(weights_path=npz, idf=False)
+    with pytest.raises(ValueError, match="tokenizer"):
+        i.update(["a small test"], ["a small test"])
+    with pytest.raises(ValueError, match="tokenizer"):
+        BERTScore(weights_path=npz).update(["a small test"], ["a small test"])
+
+    # in-vocab pre-tokenized dicts: KL of a sentence against itself is 0,
+    # against a different sentence strictly positive. special token ids must
+    # sit inside the checkpoint vocab (default BERT ids 101-103 do not here,
+    # and out-of-vocab specials now raise instead of silently scoring 0)
+    special = dict(pad_token_id=0, cls_token_id=1, sep_token_id=2, mask_token_id=3)
+    other_ids, other_mask = _inputs(seed=6)
+    enc = {"input_ids": ids, "attention_mask": mask}
+    i_same = InfoLM(weights_path=npz, idf=False, special_tokens_map=special)
+    i_same.update(enc, enc)
+    np.testing.assert_allclose(np.asarray(i_same.compute()), 0.0, atol=1e-6)
+    i_diff = InfoLM(weights_path=npz, idf=False, special_tokens_map=special)
+    i_diff.update(enc, {"input_ids": other_ids, "attention_mask": other_mask})
+    # an untrained random model yields near-identical distributions, so only
+    # distinguishability (nonzero, finite) is meaningful here
+    diff_val = float(np.asarray(i_diff.compute()))
+    assert np.isfinite(diff_val) and abs(diff_val) > 1e-7
+    i_oov = InfoLM(weights_path=npz, idf=False)  # default mask id 103 >= vocab 97
+    i_oov.update(enc, enc)
+    with pytest.raises(ValueError, match="outside the model vocab"):
+        i_oov.compute()

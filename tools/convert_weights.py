@@ -17,6 +17,7 @@ Usage::
 
     python tools/convert_weights.py inception weights.pth out.npz
     python tools/convert_weights.py lpips vgg16.pth lpips_heads.pth out.npz
+    python tools/convert_weights.py bert bert_mlm.pth out.npz [num_heads]
 
 Checkpoints are loaded with ``torch.load(map_location="cpu")``; only numpy
 arrays are written.  The conversion functions are also importable for use in
@@ -27,7 +28,7 @@ trunks and assert feature parity with the Flax trunks).
 from __future__ import annotations
 
 import sys
-from typing import Dict, Mapping
+from typing import Dict, Mapping, Optional
 
 import numpy as np
 
@@ -166,6 +167,83 @@ def convert_lpips_state_dicts(vgg_sd: Mapping, heads_sd: Mapping) -> Dict[str, n
     return out
 
 
+# ---------------------------------------------------------------------------
+# BERT: HF BertModel / BertForMaskedLM naming -> torchmetrics_tpu BertEncoder
+# ---------------------------------------------------------------------------
+
+
+def _dense(out: Dict[str, np.ndarray], flax_prefix: str, torch_prefix: str, sd: Mapping) -> None:
+    out[f"params/{flax_prefix}/kernel"] = _to_numpy(sd[f"{torch_prefix}.weight"]).transpose(1, 0)
+    out[f"params/{flax_prefix}/bias"] = _to_numpy(sd[f"{torch_prefix}.bias"])
+
+
+def _layernorm(out: Dict[str, np.ndarray], flax_prefix: str, torch_prefix: str, sd: Mapping) -> None:
+    out[f"params/{flax_prefix}/scale"] = _to_numpy(sd[f"{torch_prefix}.weight"])
+    out[f"params/{flax_prefix}/bias"] = _to_numpy(sd[f"{torch_prefix}.bias"])
+
+
+def convert_bert_state_dict(sd: Mapping, num_heads: Optional[int] = None) -> Dict[str, np.ndarray]:
+    """HF ``BertModel``/``BertForMaskedLM`` state dict -> flattened npz mapping.
+
+    Encoder weights land under ``params/bert/...``; the MLM prediction head
+    (when present, i.e. a ``BertForMaskedLM`` checkpoint) under
+    ``params/mlm/...``.  Config scalars are derived from the shapes so the
+    npz is self-describing.
+    """
+    # BertForMaskedLM prefixes everything with "bert."
+    prefix = "bert." if any(k.startswith("bert.") for k in sd) else ""
+    out: Dict[str, np.ndarray] = {}
+
+    emb = f"{prefix}embeddings"
+    word = _to_numpy(sd[f"{emb}.word_embeddings.weight"])
+    pos = _to_numpy(sd[f"{emb}.position_embeddings.weight"])
+    typ = _to_numpy(sd[f"{emb}.token_type_embeddings.weight"])
+    out["params/bert/word_embeddings/embedding"] = word
+    out["params/bert/position_embeddings/embedding"] = pos
+    out["params/bert/token_type_embeddings/embedding"] = typ
+    _layernorm(out, "bert/embeddings_ln", f"{emb}.LayerNorm", sd)
+
+    n_layers = 0
+    while f"{prefix}encoder.layer.{n_layers}.attention.self.query.weight" in sd:
+        t = f"{prefix}encoder.layer.{n_layers}"
+        f = f"bert/layer_{n_layers}"
+        _dense(out, f"{f}/attention/query", f"{t}.attention.self.query", sd)
+        _dense(out, f"{f}/attention/key", f"{t}.attention.self.key", sd)
+        _dense(out, f"{f}/attention/value", f"{t}.attention.self.value", sd)
+        _dense(out, f"{f}/attention/out", f"{t}.attention.output.dense", sd)
+        _layernorm(out, f"{f}/attention/ln", f"{t}.attention.output.LayerNorm", sd)
+        _dense(out, f"{f}/intermediate", f"{t}.intermediate.dense", sd)
+        _dense(out, f"{f}/output", f"{t}.output.dense", sd)
+        _layernorm(out, f"{f}/ln", f"{t}.output.LayerNorm", sd)
+        n_layers += 1
+
+    with_mlm = "cls.predictions.transform.dense.weight" in sd
+    if with_mlm:
+        _dense(out, "mlm/transform", "cls.predictions.transform.dense", sd)
+        _layernorm(out, "mlm/transform_ln", "cls.predictions.transform.LayerNorm", sd)
+        decoder_w = _to_numpy(
+            sd.get("cls.predictions.decoder.weight", sd[f"{emb}.word_embeddings.weight"])
+        )  # tied embeddings when the decoder weight is absent
+        out["params/mlm/decoder/kernel"] = decoder_w.transpose(1, 0)
+        bias = sd.get("cls.predictions.decoder.bias", sd.get("cls.predictions.bias"))
+        out["params/mlm/decoder/bias"] = _to_numpy(bias)
+
+    intermediate = out["params/bert/layer_0/intermediate/kernel"].shape[1] if n_layers else 0
+    # the head count is not recoverable from shapes; default to the HF
+    # convention hidden/64 (true for every released BERT), overridable
+    if num_heads is None:
+        num_heads = max(word.shape[1] // 64, 1)
+    out["config/vocab_size"] = np.asarray(word.shape[0])
+    out["config/hidden_size"] = np.asarray(word.shape[1])
+    out["config/num_layers"] = np.asarray(n_layers)
+    out["config/num_heads"] = np.asarray(num_heads)
+    out["config/intermediate_size"] = np.asarray(intermediate)
+    out["config/max_position"] = np.asarray(pos.shape[0])
+    out["config/type_vocab"] = np.asarray(typ.shape[0])
+    out["config/with_mlm_head"] = np.asarray(int(with_mlm))
+    return out
+
+
 def _save(out_path: str, flat: Dict[str, np.ndarray]) -> None:
     np.savez(out_path, **flat)
     total = sum(v.size for v in flat.values())
@@ -184,6 +262,10 @@ def _load_torch_checkpoint(path: str) -> Mapping:
 def main(argv) -> int:
     if len(argv) >= 3 and argv[0] == "inception":
         _save(argv[2], convert_inception_state_dict(_load_torch_checkpoint(argv[1])))
+        return 0
+    if len(argv) >= 3 and argv[0] == "bert":
+        heads = int(argv[3]) if len(argv) > 3 else None
+        _save(argv[2], convert_bert_state_dict(_load_torch_checkpoint(argv[1]), num_heads=heads))
         return 0
     if len(argv) >= 4 and argv[0] == "lpips":
         _save(argv[3], convert_lpips_state_dicts(_load_torch_checkpoint(argv[1]), _load_torch_checkpoint(argv[2])))

@@ -5,11 +5,13 @@ embeds every sentence with a pluggable encoder and runs the greedy cosine
 matching (``functional/text/bert.py:243-263``) as one batched einsum + masked
 max on device.
 
-Pretrained transformers cannot be downloaded in this environment, so the
-default encoder is a deterministic hash-embedding lookup (seeded random
-per-token vectors). Scores are self-consistent (identical sentences → 1.0,
-disjoint sentences → near 0) but do not match published BERTScore numbers;
-pass ``user_model``/``user_forward_fn`` for real use.
+Pretrained transformers cannot be downloaded in this environment; the
+default encoder is a deterministic hash-embedding lookup (self-consistent
+scores only). For real BERTScore values, convert any HF BERT checkpoint
+(``tools/convert_weights.py bert``) and pass
+``model=BertEncoderExtractor(npz)`` (or ``weights_path=`` on the modular
+class) — the Flax encoder is architecture-equivalence-tested against
+``transformers.BertModel`` (``tests/unittests/text/test_bert_encoder_equivalence.py``).
 """
 
 from __future__ import annotations

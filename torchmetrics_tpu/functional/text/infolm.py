@@ -229,6 +229,16 @@ def infolm(
             " self-consistent but do not match published InfoLM values."
         )
     model_fn = model if model is not None else _default_hash_model
+    vocab_size = getattr(getattr(model_fn, "config", None), "vocab_size", None)
+    if vocab_size is not None:
+        oov = {k: v for k, v in special.items() if v >= vocab_size}
+        if oov:
+            # out-of-vocab ids silently become NaN-filled embeddings, which
+            # nan_to_num would wash out to a meaningless 0 score
+            raise ValueError(
+                f"special_tokens_map ids {oov} fall outside the model vocab ({vocab_size});"
+                " pass `special_tokens_map=` matching the checkpoint's tokenizer."
+            )
 
     def encode(data) -> Tuple[np.ndarray, np.ndarray]:
         if isinstance(data, dict):

@@ -55,15 +55,25 @@ class BERTScore(Metric):
         rescale_with_baseline: bool = False,
         baseline_path: Optional[str] = None,
         baseline_url: Optional[str] = None,
+        weights_path: Optional[str] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
         self.model_name_or_path = model_name_or_path
+        self._converted_weights = bool(model is None and weights_path)
+        if self._converted_weights:
+            # converted HF BERT checkpoint (tools/convert_weights.py bert)
+            from torchmetrics_tpu.text._bert_encoder import BertEncoderExtractor
+
+            model = BertEncoderExtractor(weights_path, num_layers=num_layers)
         self.model = model
         self.user_tokenizer = user_tokenizer
         self.user_forward_fn = user_forward_fn
         self.idf = idf
         self.max_length = max_length
+        if self._converted_weights:
+            # never pad past the checkpoint's positional capacity
+            self.max_length = min(self.max_length, self.model.config.max_position)
         self.batch_size = batch_size
         self.return_hash = return_hash
         self.rescale_with_baseline = rescale_with_baseline
@@ -74,15 +84,39 @@ class BERTScore(Metric):
         self.add_state("target_input_ids", default=[], dist_reduce_fx="cat")
         self.add_state("target_attention_mask", default=[], dist_reduce_fx="cat")
 
-    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+    def _pad_encoding(self, enc: Dict) -> Dict[str, np.ndarray]:
+        """Pad/truncate a pre-tokenized batch to ``max_length`` so cat states
+        from mixed-width updates concatenate."""
+        out = {}
+        for key in ("input_ids", "attention_mask"):
+            arr = np.asarray(enc[key])[:, : self.max_length]
+            if arr.shape[1] < self.max_length:
+                arr = np.pad(arr, ((0, 0), (0, self.max_length - arr.shape[1])))
+            out[key] = arr
+        return out
+
+    def _encode(self, texts: Union[List[str], Dict]) -> Dict[str, np.ndarray]:
+        if isinstance(texts, dict):
+            return self._pad_encoding(texts)
+        if self._converted_weights and self.user_tokenizer is None:
+            raise ValueError(
+                "BERTScore was built from converted BERT weights, whose token ids only make sense with"
+                " the checkpoint's own tokenizer. Pass `user_tokenizer=` (any callable producing"
+                " {'input_ids', 'attention_mask'}) or update with pre-tokenized dicts."
+            )
+        return self._tokenizer(list(texts), self.max_length)
+
+    def update(self, preds: Union[str, List[str], Dict], target: Union[str, List[str], Dict]) -> None:
+        """Accepts sentences (tokenized with the configured tokenizer) or
+        pre-tokenized ``{"input_ids", "attention_mask"}`` dicts."""
         if isinstance(preds, str):
             preds = [preds]
         if isinstance(target, str):
             target = [target]
-        if len(preds) != len(target):
+        pred_enc = self._encode(preds)
+        tgt_enc = self._encode(target)
+        if np.asarray(pred_enc["input_ids"]).shape[0] != np.asarray(tgt_enc["input_ids"]).shape[0]:
             raise ValueError("Number of predicted and reference sententes must be the same!")
-        pred_enc = self._tokenizer(list(preds), self.max_length)
-        tgt_enc = self._tokenizer(list(target), self.max_length)
         self.preds_input_ids.append(jnp.asarray(np.asarray(pred_enc["input_ids"])))
         self.preds_attention_mask.append(jnp.asarray(np.asarray(pred_enc["attention_mask"])))
         self.target_input_ids.append(jnp.asarray(np.asarray(tgt_enc["input_ids"])))

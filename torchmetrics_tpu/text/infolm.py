@@ -54,10 +54,17 @@ class InfoLM(Metric):
         return_sentence_level_score: bool = False,
         model: Optional[Callable[[Array, Array], Array]] = None,
         tokenizer: Optional[Any] = None,
+        weights_path: Optional[str] = None,
         special_tokens_map: Optional[Dict[str, int]] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        self._converted_weights = bool(model is None and weights_path)
+        if self._converted_weights:
+            # converted HF BertForMaskedLM checkpoint (tools/convert_weights.py bert)
+            from torchmetrics_tpu.text._bert_encoder import BertMLMExtractor
+
+            model = BertMLMExtractor(weights_path)
         self.model_name_or_path = model_name_or_path
         self.temperature = temperature
         self.information_measure = information_measure
@@ -65,6 +72,9 @@ class InfoLM(Metric):
         self.alpha = alpha
         self.beta = beta
         self.max_length = max_length
+        if self._converted_weights:
+            # never pad past the checkpoint's positional capacity
+            self.max_length = min(self.max_length or 64, model.config.max_position)
         self.batch_size = batch_size
         self.return_sentence_level_score = return_sentence_level_score
         self._model = model
@@ -77,16 +87,35 @@ class InfoLM(Metric):
         self.add_state("target_input_ids", default=[], dist_reduce_fx="cat")
         self.add_state("target_attention_mask", default=[], dist_reduce_fx="cat")
 
-    def update(self, preds: Union[str, List[str]], target: Union[str, List[str]]) -> None:
+    def _encode(self, texts: Union[List[str], Dict], width: int) -> Dict[str, np.ndarray]:
+        if isinstance(texts, dict):
+            out = {}
+            for key in ("input_ids", "attention_mask"):
+                arr = np.asarray(texts[key])[:, :width]
+                if arr.shape[1] < width:
+                    arr = np.pad(arr, ((0, 0), (0, width - arr.shape[1])))
+                out[key] = arr
+            return out
+        if self._converted_weights and self._user_tokenizer is None:
+            raise ValueError(
+                "InfoLM was built from converted BERT weights, whose token ids only make sense with"
+                " the checkpoint's own tokenizer. Pass `tokenizer=` (any callable producing"
+                " {'input_ids', 'attention_mask'}) or update with pre-tokenized dicts."
+            )
+        return self._tokenizer_fn(list(texts), width)
+
+    def update(self, preds: Union[str, List[str], Dict], target: Union[str, List[str], Dict]) -> None:
+        """Accepts sentences (tokenized with the configured tokenizer) or
+        pre-tokenized ``{"input_ids", "attention_mask"}`` dicts."""
         if isinstance(preds, str):
             preds = [preds]
         if isinstance(target, str):
             target = [target]
-        if len(preds) != len(target):
-            raise ValueError("Number of predicted and reference sententes must be the same!")
         width = self.max_length or 64
-        pred_enc = self._tokenizer_fn(list(preds), width)
-        tgt_enc = self._tokenizer_fn(list(target), width)
+        pred_enc = self._encode(preds, width)
+        tgt_enc = self._encode(target, width)
+        if np.asarray(pred_enc["input_ids"]).shape[0] != np.asarray(tgt_enc["input_ids"]).shape[0]:
+            raise ValueError("Number of predicted and reference sententes must be the same!")
         self.preds_input_ids.append(jnp.asarray(np.asarray(pred_enc["input_ids"])))
         self.preds_attention_mask.append(jnp.asarray(np.asarray(pred_enc["attention_mask"])))
         self.target_input_ids.append(jnp.asarray(np.asarray(tgt_enc["input_ids"])))
