@@ -1,0 +1,221 @@
+"""Architecture-equivalence: Flax CLIP towers vs transformers CLIPModel.
+
+Like the BERT suite, the torch side is the REAL HF implementation with random
+weights on a small config; converting its state dict and matching
+``get_image_features`` / ``get_text_features`` certifies that a real CLIP
+checkpoint reproduces the reference's CLIPScore / CLIP-IQA encoder outputs.
+"""
+
+from __future__ import annotations
+
+import sys
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+torch = pytest.importorskip("torch")
+transformers = pytest.importorskip("transformers")
+
+sys.path.insert(0, str(Path(__file__).resolve().parents[3] / "tools"))
+from convert_weights import convert_clip_state_dict  # noqa: E402
+
+from torchmetrics_tpu.multimodal._clip_encoder import ClipExtractor  # noqa: E402
+
+TEXT_CFG = dict(
+    vocab_size=99,
+    hidden_size=40,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    intermediate_size=64,
+    max_position_embeddings=24,
+    # 98 == the vocab's top id, like real CLIP (49407): HF's legacy
+    # argmax-pooling branch (eos_token_id==2) and its modern first-EOS branch
+    # then agree, as they do on real checkpoints
+    eos_token_id=98,
+    bos_token_id=1,
+    pad_token_id=0,
+    attention_dropout=0.0,
+)
+VISION_CFG = dict(
+    hidden_size=48,
+    num_hidden_layers=2,
+    num_attention_heads=4,
+    intermediate_size=64,
+    image_size=32,
+    patch_size=8,
+    attention_dropout=0.0,
+)
+
+
+@pytest.fixture(scope="module")
+def converted(tmp_path_factory):
+    torch.manual_seed(0)
+    config = transformers.CLIPConfig(
+        text_config=TEXT_CFG, vision_config=VISION_CFG, projection_dim=32
+    )
+    model = transformers.CLIPModel(config).eval()
+    npz = tmp_path_factory.mktemp("clip") / "clip.npz"
+    np.savez(
+        npz,
+        **convert_clip_state_dict(
+            model.state_dict(),
+            text_heads=TEXT_CFG["num_attention_heads"],
+            vision_heads=VISION_CFG["num_attention_heads"],
+            eos_token_id=TEXT_CFG["eos_token_id"],
+        ),
+    )
+    return model, str(npz)
+
+
+def _token_batch(batch=3, length=10, seed=0):
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(3, TEXT_CFG["eos_token_id"], (batch, length))
+    ids[:, 0] = TEXT_CFG["bos_token_id"]
+    lengths = ([length, length - 3, length - 1] * batch)[:batch]
+    mask = np.zeros((batch, length), np.int64)
+    for i, ln in enumerate(lengths):
+        ids[i, ln - 1] = TEXT_CFG["eos_token_id"]
+        ids[i, ln:] = TEXT_CFG["pad_token_id"]
+        mask[i, :ln] = 1
+    return ids, mask
+
+
+def test_image_features_match(converted):
+    model, npz = converted
+    rng = np.random.default_rng(1)
+    imgs = rng.random((2, 3, 32, 32)).astype(np.float32)
+    mean = np.asarray([0.48145466, 0.4578275, 0.40821073]).reshape(1, 3, 1, 1)
+    std = np.asarray([0.26862954, 0.26130258, 0.27577711]).reshape(1, 3, 1, 1)
+    with torch.no_grad():
+        want = model.get_image_features(torch.from_numpy((imgs - mean) / std).float()).numpy()
+    ours = ClipExtractor(npz)
+    got = np.asarray(ours.get_image_features(jnp.asarray(imgs)))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_text_features_match(converted):
+    model, npz = converted
+    ids, mask = _token_batch()
+    with torch.no_grad():
+        want = model.get_text_features(torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)).numpy()
+    ours = ClipExtractor(npz)
+    got = np.asarray(ours.get_text_features({"input_ids": ids, "attention_mask": mask}))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_clip_score_with_converted_model(converted):
+    """CLIPScore through the pluggable contract, cross-checked against the
+    same cosine computed from the torch model's features."""
+    from torchmetrics_tpu.functional.multimodal import clip_score
+
+    model, npz = converted
+    rng = np.random.default_rng(2)
+    imgs = rng.random((3, 3, 32, 32)).astype(np.float32)
+    ids, mask = _token_batch(seed=3)
+
+    class _Tok:
+        def __call__(self, texts):
+            return {"input_ids": ids[: len(texts)], "attention_mask": mask[: len(texts)]}
+
+    extractor = ClipExtractor(npz, tokenizer=_Tok())
+    got = float(clip_score(list(jnp.asarray(imgs)), ["a", "b", "c"], model=extractor))
+
+    mean = np.asarray([0.48145466, 0.4578275, 0.40821073]).reshape(1, 3, 1, 1)
+    std = np.asarray([0.26862954, 0.26130258, 0.27577711]).reshape(1, 3, 1, 1)
+    with torch.no_grad():
+        img_f = model.get_image_features(torch.from_numpy((imgs - mean) / std).float())
+        txt_f = model.get_text_features(torch.from_numpy(ids), attention_mask=torch.from_numpy(mask))
+    img_f = img_f / img_f.norm(dim=-1, keepdim=True)
+    txt_f = txt_f / txt_f.norm(dim=-1, keepdim=True)
+    want = max(float((100 * (img_f * txt_f).sum(-1)).mean()), 0.0)
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-4)
+
+
+def test_clip_iqa_with_converted_model(converted):
+    """CLIP-IQA runs on the converted model with pre-tokenized prompt anchors."""
+    from torchmetrics_tpu.functional.multimodal.clip_iqa import clip_image_quality_assessment
+
+    _, npz = converted
+    ids, mask = _token_batch(batch=2, seed=4)
+
+    class _Tok:
+        def __call__(self, texts):
+            reps = ids[np.arange(len(texts)) % 2]
+            return {"input_ids": reps, "attention_mask": mask[np.arange(len(texts)) % 2]}
+
+    extractor = ClipExtractor(npz, tokenizer=_Tok())
+    rng = np.random.default_rng(5)
+    imgs = jnp.asarray(rng.random((2, 3, 32, 32)).astype(np.float32))
+    out = clip_image_quality_assessment(imgs, model=extractor)
+    vals = np.asarray(out)
+    assert vals.shape == (2,)
+    assert np.isfinite(vals).all() and (vals >= 0).all() and (vals <= 1).all()
+
+
+def test_string_text_without_tokenizer_raises(converted):
+    _, npz = converted
+    ex = ClipExtractor(npz)
+    with pytest.raises(ValueError, match="tokenizer"):
+        ex.get_text_features(["a photo of a cat"])
+
+
+def test_modular_weights_path_wiring(converted):
+    from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment, CLIPScore
+
+    _, npz = converted
+    ids, mask = _token_batch(seed=6)
+
+    class _Tok:
+        def __call__(self, texts):
+            n = len(texts)
+            reps = ids[np.arange(n) % ids.shape[0]]
+            return {"input_ids": reps, "attention_mask": mask[np.arange(n) % ids.shape[0]]}
+
+    rng = np.random.default_rng(7)
+    imgs = jnp.asarray(rng.random((3, 3, 32, 32)).astype(np.float32))
+    m = CLIPScore(weights_path=npz, tokenizer=_Tok())
+    m.update(list(imgs), ["a", "b", "c"])
+    assert np.isfinite(float(m.compute()))
+
+    iqa = CLIPImageQualityAssessment(weights_path=npz, tokenizer=_Tok())
+    iqa.update(imgs)
+    vals = np.asarray(iqa.compute())
+    assert vals.shape == (3,) and np.isfinite(vals).all()
+
+
+def test_legacy_eos2_pooling_matches_hf(tmp_path):
+    """Real OpenAI CLIP configs ship eos_token_id=2, which HF routes through
+    its legacy argmax(input_ids) pooling; the converted tower must do the
+    same (round-3 review finding: first-EOS pooling is wrong there)."""
+    torch.manual_seed(4)
+    text_cfg = dict(TEXT_CFG)
+    text_cfg["eos_token_id"] = 2
+    config = transformers.CLIPConfig(text_config=text_cfg, vision_config=VISION_CFG, projection_dim=32)
+    model = transformers.CLIPModel(config).eval()
+    npz = tmp_path / "clip_eos2.npz"
+    np.savez(
+        npz,
+        **convert_clip_state_dict(
+            model.state_dict(), text_heads=4, vision_heads=4, eos_token_id=2
+        ),
+    )
+    rng = np.random.default_rng(11)
+    # ids contain NO token equal to 2, so argmax pooling lands on the max id —
+    # exactly what HF does on this branch
+    ids = rng.integers(3, TEXT_CFG["vocab_size"], (3, 9))
+    mask = np.ones((3, 9), np.int64)
+    with torch.no_grad():
+        want = model.get_text_features(torch.from_numpy(ids), attention_mask=torch.from_numpy(mask)).numpy()
+    got = np.asarray(ClipExtractor(str(npz)).get_text_features({"input_ids": ids, "attention_mask": mask}))
+    np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-5)
+
+
+def test_text_wider_than_max_position_truncates(converted):
+    _, npz = converted
+    ids, mask = _token_batch(length=TEXT_CFG["max_position_embeddings"] + 8, seed=12)
+    ex = ClipExtractor(npz)
+    out = ex.get_text_features({"input_ids": ids, "attention_mask": mask})
+    assert np.isfinite(np.asarray(out)).all()

@@ -18,6 +18,7 @@ Usage::
     python tools/convert_weights.py inception weights.pth out.npz
     python tools/convert_weights.py lpips vgg16.pth lpips_heads.pth out.npz
     python tools/convert_weights.py bert bert_mlm.pth out.npz [num_heads]
+    python tools/convert_weights.py clip clip_model.pth out.npz
 
 Checkpoints are loaded with ``torch.load(map_location="cpu")``; only numpy
 arrays are written.  The conversion functions are also importable for use in
@@ -168,6 +169,73 @@ def convert_lpips_state_dicts(vgg_sd: Mapping, heads_sd: Mapping) -> Dict[str, n
 
 
 # ---------------------------------------------------------------------------
+# CLIP: HF CLIPModel naming -> torchmetrics_tpu ClipExtractor
+# ---------------------------------------------------------------------------
+
+
+def convert_clip_state_dict(
+    sd: Mapping,
+    text_heads: Optional[int] = None,
+    vision_heads: Optional[int] = None,
+    eos_token_id: int = 2,
+) -> Dict[str, np.ndarray]:
+    """HF ``CLIPModel`` state dict -> flattened npz mapping (both towers)."""
+    out: Dict[str, np.ndarray] = {}
+
+    def layers(tower: str, flax_tower: str) -> int:
+        n = 0
+        while f"{tower}.encoder.layers.{n}.self_attn.q_proj.weight" in sd:
+            t = f"{tower}.encoder.layers.{n}"
+            f = f"{flax_tower}/layer_{n}"
+            for src, dst in (("q_proj", "q"), ("k_proj", "k"), ("v_proj", "v"), ("out_proj", "out")):
+                _dense(out, f"{f}/attn/{dst}", f"{t}.self_attn.{src}", sd)
+            _layernorm(out, f"{f}/ln1", f"{t}.layer_norm1", sd)
+            _layernorm(out, f"{f}/ln2", f"{t}.layer_norm2", sd)
+            _dense(out, f"{f}/fc1", f"{t}.mlp.fc1", sd)
+            _dense(out, f"{f}/fc2", f"{t}.mlp.fc2", sd)
+            n += 1
+        return n
+
+    # vision tower
+    patch = _to_numpy(sd["vision_model.embeddings.patch_embedding.weight"])  # (H, 3, P, P)
+    out["params/vision/patch_embedding/kernel"] = patch.transpose(2, 3, 1, 0)
+    out["params/vision/class_embedding"] = _to_numpy(sd["vision_model.embeddings.class_embedding"])
+    vis_pos = _to_numpy(sd["vision_model.embeddings.position_embedding.weight"])
+    out["params/vision/position_embedding/embedding"] = vis_pos
+    _layernorm(out, "vision/pre_ln", "vision_model.pre_layrnorm", sd)  # HF's own spelling
+    vision_layers = layers("vision_model", "vision")
+    _layernorm(out, "vision/post_ln", "vision_model.post_layernorm", sd)
+    out["params/visual_projection/kernel"] = _to_numpy(sd["visual_projection.weight"]).transpose(1, 0)
+
+    # text tower
+    tok = _to_numpy(sd["text_model.embeddings.token_embedding.weight"])
+    txt_pos = _to_numpy(sd["text_model.embeddings.position_embedding.weight"])
+    out["params/text/token_embedding/embedding"] = tok
+    out["params/text/position_embedding/embedding"] = txt_pos
+    text_layers = layers("text_model", "text")
+    _layernorm(out, "text/final_ln", "text_model.final_layer_norm", sd)
+    out["params/text_projection/kernel"] = _to_numpy(sd["text_projection.weight"]).transpose(1, 0)
+
+    patch_size = patch.shape[-1]
+    n_patches_side = int(np.sqrt(vis_pos.shape[0] - 1))
+    out["config/vocab_size"] = np.asarray(tok.shape[0])
+    out["config/text_hidden"] = np.asarray(tok.shape[1])
+    out["config/text_layers"] = np.asarray(text_layers)
+    out["config/text_heads"] = np.asarray(text_heads if text_heads else max(tok.shape[1] // 64, 1))
+    out["config/text_intermediate"] = np.asarray(out["params/text/layer_0/fc1/kernel"].shape[1])
+    out["config/max_position"] = np.asarray(txt_pos.shape[0])
+    out["config/vision_hidden"] = np.asarray(patch.shape[0])
+    out["config/vision_layers"] = np.asarray(vision_layers)
+    out["config/vision_heads"] = np.asarray(vision_heads if vision_heads else max(patch.shape[0] // 64, 1))
+    out["config/vision_intermediate"] = np.asarray(out["params/vision/layer_0/fc1/kernel"].shape[1])
+    out["config/image_size"] = np.asarray(n_patches_side * patch_size)
+    out["config/patch_size"] = np.asarray(patch_size)
+    out["config/projection_dim"] = np.asarray(out["params/visual_projection/kernel"].shape[1])
+    out["config/eos_token_id"] = np.asarray(eos_token_id)
+    return out
+
+
+# ---------------------------------------------------------------------------
 # BERT: HF BertModel / BertForMaskedLM naming -> torchmetrics_tpu BertEncoder
 # ---------------------------------------------------------------------------
 
@@ -262,6 +330,9 @@ def _load_torch_checkpoint(path: str) -> Mapping:
 def main(argv) -> int:
     if len(argv) >= 3 and argv[0] == "inception":
         _save(argv[2], convert_inception_state_dict(_load_torch_checkpoint(argv[1])))
+        return 0
+    if len(argv) >= 3 and argv[0] == "clip":
+        _save(argv[2], convert_clip_state_dict(_load_torch_checkpoint(argv[1])))
         return 0
     if len(argv) >= 3 and argv[0] == "bert":
         heads = int(argv[3]) if len(argv) > 3 else None

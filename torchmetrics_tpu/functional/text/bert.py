@@ -65,6 +65,17 @@ def _hash_embedding(input_ids: Array, attention_mask: Array) -> Array:
     return flat.reshape(*input_ids.shape, _EMBED_DIM) * attention_mask[..., None]
 
 
+def _pad_encoding(enc, max_length: int):
+    """Pad/truncate a pre-tokenized {'input_ids','attention_mask'} batch."""
+    out = {}
+    for key in ("input_ids", "attention_mask"):
+        arr = np.asarray(enc[key])[:, :max_length]
+        if arr.shape[1] < max_length:
+            arr = np.pad(arr, ((0, 0), (0, max_length - arr.shape[1])))
+        out[key] = arr
+    return out
+
+
 def _compute_idf(input_ids: np.ndarray, attention_mask: np.ndarray) -> Dict[int, float]:
     """Inverse-document-frequency weights over the reference corpus."""
     num_docs = input_ids.shape[0]

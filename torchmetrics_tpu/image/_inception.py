@@ -24,7 +24,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.utilities.jit_pickle import PickleableJitMixin
+
 Array = jax.Array
+
+
+def _mxu_precision(dtype):
+    """f32 weights on the TPU MXU silently drop to bf16 passes; request full
+    precision unless the caller explicitly chose a half compute dtype."""
+    return "highest" if dtype in (None, jnp.float32) else None
 
 
 class BasicConv2d(nn.Module):
@@ -38,7 +46,7 @@ class BasicConv2d(nn.Module):
     def __call__(self, x: Array) -> Array:
         x = nn.Conv(
             self.out_channels, self.kernel_size, self.strides, padding=self.padding, use_bias=False,
-            dtype=self.dtype,
+            dtype=self.dtype, precision=_mxu_precision(self.dtype),
         )(x)
         x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9, dtype=self.dtype)(x)
         return nn.relu(x)
@@ -170,7 +178,7 @@ class InceptionV3(nn.Module):
         x = InceptionE(pool_type="max", dtype=self.dtype)(x)
         pooled = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         out["2048"] = pooled
-        out["logits_unbiased"] = nn.Dense(self.num_classes, use_bias=False, name="fc")(pooled)
+        out["logits_unbiased"] = nn.Dense(self.num_classes, use_bias=False, name="fc", precision="highest")(pooled)
         return out
 
 
@@ -234,7 +242,8 @@ def _resize_bilinear_tf1(x: Array, out_h: int, out_w: int) -> Array:
     return top + (bottom - top) * fy
 
 
-class InceptionFeatureExtractor:
+class InceptionFeatureExtractor(PickleableJitMixin):
+    _COMPILED_ATTRS = ("_forward",)
     """Stateful wrapper: resize + TF preprocessing + InceptionV3 forward.
 
     ``feature`` selects the tap (64 / 192 / 768 / 2048 / 'logits_unbiased').
@@ -268,6 +277,9 @@ class InceptionFeatureExtractor:
             )
             self.variables = self.net.init(jax.random.PRNGKey(seed), dummy)
 
+        self._build_forward()
+
+    def _build_forward(self) -> None:
         feature = self.feature
 
         def _fwd(variables, imgs):
@@ -287,6 +299,7 @@ class InceptionFeatureExtractor:
             return self.net.apply(variables, imgs)[feature].astype(jnp.float32)
 
         self._forward = jax.jit(_fwd)
+
 
     def __call__(self, imgs: Array) -> Array:
         """``imgs``: (N, 3, H, W) uint8 [0, 255] or float [0, 1]."""

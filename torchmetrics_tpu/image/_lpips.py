@@ -16,7 +16,15 @@ import flax.linen as nn
 import jax
 import jax.numpy as jnp
 
+from torchmetrics_tpu.utilities.jit_pickle import PickleableJitMixin
+
 Array = jax.Array
+
+
+def _mxu_precision(dtype):
+    """f32 weights on the TPU MXU silently drop to bf16 passes; request full
+    precision unless the caller explicitly chose a half compute dtype."""
+    return "highest" if dtype in (None, jnp.float32) else None
 
 # ImageNet scaling constants used by LPIPS (reference ScalingLayer)
 _SHIFT = (-0.030, -0.088, -0.188)
@@ -41,7 +49,7 @@ class VGG16Features(nn.Module):
             if v == "M":
                 x = nn.max_pool(x, (2, 2), strides=(2, 2))
             else:
-                x = nn.Conv(v, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype)(x)
+                x = nn.Conv(v, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, precision=_mxu_precision(self.dtype))(x)
                 x = nn.relu(x)
                 if conv_idx in _VGG_TAPS:
                     taps.append(x)
@@ -76,12 +84,13 @@ class LPIPSNet(nn.Module):
             # distances accumulate in float32 regardless of trunk dtype
             f0, f1 = f0.astype(jnp.float32), f1.astype(jnp.float32)
             d = (_normalize_tensor(f0) - _normalize_tensor(f1)) ** 2
-            lin = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}")(d)
+            lin = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}", precision="highest")(d)
             total = total + jnp.mean(lin, axis=(1, 2, 3))
         return total
 
 
-class LPIPSExtractor:
+class LPIPSExtractor(PickleableJitMixin):
+    _COMPILED_ATTRS = ("_forward",)
     """Stateful wrapper with jit-compiled forward and optional weight loading."""
 
     def __init__(self, net_type: str = "vgg", weights_path: str = None, seed: int = 0, compute_dtype=None) -> None:
@@ -111,7 +120,11 @@ class LPIPSExtractor:
                 " pass converted weights or a custom `net` callable for real use."
             )
             self.variables = self.net.init(jax.random.PRNGKey(seed), dummy, dummy)
+        self._build_forward()
+
+    def _build_forward(self) -> None:
         self._forward = jax.jit(lambda v, a, b: self.net.apply(v, a, b))
+
 
     def __call__(self, img0: Array, img1: Array) -> Array:
         return self._forward(self.variables, img0, img1)

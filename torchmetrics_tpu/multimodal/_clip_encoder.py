@@ -1,0 +1,299 @@
+"""Flax CLIP (vision + text towers) for CLIPScore / CLIP-IQA.
+
+TPU-native replacement for the ``transformers.CLIPModel`` the reference loads
+(``functional/multimodal/clip_score.py``).  Both towers mirror the HF
+computation — pre-LayerNorm blocks, quick-GELU, causal text masking,
+first-EOS pooling, bias-free projections — so weights converted from any HF
+CLIP checkpoint (``tools/convert_weights.py clip``) reproduce its
+``get_image_features`` / ``get_text_features``; the equivalence suite pins
+this against a random-weight torch ``CLIPModel``.
+
+The extractor implements the pluggable-encoder contract the metrics consume:
+``get_image_features(images NCHW)`` and ``get_text_features(list_of_str)``
+(text needs a ``tokenizer`` callable returning
+``{"input_ids", "attention_mask"}`` — HF's CLIP tokenizer works offline from
+its vocab files).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.utilities.jit_pickle import PickleableJitMixin
+
+Array = jax.Array
+
+
+def _mxu_precision(dtype):
+    """f32 weights on the TPU MXU silently drop to bf16 passes; request full
+    precision unless the caller explicitly chose a half compute dtype."""
+    return "highest" if dtype in (None, jnp.float32) else None
+
+# CLIPProcessor normalization constants
+_CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
+_CLIP_STD = (0.26862954, 0.26130258, 0.27577711)
+
+
+def _quick_gelu(x: Array) -> Array:
+    return x * jax.nn.sigmoid(1.702 * x)
+
+
+class ClipConfig:
+    def __init__(
+        self,
+        vocab_size: int,
+        text_hidden: int,
+        text_layers: int,
+        text_heads: int,
+        text_intermediate: int,
+        max_position: int,
+        vision_hidden: int,
+        vision_layers: int,
+        vision_heads: int,
+        vision_intermediate: int,
+        image_size: int,
+        patch_size: int,
+        projection_dim: int,
+        eos_token_id: int = 2,
+        layer_norm_eps: float = 1e-5,
+    ) -> None:
+        self.vocab_size = vocab_size
+        self.text_hidden = text_hidden
+        self.text_layers = text_layers
+        self.text_heads = text_heads
+        self.text_intermediate = text_intermediate
+        self.max_position = max_position
+        self.vision_hidden = vision_hidden
+        self.vision_layers = vision_layers
+        self.vision_heads = vision_heads
+        self.vision_intermediate = vision_intermediate
+        self.image_size = image_size
+        self.patch_size = patch_size
+        self.projection_dim = projection_dim
+        self.eos_token_id = eos_token_id
+        self.layer_norm_eps = layer_norm_eps
+
+
+class _ClipAttention(nn.Module):
+    hidden: int
+    heads: int
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x: Array, bias: Optional[Array]) -> Array:
+        head_dim = self.hidden // self.heads
+        q = nn.Dense(self.hidden, name="q", dtype=self.dtype, precision=_mxu_precision(self.dtype))(x)
+        k = nn.Dense(self.hidden, name="k", dtype=self.dtype, precision=_mxu_precision(self.dtype))(x)
+        v = nn.Dense(self.hidden, name="v", dtype=self.dtype, precision=_mxu_precision(self.dtype))(x)
+
+        def split(t):
+            return t.reshape(*t.shape[:2], self.heads, head_dim).transpose(0, 2, 1, 3)
+
+        scores = jnp.einsum("bhqd,bhkd->bhqk", split(q), split(k), precision="highest")
+        scores = scores / jnp.sqrt(jnp.asarray(head_dim, scores.dtype))
+        if bias is not None:
+            scores = scores + bias.astype(scores.dtype)
+        probs = jax.nn.softmax(scores, axis=-1)
+        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, split(v), precision="highest")
+        ctx = ctx.transpose(0, 2, 1, 3).reshape(*x.shape[:2], self.hidden)
+        return nn.Dense(self.hidden, name="out", dtype=self.dtype, precision=_mxu_precision(self.dtype))(ctx)
+
+
+class _ClipLayer(nn.Module):
+    """Pre-LN transformer block with quick-GELU (HF CLIPEncoderLayer)."""
+
+    hidden: int
+    heads: int
+    intermediate: int
+    eps: float
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x: Array, bias: Optional[Array]) -> Array:
+        h = nn.LayerNorm(epsilon=self.eps, name="ln1")(x)
+        x = x + _ClipAttention(self.hidden, self.heads, self.dtype, name="attn")(h, bias)
+        h = nn.LayerNorm(epsilon=self.eps, name="ln2")(x)
+        h = nn.Dense(self.intermediate, name="fc1", dtype=self.dtype, precision=_mxu_precision(self.dtype))(h)
+        h = _quick_gelu(h)
+        h = nn.Dense(self.hidden, name="fc2", dtype=self.dtype, precision=_mxu_precision(self.dtype))(h)
+        return x + h
+
+
+class ClipVisionTower(nn.Module):
+    config: ClipConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, pixels: Array) -> Array:
+        """``pixels``: (N, H, W, 3) normalized. Returns pooled (N, hidden)."""
+        cfg = self.config
+        patches = nn.Conv(
+            cfg.vision_hidden,
+            (cfg.patch_size, cfg.patch_size),
+            strides=(cfg.patch_size, cfg.patch_size),
+            padding="VALID",
+            use_bias=False,
+            name="patch_embedding",
+            dtype=self.dtype,
+            precision=_mxu_precision(self.dtype),
+        )(pixels)
+        patches = patches.reshape(patches.shape[0], -1, cfg.vision_hidden)
+        cls = self.param("class_embedding", nn.initializers.normal(), (cfg.vision_hidden,))
+        cls_tok = jnp.broadcast_to(cls, (patches.shape[0], 1, cfg.vision_hidden)).astype(patches.dtype)
+        x = jnp.concatenate([cls_tok, patches], axis=1)
+        n_pos = x.shape[1]
+        x = x + nn.Embed(n_pos, cfg.vision_hidden, name="position_embedding")(jnp.arange(n_pos)[None, :])
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="pre_ln")(x)
+        for i in range(cfg.vision_layers):
+            x = _ClipLayer(
+                cfg.vision_hidden, cfg.vision_heads, cfg.vision_intermediate, cfg.layer_norm_eps,
+                self.dtype, name=f"layer_{i}",
+            )(x, None)
+        pooled = x[:, 0]
+        return nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="post_ln")(pooled)
+
+
+class ClipTextTower(nn.Module):
+    config: ClipConfig
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, input_ids: Array, attention_mask: Array) -> Array:
+        """Returns pooled features at the FIRST EOS position (HF semantics)."""
+        cfg = self.config
+        length = input_ids.shape[1]
+        x = nn.Embed(cfg.vocab_size, cfg.text_hidden, name="token_embedding")(input_ids)
+        x = x + nn.Embed(cfg.max_position, cfg.text_hidden, name="position_embedding")(
+            jnp.arange(length)[None, :]
+        )
+        causal = jnp.triu(jnp.full((length, length), -1e9, jnp.float32), k=1)[None, None, :, :]
+        pad = (1.0 - attention_mask[:, None, None, :].astype(jnp.float32)) * -1e9
+        bias = causal + pad
+        for i in range(cfg.text_layers):
+            x = _ClipLayer(
+                cfg.text_hidden, cfg.text_heads, cfg.text_intermediate, cfg.layer_norm_eps,
+                self.dtype, name=f"layer_{i}",
+            )(x, bias)
+        x = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="final_ln")(x)
+        if cfg.eos_token_id == 2:
+            # HF's legacy branch for checkpoints with config eos_token_id == 2
+            # (ALL original OpenAI CLIP configs): pool at argmax(input_ids),
+            # which is the EOS position because id 49407 is the top vocab id
+            eos_idx = jnp.argmax(input_ids, axis=1)
+        else:
+            # modern branch: first occurrence of the EOS token
+            is_eos = (input_ids == cfg.eos_token_id).astype(jnp.int32)
+            eos_idx = jnp.sum(jnp.cumsum(is_eos, axis=1) == 0, axis=1)
+            eos_idx = jnp.minimum(eos_idx, length - 1)
+        return jnp.take_along_axis(x, eos_idx[:, None, None], axis=1)[:, 0]
+
+
+class _ClipModel(nn.Module):
+    config: ClipConfig
+    dtype: Any = jnp.float32
+
+    def setup(self):
+        self.vision = ClipVisionTower(self.config, self.dtype)
+        self.text = ClipTextTower(self.config, self.dtype)
+        self.visual_projection = nn.Dense(self.config.projection_dim, use_bias=False, precision="highest")
+        self.text_projection = nn.Dense(self.config.projection_dim, use_bias=False, precision="highest")
+
+    def image_features(self, pixels: Array) -> Array:
+        return self.visual_projection(self.vision(pixels).astype(jnp.float32))
+
+    def text_features(self, input_ids: Array, attention_mask: Array) -> Array:
+        return self.text_projection(self.text(input_ids, attention_mask).astype(jnp.float32))
+
+    def __call__(self, pixels: Array, input_ids: Array, attention_mask: Array):
+        return self.image_features(pixels), self.text_features(input_ids, attention_mask)
+
+
+def _config_from_npz(flat: Dict[str, np.ndarray]) -> ClipConfig:
+    get = lambda k: int(flat[f"config/{k}"])
+    return ClipConfig(
+        vocab_size=get("vocab_size"),
+        text_hidden=get("text_hidden"),
+        text_layers=get("text_layers"),
+        text_heads=get("text_heads"),
+        text_intermediate=get("text_intermediate"),
+        max_position=get("max_position"),
+        vision_hidden=get("vision_hidden"),
+        vision_layers=get("vision_layers"),
+        vision_heads=get("vision_heads"),
+        vision_intermediate=get("vision_intermediate"),
+        image_size=get("image_size"),
+        patch_size=get("patch_size"),
+        projection_dim=get("projection_dim"),
+        eos_token_id=get("eos_token_id"),
+    )
+
+
+class ClipExtractor(PickleableJitMixin):
+    _COMPILED_ATTRS = ("_image_forward", "_text_forward")
+    """Converted-checkpoint CLIP implementing the metrics' encoder contract.
+
+    ``tokenizer``: callable ``(list_of_str) -> {"input_ids", "attention_mask"}``
+    matching the checkpoint (HF's CLIP tokenizer runs offline from vocab
+    files).  Pre-tokenized dicts are also accepted by ``get_text_features``.
+    ``get_image_features`` takes float NCHW in [0, 1] (or uint8 [0, 255]) and
+    applies the CLIPProcessor normalization + bilinear resize to the
+    checkpoint's image size.
+    """
+
+    def __init__(self, weights_path: str, tokenizer: Optional[Callable] = None, compute_dtype=None) -> None:
+        from torchmetrics_tpu.text._bert_encoder import _params_tree_from_flat
+
+        flat = dict(np.load(weights_path))
+        self.config = _config_from_npz(flat)
+        self.tokenizer = tokenizer
+        self.net = _ClipModel(self.config, dtype=compute_dtype if compute_dtype is not None else jnp.float32)
+        self.variables = {"params": _params_tree_from_flat(flat)}
+        self._build_forward()
+
+    def _build_forward(self) -> None:
+        cfg = self.config
+
+        def _img(variables, imgs):
+            if imgs.dtype == jnp.uint8:
+                imgs = imgs.astype(jnp.float32) / 255.0
+            imgs = jnp.transpose(imgs, (0, 2, 3, 1))  # NCHW -> NHWC
+            if imgs.shape[1:3] != (cfg.image_size, cfg.image_size):
+                imgs = jax.image.resize(
+                    imgs, (imgs.shape[0], cfg.image_size, cfg.image_size, imgs.shape[3]), method="bilinear"
+                )
+            mean = jnp.asarray(_CLIP_MEAN).reshape(1, 1, 1, 3)
+            std = jnp.asarray(_CLIP_STD).reshape(1, 1, 1, 3)
+            return self.net.apply(variables, (imgs - mean) / std, method=_ClipModel.image_features)
+
+        def _txt(variables, ids, mask):
+            return self.net.apply(variables, ids, mask, method=_ClipModel.text_features)
+
+        self._image_forward = jax.jit(_img)
+        self._text_forward = jax.jit(_txt)
+
+
+    def get_image_features(self, images: Array) -> Array:
+        return self._image_forward(self.variables, jnp.asarray(images))
+
+    def get_text_features(self, text: Any) -> Array:
+        if isinstance(text, dict):
+            enc = text
+        else:
+            if self.tokenizer is None:
+                raise ValueError(
+                    "This CLIP runs on converted weights, whose token ids only make sense with the"
+                    " checkpoint's tokenizer. Pass `tokenizer=` to ClipExtractor or call with a"
+                    " pre-tokenized {'input_ids', 'attention_mask'} dict."
+                )
+            enc = self.tokenizer(list(text) if not isinstance(text, str) else [text])
+        # never index past the checkpoint's position table (real CLIP: 77) —
+        # nn.Embed's clamping gather would silently reuse the last position
+        width = self.config.max_position
+        ids = jnp.asarray(np.asarray(enc["input_ids"])[:, :width])
+        mask = jnp.asarray(np.asarray(enc["attention_mask"])[:, :width])
+        return self._text_forward(self.variables, ids, mask)

@@ -46,9 +46,16 @@ class CLIPImageQualityAssessment(Metric):
         data_range: float = 1.0,
         prompts: Tuple = ("quality",),
         model: Optional[Any] = None,
+        weights_path: Optional[str] = None,
+        tokenizer: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        if model is None and weights_path:
+            # converted HF CLIP checkpoint (tools/convert_weights.py clip)
+            from torchmetrics_tpu.multimodal._clip_encoder import ClipExtractor
+
+            model = ClipExtractor(weights_path, tokenizer=tokenizer)
         self.data_range = data_range
         self.prompts_list, self.prompts_names = _clip_iqa_format_prompts(prompts)
         self.model = model if model is not None else RandomProjectionClipEncoder()

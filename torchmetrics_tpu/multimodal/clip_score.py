@@ -41,9 +41,16 @@ class CLIPScore(Metric):
         self,
         model_name_or_path: Optional[str] = None,
         model: Optional[Any] = None,
+        weights_path: Optional[str] = None,
+        tokenizer: Optional[Any] = None,
         **kwargs: Any,
     ) -> None:
         super().__init__(**kwargs)
+        if model is None and weights_path:
+            # converted HF CLIP checkpoint (tools/convert_weights.py clip)
+            from torchmetrics_tpu.multimodal._clip_encoder import ClipExtractor
+
+            model = ClipExtractor(weights_path, tokenizer=tokenizer)
         self.model = _get_clip_model(model_name_or_path, model)
         self.add_state("score", default=jnp.asarray(0.0), dist_reduce_fx="sum")
         self.add_state("n_samples", default=jnp.asarray(0), dist_reduce_fx="sum")

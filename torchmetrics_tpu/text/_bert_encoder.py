@@ -21,7 +21,15 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu.utilities.jit_pickle import PickleableJitMixin
+
 Array = jax.Array
+
+
+def _mxu_precision(dtype):
+    """f32 weights on the TPU MXU silently drop to bf16 passes; request full
+    precision unless the caller explicitly chose a half compute dtype."""
+    return "highest" if dtype in (None, jnp.float32) else None
 
 
 class BertConfig:
@@ -57,9 +65,9 @@ class _SelfAttention(nn.Module):
     @nn.compact
     def __call__(self, x: Array, attention_mask: Array) -> Array:
         head_dim = self.hidden_size // self.num_heads
-        q = nn.Dense(self.hidden_size, name="query", dtype=self.dtype)(x)
-        k = nn.Dense(self.hidden_size, name="key", dtype=self.dtype)(x)
-        v = nn.Dense(self.hidden_size, name="value", dtype=self.dtype)(x)
+        q = nn.Dense(self.hidden_size, name="query", dtype=self.dtype, precision=_mxu_precision(self.dtype))(x)
+        k = nn.Dense(self.hidden_size, name="key", dtype=self.dtype, precision=_mxu_precision(self.dtype))(x)
+        v = nn.Dense(self.hidden_size, name="value", dtype=self.dtype, precision=_mxu_precision(self.dtype))(x)
 
         def split(t):  # (B, L, H) -> (B, heads, L, head_dim)
             return t.reshape(*t.shape[:2], self.num_heads, head_dim).transpose(0, 2, 1, 3)
@@ -71,7 +79,7 @@ class _SelfAttention(nn.Module):
         probs = jax.nn.softmax(scores + bias, axis=-1)
         ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, split(v), precision="highest")
         ctx = ctx.transpose(0, 2, 1, 3).reshape(*x.shape[:2], self.hidden_size)
-        out = nn.Dense(self.hidden_size, name="out", dtype=self.dtype)(ctx)
+        out = nn.Dense(self.hidden_size, name="out", dtype=self.dtype, precision=_mxu_precision(self.dtype))(ctx)
         return nn.LayerNorm(epsilon=self.eps, name="ln")(x + out)
 
 
@@ -87,9 +95,9 @@ class _EncoderLayer(nn.Module):
         x = _SelfAttention(self.hidden_size, self.num_heads, self.eps, self.dtype, name="attention")(
             x, attention_mask
         )
-        h = nn.Dense(self.intermediate_size, name="intermediate", dtype=self.dtype)(x)
+        h = nn.Dense(self.intermediate_size, name="intermediate", dtype=self.dtype, precision=_mxu_precision(self.dtype))(x)
         h = jax.nn.gelu(h, approximate=False)  # HF "gelu" is the erf form
-        h = nn.Dense(self.hidden_size, name="output", dtype=self.dtype)(h)
+        h = nn.Dense(self.hidden_size, name="output", dtype=self.dtype, precision=_mxu_precision(self.dtype))(h)
         return nn.LayerNorm(epsilon=self.eps, name="ln")(x + h)
 
 
@@ -133,10 +141,10 @@ class BertMLMHead(nn.Module):
     @nn.compact
     def __call__(self, hidden: Array) -> Array:
         cfg = self.config
-        h = nn.Dense(cfg.hidden_size, name="transform", dtype=self.dtype)(hidden)
+        h = nn.Dense(cfg.hidden_size, name="transform", dtype=self.dtype, precision=_mxu_precision(self.dtype))(hidden)
         h = jax.nn.gelu(h, approximate=False)
         h = nn.LayerNorm(epsilon=cfg.layer_norm_eps, name="transform_ln")(h)
-        return nn.Dense(cfg.vocab_size, name="decoder")(h.astype(jnp.float32))
+        return nn.Dense(cfg.vocab_size, name="decoder", precision="highest")(h.astype(jnp.float32))
 
 
 class _BertWithHead(nn.Module):
@@ -180,7 +188,8 @@ def _config_from_npz(flat: Dict[str, np.ndarray]) -> BertConfig:
     )
 
 
-class BertEncoderExtractor:
+class BertEncoderExtractor(PickleableJitMixin):
+    _COMPILED_ATTRS = ("_forward",)
     """Jit-compiled embedding callable for :func:`bert_score`.
 
     ``num_layers`` selects the hidden state exactly like the reference's
@@ -195,7 +204,9 @@ class BertEncoderExtractor:
         self.net = _BertWithHead(self.config, dtype=compute_dtype if compute_dtype is not None else jnp.float32)
         self.variables = {"params": _params_tree_from_flat(flat)}
         self.num_layers = num_layers
+        self._build_forward()
 
+    def _build_forward(self) -> None:
         def _fwd(variables, ids, mask):
             hidden_states, _ = self.net.apply(variables, ids, mask)
             index = self.num_layers if self.num_layers is not None else len(hidden_states) - 1
@@ -203,11 +214,13 @@ class BertEncoderExtractor:
 
         self._forward = jax.jit(_fwd)
 
+
     def __call__(self, input_ids: Array, attention_mask: Array) -> Array:
         return self._forward(self.variables, jnp.asarray(input_ids), jnp.asarray(attention_mask))
 
 
-class BertMLMExtractor:
+class BertMLMExtractor(PickleableJitMixin):
+    _COMPILED_ATTRS = ("_forward",)
     """Jit-compiled vocab-logits callable for InfoLM (``(ids, mask) -> logits``)."""
 
     def __init__(self, weights_path: str, compute_dtype=None) -> None:
@@ -220,7 +233,11 @@ class BertMLMExtractor:
             )
         self.net = _BertWithHead(self.config, dtype=compute_dtype if compute_dtype is not None else jnp.float32)
         self.variables = {"params": _params_tree_from_flat(flat)}
+        self._build_forward()
+
+    def _build_forward(self) -> None:
         self._forward = jax.jit(lambda v, ids, mask: self.net.apply(v, ids, mask)[1])
+
 
     def __call__(self, input_ids: Array, attention_mask: Array) -> Array:
         return self._forward(self.variables, jnp.asarray(input_ids), jnp.asarray(attention_mask))

@@ -8,7 +8,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torchmetrics_tpu.functional.text.bert import _DEFAULT_MAX_LENGTH, _HashTokenizer, bert_score
+from torchmetrics_tpu.functional.text.bert import _pad_encoding, _DEFAULT_MAX_LENGTH, _HashTokenizer, bert_score
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 
@@ -84,20 +84,9 @@ class BERTScore(Metric):
         self.add_state("target_input_ids", default=[], dist_reduce_fx="cat")
         self.add_state("target_attention_mask", default=[], dist_reduce_fx="cat")
 
-    def _pad_encoding(self, enc: Dict) -> Dict[str, np.ndarray]:
-        """Pad/truncate a pre-tokenized batch to ``max_length`` so cat states
-        from mixed-width updates concatenate."""
-        out = {}
-        for key in ("input_ids", "attention_mask"):
-            arr = np.asarray(enc[key])[:, : self.max_length]
-            if arr.shape[1] < self.max_length:
-                arr = np.pad(arr, ((0, 0), (0, self.max_length - arr.shape[1])))
-            out[key] = arr
-        return out
-
     def _encode(self, texts: Union[List[str], Dict]) -> Dict[str, np.ndarray]:
         if isinstance(texts, dict):
-            return self._pad_encoding(texts)
+            return _pad_encoding(texts, self.max_length)
         if self._converted_weights and self.user_tokenizer is None:
             raise ValueError(
                 "BERTScore was built from converted BERT weights, whose token ids only make sense with"

@@ -89,13 +89,9 @@ class InfoLM(Metric):
 
     def _encode(self, texts: Union[List[str], Dict], width: int) -> Dict[str, np.ndarray]:
         if isinstance(texts, dict):
-            out = {}
-            for key in ("input_ids", "attention_mask"):
-                arr = np.asarray(texts[key])[:, :width]
-                if arr.shape[1] < width:
-                    arr = np.pad(arr, ((0, 0), (0, width - arr.shape[1])))
-                out[key] = arr
-            return out
+            from torchmetrics_tpu.functional.text.bert import _pad_encoding
+
+            return _pad_encoding(texts, width)
         if self._converted_weights and self._user_tokenizer is None:
             raise ValueError(
                 "InfoLM was built from converted BERT weights, whose token ids only make sense with"

@@ -1,0 +1,20 @@
+"""Pickle support for extractor objects holding jit-compiled closures."""
+
+from __future__ import annotations
+
+
+class PickleableJitMixin:
+    """Drop compiled-forward attributes on pickle, rebuild on unpickle.
+
+    Subclasses list their compiled attributes in ``_COMPILED_ATTRS`` and
+    implement ``_build_forward()`` (also called at the end of ``__init__``).
+    """
+
+    _COMPILED_ATTRS: tuple = ()
+
+    def __getstate__(self):
+        return {k: v for k, v in self.__dict__.items() if k not in self._COMPILED_ATTRS}
+
+    def __setstate__(self, state):
+        self.__dict__.update(state)
+        self._build_forward()
