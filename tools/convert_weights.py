@@ -18,7 +18,7 @@ Usage::
     python tools/convert_weights.py inception weights.pth out.npz
     python tools/convert_weights.py lpips vgg16.pth lpips_heads.pth out.npz
     python tools/convert_weights.py bert bert_mlm.pth out.npz [num_heads]
-    python tools/convert_weights.py clip clip_model.pth out.npz
+    python tools/convert_weights.py clip clip_model.pth out.npz [text_heads vision_heads eos_id]
 
 Checkpoints are loaded with ``torch.load(map_location="cpu")``; only numpy
 arrays are written.  The conversion functions are also importable for use in
@@ -332,7 +332,15 @@ def main(argv) -> int:
         _save(argv[2], convert_inception_state_dict(_load_torch_checkpoint(argv[1])))
         return 0
     if len(argv) >= 3 and argv[0] == "clip":
-        _save(argv[2], convert_clip_state_dict(_load_torch_checkpoint(argv[1])))
+        text_heads = int(argv[3]) if len(argv) > 3 else None
+        vision_heads = int(argv[4]) if len(argv) > 4 else None
+        eos = int(argv[5]) if len(argv) > 5 else 2
+        _save(
+            argv[2],
+            convert_clip_state_dict(
+                _load_torch_checkpoint(argv[1]), text_heads=text_heads, vision_heads=vision_heads, eos_token_id=eos
+            ),
+        )
         return 0
     if len(argv) >= 3 and argv[0] == "bert":
         heads = int(argv[3]) if len(argv) > 3 else None

@@ -28,11 +28,7 @@ from torchmetrics_tpu.utilities.jit_pickle import PickleableJitMixin
 
 Array = jax.Array
 
-
-def _mxu_precision(dtype):
-    """f32 weights on the TPU MXU silently drop to bf16 passes; request full
-    precision unless the caller explicitly chose a half compute dtype."""
-    return "highest" if dtype in (None, jnp.float32) else None
+from torchmetrics_tpu.utilities.compute import _mxu_precision  # noqa: E402
 
 
 class BasicConv2d(nn.Module):
@@ -243,7 +239,6 @@ def _resize_bilinear_tf1(x: Array, out_h: int, out_w: int) -> Array:
 
 
 class InceptionFeatureExtractor(PickleableJitMixin):
-    _COMPILED_ATTRS = ("_forward",)
     """Stateful wrapper: resize + TF preprocessing + InceptionV3 forward.
 
     ``feature`` selects the tap (64 / 192 / 768 / 2048 / 'logits_unbiased').
@@ -257,6 +252,9 @@ class InceptionFeatureExtractor(PickleableJitMixin):
     downstream FID/KID covariance folds see full-precision features. Pass
     ``jnp.float32`` for bit-exact fp32 trunks.
     """
+
+    _COMPILED_ATTRS = ("_forward",)
+
 
     def __init__(self, feature="2048", weights_path: str = None, seed: int = 0, compute_dtype=None) -> None:
         self.feature = str(feature)

@@ -20,11 +20,7 @@ from torchmetrics_tpu.utilities.jit_pickle import PickleableJitMixin
 
 Array = jax.Array
 
-
-def _mxu_precision(dtype):
-    """f32 weights on the TPU MXU silently drop to bf16 passes; request full
-    precision unless the caller explicitly chose a half compute dtype."""
-    return "highest" if dtype in (None, jnp.float32) else None
+from torchmetrics_tpu.utilities.compute import _mxu_precision  # noqa: E402
 
 # ImageNet scaling constants used by LPIPS (reference ScalingLayer)
 _SHIFT = (-0.030, -0.088, -0.188)
@@ -90,8 +86,10 @@ class LPIPSNet(nn.Module):
 
 
 class LPIPSExtractor(PickleableJitMixin):
-    _COMPILED_ATTRS = ("_forward",)
     """Stateful wrapper with jit-compiled forward and optional weight loading."""
+
+    _COMPILED_ATTRS = ("_forward",)
+
 
     def __init__(self, net_type: str = "vgg", weights_path: str = None, seed: int = 0, compute_dtype=None) -> None:
         if net_type not in ("vgg", "alex", "squeeze"):

@@ -28,11 +28,7 @@ from torchmetrics_tpu.utilities.jit_pickle import PickleableJitMixin
 
 Array = jax.Array
 
-
-def _mxu_precision(dtype):
-    """f32 weights on the TPU MXU silently drop to bf16 passes; request full
-    precision unless the caller explicitly chose a half compute dtype."""
-    return "highest" if dtype in (None, jnp.float32) else None
+from torchmetrics_tpu.utilities.compute import _mxu_precision  # noqa: E402
 
 # CLIPProcessor normalization constants
 _CLIP_MEAN = (0.48145466, 0.4578275, 0.40821073)
@@ -234,7 +230,6 @@ def _config_from_npz(flat: Dict[str, np.ndarray]) -> ClipConfig:
 
 
 class ClipExtractor(PickleableJitMixin):
-    _COMPILED_ATTRS = ("_image_forward", "_text_forward")
     """Converted-checkpoint CLIP implementing the metrics' encoder contract.
 
     ``tokenizer``: callable ``(list_of_str) -> {"input_ids", "attention_mask"}``
@@ -244,6 +239,9 @@ class ClipExtractor(PickleableJitMixin):
     applies the CLIPProcessor normalization + bilinear resize to the
     checkpoint's image size.
     """
+
+    _COMPILED_ATTRS = ("_image_forward", "_text_forward")
+
 
     def __init__(self, weights_path: str, tokenizer: Optional[Callable] = None, compute_dtype=None) -> None:
         from torchmetrics_tpu.text._bert_encoder import _params_tree_from_flat
@@ -294,6 +292,16 @@ class ClipExtractor(PickleableJitMixin):
         # never index past the checkpoint's position table (real CLIP: 77) —
         # nn.Embed's clamping gather would silently reuse the last position
         width = self.config.max_position
-        ids = jnp.asarray(np.asarray(enc["input_ids"])[:, :width])
-        mask = jnp.asarray(np.asarray(enc["attention_mask"])[:, :width])
-        return self._text_forward(self.variables, ids, mask)
+        ids_np = np.asarray(enc["input_ids"])
+        mask_np = np.asarray(enc["attention_mask"])
+        truncated = ids_np.shape[1] > width
+        ids_np = ids_np[:, :width].copy()
+        mask_np = mask_np[:, :width]
+        if truncated:
+            # HF tokenizer truncation keeps EOS at the last kept position;
+            # chopping it off would shift the modern-branch pooling onto an
+            # arbitrary mid-sentence token
+            eos = self.config.eos_token_id
+            missing = ~(ids_np == eos).any(axis=1)
+            ids_np[missing, -1] = eos
+        return self._text_forward(self.variables, jnp.asarray(ids_np), jnp.asarray(mask_np))

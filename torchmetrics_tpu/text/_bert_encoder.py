@@ -25,11 +25,7 @@ from torchmetrics_tpu.utilities.jit_pickle import PickleableJitMixin
 
 Array = jax.Array
 
-
-def _mxu_precision(dtype):
-    """f32 weights on the TPU MXU silently drop to bf16 passes; request full
-    precision unless the caller explicitly chose a half compute dtype."""
-    return "highest" if dtype in (None, jnp.float32) else None
+from torchmetrics_tpu.utilities.compute import _mxu_precision  # noqa: E402
 
 
 class BertConfig:
@@ -189,7 +185,6 @@ def _config_from_npz(flat: Dict[str, np.ndarray]) -> BertConfig:
 
 
 class BertEncoderExtractor(PickleableJitMixin):
-    _COMPILED_ATTRS = ("_forward",)
     """Jit-compiled embedding callable for :func:`bert_score`.
 
     ``num_layers`` selects the hidden state exactly like the reference's
@@ -197,6 +192,9 @@ class BertEncoderExtractor(PickleableJitMixin):
     last).  The callable signature is the pluggable-encoder contract:
     ``(input_ids, attention_mask) -> (B, L, H) embeddings``.
     """
+
+    _COMPILED_ATTRS = ("_forward",)
+
 
     def __init__(self, weights_path: str, num_layers: Optional[int] = None, compute_dtype=None) -> None:
         flat = dict(np.load(weights_path))
@@ -220,8 +218,10 @@ class BertEncoderExtractor(PickleableJitMixin):
 
 
 class BertMLMExtractor(PickleableJitMixin):
-    _COMPILED_ATTRS = ("_forward",)
     """Jit-compiled vocab-logits callable for InfoLM (``(ids, mask) -> logits``)."""
+
+    _COMPILED_ATTRS = ("_forward",)
+
 
     def __init__(self, weights_path: str, compute_dtype=None) -> None:
         flat = dict(np.load(weights_path))

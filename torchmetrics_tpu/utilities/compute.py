@@ -30,6 +30,12 @@ def _safe_matmul(x: Array, y: Array) -> Array:
     return jnp.matmul(x, y.T, precision="highest")
 
 
+def _mxu_precision(dtype):
+    """f32 weights on the TPU MXU silently drop to bf16 passes; request full
+    precision unless the caller explicitly chose a half compute dtype."""
+    return "highest" if dtype in (None, jnp.float32) else None
+
+
 def _safe_sqrt(x: Array) -> Array:
     """``sqrt`` with a finite (zero) gradient at 0.
 
