@@ -1,0 +1,251 @@
+"""Transparent auto-compiled update/forward (round-4).
+
+`Metric.update()` / `Metric.forward()` route repeat-shape calls through the
+shape-keyed compiled path (one XLA executable per batch) whenever that cannot
+change semantics: first call per signature runs eagerly (value validation +
+lazy-state warm-up), `validate_args=True` metrics never auto-compile, and any
+untraceable update permanently drops back to eager. These tests pin:
+
+- state/compute parity between auto-on and auto-off streaming,
+- forward() batch values + accumulation parity,
+- validation still raising mid-stream for `validate_args=True`,
+- fallback behaviors (list states, aggregator nan checks, shape churn),
+- pickle/clone hygiene and `set_dtype` cache-key correctness (advisor r3 #1),
+- a registry-wide sweep over the precision-sweep SPECS.
+"""
+
+import inspect
+import pickle
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu.classification import BinaryStatScores, MulticlassAccuracy, MulticlassConfusionMatrix
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu.regression import MeanSquaredError
+
+from tests.unittests.test_precision_differentiability_sweep import SPECS, _seed_for
+
+RNG = np.random.default_rng(123)
+
+
+def _batches(n=4, b=32, c=5):
+    return [
+        (RNG.random((b, c)).astype(np.float32), RNG.integers(0, c, b))
+        for _ in range(n)
+    ]
+
+
+class TestAutoUpdateParity:
+    def test_engages_and_matches_eager(self):
+        batches = _batches()
+        auto = MulticlassAccuracy(num_classes=5, validate_args=False)
+        eager = MulticlassAccuracy(num_classes=5, validate_args=False, auto_compile=False)
+        for p, t in batches:
+            auto.update(jnp.asarray(p), jnp.asarray(t))
+            eager.update(jnp.asarray(p), jnp.asarray(t))
+        assert "_auto_update_fn" in auto.__dict__, "compiled path did not engage"
+        assert "_auto_update_fn" not in eager.__dict__
+        assert auto._update_count == eager._update_count == len(batches)
+        for name in auto._defaults:
+            np.testing.assert_array_equal(np.asarray(getattr(auto, name)), np.asarray(getattr(eager, name)))
+        np.testing.assert_allclose(float(auto.compute()), float(eager.compute()), rtol=1e-6)
+
+    def test_forward_engages_and_matches_eager(self):
+        batches = _batches()
+        auto = MulticlassAccuracy(num_classes=5, validate_args=False)
+        eager = MulticlassAccuracy(num_classes=5, validate_args=False, auto_compile=False)
+        for p, t in batches:
+            va = auto(jnp.asarray(p), jnp.asarray(t))
+            ve = eager(jnp.asarray(p), jnp.asarray(t))
+            np.testing.assert_allclose(float(va), float(ve), rtol=1e-6)
+        assert "_auto_forward_fn" in auto.__dict__, "compiled forward did not engage"
+        np.testing.assert_allclose(float(auto.compute()), float(eager.compute()), rtol=1e-6)
+
+    def test_forward_mean_reduction_weighting(self):
+        # mean-reduced states hit the (n-1)/n running-mean merge inside the
+        # compiled forward — exercise several steps so the weighting matters
+        auto = MeanSquaredError(auto_compile=True)
+        eager = MeanSquaredError(auto_compile=False)
+        for _ in range(5):
+            p, t = RNG.standard_normal(16).astype(np.float32), RNG.standard_normal(16).astype(np.float32)
+            va = auto(jnp.asarray(p), jnp.asarray(t))
+            ve = eager(jnp.asarray(p), jnp.asarray(t))
+            np.testing.assert_allclose(float(va), float(ve), rtol=1e-6)
+        np.testing.assert_allclose(float(auto.compute()), float(eager.compute()), rtol=1e-6)
+
+    def test_validate_args_true_never_compiles_and_still_raises(self):
+        m = BinaryStatScores()  # validate_args defaults True
+        good_p = jnp.asarray(RNG.random(8).astype(np.float32))
+        good_t = jnp.asarray(RNG.integers(0, 2, 8))
+        m.update(good_p, good_t)
+        m.update(good_p, good_t)
+        m.update(good_p, good_t)
+        assert "_auto_update_fn" not in m.__dict__
+        bad_t = jnp.asarray(np.full(8, 7))  # same shape/dtype as good_t
+        with pytest.raises(RuntimeError, match="Detected the following values"):
+            m.update(good_p, bad_t)
+
+    def test_aggregator_nan_check_falls_back(self):
+        # bool(jnp.any(nans)) cannot trace: first compiled attempt must
+        # disable the auto path and the eager result must stay correct
+        m = MeanMetric(nan_strategy="ignore")
+        x = jnp.asarray(np.array([1.0, 2.0, np.nan, 4.0], np.float32))
+        m.update(x)
+        m.update(x)
+        m.update(x)
+        assert m._auto_disabled
+        np.testing.assert_allclose(float(m.compute()), 7.0 / 3.0, rtol=1e-6)
+
+    def test_float_imputation_aggregator_compiles(self):
+        # nan_strategy=<float> is pure jnp.where — trace-safe, should engage
+        auto = SumMetric(nan_strategy=0.0)
+        eager = SumMetric(nan_strategy=0.0, auto_compile=False)
+        x = np.array([1.0, np.nan, 3.0], np.float32)
+        for _ in range(3):
+            auto.update(jnp.asarray(x))
+            eager.update(jnp.asarray(x))
+        assert "_auto_update_fn" in auto.__dict__
+        np.testing.assert_allclose(float(auto.compute()), float(eager.compute()))
+
+    def test_list_state_metric_stays_eager(self):
+        m = MulticlassAccuracy(num_classes=5, multidim_average="samplewise", average="micro", validate_args=False)
+        p = jnp.asarray(RNG.random((4, 5, 6)).astype(np.float32))
+        t = jnp.asarray(RNG.integers(0, 5, (4, 6)))
+        m.update(p, t)
+        m.update(p, t)
+        m.update(p, t)
+        assert m._auto_disabled
+        assert len(m.tp) == 3  # appended eagerly each call
+
+    def test_shape_churn_keeps_correctness(self):
+        auto = MulticlassAccuracy(num_classes=5, validate_args=False)
+        eager = MulticlassAccuracy(num_classes=5, validate_args=False, auto_compile=False)
+        # more distinct shapes than the signature cap, interleaved with repeats
+        for i in range(2 * auto._AUTO_MAX_SIGNATURES + 4):
+            b = 8 + (i % (auto._AUTO_MAX_SIGNATURES + 2))
+            p = jnp.asarray(RNG.random((b, 5)).astype(np.float32))
+            t = jnp.asarray(RNG.integers(0, 5, b))
+            auto.update(p, t)
+            eager.update(p, t)
+        np.testing.assert_allclose(float(auto.compute()), float(eager.compute()), rtol=1e-6)
+
+    def test_update_count_and_reset(self):
+        m = MulticlassAccuracy(num_classes=5, validate_args=False)
+        p, t = _batches(1)[0]
+        for _ in range(4):
+            m.update(jnp.asarray(p), jnp.asarray(t))
+        assert m._update_count == 4
+        m.reset()
+        assert m._update_count == 0
+        m.update(jnp.asarray(p), jnp.asarray(t))  # compiled path still usable post-reset
+        assert m._update_count == 1
+        assert float(m.compute()) == pytest.approx(float(MulticlassAccuracy(num_classes=5)(jnp.asarray(p), jnp.asarray(t))))
+
+    def test_pickle_and_clone_drop_caches(self):
+        m = MulticlassAccuracy(num_classes=5, validate_args=False)
+        p, t = _batches(1)[0]
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        assert "_auto_update_fn" in m.__dict__
+        m2 = pickle.loads(pickle.dumps(m))
+        assert "_auto_update_fn" not in m2.__dict__ and m2._auto_sigs == {}
+        c = m.clone()
+        assert "_auto_update_fn" not in c.__dict__
+        m2.update(jnp.asarray(p), jnp.asarray(t))  # recompiles cleanly
+        m.update(jnp.asarray(p), jnp.asarray(t))
+        np.testing.assert_array_equal(np.asarray(m2.tp), np.asarray(m.tp))
+
+    def test_set_dtype_invalidates_compiled_policy(self):
+        # advisor r3 #1: the dtype policy participates in the compile key, so
+        # a post-compile set_dtype must not replay a stale executable
+        m = MeanSquaredError()
+        p = jnp.asarray(RNG.standard_normal(8).astype(np.float32))
+        t = jnp.asarray(RNG.standard_normal(8).astype(np.float32))
+        m.update(p, t)
+        m.update(p, t)
+        m.set_dtype(jnp.bfloat16)
+        m.update(p, t)
+        assert m.sum_squared_error.dtype == jnp.bfloat16
+
+    def test_confusion_matrix_parity(self):
+        auto = MulticlassConfusionMatrix(num_classes=5, validate_args=False)
+        eager = MulticlassConfusionMatrix(num_classes=5, validate_args=False, auto_compile=False)
+        for p, t in _batches():
+            auto.update(jnp.asarray(p), jnp.asarray(t))
+            eager.update(jnp.asarray(p), jnp.asarray(t))
+        assert "_auto_update_fn" in auto.__dict__
+        np.testing.assert_array_equal(np.asarray(auto.compute()), np.asarray(eager.compute()))
+
+    def test_merge_state_after_auto_updates(self):
+        a = MulticlassAccuracy(num_classes=5, validate_args=False)
+        b = MulticlassAccuracy(num_classes=5, validate_args=False)
+        batches = _batches(4)
+        for p, t in batches[:2]:
+            a.update(jnp.asarray(p), jnp.asarray(t))
+        for p, t in batches[2:]:
+            b.update(jnp.asarray(p), jnp.asarray(t))
+        a.merge_state(b)
+        ref = MulticlassAccuracy(num_classes=5, validate_args=False, auto_compile=False)
+        for p, t in batches:
+            ref.update(jnp.asarray(p), jnp.asarray(t))
+        np.testing.assert_allclose(float(a.compute()), float(ref.compute()), rtol=1e-6)
+
+
+class TestRingBufferOverflowWarning:
+    def test_compiled_stream_still_warns(self):
+        # advisor r3 #2: streaming entirely through compiled updates must not
+        # silently overwrite rows — the overflow warning fires via the
+        # once-per-signature count readback
+        from torchmetrics_tpu.aggregation import CatMetric
+
+        m = CatMetric(nan_strategy="disable", cat_state_capacity=8)
+        x = jnp.asarray(np.arange(4, dtype=np.float32))
+        with pytest.warns(UserWarning, match="capacity"):
+            for _ in range(4):  # 16 rows > capacity 8
+                m.jit_update(x)
+        assert m.value._host_count == 16
+
+    def test_auto_compiled_stream_warns(self):
+        from torchmetrics_tpu.aggregation import CatMetric
+
+        m = CatMetric(nan_strategy="disable", cat_state_capacity=8)
+        x = jnp.asarray(np.arange(4, dtype=np.float32))
+        with pytest.warns(UserWarning, match="capacity"):
+            for _ in range(5):
+                m.update(x)
+        assert "_auto_update_fn" in m.__dict__
+        assert m.value._host_count == 20
+
+
+def _spec_metric(name, spec, **extra):
+    cls = getattr(tm, name)
+    kwargs = dict(spec.kwargs)
+    if "validate_args" in inspect.signature(cls.__init__).parameters:
+        kwargs["validate_args"] = False
+    kwargs.update(extra)
+    return cls(**kwargs)
+
+
+@pytest.mark.parametrize("name", sorted(SPECS))
+def test_auto_compile_sweep_matches_eager(name):
+    """Registry-wide: 3 identical-shape updates with auto-compile on vs off."""
+    spec = SPECS[name]
+    _seed_for(name)
+    batches = [spec.make() for _ in range(3)]
+    auto = _spec_metric(name, spec)
+    eager = _spec_metric(name, spec, auto_compile=False)
+    for batch in batches:
+        # dict-valued entries (pan-sharpening targets) are positional pytree args
+        args = tuple(
+            {k: jnp.asarray(v) for k, v in x.items()} if isinstance(x, dict) else jnp.asarray(x) for x in batch
+        )
+        auto.update(*args)
+        eager.update(*args)
+    va, ve = auto.compute(), eager.compute()
+    np.testing.assert_allclose(
+        np.asarray(va, dtype=np.float32), np.asarray(ve, dtype=np.float32), rtol=1e-4, atol=1e-5
+    )

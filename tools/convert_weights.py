@@ -294,7 +294,11 @@ def convert_bert_state_dict(sd: Mapping, num_heads: Optional[int] = None) -> Dic
         )  # tied embeddings when the decoder weight is absent
         out["params/mlm/decoder/kernel"] = decoder_w.transpose(1, 0)
         bias = sd.get("cls.predictions.decoder.bias", sd.get("cls.predictions.bias"))
-        out["params/mlm/decoder/bias"] = _to_numpy(bias)
+        if bias is None:  # bias-free MLM head checkpoints exist (e.g. distilled exports)
+            # decoder_w is torch-Linear layout (vocab, hidden): bias is per-vocab
+            out["params/mlm/decoder/bias"] = np.zeros(decoder_w.shape[0], decoder_w.dtype)
+        else:
+            out["params/mlm/decoder/bias"] = _to_numpy(bias)
 
     intermediate = out["params/bert/layer_0/intermediate/kernel"].shape[1] if n_layers else 0
     # the head count is not recoverable from shapes; default to the HF

@@ -38,7 +38,7 @@ class PermutationInvariantTraining(_AveragingAudioMetric):
             key: kwargs.pop(key)
             for key in list(kwargs)
             if key in ("compute_on_cpu", "dist_sync_on_step", "process_group", "dist_sync_fn", "sync_on_compute",
-                       "compute_with_cache", "distributed_available_fn")
+                       "compute_with_cache", "distributed_available_fn", "auto_compile", "cat_state_capacity")
         }
         super().__init__(**base_kwargs)
         if eval_func not in ("max", "min"):

@@ -221,8 +221,10 @@ def _fft_gtgram(wave: Array, fs: int, n_filters: int, low_freq: float) -> Array:
     weighted by per-filter FFT-bin gammatone responses.
     """
     window_time, hop_time = 0.010, 0.0025
-    nwin = int(window_time * fs)
-    nhop = int(hop_time * fs)
+    # round half away from zero, as the gammatone package's fftweight does —
+    # plain truncation diverges at rates where 0.010*fs is not integral
+    nwin = int(np.floor(window_time * fs + 0.5))
+    nhop = int(np.floor(hop_time * fs + 0.5))
     nfft = int(2 ** ceil(log2(2 * nwin)))
 
     # zero-phase window: half-Hann lobes at both ends of the nfft buffer

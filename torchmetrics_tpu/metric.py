@@ -138,6 +138,17 @@ class Metric(ABC):
             raise ValueError(
                 f"Expected keyword argument `compute_with_cache` to be a `bool` but got {self.compute_with_cache}"
             )
+        # TPU-native extension: transparently route repeat-shape `update()` /
+        # `forward()` calls through the shape-keyed compiled path. The first
+        # call with any argument signature always runs eagerly (running
+        # value-dependent validation and warming lazily-shaped states); repeat
+        # signatures replay one XLA executable. Metrics constructed with
+        # `validate_args=True` never auto-compile (their per-batch value checks
+        # must keep running), and any metric whose update cannot trace is
+        # permanently dropped back to the eager path on first failure.
+        self.auto_compile = kwargs.pop("auto_compile", True)
+        if not isinstance(self.auto_compile, bool):
+            raise ValueError(f"Expected keyword argument `auto_compile` to be a `bool` but got {self.auto_compile}")
         # TPU-native extension (SURVEY §5/§7): bound append-mode ("cat") states
         # to a fixed-capacity device ring buffer instead of an unbounded list
         self.cat_state_capacity = kwargs.pop("cat_state_capacity", None)
@@ -168,6 +179,14 @@ class Metric(ABC):
         self._is_synced = False
         self._cache: Optional[Dict[str, Union[Array, List]]] = None
         self._dtype_policy: Optional[Any] = None
+
+        # auto-compile bookkeeping: seen argument signatures, cached state
+        # names, and per-path disable flags (flipped on first trace failure)
+        self._auto_sigs: Dict[Any, int] = {}
+        self._auto_fwd_sigs: Dict[Any, int] = {}
+        self._auto_names: Optional[List[str]] = None
+        self._auto_disabled = False
+        self._auto_forward_disabled = False
 
     # ------------------------------------------------------------------ state
     @property
@@ -254,7 +273,8 @@ class Metric(ABC):
         if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
             self._forward_cache = self._forward_full_state_update(*args, **kwargs)
         else:
-            self._forward_cache = self._forward_reduce_state_update(*args, **kwargs)
+            handled, batch_val = self._try_auto_forward(args, kwargs)
+            self._forward_cache = batch_val if handled else self._forward_reduce_state_update(*args, **kwargs)
         return self._forward_cache
 
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
@@ -344,6 +364,8 @@ class Metric(ABC):
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
+            if self._try_auto_update(args, kwargs):
+                return None
             self._computed = None
             self._update_count += 1
             update(*args, **kwargs)
@@ -560,11 +582,29 @@ class Metric(ABC):
         def metric_like(v: Any) -> bool:
             # Metric subclasses AND collection-shaped delegates (MetricCollection,
             # wrapped collections) — anything with its own update/compute/reset
+            # and a state registry
             return isinstance(v, Metric) or (
-                hasattr(v, "update") and hasattr(v, "compute") and hasattr(v, "reset")
+                hasattr(v, "update")
+                and hasattr(v, "compute")
+                and hasattr(v, "reset")
+                and (hasattr(v, "_defaults") or hasattr(v, "_modules"))
+            )
+
+        def stateful_like(v: Any) -> bool:
+            # duck-typed accumulators: the three method names but no registry.
+            # Tracing an update that mutates such an object would freeze or
+            # corrupt its state, so these also block the compiled paths — with
+            # a distinct message, since they may be innocent user helpers.
+            return (
+                not isinstance(v, (Metric, jnp.ndarray, np.ndarray, RingBuffer))
+                and hasattr(v, "update")
+                and hasattr(v, "compute")
+                and hasattr(v, "reset")
             )
 
         for attr, value in self.__dict__.items():
+            if attr in ("update", "compute"):
+                continue
             # metrics that delegate to child metrics (CompositionalMetric,
             # wrappers, task dicts) mutate state OUTSIDE self._defaults —
             # tracing their update would leak tracers into the children
@@ -574,11 +614,18 @@ class Metric(ABC):
                 children = list(value)
             else:
                 children = [value]
-            if attr not in ("update", "compute") and any(metric_like(v) for v in children):
+            if any(metric_like(v) for v in children):
                 raise TorchMetricsUserError(
                     f"`{method_name}` is unsupported on {type(self).__name__}: it delegates to child"
                     f" metric(s) (`{attr}`) whose states live outside this metric's state registry."
                     " Call the compiled update on the component metrics directly."
+                )
+            if any(stateful_like(v) for v in children):
+                raise TorchMetricsUserError(
+                    f"`{method_name}` is unsupported on {type(self).__name__}: attribute `{attr}` looks"
+                    " stateful (it exposes update/compute/reset) but is not a registered metric state."
+                    " If `update()` mutates it, tracing would corrupt it; stream through the plain"
+                    " `update()` path, or register its state with `add_state`."
                 )
         names = list(self._defaults)
         warm_up = False
@@ -651,9 +698,232 @@ class Metric(ABC):
 
     def _compiled_update(self, cache_name: str, key, build) -> Callable:
         cache = self.__dict__.setdefault(cache_name, {})
+        # the dtype policy is baked into the trace (states are cast inside
+        # `_traced_update`), so it must participate in the cache key — a
+        # `set_dtype` call after a compile would otherwise replay stale casts
+        policy = None if self._dtype_policy is None else jnp.dtype(self._dtype_policy).name
+        key = (key, policy)
         if key not in cache:
             cache[key] = jax.jit(build())
         return cache[key]
+
+    # ---------------------------------------------------- transparent auto-jit
+    _AUTO_MAX_SIGNATURES = 8
+
+    def _auto_eligible(self) -> bool:
+        """Base gate for transparent compilation of ``update``/``forward``.
+
+        Metrics with ``validate_args=True`` keep the eager path: their
+        per-batch value checks (host-side, concreteness-gated) would silently
+        stop running after trace time. ``compute_on_cpu`` implies host-resident
+        growing states, which the compiled path cannot maintain.
+        """
+        return (
+            self.auto_compile
+            and not self._auto_disabled
+            and not self.compute_on_cpu
+            and getattr(self, "validate_args", None) is not True
+        )
+
+    def _auto_state_names(self, method_name: str) -> Optional[List[str]]:
+        """Fixed-shape state names for the auto paths (cached when stable)."""
+        names = self._auto_names
+        if names is not None:
+            return names
+        names = self._fixed_shape_state_names(method_name)
+        if names is None:  # lazily-shaped ring buffer: warm up eagerly first
+            return None
+        if not any(isinstance(getattr(self, n), RingBuffer) for n in names):
+            # ring-buffer states go back to lazy after reset(), so only
+            # plain-array state sets can skip the re-check
+            self._auto_names = names
+        return names
+
+    def _auto_signature(self, args: tuple, kwargs: Dict[str, Any], method_name: str = "update"):
+        """Hashable (structure, statics, shapes/dtypes) argument-signature key.
+
+        The single composition point for every compiled-path cache key
+        (auto update/forward, ``jit_update``, ``scan_update``, ring-buffer
+        append-count replay) — keep it that way.
+        """
+        treedef, dynamic, statics = self._split_batch_args(method_name, args, kwargs)
+        sig = (treedef, statics, tuple((tuple(d.shape), str(d.dtype)) for d in dynamic))
+        return sig, treedef, dynamic, statics
+
+    def _try_auto_update(self, args: tuple, kwargs: Dict[str, Any]) -> bool:
+        """Route a repeat-signature ``update()`` through the compiled path.
+
+        Returns True when the update was fully handled. Any failure —
+        unhashable statics, list states, delegating metrics, untraceable
+        update bodies — permanently disables the auto path for this instance
+        and falls back to the eager wrapped update.
+        """
+        if not self._auto_eligible():
+            return False
+        try:
+            sig, treedef, dynamic, statics = self._auto_signature(args, kwargs)
+        except (TorchMetricsUserError, TypeError):
+            self._auto_disabled = True
+            return False
+        if not dynamic:
+            # pure-static call (e.g. `update(1.0)` streams of python scalars):
+            # the values live in the compile key, so compiling buys nothing
+            return False
+        seen = self._auto_sigs
+        if sig not in seen:
+            if len(seen) >= self._AUTO_MAX_SIGNATURES:
+                return False  # shape churn: keep known sigs compiled, new ones eager
+            seen[sig] = 0
+            return False  # first occurrence runs eagerly (validation + warm-up)
+        try:
+            names = self._auto_state_names("update")
+        except TorchMetricsUserError:
+            self._auto_disabled = True
+            return False
+        if names is None:
+            return False
+        states = {n: getattr(self, n) for n in names}
+
+        def build():
+            def _pure(states_, dyn):
+                a, kw = self._merge_batch_args(treedef, dyn, statics)
+                return self._traced_update(names, states_, a, kw)
+
+            return _pure
+
+        try:
+            fn = self._compiled_update("_auto_update_fn", (treedef, statics), build)
+            new_states = fn(states, dynamic)
+        except Exception:
+            self._auto_disabled = True
+            return False
+        seen[sig] += 1
+        self._computed = None
+        self._update_count += 1
+        self._commit_compiled_states(names, states, new_states, sig)
+        return True
+
+    def _traced_compute(self, names: List[str], states: Dict[str, Any]) -> Any:
+        """Run the raw (unwrapped) compute on temporarily-bound traced states."""
+        saved = {n: getattr(self, n) for n in names}
+        try:
+            for n in names:
+                object.__setattr__(self, n, states[n])
+            return self.compute.__wrapped__()
+        finally:
+            for n, v in saved.items():
+                object.__setattr__(self, n, v)
+
+    def _auto_forward_mergeable(self, names: List[str]) -> bool:
+        """True when every state merges functionally under trace (no growing shapes)."""
+        for n in names:
+            if isinstance(getattr(self, n), RingBuffer):
+                return False
+            reduce_fn = self._reductions[n]
+            if not (reduce_fn in ("sum", "mean", "max", "min") or callable(reduce_fn)):
+                return False
+        return True
+
+    def _try_auto_forward(self, args: tuple, kwargs: Dict[str, Any]):
+        """Compiled ``forward`` for reduce-state metrics: one XLA call computes
+        the batch value AND merges the batch state into the global state —
+        replacing the eager stash/reset/update/compute/merge dance
+        (reference ``metric.py:353-391``) with a single device dispatch.
+        """
+        if self._auto_forward_disabled or not self._auto_eligible():
+            return False, None
+        try:
+            sig, treedef, dynamic, statics = self._auto_signature(args, kwargs)
+        except (TorchMetricsUserError, TypeError):
+            self._auto_disabled = True
+            return False, None
+        if not dynamic:
+            return False, None
+        seen = self._auto_fwd_sigs
+        if sig not in seen:
+            if len(seen) >= self._AUTO_MAX_SIGNATURES:
+                return False, None
+            seen[sig] = 0
+            return False, None
+        try:
+            names = self._auto_state_names("forward")
+        except TorchMetricsUserError:
+            self._auto_disabled = True
+            return False, None
+        if names is None or not self._auto_forward_mergeable(names):
+            self._auto_forward_disabled = True
+            return False, None
+        states = {n: getattr(self, n) for n in names}
+        reductions = {n: self._reductions[n] for n in names}
+        defaults = {n: jnp.asarray(self._defaults[n]) for n in names}
+
+        def build():
+            def _pure(states_, dyn, prev_count):
+                a, kw = self._merge_batch_args(treedef, dyn, statics)
+                batch = self._traced_update(names, defaults, a, kw)
+                batch_val = _squeeze_if_scalar(self._traced_compute(names, batch))
+                merged = {}
+                for n in names:
+                    reduce_fn = reductions[n]
+                    g, loc = states_[n], batch[n]
+                    if reduce_fn == "sum":
+                        merged[n] = g + loc
+                    elif reduce_fn == "mean":
+                        merged[n] = (prev_count * g + loc) / (prev_count + 1.0)
+                    elif reduce_fn == "max":
+                        merged[n] = jnp.maximum(g, loc)
+                    elif reduce_fn == "min":
+                        merged[n] = jnp.minimum(g, loc)
+                    else:
+                        merged[n] = reduce_fn(jnp.stack([g, loc]))
+                return merged, batch_val, prev_count + 1.0
+
+            return _pure
+
+        # the update count rides along as a device scalar so steady-state
+        # streaming never pays a per-call host->device transfer for it
+        cnt = self.__dict__.get("_auto_cnt")
+        if cnt is None or cnt[0] != self._update_count:
+            cnt = (self._update_count, jnp.float32(self._update_count))
+        try:
+            fn = self._compiled_update("_auto_forward_fn", (treedef, statics), build)
+            new_states, batch_val, new_cnt = fn(states, dynamic, cnt[1])
+        except Exception:
+            self._auto_forward_disabled = True
+            return False, None
+        object.__setattr__(self, "_auto_cnt", (self._update_count + 1, new_cnt))
+        seen[sig] += 1
+        self._update_count += 1
+        for n in names:
+            object.__setattr__(self, n, new_states[n])
+        self._computed = None
+        self._is_synced = False
+        self._should_unsync = True
+        self._to_sync = self.sync_on_compute
+        return True, batch_val
+
+    def _commit_compiled_states(self, names: List[str], prior: Dict[str, Any], new_states: Dict[str, Any], sig) -> None:
+        """Bind post-compiled-update states, restoring ring-buffer bookkeeping.
+
+        A traced ring push cannot run the host-side overflow check, so the
+        appended row count per argument signature is measured once (a single
+        device readback) and replayed thereafter — the capacity-overflow
+        warning keeps firing even for streams that never touch the eager path.
+        """
+        for n in names:
+            nb = new_states[n]
+            ob = prior.get(n)
+            if isinstance(nb, RingBuffer) and isinstance(ob, RingBuffer):
+                nb._warned_overflow = ob._warned_overflow
+                if ob._host_count is None:
+                    nb._sync_host_count(None)
+                else:
+                    deltas = self.__dict__.setdefault("_ring_count_deltas", {})
+                    key = (n, sig)
+                    if key not in deltas:
+                        deltas[key] = int(nb.count) - ob._host_count
+                    nb._sync_host_count(ob._host_count + deltas[key])
+            object.__setattr__(self, n, nb)
 
     def jit_update(self, *args: Any, **kwargs: Any) -> None:
         """``update()`` compiled into a single XLA computation.
@@ -672,7 +942,7 @@ class Metric(ABC):
         if names is None:  # uninitialized ring buffer: first batch allocates eagerly
             self.update(*args, **kwargs)
             return
-        treedef, dynamic, statics = self._split_batch_args("jit_update", args, kwargs)
+        sig, treedef, dynamic, statics = self._auto_signature(args, kwargs, "jit_update")
 
         def build():
             def _pure(states, dyn):
@@ -686,8 +956,7 @@ class Metric(ABC):
         new_states = fn(states, dynamic)
         self._computed = None
         self._update_count += 1
-        for n in names:
-            object.__setattr__(self, n, new_states[n])
+        self._commit_compiled_states(names, states, new_states, sig)
 
     def scan_update(self, *args: Any, **kwargs: Any) -> None:
         """Consume a whole stacked stream of batches in one ``lax.scan``.
@@ -708,7 +977,7 @@ class Metric(ABC):
             if arr and arr[0].shape[0]:
                 self.scan_update(*rest[0], **rest[1])
             return
-        treedef, dynamic, statics = self._split_batch_args("scan_update", args, kwargs)
+        sig, treedef, dynamic, statics = self._auto_signature(args, kwargs, "scan_update")
         if not dynamic:
             raise TorchMetricsUserError("`scan_update` needs at least one array argument with a stream axis")
 
@@ -728,8 +997,7 @@ class Metric(ABC):
         new_states = fn(states, dynamic)
         self._computed = None
         self._update_count += n_steps
-        for n in names:
-            object.__setattr__(self, n, new_states[n])
+        self._commit_compiled_states(names, states, new_states, sig)
 
     def merge_state(self, incoming: Union["Metric", Dict[str, Any]]) -> None:
         """Merge another metric's (or raw state dict's) state into this one.
@@ -845,7 +1113,20 @@ class Metric(ABC):
         state = {
             k: v
             for k, v in self.__dict__.items()
-            if k not in ("update", "compute", "_update_signature", "_jit_update_fn", "_scan_update_fn")
+            if k
+            not in (
+                "update",
+                "compute",
+                "_update_signature",
+                "_jit_update_fn",
+                "_scan_update_fn",
+                "_auto_update_fn",
+                "_auto_forward_fn",
+                "_auto_sigs",
+                "_auto_fwd_sigs",
+                "_auto_cnt",
+                "_ring_count_deltas",
+            )
         }
         for attr in self._defaults:
             cur = state.get(attr)
@@ -883,6 +1164,9 @@ class Metric(ABC):
         self._update_signature = inspect.signature(self.update)
         self.update = self._wrap_update(self.update)  # type: ignore[method-assign]
         self.compute = self._wrap_compute(self.compute)  # type: ignore[method-assign]
+        self._auto_sigs = {}
+        self._auto_fwd_sigs = {}
+        self._auto_names = None
 
     def __setattr__(self, name: str, value: Any) -> None:
         """Class-flag immutability guard (reference ``metric.py:715-726``)."""

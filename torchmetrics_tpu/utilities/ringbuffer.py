@@ -161,6 +161,24 @@ class RingBuffer:
         self.data, self.valid, self.count = ring_push(self.data, self.valid, self.count, batch)
         return self
 
+    def _sync_host_count(self, host_count: Optional[int]) -> None:
+        """Restore host-side overflow bookkeeping after a traced push.
+
+        Compiled updates (``jit_update``/``scan_update``/auto-compiled
+        ``update``) push rows under trace, where the occupancy check cannot
+        run; the metric runtime re-derives the host count afterwards (one
+        readback per argument signature) and hands it back here so the
+        capacity-overflow warning still fires.
+        """
+        self._host_count = host_count
+        if host_count is not None and host_count > self.capacity and not self._warned_overflow:
+            rank_zero_warn(
+                f"RingBuffer capacity ({self.capacity}) exceeded; oldest rows are being overwritten."
+                " Increase `cat_state_capacity` if the metric should see every sample.",
+                UserWarning,
+            )
+            self._warned_overflow = True
+
     def extend(self, values: Any) -> "RingBuffer":
         """Append an iterable of batches, another :class:`RingBuffer`, or one array."""
         if isinstance(values, RingBuffer):
