@@ -4,20 +4,32 @@ Mirrors the reference strategy (SURVEY.md §4: multi-node simulated by
 multi-process gloo on CPU): here, multi-chip is simulated by
 ``--xla_force_host_platform_device_count=8`` so mesh/sharding/collective tests
 run without TPU hardware. Must run before jax is imported anywhere.
+
+On-TPU leg (round-4): setting ``TM_TPU_SUITE=1`` leaves the real accelerator
+(axon) as the default backend instead — the reference-differential and
+param-sweep suites then execute every kernel on the chip, with per-domain
+tolerance floors absorbing legitimate accumulation-order/bf16-rounding drift.
+This is the analogue of the reference's GPU CI pipeline (SURVEY §4.3); the
+driver records the result as ``TPU_SUITE_r{N}.md``.
 """
 
 import os
 
-os.environ["JAX_PLATFORMS"] = "cpu"
+TPU_SUITE = os.environ.get("TM_TPU_SUITE", "") == "1"
+
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if not TPU_SUITE:
+    os.environ["JAX_PLATFORMS"] = "cpu"
 
 import jax  # noqa: E402
 
-# the container's sitecustomize force-registers the axon TPU backend and sets
-# jax_platforms="axon,cpu"; tests must run on the virtual 8-device CPU platform
-jax.config.update("jax_platforms", "cpu")
+if not TPU_SUITE:
+    # the container's sitecustomize force-registers the axon TPU backend and
+    # sets jax_platforms="axon,cpu"; tests must run on the virtual 8-device
+    # CPU platform unless the on-TPU leg was requested
+    jax.config.update("jax_platforms", "cpu")
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
@@ -34,3 +46,49 @@ THRESHOLD = 0.5
 def _seed_numpy():
     np.random.seed(42)
     yield
+
+
+# --------------------------------------------------------------------- #
+# On-TPU tolerance policy                                                #
+# --------------------------------------------------------------------- #
+# The CPU-pinned suites assert near-bit tolerances against the torch-CPU
+# oracle. On the chip, XLA:TPU reorders accumulations and routes some f32
+# work through the MXU (bf16 operands unless precision="highest"), so the
+# same comparisons need domain-calibrated floors: conv/filterbank-heavy
+# domains drift more than scalar-reduction domains. The floors apply only
+# under TM_TPU_SUITE=1 and only RAISE tolerances (never tighten).
+
+_TPU_TOL_FLOORS = (
+    # (nodeid substring, rtol floor, atol floor) — first match wins
+    ("audio", 5e-3, 5e-3),
+    ("image", 2e-3, 2e-3),
+    ("ssim", 2e-3, 2e-3),
+    ("fid", 2e-3, 2e-3),
+    ("clustering", 1e-3, 1e-4),
+    ("text", 1e-4, 1e-5),
+    ("", 5e-4, 1e-5),  # default
+)
+_TPU_DEFAULT_FLOOR = (5e-4, 1e-5)
+
+if TPU_SUITE:
+    import numpy.testing as npt
+
+    _ORIG_ALLCLOSE = npt.assert_allclose
+    _CURRENT_FLOOR = [_TPU_DEFAULT_FLOOR]
+
+    def _floored_allclose(actual, desired, rtol=1e-07, atol=0, *args, **kwargs):
+        rf, af = _CURRENT_FLOOR[0]
+        return _ORIG_ALLCLOSE(actual, desired, max(rtol, rf), max(atol, af), *args, **kwargs)
+
+    npt.assert_allclose = _floored_allclose
+    np.testing.assert_allclose = _floored_allclose
+
+    @pytest.fixture(autouse=True)
+    def _tpu_tolerance_floor(request):
+        nodeid = request.node.nodeid.lower()
+        for key, rf, af in _TPU_TOL_FLOORS:
+            if key in nodeid:
+                _CURRENT_FLOOR[0] = (rf, af)
+                break
+        yield
+        _CURRENT_FLOOR[0] = _TPU_DEFAULT_FLOOR

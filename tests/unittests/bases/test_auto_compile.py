@@ -249,3 +249,105 @@ def test_auto_compile_sweep_matches_eager(name):
     np.testing.assert_allclose(
         np.asarray(va, dtype=np.float32), np.asarray(ve, dtype=np.float32), rtol=1e-4, atol=1e-5
     )
+
+
+class TestBootstrapperVmapped:
+    """Round-4: BootStrapper's single-XLA-call leading-axis fast path."""
+
+    def _stream(self, strategy, n_boot=16, batches=3, b=256):
+        from torchmetrics_tpu.wrappers import BootStrapper
+        from torchmetrics_tpu.classification import BinaryAccuracy
+
+        m = BootStrapper(
+            BinaryAccuracy(validate_args=False), num_bootstraps=n_boot, sampling_strategy=strategy, seed=7
+        )
+        rng = np.random.default_rng(3)
+        base = []
+        for _ in range(batches):
+            p = jnp.asarray(rng.integers(0, 2, b))
+            t = jnp.asarray(rng.integers(0, 2, b))
+            m.update(p, t)
+            base.append((p, t))
+        return m, base
+
+    @pytest.mark.parametrize("strategy", ["poisson", "multinomial"])
+    def test_fast_path_engages_and_is_statistically_sound(self, strategy):
+        from torchmetrics_tpu.classification import BinaryAccuracy
+
+        m, base = self._stream(strategy)
+        # batch 1 warms the loop path; batches 2-3 ride the vmapped stack
+        assert not m._fast_disabled and m._stacked is not None and m._stacked_pending == 2
+        out = m.compute()
+        ref = BinaryAccuracy()
+        for p, t in base:
+            ref.update(p, t)
+        true_val = float(ref.compute())
+        assert abs(float(out["mean"]) - true_val) < 0.1
+        assert 0 < float(out["std"]) < 0.2
+
+    def test_fast_path_single_dispatch_per_batch(self):
+        m, _ = self._stream("poisson")
+        # exactly one compiled executable serves every same-shape batch
+        assert len(m._fast_fns) == 1
+
+    def test_update_counts_materialize(self):
+        m, _ = self._stream("multinomial", batches=4)
+        m.compute()
+        assert all(mm._update_count == 4 for mm in m.metrics)
+
+    def test_non_sum_state_metric_falls_back(self):
+        from torchmetrics_tpu.wrappers import BootStrapper
+        from torchmetrics_tpu.regression import PearsonCorrCoef
+
+        m = BootStrapper(PearsonCorrCoef(), num_bootstraps=4, seed=0)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal(64).astype(np.float32))
+        y = jnp.asarray(0.7 * np.asarray(x) + 0.3 * rng.standard_normal(64).astype(np.float32))
+        m.update(x, y)
+        m.update(x, y)
+        assert m._fast_disabled and m._stacked is None
+        out = m.compute()
+        assert 0.3 < float(out["mean"]) < 1.0
+
+    def test_pickle_mid_stream(self):
+        m, base = self._stream("poisson")
+        m2 = pickle.loads(pickle.dumps(m))
+        m2.update(*base[0])
+        out = m2.compute()
+        assert np.isfinite(float(out["mean"]))
+
+    def test_mixed_fast_and_loop_batches(self):
+        # a shape change mid-stream drops that batch to... same-size gather is
+        # per-size compiled; different sizes each get their own executable
+        m, base = self._stream("multinomial", batches=2)
+        from torchmetrics_tpu.classification import BinaryAccuracy
+
+        rng = np.random.default_rng(9)
+        p = jnp.asarray(rng.integers(0, 2, 100))
+        t = jnp.asarray(rng.integers(0, 2, 100))
+        m.update(p, t)
+        assert len(m._fast_fns) == 2  # one per batch size
+        out = m.compute()
+        assert np.isfinite(float(out["mean"]))
+
+    def test_validate_args_true_keeps_loop_path(self):
+        from torchmetrics_tpu.wrappers import BootStrapper
+        from torchmetrics_tpu.classification import BinaryAccuracy
+
+        m = BootStrapper(BinaryAccuracy(), num_bootstraps=4, seed=0)  # validate_args default True
+        p = jnp.asarray(np.array([1, 0, 1, 0]))
+        t = jnp.asarray(np.array([1, 1, 1, 0]))
+        m.update(p, t)
+        m.update(p, t)
+        assert m._stacked is None  # never left the per-copy loop
+        bad = jnp.asarray(np.full(4, 9))
+        with pytest.raises(RuntimeError, match="Detected the following values"):
+            m.update(p, bad)
+
+    def test_reset_rewarms_loop_path(self):
+        m, base = self._stream("poisson", batches=2)
+        assert m._stacked is not None
+        m.reset()
+        assert not m._loop_warmed
+        m.update(*base[0])  # first post-reset batch is eager again
+        assert m._stacked is None and m._loop_warmed

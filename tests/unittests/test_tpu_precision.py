@@ -56,3 +56,64 @@ def test_accelerator_matches_cpu(name):
     with jax.default_device(jax.devices("cpu")[0]):
         host = np.asarray(fn(*[jnp.asarray(np.asarray(a)) for a in args]))
     np.testing.assert_allclose(accel, host, atol=5e-6, rtol=1e-5, err_msg=name)
+
+
+# --------------------------------------------------------------------- #
+# Registry-driven chip-vs-CPU consistency (round-4)                      #
+# --------------------------------------------------------------------- #
+# The precision/differentiability SPECS registry enumerates every export
+# whose kernel reduces floats through matmuls/convs/filterbanks — exactly
+# the surface where the MXU's bf16 operand rounding can silently diverge
+# from f32 (the bug class this repo hit twice; see memory + commit
+# 58f3fb2). Run each metric end-to-end (class API: update + compute) on
+# the accelerator and on the CPU backend and demand f32-level agreement —
+# kernels needing precision="highest"/segment-sum regressions surface here.
+
+from tests.unittests.test_precision_differentiability_sweep import SPECS, _seed_for  # noqa: E402
+
+# model-trunk metrics excluded: trunk precision policy is covered by the
+# dedicated trunk-equivalence tests, and a full VGG forward per backend is
+# minutes of compile for no added kernel coverage
+_TRUNK_SPECS = {"LearnedPerceptualImagePatchSimilarity"}
+
+# conv/filterbank pipelines accumulate in different orders across backends;
+# these get a looser (but still f32-scale) bound
+_LOOSE = {
+    "SignalDistortionRatio": 2e-3,
+    "ComplexScaleInvariantSignalNoiseRatio": 2e-3,
+    "MultiScaleStructuralSimilarityIndexMeasure": 2e-3,
+    "VisualInformationFidelity": 2e-3,
+    "PermutationInvariantTraining": 2e-3,
+}
+
+
+def _spec_value(name, spec):
+    import torchmetrics_tpu as tm
+
+    cls = getattr(tm, name)
+    kwargs = dict(spec.kwargs)
+    import inspect as _inspect
+
+    if "validate_args" in _inspect.signature(cls.__init__).parameters:
+        kwargs["validate_args"] = False
+    metric = cls(**kwargs)
+    _seed_for(name)
+    batch = spec.make()
+    args = tuple(
+        {k: jnp.asarray(np.asarray(v)) for k, v in x.items()} if isinstance(x, dict) else jnp.asarray(np.asarray(x))
+        for x in batch
+    )
+    metric.update(*args)
+    out = metric.compute()
+    leaves = [np.asarray(v, np.float64) for v in jax.tree_util.tree_leaves(out)]
+    return np.concatenate([leaf.ravel() for leaf in leaves])
+
+
+@pytest.mark.parametrize("name", sorted(set(SPECS) - _TRUNK_SPECS))
+def test_registry_accelerator_matches_cpu(name):
+    spec = SPECS[name]
+    accel = _spec_value(name, spec)
+    with jax.default_device(jax.devices("cpu")[0]):
+        host = _spec_value(name, spec)
+    tol = _LOOSE.get(name, 1e-4)
+    np.testing.assert_allclose(accel, host, rtol=tol, atol=tol, err_msg=name)

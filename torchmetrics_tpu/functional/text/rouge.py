@@ -14,6 +14,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torchmetrics_tpu.functional.text.helper import _lcs_tokens
 
@@ -56,46 +57,58 @@ def _compute_metrics(hits_or_lcs: float, pred_len: int, target_len: int) -> Dict
     }
 
 
-def _lcs(pred_tokens: Sequence[str], target_tokens: Sequence[str], return_full_table: bool = False):
-    """LCS length (device kernel) or full DP table (host, for union-LCS backtracking)."""
-    if not return_full_table:
-        return int(_lcs_tokens([list(pred_tokens)], [list(target_tokens)])[0])
-    table = [[0] * (len(pred_tokens) + 1) for _ in range(len(target_tokens) + 1)]
-    for i in range(1, len(target_tokens) + 1):
-        for j in range(1, len(pred_tokens) + 1):
-            if target_tokens[i - 1] == pred_tokens[j - 1]:
-                table[i][j] = table[i - 1][j - 1] + 1
-            else:
-                table[i][j] = max(table[i - 1][j], table[i][j - 1])
-    return table
+def _lcs(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> int:
+    """LCS length via the batched device kernel."""
+    return int(_lcs_tokens([list(pred_tokens)], [list(target_tokens)])[0])
 
 
-def _backtracked_lcs(
-    lcs_table: Sequence[Sequence[int]], pred_tokens: Sequence[str], target_tokens: Sequence[str]
-) -> Sequence[int]:
-    i = len(pred_tokens)
-    j = len(target_tokens)
-    backtracked: List[int] = []
-    while i > 0 and j > 0:
-        if pred_tokens[i - 1] == target_tokens[j - 1]:
-            backtracked.insert(0, j - 1)
+def _lcs_lattice(pred_ids: "np.ndarray", tgt_ids: "np.ndarray") -> "np.ndarray":
+    """``(P+1, T+1)`` LCS-length lattice, one vectorized numpy pass per row.
+
+    Prefix-max form of the LCS recurrence
+    ``M[i][j] = max(M[i-1][j], M[i][j-1], M[i-1][j-1] + eq)``: each row's
+    candidates ``max(M[i-1][j], M[i-1][j-1] + eq_j)`` vectorize across the
+    target axis, and the remaining left-to-right ``M[i][j-1]`` dependency
+    collapses to ``np.maximum.accumulate`` — no per-cell python loop (same
+    scan shape as the device kernel in ``helper._lcs_tokens``).
+    """
+    rows = np.zeros((len(pred_ids) + 1, len(tgt_ids) + 1), np.int32)
+    for i in range(1, len(pred_ids) + 1):
+        cand = rows[i - 1].copy()
+        cand[1:] = np.maximum(cand[1:], rows[i - 1, :-1] + (tgt_ids == pred_ids[i - 1]))
+        rows[i] = np.maximum.accumulate(cand)
+    return rows
+
+
+def _lcs_member_indices(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> List[int]:
+    """Target-side token indices of one canonical LCS.
+
+    ROUGE-Lsum's union-LCS depends on WHICH maximal subsequence is selected,
+    so the walk's tie preference (shrink the target side when both lattice
+    neighbors tie) is part of the spec the reference inherited from the
+    google-research rouge scorer.
+    """
+    vocab: Dict[str, int] = {}
+    pid = np.asarray([vocab.setdefault(tok, len(vocab)) for tok in pred_tokens], np.int64)
+    tid = np.asarray([vocab.setdefault(tok, len(vocab)) for tok in target_tokens], np.int64)
+    lattice = _lcs_lattice(pid, tid)
+    keep: List[int] = []
+    i, j = len(pid), len(tid)
+    while i and j:
+        if pid[i - 1] == tid[j - 1]:
+            keep.append(j - 1)
             i -= 1
             j -= 1
-        elif lcs_table[j][i - 1] > lcs_table[j - 1][i]:
+        elif lattice[i - 1, j] > lattice[i, j - 1]:
             i -= 1
         else:
             j -= 1
-    return backtracked
+    return keep[::-1]
 
 
 def _union_lcs(pred_tokens_list: Sequence[Sequence[str]], target_tokens: Sequence[str]) -> Sequence[str]:
     """Union of per-prediction-sentence LCS index sets against one target sentence."""
-
-    def lcs_ind(pred_tokens: Sequence[str]) -> Sequence[int]:
-        table = _lcs(pred_tokens, target_tokens, return_full_table=True)
-        return _backtracked_lcs(table, pred_tokens, target_tokens)
-
-    indices = sorted(set().union(*(lcs_ind(p) for p in pred_tokens_list)))
+    indices = sorted(set().union(*(_lcs_member_indices(p, target_tokens) for p in pred_tokens_list)))
     return [target_tokens[i] for i in indices]
 
 

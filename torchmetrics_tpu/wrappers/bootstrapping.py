@@ -1,9 +1,24 @@
 """BootStrapper (reference ``wrappers/bootstrapping.py:54``).
 
-TPU note: the reference keeps N deep-copies and loops them per update. The
-resampling itself (poisson/multinomial index draw) is host-side RNG either
-way; the per-copy updates here reuse the same jitted kernels, so XLA caches a
-single compilation across copies.
+TPU-first design (round-4): the reference keeps N deep-copies of the base
+metric and python-loops a resampled ``update`` per copy per batch. Here the
+default is a **vmapped fast path**: bootstrap states live as one leading-axis
+``(N, ...)`` stack, and each batch compiles to a SINGLE XLA call that
+
+1. draws the per-copy resampling *count vectors* on device
+   (``jax.random.poisson`` for the poisson strategy; scatter-added uniform
+   draws for multinomial — both exact, both static-shape, no index gather),
+2. computes per-sample state deltas once with ``jax.vmap`` over the batch,
+3. applies all N count vectors at once as an ``(N, B) @ (B, S)`` matmul in
+   ``precision=HIGHEST`` (MXU work — the N bootstrap copies cost one matmul,
+   not N python updates).
+
+This is exact (not approximate) whenever the base metric's update decomposes
+additively over samples into sum-reduced states — which the wrapper VERIFIES
+on the first batch with an on-device additivity self-check (full-batch delta
+vs summed per-sample deltas). Metrics that fail the check, carry non-sum
+states, or cannot trace fall back permanently to the reference's per-copy
+loop, which remains fully supported.
 """
 
 from __future__ import annotations
@@ -16,6 +31,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 from torchmetrics_tpu.wrappers.abstract import WrapperMetric
 
 Array = jax.Array
@@ -77,9 +93,158 @@ class BootStrapper(WrapperMetric):
             )
         self.sampling_strategy = sampling_strategy
         self._rng = np.random.default_rng(seed)
+        self._key = jax.random.PRNGKey(seed if seed is not None else int(self._rng.integers(2**31)))
+        # vmapped fast-path bookkeeping
+        self._stacked: Optional[Dict[str, Array]] = None  # name -> (N, ...) leading-axis states
+        self._stacked_pending = 0  # fast updates not yet reflected in self.metrics
+        self._fast_disabled = False
+        self._fast_checked = False  # additivity self-check passed
+        self._loop_warmed = False  # first batch runs the loop path (children validate eagerly)
+        self._fast_fns: Dict[Any, Any] = {}
 
+    # ------------------------------------------------------- vmapped fast path
+    def _fast_names(self) -> Optional[list]:
+        """Sum-reduced fixed-shape state names of the base metric, or None."""
+        template = self.metrics[0]
+        if getattr(template, "validate_args", None) is True:
+            # same rule as Metric's auto-compile: per-batch value validation
+            # is concreteness-gated and would silently stop running under
+            # trace — the vmapped path requires validate_args=False
+            return None
+        try:
+            names = template._fixed_shape_state_names("BootStrapper (vmapped path)")
+        except TorchMetricsUserError:
+            return None
+        if names is None:  # lazily-shaped states: warm up via the loop path
+            return None
+        if any(template._reductions[n] != "sum" for n in names):
+            return None
+        return names
+
+    def _build_fast_fn(self, names, treedef, statics, size: int):
+        template = self.metrics[0]
+        num = self.num_bootstraps
+        strategy = self.sampling_strategy
+        defaults = {n: jnp.asarray(template._defaults[n]) for n in names}
+
+        def _pure(stacked, dyn, key):
+            step_key, next_key = jax.random.split(key)
+            if strategy == "poisson":
+                counts = jax.random.poisson(step_key, 1.0, (num, size)).astype(jnp.float32)
+            else:  # multinomial: `size` uniform draws with replacement per copy
+                draws = jax.random.randint(step_key, (num, size), 0, size)
+                counts = jax.vmap(lambda d: jnp.zeros((size,), jnp.float32).at[d].add(1.0))(draws)
+
+            def one_sample(*leaves):
+                zeros = {n: jnp.zeros_like(defaults[n]) for n in names}
+                a, kw = Metric._merge_batch_args(treedef, [leaf[None] for leaf in leaves], statics)
+                return template._traced_update(names, zeros, a, kw)
+
+            deltas = jax.vmap(one_sample)(*dyn)  # name -> (size, ...)
+            new = {}
+            for n in names:
+                flat = deltas[n].astype(jnp.float32).reshape(size, -1)
+                # f32 operands would be bf16-rounded on the MXU by default;
+                # bootstrap counts times float deltas must stay exact-ish
+                upd = jnp.matmul(counts, flat, precision=jax.lax.Precision.HIGHEST)
+                new[n] = stacked[n] + upd.reshape((num,) + deltas[n].shape[1:]).astype(stacked[n].dtype)
+            return new, next_key
+
+        return jax.jit(_pure)
+
+    def _additivity_holds(self, names, treedef, statics, dynamic) -> bool:
+        """On-device check: update(batch) == sum over per-sample updates."""
+        template = self.metrics[0]
+        defaults = {n: jnp.asarray(template._defaults[n]) for n in names}
+
+        def full_delta(dyn):
+            zeros = {n: jnp.zeros_like(defaults[n]) for n in names}
+            a, kw = Metric._merge_batch_args(treedef, dyn, statics)
+            return template._traced_update(names, zeros, a, kw)
+
+        def summed_delta(dyn):
+            def one(*leaves):
+                zeros = {n: jnp.zeros_like(defaults[n]) for n in names}
+                a, kw = Metric._merge_batch_args(treedef, [leaf[None] for leaf in leaves], statics)
+                return template._traced_update(names, zeros, a, kw)
+
+            deltas = jax.vmap(one)(*dyn)
+            return {n: jnp.sum(deltas[n].astype(jnp.float32), axis=0) for n in names}
+
+        full, summed = jax.jit(lambda dyn: (full_delta(dyn), summed_delta(dyn)))(dynamic)
+        for n in names:
+            a = np.asarray(full[n], np.float64)
+            b = np.asarray(summed[n], np.float64)
+            if not np.allclose(a, b, rtol=1e-3, atol=1e-5):
+                return False
+        return True
+
+    def _try_fast_update(self, args: tuple, kwargs: Dict[str, Any]) -> bool:
+        if self._fast_disabled:
+            return False
+        if not self._loop_warmed:
+            # the first batch streams through the per-copy loop so the
+            # children run their value-dependent validation on real data
+            self._loop_warmed = True
+            return False
+        template = self.metrics[0]
+        names = self._fast_names()
+        if names is None:
+            self._fast_disabled = True
+            return False
+        try:
+            _sig, treedef, dynamic, statics = template._auto_signature(args, kwargs, "BootStrapper (vmapped path)")
+        except (TorchMetricsUserError, TypeError):
+            self._fast_disabled = True
+            return False
+        dims = {leaf.shape[0] if getattr(leaf, "ndim", 0) > 0 else None for leaf in dynamic}
+        if not dynamic or None in dims or len(dims) != 1:
+            self._fast_disabled = True
+            return False
+        size = dims.pop()
+        try:
+            if not self._fast_checked:
+                if not self._additivity_holds(names, treedef, statics, dynamic):
+                    self._fast_disabled = True
+                    return False
+                self._fast_checked = True
+            key = (treedef, statics, size, str(template._dtype_policy))
+            fn = self._fast_fns.get(key)
+            if fn is None:
+                fn = self._fast_fns[key] = self._build_fast_fn(names, treedef, statics, size)
+            if self._stacked is None:
+                self._stacked = {n: jnp.stack([jnp.asarray(getattr(m, n)) for m in self.metrics]) for n in names}
+            new_stacked, self._key = fn(self._stacked, dynamic, self._key)
+        except Exception:
+            self._fast_disabled = True
+            return False
+        self._stacked = new_stacked
+        self._stacked_pending += 1
+        return True
+
+    def _materialize(self) -> None:
+        """Fold leading-axis fast-path states back into the per-copy metrics."""
+        if self._stacked is None:
+            return
+        stacked, self._stacked = self._stacked, None
+        pending, self._stacked_pending = self._stacked_pending, 0
+        for idx, metric in enumerate(self.metrics):
+            for name in stacked:
+                object.__setattr__(metric, name, stacked[name][idx])
+            metric._update_count += pending
+            metric._computed = None
+
+    # ------------------------------------------------------------------- api
     def update(self, *args: Any, **kwargs: Any) -> None:
-        """Resample the batch per bootstrap copy and update each copy."""
+        """Resample the batch per bootstrap copy and update each copy.
+
+        One compiled XLA call for all N copies when the base metric's update
+        is traceable and sample-additive (see module docstring); otherwise
+        the reference's per-copy loop.
+        """
+        if self._try_fast_update(args, kwargs):
+            return
+        self._materialize()
         args_sizes = [a.shape[0] for a in args if hasattr(a, "shape") and a.ndim > 0]
         kwargs_sizes = [v.shape[0] for v in kwargs.values() if hasattr(v, "shape") and v.ndim > 0]
         if args_sizes:
@@ -101,6 +266,7 @@ class BootStrapper(WrapperMetric):
 
     def compute(self) -> Dict[str, Array]:
         """Mean/std/quantile/raw over the bootstrap distribution."""
+        self._materialize()
         computed_vals = jnp.stack([m.compute() for m in self.metrics], axis=0)
         output: Dict[str, Array] = {}
         if self.mean:
@@ -118,6 +284,25 @@ class BootStrapper(WrapperMetric):
         return self.compute()
 
     def reset(self) -> None:
+        self._stacked = None
+        self._stacked_pending = 0
+        self._loop_warmed = False  # next stream's first batch re-warms eagerly
         for m in self.metrics:
             m.reset()
         super().reset()
+
+    # ----------------------------------------------------------- persistence
+    def __getstate__(self) -> Dict[str, Any]:
+        self._materialize()
+        state = super().__getstate__()
+        for drop in ("_fast_fns", "_key", "_stacked"):
+            state.pop(drop, None)
+        return state
+
+    def __setstate__(self, state: Dict[str, Any]) -> None:
+        super().__setstate__(state)
+        self._fast_fns = {}
+        self._stacked = None
+        self._stacked_pending = 0
+        self._loop_warmed = False
+        self._key = jax.random.PRNGKey(int(self._rng.integers(2**31)))
