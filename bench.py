@@ -1048,7 +1048,7 @@ def _parse_bench_artifact(path: str):
 
 _README_LABELS = {
     "multiclass_accuracy_updates_per_sec": ("Fused-scan streaming accuracy", "{v:,.0f} updates/s"),
-    "class_api_updates_per_sec": ("Class API `update()` (default path)", "{v:,.0f} updates/s"),
+    "class_api_updates_per_sec": ("Class API `update()`", "{v:,.0f} updates/s"),
     "class_api_jit_updates_per_sec": ("Class API `jit_update()`", "{v:,.0f} updates/s"),
     "class_api_forward_per_sec": ("Class API `forward()` dual-mode", "{v:,.0f} forwards/s"),
     "map_compute_wallclock_100k_boxes": ("mAP `compute()` @100k boxes", "{v:.0f} ms"),

@@ -351,3 +351,53 @@ class TestBootstrapperVmapped:
         assert not m._loop_warmed
         m.update(*base[0])  # first post-reset batch is eager again
         assert m._stacked is None and m._loop_warmed
+
+
+def test_tree_merge_of_none_reduce_states():
+    """Pairwise/tree-shaped merge_state chains on gather-mode (None) states:
+    both sides may already be stacked collections."""
+    from torchmetrics_tpu.regression import PearsonCorrCoef
+
+    rng = np.random.default_rng(2)
+    shards = []
+    for _ in range(4):
+        x = rng.standard_normal(64).astype(np.float32)
+        y = (0.7 * x + 0.3 * rng.standard_normal(64)).astype(np.float32)
+        m = PearsonCorrCoef()
+        m.update(jnp.asarray(x), jnp.asarray(y))
+        shards.append((m, x, y))
+    a, b, c, d = (s[0] for s in shards)
+    a.merge_state(b)
+    c.merge_state(d)
+    a.merge_state(c)  # stacked-into-stacked
+    ref = PearsonCorrCoef()
+    ref.update(
+        jnp.asarray(np.concatenate([s[1] for s in shards])),
+        jnp.asarray(np.concatenate([s[2] for s in shards])),
+    )
+    np.testing.assert_allclose(float(a.compute()), float(ref.compute()), rtol=1e-5)
+
+
+def test_bootstrapper_checkpoint_resumes_resampling_stream():
+    """A seeded BootStrapper run that pickles mid-stream must produce the
+    same bootstrap statistics as the uninterrupted run."""
+    from torchmetrics_tpu.wrappers import BootStrapper
+    from torchmetrics_tpu.classification import BinaryAccuracy
+
+    rng = np.random.default_rng(8)
+    batches = [
+        (jnp.asarray(rng.integers(0, 2, 64)), jnp.asarray(rng.integers(0, 2, 64)))
+        for _ in range(6)
+    ]
+    straight = BootStrapper(BinaryAccuracy(validate_args=False), num_bootstraps=8, seed=3)
+    for p, t in batches:
+        straight.update(p, t)
+    resumed = BootStrapper(BinaryAccuracy(validate_args=False), num_bootstraps=8, seed=3)
+    for p, t in batches[:3]:
+        resumed.update(p, t)
+    resumed = pickle.loads(pickle.dumps(resumed))
+    for p, t in batches[3:]:
+        resumed.update(p, t)
+    a, b = straight.compute(), resumed.compute()
+    np.testing.assert_allclose(float(a["mean"]), float(b["mean"]), rtol=1e-6)
+    np.testing.assert_allclose(float(a["std"]), float(b["std"]), rtol=1e-6)

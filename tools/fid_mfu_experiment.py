@@ -1,0 +1,72 @@
+"""FID trunk MFU experiments (round-4, VERDICT r3 item #4).
+
+Sweeps batch size and measures achieved FLOP/s vs the v5e bf16 peak using
+XLA's own cost analysis, to locate the InceptionV3 trunk's utilization
+ceiling. Run on the real chip: ``python tools/fid_mfu_experiment.py``.
+"""
+
+import os
+import sys
+import time
+import warnings
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK = 394e12  # v5e bf16
+
+
+def _rtt() -> float:
+    f = jax.jit(lambda x: x + 1.0)
+    float(f(jnp.zeros(())))
+    ts = []
+    for _ in range(7):
+        t0 = time.perf_counter()
+        float(f(jnp.zeros(())))
+        ts.append(time.perf_counter() - t0)
+    return sorted(ts)[len(ts) // 2]
+
+
+def bench(ext, batch, stream=16, reps=3):
+    imgs = jnp.asarray(np.random.default_rng(0).integers(0, 255, (batch, 3, 299, 299)), jnp.uint8)
+
+    def step():
+        acc = jnp.zeros(())
+        for _ in range(stream):
+            feats = ext(imgs)
+            acc = acc + jnp.sum(feats.T @ feats) + jnp.sum(feats)
+        return float(acc)
+
+    step()
+    times = []
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        step()
+        times.append(time.perf_counter() - t0)
+    dt = max(min(times) - _rtt(), 1e-6)
+    rate = batch * stream / dt
+    cost = ext._forward.lower(ext.variables, imgs).compile().cost_analysis()
+    flops = float(cost.get("flops", 0.0))
+    mfu = (rate / batch) * flops / PEAK
+    return rate, mfu, flops
+
+
+def main():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
+
+        for batch in (128, 256, 512):
+            ext = InceptionFeatureExtractor(feature="2048")
+            rate, mfu, flops = bench(ext, batch)
+            print(
+                f"batch={batch:4d}  imgs/s={rate:9.1f}  MFU={mfu:6.1%}"
+                f"  flops/img={flops / batch / 1e9:.2f} GF"
+            )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,165 @@
+"""Freeze the golden-value regression pack.
+
+Evaluates every :mod:`tests.helpers.golden_specs` spec — with the REFERENCE
+package on torch CPU for ``source="ref"`` specs, with OUR functionals for
+``source="self"`` specs (reference unrunnable offline) — and writes the
+flattened outputs to ``tests/goldens/goldens.npz`` plus a human-readable
+manifest. Run from the repo root:
+
+    python tools/make_goldens.py
+
+Idempotent given the same reference snapshot; regenerate only when specs
+change (the test suite consumes the committed pack and never regenerates).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import warnings
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+from tests.helpers.golden_specs import EXEMPT, SPECS  # noqa: E402
+
+OUT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "tests", "goldens")
+
+
+def _flatten_output(out) -> list:
+    """Deterministic flatten of arbitrary metric output to numpy leaves."""
+    if isinstance(out, dict):
+        leaves = []
+        for key in sorted(out):
+            leaves.extend(_flatten_output(out[key]))
+        return leaves
+    if isinstance(out, (list, tuple)):
+        leaves = []
+        for item in out:
+            leaves.extend(_flatten_output(item))
+        return leaves
+    try:
+        import torch
+
+        if torch.is_tensor(out):
+            return [out.detach().cpu().numpy()]
+    except ImportError:
+        pass
+    return [np.asarray(out)]
+
+
+def _ref_functional(name: str):
+    import torchmetrics.functional as RF
+    import torchmetrics.functional.audio  # noqa: F401
+    import torchmetrics.functional.classification  # noqa: F401
+    import torchmetrics.functional.clustering  # noqa: F401
+    import torchmetrics.functional.detection  # noqa: F401
+    import torchmetrics.functional.image  # noqa: F401
+    import torchmetrics.functional.nominal  # noqa: F401
+    import torchmetrics.functional.pairwise  # noqa: F401
+    import torchmetrics.functional.regression  # noqa: F401
+    import torchmetrics.functional.retrieval  # noqa: F401
+    import torchmetrics.functional.text  # noqa: F401
+    from torchmetrics.functional.clustering import utils as _cl_utils
+
+    for mod in (
+        RF, RF.classification, RF.regression, RF.clustering, _cl_utils, RF.nominal, RF.audio,
+        RF.image, RF.pairwise, RF.retrieval, RF.detection, RF.text,
+    ):
+        if hasattr(mod, name):
+            return getattr(mod, name)
+    raise AttributeError(f"reference has no functional {name!r}")
+
+
+def _to_torch(x):
+    import torch
+
+    if isinstance(x, np.ndarray):
+        return torch.as_tensor(x)
+    if isinstance(x, dict):
+        return {k: _to_torch(v) for k, v in x.items()}
+    if isinstance(x, list):
+        return [_to_torch(v) for v in x]
+    return x
+
+
+def _to_jnp(x):
+    import jax.numpy as jnp
+
+    if isinstance(x, np.ndarray):
+        return jnp.asarray(x)
+    if isinstance(x, dict):
+        return {k: _to_jnp(v) for k, v in x.items()}
+    if isinstance(x, list) and x and isinstance(x[0], np.ndarray):
+        return [_to_jnp(v) for v in x]
+    return x
+
+
+def main() -> None:
+    sys.path.insert(0, os.path.join(os.path.dirname(os.path.abspath(__file__)), ".."))
+    from tests.helpers.reference_oracle import load_reference
+
+    torchmetrics = load_reference()
+    import torchmetrics_tpu.functional as F
+
+    arrays: dict = {}
+    manifest: dict = {"cases": [], "exempt": EXEMPT}
+    failures = []
+    for idx, spec in enumerate(SPECS):
+        case_id = f"{idx:03d}_{spec.fn}"
+        args = spec.make()
+        kwargs = dict(spec.kwargs)
+        metric_func_name = kwargs.pop("__metric_func", None)
+        try:
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                if spec.source == "ref":
+                    if torchmetrics is None:
+                        raise RuntimeError("reference checkout unavailable")
+                    fn = _ref_functional(spec.ref_fn or spec.fn)
+                    if metric_func_name:
+                        kwargs["metric_func"] = _ref_functional(metric_func_name)
+                    out = fn(*[_to_torch(a) for a in args], **kwargs)
+                else:
+                    fn = getattr(F, spec.fn)
+                    if metric_func_name:
+                        kwargs["metric_func"] = getattr(F, metric_func_name)
+                    out = fn(*[_to_jnp(a) for a in args], **kwargs)
+            leaves = _flatten_output(out)
+        except Exception as err:  # noqa: BLE001
+            failures.append((case_id, repr(err)))
+            continue
+        for li, leaf in enumerate(leaves):
+            arrays[f"{case_id}/{li}"] = np.asarray(leaf)
+        manifest["cases"].append(
+            {
+                "id": case_id,
+                "fn": spec.fn,
+                "kwargs": {k: repr(v) for k, v in kwargs.items() if not callable(v)},
+                "source": spec.source,
+                "atol": spec.atol,
+                "n_leaves": len(leaves),
+            }
+        )
+
+    os.makedirs(OUT_DIR, exist_ok=True)
+    np.savez_compressed(os.path.join(OUT_DIR, "goldens.npz"), **arrays)
+    with open(os.path.join(OUT_DIR, "manifest.json"), "w") as fh:
+        json.dump(manifest, fh, indent=1, sort_keys=True)
+    print(f"froze {len(manifest['cases'])} cases, {len(arrays)} leaves -> {OUT_DIR}")
+    if failures:
+        print("FAILED cases (not frozen):")
+        for cid, err in failures:
+            print(f"  {cid}: {err}")
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

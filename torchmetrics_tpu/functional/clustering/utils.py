@@ -77,8 +77,13 @@ def calculate_pair_cluster_confusion_matrix(
     sum_cols = contingency.sum(axis=0)
     sum_squared = jnp.sum(contingency**2)
     n11 = sum_squared - n
-    n10 = jnp.sum(sum_rows**2) - sum_squared
-    n01 = jnp.sum(sum_cols**2) - sum_squared
+    # off-diagonal orientation matches sklearn's pair_confusion_matrix (and
+    # the reference): [0,1] comes from the contingency ROW marginals, [1,0]
+    # from the COLUMN marginals — pinned by the golden pack (the entries
+    # were once swapped; symmetric downstream consumers like the Rand
+    # scores masked it)
+    n01 = jnp.sum(sum_rows**2) - sum_squared
+    n10 = jnp.sum(sum_cols**2) - sum_squared
     n00 = n**2 - n11 - n10 - n01 - n
     return jnp.array([[n00, n01], [n10, n11]])
 

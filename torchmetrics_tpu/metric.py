@@ -351,7 +351,26 @@ class Metric(ABC):
             elif (reduce_fn == "cat" or reduce_fn is None) and isinstance(global_state, list):
                 reduced = global_state + list(local_state)
             elif reduce_fn is None and _is_array(global_state):
-                reduced = jnp.stack([global_state, local_state])
+                default = self._defaults.get(attr)
+
+                def _stacked(v: Any) -> bool:
+                    # a (k, *default_shape) collection produced by earlier
+                    # merges, as opposed to a plain state value
+                    return (
+                        _is_array(default)
+                        and getattr(v, "ndim", 0) == getattr(default, "ndim", 0) + 1
+                        and tuple(v.shape[1:]) == tuple(default.shape)
+                    )
+
+                if _stacked(global_state) or _stacked(local_state):
+                    # chained/tree merges: either side may already be stacked
+                    # (N-replica merge_state chains, pairwise shard reduces);
+                    # normalize both to (k, ...) and concatenate
+                    g = global_state if _stacked(global_state) else global_state[None]
+                    loc = local_state if _stacked(local_state) else local_state[None]
+                    reduced = jnp.concatenate([g, loc])
+                else:
+                    reduced = jnp.stack([global_state, local_state])
             elif reduce_fn == "cat" and _is_array(global_state):
                 reduced = jnp.concatenate([jnp.atleast_1d(global_state), jnp.atleast_1d(local_state)])
             elif callable(reduce_fn):
@@ -835,7 +854,7 @@ class Metric(ABC):
         try:
             sig, treedef, dynamic, statics = self._auto_signature(args, kwargs)
         except (TorchMetricsUserError, TypeError):
-            self._auto_disabled = True
+            self._auto_forward_disabled = True
             return False, None
         if not dynamic:
             return False, None
@@ -848,7 +867,7 @@ class Metric(ABC):
         try:
             names = self._auto_state_names("forward")
         except TorchMetricsUserError:
-            self._auto_disabled = True
+            self._auto_forward_disabled = True
             return False, None
         if names is None or not self._auto_forward_mergeable(names):
             self._auto_forward_disabled = True

@@ -295,8 +295,11 @@ class BootStrapper(WrapperMetric):
     def __getstate__(self) -> Dict[str, Any]:
         self._materialize()
         state = super().__getstate__()
-        for drop in ("_fast_fns", "_key", "_stacked"):
+        for drop in ("_fast_fns", "_stacked"):
             state.pop(drop, None)
+        # the resampling key rides along so a checkpointed seeded run resumes
+        # the exact bootstrap stream it would have drawn uninterrupted
+        state["_key"] = np.asarray(state["_key"])
         return state
 
     def __setstate__(self, state: Dict[str, Any]) -> None:
@@ -304,5 +307,4 @@ class BootStrapper(WrapperMetric):
         self._fast_fns = {}
         self._stacked = None
         self._stacked_pending = 0
-        self._loop_warmed = False
-        self._key = jax.random.PRNGKey(int(self._rng.integers(2**31)))
+        self._key = jnp.asarray(self._key)
