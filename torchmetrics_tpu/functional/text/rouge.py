@@ -44,17 +44,20 @@ def _split_sentence(x: str) -> Sequence[str]:
     return [s for s in parts if s]
 
 
-def _compute_metrics(hits_or_lcs: float, pred_len: int, target_len: int) -> Dict[str, Array]:
+def _compute_metrics(hits_or_lcs: float, pred_len: int, target_len: int) -> Dict[str, float]:
+    """Per-sample P/R/F as host floats.
+
+    Per-sample scalars stay on the host: pushing thousands of 0-d arrays to
+    the device per corpus (3 values x keys x samples) costs a transfer each
+    and throttled the whole metric to single-digit samples/sec through a
+    device tunnel. Only the final corpus aggregation touches the device.
+    """
     precision = hits_or_lcs / pred_len
     recall = hits_or_lcs / target_len
     if precision == recall == 0.0:
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
     fmeasure = 2 * precision * recall / (precision + recall)
-    return {
-        "precision": jnp.asarray(precision),
-        "recall": jnp.asarray(recall),
-        "fmeasure": jnp.asarray(fmeasure),
-    }
+    return {"precision": precision, "recall": recall, "fmeasure": fmeasure}
 
 
 def _lcs(pred_tokens: Sequence[str], target_tokens: Sequence[str]) -> int:
@@ -132,7 +135,7 @@ def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> D
     pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
     pred_len, target_len = sum(pred_ngrams.values()), sum(target_ngrams.values())
     if 0 in (pred_len, target_len):
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
     hits = sum(min(pred_ngrams[w], target_ngrams[w]) for w in set(pred_ngrams))
     return _compute_metrics(hits, max(pred_len, 1), max(target_len, 1))
 
@@ -142,7 +145,7 @@ def _rouge_l_score(
 ) -> Dict[str, Array]:
     pred_len, target_len = len(pred), len(target)
     if 0 in (pred_len, target_len):
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
     lcs = precomputed_lcs if precomputed_lcs is not None else _lcs(pred, target)
     return _compute_metrics(lcs, pred_len, target_len)
 
@@ -151,7 +154,7 @@ def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[s
     pred_len = sum(map(len, pred))
     target_len = sum(map(len, target))
     if 0 in (pred_len, target_len):
-        return {"precision": jnp.asarray(0.0), "recall": jnp.asarray(0.0), "fmeasure": jnp.asarray(0.0)}
+        return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
 
     def _get_token_counts(sentences: Sequence[Sequence[str]]) -> Counter:
         counts: Counter = Counter()
@@ -187,6 +190,12 @@ def _rouge_score_update(
     """
     results: Dict[Union[int, str], List[Dict[str, Array]]] = {key: [] for key in rouge_keys_values}
 
+    # tokenize each text exactly once
+    pred_toks = [_normalize_and_tokenize_text(p, stemmer, normalizer, tokenizer) for p in preds]
+    tgt_toks = [
+        [_normalize_and_tokenize_text(t, stemmer, normalizer, tokenizer) for t in refs] for refs in target
+    ]
+
     # Batch every (pred, ref) ROUGE-L pair into ONE device kernel launch up
     # front instead of a blocking batch-of-1 launch per pair in the loop.
     lcs_cache: Dict[Tuple[int, int], float] = {}
@@ -194,23 +203,24 @@ def _rouge_score_update(
         pair_index: List[Tuple[int, int]] = []
         pair_preds: List[Sequence[str]] = []
         pair_tgts: List[Sequence[str]] = []
-        for i, (pred_raw, target_raw) in enumerate(zip(preds, target)):
-            pred_tok = _normalize_and_tokenize_text(pred_raw, stemmer, normalizer, tokenizer)
-            for j, tgt_raw in enumerate(target_raw):
-                tgt_tok = _normalize_and_tokenize_text(tgt_raw, stemmer, normalizer, tokenizer)
+        # zip: mismatched pred/target lengths truncate (matching the main loop)
+        for i, (pred_tok, refs) in enumerate(zip(pred_toks, tgt_toks)):
+            for j, tgt_tok in enumerate(refs):
                 if len(pred_tok) and len(tgt_tok):
                     pair_index.append((i, j))
                     pair_preds.append(pred_tok)
                     pair_tgts.append(tgt_tok)
         if pair_preds:
-            lengths = _lcs_tokens(pair_preds, pair_tgts)
+            # ONE host readback for the whole corpus — float() per element
+            # would pay a device round-trip per pair
+            lengths = np.asarray(_lcs_tokens(pair_preds, pair_tgts))
             lcs_cache = {key: float(val) for key, val in zip(pair_index, lengths)}
 
     for i_sample, (pred_raw, target_raw) in enumerate(zip(preds, target)):
         result_inner: Dict[Union[int, str], Dict[str, Array]] = {}
         result_avg: Dict[Union[int, str], List[Dict[str, Array]]] = {key: [] for key in rouge_keys_values}
         list_results = []
-        pred = _normalize_and_tokenize_text(pred_raw, stemmer, normalizer, tokenizer)
+        pred = pred_toks[i_sample]
         pred_lsum = (
             [_normalize_and_tokenize_text(s, stemmer, normalizer, tokenizer) for s in _split_sentence(pred_raw)]
             if "Lsum" in rouge_keys_values
@@ -218,7 +228,7 @@ def _rouge_score_update(
         )
 
         for j_ref, target_raw_inner in enumerate(target_raw):
-            tgt = _normalize_and_tokenize_text(target_raw_inner, stemmer, normalizer, tokenizer)
+            tgt = tgt_toks[i_sample][j_ref]
             tgt_lsum = (
                 [_normalize_and_tokenize_text(s, stemmer, normalizer, tokenizer) for s in _split_sentence(target_raw_inner)]
                 if "Lsum" in rouge_keys_values
@@ -241,22 +251,23 @@ def _rouge_score_update(
             highest_idx = int(max(range(len(all_fmeasure)), key=all_fmeasure.__getitem__))
             for rouge_key in rouge_keys_values:
                 results[rouge_key].append(list_results[highest_idx][rouge_key])
-        else:  # "avg"
+        else:  # "avg" — host-float mean, same no-per-sample-transfer rule
             for rouge_key in rouge_keys_values:
                 scores = result_avg[rouge_key]
                 mean_score = {
-                    stat: jnp.mean(jnp.stack([s[stat] for s in scores])) for stat in ("precision", "recall", "fmeasure")
+                    stat: sum(float(s[stat]) for s in scores) / len(scores)
+                    for stat in ("precision", "recall", "fmeasure")
                 }
                 results[rouge_key].append(mean_score)
 
     return results
 
 
-def _rouge_score_compute(sentence_results: Dict[str, List[Array]]) -> Dict[str, Array]:
+def _rouge_score_compute(sentence_results: Dict[str, Any]) -> Dict[str, Array]:
     output: Dict[str, Array] = {}
     for rouge_key, scores in sentence_results.items():
         if isinstance(scores, list) and len(scores) > 0:
-            output[rouge_key] = jnp.mean(jnp.stack(scores))
+            output[rouge_key] = jnp.asarray(float(np.mean([float(v) for v in scores])))
         elif isinstance(scores, list):
             output[rouge_key] = jnp.asarray(0.0)
         else:

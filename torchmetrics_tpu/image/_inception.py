@@ -37,14 +37,16 @@ class BasicConv2d(nn.Module):
     strides: Sequence[int] = (1, 1)
     padding: Any = "VALID"
     dtype: Any = jnp.float32  # compute dtype; params stay float32
+    fuse_bn: bool = False  # inference-mode BN folded into the conv (see fold_batchnorm)
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
         x = nn.Conv(
-            self.out_channels, self.kernel_size, self.strides, padding=self.padding, use_bias=False,
-            dtype=self.dtype, precision=_mxu_precision(self.dtype),
+            self.out_channels, self.kernel_size, self.strides, padding=self.padding,
+            use_bias=self.fuse_bn, dtype=self.dtype, precision=_mxu_precision(self.dtype),
         )(x)
-        x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9, dtype=self.dtype)(x)
+        if not self.fuse_bn:
+            x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9, dtype=self.dtype)(x)
         return nn.relu(x)
 
 
@@ -56,28 +58,30 @@ def _pad(k: int) -> Any:
 class InceptionA(nn.Module):
     pool_features: int
     dtype: Any = jnp.float32
+    fuse_bn: bool = False
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        b1 = BasicConv2d(64, (1, 1), dtype=self.dtype)(x)
-        b5 = BasicConv2d(48, (1, 1), dtype=self.dtype)(x)
-        b5 = BasicConv2d(64, (5, 5), padding=_pad(5), dtype=self.dtype)(b5)
-        b3 = BasicConv2d(64, (1, 1), dtype=self.dtype)(x)
-        b3 = BasicConv2d(96, (3, 3), padding=_pad(3), dtype=self.dtype)(b3)
-        b3 = BasicConv2d(96, (3, 3), padding=_pad(3), dtype=self.dtype)(b3)
+        b1 = BasicConv2d(64, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        b5 = BasicConv2d(48, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        b5 = BasicConv2d(64, (5, 5), padding=_pad(5), dtype=self.dtype, fuse_bn=self.fuse_bn)(b5)
+        b3 = BasicConv2d(64, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        b3 = BasicConv2d(96, (3, 3), padding=_pad(3), dtype=self.dtype, fuse_bn=self.fuse_bn)(b3)
+        b3 = BasicConv2d(96, (3, 3), padding=_pad(3), dtype=self.dtype, fuse_bn=self.fuse_bn)(b3)
         bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=_pad(3), count_include_pad=False)
-        bp = BasicConv2d(self.pool_features, (1, 1), dtype=self.dtype)(bp)
+        bp = BasicConv2d(self.pool_features, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(bp)
         return jnp.concatenate([b1, b5, b3, bp], axis=-1)
 
 
 class InceptionB(nn.Module):
     dtype: Any = jnp.float32
+    fuse_bn: bool = False
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        b3 = BasicConv2d(384, (3, 3), strides=(2, 2), dtype=self.dtype)(x)
-        bd = BasicConv2d(64, (1, 1), dtype=self.dtype)(x)
-        bd = BasicConv2d(96, (3, 3), padding=_pad(3), dtype=self.dtype)(bd)
-        bd = BasicConv2d(96, (3, 3), strides=(2, 2), dtype=self.dtype)(bd)
+        b3 = BasicConv2d(384, (3, 3), strides=(2, 2), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        bd = BasicConv2d(64, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        bd = BasicConv2d(96, (3, 3), padding=_pad(3), dtype=self.dtype, fuse_bn=self.fuse_bn)(bd)
+        bd = BasicConv2d(96, (3, 3), strides=(2, 2), dtype=self.dtype, fuse_bn=self.fuse_bn)(bd)
         bp = nn.max_pool(x, (3, 3), strides=(2, 2))
         return jnp.concatenate([b3, bd, bp], axis=-1)
 
@@ -85,34 +89,36 @@ class InceptionB(nn.Module):
 class InceptionC(nn.Module):
     channels_7x7: int
     dtype: Any = jnp.float32
+    fuse_bn: bool = False
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
         c7 = self.channels_7x7
-        b1 = BasicConv2d(192, (1, 1), dtype=self.dtype)(x)
-        b7 = BasicConv2d(c7, (1, 1), dtype=self.dtype)(x)
-        b7 = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype)(b7)
-        b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype)(b7)
-        bd = BasicConv2d(c7, (1, 1), dtype=self.dtype)(x)
-        bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype)(bd)
-        bd = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype)(bd)
-        bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype)(bd)
-        bd = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype)(bd)
+        b1 = BasicConv2d(192, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        b7 = BasicConv2d(c7, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        b7 = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype, fuse_bn=self.fuse_bn)(b7)
+        b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype, fuse_bn=self.fuse_bn)(b7)
+        bd = BasicConv2d(c7, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype, fuse_bn=self.fuse_bn)(bd)
+        bd = BasicConv2d(c7, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype, fuse_bn=self.fuse_bn)(bd)
+        bd = BasicConv2d(c7, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype, fuse_bn=self.fuse_bn)(bd)
+        bd = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype, fuse_bn=self.fuse_bn)(bd)
         bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=_pad(3), count_include_pad=False)
-        bp = BasicConv2d(192, (1, 1), dtype=self.dtype)(bp)
+        bp = BasicConv2d(192, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(bp)
         return jnp.concatenate([b1, b7, bd, bp], axis=-1)
 
 
 class InceptionD(nn.Module):
     dtype: Any = jnp.float32
+    fuse_bn: bool = False
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        b3 = BasicConv2d(192, (1, 1), dtype=self.dtype)(x)
-        b3 = BasicConv2d(320, (3, 3), strides=(2, 2), dtype=self.dtype)(b3)
-        b7 = BasicConv2d(192, (1, 1), dtype=self.dtype)(x)
-        b7 = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype)(b7)
-        b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype)(b7)
-        b7 = BasicConv2d(192, (3, 3), strides=(2, 2), dtype=self.dtype)(b7)
+        b3 = BasicConv2d(192, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        b3 = BasicConv2d(320, (3, 3), strides=(2, 2), dtype=self.dtype, fuse_bn=self.fuse_bn)(b3)
+        b7 = BasicConv2d(192, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        b7 = BasicConv2d(192, (1, 7), padding=((0, 0), (3, 3)), dtype=self.dtype, fuse_bn=self.fuse_bn)(b7)
+        b7 = BasicConv2d(192, (7, 1), padding=((3, 3), (0, 0)), dtype=self.dtype, fuse_bn=self.fuse_bn)(b7)
+        b7 = BasicConv2d(192, (3, 3), strides=(2, 2), dtype=self.dtype, fuse_bn=self.fuse_bn)(b7)
         bp = nn.max_pool(x, (3, 3), strides=(2, 2))
         return jnp.concatenate([b3, b7, bp], axis=-1)
 
@@ -120,24 +126,25 @@ class InceptionD(nn.Module):
 class InceptionE(nn.Module):
     pool_type: str = "avg"  # FID variant uses max pooling in the last block
     dtype: Any = jnp.float32
+    fuse_bn: bool = False
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
-        b1 = BasicConv2d(320, (1, 1), dtype=self.dtype)(x)
-        b3 = BasicConv2d(384, (1, 1), dtype=self.dtype)(x)
-        b3a = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)), dtype=self.dtype)(b3)
-        b3b = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)), dtype=self.dtype)(b3)
+        b1 = BasicConv2d(320, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        b3 = BasicConv2d(384, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        b3a = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)), dtype=self.dtype, fuse_bn=self.fuse_bn)(b3)
+        b3b = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)), dtype=self.dtype, fuse_bn=self.fuse_bn)(b3)
         b3 = jnp.concatenate([b3a, b3b], axis=-1)
-        bd = BasicConv2d(448, (1, 1), dtype=self.dtype)(x)
-        bd = BasicConv2d(384, (3, 3), padding=_pad(3), dtype=self.dtype)(bd)
-        bda = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)), dtype=self.dtype)(bd)
-        bdb = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)), dtype=self.dtype)(bd)
+        bd = BasicConv2d(448, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        bd = BasicConv2d(384, (3, 3), padding=_pad(3), dtype=self.dtype, fuse_bn=self.fuse_bn)(bd)
+        bda = BasicConv2d(384, (1, 3), padding=((0, 0), (1, 1)), dtype=self.dtype, fuse_bn=self.fuse_bn)(bd)
+        bdb = BasicConv2d(384, (3, 1), padding=((1, 1), (0, 0)), dtype=self.dtype, fuse_bn=self.fuse_bn)(bd)
         bd = jnp.concatenate([bda, bdb], axis=-1)
         if self.pool_type == "avg":
             bp = nn.avg_pool(x, (3, 3), strides=(1, 1), padding=_pad(3), count_include_pad=False)
         else:
             bp = nn.max_pool(x, (3, 3), strides=(1, 1), padding=_pad(3))
-        bp = BasicConv2d(192, (1, 1), dtype=self.dtype)(bp)
+        bp = BasicConv2d(192, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(bp)
         return jnp.concatenate([b1, b3, bd, bp], axis=-1)
 
 
@@ -146,32 +153,33 @@ class InceptionV3(nn.Module):
 
     num_classes: int = 1008
     dtype: Any = jnp.float32
+    fuse_bn: bool = False
 
     @nn.compact
     def __call__(self, x: Array) -> Dict[str, Array]:
         # x: (N, H, W, 3), float in [-1, 1] (TF preprocessing)
         out = {}
-        x = BasicConv2d(32, (3, 3), strides=(2, 2), dtype=self.dtype)(x)
-        x = BasicConv2d(32, (3, 3), dtype=self.dtype)(x)
-        x = BasicConv2d(64, (3, 3), padding=_pad(3), dtype=self.dtype)(x)
+        x = BasicConv2d(32, (3, 3), strides=(2, 2), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = BasicConv2d(32, (3, 3), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = BasicConv2d(64, (3, 3), padding=_pad(3), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
         out["64"] = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
-        x = BasicConv2d(80, (1, 1), dtype=self.dtype)(x)
-        x = BasicConv2d(192, (3, 3), dtype=self.dtype)(x)
+        x = BasicConv2d(80, (1, 1), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = BasicConv2d(192, (3, 3), dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
         out["192"] = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
-        x = InceptionA(pool_features=32, dtype=self.dtype)(x)
-        x = InceptionA(pool_features=64, dtype=self.dtype)(x)
-        x = InceptionA(pool_features=64, dtype=self.dtype)(x)
-        x = InceptionB(dtype=self.dtype)(x)
-        x = InceptionC(channels_7x7=128, dtype=self.dtype)(x)
-        x = InceptionC(channels_7x7=160, dtype=self.dtype)(x)
-        x = InceptionC(channels_7x7=160, dtype=self.dtype)(x)
-        x = InceptionC(channels_7x7=192, dtype=self.dtype)(x)
+        x = InceptionA(pool_features=32, dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = InceptionA(pool_features=64, dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = InceptionA(pool_features=64, dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = InceptionB(dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = InceptionC(channels_7x7=128, dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = InceptionC(channels_7x7=160, dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = InceptionC(channels_7x7=160, dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = InceptionC(channels_7x7=192, dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
         out["768"] = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
-        x = InceptionD(dtype=self.dtype)(x)
-        x = InceptionE(pool_type="avg", dtype=self.dtype)(x)
-        x = InceptionE(pool_type="max", dtype=self.dtype)(x)
+        x = InceptionD(dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = InceptionE(pool_type="avg", dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
+        x = InceptionE(pool_type="max", dtype=self.dtype, fuse_bn=self.fuse_bn)(x)
         pooled = jnp.mean(x.astype(jnp.float32), axis=(1, 2))
         out["2048"] = pooled
         out["logits_unbiased"] = nn.Dense(self.num_classes, use_bias=False, name="fc", precision="highest")(pooled)
@@ -208,6 +216,45 @@ def load_variables_npz(path: str):
         merged.update(tree)
         collections["params"] = merged
     return collections
+
+
+def fold_batchnorm(variables: Dict[str, Any], epsilon: float = 1e-3) -> Dict[str, Any]:
+    """Fold inference-mode BatchNorm into each preceding conv's kernel/bias.
+
+    ``conv(x) @ W`` followed by ``(y - mean) * gamma / sqrt(var + eps) + beta``
+    is exactly ``conv(x) @ (W * m) + (beta - mean * m)`` with
+    ``m = gamma / sqrt(var + eps)`` — the standard inference-time fusion. It
+    removes every BatchNorm op from the graph (measured 8.0k -> 10.9k
+    imgs/s at batch 128 on v5e; ``tools/fid_mfu_experiment.py``) and is
+    numerically equivalent in f32.
+
+    Input: variables in the unfused layout (``params`` with Conv_0 +
+    BatchNorm_0 per BasicConv2d, plus ``batch_stats``). Output: ``params``
+    for the ``fuse_bn=True`` module tree (conv bias, no BN, no batch_stats).
+    """
+    stats = variables.get("batch_stats", {})
+
+    def walk(params: Dict[str, Any], node_stats: Dict[str, Any]) -> Dict[str, Any]:
+        if "Conv_0" in params and "BatchNorm_0" in params:  # a BasicConv2d
+            kernel = jnp.asarray(params["Conv_0"]["kernel"])
+            bn = params["BatchNorm_0"]
+            st = node_stats["BatchNorm_0"]
+            mult = jnp.asarray(bn["scale"]) / jnp.sqrt(jnp.asarray(st["var"]) + epsilon)
+            return {
+                "Conv_0": {
+                    "kernel": kernel * mult,  # (kh, kw, cin, cout) * (cout,)
+                    "bias": jnp.asarray(bn["bias"]) - jnp.asarray(st["mean"]) * mult,
+                }
+            }
+        out = {}
+        for key, value in params.items():
+            if isinstance(value, dict):
+                out[key] = walk(value, node_stats.get(key, {}) if isinstance(node_stats, dict) else {})
+            else:
+                out[key] = value
+        return out
+
+    return {"params": walk(variables["params"], stats)}
 
 
 def _resize_bilinear_tf1(x: Array, out_h: int, out_w: int) -> Array:
@@ -256,14 +303,20 @@ class InceptionFeatureExtractor(PickleableJitMixin):
     _COMPILED_ATTRS = ("_forward",)
 
 
-    def __init__(self, feature="2048", weights_path: str = None, seed: int = 0, compute_dtype=None) -> None:
+    def __init__(
+        self, feature="2048", weights_path: str = None, seed: int = 0, compute_dtype=None, fuse_bn: bool = True
+    ) -> None:
         self.feature = str(feature)
-        self.net = InceptionV3(dtype=compute_dtype if compute_dtype is not None else jnp.bfloat16)
+        dtype = compute_dtype if compute_dtype is not None else jnp.bfloat16
+        # checkpoints (and flax init) produce the unfused conv+BN layout;
+        # inference folds BN into the conv weights (fold_batchnorm) unless
+        # fuse_bn=False asks for the literal unfused graph
+        unfused = InceptionV3(dtype=dtype, fuse_bn=False)
         dummy = jnp.zeros((1, 299, 299, 3), jnp.float32)
         if weights_path:
             self.variables = load_variables_npz(weights_path)
             if "batch_stats" not in self.variables:  # params-only checkpoint
-                init_vars = self.net.init(jax.random.PRNGKey(seed), dummy)
+                init_vars = unfused.init(jax.random.PRNGKey(seed), dummy)
                 self.variables = {"params": self.variables["params"], "batch_stats": init_vars["batch_stats"]}
         else:
             from torchmetrics_tpu.utilities.prints import rank_zero_warn
@@ -273,7 +326,12 @@ class InceptionFeatureExtractor(PickleableJitMixin):
                 " cannot download pretrained checkpoints). Feature statistics will be meaningless for real"
                 " FID comparisons; pass a converted checkpoint or a custom feature extractor callable."
             )
-            self.variables = self.net.init(jax.random.PRNGKey(seed), dummy)
+            self.variables = unfused.init(jax.random.PRNGKey(seed), dummy)
+        if fuse_bn:
+            self.net = InceptionV3(dtype=dtype, fuse_bn=True)
+            self.variables = fold_batchnorm(self.variables)
+        else:
+            self.net = unfused
 
         self._build_forward()
 

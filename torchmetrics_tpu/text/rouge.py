@@ -86,10 +86,15 @@ class ROUGEScore(Metric):
             self.normalizer,
             self.tokenizer,
         )
+        # per-sample scores arrive as host floats; one device array per
+        # (key, score) per update keeps cat-state sync intact without a
+        # transfer per sample
         for rouge_key, metrics in output.items():
-            for metric in metrics:
-                for score_name, score in metric.items():
-                    getattr(self, f"rouge{rouge_key}_{score_name}").append(score.reshape(1))
+            if not metrics:
+                continue
+            for score_name in ("fmeasure", "precision", "recall"):
+                vals = jnp.asarray([float(metric[score_name]) for metric in metrics], jnp.float32)
+                getattr(self, f"rouge{rouge_key}_{score_name}").append(vals)
 
     def compute(self) -> Dict[str, Array]:
         update_output = {}
