@@ -582,10 +582,10 @@ FID_STREAM = 16  # batches streamed back-to-back per timed fetch
 def _bench_fid_imgs_per_sec() -> tuple:
     """images/sec through the jitted Flax InceptionV3 trunk + FID state fold.
 
-    Returns ``(imgs_per_sec, mfu)``: MFU = achieved FLOP/s over the chip's
-    bf16 peak, with the per-batch FLOP count taken from XLA's own cost
-    analysis of the compiled trunk (so regressions in either throughput or
-    compiled FLOPs are visible).
+    Returns ``(imgs_per_sec, mfu, roofline_mfu)``: MFU = achieved FLOP/s over
+    the chip's bf16 peak (per XLA cost analysis of the compiled trunk);
+    ``roofline_mfu`` = the HBM-bandwidth-implied ceiling from the trunk's
+    arithmetic intensity (0.0 when cost analysis is unavailable).
     """
     import warnings
 

@@ -28,7 +28,9 @@ def _uniform_kernel_1d(kernel_size: int, dtype=jnp.float32) -> Array:
 def _depthwise_conv2d(x: Array, kernel: Array) -> Array:
     """Depthwise valid conv. ``x``: (N, C, H, W); ``kernel``: (kh, kw)."""
     c = x.shape[1]
-    k = jnp.broadcast_to(kernel[None, None, :, :], (c, 1, *kernel.shape))
+    # match the window dtype to the input (set_dtype(bf16) policies cast
+    # states); HIGHEST precision keeps the accumulation in f32 regardless
+    k = jnp.broadcast_to(kernel.astype(x.dtype)[None, None, :, :], (c, 1, *kernel.shape))
     return lax.conv_general_dilated(
         x,
         k,
@@ -45,7 +47,7 @@ def _depthwise_conv2d(x: Array, kernel: Array) -> Array:
 def _depthwise_conv3d(x: Array, kernel: Array) -> Array:
     """Depthwise valid 3D conv. ``x``: (N, C, D, H, W); ``kernel``: (kd, kh, kw)."""
     c = x.shape[1]
-    k = jnp.broadcast_to(kernel[None, None], (c, 1, *kernel.shape))
+    k = jnp.broadcast_to(kernel.astype(x.dtype)[None, None], (c, 1, *kernel.shape))
     return lax.conv_general_dilated(
         x,
         k,

@@ -128,7 +128,7 @@ def _normalize_and_tokenize_text(
     return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
 
 
-def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, Array]:
+def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, float]:
     def _create_ngrams(tokens: Sequence[str], n: int) -> Counter:
         return Counter(tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1))
 
@@ -142,7 +142,7 @@ def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> D
 
 def _rouge_l_score(
     pred: Sequence[str], target: Sequence[str], precomputed_lcs: Optional[float] = None
-) -> Dict[str, Array]:
+) -> Dict[str, float]:
     pred_len, target_len = len(pred), len(target)
     if 0 in (pred_len, target_len):
         return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
@@ -150,7 +150,7 @@ def _rouge_l_score(
     return _compute_metrics(lcs, pred_len, target_len)
 
 
-def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[str]]) -> Dict[str, Array]:
+def _rouge_lsum_score(pred: Sequence[Sequence[str]], target: Sequence[Sequence[str]]) -> Dict[str, float]:
     pred_len = sum(map(len, pred))
     target_len = sum(map(len, target))
     if 0 in (pred_len, target_len):
@@ -183,12 +183,12 @@ def _rouge_score_update(
     stemmer: Optional[Any] = None,
     normalizer: Optional[Callable[[str], str]] = None,
     tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
-) -> Dict[Union[int, str], List[Dict[str, Array]]]:
-    """Per-sample P/R/F for every requested ROUGE variant; multi-reference
+) -> Dict[Union[int, str], List[Dict[str, float]]]:
+    """Per-sample P/R/F (host floats) for every requested ROUGE variant; multi-reference
     handling via ``accumulate='best'`` (highest first-key fmeasure) or
     ``'avg'`` (mean over references), matching ``rouge.py:373-399``.
     """
-    results: Dict[Union[int, str], List[Dict[str, Array]]] = {key: [] for key in rouge_keys_values}
+    results: Dict[Union[int, str], List[Dict[str, float]]] = {key: [] for key in rouge_keys_values}
 
     # tokenize each text exactly once
     pred_toks = [_normalize_and_tokenize_text(p, stemmer, normalizer, tokenizer) for p in preds]
@@ -217,8 +217,8 @@ def _rouge_score_update(
             lcs_cache = {key: float(val) for key, val in zip(pair_index, lengths)}
 
     for i_sample, (pred_raw, target_raw) in enumerate(zip(preds, target)):
-        result_inner: Dict[Union[int, str], Dict[str, Array]] = {}
-        result_avg: Dict[Union[int, str], List[Dict[str, Array]]] = {key: [] for key in rouge_keys_values}
+        result_inner: Dict[Union[int, str], Dict[str, float]] = {}
+        result_avg: Dict[Union[int, str], List[Dict[str, float]]] = {key: [] for key in rouge_keys_values}
         list_results = []
         pred = pred_toks[i_sample]
         pred_lsum = (

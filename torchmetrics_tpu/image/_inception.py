@@ -294,10 +294,15 @@ class InceptionFeatureExtractor(PickleableJitMixin):
     — a warning is emitted once).
 
     ``compute_dtype`` defaults to bfloat16: convolutions run on the MXU at
-    twice the fp32 rate while parameters, BatchNorm statistics, and the
-    pooled feature taps stay float32 (the flax mixed-precision recipe), so
-    downstream FID/KID covariance folds see full-precision features. Pass
-    ``jnp.float32`` for bit-exact fp32 trunks.
+    twice the fp32 rate while parameters and the pooled feature taps stay
+    float32 (the flax mixed-precision recipe), so downstream FID/KID
+    covariance folds see full-precision features. Pass ``jnp.float32`` for
+    bit-exact fp32 trunks.
+
+    ``fuse_bn`` (default True) folds the inference-mode BatchNorm statistics
+    into the conv kernels/biases at load time (:func:`fold_batchnorm`) —
+    the applied graph then has no BN ops or ``batch_stats`` collection;
+    pass ``fuse_bn=False`` for the literal unfused conv+BN graph.
     """
 
     _COMPILED_ATTRS = ("_forward",)
