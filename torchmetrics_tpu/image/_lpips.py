@@ -71,9 +71,14 @@ class LPIPSNet(nn.Module):
         x0 = (jnp.transpose(img0, (0, 2, 3, 1)) - shift) / scale
         x1 = (jnp.transpose(img1, (0, 2, 3, 1)) - shift) / scale
 
+        # one trunk pass over the concatenated pair batch: same math, twice
+        # the batch per conv (better MXU utilization than two half-batch
+        # passes) and one kernel stream instead of two
+        n = x0.shape[0]
         trunk = VGG16Features(name="net", dtype=self.dtype)
-        feats0 = trunk(x0)
-        feats1 = trunk(x1)
+        feats = trunk(jnp.concatenate([x0, x1], axis=0))
+        feats0 = [f[:n] for f in feats]
+        feats1 = [f[n:] for f in feats]
 
         total = 0.0
         for i, (f0, f1) in enumerate(zip(feats0, feats1)):
