@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 PEAK = 394e12  # v5e bf16
+HBM_BW = 819e9  # v5e HBM bytes/s
 
 
 def _rtt() -> float:
@@ -50,8 +51,10 @@ def bench(ext, batch, stream=16, reps=3):
     rate = batch * stream / dt
     cost = ext._forward.lower(ext.variables, imgs).compile().cost_analysis()
     flops = float(cost.get("flops", 0.0))
+    bytes_acc = float(cost.get("bytes accessed", 0.0))
     mfu = (rate / batch) * flops / PEAK
-    return rate, mfu, flops
+    roofline = min(1.0, (flops / bytes_acc) * HBM_BW / PEAK) if bytes_acc else 0.0
+    return rate, mfu, flops, roofline
 
 
 def main():
@@ -61,11 +64,14 @@ def main():
 
         for batch in (128, 256, 512):
             ext = InceptionFeatureExtractor(feature="2048")
-            rate, mfu, flops = bench(ext, batch)
-            print(
+            rate, mfu, flops, roofline = bench(ext, batch)
+            line = (
                 f"batch={batch:4d}  imgs/s={rate:9.1f}  MFU={mfu:6.1%}"
                 f"  flops/img={flops / batch / 1e9:.2f} GF"
             )
+            if roofline:
+                line += f"  HBM-roofline={roofline:6.1%}  of-roofline={mfu / roofline:6.1%}"
+            print(line)
 
 
 if __name__ == "__main__":

@@ -73,7 +73,9 @@ class LPIPSNet(nn.Module):
 
         # one trunk pass over the concatenated pair batch: same math, twice
         # the batch per conv (better MXU utilization than two half-batch
-        # passes) and one kernel stream instead of two
+        # passes) and one kernel stream instead of two. Peak activation
+        # memory doubles accordingly — halve the LPIPS batch if a previous
+        # batch size was sized to fill HBM
         n = x0.shape[0]
         trunk = VGG16Features(name="net", dtype=self.dtype)
         feats = trunk(jnp.concatenate([x0, x1], axis=0))
