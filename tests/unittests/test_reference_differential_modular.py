@@ -386,3 +386,18 @@ def test_bootstrapper_structure():
     point = float(base.compute())
     assert abs(float(out["mean"]) - point) < 0.15
     assert 0.0 <= float(out["std"]) < 0.3
+
+
+@pytest.mark.parametrize(("name", "kwargs", "stream"), CLASS_CASES, ids=lambda v: str(v)[:44])
+def test_streaming_classification_auto_compiled(name, kwargs, stream):
+    """Round-4: the same reference comparison with the transparent
+    auto-compiled update path engaged (validate_args=False so repeat-shape
+    batches replay the compiled executable) — the compiled state transition
+    must match the reference exactly like the eager one does."""
+    if not callable(stream):
+        pytest.skip("bad id")
+    ours = getattr(tm, name)(**kwargs, validate_args=False)
+    ref = getattr(torchmetrics.classification, name)(**kwargs, validate_args=False)
+    _run_pair(ours, ref, stream())
+    if not (ours._auto_disabled or any(isinstance(getattr(ours, n), list) for n in ours._defaults)):
+        assert "_auto_update_fn" in ours.__dict__, f"{name}: compiled path never engaged"

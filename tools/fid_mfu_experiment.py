@@ -16,8 +16,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-PEAK = 394e12  # v5e bf16
-HBM_BW = 819e9  # v5e HBM bytes/s
+from bench import _HBM_BYTES_PER_S as HBM_BW, _PEAK_BF16_FLOPS as PEAK  # single source for the v5e constants
 
 
 def _rtt() -> float:
