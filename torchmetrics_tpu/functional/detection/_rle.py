@@ -1,10 +1,13 @@
-"""Pure-Python COCO RLE mask codec.
+"""COCO RLE mask codec (native C fast path + pure-Python fallback/oracle).
 
 The reference delegates RLE encode/decode to ``pycocotools.mask`` (C) /
 ``faster_coco_eval`` (C++) (reference ``detection/mean_ap.py:50-71``). The
 TPU build keeps masks dense on device (mask IoU is an MXU matmul); RLE is
 only needed at the COCO-JSON interchange boundary (``coco_to_tm`` /
-``tm_to_coco``), where a host-side Python codec is plenty.
+``tm_to_coco``). The hot loops live in ``torchmetrics_tpu/native/rle.c``
+(compiled on demand, ctypes-loaded); the pure-Python implementations below
+are the fallback when no C compiler is available AND the differential
+oracle for the native codec's tests.
 
 COCO RLE conventions: column-major (Fortran) scan order; ``counts`` starts
 with the number of zeros; the compressed string form packs each count as a
@@ -14,9 +17,12 @@ against counts[i-2] (see pycocotools ``rleToString``/``rleFrString``).
 
 from __future__ import annotations
 
+import ctypes
 from typing import Dict, List, Union
 
 import numpy as np
+
+from torchmetrics_tpu.native import load_rle
 
 
 def mask_to_rle_counts(mask: np.ndarray) -> List[int]:
@@ -24,6 +30,16 @@ def mask_to_rle_counts(mask: np.ndarray) -> List[int]:
     flat = np.asarray(mask, dtype=np.uint8).flatten(order="F")
     if flat.size == 0:
         return []
+    lib = load_rle()
+    if lib is not None:
+        flat = np.ascontiguousarray(flat)
+        out = np.empty(flat.size + 1, dtype=np.dtype(ctypes.c_long))
+        m = lib.tm_mask_to_counts(
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            flat.size,
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+        )
+        return out[:m].tolist()
     change = np.nonzero(np.diff(flat))[0] + 1
     runs = np.diff(np.concatenate([[0], change, [flat.size]])).tolist()
     if flat[0] == 1:  # counts must start with a zero-run
@@ -34,6 +50,17 @@ def mask_to_rle_counts(mask: np.ndarray) -> List[int]:
 def rle_counts_to_mask(counts: List[int], size: List[int]) -> np.ndarray:
     """Uncompressed COCO counts list + (H, W) size → dense uint8 mask."""
     h, w = int(size[0]), int(size[1])
+    lib = load_rle()
+    if lib is not None:
+        carr = np.ascontiguousarray(np.asarray(counts, dtype=np.dtype(ctypes.c_long)))
+        flat = np.zeros(h * w, dtype=np.uint8)
+        lib.tm_counts_to_mask(
+            carr.ctypes.data_as(ctypes.POINTER(ctypes.c_long)),
+            carr.size,
+            flat.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+            flat.size,
+        )
+        return flat.reshape((h, w), order="F")
     flat = np.zeros(h * w, dtype=np.uint8)
     pos, val = 0, 0
     for c in counts:
@@ -46,6 +73,14 @@ def rle_counts_to_mask(counts: List[int], size: List[int]) -> np.ndarray:
 
 def rle_string_encode(counts: List[int]) -> str:
     """Counts list → compressed COCO RLE string (pycocotools ``rleToString``)."""
+    lib = load_rle()
+    if lib is not None and len(counts):
+        carr = np.ascontiguousarray(np.asarray(counts, dtype=np.dtype(ctypes.c_long)))
+        buf = ctypes.create_string_buffer(16 * carr.size)
+        n = lib.tm_string_encode(
+            carr.ctypes.data_as(ctypes.POINTER(ctypes.c_long)), carr.size, buf
+        )
+        return buf.raw[:n].decode("ascii")
     out = bytearray()
     for i, c in enumerate(counts):
         x = int(c)
@@ -66,6 +101,13 @@ def rle_string_decode(s: Union[str, bytes]) -> List[int]:
     """Compressed COCO RLE string → counts list (pycocotools ``rleFrString``)."""
     if isinstance(s, str):
         s = s.encode("ascii")
+    lib = load_rle()
+    if lib is not None and len(s):
+        out = np.empty(len(s), dtype=np.dtype(ctypes.c_long))
+        m = lib.tm_string_decode(s, len(s), out.ctypes.data_as(ctypes.POINTER(ctypes.c_long)))
+        if m < 0:
+            raise ValueError("truncated RLE string (continuation bit set on the final byte)")
+        return out[:m].tolist()
     counts: List[int] = []
     p = 0
     while p < len(s):

@@ -1,0 +1,111 @@
+"""On-demand-built native (C) helpers for host-side runtime work.
+
+The reference's host-boundary hot loops live in third-party C/C++
+(pycocotools' mask codec, faster-coco-eval); this package holds the TPU
+build's own native equivalents. Sources compile once per machine with the
+system C compiler into ``<repo>/.native_cache/`` and load via ctypes — no
+pip, no build system, and every entry point has a pure-Python fallback, so
+a missing/failed compiler only costs speed:
+
+    lib = load_rle()          # ctypes CDLL or None
+    set_native_enabled(False) # force the pure-Python paths (or TM_NO_NATIVE=1)
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import sysconfig
+import threading
+from typing import Optional
+
+_SRC_DIR = os.path.dirname(os.path.abspath(__file__))
+_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(_SRC_DIR)), ".native_cache")
+
+_lock = threading.Lock()
+_cache: dict = {}
+_enabled = os.environ.get("TM_NO_NATIVE", "") != "1"
+
+
+def set_native_enabled(value: bool) -> None:
+    """Toggle native codecs at runtime (tests use this to hit both paths)."""
+    global _enabled
+    _enabled = bool(value)
+
+
+def native_enabled() -> bool:
+    return _enabled
+
+
+def _compiler() -> Optional[str]:
+    for cand in (os.environ.get("CC"), "cc", "gcc", "clang"):
+        if not cand:
+            continue
+        try:
+            subprocess.run([cand, "--version"], capture_output=True, timeout=30)
+            return cand
+        except (OSError, subprocess.TimeoutExpired):
+            continue
+    return None
+
+
+def _build(name: str) -> Optional[str]:
+    src = os.path.join(_SRC_DIR, f"{name}.c")
+    if not os.path.exists(src):
+        return None
+    tag = sysconfig.get_platform().replace("-", "_")
+    out = os.path.join(_CACHE_DIR, f"{name}_{tag}.so")
+    if os.path.exists(out) and os.path.getmtime(out) >= os.path.getmtime(src):
+        return out
+    cc = _compiler()
+    if cc is None:
+        return None
+    tmp = f"{out}.{os.getpid()}.build"  # per-process: concurrent builders never share a tmp
+    try:
+        os.makedirs(_CACHE_DIR, exist_ok=True)  # read-only installs fall back to python
+        res = subprocess.run(
+            [cc, "-O2", "-shared", "-fPIC", "-o", tmp, src],
+            capture_output=True,
+            timeout=120,
+        )
+        if res.returncode != 0:
+            return None
+        os.replace(tmp, out)  # atomic publish
+        return out
+    except (OSError, subprocess.TimeoutExpired):
+        return None
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+
+
+def load_rle() -> Optional[ctypes.CDLL]:
+    """The RLE codec library with argtypes bound, or None (fallback to python)."""
+    if not _enabled:
+        return None
+    with _lock:
+        if "rle" in _cache:
+            return _cache["rle"]
+        lib = None
+        path = _build("rle")
+        if path is not None:
+            try:
+                lib = ctypes.CDLL(path)
+                u8p = ctypes.POINTER(ctypes.c_uint8)
+                lp = ctypes.POINTER(ctypes.c_long)
+                lib.tm_mask_to_counts.argtypes = [u8p, ctypes.c_long, lp]
+                lib.tm_mask_to_counts.restype = ctypes.c_long
+                lib.tm_counts_to_mask.argtypes = [lp, ctypes.c_long, u8p, ctypes.c_long]
+                lib.tm_counts_to_mask.restype = None
+                lib.tm_string_encode.argtypes = [lp, ctypes.c_long, ctypes.c_char_p]
+                lib.tm_string_encode.restype = ctypes.c_long
+                lib.tm_string_decode.argtypes = [ctypes.c_char_p, ctypes.c_long, lp]
+                lib.tm_string_decode.restype = ctypes.c_long
+            except OSError:
+                lib = None
+        _cache["rle"] = lib
+        return lib
