@@ -89,3 +89,22 @@ def test_truncated_string_raises_not_garbage():
         _rle.rle_string_decode(truncated)
     with _python_paths(), pytest.raises((ValueError, IndexError)):
         _rle.rle_string_decode(truncated)
+
+
+def test_nonbinary_mask_values_agree():
+    """0/255 masks (PNG-style) must encode identically on both paths."""
+    rng = np.random.default_rng(3)
+    mask = ((rng.random((15, 11)) < 0.5) * 255).astype(np.uint8)
+    counts_native = _rle.mask_to_rle_counts(mask)
+    with _python_paths():
+        counts_py = _rle.mask_to_rle_counts(mask)
+    assert counts_native == counts_py
+    np.testing.assert_array_equal(
+        _rle.rle_counts_to_mask(counts_native, [15, 11]), (mask != 0).astype(np.uint8)
+    )
+
+
+def test_overlong_varint_raises():
+    corrupt = chr(48 + 0x20) * 20 + chr(48)  # 20 continuation groups then a terminator
+    with pytest.raises((ValueError, OverflowError)):
+        _rle.rle_string_decode(corrupt)

@@ -28,6 +28,7 @@ from torchmetrics_tpu.native import load_rle
 def mask_to_rle_counts(mask: np.ndarray) -> List[int]:
     """Dense (H, W) binary mask → uncompressed COCO counts list."""
     flat = np.asarray(mask, dtype=np.uint8).flatten(order="F")
+    flat = (flat != 0).astype(np.uint8)  # nonzero = foreground (0/255 PNGs etc.)
     if flat.size == 0:
         return []
     lib = load_rle()
@@ -42,7 +43,7 @@ def mask_to_rle_counts(mask: np.ndarray) -> List[int]:
         return out[:m].tolist()
     change = np.nonzero(np.diff(flat))[0] + 1
     runs = np.diff(np.concatenate([[0], change, [flat.size]])).tolist()
-    if flat[0] == 1:  # counts must start with a zero-run
+    if flat[0]:  # counts must start with a zero-run
         runs = [0, *runs]
     return [int(r) for r in runs]
 

@@ -21,7 +21,11 @@ import threading
 from typing import Optional
 
 _SRC_DIR = os.path.dirname(os.path.abspath(__file__))
-_CACHE_DIR = os.path.join(os.path.dirname(os.path.dirname(_SRC_DIR)), ".native_cache")
+# override with TM_NATIVE_CACHE for installed deployments (the default sits
+# next to the package checkout, which suits a repo install)
+_CACHE_DIR = os.environ.get("TM_NATIVE_CACHE") or os.path.join(
+    os.path.dirname(os.path.dirname(_SRC_DIR)), ".native_cache"
+)
 
 _lock = threading.Lock()
 _cache: dict = {}

@@ -53,8 +53,9 @@ void tm_counts_to_mask(const long *counts, long m, uint8_t *flat, long n) {
     }
 }
 
-/* counts -> compressed string (caller buffer: 8 bytes per count is ample).
- * Returns the encoded length. */
+/* counts -> compressed string (caller buffer: 13 bytes per count worst
+ * case — a 64-bit negative delta emits 13 five-bit groups; the Python
+ * caller allocates 16). Returns the encoded length. */
 long tm_string_encode(const long *counts, long m, char *out) {
     long p = 0;
     for (long i = 0; i < m; i++) {
@@ -81,6 +82,7 @@ long tm_string_decode(const char *s, long len, long *counts_out) {
         int k = 0, more = 1;
         while (more) {
             if (p >= len) return -1; /* continuation bit set on the last byte */
+            if (k >= 12) return -1;  /* >=13 groups would shift past 64 bits (corrupt input) */
             long c = (long)s[p] - 48;
             x |= (c & 0x1f) << (5 * k);
             more = (c & 0x20) != 0;
