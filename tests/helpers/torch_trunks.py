@@ -276,3 +276,82 @@ class TorchLPIPS(nn.Module):
             d = (unit(f0) - unit(f1)).pow(2)
             total = total + lin(d).mean(dim=(1, 2, 3))
         return total
+
+
+class _TorchFire(nn.Module):
+    """torchvision SqueezeNet Fire module replica (attr names match its state dict)."""
+
+    def __init__(self, in_ch: int, squeeze: int, expand: int) -> None:
+        super().__init__()
+        self.squeeze = nn.Conv2d(in_ch, squeeze, kernel_size=1)
+        self.expand1x1 = nn.Conv2d(squeeze, expand, kernel_size=1)
+        self.expand3x3 = nn.Conv2d(squeeze, expand, kernel_size=3, padding=1)
+
+    def forward(self, x):
+        s = torch.relu(self.squeeze(x))
+        return torch.cat([torch.relu(self.expand1x1(s)), torch.relu(self.expand3x3(s))], dim=1)
+
+
+class TorchLPIPSAlt(nn.Module):
+    """AlexNet / SqueezeNet-1.1 LPIPS replicas with torchvision `features` naming."""
+
+    def __init__(self, net_type: str) -> None:
+        super().__init__()
+        self.net_type = net_type
+        if net_type == "alex":
+            self.features = nn.Sequential(
+                nn.Conv2d(3, 64, kernel_size=11, stride=4, padding=2), nn.ReLU(),   # 0, 1
+                nn.MaxPool2d(3, 2),                                                  # 2
+                nn.Conv2d(64, 192, kernel_size=5, padding=2), nn.ReLU(),             # 3, 4
+                nn.MaxPool2d(3, 2),                                                  # 5
+                nn.Conv2d(192, 384, kernel_size=3, padding=1), nn.ReLU(),            # 6, 7
+                nn.Conv2d(384, 256, kernel_size=3, padding=1), nn.ReLU(),            # 8, 9
+                nn.Conv2d(256, 256, kernel_size=3, padding=1), nn.ReLU(),            # 10, 11
+            )
+            self._tap_layers = (1, 4, 7, 9, 11)
+            channels = (64, 192, 384, 256, 256)
+        elif net_type == "squeeze":
+            self.features = nn.Sequential(
+                nn.Conv2d(3, 64, kernel_size=3, stride=2), nn.ReLU(),                # 0, 1
+                nn.MaxPool2d(3, 2, ceil_mode=True),                                  # 2
+                _TorchFire(64, 16, 64), _TorchFire(128, 16, 64),                     # 3, 4
+                nn.MaxPool2d(3, 2, ceil_mode=True),                                  # 5
+                _TorchFire(128, 32, 128), _TorchFire(256, 32, 128),                  # 6, 7
+                nn.MaxPool2d(3, 2, ceil_mode=True),                                  # 8
+                _TorchFire(256, 48, 192), _TorchFire(384, 48, 192),                  # 9, 10
+                _TorchFire(384, 64, 256), _TorchFire(512, 64, 256),                  # 11, 12
+            )
+            self._tap_layers = (1, 4, 7, 9, 10, 11, 12)
+            channels = (64, 128, 256, 384, 384, 512, 512)
+        else:
+            raise ValueError(net_type)
+        self.lins = nn.ModuleList([nn.Conv2d(c, 1, kernel_size=1, bias=False) for c in channels])
+        self.register_buffer("shift", torch.tensor([-0.030, -0.088, -0.188]).view(1, 3, 1, 1))
+        self.register_buffer("scale", torch.tensor([0.458, 0.448, 0.450]).view(1, 3, 1, 1))
+
+    def trunk_state_dict(self):
+        """State dict with torchvision `features.N` naming (incl. fire submodules)."""
+        return {k: v for k, v in self.state_dict().items() if k.startswith("features.")}
+
+    def heads_state_dict(self):
+        return {f"lin{i}.model.1.weight": lin.weight for i, lin in enumerate(self.lins)}
+
+    @torch.no_grad()
+    def forward(self, img0: torch.Tensor, img1: torch.Tensor) -> torch.Tensor:
+        def taps(x):
+            x = (x - self.shift) / self.scale
+            feats = []
+            for i, layer in enumerate(self.features):
+                x = layer(x)
+                if i in self._tap_layers:
+                    feats.append(x)
+            return feats
+
+        def unit(x, eps=1e-10):
+            return x / (x.pow(2).sum(dim=1, keepdim=True).sqrt() + eps)
+
+        total = 0.0
+        for f0, f1, lin in zip(taps(img0), taps(img1), self.lins):
+            d = (unit(f0) - unit(f1)).pow(2)
+            total = total + lin(d).mean(dim=(1, 2, 3))
+        return total

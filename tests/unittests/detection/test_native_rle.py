@@ -104,7 +104,25 @@ def test_nonbinary_mask_values_agree():
     )
 
 
-def test_overlong_varint_raises():
+def test_overlong_varint_raises_on_both_paths():
     corrupt = chr(48 + 0x20) * 20 + chr(48)  # 20 continuation groups then a terminator
-    with pytest.raises((ValueError, OverflowError)):
+    with pytest.raises(ValueError):
         _rle.rle_string_decode(corrupt)
+    with _python_paths(), pytest.raises(ValueError):
+        _rle.rle_string_decode(corrupt)
+
+
+def test_huge_count_round_trips_on_both_paths():
+    counts = [0, 1, 2, 2**61]  # absurd but encodable: 13-group varint
+    enc = _rle.rle_string_encode(counts)
+    assert _rle.rle_string_decode(enc) == counts
+    with _python_paths():
+        assert _rle.rle_string_encode(counts) == enc
+        assert _rle.rle_string_decode(enc) == counts
+
+
+def test_int32_mask_multiple_of_256_is_foreground():
+    mask = np.full((2, 2), 256, dtype=np.int32)
+    assert _rle.mask_to_rle_counts(mask) == [0, 4]
+    with _python_paths():
+        assert _rle.mask_to_rle_counts(mask) == [0, 4]

@@ -151,3 +151,31 @@ def test_lpips_equivalence():
         ours = LPIPSExtractor(net_type="vgg", weights_path=str(npz), compute_dtype=jnp.float32)
         got = np.asarray(ours(jnp.asarray(img0), jnp.asarray(img1)))
         np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-5)
+
+
+@pytest.mark.parametrize("net_type", ["alex", "squeeze"])
+def test_lpips_alt_trunk_equivalence(net_type):
+    """AlexNet / SqueezeNet LPIPS trunks match a torch replica on converted
+    random weights (round-4: all three reference net_types supported)."""
+    from tests.helpers.torch_trunks import TorchLPIPSAlt
+
+    torch.manual_seed(5)
+    ref = TorchLPIPSAlt(net_type).eval()
+    with torch.no_grad():
+        for lin in ref.lins:
+            lin.weight.abs_()
+    import tempfile
+
+    with tempfile.TemporaryDirectory() as td:
+        npz = Path(td) / f"lpips_{net_type}.npz"
+        np.savez(
+            npz,
+            **convert_lpips_state_dicts(ref.trunk_state_dict(), ref.heads_state_dict(), net_type=net_type),
+        )
+        rng = np.random.default_rng(13)
+        img0 = (rng.random((2, 3, 65, 65)).astype(np.float32) * 2) - 1  # odd size: exercises ceil-mode pools
+        img1 = (rng.random((2, 3, 65, 65)).astype(np.float32) * 2) - 1
+        want = ref(torch.from_numpy(img0), torch.from_numpy(img1)).numpy()
+        ours = LPIPSExtractor(net_type=net_type, weights_path=str(npz), compute_dtype=jnp.float32)
+        got = np.asarray(ours(jnp.asarray(img0), jnp.asarray(img1)))
+        np.testing.assert_allclose(got, want, rtol=1e-3, atol=1e-5)

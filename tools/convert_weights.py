@@ -16,7 +16,7 @@ those files from the torch checkpoints the reference stack downloads:
 Usage::
 
     python tools/convert_weights.py inception weights.pth out.npz
-    python tools/convert_weights.py lpips vgg16.pth lpips_heads.pth out.npz
+    python tools/convert_weights.py lpips vgg16.pth lpips_heads.pth out.npz [vgg|alex|squeeze]
     python tools/convert_weights.py bert bert_mlm.pth out.npz [num_heads]
     python tools/convert_weights.py clip clip_model.pth out.npz [text_heads vision_heads eos_id]
 
@@ -145,20 +145,39 @@ def convert_inception_state_dict(sd: Mapping) -> Dict[str, np.ndarray]:
 # LPIPS: torchvision VGG16 features + richzhang linear heads
 # ---------------------------------------------------------------------------
 
-# torchvision vgg16 conv layer indices inside `features`
+# torchvision conv layer indices inside `features` per trunk
 _VGG16_CONV_IDX = (0, 2, 5, 7, 10, 12, 14, 17, 19, 21, 24, 26, 28)
+_ALEXNET_CONV_IDX = (0, 3, 6, 8, 10)
+_SQUEEZE_FIRE_IDX = (3, 4, 6, 7, 9, 10, 11, 12)
+_LPIPS_NUM_HEADS = {"vgg": 5, "alex": 5, "squeeze": 7}
 
 
-def convert_lpips_state_dicts(vgg_sd: Mapping, heads_sd: Mapping) -> Dict[str, np.ndarray]:
-    """VGG16 trunk + LPIPS head state dicts -> flattened npz mapping."""
+def _convert_conv(out: Dict[str, np.ndarray], sd: Mapping, torch_key: str, flax_key: str) -> None:
+    if f"{torch_key}.weight" not in sd:
+        raise KeyError(f"Missing `{torch_key}.weight` — expected torchvision `features.N` naming")
+    out[f"params/net/{flax_key}/kernel"] = _to_numpy(sd[f"{torch_key}.weight"]).transpose(2, 3, 1, 0)
+    out[f"params/net/{flax_key}/bias"] = _to_numpy(sd[f"{torch_key}.bias"])
+
+
+def convert_lpips_state_dicts(trunk_sd: Mapping, heads_sd: Mapping, net_type: str = "vgg") -> Dict[str, np.ndarray]:
+    """LPIPS trunk (torchvision vgg16/alexnet/squeezenet1_1 ``features``
+    naming) + richzhang head state dicts -> flattened npz mapping."""
     out: Dict[str, np.ndarray] = {}
-    for flax_idx, torch_idx in enumerate(_VGG16_CONV_IDX):
-        key = f"features.{torch_idx}"
-        if f"{key}.weight" not in vgg_sd:  # richzhang checkpoints use net.slice naming
-            raise KeyError(f"Missing `{key}.weight` — expected torchvision vgg16 `features.N` naming")
-        out[f"params/net/Conv_{flax_idx}/kernel"] = _to_numpy(vgg_sd[f"{key}.weight"]).transpose(2, 3, 1, 0)
-        out[f"params/net/Conv_{flax_idx}/bias"] = _to_numpy(vgg_sd[f"{key}.bias"])
-    for i in range(5):
+    if net_type == "vgg":
+        for flax_idx, torch_idx in enumerate(_VGG16_CONV_IDX):
+            _convert_conv(out, trunk_sd, f"features.{torch_idx}", f"Conv_{flax_idx}")
+    elif net_type == "alex":
+        for flax_idx, torch_idx in enumerate(_ALEXNET_CONV_IDX):
+            _convert_conv(out, trunk_sd, f"features.{torch_idx}", f"Conv_{flax_idx}")
+    elif net_type == "squeeze":
+        _convert_conv(out, trunk_sd, "features.0", "Conv_0")
+        for t in _SQUEEZE_FIRE_IDX:
+            _convert_conv(out, trunk_sd, f"features.{t}.squeeze", f"fire{t}_squeeze")
+            _convert_conv(out, trunk_sd, f"features.{t}.expand1x1", f"fire{t}_expand1")
+            _convert_conv(out, trunk_sd, f"features.{t}.expand3x3", f"fire{t}_expand3")
+    else:
+        raise ValueError(f"unknown LPIPS net_type {net_type!r}")
+    for i in range(_LPIPS_NUM_HEADS[net_type]):
         for candidate in (f"lin{i}.model.1.weight", f"lins.{i}.model.1.weight", f"lin{i}.weight"):
             if candidate in heads_sd:
                 out[f"params/lin{i}/kernel"] = _to_numpy(heads_sd[candidate]).transpose(2, 3, 1, 0)
@@ -351,7 +370,13 @@ def main(argv) -> int:
         _save(argv[2], convert_bert_state_dict(_load_torch_checkpoint(argv[1]), num_heads=heads))
         return 0
     if len(argv) >= 4 and argv[0] == "lpips":
-        _save(argv[3], convert_lpips_state_dicts(_load_torch_checkpoint(argv[1]), _load_torch_checkpoint(argv[2])))
+        net_type = argv[4] if len(argv) > 4 else "vgg"
+        _save(
+            argv[3],
+            convert_lpips_state_dicts(
+                _load_torch_checkpoint(argv[1]), _load_torch_checkpoint(argv[2]), net_type=net_type
+            ),
+        )
         return 0
     print(__doc__)
     return 1

@@ -27,8 +27,9 @@ from torchmetrics_tpu.native import load_rle
 
 def mask_to_rle_counts(mask: np.ndarray) -> List[int]:
     """Dense (H, W) binary mask → uncompressed COCO counts list."""
-    flat = np.asarray(mask, dtype=np.uint8).flatten(order="F")
-    flat = (flat != 0).astype(np.uint8)  # nonzero = foreground (0/255 PNGs etc.)
+    # binarize BEFORE any narrowing cast: nonzero = foreground (0/255 PNGs,
+    # int32 instance-id masks whose values may be multiples of 256, ...)
+    flat = (np.asarray(mask) != 0).astype(np.uint8).flatten(order="F")
     if flat.size == 0:
         return []
     lib = load_rle()
@@ -106,21 +107,28 @@ def rle_string_decode(s: Union[str, bytes]) -> List[int]:
     if lib is not None and len(s):
         out = np.empty(len(s), dtype=np.dtype(ctypes.c_long))
         m = lib.tm_string_decode(s, len(s), out.ctypes.data_as(ctypes.POINTER(ctypes.c_long)))
-        if m < 0:
+        if m == -1:
             raise ValueError("truncated RLE string (continuation bit set on the final byte)")
+        if m == -2:
+            raise ValueError("overlong RLE varint (corrupt input)")
         return out[:m].tolist()
     counts: List[int] = []
     p = 0
     while p < len(s):
         x, k, more = 0, 0, True
         while more:
+            if k >= 13:  # no 64-bit value needs more than 13 five-bit groups
+                raise ValueError("overlong RLE varint (corrupt input)")
             c = s[p] - 48
             x |= (c & 0x1F) << (5 * k)
             more = bool(c & 0x20)
             p += 1
             k += 1
-            if not more and (c & 0x10):
+            if not more and (c & 0x10) and 5 * k < 64:
                 x |= -1 << (5 * k)
+        x &= (1 << 64) - 1  # normalize to 64-bit two's complement (match the C path)
+        if x >= 1 << 63:
+            x -= 1 << 64
         if len(counts) > 2:
             x += counts[-2]
         counts.append(x)

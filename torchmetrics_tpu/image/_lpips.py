@@ -53,6 +53,111 @@ class VGG16Features(nn.Module):
         return taps
 
 
+class AlexNetFeatures(nn.Module):
+    """AlexNet conv trunk returning the 5 LPIPS feature taps.
+
+    torchvision ``alexnet().features`` layout (the reference slices it at
+    every relu: ``functional/image/lpips.py`` ``Alexnet``); convs are
+    ``Conv_0..Conv_4`` for the checkpoint converter.
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        p = _mxu_precision(self.dtype)
+        taps = []
+        x = nn.relu(nn.Conv(64, (11, 11), (4, 4), padding=((2, 2), (2, 2)), dtype=self.dtype, precision=p)(x))
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(192, (5, 5), padding=((2, 2), (2, 2)), dtype=self.dtype, precision=p)(x))
+        taps.append(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.relu(nn.Conv(384, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, precision=p)(x))
+        taps.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, precision=p)(x))
+        taps.append(x)
+        x = nn.relu(nn.Conv(256, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, precision=p)(x))
+        taps.append(x)
+        return taps
+
+
+def _max_pool_ceil(x: Array, window: int = 3, stride: int = 2) -> Array:
+    """torch ``MaxPool2d(window, stride, ceil_mode=True)`` on NHWC.
+
+    Ceil mode pads the high edges just enough for the last partial window,
+    but windows may not START inside the padding (torch's rule) — hence the
+    output-size clamp before computing the pad.
+    """
+    import math
+
+    def pad_for(n: int) -> int:
+        out = math.ceil((n - window) / stride) + 1
+        if (out - 1) * stride >= n:
+            out -= 1
+        return max(0, (out - 1) * stride + window - n)
+
+    ph, pw = pad_for(x.shape[1]), pad_for(x.shape[2])
+    if ph or pw:
+        x = jnp.pad(x, ((0, 0), (0, ph), (0, pw), (0, 0)), constant_values=-jnp.inf)
+    return nn.max_pool(x, (window, window), strides=(stride, stride))
+
+
+class SqueezeNetFeatures(nn.Module):
+    """SqueezeNet-1.1 trunk returning the 7 LPIPS feature taps.
+
+    torchvision ``squeezenet1_1().features`` layout (the reference slices it
+    into 7 relu taps). Module names mirror the torchvision indices so the
+    converter maps ``features.{t}.squeeze`` -> ``fire{t}_squeeze`` etc.
+    """
+
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x: Array) -> List[Array]:
+        p = _mxu_precision(self.dtype)
+
+        def fire(x: Array, idx: int, squeeze: int, expand: int) -> Array:
+            s = nn.relu(
+                nn.Conv(squeeze, (1, 1), dtype=self.dtype, precision=p, name=f"fire{idx}_squeeze")(x)
+            )
+            e1 = nn.relu(
+                nn.Conv(expand, (1, 1), dtype=self.dtype, precision=p, name=f"fire{idx}_expand1")(s)
+            )
+            e3 = nn.relu(
+                nn.Conv(
+                    expand, (3, 3), padding=((1, 1), (1, 1)), dtype=self.dtype, precision=p,
+                    name=f"fire{idx}_expand3",
+                )(s)
+            )
+            return jnp.concatenate([e1, e3], axis=-1)
+
+        taps = []
+        x = nn.relu(nn.Conv(64, (3, 3), (2, 2), padding="VALID", dtype=self.dtype, precision=p)(x))
+        taps.append(x)  # relu1 (64)
+        x = _max_pool_ceil(x)
+        x = fire(x, 3, 16, 64)
+        x = fire(x, 4, 16, 64)
+        taps.append(x)  # relu2 (128)
+        x = _max_pool_ceil(x)
+        x = fire(x, 6, 32, 128)
+        x = fire(x, 7, 32, 128)
+        taps.append(x)  # relu3 (256)
+        x = _max_pool_ceil(x)
+        x = fire(x, 9, 48, 192)
+        taps.append(x)  # relu4 (384)
+        x = fire(x, 10, 48, 192)
+        taps.append(x)  # relu5 (384)
+        x = fire(x, 11, 64, 256)
+        taps.append(x)  # relu6 (512)
+        x = fire(x, 12, 64, 256)
+        taps.append(x)  # relu7 (512)
+        return taps
+
+
+_LPIPS_TRUNKS = {"vgg": VGG16Features, "alex": AlexNetFeatures, "squeeze": SqueezeNetFeatures}
+
+
 def _normalize_tensor(x: Array, eps: float = 1e-10) -> Array:
     norm = jnp.sqrt(jnp.sum(x**2, axis=-1, keepdims=True))
     return x / (norm + eps)
@@ -62,6 +167,7 @@ class LPIPSNet(nn.Module):
     """Full LPIPS: trunk + per-tap linear heads, spatial-averaged and summed."""
 
     dtype: Any = jnp.float32
+    net_type: str = "vgg"  # 'vgg' | 'alex' | 'squeeze', like the reference
 
     @nn.compact
     def __call__(self, img0: Array, img1: Array) -> Array:
@@ -77,7 +183,7 @@ class LPIPSNet(nn.Module):
         # memory doubles accordingly — halve the LPIPS batch if a previous
         # batch size was sized to fill HBM
         n = x0.shape[0]
-        trunk = VGG16Features(name="net", dtype=self.dtype)
+        trunk = _LPIPS_TRUNKS[self.net_type](name="net", dtype=self.dtype)
         feats = trunk(jnp.concatenate([x0, x1], axis=0))
         feats0 = [f[:n] for f in feats]
         feats1 = [f[n:] for f in feats]
@@ -101,16 +207,11 @@ class LPIPSExtractor(PickleableJitMixin):
     def __init__(self, net_type: str = "vgg", weights_path: str = None, seed: int = 0, compute_dtype=None) -> None:
         if net_type not in ("vgg", "alex", "squeeze"):
             raise ValueError(f"Argument `net_type` must be one of 'vgg', 'alex' or 'squeeze', but got {net_type}")
-        if net_type != "vgg":
-            from torchmetrics_tpu.utilities.prints import rank_zero_warn
-
-            rank_zero_warn(
-                f"net_type='{net_type}' falls back to the VGG trunk in this implementation;"
-                " pass a custom `net` callable for other trunks."
-            )
-        # bfloat16 trunk by default: VGG convs hit the MXU at twice the fp32
+        # bfloat16 trunk by default: the convs hit the MXU at twice the fp32
         # rate; params and the per-tap distance heads stay float32
-        self.net = LPIPSNet(dtype=compute_dtype if compute_dtype is not None else jnp.bfloat16)
+        self.net = LPIPSNet(
+            dtype=compute_dtype if compute_dtype is not None else jnp.bfloat16, net_type=net_type
+        )
         dummy = jnp.zeros((1, 3, 64, 64), jnp.float32)
         if weights_path:
             from torchmetrics_tpu.image._inception import load_variables_npz

@@ -74,22 +74,25 @@ long tm_string_encode(const long *counts, long m, char *out) {
 }
 
 /* compressed string -> counts (counts_out sized >= string length).
- * Returns the run count, or -1 on a truncated varint (corrupt input). */
+ * Returns the run count, -1 on a truncated varint, -2 on an overlong
+ * varint (>13 five-bit groups; no 64-bit value needs more). Accumulation
+ * is unsigned so the 13th group's shift stays defined behavior. */
 long tm_string_decode(const char *s, long len, long *counts_out) {
     long m = 0, p = 0;
     while (p < len) {
-        long x = 0;
+        unsigned long ux = 0;
         int k = 0, more = 1;
         while (more) {
             if (p >= len) return -1; /* continuation bit set on the last byte */
-            if (k >= 12) return -1;  /* >=13 groups would shift past 64 bits (corrupt input) */
+            if (k >= 13) return -2;  /* overlong varint */
             long c = (long)s[p] - 48;
-            x |= (c & 0x1f) << (5 * k);
+            if (5 * k < 64) ux |= (unsigned long)(c & 0x1f) << (5 * k);
             more = (c & 0x20) != 0;
             p++;
             k++;
-            if (!more && (c & 0x10)) x |= -1L << (5 * k);
+            if (!more && (c & 0x10) && 5 * k < 64) ux |= ~0UL << (5 * k);
         }
+        long x = (long)ux;
         if (m > 2) x += counts_out[m - 2];
         counts_out[m++] = x;
     }
