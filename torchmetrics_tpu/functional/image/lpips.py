@@ -44,7 +44,7 @@ def learned_perceptual_image_patch_similarity(
         >>> img1 = jax.random.uniform(k1, (5, 3, 64, 64)) * 2 - 1
         >>> img2 = jax.random.uniform(k2, (5, 3, 64, 64)) * 2 - 1
         >>> d = learned_perceptual_image_patch_similarity(img1, img2, net_type='squeeze')
-        >>> bool(d >= 0)
+        >>> bool(jnp.isfinite(d))  # sign is meaningless under random head weights
         True
     """
     valid_net_type = ("vgg", "alex", "squeeze")
