@@ -139,6 +139,17 @@ def _bench_class_api() -> tuple:
             eager.update(preds, target)
         return float(eager.compute())
 
+    # the true out-of-the-box configuration: ctor defaults, validate_args=True.
+    # Round-5: the value checks compile fused into the XLA update (device-side
+    # violation flags, surfaced at compute), so this path auto-compiles too.
+    default = MulticlassAccuracy(num_classes=NUM_CLASSES)
+
+    def run_default():
+        default.reset()
+        for _ in range(n_updates):
+            default.update(preds, target)
+        return float(default.compute())
+
     jitted = MulticlassAccuracy(num_classes=NUM_CLASSES, validate_args=False)
 
     def run_jit():
@@ -160,6 +171,7 @@ def _bench_class_api() -> tuple:
         n_updates / _min_time(run_eager, reps=3),
         n_updates / _min_time(run_jit, reps=3),
         n_updates / _min_time(run_forward, reps=3),
+        n_updates / _min_time(run_default, reps=3),
     )
 
 
@@ -194,17 +206,28 @@ def _bench_class_api_torch_baseline() -> tuple:
             for _ in range(n_updates):
                 fmetric(preds, target)
             float(fmetric.compute())
+
+        # ctor-default on both sides: the reference's validate_args also
+        # defaults True, so this is the honest out-of-the-box comparison
+        dmetric = torchmetrics.classification.MulticlassAccuracy(num_classes=NUM_CLASSES)
+
+        def run_default():
+            dmetric.reset()
+            for _ in range(n_updates):
+                dmetric.update(preds, target)
+            float(dmetric.compute())
     else:  # reference checkout unavailable: plain torch stat-scores loop
         def run():
             for _ in range(n_updates):
                 lbl = preds.argmax(dim=1)
                 (lbl == target).sum()
 
-        run_fwd = run
+        run_fwd = run_default = run
 
     return (
         n_updates / _min_time(run, reps=3, subtract_rtt=False),
         n_updates / _min_time(run_fwd, reps=3, subtract_rtt=False),
+        n_updates / _min_time(run_default, reps=3, subtract_rtt=False),
         torchmetrics is not None,
     )
 
@@ -863,8 +886,8 @@ def main() -> None:
         )
     )
 
-    eager_rate, jit_rate, fwd_rate = _bench_class_api()
-    class_base, class_base_fwd, have_ref = _bench_class_api_torch_baseline()
+    eager_rate, jit_rate, fwd_rate, default_rate = _bench_class_api()
+    class_base, class_base_fwd, class_base_default, have_ref = _bench_class_api_torch_baseline()
     base_label = "reference class API on torch CPU" if have_ref else "plain torch stat-scores loop (reference unavailable)"
     print(
         json.dumps(
@@ -874,6 +897,18 @@ def main() -> None:
                 "unit": f"updates/sec (default Metric.update — auto-compiled on repeat shapes, batch={BATCH},"
                 f" C={NUM_CLASSES}; baseline = {base_label})",
                 "vs_baseline": round(eager_rate / class_base, 3),
+            }
+        )
+    )
+    print(
+        json.dumps(
+            {
+                "metric": "class_api_default_updates_per_sec",
+                "value": round(default_rate, 2),
+                "unit": f"updates/sec (ctor-default Metric.update, validate_args=True on BOTH sides —"
+                f" fused compiled value checks vs the reference's per-batch host checks, batch={BATCH},"
+                f" C={NUM_CLASSES}; baseline = {base_label} — ctor-default)",
+                "vs_baseline": round(default_rate / class_base_default, 3),
             }
         )
     )
@@ -1065,6 +1100,7 @@ def _parse_bench_artifact(path: str):
 _README_LABELS = {
     "multiclass_accuracy_updates_per_sec": ("Fused-scan streaming accuracy", "{v:,.0f} updates/s"),
     "class_api_updates_per_sec": ("Class API `update()`", "{v:,.0f} updates/s"),
+    "class_api_default_updates_per_sec": ("Class API `update()` ctor-default", "{v:,.0f} updates/s"),
     "class_api_jit_updates_per_sec": ("Class API `jit_update()`", "{v:,.0f} updates/s"),
     "class_api_forward_per_sec": ("Class API `forward()` dual-mode", "{v:,.0f} forwards/s"),
     "map_compute_wallclock_100k_boxes": ("mAP `compute()` @100k boxes", "{v:.0f} ms"),

@@ -78,17 +78,93 @@ class TestAutoUpdateParity:
             np.testing.assert_allclose(float(va), float(ve), rtol=1e-6)
         np.testing.assert_allclose(float(auto.compute()), float(eager.compute()), rtol=1e-6)
 
-    def test_validate_args_true_never_compiles_and_still_raises(self):
+    def test_validate_args_true_compiles_with_fused_checks(self):
+        # round-5: metrics with a traced validator compile the ctor-default
+        # (validate_args=True) path; the value checks run fused in the XLA
+        # step and violations surface at the next host synchronization point
         m = BinaryStatScores()  # validate_args defaults True
         good_p = jnp.asarray(RNG.random(8).astype(np.float32))
         good_t = jnp.asarray(RNG.integers(0, 2, 8))
         m.update(good_p, good_t)
         m.update(good_p, good_t)
         m.update(good_p, good_t)
-        assert "_auto_update_fn" not in m.__dict__
+        assert "_auto_update_fn" in m.__dict__  # compiled despite validate_args=True
         bad_t = jnp.asarray(np.full(8, 7))  # same shape/dtype as good_t
+        m.update(good_p, bad_t)  # compiled replay: records the violation device-side
+        with pytest.raises(RuntimeError, match="outside of the expected set"):
+            m.compute()
+        # the raise clears the pending flags; the metric remains usable
+        float(jnp.sum(m.compute()))
+
+    def test_violating_batch_does_not_contaminate_state(self):
+        # the eager/reference path raises BEFORE merging a bad batch; the
+        # compiled path must equally drop its contribution
+        m = BinaryStatScores()
+        clean = BinaryStatScores(auto_compile=False)
+        p = jnp.asarray(RNG.random(8).astype(np.float32))
+        t = jnp.asarray(RNG.integers(0, 2, 8))
+        for _ in range(3):
+            m.update(p, t)
+            clean.update(p, t)
+        m.update(p, jnp.asarray(np.full(8, 7)))  # compiled, records violation
+        with pytest.raises(RuntimeError, match="outside of the expected set"):
+            m.compute()
+        np.testing.assert_array_equal(np.asarray(m.compute()), np.asarray(clean.compute()))
+
+    def test_mixed_dtype_signatures_keep_flags_aligned(self):
+        # float-preds and int-preds signatures must produce the same flag
+        # vector length (the int-only check is constant-False for floats),
+        # so streaming both through one metric keeps messages aligned
+        m = BinaryStatScores()
+        pf = jnp.asarray(RNG.random(8).astype(np.float32))
+        pi = jnp.asarray(RNG.integers(0, 2, 8))
+        t = jnp.asarray(RNG.integers(0, 2, 8))
+        for _ in range(3):
+            m.update(pf, t)  # float-preds signature
+        for _ in range(3):
+            m.update(pi, t)  # int-preds signature (compiles separately)
+        assert not m._auto_disabled
+        # violate the int-preds-only check on the compiled int signature
+        m.update(jnp.asarray(np.full(8, 3)), t)
+        with pytest.raises(RuntimeError, match="binary set"):
+            m.compute()
+
+    def test_validate_args_true_first_call_still_raises_eagerly(self):
+        m = BinaryStatScores()
+        good_p = jnp.asarray(RNG.random(8).astype(np.float32))
+        bad_t = jnp.asarray(np.full(8, 7))
         with pytest.raises(RuntimeError, match="Detected the following values"):
             m.update(good_p, bad_t)
+
+    def test_validated_compiled_values_match_eager(self):
+        auto = BinaryStatScores()  # validate_args=True, auto-compiles
+        eager = BinaryStatScores(auto_compile=False)
+        for _ in range(4):
+            p = jnp.asarray(RNG.random(16).astype(np.float32))
+            t = jnp.asarray(RNG.integers(0, 2, 16))
+            auto.update(p, t)
+            eager.update(p, t)
+        np.testing.assert_array_equal(np.asarray(auto.compute()), np.asarray(eager.compute()))
+
+    def test_update_mutating_plain_attribute_disables_auto(self):
+        # advisor r4: a custom subclass mutating an unregistered python
+        # attribute must keep the eager path (tracing would freeze it)
+        class Counting(SumMetric):
+            def __init__(self):
+                super().__init__()
+                self.n_calls = 0
+
+            def update(self, value):
+                self.n_calls += 1
+                super(Counting, self).update(value)
+
+        m = Counting()
+        x = jnp.asarray(np.ones(4, np.float32))
+        for _ in range(5):
+            m.update(x)
+        assert m._auto_disabled
+        assert m.n_calls == 5
+        np.testing.assert_allclose(float(m.compute()), 20.0, rtol=1e-6)
 
     def test_aggregator_nan_check_falls_back(self):
         # bool(jnp.any(nans)) cannot trace: first compiled attempt must

@@ -14,6 +14,8 @@ from torchmetrics_tpu.functional.classification.confusion_matrix import (
     _binary_confusion_matrix_format,
     _binary_confusion_matrix_tensor_validation,
     _binary_confusion_matrix_update,
+    _binary_confusion_matrix_value_flags,
+    _confusion_matrix_no_value_flags,
     _multiclass_confusion_matrix_arg_validation,
     _multiclass_confusion_matrix_compute,
     _multiclass_confusion_matrix_format,
@@ -74,6 +76,9 @@ class BinaryConfusionMatrix(Metric):
         confmat = _binary_confusion_matrix_update(preds, target, valid)
         self.confmat = self.confmat + confmat
 
+    def _traced_value_flags(self, preds: Array, target: Array):
+        return _binary_confusion_matrix_value_flags(preds, target, self.ignore_index)
+
     def compute(self) -> Array:
         return _binary_confusion_matrix_compute(self.confmat, self.normalize)
 
@@ -122,6 +127,9 @@ class MulticlassConfusionMatrix(Metric):
         confmat = _multiclass_confusion_matrix_update(preds, target, valid, self.num_classes)
         self.confmat = self.confmat + confmat
 
+    def _traced_value_flags(self, preds: Array, target: Array):
+        return _confusion_matrix_no_value_flags(preds, target)
+
     def compute(self) -> Array:
         return _multiclass_confusion_matrix_compute(self.confmat, self.normalize)
 
@@ -160,6 +168,9 @@ class MultilabelConfusionMatrix(Metric):
         )
         confmat = _multilabel_confusion_matrix_update(preds, target, valid, self.num_labels)
         self.confmat = self.confmat + confmat
+
+    def _traced_value_flags(self, preds: Array, target: Array):
+        return _confusion_matrix_no_value_flags(preds, target)
 
     def compute(self) -> Array:
         return _multilabel_confusion_matrix_compute(self.confmat, self.normalize)

@@ -20,16 +20,19 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
     _binary_stat_scores_format,
     _binary_stat_scores_tensor_validation,
     _binary_stat_scores_update,
+    _binary_stat_scores_value_flags,
     _multiclass_stat_scores_arg_validation,
     _multiclass_stat_scores_compute,
     _multiclass_stat_scores_format,
     _multiclass_stat_scores_tensor_validation,
     _multiclass_stat_scores_update,
+    _multiclass_stat_scores_value_flags,
     _multilabel_stat_scores_arg_validation,
     _multilabel_stat_scores_compute,
     _multilabel_stat_scores_format,
     _multilabel_stat_scores_tensor_validation,
     _multilabel_stat_scores_update,
+    _multilabel_stat_scores_value_flags,
 )
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utilities.data import dim_zero_cat
@@ -122,6 +125,9 @@ class BinaryStatScores(_AbstractStatScores):
         tp, fp, tn, fn = _binary_stat_scores_update(preds, target, valid, self.multidim_average)
         self._update_state(tp, fp, tn, fn)
 
+    def _traced_value_flags(self, preds: Array, target: Array):
+        return _binary_stat_scores_value_flags(preds, target, self.ignore_index)
+
     def compute(self) -> Array:
         """Final ``[tp, fp, tn, fn, support]``."""
         tp, fp, tn, fn = self._final_state()
@@ -179,6 +185,9 @@ class MulticlassStatScores(_AbstractStatScores):
         )
         self._update_state(tp, fp, tn, fn)
 
+    def _traced_value_flags(self, preds: Array, target: Array):
+        return _multiclass_stat_scores_value_flags(preds, target, self.num_classes, self.ignore_index)
+
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()
         return _multiclass_stat_scores_compute(tp, fp, tn, fn, self.average, self.multidim_average)
@@ -223,6 +232,9 @@ class MultilabelStatScores(_AbstractStatScores):
         )
         tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, valid, self.multidim_average)
         self._update_state(tp, fp, tn, fn)
+
+    def _traced_value_flags(self, preds: Array, target: Array):
+        return _multilabel_stat_scores_value_flags(preds, target, self.ignore_index)
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()

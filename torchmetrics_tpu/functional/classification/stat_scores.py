@@ -21,7 +21,12 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
-from torchmetrics_tpu.utilities.checks import _check_same_shape, _is_concrete
+from torchmetrics_tpu.utilities.checks import (
+    _check_same_shape,
+    _is_concrete,
+    _no_value_flags,
+    _target_set_value_flags,
+)
 from torchmetrics_tpu.utilities.compute import _safe_divide, normalize_logits_if_needed
 from torchmetrics_tpu.utilities.data import select_topk
 
@@ -77,6 +82,31 @@ def _binary_stat_scores_tensor_validation(
                 )
     if multidim_average != "global" and preds.ndim < 2:
         raise ValueError("Expected input to be at least 2D when multidim_average is set to `samplewise`")
+
+
+def _binary_stat_scores_value_flags(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Tuple[str, ...], Array]:
+    """Traceable form of the binary value checks: ``(messages, violation_flags)``.
+
+    Mirrors exactly the concreteness-gated checks of
+    :func:`_binary_stat_scores_tensor_validation`, but as jnp boolean
+    reductions with no host sync — the fused-validation contract of
+    ``Metric._traced_value_flags`` (the compiled ``validate_args=True`` path).
+    The flag vector is the same length for every argument signature: the
+    int-preds check is constant-False for float preds (where it does not
+    apply) rather than absent, keeping the OR accumulator aligned.
+    """
+    preds = jnp.asarray(preds)
+    msgs_t, flag_t = _target_set_value_flags(target, ignore_index)
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        bad_p = jnp.zeros((), dtype=jnp.bool_)
+    else:
+        bad_p = jnp.any((preds != 0) & (preds != 1))
+    msgs = msgs_t + ("Detected values in `preds` outside of the expected binary set [0, 1].",)
+    return msgs, jnp.concatenate([flag_t, bad_p[None]])
 
 
 def _binary_stat_scores_format(
@@ -238,6 +268,29 @@ def _multiclass_stat_scores_tensor_validation(
                 raise RuntimeError(
                     f"Detected more unique values in `preds` than expected. Expected only {num_classes}."
                 )
+
+
+def _multiclass_stat_scores_value_flags(
+    preds: Array,
+    target: Array,
+    num_classes: int,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Tuple[str, ...], Array]:
+    """Traceable form of the multiclass value checks (see binary counterpart —
+    same signature-stable flag-length contract)."""
+    preds = jnp.asarray(preds)
+    target = jnp.asarray(target)
+    valid = jnp.ones(target.shape, dtype=jnp.bool_) if ignore_index is None else target != ignore_index
+    bad_t = jnp.any(valid & ((target < 0) | (target >= num_classes)))
+    if jnp.issubdtype(preds.dtype, jnp.floating):
+        bad_p = jnp.zeros((), dtype=jnp.bool_)
+    else:
+        bad_p = jnp.any((preds < 0) | (preds >= num_classes))
+    msgs = (
+        f"Detected more unique values in `target` than expected. Expected only {num_classes}.",
+        f"Detected more unique values in `preds` than expected. Expected only {num_classes}.",
+    )
+    return msgs, jnp.stack([bad_t, bad_p])
 
 
 def _multiclass_stat_scores_format(
@@ -406,6 +459,17 @@ def _multilabel_stat_scores_tensor_validation(
         )
     if multidim_average != "global" and preds.ndim < 3:
         raise ValueError("Expected input to be at least 3D when multidim_average is set to `samplewise`")
+
+
+def _multilabel_stat_scores_value_flags(
+    preds: Array,
+    target: Array,
+    ignore_index: Optional[int] = None,
+) -> Tuple[Tuple[str, ...], Array]:
+    """Multilabel validation is metadata-only (shapes/dims, checked at trace
+    time), so there are no value checks to fuse — the empty tuple tells the
+    auto-compile path it may compile ``validate_args=True`` updates freely."""
+    return _no_value_flags(preds, target)
 
 
 def _multilabel_stat_scores_format(

@@ -119,6 +119,8 @@ def rle_string_decode(s: Union[str, bytes]) -> List[int]:
         while more:
             if k >= 13:  # no 64-bit value needs more than 13 five-bit groups
                 raise ValueError("overlong RLE varint (corrupt input)")
+            if p >= len(s):  # mirror the native path's -1: same error type either codec
+                raise ValueError("truncated RLE string (continuation bit set on the final byte)")
             c = s[p] - 48
             x |= (c & 0x1F) << (5 * k)
             more = bool(c & 0x20)

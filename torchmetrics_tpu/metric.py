@@ -25,7 +25,7 @@ import functools
 import inspect
 from abc import ABC, abstractmethod
 from copy import deepcopy
-from typing import Any, Callable, Dict, List, Optional, Sequence, Union
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
@@ -187,6 +187,13 @@ class Metric(ABC):
         self._auto_names: Optional[List[str]] = None
         self._auto_disabled = False
         self._auto_forward_disabled = False
+        # compiled-validation bookkeeping: when `validate_args=True` and the
+        # metric provides `_traced_value_flags`, the per-batch value checks run
+        # fused inside the compiled update and OR-accumulate device-side here;
+        # violations surface at the next host synchronization point
+        self._viol_msgs: Optional[Tuple[str, ...]] = None
+        self._viol_flags: Optional[Array] = None
+        self._traced_validation_supported: Optional[bool] = None
 
     # ------------------------------------------------------------------ state
     @property
@@ -385,9 +392,20 @@ class Metric(ABC):
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             if self._try_auto_update(args, kwargs):
                 return None
+            self._check_pending_violations()
             self._computed = None
             self._update_count += 1
+            # only pay the fingerprint where a compiled path could engage
+            guard = self._auto_eligible()
+            if guard:
+                before = self._host_attr_snapshot()
             update(*args, **kwargs)
+            if guard and self._host_attr_snapshot() != before:
+                # update() mutates plain (unregistered) python attributes; a
+                # traced replay would silently freeze those side effects, so
+                # the compiled paths are permanently off for this instance
+                self._auto_disabled = True
+                self._auto_forward_disabled = True
             if self._dtype_policy is not None:
                 self._apply_dtype_policy()
             if self.compute_on_cpu:
@@ -396,6 +414,42 @@ class Metric(ABC):
 
         wrapped_func.__wrapped_by_metric__ = True  # type: ignore[attr-defined]
         return wrapped_func
+
+    def _host_attr_snapshot(self) -> List[tuple]:
+        """Fingerprint of plain (non-state, non-private) host attributes.
+
+        Auto-compile replays ``update()`` as a traced executable, which would
+        silently freeze host-side mutations of unregistered attributes (a
+        python counter, a list kept outside ``add_state``). Every eager pass
+        fingerprints those attributes; any observed change disables the
+        compiled paths for this instance. Private (``_``-prefixed) attributes
+        are the metric machinery's own bookkeeping and are not guarded.
+        """
+        def fp(v: Any):
+            # one-level value fingerprint; arrays/objects degrade to identity.
+            # Mutations nested deeper than one container level (or occurring
+            # only on inputs never seen eagerly) are out of the guard's reach.
+            if isinstance(v, (bool, int, float, complex, str, bytes, type(None))):
+                return v
+            return id(v)
+
+        snap: List[tuple] = []
+        for k, v in self.__dict__.items():
+            if k.startswith("_") or k in self._defaults:
+                continue
+            if _is_array(v) or isinstance(v, RingBuffer) or callable(v):
+                continue
+            if isinstance(v, (bool, int, float, complex, str, bytes, type(None))):
+                snap.append((k, v))
+            elif isinstance(v, dict) and len(v) <= 16:
+                snap.append((k, id(v), tuple((fp(dk), fp(dv)) for dk, dv in v.items())))
+            elif isinstance(v, (list, tuple)) and len(v) <= 16:
+                snap.append((k, id(v), tuple(fp(i) for i in v)))
+            elif isinstance(v, (list, dict, set, tuple)):
+                snap.append((k, id(v), len(v)))
+            else:
+                snap.append((k, id(v)))
+        return snap
 
     def _apply_dtype_policy(self) -> None:
         """Re-cast floating states to the ``set_dtype`` policy after an update.
@@ -442,6 +496,7 @@ class Metric(ABC):
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            self._check_pending_violations()
             if not self.update_called:
                 rank_zero_warn(
                     f"The ``compute`` method of metric {self.__class__.__name__}"
@@ -732,17 +787,85 @@ class Metric(ABC):
     def _auto_eligible(self) -> bool:
         """Base gate for transparent compilation of ``update``/``forward``.
 
-        Metrics with ``validate_args=True`` keep the eager path: their
-        per-batch value checks (host-side, concreteness-gated) would silently
-        stop running after trace time. ``compute_on_cpu`` implies host-resident
-        growing states, which the compiled path cannot maintain.
+        Metrics with ``validate_args=True`` compile only when they provide a
+        traced validator (:meth:`_traced_value_flags`): the per-batch value
+        checks then run fused inside the XLA step and surface asynchronously
+        (see :meth:`_check_pending_violations`). Without a traced validator
+        the eager path keeps running the host-side checks. ``compute_on_cpu``
+        implies host-resident growing states, which the compiled path cannot
+        maintain.
         """
         return (
             self.auto_compile
             and not self._auto_disabled
             and not self.compute_on_cpu
-            and getattr(self, "validate_args", None) is not True
+            and (getattr(self, "validate_args", None) is not True or self._supports_traced_validation())
         )
+
+    def _traced_value_flags(self, *args: Any, **kwargs: Any) -> Optional[Tuple[Tuple[str, ...], Array]]:
+        """Traceable value-dependent input validation: ``(messages, flags)``.
+
+        Subclasses that support compiled validation return a static tuple of
+        violation messages and a same-length boolean array (``flags[i]=True``
+        means the batch violates check ``i``), computed with jnp ops only —
+        no host synchronization. The message tuple must not depend on the
+        argument values. The base returns ``None``: metrics without a traced
+        validator keep the eager path whenever ``validate_args=True``.
+        """
+        return None
+
+    def _supports_traced_validation(self) -> bool:
+        sup = self._traced_validation_supported
+        if sup is None:
+            sup = type(self)._traced_value_flags is not Metric._traced_value_flags
+            self._traced_validation_supported = sup
+        return sup
+
+    def _auto_validate(self) -> bool:
+        """True when compiled updates must carry the fused value checks."""
+        return getattr(self, "validate_args", None) is True and self._supports_traced_validation()
+
+    def _prime_violation_state(self, treedef, dynamic: List[Any], statics) -> bool:
+        """Learn the violation-message vector (once) before the first compile.
+
+        Returns True when the metric has value checks to fuse; False when its
+        validation is metadata-only (compiled updates then skip the flag
+        carry entirely).
+        """
+        if self._viol_msgs is None:
+            a, kw = self._merge_batch_args(treedef, dynamic, statics)
+            msgs, _ = self._traced_value_flags(*a, **kw)
+            self._viol_msgs = tuple(msgs)
+        if self._viol_flags is None and self._viol_msgs:
+            object.__setattr__(self, "_viol_flags", jnp.zeros(len(self._viol_msgs), dtype=bool))
+        return bool(self._viol_msgs)
+
+    def _check_pending_violations(self) -> None:
+        """Surface value-check violations recorded by compiled updates.
+
+        With auto-compile the ``validate_args=True`` value checks run fused
+        inside the XLA step and OR-accumulate into a device-resident flag
+        vector — a per-batch host readback would serialize the TPU stream
+        (and costs a full RTT through a remote-device tunnel). Violations
+        therefore surface at the next host synchronization point — the next
+        eager ``update``/``forward``, ``compute()``, or ``reset()`` — the
+        same way CUDA device-side asserts surface at the next sync. The
+        first call with any argument signature always validates eagerly, so
+        single-batch misuse still raises immediately with the reference's
+        exact message.
+        """
+        flags = self._viol_flags
+        if flags is None:
+            return
+        vals = np.asarray(flags)
+        if vals.any():
+            msgs = [m for m, v in zip(self._viol_msgs, vals) if v]
+            object.__setattr__(self, "_viol_flags", jnp.zeros_like(flags))
+            raise RuntimeError(
+                f"{msgs[0]} (raised asynchronously: with `auto_compile` the `validate_args=True`"
+                " value checks run fused inside the compiled update and surface at the next host"
+                " synchronization point)"
+            )
 
     def _auto_state_names(self, method_name: str) -> Optional[List[str]]:
         """Fixed-shape state names for the auto paths (cached when stable)."""
@@ -802,20 +925,44 @@ class Metric(ABC):
         if names is None:
             return False
         states = {n: getattr(self, n) for n in names}
+        validate = self._auto_validate()
+        if validate:
+            try:
+                validate = self._prime_violation_state(treedef, dynamic, statics)
+            except Exception:
+                self._auto_disabled = True
+                return False
 
         def build():
-            def _pure(states_, dyn):
+            def _pure(states_, viol, dyn):
                 a, kw = self._merge_batch_args(treedef, dyn, statics)
-                return self._traced_update(names, states_, a, kw)
+                new_states_ = self._traced_update(names, states_, a, kw)
+                if validate:
+                    msgs, flags = self._traced_value_flags(*a, **kw)
+                    if tuple(msgs) != self._viol_msgs:  # static, checked at trace time
+                        raise TorchMetricsUserError(
+                            "traced validation messages changed across argument signatures"
+                        )
+                    viol = viol | flags
+                    # a violating batch must not contaminate the state — the
+                    # eager/reference path raises before committing, so the
+                    # compiled path drops the batch's contribution instead
+                    bad = jnp.any(flags)
+                    new_states_ = jax.tree_util.tree_map(
+                        lambda old, new: jnp.where(bad, old, new), states_, new_states_
+                    )
+                return new_states_, viol
 
             return _pure
 
         try:
-            fn = self._compiled_update("_auto_update_fn", (treedef, statics), build)
-            new_states = fn(states, dynamic)
+            fn = self._compiled_update("_auto_update_fn", (treedef, statics, validate), build)
+            new_states, new_viol = fn(states, self._viol_flags if validate else None, dynamic)
         except Exception:
             self._auto_disabled = True
             return False
+        if validate:
+            object.__setattr__(self, "_viol_flags", new_viol)
         seen[sig] += 1
         self._computed = None
         self._update_count += 1
@@ -875,12 +1022,32 @@ class Metric(ABC):
         states = {n: getattr(self, n) for n in names}
         reductions = {n: self._reductions[n] for n in names}
         defaults = {n: jnp.asarray(self._defaults[n]) for n in names}
+        validate = self._auto_validate()
+        if validate:
+            try:
+                validate = self._prime_violation_state(treedef, dynamic, statics)
+            except Exception:
+                self._auto_forward_disabled = True
+                return False, None
 
         def build():
-            def _pure(states_, dyn, prev_count):
+            def _pure(states_, viol, dyn, prev_count):
                 a, kw = self._merge_batch_args(treedef, dyn, statics)
                 batch = self._traced_update(names, defaults, a, kw)
                 batch_val = _squeeze_if_scalar(self._traced_compute(names, batch))
+                bad = jnp.zeros((), dtype=jnp.bool_)
+                if validate:
+                    msgs, flags = self._traced_value_flags(*a, **kw)
+                    if tuple(msgs) != self._viol_msgs:  # static, checked at trace time
+                        raise TorchMetricsUserError(
+                            "traced validation messages changed across argument signatures"
+                        )
+                    viol = viol | flags
+                    bad = jnp.any(flags)
+                # the count carries as int32 (exact for any realistic stream,
+                # unlike a f32 carry which saturates at 2^24) and converts to
+                # float only where the running-mean weights need it
+                prev_f = prev_count.astype(jnp.float32)
                 merged = {}
                 for n in names:
                     reduce_fn = reductions[n]
@@ -888,14 +1055,19 @@ class Metric(ABC):
                     if reduce_fn == "sum":
                         merged[n] = g + loc
                     elif reduce_fn == "mean":
-                        merged[n] = (prev_count * g + loc) / (prev_count + 1.0)
+                        merged[n] = (prev_f * g + loc) / (prev_f + 1.0)
                     elif reduce_fn == "max":
                         merged[n] = jnp.maximum(g, loc)
                     elif reduce_fn == "min":
                         merged[n] = jnp.minimum(g, loc)
                     else:
                         merged[n] = reduce_fn(jnp.stack([g, loc]))
-                return merged, batch_val, prev_count + 1.0
+                    if validate:
+                        # violating batches contribute nothing (the eager
+                        # path raises before merging) — state and count both
+                        # hold so post-reset streams resume uncontaminated
+                        merged[n] = jnp.where(bad, g, merged[n])
+                return merged, batch_val, viol, prev_count + jnp.where(bad, 0, 1).astype(prev_count.dtype)
 
             return _pure
 
@@ -903,13 +1075,17 @@ class Metric(ABC):
         # streaming never pays a per-call host->device transfer for it
         cnt = self.__dict__.get("_auto_cnt")
         if cnt is None or cnt[0] != self._update_count:
-            cnt = (self._update_count, jnp.float32(self._update_count))
+            cnt = (self._update_count, jnp.int32(self._update_count))
         try:
-            fn = self._compiled_update("_auto_forward_fn", (treedef, statics), build)
-            new_states, batch_val, new_cnt = fn(states, dynamic, cnt[1])
+            fn = self._compiled_update("_auto_forward_fn", (treedef, statics, validate), build)
+            new_states, batch_val, new_viol, new_cnt = fn(
+                states, self._viol_flags if validate else None, dynamic, cnt[1]
+            )
         except Exception:
             self._auto_forward_disabled = True
             return False, None
+        if validate:
+            object.__setattr__(self, "_viol_flags", new_viol)
         object.__setattr__(self, "_auto_cnt", (self._update_count + 1, new_cnt))
         seen[sig] += 1
         self._update_count += 1
@@ -1046,6 +1222,7 @@ class Metric(ABC):
     # ---------------------------------------------------------------- reset
     def reset(self) -> None:
         """Reset states to their defaults (reference ``metric.py:673-688``)."""
+        self._check_pending_violations()
         self._update_count = 0
         self._forward_cache = None
         self._computed = None

@@ -66,6 +66,34 @@ def _num_samples_check(preds: Array, target: Array) -> None:
         raise RuntimeError("Predictions and targets must have the same number of samples.")
 
 
+# ------------------------------------------------------------------ traced
+# Building blocks for ``Metric._traced_value_flags`` (the fused-validation
+# contract of the compiled ``validate_args=True`` path): each returns a
+# static message tuple plus a same-length boolean violation vector computed
+# with jnp ops only. The message tuple — and therefore the flag length —
+# must be identical across every argument signature of a metric instance
+# (dtype-inapplicable checks contribute a constant-False flag, never a
+# missing entry), so the device-side OR accumulator stays aligned.
+
+
+def _target_set_value_flags(target: Array, ignore_index: Optional[int] = None):
+    """Flag for "target values outside {0, 1} (∪ ignore_index)"."""
+    target = jnp.asarray(target)
+    allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
+    ok = (target == 0) | (target == 1)
+    if ignore_index is not None:
+        ok = ok | (target == ignore_index)
+    msgs = (f"Detected values in `target` outside of the expected set {sorted(allowed)}.",)
+    return msgs, jnp.any(~ok)[None]
+
+
+def _no_value_flags(*_args: Array, **_kwargs: Array):
+    """For metrics whose validation is metadata-only (checked at trace time):
+    no value checks to fuse, compiled ``validate_args=True`` updates are
+    unconditionally safe."""
+    return (), jnp.zeros((0,), dtype=jnp.bool_)
+
+
 def check_forward_full_state_property(
     metric_class,
     init_args: Optional[dict] = None,

@@ -98,7 +98,7 @@ class BootStrapper(WrapperMetric):
         self._stacked: Optional[Dict[str, Array]] = None  # name -> (N, ...) leading-axis states
         self._stacked_pending = 0  # fast updates not yet reflected in self.metrics
         self._fast_disabled = False
-        self._fast_checked = False  # additivity self-check passed
+        self._fast_checked_sizes: set = set()  # batch sizes whose additivity self-check passed
         self._loop_warmed = False  # first batch runs the loop path (children validate eagerly)
         self._fast_fns: Dict[Any, Any] = {}
 
@@ -203,11 +203,17 @@ class BootStrapper(WrapperMetric):
             return False
         size = dims.pop()
         try:
-            if not self._fast_checked:
+            # the check is keyed per batch size: a size-1 batch passes it
+            # trivially for ANY metric (full delta == the one per-sample
+            # delta), so it must never license larger batches. Size 1 itself
+            # needs no check — for sum states, k resamples of the single
+            # sample contribute exactly k*delta, which is what the count
+            # matmul computes.
+            if size > 1 and size not in self._fast_checked_sizes:
                 if not self._additivity_holds(names, treedef, statics, dynamic):
                     self._fast_disabled = True
                     return False
-                self._fast_checked = True
+                self._fast_checked_sizes.add(size)
             key = (treedef, statics, size, str(template._dtype_policy))
             fn = self._fast_fns.get(key)
             if fn is None:
