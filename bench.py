@@ -621,18 +621,31 @@ def _bench_fid_imgs_per_sec() -> tuple:
         from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
 
         ext = InceptionFeatureExtractor(feature="2048")
+        # bf16-stored weights halve the trunk's HBM weight traffic; measure
+        # both and report the faster (a no-gain result is itself diagnostic:
+        # the trunk is then activation-bound, not weight-bound)
+        ext16 = InceptionFeatureExtractor(feature="2048", weights_dtype=jnp.bfloat16)
     imgs = jnp.asarray(np.random.default_rng(0).integers(0, 255, (FID_BATCH, 3, 299, 299)), jnp.uint8)
 
-    def step():
-        # sustained streaming: FID updates never read back between batches —
-        # dispatch a stream of trunk forwards + state folds, fetch once
-        acc = jnp.zeros(())
-        for _ in range(FID_STREAM):
-            feats = ext(imgs)
-            acc = acc + jnp.sum(feats.T @ feats) + jnp.sum(feats)  # cov + sum fold
-        return float(acc)
+    def _make_step(extractor):
+        def step():
+            # sustained streaming: FID updates never read back between
+            # batches — dispatch a stream of trunk forwards + state folds,
+            # fetch once
+            acc = jnp.zeros(())
+            for _ in range(FID_STREAM):
+                feats = extractor(imgs)
+                acc = acc + jnp.sum(feats.T @ feats) + jnp.sum(feats)  # cov + sum fold
+            return float(acc)
 
-    rate = FID_BATCH * FID_STREAM / _min_time(step, reps=3)
+        return step
+
+    rate_f32w = FID_BATCH * FID_STREAM / _min_time(_make_step(ext), reps=3)
+    rate_bf16w = FID_BATCH * FID_STREAM / _min_time(_make_step(ext16), reps=3)
+    if rate_bf16w > rate_f32w:
+        rate, ext, weights_note = rate_bf16w, ext16, f"bf16-stored weights (+{rate_bf16w / rate_f32w - 1:.0%} vs f32)"
+    else:
+        rate, weights_note = rate_f32w, f"f32 weights (bf16 storage gained nothing: activation-bound; bf16 {rate_bf16w:.0f}/s)"
 
     try:
         cost = ext._forward.lower(ext.variables, imgs).compile().cost_analysis()
@@ -642,14 +655,42 @@ def _bench_fid_imgs_per_sec() -> tuple:
         flops_per_batch = bytes_per_batch = 0.0
     peak = _PEAK_BF16_FLOPS
     mfu = (rate / FID_BATCH) * flops_per_batch / peak if flops_per_batch else 0.0
-    # HBM roofline: arithmetic intensity caps the achievable MFU — the trunk
-    # is memory-bound on v5e (819 GB/s), so report the ceiling alongside
+    # HBM roofline from MEASURED bandwidth (a timed streaming copy on this
+    # device, not the datasheet number): arithmetic intensity caps the
+    # achievable MFU, so report the ceiling alongside
+    hbm_bw, bw_src = _measured_hbm_bytes_per_s()
     roofline = (
-        min(1.0, (flops_per_batch / bytes_per_batch) * _HBM_BYTES_PER_S / peak)
+        min(1.0, (flops_per_batch / bytes_per_batch) * hbm_bw / peak)
         if bytes_per_batch
         else 0.0
     )
-    return rate, mfu, roofline
+    weights_note += f"; roofline vs {bw_src} HBM BW {hbm_bw / 1e9:.0f} GB/s"
+    return rate, mfu, roofline, weights_note
+
+
+_HBM_MEASURED = [None]
+
+
+def _measured_hbm_bytes_per_s() -> tuple:
+    """(bytes/s, source-label): timed big-array copy on the default device.
+
+    A 256 MB f32 triad (`y = x * a`) moves 2x its footprint; the best of a
+    few runs approximates the practical streaming bandwidth — the number
+    the roofline should use instead of the 819 GB/s datasheet peak. On a
+    CPU-only session this measures host bandwidth and is labeled as such.
+    """
+    if _HBM_MEASURED[0] is None:
+        import jax
+        import jax.numpy as jnp
+
+        n = 64 * 1024 * 1024  # 256 MB of f32
+        x = jnp.ones((n,), jnp.float32)
+        f = jax.jit(lambda v: v * 1.5)
+        t = _min_time(lambda: float(f(x)[0]), reps=3)
+        bw = 2 * 4 * n / max(t, 1e-9)
+        on_chip = jax.devices()[0].platform != "cpu"
+        _HBM_MEASURED[0] = (min(bw, _HBM_BYTES_PER_S), "measured" if on_chip else "host-measured")
+    return _HBM_MEASURED[0]
 
 
 # TPU v5e (v5 lite) peak: 394 TFLOP/s bf16 per chip, ~819 GB/s HBM
@@ -693,40 +734,88 @@ def _bench_map_streaming(data) -> tuple:
     ours = MAP_STREAM_IMGS / _min_time(run, reps=3, subtract_rtt=False)
 
     base = None
+    base_label = None
     try:
         from tests.helpers.reference_oracle import load_reference
 
         torchmetrics = load_reference()
         import torch
 
+        tp = [
+            {
+                "boxes": torch.as_tensor(det_b[i]),
+                "scores": torch.as_tensor(det_s[i]),
+                "labels": torch.as_tensor(det_l[i]).long(),
+            }
+            for i in range(MAP_STREAM_IMGS)
+        ]
+        tt = [
+            {
+                "boxes": torch.as_tensor(gt_b[i]),
+                "labels": torch.as_tensor(gt_l[i]).long(),
+                "iscrowd": torch.as_tensor(gt_c[i].astype(np.int64)),
+            }
+            for i in range(MAP_STREAM_IMGS)
+        ]
+        ref = None
         if torchmetrics is not None:
-            ref = torchmetrics.detection.MeanAveragePrecision()
-            tp = [
-                {
-                    "boxes": torch.as_tensor(det_b[i]),
-                    "scores": torch.as_tensor(det_s[i]),
-                    "labels": torch.as_tensor(det_l[i]).long(),
-                }
-                for i in range(MAP_STREAM_IMGS)
-            ]
-            tt = [
-                {
-                    "boxes": torch.as_tensor(gt_b[i]),
-                    "labels": torch.as_tensor(gt_l[i]).long(),
-                    "iscrowd": torch.as_tensor(gt_c[i].astype(np.int64)),
-                }
-                for i in range(MAP_STREAM_IMGS)
-            ]
-
+            try:
+                ref = torchmetrics.detection.MeanAveragePrecision()
+            except Exception:  # ctor hard-requires pycocotools in this image
+                ref = None
+        if ref is not None:
             def run_ref():
                 ref.reset()
                 for p, t in zip(tp, tt):
                     ref.update([p], [t])
 
             base = MAP_STREAM_IMGS / _min_time(run_ref, reps=3, subtract_rtt=False)
+            base_label = "reference MeanAveragePrecision.update on torch CPU"
+        else:
+            # labeled proxy: the reference ctor needs pycocotools (absent
+            # here), so replicate its update() body — _input_validator type/
+            # key/length checks, _fix_empty_tensors + box_convert per image,
+            # tensor appends (reference mean_ap.py:470-511) — in plain torch
+            def _proxy_validate(p, t):
+                for k in ("boxes", "scores", "labels"):
+                    if not isinstance(p[k], torch.Tensor):
+                        raise ValueError
+                for k in ("boxes", "labels"):
+                    if not isinstance(t[k], torch.Tensor):
+                        raise ValueError
+                if len(p["boxes"]) != len(p["scores"]) or len(p["boxes"]) != len(p["labels"]):
+                    raise ValueError
+                if len(t["boxes"]) != len(t["labels"]):
+                    raise ValueError
+
+            state: dict = {k: [] for k in ("db", "ds", "dl", "gb", "gl", "gc")}
+
+            def run_ref():
+                for v in state.values():
+                    v.clear()
+                for p, t in zip(tp, tt):
+                    _proxy_validate(p, t)
+                    boxes = p["boxes"].to(torch.float32)
+                    if boxes.numel() == 0:
+                        boxes = boxes.reshape(0, 4)
+                    state["db"].append(boxes)  # box_convert no-ops for xyxy like the reference's
+                    state["ds"].append(p["scores"].to(torch.float32))
+                    state["dl"].append(p["labels"])
+                    gboxes = t["boxes"].to(torch.float32)
+                    if gboxes.numel() == 0:
+                        gboxes = gboxes.reshape(0, 4)
+                    state["gb"].append(gboxes)
+                    state["gl"].append(t["labels"])
+                    state["gc"].append(t.get("iscrowd", torch.zeros_like(t["labels"])))
+
+            base = MAP_STREAM_IMGS / _min_time(run_ref, reps=3, subtract_rtt=False)
+            base_label = (
+                "torch proxy of the reference's validate+convert+append update body"
+                " (reference ctor unavailable: needs pycocotools)"
+            )
     except Exception:
         base = None
-    return ours, base
+    return ours, base, base_label
 
 
 # --------------------------------------------------------------------- #
@@ -872,11 +961,114 @@ def _bench_bert_encoder() -> tuple:
     return rate, mfu
 
 
+def _bench_chip_parity() -> tuple:
+    """Driver-verifiable on-chip correctness leg (round-5).
+
+    Runs a battery of representative device kernels twice — once pinned to
+    the CPU backend (the oracle the full differential suite validates
+    against torch on) and once on the session-default backend (the real
+    chip under the driver) — and counts agreement within the on-chip
+    tolerance floors (tests/conftest.py). Recorded by the driver with every
+    bench run, replacing the hand-written TPU_SUITE_r{N}.md attestation.
+    On a CPU-only session both legs coincide and the line reads 100%.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import torchmetrics_tpu.functional as F
+
+    r = np.random.default_rng(7)
+    n, c = 256, 5
+    probs = r.random((n, c)).astype(np.float32)
+    probs /= probs.sum(1, keepdims=True)
+    t_mc = r.integers(0, c, n)
+    p_bin = r.random(n).astype(np.float32)
+    t_bin = r.integers(0, 2, n)
+    x = r.standard_normal(n).astype(np.float32)
+    y = (0.7 * x + 0.3 * r.standard_normal(n)).astype(np.float32)
+    img_a = r.random((2, 3, 64, 64)).astype(np.float32)
+    img_b = np.clip(img_a + 0.1 * r.random((2, 3, 64, 64)).astype(np.float32), 0, 1)
+    wav_a = r.standard_normal((2, 4000)).astype(np.float32)
+    wav_b = (wav_a + 0.3 * r.standard_normal((2, 4000))).astype(np.float32)
+    ml_p = r.random((n, 4)).astype(np.float32)
+    ml_t = r.integers(0, 2, (n, 4))
+    box_a = r.random((6, 4)).astype(np.float32) * 50 + np.array([0, 0, 50, 50], np.float32)
+    box_b = r.random((6, 4)).astype(np.float32) * 50 + np.array([0, 0, 50, 50], np.float32)
+
+    battery = [
+        ("multiclass_accuracy", lambda: F.multiclass_accuracy(jnp.asarray(probs), jnp.asarray(t_mc), num_classes=c), 5e-4),
+        ("multiclass_confusion", lambda: F.multiclass_confusion_matrix(jnp.asarray(probs), jnp.asarray(t_mc), num_classes=c), 0),
+        ("binary_auroc", lambda: F.binary_auroc(jnp.asarray(p_bin), jnp.asarray(t_bin)), 5e-4),
+        ("binary_average_precision", lambda: F.binary_average_precision(jnp.asarray(p_bin), jnp.asarray(t_bin)), 5e-4),
+        ("multilabel_f1", lambda: F.multilabel_f1_score(jnp.asarray(ml_p), jnp.asarray(ml_t), num_labels=4), 5e-4),
+        ("binary_calibration_error", lambda: F.binary_calibration_error(jnp.asarray(p_bin), jnp.asarray(t_bin)), 5e-4),
+        ("matthews_corrcoef", lambda: F.multiclass_matthews_corrcoef(jnp.asarray(probs), jnp.asarray(t_mc), num_classes=c), 5e-4),
+        ("mean_squared_error", lambda: F.mean_squared_error(jnp.asarray(x), jnp.asarray(y)), 5e-4),
+        ("pearson_corrcoef", lambda: F.pearson_corrcoef(jnp.asarray(x), jnp.asarray(y)), 1e-3),
+        ("spearman_corrcoef", lambda: F.spearman_corrcoef(jnp.asarray(x), jnp.asarray(y)), 1e-3),
+        ("r2_score", lambda: F.r2_score(jnp.asarray(x), jnp.asarray(y)), 1e-3),
+        ("kl_divergence", lambda: F.kl_divergence(jnp.asarray(probs), jnp.asarray(np.roll(probs, 1, 0))), 1e-3),
+        ("psnr", lambda: F.peak_signal_noise_ratio(jnp.asarray(img_a), jnp.asarray(img_b), data_range=1.0), 2e-3),
+        ("ssim", lambda: F.structural_similarity_index_measure(jnp.asarray(img_a), jnp.asarray(img_b), data_range=1.0), 2e-3),
+        ("universal_image_quality", lambda: F.universal_image_quality_index(jnp.asarray(img_a), jnp.asarray(img_b)), 2e-3),
+        ("snr", lambda: F.signal_noise_ratio(jnp.asarray(wav_b), jnp.asarray(wav_a)), 5e-3),
+        ("si_sdr", lambda: F.scale_invariant_signal_distortion_ratio(jnp.asarray(wav_b), jnp.asarray(wav_a)), 5e-3),
+        ("pairwise_cosine", lambda: F.pairwise_cosine_similarity(jnp.asarray(img_a.reshape(2, -1))), 1e-3),
+        ("giou", lambda: F.generalized_intersection_over_union(jnp.asarray(box_a), jnp.asarray(box_b)), 1e-3),
+        ("dice", lambda: F.dice(jnp.asarray(probs), jnp.asarray(t_mc)), 5e-4),
+    ]
+
+    cpu = jax.devices("cpu")[0]
+    default = jax.devices()[0]
+    on_chip = default.platform != "cpu"
+    passed, failed = 0, []
+    for name, fn, tol in battery:
+        try:
+            with jax.default_device(cpu):
+                want = np.asarray(jax.device_get(fn()), np.float64)
+            with jax.default_device(default):
+                got = np.asarray(jax.device_get(fn()), np.float64)
+            np.testing.assert_allclose(got, want, rtol=max(tol, 1e-7), atol=max(tol * 0.1, 1e-6))
+            passed += 1
+        except Exception:
+            failed.append(name)
+    return passed, len(battery), on_chip, failed
+
+
+_RESULTS: list = []
+
+
+def _emit(line: dict) -> None:
+    """Print one bench line and record it for the final summary line.
+
+    The driver records only the LAST ~2000 characters of stdout, so detailed
+    per-line unit strings can push early lines out of the recorded artifact.
+    ``main`` therefore ends with a standard-shaped line whose extra ``all``
+    field carries every ``metric -> [value, vs_baseline]`` compactly — the
+    full result set always survives in the recorded tail.
+    """
+    _RESULTS.append(line)
+    print(json.dumps(line))
+
+
+def _emit_summary() -> None:
+    if not _RESULTS:
+        return
+    last = dict(_RESULTS[-1])
+    last["all"] = {
+        r["metric"]: (
+            [r["value"], r["vs_baseline"]] if "vs_baseline" in r else [r["value"]]
+        )
+        for r in _RESULTS
+    }
+    print(json.dumps(last))
+
+
 def main() -> None:
     ours = _bench_ours()
     base = _bench_torch_cpu_baseline()
-    print(
-        json.dumps(
+    _emit((
             {
                 "metric": "multiclass_accuracy_updates_per_sec",
                 "value": round(ours, 2),
@@ -889,8 +1081,7 @@ def main() -> None:
     eager_rate, jit_rate, fwd_rate, default_rate = _bench_class_api()
     class_base, class_base_fwd, class_base_default, have_ref = _bench_class_api_torch_baseline()
     base_label = "reference class API on torch CPU" if have_ref else "plain torch stat-scores loop (reference unavailable)"
-    print(
-        json.dumps(
+    _emit((
             {
                 "metric": "class_api_updates_per_sec",
                 "value": round(eager_rate, 2),
@@ -900,8 +1091,7 @@ def main() -> None:
             }
         )
     )
-    print(
-        json.dumps(
+    _emit((
             {
                 "metric": "class_api_default_updates_per_sec",
                 "value": round(default_rate, 2),
@@ -912,8 +1102,7 @@ def main() -> None:
             }
         )
     )
-    print(
-        json.dumps(
+    _emit((
             {
                 "metric": "class_api_jit_updates_per_sec",
                 "value": round(jit_rate, 2),
@@ -923,8 +1112,7 @@ def main() -> None:
             }
         )
     )
-    print(
-        json.dumps(
+    _emit((
             {
                 "metric": "class_api_forward_per_sec",
                 "value": round(fwd_rate, 2),
@@ -938,8 +1126,7 @@ def main() -> None:
     data = _map_dataset()
     map_t = _bench_map_ours(data)
     map_base = _bench_map_cpu_baseline(data)
-    print(
-        json.dumps(
+    _emit((
             {
                 "metric": "map_compute_wallclock_100k_boxes",
                 "value": round(map_t * 1000, 1),
@@ -949,29 +1136,24 @@ def main() -> None:
         )
     )
 
-    map_upd, map_upd_base = _bench_map_streaming(data)
+    map_upd, map_upd_base, map_base_label = _bench_map_streaming(data)
     map_upd_line = {
         "metric": "map_streaming_updates_per_sec",
         "value": round(map_upd, 1),
         "unit": f"updates/sec (1 img/update, {MAP_DETS} dets + {MAP_GTS} gts each;"
-        + (
-            " baseline = reference MeanAveragePrecision.update on torch CPU)"
-            if map_upd_base
-            else " no CPU reference measurable)"
-        ),
+        + (f" baseline = {map_base_label})" if map_upd_base else " no CPU reference measurable)"),
     }
     if map_upd_base:
         map_upd_line["vs_baseline"] = round(map_upd / map_upd_base, 2)
-    print(json.dumps(map_upd_line))
+    _emit((map_upd_line))
 
-    fid_rate, fid_mfu, fid_roof = _bench_fid_imgs_per_sec()
-    print(
-        json.dumps(
+    fid_rate, fid_mfu, fid_roof, fid_weights_note = _bench_fid_imgs_per_sec()
+    _emit((
             {
                 "metric": "fid_inception_images_per_sec",
                 "value": round(fid_rate, 1),
                 "unit": (
-                    f"imgs/sec (batch={FID_BATCH}, 299x299, InceptionV3 2048-d + cov fold;"
+                    f"imgs/sec (batch={FID_BATCH}, 299x299, InceptionV3 2048-d + cov fold; {fid_weights_note};"
                     f" MFU={fid_mfu:.1%} of v5e bf16 peak per XLA cost analysis"
                     + (
                         f" — the trunk is HBM-bound: arithmetic intensity caps the roofline at"
@@ -988,8 +1170,7 @@ def main() -> None:
     )
 
     lpips_rate, lpips_mfu, lpips_base = _bench_lpips()
-    print(
-        json.dumps(
+    _emit((
             {
                 "metric": "lpips_images_per_sec",
                 "value": round(lpips_rate, 1),
@@ -1004,8 +1185,7 @@ def main() -> None:
     )
 
     bert_enc_rate, bert_enc_mfu = _bench_bert_encoder()
-    print(
-        json.dumps(
+    _emit((
             {
                 "metric": "bert_encoder_tokens_per_sec",
                 "value": round(bert_enc_rate, 1),
@@ -1032,13 +1212,12 @@ def main() -> None:
     }
     if rouge_base:
         rouge_line["vs_baseline"] = round(rouge_rate / rouge_base, 2)
-    print(json.dumps(rouge_line))
+    _emit((rouge_line))
 
     bert_rate = _bench_bertscore_samples_per_sec(text_preds, text_target)
     bert_base = _bench_bertscore_torch_cpu_baseline()
     cer_rate, cer_base = _bench_cer()
-    print(
-        json.dumps(
+    _emit((
             {
                 "metric": "bertscore_samples_per_sec",
                 "value": round(bert_rate, 1),
@@ -1050,8 +1229,7 @@ def main() -> None:
             }
         )
     )
-    print(
-        json.dumps(
+    _emit((
             {
                 "metric": "cer_long_transcript_samples_per_sec",
                 "value": round(cer_rate, 1),
@@ -1061,10 +1239,24 @@ def main() -> None:
         )
     )
 
+    chip_pass, chip_total, on_chip, chip_failed = _bench_chip_parity()
+    _emit((
+            {
+                "metric": "chip_vs_cpu_parity",
+                "value": chip_pass,
+                "unit": (
+                    f"kernels matching the CPU oracle within on-chip tolerance floors, out of {chip_total}"
+                    + (f"; FAILED: {','.join(chip_failed)}" if chip_failed else "")
+                    + ("" if on_chip else " (cpu-only session: both legs on CPU)")
+                ),
+                "vs_baseline": round(chip_pass / chip_total, 3),
+            }
+        )
+    )
+
     sync = _bench_collection_sync()
     if sync is not None:
-        print(
-            json.dumps(
+        _emit((
                 {
                     "metric": "collection_sync_p50_latency",
                     "value": round(sync["p50_ms"], 3),
@@ -1073,6 +1265,8 @@ def main() -> None:
                 }
             )
         )
+
+    _emit_summary()
 
 
 def _parse_bench_artifact(path: str):
@@ -1094,6 +1288,22 @@ def _parse_bench_artifact(path: str):
                 continue
             if "metric" in d and "value" in d:
                 rows.append(d)
+    # the final line's compact `all` map recovers metrics whose detailed
+    # lines were pushed out of the recorded 2000-char tail
+    for d in rows:
+        if isinstance(d.get("all"), dict):
+            seen = {r["metric"] for r in rows}
+            order = list(d["all"].items())
+            recovered = []
+            for metric, vals in order:
+                if metric in seen:
+                    continue
+                row = {"metric": metric, "value": vals[0], "unit": ""}
+                if len(vals) > 1:
+                    row["vs_baseline"] = vals[1]
+                recovered.append(row)
+            rows = recovered + [r for r in rows if "all" not in r]
+            break
     return rows
 
 

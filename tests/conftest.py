@@ -113,3 +113,37 @@ def pytest_configure(config):
         "markers",
         "tm_exact: this test asserts exact/near-bit invariants; the TM_TPU_SUITE tolerance floors must not apply",
     )
+
+
+def pytest_sessionfinish(session, exitstatus):
+    """Under TM_TPU_SUITE=1, write a machine-readable result artifact.
+
+    Replaces the hand-written ``TPU_SUITE_r{N}.md`` attestation (VERDICT r4
+    weak #6): the pytest run itself records what executed on which backend,
+    so the on-chip leg's outcome is verifiable from the artifact rather
+    than builder-asserted.
+    """
+    if not TPU_SUITE:
+        return
+    import json
+    import sys as _sys
+    import time
+
+    import jax as _jax
+
+    tr = session.config.pluginmanager.get_plugin("terminalreporter")
+    stats = {k: len(v) for k, v in getattr(tr, "stats", {}).items() if k}
+    out = {
+        "exitstatus": int(exitstatus),
+        "passed": stats.get("passed", 0),
+        "failed": stats.get("failed", 0),
+        "skipped": stats.get("skipped", 0),
+        "errors": stats.get("error", 0),
+        "backend": _jax.default_backend(),
+        "devices": [str(d) for d in _jax.devices()],
+        "argv": _sys.argv,
+        "unix_time": int(time.time()),
+    }
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    with open(os.path.join(root, "TPU_SUITE_RESULT.json"), "w") as fh:
+        json.dump(out, fh, indent=2)

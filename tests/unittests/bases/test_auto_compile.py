@@ -129,6 +129,35 @@ class TestAutoUpdateParity:
         with pytest.raises(RuntimeError, match="binary set"):
             m.compute()
 
+    def test_update_reassigning_array_attribute_disables_auto(self):
+        # an unregistered ARRAY attribute reassigned by update() must also
+        # disable the compiled paths (identity fingerprint)
+        class Caching(SumMetric):
+            def update(self, value):
+                self.last_batch = value
+                super(Caching, self).update(value)
+
+        m = Caching()
+        x = jnp.asarray(np.ones(4, np.float32))
+        for i in range(5):
+            m.update(x + i)
+        assert m._auto_disabled
+        np.testing.assert_allclose(np.asarray(m.last_batch), np.asarray(x + 4))
+
+    def test_violating_forward_batch_value_is_poisoned(self):
+        # the eager path raises and yields nothing for an invalid batch;
+        # the compiled forward poisons the returned value (INT_MIN for the
+        # stat-scores int output) instead of returning plausible garbage
+        m = BinaryStatScores()
+        p = jnp.asarray(RNG.random(8).astype(np.float32))
+        t = jnp.asarray(RNG.integers(0, 2, 8))
+        for _ in range(3):
+            m(p, t)
+        out = m(p, jnp.asarray(np.full(8, 7)))
+        assert int(np.asarray(out).min()) == np.iinfo(np.asarray(out).dtype).min
+        with pytest.raises(RuntimeError, match="outside of the expected set"):
+            m.compute()
+
     def test_validate_args_true_first_call_still_raises_eagerly(self):
         m = BinaryStatScores()
         good_p = jnp.asarray(RNG.random(8).astype(np.float32))

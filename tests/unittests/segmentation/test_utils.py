@@ -124,8 +124,43 @@ def test_validation():
         distance_transform(jnp.zeros((2, 5, 5)))
     with pytest.raises(ValueError, match="metric"):
         distance_transform(jnp.zeros((5, 5)), metric="bad")
-    with pytest.raises(NotImplementedError, match="3D"):
+    with pytest.raises(ValueError, match="length 2 or 3"):
         cube = jnp.zeros((4, 4, 4), dtype=bool).at[1:3, 1:3, 1:3].set(True)
-        mask_edges(cube, cube, spacing=(1, 1, 1))
+        mask_edges(cube, cube, spacing=(1, 1, 1, 1))
+    with pytest.raises(ValueError, match="match the input rank"):
+        mask_edges(jnp.zeros((4, 4), dtype=bool), jnp.zeros((4, 4), dtype=bool), spacing=(1, 1, 1))
     with pytest.raises(ValueError, match="bool"):
         surface_distance(jnp.zeros((4, 4)), jnp.zeros((4, 4), dtype=bool))
+
+
+def test_mask_edges_3d_surface_area_unit_cube():
+    # a 2x2x2 solid in a padded volume: every foreground voxel is an edge
+    # voxel, and the summed per-voxel surface areas of a closed axis-aligned
+    # cube of side 2 must approximate its analytic surface (marching-cubes
+    # smooths corners, so the total is below 6*s^2 but positive and symmetric)
+    cube = jnp.zeros((6, 6, 6), dtype=bool).at[2:4, 2:4, 2:4].set(True)
+    edge_p, edge_t, areas_p, areas_t = mask_edges(cube, cube, crop=False, spacing=(1, 1, 1))
+    assert np.asarray(edge_p).any()
+    assert np.array_equal(np.asarray(edge_p), np.asarray(edge_t))
+    assert float(np.asarray(areas_p).sum()) > 0
+    assert np.allclose(np.asarray(areas_p), np.asarray(areas_t))
+
+
+@pytest.mark.parametrize("spacing", [(1, 1, 1), (1, 2, 3), (3, 1, 2)])
+def test_mask_edges_3d_matches_reference(spacing):
+    from tests.helpers.reference_oracle import load_reference
+
+    torchmetrics = load_reference()
+    if torchmetrics is None:
+        pytest.skip("reference checkout unavailable")
+    import torch
+
+    from torchmetrics.functional.segmentation.utils import mask_edges as ref_mask_edges
+
+    rng = np.random.default_rng(17)
+    preds = rng.random((7, 8, 9)) > 0.6
+    target = rng.random((7, 8, 9)) > 0.6
+    ours = mask_edges(jnp.asarray(preds), jnp.asarray(target), crop=True, spacing=spacing)
+    ref = ref_mask_edges(torch.from_numpy(preds), torch.from_numpy(target), crop=True, spacing=spacing)
+    for o, r in zip(ours, ref):
+        np.testing.assert_allclose(np.asarray(o, dtype=np.float64), np.asarray(r, dtype=np.float64), atol=1e-5)

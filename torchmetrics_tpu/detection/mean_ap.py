@@ -131,6 +131,19 @@ class MeanAveragePrecision(Metric):
         self.add_state("groundtruth_crowds", default=[], dist_reduce_fx=None)
         self.add_state("groundtruth_area", default=[], dist_reduce_fx=None)
 
+    @staticmethod
+    def _as_typed(x: Any, dtype) -> Array:
+        """Pass device arrays of the right dtype through untouched.
+
+        ``update`` is a validate-and-append hot path (reference
+        ``mean_ap.py:470-511``); a redundant ``convert_element_type`` per
+        field per image dominated its cost, so conversion only happens when
+        the input is not already a correctly-typed ``jax.Array``.
+        """
+        if isinstance(x, jax.Array) and x.dtype == dtype:
+            return x
+        return jnp.asarray(x, dtype)
+
     def update(self, preds: List[Dict[str, Array]], target: List[Dict[str, Array]]) -> None:
         """Append per-image detections and ground truths to state."""
         _input_validator(preds, target, iou_type=self.iou_type)
@@ -141,8 +154,8 @@ class MeanAveragePrecision(Metric):
                 self.detection_box.append(bbox)
             if mask is not None:
                 self.detection_mask.append(mask)
-            self.detection_labels.append(jnp.asarray(item["labels"], jnp.int32))
-            self.detection_scores.append(jnp.asarray(item["scores"], jnp.float32))
+            self.detection_labels.append(self._as_typed(item["labels"], jnp.int32))
+            self.detection_scores.append(self._as_typed(item["scores"], jnp.float32))
 
         for item in target:
             bbox, mask = self._get_safe_item_values(item)
@@ -150,17 +163,25 @@ class MeanAveragePrecision(Metric):
                 self.groundtruth_box.append(bbox)
             if mask is not None:
                 self.groundtruth_mask.append(mask)
-            labels = jnp.asarray(item["labels"], jnp.int32)
+            labels = self._as_typed(item["labels"], jnp.int32)
             self.groundtruth_labels.append(labels)
-            self.groundtruth_crowds.append(jnp.asarray(item.get("iscrowd", jnp.zeros_like(labels)), jnp.int32))
-            self.groundtruth_area.append(jnp.asarray(item.get("area", jnp.zeros_like(labels)), jnp.float32))
+            crowds = item.get("iscrowd")
+            area = item.get("area")
+            # the zero defaults are shared per count — building fresh
+            # zeros_like arrays per image paid two dispatches per update
+            zeros = self.__dict__.setdefault("_zero_default_cache", {})
+            n = int(labels.shape[0]) if hasattr(labels, "shape") else len(labels)
+            if (crowds is None or area is None) and n not in zeros:
+                zeros[n] = (jnp.zeros((n,), jnp.int32), jnp.zeros((n,), jnp.float32))
+            self.groundtruth_crowds.append(zeros[n][0] if crowds is None else self._as_typed(crowds, jnp.int32))
+            self.groundtruth_area.append(zeros[n][1] if area is None else self._as_typed(area, jnp.float32))
 
     def _get_safe_item_values(
         self, item: Dict[str, Array], warn: bool = False
     ) -> Tuple[Optional[Array], Optional[Array]]:
         output = [None, None]
         if "bbox" in self.iou_type:
-            boxes = _fix_empty_tensors(jnp.asarray(item["boxes"], jnp.float32))
+            boxes = _fix_empty_tensors(self._as_typed(item["boxes"], jnp.float32))
             if boxes.size > 0:
                 boxes = box_convert(boxes, in_fmt=self.box_format, out_fmt="xyxy")
             output[0] = boxes

@@ -2,8 +2,10 @@
 
 Tokenization/normalization is host work; ROUGE-L's LCS runs through the
 batched device kernel in ``helper.py`` (prefix-max scan) rather than the
-reference's Python DP table. Sentence splitting for ROUGE-Lsum uses a
-regex splitter instead of the reference's nltk-punkt dependency.
+reference's Python DP table. Sentence splitting for ROUGE-Lsum models the
+behavior of the reference's nltk-punkt dependency (``reference
+functional/text/rouge.py:42-71``) — see :func:`_split_sentence` for the
+approximation boundary.
 """
 
 from __future__ import annotations
@@ -35,13 +37,69 @@ ALLOWED_ROUGE_KEYS: Dict[str, Union[int, str]] = {
 }
 ALLOWED_ACCUMULATE_VALUES = ("avg", "best")
 
-_SENTENCE_SPLIT_REGEX = re.compile(r"(?<=[.!?])\s+|\n+")
+# Common English abbreviations that the pretrained punkt model treats as
+# non-terminal (a period after them does not end the sentence). Lowercased,
+# trailing period stripped; internal periods kept ("e.g", "u.s").
+_PUNKT_ABBREVIATIONS = frozenset(
+    (
+        "dr mr mrs ms prof rev fr sr jr st vs etc inc ltd co corp dept univ est fig al gen rep sen gov "
+        "lt col maj sgt capt cmdr adm hon messrs mme mlle no nos vol pp approx appt min sec mt ave blvd rd apt "
+        "jan feb mar apr jun jul aug sep sept oct nov dec mon tue tues wed thu thurs fri sat sun "
+        "e.g i.e a.m p.m ph.d b.a m.a b.sc m.sc d.c u.s u.k u.n cf ca viz resp"
+    ).split()
+)
+
+# candidate boundary: terminal punctuation, optional closing quotes/brackets,
+# then whitespace — the capture keeps the token to the left for inspection
+_SENTENCE_BOUNDARY = re.compile(r"(\S*[.!?]+[\"'”’)\]]*)(\s+)")
 
 
 def _split_sentence(x: str) -> Sequence[str]:
-    """Regex sentence splitter (reference uses nltk punkt, unavailable offline)."""
-    parts = [s.strip() for s in _SENTENCE_SPLIT_REGEX.split(x)]
-    return [s for s in parts if s]
+    """Sentence splitter modeling nltk punkt's English behavior.
+
+    The reference calls ``nltk.sent_tokenize`` (pretrained punkt,
+    ``reference functional/text/rouge.py:62-71``); punkt data cannot be
+    downloaded in an offline environment, so this is a rule-based port of
+    its observable behavior: breaks at ``.!?`` (plus trailing close
+    quotes/brackets) before whitespace, EXCEPT after known abbreviations
+    ("Dr.", "e.g."), single-letter initials ("J. Smith"), and when the next
+    word starts lowercase or with a digit (punkt's orthographic heuristic).
+    Newlines always split. Approximation boundary (covered by
+    ``tests/unittests/text/test_rouge_sentence_split.py``): punkt's
+    corpus-learned rare abbreviations and its collocation/frequent-
+    sentence-starter reclassification are not modeled, so e.g. "No. 7" or a
+    sentence break directly after an unlisted abbreviation can differ.
+    """
+    sentences: List[str] = []
+    for paragraph in x.splitlines():
+        paragraph = paragraph.strip()
+        if not paragraph:
+            continue
+        start = 0
+        for m in _SENTENCE_BOUNDARY.finditer(paragraph):
+            token, end = m.group(1), m.end()
+            nxt = paragraph[end : end + 1]
+            if token[-1] not in ".!?\"'”’)]":
+                continue
+            # strip close-punct; keep the word carrying the terminal mark
+            word = token.rstrip("\"'”’)]")
+            if word.endswith("."):
+                core = word[:-1].strip("\"'“‘([").lower()
+                bare = core.rstrip(".")
+                if bare in _PUNKT_ABBREVIATIONS or core in _PUNKT_ABBREVIATIONS:
+                    continue  # "Dr. Smith", "etc. and"
+                if len(bare) == 1 and bare.isalpha():
+                    continue  # initials: "J. Smith"
+                if nxt.islower() or nxt.isdigit():
+                    continue  # punkt ortho heuristic: next word not a starter
+            sentence = paragraph[start : m.end(1)].strip()
+            if sentence:
+                sentences.append(sentence)
+            start = end
+        tail = paragraph[start:].strip()
+        if tail:
+            sentences.append(tail)
+    return sentences
 
 
 def _compute_metrics(hits_or_lcs: float, pred_len: int, target_len: int) -> Dict[str, float]:
@@ -128,15 +186,20 @@ def _normalize_and_tokenize_text(
     return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
 
 
-def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, float]:
-    def _create_ngrams(tokens: Sequence[str], n: int) -> Counter:
-        return Counter(tuple(tokens[i : i + n]) for i in range(len(tokens) - n + 1))
+def _create_ngrams(tokens: Sequence[str], n: int) -> Counter:
+    if n == 1:
+        return Counter(tokens)
+    # zip of shifted views beats per-position tuple slicing by ~2x host-side
+    return Counter(zip(*(tokens[k:] for k in range(n))))
 
+
+def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, float]:
     pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
     pred_len, target_len = sum(pred_ngrams.values()), sum(target_ngrams.values())
     if 0 in (pred_len, target_len):
         return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
-    hits = sum(min(pred_ngrams[w], target_ngrams[w]) for w in set(pred_ngrams))
+    # clipped hits = multiset intersection, computed in C by Counter.__and__
+    hits = sum((pred_ngrams & target_ngrams).values())
     return _compute_metrics(hits, max(pred_len, 1), max(target_len, 1))
 
 

@@ -309,7 +309,13 @@ class InceptionFeatureExtractor(PickleableJitMixin):
 
 
     def __init__(
-        self, feature="2048", weights_path: str = None, seed: int = 0, compute_dtype=None, fuse_bn: bool = True
+        self,
+        feature="2048",
+        weights_path: str = None,
+        seed: int = 0,
+        compute_dtype=None,
+        fuse_bn: bool = True,
+        weights_dtype=None,
     ) -> None:
         self.feature = str(feature)
         dtype = compute_dtype if compute_dtype is not None else jnp.bfloat16
@@ -337,6 +343,15 @@ class InceptionFeatureExtractor(PickleableJitMixin):
             self.variables = fold_batchnorm(self.variables)
         else:
             self.net = unfused
+        if weights_dtype is not None:
+            # store params at reduced precision: the trunk's HBM weight
+            # traffic halves under bf16 storage (the MXU computes in the
+            # compute dtype regardless — f32 params are cast per use, so
+            # full-precision storage buys bytes, not accuracy, in bf16 mode)
+            self.variables = jax.tree_util.tree_map(
+                lambda a: a.astype(weights_dtype) if jnp.issubdtype(a.dtype, jnp.floating) else a,
+                self.variables,
+            )
 
         self._build_forward()
 

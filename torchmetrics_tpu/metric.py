@@ -437,9 +437,14 @@ class Metric(ABC):
         for k, v in self.__dict__.items():
             if k.startswith("_") or k in self._defaults:
                 continue
-            if _is_array(v) or isinstance(v, RingBuffer) or callable(v):
+            if callable(v):
                 continue
-            if isinstance(v, (bool, int, float, complex, str, bytes, type(None))):
+            if _is_array(v) or isinstance(v, RingBuffer):
+                # unregistered array attrs are identity-fingerprinted:
+                # `self.cache = preds` reassigns (new id) and must disable
+                # the compiled paths just like a mutated python container
+                snap.append((k, id(v)))
+            elif isinstance(v, (bool, int, float, complex, str, bytes, type(None))):
                 snap.append((k, v))
             elif isinstance(v, dict) and len(v) <= 16:
                 snap.append((k, id(v), tuple((fp(dk), fp(dv)) for dk, dv in v.items())))
@@ -1044,6 +1049,19 @@ class Metric(ABC):
                         )
                     viol = viol | flags
                     bad = jnp.any(flags)
+
+                    def _poison(v):
+                        # the eager/reference contract raises and never
+                        # yields a value for an invalid batch; the compiled
+                        # path can't raise mid-stream, so the returned batch
+                        # value is visibly poisoned instead (NaN / INT_MIN)
+                        if jnp.issubdtype(v.dtype, jnp.inexact):
+                            return jnp.where(bad, jnp.nan, v)
+                        if jnp.issubdtype(v.dtype, jnp.integer):
+                            return jnp.where(bad, jnp.iinfo(v.dtype).min, v)
+                        return v
+
+                    batch_val = jax.tree_util.tree_map(_poison, batch_val)
                 # the count carries as int32 (exact for any realistic stream,
                 # unlike a f32 carry which saturates at 2^24) and converts to
                 # float only where the running-mean weights need it
