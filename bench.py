@@ -1292,17 +1292,19 @@ def _parse_bench_artifact(path: str):
     # lines were pushed out of the recorded 2000-char tail
     for d in rows:
         if isinstance(d.get("all"), dict):
-            seen = {r["metric"] for r in rows}
-            order = list(d["all"].items())
+            detailed = [r for r in rows if "all" not in r]
+            seen = {r["metric"] for r in detailed}  # NOT counting the summary row itself
             recovered = []
-            for metric, vals in order:
+            for metric, vals in d["all"].items():
                 if metric in seen:
                     continue
-                row = {"metric": metric, "value": vals[0], "unit": ""}
+                row = {"metric": metric, "value": vals[0]}
+                # the summary row carries its base metric's full unit string
+                row["unit"] = d.get("unit", "") if metric == d.get("metric") else ""
                 if len(vals) > 1:
                     row["vs_baseline"] = vals[1]
                 recovered.append(row)
-            rows = recovered + [r for r in rows if "all" not in r]
+            rows = recovered + detailed
             break
     return rows
 
@@ -1329,7 +1331,7 @@ def update_readme(artifact_path: str, readme_path: str = "README.md") -> None:
     """Rewrite the README benchmark table from a driver-recorded artifact.
 
     Keeps README == driver numbers by construction (VERDICT r3 weak #5):
-    ``python bench.py --readme BENCH_r03.json``.
+    ``python bench.py --readme BENCH_r{N}.json`` with the newest artifact.
     """
     rows = _parse_bench_artifact(artifact_path)
     src = os.path.basename(artifact_path)

@@ -158,6 +158,93 @@ class TestAutoUpdateParity:
         with pytest.raises(RuntimeError, match="outside of the expected set"):
             m.compute()
 
+    @pytest.mark.parametrize(
+        ("cls_name", "kwargs", "maker"), [
+            ("BinaryAUROC", {"thresholds": 32}, "binary"),
+            ("MulticlassAveragePrecision", {"num_classes": 4, "thresholds": 32}, "multiclass"),
+            ("MultilabelROC", {"num_labels": 3, "thresholds": 32}, "multilabel"),
+            ("BinaryHingeLoss", {}, "binary_float"),
+            ("MultilabelRankingLoss", {"num_labels": 3}, "multilabel"),
+            ("MulticlassExactMatch", {"num_classes": 4}, "multiclass_labels"),
+        ],
+    )
+    def test_ctor_default_families_auto_compile(self, cls_name, kwargs, maker):
+        # round-5 widening: binned curve family, hinge, ranking, exact match
+        # all auto-compile at ctor defaults (validate_args=True)
+        import torchmetrics_tpu as tm
+
+        def batch(i):
+            r = np.random.default_rng(60_000 + i)
+            if maker == "binary":
+                return jnp.asarray(r.random(32).astype(np.float32)), jnp.asarray(r.integers(0, 2, 32))
+            if maker == "binary_float":
+                return jnp.asarray(r.random(32).astype(np.float32)), jnp.asarray(r.integers(0, 2, 32))
+            if maker == "multiclass":
+                p = r.random((32, 4)).astype(np.float32)
+                return jnp.asarray(p / p.sum(1, keepdims=True)), jnp.asarray(r.integers(0, 4, 32))
+            if maker == "multiclass_labels":
+                return jnp.asarray(r.integers(0, 4, (32, 5))), jnp.asarray(r.integers(0, 4, (32, 5)))
+            p = r.random((32, 3)).astype(np.float32)
+            return jnp.asarray(p), jnp.asarray(r.integers(0, 2, (32, 3)))
+
+        auto = getattr(tm, cls_name)(**kwargs)
+        eager = getattr(tm, cls_name)(**kwargs, auto_compile=False)
+        assert auto.validate_args is True
+        for i in range(4):
+            p, t = batch(i)
+            auto.update(p, t)
+            eager.update(p, t)
+        assert not auto._auto_disabled
+        assert "_auto_update_fn" in auto.__dict__, f"{cls_name} did not compile at ctor defaults"
+        a = jax.tree_util.tree_leaves(auto.compute())
+        b = jax.tree_util.tree_leaves(eager.compute())
+        for xa, xb in zip(a, b):
+            np.testing.assert_allclose(np.asarray(xa), np.asarray(xb), rtol=1e-5, atol=1e-6)
+
+    def test_binned_curve_deferred_violation(self):
+        # the curve family's fused target-set check: bad labels on the
+        # compiled path surface at compute with the check's message
+        import torchmetrics_tpu as tm
+
+        m = tm.BinaryAUROC(thresholds=32)
+        p = jnp.asarray(RNG.random(16).astype(np.float32))
+        t = jnp.asarray(RNG.integers(0, 2, 16))
+        for _ in range(3):
+            m.update(p, t)
+        m.update(p, jnp.asarray(np.full(16, 4)))
+        with pytest.raises(RuntimeError, match="outside of the expected set"):
+            m.compute()
+
+    def test_demographic_parity_ignores_raw_target_like_eager(self):
+        # demographic_parity substitutes a zero target before validation;
+        # the fused check must accept the same inputs the eager path does
+        import torchmetrics_tpu as tm
+
+        auto = tm.BinaryFairness(num_groups=2, task="demographic_parity")
+        eager = tm.BinaryFairness(num_groups=2, task="demographic_parity", auto_compile=False)
+        p = jnp.asarray(RNG.random(16).astype(np.float32))
+        t = jnp.asarray(np.full(16, 7))  # out-of-set, but deliberately unvalidated for DP
+        g = jnp.asarray(RNG.integers(0, 2, 16))
+        for _ in range(4):
+            auto.update(p, t, g)
+            eager.update(p, t, g)
+        a, b = auto.compute(), eager.compute()
+        for k in a:
+            np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]), rtol=1e-6)
+
+    def test_group_fairness_deferred_violation(self):
+        import torchmetrics_tpu as tm
+
+        m = tm.BinaryGroupStatRates(num_groups=2)
+        p = jnp.asarray(RNG.random(16).astype(np.float32))
+        t = jnp.asarray(RNG.integers(0, 2, 16))
+        g = jnp.asarray(RNG.integers(0, 2, 16))
+        for _ in range(3):
+            m.update(p, t, g)
+        m.update(p, t, jnp.asarray(np.full(16, 9)))  # groups out of range
+        with pytest.raises(RuntimeError, match="number of groups"):
+            m.compute()
+
     def test_validate_args_true_first_call_still_raises_eagerly(self):
         m = BinaryStatScores()
         good_p = jnp.asarray(RNG.random(8).astype(np.float32))

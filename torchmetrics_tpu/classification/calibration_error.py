@@ -22,6 +22,7 @@ from torchmetrics_tpu.functional.classification.calibration_error import (
     _multiclass_calibration_error_update,
 )
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.checks import _no_value_flags
 from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 from torchmetrics_tpu.utilities.enums import ClassificationTaskNoMultilabel
@@ -79,6 +80,10 @@ class BinaryCalibrationError(Metric):
         self.confidences.append(confidences)
         self.accuracies.append(accuracies)
 
+    def _traced_value_flags(self, preds, target):
+        # eager validation is metadata-only (float dtype / shape)
+        return _no_value_flags(preds, target)
+
     def compute(self) -> Array:
         confidences = dim_zero_cat(self.confidences)
         accuracies = dim_zero_cat(self.accuracies)
@@ -127,6 +132,9 @@ class MulticlassCalibrationError(Metric):
         confidences, accuracies = _multiclass_calibration_error_update(preds, target)
         self.confidences.append(confidences)
         self.accuracies.append(accuracies)
+
+    def _traced_value_flags(self, preds, target):
+        return _no_value_flags(preds, target)
 
     def compute(self) -> Array:
         confidences = dim_zero_cat(self.confidences)

@@ -14,6 +14,7 @@ from torchmetrics_tpu.functional.classification.group_fairness import (
     _groups_reduce,
     _groups_stat_transform,
 )
+from torchmetrics_tpu.functional.classification.stat_scores import _binary_stat_scores_value_flags
 from torchmetrics_tpu.metric import Metric
 
 Array = jax.Array
@@ -75,6 +76,17 @@ class BinaryGroupStatRates(_AbstractGroupStatScores):
         )
         self._update_states(group_stats)
 
+    def _traced_value_flags(self, preds: Array, target: Array, groups: Array):
+        # binary target-set check + the groups-range check (mirroring the
+        # eager `_groups_validation`: flags only values strictly above
+        # `num_groups`, like the host-side check it replaces)
+        msgs_t, flags_t = _binary_stat_scores_value_flags(preds, target, self.ignore_index)
+        groups = jnp.asarray(groups)
+        msgs = msgs_t + (
+            f"The groups tensor contains identifiers larger than the specified number of groups {self.num_groups}.",
+        )
+        return msgs, jnp.concatenate([flags_t, (jnp.max(groups) > self.num_groups)[None]])
+
     def compute(self) -> Dict[str, Array]:
         return _groups_reduce([(self.tp[g], self.fp[g], self.tn[g], self.fn[g]) for g in range(self.num_groups)])
 
@@ -126,6 +138,19 @@ class BinaryFairness(_AbstractGroupStatScores):
             preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
         )
         self._update_states(group_stats)
+
+    def _traced_value_flags(self, preds: Array, target: Array, groups: Array):
+        # mirror the eager path exactly: demographic_parity substitutes a
+        # zero target BEFORE validation (update() above), so its raw target
+        # is deliberately unvalidated — the fused check must match
+        if self.task == "demographic_parity":
+            target = jnp.zeros(jnp.asarray(preds).shape, dtype=jnp.int32)
+        msgs_t, flags_t = _binary_stat_scores_value_flags(preds, target, self.ignore_index)
+        groups = jnp.asarray(groups)
+        msgs = msgs_t + (
+            f"The groups tensor contains identifiers larger than the specified number of groups {self.num_groups}.",
+        )
+        return msgs, jnp.concatenate([flags_t, (jnp.max(groups) > self.num_groups)[None]])
 
     def compute(self) -> Dict[str, Array]:
         stats = {"tp": self.tp, "fp": self.fp, "tn": self.tn, "fn": self.fn}

@@ -34,6 +34,7 @@ from torchmetrics_tpu.functional.classification.precision_recall_curve import (
 )
 from torchmetrics_tpu.functional.classification.auroc import _reduce_auroc
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.checks import _no_value_flags, _target_set_value_flags
 from torchmetrics_tpu.utilities.compute import _auc_compute_without_check
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 from torchmetrics_tpu.utilities.enums import ClassificationTask
@@ -112,6 +113,11 @@ class BinaryPrecisionRecallCurve(Metric):
         else:
             self.confmat = self.confmat + state
 
+    def _traced_value_flags(self, preds: Array, target: Array):
+        # binned-mode instances auto-compile with the fused target-set check
+        # (the eager validator's only value-dependent check)
+        return _target_set_value_flags(target, self.ignore_index)
+
     def _final_state(self):
         if self.thresholds is None:
             return dim_zero_cat(self.preds), dim_zero_cat(self.target)
@@ -173,6 +179,11 @@ class MulticlassPrecisionRecallCurve(Metric):
             self.target.append(state[1])
         else:
             self.confmat = self.confmat + state
+
+    def _traced_value_flags(self, preds: Array, target: Array):
+        # eager validation is metadata-only (shapes/dtype/class axis); no
+        # value checks to fuse — binned instances compile freely
+        return _no_value_flags(preds, target)
 
     def _final_state(self):
         if self.thresholds is None:
@@ -238,6 +249,10 @@ class MultilabelPrecisionRecallCurve(Metric):
             self.target.append(state[1])
         else:
             self.confmat = self.confmat + state
+
+    def _traced_value_flags(self, preds: Array, target: Array):
+        # eager validation is metadata-only (shapes/dtype/label axis)
+        return _no_value_flags(preds, target)
 
     def _final_state(self):
         if self.thresholds is None:

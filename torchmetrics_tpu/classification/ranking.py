@@ -15,6 +15,7 @@ from torchmetrics_tpu.functional.classification.ranking import (
     _multilabel_ranking_tensor_validation,
 )
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.checks import _no_value_flags
 
 Array = jax.Array
 
@@ -48,6 +49,10 @@ class _RankingMetricBase(Metric):
         measure, total = type(self)._update_fn(preds, target)
         self.measure = self.measure + measure
         self.total = self.total + total
+
+    def _traced_value_flags(self, preds, target):
+        # eager validation is metadata-only (label axis / float dtype)
+        return _no_value_flags(preds, target)
 
     def compute(self) -> Array:
         return self.measure / self.total

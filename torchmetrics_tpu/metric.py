@@ -398,9 +398,12 @@ class Metric(ABC):
             # only pay the fingerprint where a compiled path could engage
             guard = self._auto_eligible()
             if guard:
-                before = self._host_attr_snapshot()
+                # the keep-alive list pins every fingerprinted object for the
+                # duration of the update, so a freed-and-reallocated object
+                # cannot alias a stale id in the comparison
+                before, _keepalive = self._host_attr_snapshot()
             update(*args, **kwargs)
-            if guard and self._host_attr_snapshot() != before:
+            if guard and self._host_attr_snapshot()[0] != before:
                 # update() mutates plain (unregistered) python attributes; a
                 # traced replay would silently freeze those side effects, so
                 # the compiled paths are permanently off for this instance
@@ -415,7 +418,7 @@ class Metric(ABC):
         wrapped_func.__wrapped_by_metric__ = True  # type: ignore[attr-defined]
         return wrapped_func
 
-    def _host_attr_snapshot(self) -> List[tuple]:
+    def _host_attr_snapshot(self) -> Tuple[List[tuple], List[Any]]:
         """Fingerprint of plain (non-state, non-private) host attributes.
 
         Auto-compile replays ``update()`` as a traced executable, which would
@@ -424,13 +427,20 @@ class Metric(ABC):
         fingerprints those attributes; any observed change disables the
         compiled paths for this instance. Private (``_``-prefixed) attributes
         are the metric machinery's own bookkeeping and are not guarded.
+
+        Returns ``(fingerprint, keepalive)``: the caller must hold the
+        keep-alive list across the update so identity-fingerprinted objects
+        cannot be freed and reallocated at the same address mid-comparison.
         """
+        keepalive: List[Any] = []
+
         def fp(v: Any):
             # one-level value fingerprint; arrays/objects degrade to identity.
             # Mutations nested deeper than one container level (or occurring
             # only on inputs never seen eagerly) are out of the guard's reach.
             if isinstance(v, (bool, int, float, complex, str, bytes, type(None))):
                 return v
+            keepalive.append(v)
             return id(v)
 
         snap: List[tuple] = []
@@ -443,6 +453,7 @@ class Metric(ABC):
                 # unregistered array attrs are identity-fingerprinted:
                 # `self.cache = preds` reassigns (new id) and must disable
                 # the compiled paths just like a mutated python container
+                keepalive.append(v)
                 snap.append((k, id(v)))
             elif isinstance(v, (bool, int, float, complex, str, bytes, type(None))):
                 snap.append((k, v))
@@ -453,8 +464,9 @@ class Metric(ABC):
             elif isinstance(v, (list, dict, set, tuple)):
                 snap.append((k, id(v), len(v)))
             else:
+                keepalive.append(v)
                 snap.append((k, id(v)))
-        return snap
+        return snap, keepalive
 
     def _apply_dtype_policy(self) -> None:
         """Re-cast floating states to the ``set_dtype`` policy after an update.
