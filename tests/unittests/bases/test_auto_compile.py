@@ -163,7 +163,7 @@ class TestAutoUpdateParity:
             ("BinaryAUROC", {"thresholds": 32}, "binary"),
             ("MulticlassAveragePrecision", {"num_classes": 4, "thresholds": 32}, "multiclass"),
             ("MultilabelROC", {"num_labels": 3, "thresholds": 32}, "multilabel"),
-            ("BinaryHingeLoss", {}, "binary_float"),
+            ("BinaryHingeLoss", {}, "binary"),
             ("MultilabelRankingLoss", {"num_labels": 3}, "multilabel"),
             ("MulticlassExactMatch", {"num_classes": 4}, "multiclass_labels"),
         ],
@@ -176,8 +176,6 @@ class TestAutoUpdateParity:
         def batch(i):
             r = np.random.default_rng(60_000 + i)
             if maker == "binary":
-                return jnp.asarray(r.random(32).astype(np.float32)), jnp.asarray(r.integers(0, 2, 32))
-            if maker == "binary_float":
                 return jnp.asarray(r.random(32).astype(np.float32)), jnp.asarray(r.integers(0, 2, 32))
             if maker == "multiclass":
                 p = r.random((32, 4)).astype(np.float32)

@@ -36,6 +36,17 @@ class _AbstractGroupStatScores(Metric):
         self.tn = self.tn + jnp.stack([s[2] for s in group_stats])
         self.fn = self.fn + jnp.stack([s[3] for s in group_stats])
 
+    def _traced_value_flags(self, preds: Array, target: Array, groups: Array):
+        # binary target-set check + the groups-range check (mirroring the
+        # eager `_groups_validation`: flags only values strictly above
+        # `num_groups`, like the host-side check it replaces)
+        msgs_t, flags_t = _binary_stat_scores_value_flags(preds, target, self.ignore_index)
+        groups = jnp.asarray(groups)
+        msgs = msgs_t + (
+            f"The groups tensor contains identifiers larger than the specified number of groups {self.num_groups}.",
+        )
+        return msgs, jnp.concatenate([flags_t, (jnp.max(groups) > self.num_groups)[None]])
+
 
 class BinaryGroupStatRates(_AbstractGroupStatScores):
     """Per-group tp/fp/tn/fn rates.
@@ -75,17 +86,6 @@ class BinaryGroupStatRates(_AbstractGroupStatScores):
             preds, target, groups, self.num_groups, self.threshold, self.ignore_index, self.validate_args
         )
         self._update_states(group_stats)
-
-    def _traced_value_flags(self, preds: Array, target: Array, groups: Array):
-        # binary target-set check + the groups-range check (mirroring the
-        # eager `_groups_validation`: flags only values strictly above
-        # `num_groups`, like the host-side check it replaces)
-        msgs_t, flags_t = _binary_stat_scores_value_flags(preds, target, self.ignore_index)
-        groups = jnp.asarray(groups)
-        msgs = msgs_t + (
-            f"The groups tensor contains identifiers larger than the specified number of groups {self.num_groups}.",
-        )
-        return msgs, jnp.concatenate([flags_t, (jnp.max(groups) > self.num_groups)[None]])
 
     def compute(self) -> Dict[str, Array]:
         return _groups_reduce([(self.tp[g], self.fp[g], self.tn[g], self.fn[g]) for g in range(self.num_groups)])
@@ -145,12 +145,7 @@ class BinaryFairness(_AbstractGroupStatScores):
         # is deliberately unvalidated — the fused check must match
         if self.task == "demographic_parity":
             target = jnp.zeros(jnp.asarray(preds).shape, dtype=jnp.int32)
-        msgs_t, flags_t = _binary_stat_scores_value_flags(preds, target, self.ignore_index)
-        groups = jnp.asarray(groups)
-        msgs = msgs_t + (
-            f"The groups tensor contains identifiers larger than the specified number of groups {self.num_groups}.",
-        )
-        return msgs, jnp.concatenate([flags_t, (jnp.max(groups) > self.num_groups)[None]])
+        return super()._traced_value_flags(preds, target, groups)
 
     def compute(self) -> Dict[str, Array]:
         stats = {"tp": self.tp, "fp": self.fp, "tn": self.tn, "fn": self.fn}
