@@ -1039,6 +1039,60 @@ def _bench_chip_parity() -> tuple:
 _RESULTS: list = []
 
 
+# --------------------------------------------------------------------- #
+# certified-class fingerprint skip: eager update() with/without the      #
+# _host_attr_snapshot guard (torchmetrics_tpu/_analysis feedback loop)   #
+# --------------------------------------------------------------------- #
+
+FP_SKIP_UPDATES = 96
+
+
+def _bench_fingerprint_skip() -> tuple:
+    """Eager ``update()`` rate for an R1-certified metric, with the analyzer's
+    fingerprint skip vs the guard forced back on.
+
+    Shape-churn workload: every call uses a batch size beyond the 8-signature
+    auto-compile cache, so each update runs the eager wrapped path — exactly
+    where the per-update fingerprint lives.
+    """
+    import jax.numpy as jnp
+
+    from torchmetrics_tpu._analysis import manifest as manifest_mod
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    # distinct batch sizes: the first 8 fill the signature cache, the rest
+    # are permanent cache misses and replay the guarded eager path
+    inputs = [
+        (jnp.zeros((n,), jnp.float32) + 0.5, jnp.ones((n,), jnp.float32))
+        for n in range(16, 16 + 8 + FP_SKIP_UPDATES)
+    ]
+
+    def rate(skip_enabled: bool) -> float:
+        manifest_mod.set_fingerprint_skip_enabled(skip_enabled)
+        metric = MeanSquaredError()
+        for p, t in inputs[:8]:  # fill the signature cache
+            metric.update(p, t)
+
+        def run():
+            for p, t in inputs[8:]:
+                metric.update(p, t)
+            return float(metric.compute())
+
+        return FP_SKIP_UPDATES / _min_time(run, reps=3)
+
+    prior = manifest_mod.fingerprint_skip_enabled()
+    try:
+        rate(True)  # warm both code paths (dispatch caches, first-touch jit)
+        rate(False)
+        # interleave two measured passes per config and keep the best, so a
+        # transient host stall can't bias either side
+        with_skip = max(rate(True), rate(True))
+        without_skip = max(rate(False), rate(False))
+    finally:
+        manifest_mod.set_fingerprint_skip_enabled(prior)
+    return with_skip, without_skip
+
+
 def _emit(line: dict) -> None:
     """Print one bench line and record it for the final summary line.
 
@@ -1265,6 +1319,21 @@ def main() -> None:
                 }
             )
         )
+
+    fp_skip_rate, fp_guard_rate = _bench_fingerprint_skip()
+    _emit((
+            {
+                "metric": "eager_update_fingerprint_skip_per_sec",
+                "value": round(fp_skip_rate, 1),
+                "unit": (
+                    f"eager updates/sec (shape-churn MeanSquaredError, {FP_SKIP_UPDATES} distinct batch"
+                    " shapes past the auto-compile signature cache; R1-certified class skips"
+                    " _host_attr_snapshot; baseline = same run with the fingerprint guard forced on)"
+                ),
+                "vs_baseline": round(fp_skip_rate / fp_guard_rate, 3),
+            }
+        )
+    )
 
     _emit_summary()
 

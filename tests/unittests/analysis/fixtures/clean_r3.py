@@ -1,0 +1,27 @@
+"""False-positive twin for R3: branching on metadata, identity, dict keys,
+and config — never on traced values."""
+
+from typing import Dict, Optional
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+
+class GoodControlFlow(Metric):
+    def __init__(self, ignore_index: Optional[int] = None, **kwargs):
+        super().__init__(**kwargs)
+        self.ignore_index = ignore_index
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, extra: Dict[str, jnp.ndarray] = None) -> None:
+        if self.ignore_index is not None:  # config identity test
+            preds = jnp.where(preds == self.ignore_index, 0.0, preds)
+        if preds.ndim != 1:  # shape metadata
+            raise ValueError("expected 1d input")
+        if extra is not None and "weights" not in extra:  # dict-key membership
+            raise ValueError("missing weights")
+        self.total = self.total + jnp.where(preds.sum() > 0, preds.sum(), 0.0)
+
+    def compute(self):
+        return self.total

@@ -1,0 +1,27 @@
+"""False-positive twin for R5: the flag vector is declared locally or
+inherited from a base class in the chain."""
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+
+class GoodOwnValidator(Metric):
+    def __init__(self, validate_args: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.validate_args = validate_args
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def _traced_value_flags(self, preds):
+        return ("preds out of range",), jnp.any((preds < 0) | (preds > 1))[None]
+
+    def update(self, preds) -> None:
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+
+
+class GoodInheritedValidator(GoodOwnValidator):
+    def __init__(self, validate_args: bool = True, **kwargs):
+        super().__init__(validate_args=validate_args, **kwargs)

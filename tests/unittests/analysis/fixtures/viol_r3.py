@@ -1,0 +1,19 @@
+"""True-positive fixture for R3: python control flow on traced values."""
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+
+class BadControlFlow(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds) -> None:
+        if preds.sum() > 0:
+            self.total = self.total + preds.sum()
+        assert (preds >= 0).all()
+
+    def compute(self):
+        return self.total if self.total > 0 else jnp.asarray(0.0)

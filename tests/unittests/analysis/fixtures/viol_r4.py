@@ -1,0 +1,20 @@
+"""True-positive fixture for R4: value-dependent output shapes."""
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+
+class BadDynamicShapes(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        labels = jnp.unique(target)
+        kept = preds[preds > 0]
+        (idx,) = jnp.where(target > 0)
+        self.total = self.total + kept.sum() + labels.sum() + idx.sum()
+
+    def compute(self):
+        return self.total

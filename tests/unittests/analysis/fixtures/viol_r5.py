@@ -1,0 +1,18 @@
+"""True-positive fixture for R5: `validate_args` without a traced validator."""
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+
+class BadMissingValidator(Metric):
+    def __init__(self, validate_args: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.validate_args = validate_args
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds) -> None:
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total

@@ -1,0 +1,93 @@
+"""Baseline round-trip, staleness detection, and manifest behavior."""
+
+import json
+from pathlib import Path
+
+import pytest
+
+from torchmetrics_tpu._analysis import (
+    analyze_paths,
+    load_baseline,
+    load_manifest,
+    split_baselined,
+    write_baseline,
+    write_manifest,
+)
+from torchmetrics_tpu._analysis import manifest as manifest_mod
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def test_baseline_roundtrip_suppresses_everything(tmp_path):
+    result = analyze_paths([str(FIXTURES / "viol_r1.py")])
+    assert result.violations
+    bl_path = tmp_path / "baseline.json"
+    n = write_baseline(result.violations, bl_path, existing={})
+    assert n == len(result.violations)
+    baseline = load_baseline(bl_path)
+    new, suppressed, stale = split_baselined(result.violations, baseline)
+    assert new == [] and len(suppressed) == len(result.violations) and stale == []
+
+
+def test_edited_line_invalidates_baseline_entry(tmp_path):
+    result = analyze_paths([str(FIXTURES / "viol_r1.py")])
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(result.violations, bl_path, existing={})
+    # simulate an edit to one offending line: its snippet no longer matches
+    data = json.loads(bl_path.read_text())
+    data["entries"][0]["snippet"] = "self.seen_batches = 2  # edited"
+    bl_path.write_text(json.dumps(data))
+    baseline = load_baseline(bl_path)
+    new, suppressed, stale = split_baselined(result.violations, baseline)
+    assert len(new) == 1  # the edited line resurfaces as un-baselined
+    assert len(stale) == 1  # and its old entry reports stale
+
+
+def test_write_baseline_preserves_existing_justifications(tmp_path):
+    result = analyze_paths([str(FIXTURES / "viol_r1.py")])
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(result.violations, bl_path, existing={})
+    baseline = load_baseline(bl_path)
+    fp = next(iter(baseline))
+    patched = dict(baseline)
+    entry = patched[fp]
+    patched[fp] = type(entry)(
+        path=entry.path, rule=entry.rule, scope=entry.scope, snippet=entry.snippet,
+        justification="reviewed: intentional",
+    )
+    write_baseline(result.violations, bl_path, existing=patched)
+    reloaded = load_baseline(bl_path)
+    assert reloaded[fp].justification == "reviewed: intentional"
+
+
+def test_manifest_roundtrip(tmp_path):
+    path = tmp_path / "certified.json"
+    write_manifest(["pkg.mod.B", "pkg.mod.A", "pkg.mod.A"], path)
+    assert load_manifest(path) == frozenset({"pkg.mod.A", "pkg.mod.B"})
+
+
+@pytest.fixture()
+def _clean_manifest_caches():
+    yield
+    manifest_mod.invalidate_cache()
+    manifest_mod.set_fingerprint_skip_enabled(True)
+
+
+def test_fingerprint_skip_requires_whole_chain(_clean_manifest_caches):
+    from torchmetrics_tpu.regression import MeanAbsoluteError
+
+    assert manifest_mod.fingerprint_skip_allowed(MeanAbsoluteError)
+
+    class UserSubclass(MeanAbsoluteError):  # not in the manifest
+        pass
+
+    assert not manifest_mod.fingerprint_skip_allowed(UserSubclass)
+
+
+def test_fingerprint_skip_toggle(_clean_manifest_caches):
+    from torchmetrics_tpu.regression import MeanAbsoluteError
+
+    manifest_mod.set_fingerprint_skip_enabled(False)
+    assert not manifest_mod.fingerprint_skip_allowed(MeanAbsoluteError)
+    manifest_mod.set_fingerprint_skip_enabled(True)
+    assert manifest_mod.fingerprint_skip_allowed(MeanAbsoluteError)

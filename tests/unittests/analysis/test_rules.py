@@ -1,0 +1,155 @@
+"""Per-rule true-positive / false-positive suites over the seeded fixtures.
+
+Each ``viol_*`` fixture plants known violations at known lines; each
+``clean_*`` twin exercises the same code shapes in their trace-safe form and
+must stay silent. Exact rule IDs AND line numbers are asserted so checker
+regressions (wrong rule, drifted anchor) fail loudly.
+"""
+
+from pathlib import Path
+
+import pytest
+
+from torchmetrics_tpu._analysis import analyze_paths, analyze_source
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+EXPECTED = {
+    "viol_r1.py": [("R1", 17), ("R1", 18), ("R1", 22)],
+    "viol_r2.py": [("R2", 19), ("R2", 20), ("R2", 24)],
+    "viol_r3.py": [("R3", 14), ("R3", 16), ("R3", 19)],
+    "viol_r4.py": [("R4", 14), ("R4", 15), ("R4", 16)],
+    "viol_r5.py": [("R5", 8)],
+}
+
+
+@pytest.mark.parametrize("fixture", sorted(EXPECTED))
+def test_true_positives_fire_with_exact_lines(fixture):
+    result = analyze_paths([str(FIXTURES / fixture)])
+    assert not result.parse_errors
+    got = [(v.rule, v.line) for v in result.violations]
+    assert got == EXPECTED[fixture]
+
+
+@pytest.mark.parametrize("fixture", ["clean_r1.py", "clean_r2.py", "clean_r3.py", "clean_r4.py", "clean_r5.py"])
+def test_clean_twins_stay_silent(fixture):
+    result = analyze_paths([str(FIXTURES / fixture)])
+    assert not result.parse_errors
+    assert result.violations == []
+
+
+def test_functional_kernel_scope_is_scanned():
+    # analyze_source treats every `*_update`/`*_compute`-named module function
+    # as a traced kernel; the seeded float() in viol_r2's kernel must fire
+    text = (FIXTURES / "viol_r2.py").read_text()
+    result = analyze_source(text, path="viol_r2.py")
+    kernel_hits = [(v.rule, v.line) for v in result.violations if v.scope == "_bad_kernel_update"]
+    assert kernel_hits == [("R2", 28)]
+
+
+def test_clean_r1_twin_is_certified():
+    result = analyze_paths([str(FIXTURES / "clean_r1.py")])
+    assert result.certified == ["clean_r1.GoodRegisteredState"]
+
+
+def test_r1_violation_blocks_certification():
+    result = analyze_paths([str(FIXTURES / "viol_r1.py")])
+    assert result.certified == []
+
+
+def test_inline_lint_ok_suppresses_only_named_rule():
+    src = (
+        "import jax.numpy as jnp\n"
+        "from torchmetrics_tpu.metric import Metric\n"
+        "class M(Metric):\n"
+        "    def __init__(self, **kw):\n"
+        "        super().__init__(**kw)\n"
+        "        self.add_state('total', default=jnp.array(0.0), dist_reduce_fx='sum')\n"
+        "    def update(self, preds) -> None:\n"
+        "        a = float(preds.sum())  # lint-ok: R2 measured host fold\n"
+        "        b = float(preds.min())  # lint-ok: R3 wrong rule id does not suppress R2\n"
+        "        self.total = self.total + a + b\n"
+        "    def compute(self):\n"
+        "        return self.total\n"
+    )
+    result = analyze_source(src, path="inline.py")
+    assert [(v.rule, v.line) for v in result.violations] == [("R2", 9)]
+
+
+def test_inline_lint_ok_multi_rule_with_reason():
+    # `# lint-ok: R2, R4 reason` must suppress BOTH rules, reason and all
+    src = (
+        "import jax.numpy as jnp\n"
+        "from torchmetrics_tpu.metric import Metric\n"
+        "class M(Metric):\n"
+        "    def __init__(self, **kw):\n"
+        "        super().__init__(**kw)\n"
+        "        self.add_state('total', default=jnp.array(0.0), dist_reduce_fx='sum')\n"
+        "    def update(self, preds) -> None:\n"
+        "        k = float(jnp.unique(preds).sum())  # lint-ok: R2, R4 host bucketing, reviewed\n"
+        "        self.total = self.total + k\n"
+        "    def compute(self):\n"
+        "        return self.total\n"
+    )
+    result = analyze_source(src, path="multi.py")
+    assert result.violations == []
+
+
+def test_getattr_mutation_blocks_certification():
+    # a dynamically-addressed mutation can't be proven state-safe: the class
+    # must keep the runtime fingerprint guard (stay un-certified)
+    src = (
+        "import jax.numpy as jnp\n"
+        "from torchmetrics_tpu.metric import Metric\n"
+        "class M(Metric):\n"
+        "    def __init__(self, **kw):\n"
+        "        super().__init__(**kw)\n"
+        "        self.add_state('total', default=jnp.array(0.0), dist_reduce_fx='sum')\n"
+        "    def _stash(self, v):\n"
+        "        getattr(self, 'bucket_' + str(int(v.ndim))).append(v)\n"
+        "    def update(self, preds) -> None:\n"
+        "        self.total = self.total + preds.sum()\n"
+        "    def compute(self):\n"
+        "        return self.total\n"
+    )
+    result = analyze_source(src, path="dyn.py")
+    assert result.certified == []
+
+
+def test_eager_helper_marker_disables_traced_rules():
+    src = (
+        "import jax.numpy as jnp\n"
+        "from torchmetrics_tpu.metric import Metric\n"
+        "class M(Metric):\n"
+        "    def __init__(self, **kw):\n"
+        "        super().__init__(**kw)\n"
+        "        self.add_state('total', default=jnp.array(0.0), dist_reduce_fx='sum')\n"
+        "    def update(self, preds) -> None:  # lint: eager-helper\n"
+        "        self.total = self.total + float(preds.sum())\n"
+        "    def compute(self):\n"
+        "        return self.total\n"
+    )
+    result = analyze_source(src, path="marker.py")
+    assert result.violations == []
+
+
+def test_inherited_states_resolve_across_classes():
+    # a subclass mutating state registered by its base must NOT flag R1
+    src = (
+        "import jax.numpy as jnp\n"
+        "from torchmetrics_tpu.metric import Metric\n"
+        "class Base(Metric):\n"
+        "    def __init__(self, **kw):\n"
+        "        super().__init__(**kw)\n"
+        "        self.add_state('total', default=jnp.array(0.0), dist_reduce_fx='sum')\n"
+        "    def update(self, preds) -> None:\n"
+        "        self.total = self.total + preds.sum()\n"
+        "    def compute(self):\n"
+        "        return self.total\n"
+        "class Child(Base):\n"
+        "    def update(self, preds) -> None:\n"
+        "        self.total = self.total + 2 * preds.sum()\n"
+    )
+    result = analyze_source(src, path="inherit.py")
+    assert result.violations == []
+    assert sorted(result.certified) == ["inherit.Base", "inherit.Child"]

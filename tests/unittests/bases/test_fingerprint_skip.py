@@ -1,0 +1,76 @@
+"""The analyzer→runtime feedback loop: R1-certified classes skip the
+per-``update()`` ``_host_attr_snapshot`` fingerprint; anything the analyzer
+has not certified (user subclasses above all) keeps the guard — and the
+guard still catches real unregistered-attribute mutation."""
+
+import jax.numpy as jnp
+import pytest
+
+from torchmetrics_tpu._analysis import manifest as manifest_mod
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+
+
+@pytest.fixture()
+def snapshot_counter(monkeypatch):
+    calls = []
+    orig = Metric._host_attr_snapshot
+
+    def counting(self):
+        calls.append(type(self).__name__)
+        return orig(self)
+
+    monkeypatch.setattr(Metric, "_host_attr_snapshot", counting)
+    yield calls
+    manifest_mod.invalidate_cache()
+
+
+def test_certified_class_skips_snapshot(snapshot_counter):
+    metric = MeanAbsoluteError()
+    assert manifest_mod.fingerprint_skip_allowed(MeanAbsoluteError)
+    metric.update(jnp.array([0.0, 1.0, 2.0]), jnp.array([0.0, 1.0, 4.0]))
+    assert snapshot_counter == []  # no fingerprint paid on the eager pass
+    assert float(metric.compute()) == pytest.approx(2.0 / 3.0)
+
+
+def test_uncertified_subclass_keeps_guard(snapshot_counter):
+    class Sub(MeanSquaredError):
+        pass
+
+    metric = Sub()
+    metric.update(jnp.array([0.0, 1.0]), jnp.array([0.0, 2.0]))
+    # before + after snapshots on the guarded eager pass
+    assert len(snapshot_counter) == 2
+    assert not metric._auto_disabled
+
+
+def test_guard_still_catches_mutation_in_uncertified_subclass(snapshot_counter):
+    class Mutating(MeanSquaredError):
+        def update(self, preds, target):
+            super().update(preds, target)
+            self.batches = getattr(self, "batches", 0) + 1
+
+    metric = Mutating()
+    metric.update(jnp.array([0.0, 1.0]), jnp.array([0.0, 2.0]))
+    assert metric._auto_disabled  # compiled paths permanently off
+    assert metric.batches == 1
+
+
+def test_skip_disabled_toggle_restores_guard(snapshot_counter):
+    manifest_mod.set_fingerprint_skip_enabled(False)
+    try:
+        metric = MeanAbsoluteError()
+        metric.update(jnp.array([0.0, 1.0]), jnp.array([0.0, 2.0]))
+        assert len(snapshot_counter) == 2
+    finally:
+        manifest_mod.set_fingerprint_skip_enabled(True)
+
+
+def test_certified_class_still_autocompiles_on_repeat_shapes(snapshot_counter):
+    metric = MeanAbsoluteError()
+    p, t = jnp.array([0.0, 1.0, 2.0]), jnp.array([0.0, 1.0, 4.0])
+    metric.update(p, t)  # first signature: eager warm-up (snapshot skipped)
+    metric.update(p, t)  # repeat signature: compiled replay
+    assert snapshot_counter == []
+    assert metric._auto_sigs and max(metric._auto_sigs.values()) >= 1
+    assert float(metric.compute()) == pytest.approx(4.0 / 6.0)

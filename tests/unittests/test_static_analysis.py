@@ -1,0 +1,101 @@
+"""Tier-1 gate: the whole package must lint clean against the checked-in
+baseline, the certified manifest must be in sync with the code, and the full
+scan must stay inside its 10 s CI budget.
+
+Any new violation fails this test with the rendered finding: either fix the
+hazard, suppress the line with ``# lint-ok: <rule> <reason>``, or re-baseline
+via ``python tools/lint_metrics.py torchmetrics_tpu/ --write-baseline`` with
+a justification (see ANALYSIS.md).
+"""
+
+import time
+from pathlib import Path
+
+from torchmetrics_tpu._analysis import (
+    MANIFEST_PATH,
+    RULES,
+    analyze_paths,
+    load_baseline,
+    load_manifest,
+    split_baselined,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[2]
+PACKAGE = REPO_ROOT / "torchmetrics_tpu"
+BASELINE = REPO_ROOT / "tools" / "lint_baseline.json"
+FIXTURES = Path(__file__).parent / "analysis" / "fixtures"
+
+SCAN_BUDGET_SECONDS = 10.0
+
+_SCAN_CACHE = None
+
+
+def _scan():
+    """One shared full-package scan: the result is immutable and every test
+    here reads a different facet of it, so scanning once keeps this gate's
+    wall-clock at a single ~2 s scan."""
+    global _SCAN_CACHE
+    if _SCAN_CACHE is None:
+        t0 = time.perf_counter()
+        result = analyze_paths([str(PACKAGE)])
+        _SCAN_CACHE = (result, time.perf_counter() - t0)
+    return _SCAN_CACHE
+
+
+def test_package_has_zero_unbaselined_violations():
+    result, _ = _scan()
+    assert not result.parse_errors, f"analyzer failed to parse: {result.parse_errors}"
+    baseline = load_baseline(BASELINE)
+    new, _suppressed, stale = split_baselined(result.violations, baseline)
+    rendered = "\n".join(v.render() for v in new)
+    assert not new, (
+        f"{len(new)} un-baselined trace-safety violations (fix, `# lint-ok:`, or re-baseline"
+        f" with justification — see ANALYSIS.md):\n{rendered}"
+    )
+    stale_rendered = "\n".join(f"{e.path} {e.rule} [{e.scope}] {e.snippet}" for e in stale)
+    assert not stale, (
+        f"{len(stale)} stale baseline entries no longer match any violation — prune with"
+        f" `python tools/lint_metrics.py torchmetrics_tpu/ --write-baseline`:\n{stale_rendered}"
+    )
+
+
+def test_scan_meets_ci_time_budget():
+    _, elapsed = _scan()
+    assert elapsed < SCAN_BUDGET_SECONDS, f"full-package scan took {elapsed:.2f}s (budget {SCAN_BUDGET_SECONDS}s)"
+
+
+def test_every_rule_fires_on_its_fixture():
+    # end-to-end smoke that no rule has silently gone dead (the detailed
+    # line-number assertions live in tests/unittests/analysis/test_rules.py)
+    fired = set()
+    for rule_id in RULES:
+        result = analyze_paths([str(FIXTURES / f"viol_{rule_id.lower()}.py")])
+        fired |= {v.rule for v in result.violations}
+    assert fired == set(RULES), f"rules with no firing fixture: {set(RULES) - fired}"
+
+
+def test_checked_in_manifest_matches_code():
+    result, _ = _scan()
+    manifest = load_manifest(MANIFEST_PATH)
+    current = frozenset(result.certified)
+    missing = sorted(current - manifest)
+    removed = sorted(manifest - current)
+    assert manifest == current, (
+        "certified.json is out of sync with the analyzer — regenerate with"
+        " `python tools/lint_metrics.py torchmetrics_tpu/ --write-manifest`."
+        f" newly certified: {missing[:10]}; no longer certified: {removed[:10]}"
+    )
+
+
+def test_manifest_is_nontrivial_and_scoped():
+    manifest = load_manifest(MANIFEST_PATH)
+    assert len(manifest) >= 100  # the bulk of the metric catalog is clean
+    assert all(q.startswith("torchmetrics_tpu.") for q in manifest)
+    # spot-check: classes with baselined R1 violations must never be certified
+    for uncertifiable in (
+        "torchmetrics_tpu.wrappers.classwise.ClasswiseWrapper",
+        "torchmetrics_tpu.wrappers.running.Running",
+        "torchmetrics_tpu.wrappers.minmax.MinMaxMetric",
+        "torchmetrics_tpu.metric.CompositionalMetric",
+    ):
+        assert uncertifiable not in manifest, f"{uncertifiable} has R1 findings and must not be certified"

@@ -1,0 +1,107 @@
+#!/usr/bin/env python
+"""Trace-safety lint CLI for torchmetrics_tpu (rule catalog in ANALYSIS.md).
+
+Usage:
+    python tools/lint_metrics.py torchmetrics_tpu/            # human report
+    python tools/lint_metrics.py torchmetrics_tpu/ --json     # CI / machines
+    python tools/lint_metrics.py torchmetrics_tpu/ --write-baseline
+    python tools/lint_metrics.py torchmetrics_tpu/ --write-manifest
+
+Exit status: 0 when no un-baselined violations (and no parse errors),
+1 otherwise. ``--write-baseline`` rewrites the suppression file to the
+current violation set (keeping existing justifications) and exits 0;
+``--write-manifest`` regenerates the certified-clean class manifest the
+runtime uses to skip the `_host_attr_snapshot` fingerprint guard.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+DEFAULT_BASELINE = REPO_ROOT / "tools" / "lint_baseline.json"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("paths", nargs="*", default=["torchmetrics_tpu/"], help="files or directories to scan")
+    parser.add_argument("--json", action="store_true", help="emit a machine-readable JSON report")
+    parser.add_argument("--baseline", type=Path, default=DEFAULT_BASELINE, help="baseline suppression file")
+    parser.add_argument("--no-baseline", action="store_true", help="report every violation, ignoring the baseline")
+    parser.add_argument("--write-baseline", action="store_true", help="rewrite the baseline to the current violations")
+    parser.add_argument("--write-manifest", action="store_true", help="regenerate the certified-clean manifest")
+    parser.add_argument("--manifest", type=Path, default=None, help="manifest output path (default: package location)")
+    args = parser.parse_args(argv)
+
+    from torchmetrics_tpu._analysis import (
+        MANIFEST_PATH,
+        analyze_paths,
+        load_baseline,
+        split_baselined,
+        write_baseline,
+        write_manifest,
+    )
+
+    t0 = time.perf_counter()
+    paths = args.paths or ["torchmetrics_tpu/"]
+    result = analyze_paths(paths)
+    elapsed = time.perf_counter() - t0
+
+    baseline = {} if args.no_baseline else load_baseline(args.baseline)
+    new, suppressed, stale = split_baselined(result.violations, baseline)
+
+    if args.write_baseline:
+        n = write_baseline(result.violations, args.baseline, baseline)
+        print(f"wrote {n} baseline entries to {args.baseline}")
+        return 0
+
+    if args.write_manifest:
+        out = args.manifest or MANIFEST_PATH
+        n = write_manifest(result.certified, out)
+        print(f"wrote {n} certified R1-clean classes to {out}")
+        return 0
+
+    if args.json:
+        print(
+            json.dumps(
+                {
+                    "files_scanned": result.files_scanned,
+                    "classes_seen": result.classes_seen,
+                    "certified_count": len(result.certified),
+                    "elapsed_seconds": round(elapsed, 3),
+                    "violations": [v.to_json() for v in new],
+                    "suppressed_count": len(suppressed),
+                    "stale_baseline_entries": [
+                        {"path": e.path, "rule": e.rule, "scope": e.scope, "snippet": e.snippet} for e in stale
+                    ],
+                    "parse_errors": result.parse_errors,
+                },
+                indent=2,
+            )
+        )
+    else:
+        for v in new:
+            print(v.render())
+        for err in result.parse_errors:
+            print(f"PARSE ERROR: {err}")
+        print(
+            f"\nscanned {result.files_scanned} files / {result.classes_seen} classes in {elapsed:.2f}s:"
+            f" {len(new)} violations ({len(suppressed)} baselined, {len(stale)} stale baseline entries),"
+            f" {len(result.certified)} classes certified R1-clean"
+        )
+        if stale:
+            print("stale baseline entries (fixed code — prune with --write-baseline):")
+            for e in stale[:20]:
+                print(f"  {e.path} {e.rule} [{e.scope}] {e.snippet}")
+
+    return 1 if (new or result.parse_errors) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,47 @@
+"""Trace-safety static analyzer for torchmetrics_tpu.
+
+Lints every metric module for XLA hazards (rule catalog R1-R5, see
+``ANALYSIS.md``), maintains a baseline of accepted pre-existing violations,
+and certifies R1-clean classes into a manifest the runtime uses to skip the
+per-``update()`` fingerprint guard.
+
+The analyzer parses source with ``ast`` only — scanned modules are never
+imported or executed, so the full-package scan stays fast and free of import
+side effects.
+"""
+
+from torchmetrics_tpu._analysis.baseline import (
+    BaselineEntry,
+    load_baseline,
+    split_baselined,
+    write_baseline,
+)
+from torchmetrics_tpu._analysis.engine import AnalysisResult, analyze_paths, analyze_source
+from torchmetrics_tpu._analysis.manifest import (
+    MANIFEST_PATH,
+    fingerprint_skip_allowed,
+    load_manifest,
+    set_fingerprint_skip_enabled,
+    write_manifest,
+)
+from torchmetrics_tpu._analysis.model import Violation
+from torchmetrics_tpu._analysis.rules import RULES, Rule, rule
+
+__all__ = [
+    "AnalysisResult",
+    "BaselineEntry",
+    "MANIFEST_PATH",
+    "RULES",
+    "Rule",
+    "Violation",
+    "analyze_paths",
+    "analyze_source",
+    "fingerprint_skip_allowed",
+    "load_baseline",
+    "load_manifest",
+    "rule",
+    "set_fingerprint_skip_enabled",
+    "split_baselined",
+    "write_baseline",
+    "write_manifest",
+]

@@ -1,0 +1,150 @@
+"""Traced-path rules: R2 (host-sync leak), R3 (traced control flow), and
+R4 (value-dependent shapes / recompile hazards).
+
+All three only fire on *tainted* expressions — values flowing from batch
+arguments or registered states, i.e. the values XLA swaps for tracers when
+the function compiles (see ``taint.py``). Functions marked
+``# lint: eager-helper`` on their ``def`` line are host-by-design and
+skipped wholesale.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Set
+
+from torchmetrics_tpu._analysis.model import SourceInfo, Violation
+from torchmetrics_tpu._analysis.taint import HOST_CONVERTERS, TaintTracker
+
+NUMPY_MODULE_ALIASES = {"np", "numpy"}
+
+# jnp/lax ops whose output shape depends on data values
+DATA_DEPENDENT_SHAPE_FNS = {"unique", "nonzero", "argwhere", "flatnonzero", "extract", "compress", "union1d", "intersect1d", "setdiff1d"}
+
+HOST_SYNC_METHODS = {"item", "tolist"}
+
+
+def check_traced_function(
+    func: ast.FunctionDef,
+    source: SourceInfo,
+    scope: str,
+    tainted_self_attrs: Set[str],
+    is_method: bool,
+) -> List[Violation]:
+    """Run R2/R3/R4 over one traced function (method or functional kernel)."""
+    if source.is_eager_helper(func.lineno):
+        return []
+    tracker = TaintTracker(func, tainted_self_attrs, is_method=is_method)
+    out: List[Violation] = []
+
+    def emit(rule_id: str, lineno: int, message: str) -> None:
+        v = source.violation(rule_id, lineno, scope, message)
+        if v:
+            out.append(v)
+
+    for node in ast.walk(func):
+        if isinstance(node, ast.Call):
+            _check_call(node, tracker, emit)
+        elif isinstance(node, (ast.If, ast.While)):
+            if tracker.is_tainted(node.test):
+                kind = "if" if isinstance(node, ast.If) else "while"
+                emit(
+                    "R3", node.lineno,
+                    f"python `{kind}` branches on a traced value — use `jnp.where`/`lax.cond` to stay on device",
+                )
+        elif isinstance(node, ast.Assert):
+            if tracker.is_tainted(node.test):
+                emit("R3", node.lineno, "`assert` on a traced value host-syncs eagerly and fails under trace")
+        elif isinstance(node, ast.IfExp):
+            if tracker.is_tainted(node.test):
+                emit(
+                    "R3", node.lineno,
+                    "conditional expression branches on a traced value — use `jnp.where` instead",
+                )
+        elif isinstance(node, (ast.ListComp, ast.SetComp, ast.DictComp, ast.GeneratorExp)):
+            for gen in node.generators:
+                for cond in gen.ifs:
+                    if tracker.is_tainted(cond):
+                        emit("R3", cond.lineno, "comprehension filters on a traced value")
+        elif isinstance(node, ast.Subscript) and not isinstance(node.ctx, ast.Store):
+            _check_bool_mask_index(node, tracker, emit)
+    return out
+
+
+def _call_name(fn: ast.expr) -> Optional[str]:
+    if isinstance(fn, ast.Name):
+        return fn.id
+    if isinstance(fn, ast.Attribute):
+        return fn.attr
+    return None
+
+
+def _module_of(fn: ast.expr) -> Optional[str]:
+    if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name):
+        return fn.value.id
+    return None
+
+
+def _check_call(node: ast.Call, tracker: TaintTracker, emit) -> None:
+    fn = node.func
+    name = _call_name(fn)
+    mod = _module_of(fn)
+    any_tainted_arg = any(tracker.is_tainted(a) for a in node.args) or any(
+        tracker.is_tainted(kw.value) for kw in node.keywords
+    )
+
+    # R2: python scalar conversion of a traced value
+    if isinstance(fn, ast.Name) and fn.id in HOST_CONVERTERS and any_tainted_arg:
+        emit(
+            "R2", node.lineno,
+            f"`{fn.id}()` on a traced value forces a blocking host sync (and a trace-time concretization error)",
+        )
+        return
+    # R2: .item()/.tolist() on a traced value
+    if isinstance(fn, ast.Attribute) and fn.attr in HOST_SYNC_METHODS and tracker.is_tainted(fn.value):
+        emit("R2", node.lineno, f"`.{fn.attr}()` on a traced value forces a blocking host sync")
+        return
+    # R2: numpy applied to traced values (silently fetches to host)
+    if mod in NUMPY_MODULE_ALIASES and any_tainted_arg:
+        emit(
+            "R2", node.lineno,
+            f"`{mod}.{name}` on a traced value pulls the array to host — use the `jnp` equivalent",
+        )
+        return
+    # R2: explicit device fetch
+    if mod == "jax" and name == "device_get" and any_tainted_arg:
+        emit("R2", node.lineno, "`jax.device_get` on a traced value is an explicit host sync in a traced path")
+        return
+
+    # R4: value-dependent output shapes. A static `size=` keyword (jnp's
+    # trace-safe variants of unique/nonzero/...) removes the hazard.
+    has_static_size = any(kw.arg == "size" for kw in node.keywords)
+    if (mod in ("jnp", "jax", "lax") or mod is None) and not has_static_size:
+        if name in DATA_DEPENDENT_SHAPE_FNS and any_tainted_arg:
+            emit(
+                "R4", node.lineno,
+                f"`{name}` has a value-dependent output shape: every new value pattern recompiles"
+                " (use `size=`/masking, or mark the enclosing helper `# lint: eager-helper`)",
+            )
+            return
+        if name == "where" and len(node.args) == 1 and any_tainted_arg:
+            emit(
+                "R4", node.lineno,
+                "single-argument `where` is `nonzero` in disguise — value-dependent output shape",
+            )
+
+
+def _check_bool_mask_index(node: ast.Subscript, tracker: TaintTracker, emit) -> None:
+    """``x[mask]`` with a boolean mask: output length = number of True values."""
+    sl = node.slice
+    if not tracker.is_tainted(node.value) or not tracker.is_tainted(sl):
+        return
+    boolean_shaped = isinstance(sl, (ast.Compare, ast.BoolOp)) or (
+        isinstance(sl, ast.UnaryOp) and isinstance(sl.op, ast.Invert)
+    )
+    if boolean_shaped:
+        emit(
+            "R4", node.lineno,
+            "boolean-mask indexing on traced values has a value-dependent output shape —"
+            " use `jnp.where(mask, x, fill)` to keep shapes static",
+        )

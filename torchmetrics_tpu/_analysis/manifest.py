@@ -1,0 +1,95 @@
+"""Certified-clean manifest: the analyzer's feedback loop into the runtime.
+
+``tools/lint_metrics.py --write-manifest`` records every class the analyzer
+proves R1-clean (no unregistered-attribute mutation anywhere along its
+static MRO) into ``certified.json``. At runtime, ``Metric._wrap_update``
+consults :func:`fingerprint_skip_allowed` and skips the per-``update()``
+``_host_attr_snapshot`` fingerprint for instances whose entire class chain
+is certified — the static pass pays for itself as an eager-path speedup.
+
+The check is deliberately conservative: every class on ``type(self).__mro__``
+below the trusted ``Metric`` base must appear in the manifest, so any user
+subclass (whose source the analyzer never saw) keeps the runtime guard.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+from typing import Dict, FrozenSet, Iterable, Optional
+
+MANIFEST_PATH = Path(__file__).parent / "certified.json"
+MANIFEST_VERSION = 1
+
+_manifest_cache: Optional[FrozenSet[str]] = None
+_class_cache: Dict[type, bool] = {}
+# runtime toggle (benchmarks flip it to measure the guard's cost); the env
+# var gives operators a kill switch without code changes
+_enabled = os.environ.get("TM_TPU_DISABLE_FP_SKIP", "") != "1"
+
+
+def write_manifest(certified: Iterable[str], path: Optional[Path] = None) -> int:
+    classes = sorted(set(certified))
+    payload = {"version": MANIFEST_VERSION, "rule": "R1", "classes": classes}
+    (path or MANIFEST_PATH).write_text(json.dumps(payload, indent=2) + "\n", encoding="utf-8")
+    return len(classes)
+
+
+def load_manifest(path: Optional[Path] = None) -> FrozenSet[str]:
+    global _manifest_cache
+    if path is None and _manifest_cache is not None:
+        return _manifest_cache
+    p = path or MANIFEST_PATH
+    try:
+        data = json.loads(p.read_text(encoding="utf-8"))
+        classes = frozenset(data.get("classes", ()))
+    except (OSError, ValueError):
+        classes = frozenset()
+    if path is None:
+        _manifest_cache = classes
+    return classes
+
+
+def set_fingerprint_skip_enabled(flag: bool) -> None:
+    """Benchmark/diagnostic toggle; clears the per-class decision cache."""
+    global _enabled
+    _enabled = bool(flag)
+    _class_cache.clear()
+
+
+def fingerprint_skip_enabled() -> bool:
+    return _enabled
+
+
+def invalidate_cache() -> None:
+    global _manifest_cache
+    _manifest_cache = None
+    _class_cache.clear()
+
+
+def fingerprint_skip_allowed(cls: type) -> bool:
+    """True when every class below ``Metric`` on ``cls.__mro__`` is certified
+    R1-clean, so ``update()`` provably cannot mutate unregistered attributes
+    and the eager fingerprint guard is redundant."""
+    if not _enabled:
+        return False
+    cached = _class_cache.get(cls)
+    if cached is not None:
+        return cached
+    manifest = load_manifest()
+    allowed = False
+    if manifest:
+        allowed = None  # becomes False unless we actually reach Metric
+        for c in cls.__mro__:
+            if c.__module__ == "torchmetrics_tpu.metric" and c.__name__ == "Metric":
+                allowed = True
+                break
+            if c.__module__ in ("builtins", "abc", "typing"):
+                continue
+            if f"{c.__module__}.{c.__qualname__}" not in manifest:
+                allowed = False
+                break
+        allowed = bool(allowed)
+    _class_cache[cls] = allowed
+    return allowed

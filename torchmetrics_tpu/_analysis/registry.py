@@ -1,0 +1,309 @@
+"""Cross-module class registry with static base-class resolution.
+
+The analyzer never imports the code it scans (imports would pull in jax and
+execute module side effects; AST parsing keeps the full-package scan well
+under the 10 s CI budget). Instead this registry indexes every class
+definition in the scanned tree, records the names its bases were written
+as, resolves those names through each module's imports, and answers the
+questions the rules need:
+
+- is this class (transitively) a ``Metric`` subclass?
+- which state names did ``add_state`` register anywhere along its chain?
+- does any class along the chain declare ``_traced_value_flags``?
+- is the whole chain "R1-certifiable" (every ancestor inside the package
+  and free of unregistered-attribute mutation)?
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+from torchmetrics_tpu._analysis.model import SourceInfo
+
+PACKAGE = "torchmetrics_tpu"
+METRIC_QUALNAMES = {f"{PACKAGE}.metric.Metric", f"{PACKAGE}.Metric"}
+
+# Container-mutating method names: `self.x.append(...)` counts as mutation
+MUTATOR_METHODS = {"append", "extend", "insert", "remove", "pop", "clear", "add", "update", "popitem", "setdefault"}
+
+
+@dataclass
+class ClassInfo:
+    name: str
+    module: str  # dotted module name, e.g. "torchmetrics_tpu.regression.mae"
+    path: str  # repo-relative file path
+    lineno: int
+    base_names: List[str] = field(default_factory=list)  # as written in source
+    own_states: Set[str] = field(default_factory=set)  # literal add_state names
+    dynamic_add_state: bool = False  # add_state with a non-literal name
+    sets_validate_args: bool = False
+    declares_traced_flags: bool = False
+    methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+    # `self.<plain-attr>` assignment targets per method (mutation candidates)
+    mutated_attrs: Dict[str, Set[str]] = field(default_factory=dict)
+    dynamic_setattr_methods: Set[str] = field(default_factory=set)
+
+    @property
+    def qualname(self) -> str:
+        return f"{self.module}.{self.name}"
+
+
+@dataclass
+class ModuleInfo:
+    module: str
+    path: str
+    tree: ast.Module
+    source: SourceInfo
+    imports: Dict[str, str] = field(default_factory=dict)  # local name -> dotted origin
+    classes: Dict[str, ClassInfo] = field(default_factory=dict)
+    functions: Dict[str, ast.FunctionDef] = field(default_factory=dict)
+
+
+def _record_imports(tree: ast.Module, module: str, out: Dict[str, str]) -> None:
+    pkg_parts = module.split(".")
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                out[alias.asname or alias.name.split(".")[0]] = alias.name
+        elif isinstance(node, ast.ImportFrom):
+            if node.level:
+                # relative import: resolve against this module's package
+                base = pkg_parts[: len(pkg_parts) - node.level]
+                origin = ".".join(base + ([node.module] if node.module else []))
+            else:
+                origin = node.module or ""
+            for alias in node.names:
+                out[alias.asname or alias.name] = f"{origin}.{alias.name}" if origin else alias.name
+
+
+def _base_name(expr: ast.expr) -> Optional[str]:
+    """Render a base-class expression back to a dotted name (best effort)."""
+    if isinstance(expr, ast.Name):
+        return expr.id
+    if isinstance(expr, ast.Attribute):
+        inner = _base_name(expr.value)
+        return f"{inner}.{expr.attr}" if inner else None
+    if isinstance(expr, ast.Subscript):  # Generic[...] style
+        return _base_name(expr.value)
+    return None
+
+
+def _scan_class(node: ast.ClassDef, module: str, path: str) -> ClassInfo:
+    info = ClassInfo(
+        name=node.name,
+        module=module,
+        path=path,
+        lineno=node.lineno,
+        base_names=[b for b in (_base_name(e) for e in node.bases) if b],
+    )
+    for item in node.body:
+        if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if isinstance(item, ast.AsyncFunctionDef):
+            continue
+        info.methods[item.name] = item
+        mutated: Set[str] = set()
+        for sub in ast.walk(item):
+            if isinstance(sub, ast.Call):
+                fn = sub.func
+                # self.add_state("name", ...)
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr == "add_state"
+                    and isinstance(fn.value, ast.Name)
+                    and fn.value.id == "self"
+                ):
+                    name_arg = sub.args[0] if sub.args else next(
+                        (kw.value for kw in sub.keywords if kw.arg == "name"), None
+                    )
+                    if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
+                        info.own_states.add(name_arg.value)
+                    else:
+                        info.dynamic_add_state = True
+                # setattr(self, <dynamic>, ...)
+                if isinstance(fn, ast.Name) and fn.id == "setattr" and sub.args:
+                    tgt = sub.args[0]
+                    if isinstance(tgt, ast.Name) and tgt.id == "self":
+                        name_arg = sub.args[1] if len(sub.args) > 1 else None
+                        if not (isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str)):
+                            info.dynamic_setattr_methods.add(item.name)
+                        else:
+                            mutated.add(name_arg.value)
+                # self.<attr>.append(...) etc.
+                if isinstance(fn, ast.Attribute) and fn.attr in MUTATOR_METHODS:
+                    if (
+                        isinstance(fn.value, ast.Attribute)
+                        and isinstance(fn.value.value, ast.Name)
+                        and fn.value.value.id == "self"
+                    ):
+                        mutated.add(fn.value.attr)
+                    elif (
+                        # getattr(self, <dynamic>).append(...): the receiver
+                        # cannot be named statically, so R1 certification must
+                        # treat the whole method as dynamically mutating
+                        isinstance(fn.value, ast.Call)
+                        and isinstance(fn.value.func, ast.Name)
+                        and fn.value.func.id == "getattr"
+                        and fn.value.args
+                        and isinstance(fn.value.args[0], ast.Name)
+                        and fn.value.args[0].id == "self"
+                    ):
+                        name_arg = fn.value.args[1] if len(fn.value.args) > 1 else None
+                        if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
+                            mutated.add(name_arg.value)
+                        else:
+                            info.dynamic_setattr_methods.add(item.name)
+            targets: Iterable[ast.expr] = ()
+            if isinstance(sub, ast.Assign):
+                targets = sub.targets
+            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+                targets = (sub.target,)
+            for tgt in targets:
+                for leaf in _assign_leaves(tgt):
+                    if isinstance(leaf, ast.Attribute) and isinstance(leaf.value, ast.Name) and leaf.value.id == "self":
+                        mutated.add(leaf.attr)
+                        if leaf.attr == "validate_args":
+                            info.sets_validate_args = True
+                    elif (
+                        isinstance(leaf, ast.Subscript)
+                        and isinstance(leaf.value, ast.Attribute)
+                        and isinstance(leaf.value.value, ast.Name)
+                        and leaf.value.value.id == "self"
+                    ):
+                        mutated.add(leaf.value.attr)
+        if mutated:
+            info.mutated_attrs[item.name] = mutated
+    info.declares_traced_flags = "_traced_value_flags" in info.methods
+    return info
+
+
+class Registry:
+    """Index of every scanned module, with chain-resolution helpers."""
+
+    def __init__(self) -> None:
+        self.modules: Dict[str, ModuleInfo] = {}
+        # class qualname -> ClassInfo for direct lookup
+        self.classes: Dict[str, ClassInfo] = {}
+
+    def add_module(self, module: str, path: str, tree: ast.Module, source: SourceInfo) -> ModuleInfo:
+        mod = ModuleInfo(module=module, path=path, tree=tree, source=source)
+        _record_imports(tree, module, mod.imports)
+        for node in tree.body:
+            if isinstance(node, ast.ClassDef):
+                info = _scan_class(node, module, path)
+                mod.classes[node.name] = info
+                self.classes[info.qualname] = info
+            elif isinstance(node, ast.FunctionDef):
+                mod.functions[node.name] = node
+        self.modules[module] = mod
+        return mod
+
+    # ------------------------------------------------------------ resolution
+    def resolve_base(self, owner: ClassInfo, base_name: str) -> Optional[ClassInfo]:
+        """Resolve a base written as ``base_name`` inside ``owner``'s module."""
+        mod = self.modules.get(owner.module)
+        if mod is None:
+            return None
+        head, _, rest = base_name.partition(".")
+        # same-module class
+        if not rest and head in mod.classes:
+            return mod.classes[head]
+        origin = mod.imports.get(head)
+        if origin is None:
+            return None
+        dotted = f"{origin}.{rest}" if rest else origin
+        # `from x import Cls` -> dotted is already module.Cls;
+        # `import x.y as z; z.Cls` -> origin is module, rest the class
+        if dotted in self.classes:
+            return self.classes[dotted]
+        # `from torchmetrics_tpu import Metric` style re-export
+        if dotted in METRIC_QUALNAMES:
+            return None
+        # try interpreting the last segment as a class re-exported via __init__
+        cls_name = dotted.rsplit(".", 1)[-1]
+        for qual, info in self.classes.items():
+            if info.name == cls_name and qual.endswith(f".{cls_name}"):
+                # unique name match only — ambiguity means unresolved
+                matches = [i for i in self.classes.values() if i.name == cls_name]
+                if len(matches) == 1:
+                    return matches[0]
+                return None
+        return None
+
+    def base_is_metric(self, owner: ClassInfo, base_name: str) -> bool:
+        mod = self.modules.get(owner.module)
+        if base_name == "Metric":
+            return True
+        if mod is not None:
+            origin = mod.imports.get(base_name.partition(".")[0])
+            if origin in METRIC_QUALNAMES:
+                return True
+            dotted = origin or base_name
+            if dotted in METRIC_QUALNAMES or base_name in METRIC_QUALNAMES:
+                return True
+        return False
+
+    def chain(self, cls: ClassInfo) -> Tuple[List[ClassInfo], bool, bool]:
+        """Static ancestor chain of ``cls`` inside the scanned tree.
+
+        Returns ``(chain, reaches_metric, fully_resolved)`` where ``chain``
+        includes ``cls`` itself and every resolvable ancestor (depth-first,
+        de-duplicated), ``reaches_metric`` is True when some branch bottoms
+        out at the trusted ``Metric`` base, and ``fully_resolved`` is False
+        when any base could not be resolved to a scanned class or Metric.
+        """
+        out: List[ClassInfo] = []
+        seen: Set[str] = set()
+        reaches_metric = False
+        fully_resolved = True
+        stack = [cls]
+        while stack:
+            cur = stack.pop()
+            if cur.qualname in seen:
+                continue
+            seen.add(cur.qualname)
+            out.append(cur)
+            for base in cur.base_names:
+                if self.base_is_metric(cur, base):
+                    reaches_metric = True
+                    continue
+                if base in ("ABC", "abc.ABC", "object", "Generic", "Protocol"):
+                    continue
+                resolved = self.resolve_base(cur, base)
+                if resolved is None:
+                    fully_resolved = False
+                else:
+                    stack.append(resolved)
+        return out, reaches_metric, fully_resolved
+
+    def is_metric_subclass(self, cls: ClassInfo) -> bool:
+        _, reaches, _ = self.chain(cls)
+        return reaches
+
+    def registered_states(self, cls: ClassInfo) -> Tuple[Set[str], bool]:
+        """All literal ``add_state`` names along the chain, plus a flag that
+        is True when any chain class registers states dynamically (in which
+        case R1 cannot be decided soundly and the class is not certified)."""
+        chain, _, fully_resolved = self.chain(cls)
+        states: Set[str] = set()
+        dynamic = not fully_resolved
+        for c in chain:
+            states |= c.own_states
+            dynamic = dynamic or c.dynamic_add_state
+        return states, dynamic
+
+    def declares_traced_flags(self, cls: ClassInfo) -> bool:
+        chain, _, _ = self.chain(cls)
+        return any(c.declares_traced_flags for c in chain)
+
+
+def _assign_leaves(tgt: ast.expr) -> Iterable[ast.expr]:
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _assign_leaves(elt)
+    elif isinstance(tgt, ast.Starred):
+        yield from _assign_leaves(tgt.value)
+    else:
+        yield tgt

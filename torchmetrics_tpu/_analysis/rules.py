@@ -1,0 +1,88 @@
+"""Rule catalog for the trace-safety static analyzer.
+
+Each rule encodes one XLA-semantics hazard class specific to this codebase
+(see ``ANALYSIS.md`` for the full catalog with examples and baselining
+instructions). Rules are identified by stable short IDs (``R1``..``R5``)
+that appear in violations, baseline entries, and inline suppressions.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict
+
+
+@dataclass(frozen=True)
+class Rule:
+    """One entry of the rule catalog."""
+
+    id: str
+    name: str
+    summary: str
+    rationale: str
+
+
+RULES: Dict[str, Rule] = {
+    r.id: r
+    for r in (
+        Rule(
+            id="R1",
+            name="unregistered-state-mutation",
+            summary="`self.<attr>` mutated inside `update`/`compute` without `add_state` registration",
+            rationale=(
+                "Auto-compile replays `update()` as a traced XLA executable that only threads"
+                " registered states; a mutation of a plain attribute would be silently frozen."
+                " This is the static twin of the runtime `_host_attr_snapshot` fingerprint guard"
+                " (`metric.py`), and classes proven clean here skip that guard entirely."
+            ),
+        ),
+        Rule(
+            id="R2",
+            name="host-sync-leak",
+            summary="`float()`/`int()`/`bool()`/`.item()`/`np.*` applied to traced values in a traced path",
+            rationale=(
+                "Converting a device value to a python scalar (or routing it through numpy) forces"
+                " a blocking host round-trip per call in eager mode and a trace-time"
+                " `ConcretizationTypeError` (or silently baked constant) under `jit`."
+            ),
+        ),
+        Rule(
+            id="R3",
+            name="traced-control-flow",
+            summary="python `if`/`while`/`assert` branching on a traced value",
+            rationale=(
+                "`if preds > 0:` needs a concrete boolean, so it host-syncs eagerly and fails"
+                " under trace. Data-dependent branches must be expressed with `jnp.where`/"
+                "`lax.cond` so they stay on device."
+            ),
+        ),
+        Rule(
+            id="R4",
+            name="recompile-hazard",
+            summary="value-dependent output shapes (`jnp.unique`, `jnp.nonzero`, boolean-mask indexing) in traced paths",
+            rationale=(
+                "Ops whose output shape depends on data values cannot be lowered to a fixed XLA"
+                " program: every new value pattern forces a recompile (or an outright trace"
+                " failure). They are only allowed inside whitelisted eager helpers"
+                " (`# lint: eager-helper`) that run on host by design."
+            ),
+        ),
+        Rule(
+            id="R5",
+            name="missing-traced-validator",
+            summary="class sets `self.validate_args` but declares no `_traced_value_flags` vector",
+            rationale=(
+                "Metrics constructed with `validate_args=True` only auto-compile when they provide"
+                " a traced validator (`Metric._supports_traced_validation`); without one the"
+                " per-batch host checks permanently pin the metric to the eager path. Every class"
+                " carrying `validate_args` must declare (or inherit) its flag vector."
+            ),
+        ),
+    )
+}
+
+
+def rule(rule_id: str) -> Rule:
+    if rule_id not in RULES:
+        raise KeyError(f"Unknown rule id {rule_id!r}; known: {sorted(RULES)}")
+    return RULES[rule_id]

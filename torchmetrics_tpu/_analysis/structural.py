@@ -1,0 +1,148 @@
+"""Structural rules: R1 (unregistered-state mutation) and R5 (validator flags).
+
+Both rules reason about class structure (registered states, inherited
+declarations) rather than value flow, so they live on top of the
+``Registry``'s static chain resolution instead of the taint tracker.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Set
+
+from torchmetrics_tpu._analysis.model import SourceInfo, Violation
+from torchmetrics_tpu._analysis.registry import MUTATOR_METHODS, ClassInfo, Registry
+
+# methods whose bodies replay under trace and are fingerprint-guarded
+TRACED_METHODS = ("update", "compute")
+
+
+def check_r1(cls: ClassInfo, registry: Registry, source: SourceInfo) -> List[Violation]:
+    """Flag ``self.<attr>`` mutation in ``update``/``compute`` for attrs never
+    registered via ``add_state`` (underscore attrs are metric machinery and
+    exempt, mirroring the runtime guard)."""
+    out: List[Violation] = []
+    states, dynamic = registry.registered_states(cls)
+
+    for method_name in TRACED_METHODS:
+        func = cls.methods.get(method_name)
+        if func is None:
+            continue
+        scope = f"{cls.name}.{method_name}"
+        for node in ast.walk(func):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Name) and fn.id == "setattr" and node.args:
+                    tgt, name_arg = node.args[0], node.args[1] if len(node.args) > 1 else None
+                    if isinstance(tgt, ast.Name) and tgt.id == "self":
+                        if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
+                            _flag_attr(out, cls, source, scope, node.lineno, name_arg.value, states, dynamic)
+                        else:
+                            v = source.violation(
+                                "R1", node.lineno, scope,
+                                "dynamic `setattr(self, ...)` in a traced method cannot be proven state-safe",
+                            )
+                            if v:
+                                out.append(v)
+                if (
+                    isinstance(fn, ast.Attribute)
+                    and fn.attr in MUTATOR_METHODS
+                    and isinstance(fn.value, ast.Attribute)
+                    and isinstance(fn.value.value, ast.Name)
+                    and fn.value.value.id == "self"
+                ):
+                    _flag_attr(out, cls, source, scope, node.lineno, fn.value.attr, states, dynamic,
+                               verb=f"`.{fn.attr}()` on")
+                continue
+            targets: List[ast.expr] = []
+            if isinstance(node, ast.Assign):
+                targets = list(node.targets)
+            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+                targets = [node.target]
+            for tgt in targets:
+                for leaf in _leaves(tgt):
+                    if isinstance(leaf, ast.Attribute) and isinstance(leaf.value, ast.Name) and leaf.value.id == "self":
+                        _flag_attr(out, cls, source, scope, leaf.lineno, leaf.attr, states, dynamic)
+                    elif (
+                        isinstance(leaf, ast.Subscript)
+                        and isinstance(leaf.value, ast.Attribute)
+                        and isinstance(leaf.value.value, ast.Name)
+                        and leaf.value.value.id == "self"
+                    ):
+                        _flag_attr(out, cls, source, scope, leaf.lineno, leaf.value.attr, states, dynamic,
+                                   verb="item-assignment into")
+    return out
+
+
+def _flag_attr(
+    out: List[Violation],
+    cls: ClassInfo,
+    source: SourceInfo,
+    scope: str,
+    lineno: int,
+    attr: str,
+    states: Set[str],
+    dynamic_states: bool,
+    verb: str = "assignment to",
+) -> None:
+    if attr.startswith("_") or attr in states:
+        return
+    if dynamic_states:
+        # some chain class registers states dynamically; R1 would be guesswork
+        return
+    v = source.violation(
+        "R1", lineno, scope,
+        f"{verb} `self.{attr}` which is not registered via `add_state` — a traced replay would freeze this mutation",
+    )
+    if v:
+        out.append(v)
+
+
+def check_r5(cls: ClassInfo, registry: Registry, source: SourceInfo) -> List[Violation]:
+    """Classes that set ``self.validate_args`` must declare (or inherit) the
+    traced-validator flag vector ``_traced_value_flags``."""
+    if not cls.sets_validate_args:
+        return []
+    if not registry.is_metric_subclass(cls):
+        return []
+    if registry.declares_traced_flags(cls):
+        return []
+    v = source.violation(
+        "R5", cls.lineno, cls.name,
+        f"`{cls.name}` carries `validate_args` but neither it nor its bases declare `_traced_value_flags`;"
+        " with `validate_args=True` this metric is permanently pinned to the eager path",
+    )
+    return [v] if v else []
+
+
+def r1_certifiable(cls: ClassInfo, registry: Registry) -> bool:
+    """True when the whole static chain is provably free of unregistered-
+    attribute mutation in ANY method (not just update/compute — helpers
+    called from a traced update mutate just the same), making it safe for the
+    runtime to skip the `_host_attr_snapshot` fingerprint for this class."""
+    chain, reaches_metric, fully_resolved = registry.chain(cls)
+    if not (reaches_metric and fully_resolved):
+        return False
+    states, dynamic = registry.registered_states(cls)
+    if dynamic:
+        return False
+    for c in chain:
+        for method_name, mutated in c.mutated_attrs.items():
+            if method_name in ("__init__", "__new__", "__init_subclass__"):
+                continue
+            for attr in mutated:
+                if not attr.startswith("_") and attr not in states:
+                    return False
+        if any(m not in ("__init__",) for m in c.dynamic_setattr_methods):
+            return False
+    return True
+
+
+def _leaves(tgt: ast.expr):
+    if isinstance(tgt, (ast.Tuple, ast.List)):
+        for elt in tgt.elts:
+            yield from _leaves(elt)
+    elif isinstance(tgt, ast.Starred):
+        yield from _leaves(tgt.value)
+    else:
+        yield tgt

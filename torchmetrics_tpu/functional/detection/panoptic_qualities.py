@@ -179,7 +179,7 @@ def _pq_update_sample(
     return iou_std + iou_mod, tp + tp_mod, fp, fn
 
 
-def _panoptic_quality_update(
+def _panoptic_quality_update(  # lint: eager-helper — host color-coding feeds the jitted _pq_update_sample
     flatten_preds: Array,
     flatten_target: Array,
     cat_id_to_continuous_id: Dict[int, int],

@@ -6,8 +6,10 @@ from typing import Tuple, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torchmetrics_tpu.utilities.checks import _check_same_shape
+from torchmetrics_tpu.utilities.data import concrete_or_none
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
 Array = jax.Array
@@ -35,7 +37,14 @@ def _r2_score_compute(
     adjusted: int = 0,
     multioutput: str = "uniform_average",
 ) -> Array:
-    if (jnp.asarray(total) < 2).any():
+    # value-dependent validation and the adjusted-score warnings only run on
+    # host values: under trace (auto-forward's fused compute) they have no
+    # concrete value to inspect, and the adjusted correction below switches
+    # to its branchless jnp.where form instead. The host branch must stay in
+    # numpy — inside an active trace every jnp op returns a tracer even on
+    # concrete operands (omnistaging), and `total` can be a static int there.
+    total_static = concrete_or_none(total)
+    if total_static is not None and bool(np.any(np.asarray(total_static) < 2)):
         raise ValueError("Needs at least two samples to calculate r2 score.")
     mean_obs = sum_obs / total
     tss = sum_squared_obs - sum_obs * mean_obs
@@ -68,16 +77,25 @@ def _r2_score_compute(
     if not isinstance(adjusted, int) or adjusted < 0:
         raise ValueError("`adjusted` parameter should be an integer larger or equal to 0.")
     if adjusted != 0:
-        total = int(jnp.asarray(total)) if not isinstance(total, int) else total
-        if adjusted > total - 1:
-            rank_zero_warn(
-                "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
-                UserWarning,
-            )
-        elif adjusted == total - 1:
-            rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
-        else:
-            return 1 - (1 - r2) * (total - 1) / (total - adjusted - 1)
+        if total_static is not None:
+            total_i = int(np.asarray(total_static)) if not isinstance(total_static, int) else total_static
+            if adjusted > total_i - 1:
+                rank_zero_warn(
+                    "More independent regressions than data points in adjusted r2 score. Falls back to standard r2 score.",
+                    UserWarning,
+                )
+            elif adjusted == total_i - 1:
+                rank_zero_warn("Division by zero in adjusted r2 score. Falls back to standard r2 score.", UserWarning)
+            else:
+                return 1 - (1 - r2) * (total_i - 1) / (total_i - adjusted - 1)
+            return r2
+        # traced: branchless adjusted correction — the degenerate cases
+        # (adjusted >= n-1) fall back to the unadjusted score exactly like
+        # the eager path, minus the host-side warnings (cannot fire on device)
+        totals = jnp.asarray(total)
+        denom = totals - adjusted - 1
+        adj = 1 - (1 - r2) * (totals - 1) / jnp.where(denom > 0, denom, 1)
+        return jnp.where(denom > 0, adj, r2)
     return r2
 
 

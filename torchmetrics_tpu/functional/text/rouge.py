@@ -330,7 +330,7 @@ def _rouge_score_compute(sentence_results: Dict[str, Any]) -> Dict[str, Array]:
     output: Dict[str, Array] = {}
     for rouge_key, scores in sentence_results.items():
         if isinstance(scores, list) and len(scores) > 0:
-            output[rouge_key] = jnp.asarray(float(np.mean([float(v) for v in scores])))
+            output[rouge_key] = jnp.asarray(float(np.mean([float(v) for v in scores])))  # lint-ok: R2 host aggregation of per-sentence scores; ROUGE compute is eager by design
         elif isinstance(scores, list):
             output[rouge_key] = jnp.asarray(0.0)
         else:

@@ -395,8 +395,11 @@ class Metric(ABC):
             self._check_pending_violations()
             self._computed = None
             self._update_count += 1
-            # only pay the fingerprint where a compiled path could engage
-            guard = self._auto_eligible()
+            # only pay the fingerprint where a compiled path could engage AND
+            # the static analyzer hasn't already proven the whole class chain
+            # free of unregistered-attribute mutation (R1 certification —
+            # see torchmetrics_tpu/_analysis and ANALYSIS.md)
+            guard = self._auto_eligible() and not self._fingerprint_exempt()
             if guard:
                 # the keep-alive list pins every fingerprinted object for the
                 # duration of the update, so a freed-and-reallocated object
@@ -417,6 +420,21 @@ class Metric(ABC):
 
         wrapped_func.__wrapped_by_metric__ = True  # type: ignore[attr-defined]
         return wrapped_func
+
+    def _fingerprint_exempt(self) -> bool:
+        """True when the R1-certified manifest covers this instance's class.
+
+        The trace-safety analyzer (``tools/lint_metrics.py --write-manifest``)
+        records every class whose static MRO provably never mutates an
+        unregistered attribute; for those the per-``update()``
+        ``_host_attr_snapshot`` fingerprint is redundant work. Any class the
+        analyzer has not seen (user subclasses included) keeps the guard.
+        """
+        from torchmetrics_tpu._analysis.manifest import fingerprint_skip_allowed
+
+        # per-class memoization lives in the manifest module, so the runtime
+        # toggle (set_fingerprint_skip_enabled) invalidates in one place
+        return fingerprint_skip_allowed(type(self))
 
     def _host_attr_snapshot(self) -> Tuple[List[tuple], List[Any]]:
         """Fingerprint of plain (non-state, non-private) host attributes.

@@ -66,18 +66,32 @@ class RetrievalMetric(Metric):
         if not (preds.shape == target.shape == indexes.shape):
             raise ValueError("`indexes`, `preds` and `target` must be of the same shape")
         if self.ignore_index is not None:
-            keep = jnp.nonzero(target != self.ignore_index)[0]
-            preds, target, indexes = preds[keep], target[keep], indexes[keep]
+            preds, target, indexes = self._drop_ignored(preds, target, indexes)
         self.indexes.append(indexes)
         self.preds.append(preds)
         self.target.append(target)
+
+    def _drop_ignored(self, preds: Array, target: Array, indexes: Array):  # lint: eager-helper
+        """Filter out ``ignore_index`` rows before the host-side append.
+
+        Value-dependent output shape (``jnp.nonzero``): retrieval metrics are
+        pinned to the eager path by their append-mode list states, so this
+        runs on host by design (R4 whitelist).
+        """
+        keep = jnp.nonzero(target != self.ignore_index)[0]
+        return preds[keep], target[keep], indexes[keep]
 
     # queries are "empty" when they have no positive target; FallOut inverts
     # this to "no negative target" (reference retrieval/fall_out.py semantics)
     _empty_query_has_no = "positives"
 
-    def _group_and_pad(self):
-        """Cat states → padded (num_q, max_len) preds/target/mask arrays."""
+    def _group_and_pad(self):  # lint: eager-helper
+        """Cat states → padded (num_q, max_len) preds/target/mask arrays.
+
+        Host-by-design (R4 whitelist): query grouping is inherently
+        shape-polymorphic, so it runs once per ``compute`` in numpy and hands
+        a statically-shaped padded batch to the single fused ``vmap`` kernel.
+        """
         indexes = np.asarray(dim_zero_cat(self.indexes))
         preds = dim_zero_cat(self.preds)
         target = dim_zero_cat(self.target)
@@ -109,7 +123,8 @@ class RetrievalMetric(Metric):
             return jnp.asarray(((pad_target == 0) & pad_mask).any(axis=1))
         return jnp.asarray((pad_target > 0).any(axis=1))
 
-    def _apply_empty_target_action(self, res: Array, non_empty: Array) -> Array:
+    def _apply_empty_target_action(self, res: Array, non_empty: Array) -> Array:  # lint: eager-helper
+        """Host-by-design (R4 whitelist): ``skip`` drops rows value-dependently."""
         if self.empty_target_action == "error" and bool(jnp.any(~non_empty)):
             raise ValueError("`compute` method was provided with a query without positive target.")
         if self.empty_target_action == "pos":

@@ -111,7 +111,7 @@ class BERTScore(Metric):
         self.target_input_ids.append(jnp.asarray(np.asarray(tgt_enc["input_ids"])))
         self.target_attention_mask.append(jnp.asarray(np.asarray(tgt_enc["attention_mask"])))
 
-    def compute(self) -> Dict[str, Union[Array, List[float], str]]:
+    def compute(self) -> Dict[str, Union[Array, List[float], str]]:  # lint: eager-helper — host transformer scoring
         return bert_score(
             preds={
                 "input_ids": np.asarray(dim_zero_cat(self.preds_input_ids)),

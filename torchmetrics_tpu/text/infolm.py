@@ -117,7 +117,7 @@ class InfoLM(Metric):
         self.target_input_ids.append(jnp.asarray(np.asarray(tgt_enc["input_ids"])))
         self.target_attention_mask.append(jnp.asarray(np.asarray(tgt_enc["attention_mask"])))
 
-    def compute(self) -> Union[Array, Tuple[Array, Array]]:
+    def compute(self) -> Union[Array, Tuple[Array, Array]]:  # lint: eager-helper — host transformer scoring
         return _infolm_fn(
             {
                 "input_ids": np.asarray(dim_zero_cat(self.preds_input_ids)),

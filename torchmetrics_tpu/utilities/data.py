@@ -132,6 +132,25 @@ def _cumsum(x: Array, axis: Optional[int] = None, dtype=None) -> Array:
     return jnp.cumsum(x, axis=axis, dtype=dtype)
 
 
+def concrete_or_none(x):
+    """``x`` when it is a host value or concrete array, ``None`` under trace.
+
+    The bridge between value-dependent host logic (validation raises,
+    warnings, degenerate-case warnings) and traced execution: callers run
+    the host-only branch when this returns non-None and a branchless
+    ``jnp.where`` formulation otherwise. The trace-safety analyzer treats
+    this as a sanitizer — branching on the result never host-syncs a tracer
+    (rules R2/R3 in ANALYSIS.md).
+
+    NOTE: callers must keep any math on the returned value in numpy/python —
+    inside an active trace every jnp op returns a tracer even on concrete
+    operands (omnistaging).
+    """
+    from torchmetrics_tpu.utilities.checks import _is_concrete
+
+    return x if _is_concrete(x) else None
+
+
 def allclose(a: Array, b: Array, rtol: float = 1e-5, atol: float = 1e-8) -> bool:
     """Shape-then-value closeness used by compute-group detection."""
     if a.shape != b.shape:
