@@ -29,6 +29,35 @@ NUM_CLASSES = 5
 WARMUP = 5
 ITERS = 500  # large enough that the one calibrated RTT subtraction is noise-free
 
+_DEGRADED = os.environ.get("TM_TPU_BENCH_DEGRADED", "") == "1"
+
+
+def _ensure_backend() -> None:
+    """Degrade to the CPU backend instead of crashing when the TPU is down.
+
+    BENCH_r05 aborted with rc=1 because the TPU backend failed to initialize;
+    a bench run with honest `"degraded": true` numbers beats no artifact at
+    all. The fallback re-execs this process with ``JAX_PLATFORMS=cpu`` (jax
+    caches a failed backend init, so an in-process config flip is too late).
+    """
+    if _DEGRADED:  # the re-exec below carries the flag via TM_TPU_BENCH_DEGRADED
+        return
+    try:
+        import jax
+
+        jax.devices()
+        return
+    except Exception as err:
+        if os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+            raise  # already on the fallback backend: nothing left to degrade to
+        sys.stderr.write(
+            f"accelerator backend failed to initialize ({type(err).__name__}: {err});"
+            " restarting on JAX_PLATFORMS=cpu with degraded=true\n"
+        )
+        sys.stderr.flush()
+        env = dict(os.environ, JAX_PLATFORMS="cpu", TM_TPU_BENCH_DEGRADED="1")
+        os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+
 
 
 _RTT_CACHE = [None]
@@ -1093,6 +1122,80 @@ def _bench_fingerprint_skip() -> tuple:
     return with_skip, without_skip
 
 
+# --------------------------------------------------------------------- #
+# resilience: guarded-sync happy-path overhead                           #
+# (torchmetrics_tpu/_resilience — RESILIENCE.md)                         #
+# --------------------------------------------------------------------- #
+
+RESIL_SYNC_REPS = 40
+RESIL_DCN_RTT_S = 0.0  # set >0 to model DCN latency; 0 is the harshest (free-transport) measurement
+
+
+def _bench_resilience_guard() -> tuple:
+    """(guarded syncs/sec, unguarded syncs/sec) on a simulated 2-process world.
+
+    One cycle = ``sync()`` + ``unsync()`` of a MulticlassConfusionMatrix
+    ((128, 128) int32 state — a representative production payload). The
+    guarded side runs the default ``SyncPolicy``: structure handshake (one
+    extra collective on the first sync, then cached) plus retry/backoff/
+    degradation machinery armed on every attempt (the opt-in watchdog
+    timeout adds a cross-thread dispatch; see RESILIENCE.md for its cost
+    profile). The simulated transport is in-process and essentially free —
+    the harshest possible denominator: against a real DCN collective
+    (milliseconds per gather) the guard's ~6µs happy-path cost disappears
+    entirely. ``RESIL_DCN_RTT_S`` can add a per-collective sleep to model
+    network latency; both sides pay it identically.
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from torchmetrics_tpu._resilience import SyncPolicy
+    from torchmetrics_tpu._resilience.faultinject import simulated_world
+    from torchmetrics_tpu.classification import MulticlassConfusionMatrix
+
+    num_classes = 128
+    preds = jax.random.randint(jax.random.PRNGKey(0), (BATCH,), 0, num_classes)
+    target = jax.random.randint(jax.random.PRNGKey(1), (BATCH,), 0, num_classes)
+
+    def dcn_transport(x):
+        if RESIL_DCN_RTT_S:
+            time.sleep(RESIL_DCN_RTT_S)
+        return jax.tree_util.tree_map(lambda v: np.stack([np.asarray(v)] * 2), x)
+
+    with simulated_world(2, transport=dcn_transport):
+        m_guarded = MulticlassConfusionMatrix(num_classes=num_classes, validate_args=False)
+        m_guarded.set_resilience_policy(sync_policy=SyncPolicy())
+        m_plain = MulticlassConfusionMatrix(num_classes=num_classes, validate_args=False)
+        m_guarded.update(preds, target)
+        m_plain.update(preds, target)
+
+        def cycle(m) -> float:
+            t0 = time.perf_counter()
+            m.sync()
+            m.unsync()
+            return time.perf_counter() - t0
+
+        for _ in range(10):  # warm both paths (jit caches, handshake, guard state)
+            cycle(m_guarded)
+            cycle(m_plain)
+        # paired interleaved design: the guard's happy-path cost is µs-scale
+        # against a ms-scale sync, far below this host's run-to-run
+        # throughput swings — alternating single cycles exposes both sides
+        # to the same scheduler weather, and medians drop the stall outliers
+        g_times, p_times = [], []
+        for _ in range(RESIL_SYNC_REPS * 8):
+            g_times.append(cycle(m_guarded))
+            p_times.append(cycle(m_plain))
+        # per-pair ratios share their scheduler weather (the cycles are
+        # adjacent in time), so their median is robust to drift across the
+        # run; the plain-side median anchors the absolute rate
+        ratios = sorted(p / g for g, p in zip(g_times, p_times))
+        pair_ratio = ratios[len(ratios) // 2]
+        p_med = sorted(p_times)[len(p_times) // 2]
+    return pair_ratio / p_med, 1.0 / p_med
+
+
 def _emit(line: dict) -> None:
     """Print one bench line and record it for the final summary line.
 
@@ -1101,7 +1204,13 @@ def _emit(line: dict) -> None:
     ``main`` therefore ends with a standard-shaped line whose extra ``all``
     field carries every ``metric -> [value, vs_baseline]`` compactly — the
     full result set always survives in the recorded tail.
+
+    When the run fell back to the CPU backend (see :func:`_ensure_backend`)
+    every line carries ``"degraded": true`` so downstream consumers never
+    mistake fallback numbers for on-chip ones.
     """
+    if _DEGRADED:
+        line = dict(line, degraded=True)
     _RESULTS.append(line)
     print(json.dumps(line))
 
@@ -1120,6 +1229,7 @@ def _emit_summary() -> None:
 
 
 def main() -> None:
+    _ensure_backend()
     ours = _bench_ours()
     base = _bench_torch_cpu_baseline()
     _emit((
@@ -1320,6 +1430,25 @@ def main() -> None:
             )
         )
 
+    guarded_rate, unguarded_rate = _bench_resilience_guard()
+    _emit((
+            {
+                "metric": "resilience_guarded_sync_overhead_per_sec",
+                "value": round(guarded_rate, 1),
+                "unit": (
+                    "guarded sync+unsync cycles/sec (simulated 2-process world, free in-process"
+                    " transport — the harshest denominator: real DCN collectives cost ms and"
+                    " dwarf the guard's ~6us/sync cost; MulticlassConfusionMatrix 128x128 state;"
+                    " default SyncPolicy: handshake + retry/backoff/degradation armed;"
+                    " baseline = same cycles unguarded, paired-interleaved per-pair-ratio median"
+                    " — vs_baseline is the happy-path retention ratio, target >= 0.97 i.e."
+                    " <3% guard overhead)"
+                ),
+                "vs_baseline": round(guarded_rate / unguarded_rate, 3),
+            }
+        )
+    )
+
     fp_skip_rate, fp_guard_rate = _bench_fingerprint_skip()
     _emit((
             {
@@ -1393,6 +1522,8 @@ _README_LABELS = {
     "rouge_samples_per_sec": ("ROUGE-1/2/L corpus scoring", "{v:,.0f} samples/s"),
     "cer_long_transcript_samples_per_sec": ("CER long transcripts", "{v:,.0f} samples/s"),
     "collection_sync_p50_latency": ("Collection mesh-sync p50", "{v:.2f} ms"),
+    "resilience_guarded_sync_overhead_per_sec": ("Guarded sync (resilience) happy path", "{v:,.0f} cycles/s"),
+    "eager_update_fingerprint_skip_per_sec": ("Certified fingerprint-skip eager `update()`", "{v:,.0f} updates/s"),
 }
 
 

@@ -1,0 +1,130 @@
+"""The fault-injection harness itself: determinism and seam restoration."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tests.helpers.testers import DummyMetric
+from torchmetrics_tpu._resilience.faultinject import (
+    corrupt_state_dict,
+    inject_collective_failure,
+    inject_collective_timeout,
+    nan_batches,
+    poison_nans,
+    simulated_world,
+)
+from torchmetrics_tpu.utilities import distributed as dist
+from torchmetrics_tpu.utilities.distributed import distributed_available, gather_all_tensors, world_size
+
+DummySum = DummyMetric.scalar_sum()
+
+
+class TestSimulatedWorld:
+    def test_flips_distributed_available(self):
+        assert not distributed_available()
+        with simulated_world(2):
+            assert distributed_available()
+            assert world_size() == 2
+        assert not distributed_available()
+
+    def test_gather_returns_world_copies(self):
+        with simulated_world(3):
+            out = gather_all_tensors(jnp.asarray([1.0, 2.0]))
+        assert len(out) == 3
+        for shard in out:
+            np.testing.assert_allclose(np.asarray(shard), [1.0, 2.0])
+
+    def test_seams_restored_on_exit(self):
+        before = (dist._world_override, dist._transport)
+        with simulated_world(2):
+            pass
+        assert (dist._world_override, dist._transport) == before
+
+    def test_custom_transport(self):
+        def doubler(x):
+            # perturb only floating payloads: the shape pre-gather (int32)
+            # must stay consistent or the uneven-gather path engages
+            arr = np.asarray(x)
+            scale = 2 if np.issubdtype(arr.dtype, np.floating) else 1
+            return np.stack([arr, arr * scale])
+
+        with simulated_world(2, transport=doubler):
+            out = gather_all_tensors(jnp.asarray([1.0]))
+        np.testing.assert_allclose(np.asarray(out[1]), [2.0])
+
+    def test_invalid_size(self):
+        with pytest.raises(ValueError, match="size must be >= 1"):
+            with simulated_world(0):
+                pass
+
+
+class TestInjectors:
+    def test_failure_counts_and_recovers(self):
+        with simulated_world(2):
+            with inject_collective_failure(first_n=2) as stats:
+                with pytest.raises(ConnectionError, match="injected collective failure"):
+                    gather_all_tensors(jnp.asarray([1.0]))
+                with pytest.raises(ConnectionError):
+                    gather_all_tensors(jnp.asarray([1.0]))
+                out = gather_all_tensors(jnp.asarray([1.0]))  # third call: healthy again
+            assert len(out) == 2
+            assert stats.injected == 2 and stats.calls >= 3
+
+    def test_custom_exception_factory(self):
+        with simulated_world(2):
+            with inject_collective_failure(first_n=1, exc_factory=lambda: OSError("dcn down")):
+                with pytest.raises(OSError, match="dcn down"):
+                    gather_all_tensors(jnp.asarray([1.0]))
+
+    def test_timeout_released_at_exit(self):
+        import time
+
+        with simulated_world(2):
+            start = time.perf_counter()
+            with inject_collective_timeout(first_n=1, hang=0.1) as stats:
+                with pytest.raises(TimeoutError, match="injected collective stall"):
+                    gather_all_tensors(jnp.asarray([1.0]))
+            assert stats.injected == 1
+            assert time.perf_counter() - start < 5.0
+
+
+class TestCorruption:
+    def test_corruption_is_deterministic(self):
+        m = DummySum()
+        m.persistent(True)
+        m.update(5.0)
+        sd = m.state_dict(integrity=True)
+        a = corrupt_state_dict(sd, mode="bitflip", seed=3)
+        b = corrupt_state_dict(sd, mode="bitflip", seed=3)
+        np.testing.assert_array_equal(np.asarray(a["x"]), np.asarray(b["x"]))
+        assert not np.array_equal(np.asarray(a["x"]), np.asarray(sd["x"]))
+
+    def test_original_untouched(self):
+        m = DummySum()
+        m.persistent(True)
+        m.update(5.0)
+        sd = m.state_dict()
+        corrupt_state_dict(sd, mode="nan")
+        assert float(sd["x"]) == 5.0
+
+    def test_nan_mode_requires_float(self):
+        with pytest.raises(ValueError, match="floating"):
+            corrupt_state_dict({"k": np.zeros(3, np.int32)}, key="k", mode="nan")
+
+    def test_unknown_mode(self):
+        with pytest.raises(ValueError, match="unknown corruption mode"):
+            corrupt_state_dict({"k": np.zeros(3)}, mode="scramble")
+
+    def test_poison_nans_deterministic(self):
+        a = poison_nans(jnp.ones(8), frac=0.5)
+        assert int(np.isnan(np.asarray(a)).sum()) == 4
+        with pytest.raises(ValueError, match="floating"):
+            poison_nans(jnp.ones(4, dtype=jnp.int32))
+
+    def test_nan_batches_restores_update(self):
+        m = DummySum()
+        orig = m.update
+        with nan_batches(m, indices=(0,)):
+            assert m.update is not orig
+        assert m.update is orig

@@ -1,0 +1,390 @@
+"""Guarded distributed sync: handshake, retry/backoff, watchdog, degradation.
+
+Every test runs on a simulated multi-process world (the fault-injection
+harness patches the transport seam in ``utilities/distributed.py``), so the
+production sync code path executes byte-identically to a real DCN fabric —
+including the deadlock-shaped failures, which here resolve in milliseconds
+instead of hanging CI.
+"""
+
+import time
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.helpers.testers import DummyMetric
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu._resilience import (
+    RetryPolicy,
+    StateStructureMismatchError,
+    SyncPolicy,
+    SyncRetriesExhausted,
+    set_default_sync_policy,
+)
+from torchmetrics_tpu._resilience.faultinject import (
+    inject_collective_failure,
+    inject_collective_timeout,
+    simulated_world,
+)
+from torchmetrics_tpu.classification import MulticlassAccuracy
+
+DummySum = DummyMetric.scalar_sum()
+
+# fast-failing policy for injection tests: 3 attempts, ~10ms of total backoff
+FAST = SyncPolicy(retry=RetryPolicy(max_retries=2, timeout=0.2, backoff_base=0.005, backoff_max=0.02))
+
+
+@pytest.fixture(autouse=True)
+def _no_warning_noise():
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        yield
+
+
+class TestHappyPath:
+    def test_guarded_sync_matches_unguarded(self):
+        with simulated_world(2):
+            guarded = DummySum(sync_policy=SyncPolicy())
+            plain = DummySum()
+            for m in (guarded, plain):
+                m.update(3.0)
+            # identical data on both simulated processes: sum state doubles
+            assert float(guarded.compute()) == float(plain.compute()) == 6.0
+
+    def test_no_exception_no_event_on_happy_path(self):
+        with simulated_world(2):
+            m = DummySum(sync_policy=SyncPolicy())
+            m.update(1.0)
+            float(m.compute())
+            report = m.resilience_report()
+            assert report.healthy and not report.events and report.degraded_syncs == 0
+
+    def test_single_process_sync_is_noop(self):
+        m = DummySum(sync_policy=SyncPolicy())
+        m.update(2.0)
+        assert float(m.compute()) == 2.0
+        assert m.resilience_report().healthy
+
+    def test_default_policy_process_wide(self):
+        set_default_sync_policy(SyncPolicy())
+        try:
+            with simulated_world(2):
+                m = DummySum()  # no per-metric policy: inherits the default
+                m.update(1.0)
+                assert float(m.compute()) == 2.0
+        finally:
+            set_default_sync_policy(None)
+
+    def test_explicit_none_opts_out_of_default_policy(self):
+        # `sync_policy=None` passed EXPLICITLY must mean "unguarded", not
+        # "inherit the process default" — on failure this metric raises
+        # instead of silently degrading
+        set_default_sync_policy(FAST)
+        try:
+            with simulated_world(2):
+                opted_out = DummySum(sync_policy=None)
+                opted_out.update(1.0)
+                with inject_collective_failure(first_n=99):
+                    with pytest.raises(ConnectionError):  # raw, undegraded
+                        opted_out.sync()
+                assert opted_out.resilience_report().healthy
+                # set_resilience_policy(None) opts out the same way
+                revoked = DummySum(sync_policy=FAST).set_resilience_policy(sync_policy=None)
+                revoked.update(1.0)
+                with inject_collective_failure(first_n=99):
+                    with pytest.raises(ConnectionError):
+                        revoked.sync()
+        finally:
+            set_default_sync_policy(None)
+
+    def test_stateful_metric_guarded_sync(self):
+        with simulated_world(2):
+            guarded = MulticlassAccuracy(num_classes=3, validate_args=False, sync_policy=SyncPolicy())
+            plain = MulticlassAccuracy(num_classes=3, validate_args=False)
+            for m in (guarded, plain):
+                m.update(jnp.asarray([0, 1, 2, 1]), jnp.asarray([0, 1, 1, 1]))
+            assert float(guarded.compute()) == pytest.approx(float(plain.compute()))
+
+
+class TestTimeoutRetryDegrade:
+    def test_injected_timeout_degrades_without_hang(self):
+        """The acceptance scenario: stall -> retry -> backoff -> degradation."""
+        with simulated_world(2):
+            m = DummySum(sync_policy=FAST)
+            m.update(3.0)
+            start = time.perf_counter()
+            with inject_collective_timeout(first_n=99, hang=30.0) as stats:
+                value = float(m.compute())  # compute auto-syncs; must NOT hang or raise
+            elapsed = time.perf_counter() - start
+            assert elapsed < 5.0, f"degradation took {elapsed:.1f}s — the watchdog did not abandon the stall"
+            assert value == 3.0  # local-only state: the simulated peers never contributed
+            report = m.resilience_report()
+            assert report.degraded_syncs == 1
+            event = report.events[0]
+            assert event.kind in ("sync_degraded", "handshake_degraded")
+            assert event.attempts == FAST.retry.attempts  # every retry was used
+            assert stats.injected >= FAST.retry.attempts  # one stalled transport per attempt
+
+    def test_gather_phase_timeout_degrades(self):
+        """Stall the data gather specifically (handshake already cached)."""
+        with simulated_world(2):
+            m = DummySum(sync_policy=FAST)
+            m.update(1.0)
+            m.sync()  # clean first sync caches the handshake digest
+            m.unsync()
+            with inject_collective_timeout(first_n=99, hang=30.0):
+                m.sync()  # degraded, not raised
+            assert not m._is_synced
+            assert float(m.x) == 1.0  # local state intact
+            assert m.resilience_report().events[0].kind == "sync_degraded"
+
+    def test_transient_failure_retries_to_success(self):
+        with simulated_world(2):
+            m = DummySum(sync_policy=FAST)
+            m.update(2.0)
+            with inject_collective_failure(first_n=1) as stats:
+                assert float(m.compute()) == 4.0  # retry succeeded: fully synced value
+            assert stats.injected == 1
+            assert stats.calls > 1  # the retry actually re-hit the transport
+            assert m.resilience_report().healthy  # recovered syncs record no event
+
+    def test_on_exhausted_raise_propagates(self):
+        policy = SyncPolicy(retry=FAST.retry, on_exhausted="raise")
+        with simulated_world(2):
+            m = DummySum(sync_policy=policy)
+            m.update(1.0)
+            with inject_collective_failure(first_n=99):
+                with pytest.raises(SyncRetriesExhausted) as err:
+                    m.sync()
+            assert err.value.attempts == policy.retry.attempts
+
+    def test_recovery_after_degradation(self):
+        """A degraded metric is not poisoned: the next sync can succeed."""
+        with simulated_world(2):
+            m = DummySum(sync_policy=FAST)
+            m.update(5.0)
+            with inject_collective_failure(first_n=99):
+                m.sync()
+            assert not m._is_synced and m.resilience_report().degraded_syncs == 1
+            m.sync()  # transport healthy again
+            assert m._is_synced
+            assert float(m.x) == 10.0
+            m.unsync()
+            assert float(m.x) == 5.0
+
+    def test_overridden_sync_dist_retry_does_not_double_reduce(self):
+        # a fused (subclass-overridden) _sync_dist that dies mid-commit must
+        # be rolled back before the retry, or remote contributions are
+        # double-counted by the second attempt's reduction
+        class FusedSync(DummySum):
+            def _sync_dist(self, dist_sync_fn, process_group=None):
+                super()._sync_dist(dist_sync_fn, process_group=process_group)
+
+        with simulated_world(2):
+            m = FusedSync(sync_policy=FAST)
+            m.update(3.0)
+            # fail the SECOND transport call of attempt 1: the shape gather
+            # succeeded, then the data gather dies — with DummySum's single
+            # state the override commits nothing, so also fail mid-multi-state
+            with inject_collective_failure(first_n=1):
+                m.sync()  # attempt 1 fails after handshake, retry succeeds
+            assert float(m.x) == 6.0  # exactly one world-sum, not re-reduced
+
+    def test_on_exhausted_raise_restores_local_state(self):
+        class FusedSync(DummySum):
+            def _sync_dist(self, dist_sync_fn, process_group=None):
+                super()._sync_dist(dist_sync_fn, process_group=process_group)
+
+        policy = SyncPolicy(retry=FAST.retry, on_exhausted="raise", handshake=False)
+        with simulated_world(2):
+            m = FusedSync(sync_policy=policy)
+            m.update(3.0)
+            with inject_collective_failure(first_n=99):
+                with pytest.raises(SyncRetriesExhausted):
+                    m.sync()
+            assert float(m.x) == 3.0  # local state intact, never half-committed
+            assert not m._is_synced and m._cache is None
+
+    def test_programming_errors_fail_fast_not_degraded(self):
+        # a buggy dist_sync_fn is a bug, not a DCN fault: retrying burns the
+        # backoff budget and degrading would hide it behind a warning with
+        # silently cross-host-divergent local results
+        with simulated_world(2):
+            m = DummySum(sync_policy=SyncPolicy(handshake=False, retry=FAST.retry))
+            m.update(1.0)
+            with pytest.raises(TypeError):
+                m.sync(dist_sync_fn=lambda only_one_arg: [only_one_arg])
+            assert float(m.x) == 1.0  # local state intact
+            assert not m.resilience_report().events  # no fake degradation
+
+    def test_backoff_schedule(self):
+        retry = RetryPolicy(max_retries=3, backoff_base=0.1, backoff_factor=2.0, backoff_max=0.25)
+        assert [retry.backoff(k) for k in range(3)] == [0.1, 0.2, 0.25]
+        assert retry.attempts == 4
+
+
+class TestHandshake:
+    @staticmethod
+    def _is_digest_payload(arr: np.ndarray) -> bool:
+        # the handshake digest travels as two uint32 words (uint64 would be
+        # truncated by jax transports with x64 disabled)
+        return arr.dtype == np.uint32 and arr.shape == (2,)
+
+    def test_structure_mismatch_fails_fast(self):
+        def mismatching(x):
+            arr = np.asarray(x)
+            stacked = np.stack([arr] * 2)
+            if self._is_digest_payload(arr):  # perturb only the handshake digest
+                stacked = stacked.copy()
+                stacked[1] ^= np.uint32(1)
+            return stacked
+
+        with simulated_world(2, transport=mismatching):
+            m = DummySum(sync_policy=SyncPolicy())
+            m.update(1.0)
+            with pytest.raises(StateStructureMismatchError, match="structure digests"):
+                m.sync()
+
+    def test_digest_survives_uint64_truncating_transport(self):
+        # the REAL transport routes through jax arrays, which truncate
+        # uint64 to uint32 with x64 disabled — the handshake must survive
+        # that round trip without spuriously mismatching
+        import jax.numpy as _jnp
+
+        def jaxlike(x):
+            return jax_tree_stack(x)
+
+        def jax_tree_stack(x):
+            import jax
+
+            return jax.tree_util.tree_map(
+                lambda v: np.stack([np.asarray(_jnp.asarray(v))] * 2), x
+            )
+
+        with simulated_world(2, transport=jaxlike):
+            m = DummySum(sync_policy=SyncPolicy())
+            m.update(2.0)
+            assert float(m.compute()) == 4.0  # handshake passed, sync ran
+            assert m.resilience_report().healthy
+
+    def test_handshake_digest_covers_structure(self):
+        from torchmetrics_tpu._resilience import state_structure_digest
+
+        a = MulticlassAccuracy(num_classes=3, validate_args=False)
+        b = MulticlassAccuracy(num_classes=3, validate_args=False)
+        c = MulticlassAccuracy(num_classes=5, validate_args=False)  # different state shapes
+        assert state_structure_digest(a)[0] == state_structure_digest(b)[0]
+        assert state_structure_digest(a)[0] != state_structure_digest(c)[0]
+
+    def test_handshake_cached_after_success(self):
+        with simulated_world(2):
+            m = DummySum(sync_policy=SyncPolicy())
+            m.update(1.0)
+            m.sync()
+            m.unsync()
+            with inject_collective_failure(first_n=0) as stats:
+                m.sync()
+                m.unsync()
+            assert stats.calls == 2  # shape + data gather only: no handshake re-gather
+
+    def test_cat_state_uneven_lengths_share_digest(self):
+        # per-process cat-state lengths legitimately differ: the digest must
+        # not depend on them, or healthy uneven streams would "mismatch"
+        from torchmetrics_tpu._resilience import state_structure_digest
+
+        DummyList = DummyMetric.list_cat()
+        a = DummyList()
+        b = DummyList()
+        b.update(jnp.asarray([1.0, 2.0, 3.0]))
+        assert state_structure_digest(a)[0] == state_structure_digest(b)[0]
+
+
+class TestDegradationErgonomics:
+    def test_degraded_sync_makes_paired_unsync_a_noop(self):
+        # the manual sync()/unsync() pattern must stay graceful under
+        # degradation — the feature promising "no exception mid-eval" must
+        # not inject one from the paired unsync
+        with simulated_world(2):
+            m = DummySum(sync_policy=FAST)
+            m.update(2.0)
+            with inject_collective_failure(first_n=99):
+                m.sync()  # degrades quietly
+            m.unsync()  # no-op, no raise
+            assert float(m.x) == 2.0
+            m.sync()  # healthy again: pairing still works normally
+            m.unsync()
+            assert float(m.x) == 2.0
+            # a genuinely unpaired unsync still raises
+            with pytest.raises(Exception, match="already been un-synced"):
+                m.unsync()
+
+    def test_event_log_is_capped(self):
+        from torchmetrics_tpu._resilience.policy import MAX_EVENTS
+
+        m = DummySum()
+        for i in range(MAX_EVENTS + 10):
+            m._record_degradation("sync_degraded", detail=f"outage {i}")
+        report = m.resilience_report()
+        assert len(report.events) == MAX_EVENTS
+        assert report.dropped_events == 10
+        assert report.events[-1].detail == f"outage {MAX_EVENTS + 9}"  # newest kept
+
+    def test_concurrent_guarded_syncs_do_not_share_timeout_budget(self):
+        # a stalled sync on one metric must not consume another metric's
+        # watchdog budget by queueing behind the same worker
+        import threading
+
+        with simulated_world(2):
+            slow = DummySum(sync_policy=SyncPolicy(handshake=False, retry=RetryPolicy(timeout=1.5, max_retries=0)))
+            fast = DummySum(sync_policy=SyncPolicy(handshake=False, retry=RetryPolicy(timeout=5.0, max_retries=0)))
+            slow.update(1.0)
+            fast.update(2.0)
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                with inject_collective_timeout(first_n=1, hang=10.0):
+                    t = threading.Thread(target=slow.sync, daemon=True)
+                    t.start()
+                    time.sleep(0.2)  # let the stalled attempt occupy a worker
+                    start = time.perf_counter()
+                    fast.sync()  # must get its own worker and finish promptly
+                    elapsed = time.perf_counter() - start
+                    t.join(timeout=15.0)
+            assert fast._is_synced and float(fast.x) == 4.0
+            assert elapsed < 3.0, f"concurrent sync waited {elapsed:.1f}s behind a stalled worker"
+            fast.unsync()
+            assert not slow._is_synced  # the stalled one degraded
+
+    def test_handshake_every_sync_regathers(self):
+        policy = SyncPolicy(handshake=True, handshake_every_sync=True, retry=FAST.retry)
+        with simulated_world(2):
+            m = DummySum(sync_policy=policy)
+            m.update(1.0)
+            m.sync()
+            m.unsync()
+            with inject_collective_failure(first_n=0) as stats:
+                m.sync()
+                m.unsync()
+            # handshake + shape gather + data gather: re-verified every sync
+            assert stats.calls == 3
+
+
+class TestCollectionFanOut:
+    def test_policy_fans_out_to_members(self):
+        mc = MetricCollection([MulticlassAccuracy(num_classes=3, validate_args=False)])
+        mc.set_resilience_policy(sync_policy=FAST, nan_policy="warn")
+        for m in mc.values():
+            assert m.sync_policy is FAST
+            assert m.nan_policy == "warn"
+
+    def test_collection_degrades_member_wise(self):
+        with simulated_world(2):
+            mc = MetricCollection([MulticlassAccuracy(num_classes=3, validate_args=False)])
+            mc.set_resilience_policy(sync_policy=FAST)
+            mc.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+            with inject_collective_failure(first_n=99):
+                out = mc.compute()  # degrades, still produces local values
+            assert set(out) == {"MulticlassAccuracy"}
+            reports = mc.resilience_report()
+            assert reports["MulticlassAccuracy"].degraded_syncs >= 1

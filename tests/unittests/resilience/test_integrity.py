@@ -1,0 +1,412 @@
+"""State integrity: checksummed checkpoints, repair mode, NaN sentinels."""
+
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tests.helpers.testers import DummyMetric
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu._resilience import INTEGRITY_VERSION, StateCorruptionError, integrity_key
+from torchmetrics_tpu._resilience.faultinject import corrupt_state_dict, nan_batches, poison_nans
+from torchmetrics_tpu.aggregation import MinMetric
+from torchmetrics_tpu.classification import MulticlassAccuracy
+from torchmetrics_tpu.regression import MeanSquaredError
+
+DummySum = DummyMetric.scalar_sum()
+DummyList = DummyMetric.list_cat()
+
+
+def _persistent_sum(value: float = 5.0):
+    m = DummySum()
+    m.persistent(True)
+    m.update(value)
+    return m
+
+
+class TestCheckpointIntegrity:
+    def test_round_trip_with_integrity(self):
+        m = _persistent_sum(5.0)
+        sd = m.state_dict(integrity=True)
+        assert integrity_key() in sd
+        assert sd[integrity_key()]["version"] == INTEGRITY_VERSION
+        fresh = DummySum()
+        fresh.persistent(True)
+        fresh.load_state_dict(sd)
+        assert float(fresh.x) == 5.0
+
+    def test_bitflip_corruption_rejected(self):
+        sd = _persistent_sum().state_dict(integrity=True)
+        bad = corrupt_state_dict(sd, mode="bitflip")
+        fresh = DummySum()
+        fresh.persistent(True)
+        with pytest.raises(StateCorruptionError, match="checksum mismatch") as err:
+            fresh.load_state_dict(bad)
+        assert "x" in err.value.corrupted
+
+    def test_nan_poisoned_checkpoint_rejected(self):
+        sd = _persistent_sum().state_dict(integrity=True)
+        bad = corrupt_state_dict(sd, mode="nan")
+        fresh = DummySum()
+        fresh.persistent(True)
+        with pytest.raises(StateCorruptionError, match="failed integrity verification"):
+            fresh.load_state_dict(bad)
+
+    def test_repair_resets_only_corrupted_states(self):
+        m = MulticlassAccuracy(num_classes=3, validate_args=False)
+        m.persistent(True)
+        m.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        sd = m.state_dict(integrity=True)
+        bad = corrupt_state_dict(sd, key="tp", mode="bitflip")
+        fresh = MulticlassAccuracy(num_classes=3, validate_args=False)
+        fresh.persistent(True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fresh.load_state_dict(bad, strict="repair")
+        np.testing.assert_array_equal(np.asarray(fresh.tp), np.zeros(3))  # repaired to default
+        np.testing.assert_array_equal(np.asarray(fresh.fp), np.asarray(sd["fp"]))  # others loaded
+        report = fresh.resilience_report()
+        assert [e.kind for e in report.events] == ["state_repair"]
+        assert "tp" in report.events[0].detail
+
+    def test_unknown_schema_version_rejected(self):
+        sd = _persistent_sum().state_dict(integrity=True)
+        sd[integrity_key()] = dict(sd[integrity_key()], version=INTEGRITY_VERSION + 1)
+        fresh = DummySum()
+        fresh.persistent(True)
+        with pytest.raises(StateCorruptionError, match="schema version"):
+            fresh.load_state_dict(sd)
+
+    def test_legacy_checkpoint_without_integrity_loads(self):
+        sd = _persistent_sum(7.0).state_dict()  # no integrity block
+        assert integrity_key() not in sd
+        fresh = DummySum()
+        fresh.persistent(True)
+        fresh.load_state_dict(sd)
+        assert float(fresh.x) == 7.0
+
+    def test_repair_screens_nan_in_legacy_checkpoint(self):
+        sd = _persistent_sum().state_dict()
+        sd["x"] = np.asarray(np.nan, dtype=np.float32)
+        fresh = DummySum()
+        fresh.persistent(True)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fresh.load_state_dict(sd, strict="repair")
+        assert float(fresh.x) == 0.0
+        assert fresh.resilience_report().events[0].kind == "state_repair"
+
+    def test_list_state_round_trip(self):
+        m = DummyList()
+        m.persistent(True)
+        m.update(jnp.asarray([1.0, 2.0]))
+        m.update(jnp.asarray([3.0]))
+        sd = m.state_dict(integrity=True)
+        fresh = DummyList()
+        fresh.persistent(True)
+        fresh.load_state_dict(sd)
+        np.testing.assert_allclose(np.asarray(fresh.compute()), [1.0, 2.0, 3.0])
+        bad = corrupt_state_dict(sd, mode="bitflip")
+        fresh2 = DummyList()
+        fresh2.persistent(True)
+        with pytest.raises(StateCorruptionError):
+            fresh2.load_state_dict(bad)
+
+    def test_inf_sentinel_defaults_not_flagged(self):
+        # MinMetric's +inf default must survive an integrity round trip: only
+        # NaN (and inf in finite-default states) counts as poisoning
+        m = MinMetric()
+        m.persistent(True)
+        sd = m.state_dict(integrity=True)
+        fresh = MinMetric()
+        fresh.persistent(True)
+        fresh.load_state_dict(sd)  # no error despite the inf payload
+        assert np.isinf(np.asarray(fresh.value)).all()
+
+    def test_collection_integrity_round_trip_and_repair(self):
+        mc = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=3, validate_args=False), "mse": MeanSquaredError()}
+        )
+        mc.persistent(True)
+        mc.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        sd = mc.state_dict(integrity=True)
+        assert integrity_key("acc.") in sd and integrity_key("mse.") in sd
+        bad = corrupt_state_dict(sd, key="mse.sum_squared_error", mode="bitflip")
+        fresh = MetricCollection(
+            {"acc": MulticlassAccuracy(num_classes=3, validate_args=False), "mse": MeanSquaredError()}
+        )
+        fresh.persistent(True)
+        with pytest.raises(StateCorruptionError):
+            fresh.load_state_dict(bad)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fresh.load_state_dict(bad, strict="repair")
+        # the corrupted member state repaired; the untouched member loaded
+        assert float(np.asarray(fresh["mse"].sum_squared_error).sum()) == 0.0
+        np.testing.assert_array_equal(np.asarray(fresh["acc"].tp), np.asarray(sd["acc.tp"]))
+
+
+class TestNanPolicy:
+    def test_raise_policy(self):
+        m = MeanSquaredError(nan_policy="raise")
+        m.update(jnp.ones(4), jnp.zeros(4))
+        with pytest.raises(RuntimeError, match="Non-finite values detected"):
+            m.update(poison_nans(jnp.ones(4)), jnp.zeros(4))
+
+    def test_warn_policy(self):
+        m = MeanSquaredError(nan_policy="warn")
+        with pytest.warns(UserWarning, match="Non-finite values detected"):
+            m.update(poison_nans(jnp.ones(4)), jnp.zeros(4))
+        assert bool(jnp.isnan(m.compute()))  # warn does not roll back
+
+    def test_quarantine_drops_only_bad_batches(self):
+        q = MeanSquaredError(nan_policy="quarantine")
+        clean = MeanSquaredError(auto_compile=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with nan_batches(q, indices=(1,)) as stats:
+                for _ in range(3):
+                    q.update(jnp.ones(8) * 2, jnp.zeros(8))
+        for _ in range(2):  # the two clean batches
+            clean.update(jnp.ones(8) * 2, jnp.zeros(8))
+        assert stats.injected == 1
+        assert q._update_count == 2  # the poisoned batch contributed nothing
+        assert float(q.compute()) == float(clean.compute()) == 4.0
+        report = q.resilience_report()
+        assert report.quarantined_updates == 1
+        assert [e.kind for e in report.events] == ["nan_quarantine"]
+
+    def test_quarantine_cannot_recover_pre_poisoned_state(self):
+        m = MeanSquaredError()  # no policy: poison slips in
+        m.update(poison_nans(jnp.ones(4)), jnp.zeros(4))
+        m.set_resilience_policy(nan_policy="quarantine")
+        with pytest.warns(UserWarning, match="already non-finite"):
+            m.update(jnp.ones(4), jnp.zeros(4))
+
+    def test_inf_default_states_exempt(self):
+        m = MinMetric(nan_policy="raise")
+        m.update(jnp.asarray([3.0, 1.0]))  # min state carries the +inf default lineage
+        assert float(m.compute()) == 1.0
+
+    def test_invalid_policy_rejected(self):
+        with pytest.raises(ValueError, match="nan_policy"):
+            MeanSquaredError(nan_policy="explode")
+        with pytest.raises(ValueError, match="sync_policy"):
+            MeanSquaredError(sync_policy="not-a-policy")
+
+    def test_quarantine_forward_does_not_contaminate_mean_state(self):
+        from torchmetrics_tpu.metric import Metric
+
+        class MeanState(Metric):
+            full_state_update = False
+
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("avg", jnp.zeros(()), dist_reduce_fx="mean")
+
+            def update(self, x):
+                self.avg = jnp.mean(jnp.asarray(x))
+
+            def compute(self):
+                return self.avg
+
+        q = MeanState(nan_policy="quarantine", auto_compile=False)
+        clean = MeanState(auto_compile=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            q(jnp.asarray([2.0, 4.0]))
+            clean(jnp.asarray([2.0, 4.0]))
+            q(poison_nans(jnp.asarray([8.0, 8.0])))  # forward on a poisoned batch
+            q(jnp.asarray([6.0, 8.0]))
+            clean(jnp.asarray([6.0, 8.0]))
+        # the dropped batch contributed nothing to the mean-reduced merge
+        assert float(q.compute()) == float(clean.compute()) == 5.0
+        assert q._update_count == clean._update_count == 2
+        assert q.resilience_report().quarantined_updates == 1
+
+    def test_repair_resets_missing_persistent_key_without_integrity(self):
+        # repair semantics must not depend on whether an integrity block
+        # survived: a truncated legacy checkpoint repairs instead of KeyError
+        sd = _persistent_sum(5.0).state_dict()
+        del sd["x"]
+        fresh = DummySum()
+        fresh.persistent(True)
+        with pytest.raises(KeyError):
+            fresh.load_state_dict(sd)  # strict=True keeps raising
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fresh.load_state_dict(sd, strict="repair")
+        assert float(fresh.x) == 0.0
+        assert "x" in fresh.resilience_report().events[0].detail
+
+    def test_raise_policy_in_forward_preserves_accumulation(self):
+        # the global state stashed by forward's reduce path must survive a
+        # batch rejected by the NaN sentinel
+        m = MeanSquaredError(nan_policy="raise")
+        for _ in range(3):
+            m(jnp.ones(4) * 2, jnp.zeros(4))
+        with pytest.raises(RuntimeError, match="Non-finite"):
+            m(poison_nans(jnp.ones(4)), jnp.zeros(4))
+        assert m._update_count == 3  # accumulation intact, not reset
+        assert float(m.compute()) == 4.0
+        m(jnp.ones(4) * 2, jnp.zeros(4))  # stream continues cleanly
+        assert m._update_count == 4
+
+    def test_set_resilience_policy_rejected_leaves_state_unchanged(self):
+        m = MeanSquaredError()
+        with pytest.raises(ValueError, match="sync_policy"):
+            m.set_resilience_policy(sync_policy="aggressive")
+        assert m.sync_policy is None
+        with pytest.raises(ValueError, match="nan_policy"):
+            m.set_resilience_policy(nan_policy="explode")
+        assert m.nan_policy is None
+
+    def test_strict_false_tolerates_missing_key_with_integrity(self):
+        # strict=False's contract (partial/filtered checkpoints load) must
+        # survive opting into integrity; present-but-corrupt still raises
+        sd = _persistent_sum(5.0).state_dict(integrity=True)
+        del sd["x"]
+        fresh = DummySum()
+        fresh.persistent(True)
+        fresh.load_state_dict(sd, strict=False)  # no error
+        assert float(fresh.x) == 0.0
+        sd2 = _persistent_sum(5.0).state_dict(integrity=True)
+        bad = corrupt_state_dict(sd2, mode="bitflip")
+        with pytest.raises(StateCorruptionError):
+            fresh.load_state_dict(bad, strict=False)
+
+    def test_quarantine_forward_on_cat_state_returns_none(self):
+        # a quarantined batch must be DROPPED, not crash compute() on the
+        # rolled-back empty cat state ("no samples to concatenate")
+        q = DummyList(nan_policy="quarantine")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            q(jnp.asarray([1.0, 2.0]))
+            out = q(jnp.asarray([3.0, np.nan]))
+            q(jnp.asarray([5.0]))
+        assert out is None  # dropped batches yield no batch value
+        np.testing.assert_allclose(np.asarray(q.compute()), [1.0, 2.0, 5.0])
+        assert q.resilience_report().quarantined_updates == 1
+
+    def test_quarantine_full_state_forward_records_one_event(self):
+        from torchmetrics_tpu.metric import Metric
+
+        class FullState(Metric):
+            full_state_update = True
+
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+            def update(self, x):
+                self.total = self.total + jnp.sum(jnp.asarray(x))
+
+            def compute(self):
+                return self.total
+
+        q = FullState(nan_policy="quarantine", auto_compile=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            q(jnp.asarray([1.0, 2.0]))
+            out = q(jnp.asarray([1.0, np.nan]))
+        assert out is None
+        report = q.resilience_report()
+        assert report.quarantined_updates == 1  # one bad batch, one event
+        assert len(report.events) == 1
+        assert float(q.compute()) == 3.0
+
+    def test_nan_policy_on_stateless_wrapper_warns_noop(self):
+        from torchmetrics_tpu.classification import BinaryAccuracy
+        from torchmetrics_tpu.wrappers import BootStrapper
+
+        m = BootStrapper(BinaryAccuracy(validate_args=False), num_bootstraps=2, seed=0, nan_policy="quarantine")
+        with pytest.warns(UserWarning, match="guards nothing"):
+            m.update(jnp.asarray([1, 0, 1]), jnp.asarray([1, 1, 0]))
+
+    def test_collection_load_is_atomic_on_corruption(self):
+        # a corrupted LATER member must not leave EARLIER members already
+        # overwritten: all members verify before any member loads
+        mc = MetricCollection(
+            {"a_acc": MulticlassAccuracy(num_classes=3, validate_args=False), "b_mse": MeanSquaredError()}
+        )
+        mc.persistent(True)
+        mc.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        sd = mc.state_dict(integrity=True)
+        bad = corrupt_state_dict(sd, key="b_mse.sum_squared_error", mode="bitflip")
+        fresh = MetricCollection(
+            {"a_acc": MulticlassAccuracy(num_classes=3, validate_args=False), "b_mse": MeanSquaredError()}
+        )
+        fresh.persistent(True)
+        before_tp = np.asarray(fresh["a_acc"].tp).copy()
+        with pytest.raises(StateCorruptionError):
+            fresh.load_state_dict(bad)
+        # the earlier (clean) member was not touched by the failed load
+        np.testing.assert_array_equal(np.asarray(fresh["a_acc"].tp), before_tp)
+
+    def test_collection_repair_atomic_on_bad_schema_version(self):
+        # repair mode's only raising path (unknown schema version) must also
+        # fire before any member loads
+        mc = MetricCollection(
+            {"a_acc": MulticlassAccuracy(num_classes=3, validate_args=False), "b_mse": MeanSquaredError()}
+        )
+        mc.persistent(True)
+        mc.update(jnp.asarray([0, 1, 2]), jnp.asarray([0, 1, 1]))
+        sd = mc.state_dict(integrity=True)
+        sd[integrity_key("b_mse.")] = dict(sd[integrity_key("b_mse.")], version=INTEGRITY_VERSION + 5)
+        fresh = MetricCollection(
+            {"a_acc": MulticlassAccuracy(num_classes=3, validate_args=False), "b_mse": MeanSquaredError()}
+        )
+        fresh.persistent(True)
+        with pytest.raises(StateCorruptionError, match="schema version"):
+            fresh.load_state_dict(sd, strict="repair")
+        np.testing.assert_array_equal(np.asarray(fresh["a_acc"].tp), 0)  # nothing loaded
+
+    def test_corrupt_state_dict_does_not_alias_integrity_block(self):
+        sd = _persistent_sum(5.0).state_dict(integrity=True)
+        bad = corrupt_state_dict(sd, mode="bitflip")
+        bad[integrity_key()]["version"] = 99  # mutate the copy's metadata
+        assert sd[integrity_key()]["version"] == INTEGRITY_VERSION  # original pristine
+
+    def test_full_state_forward_reports_correct_stream_position(self):
+        from torchmetrics_tpu.metric import Metric
+
+        class FullState(Metric):
+            full_state_update = True
+
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+
+            def update(self, x):
+                self.total = self.total + jnp.sum(jnp.asarray(x))
+
+            def compute(self):
+                return self.total
+
+        q = FullState(nan_policy="quarantine", auto_compile=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            q(jnp.asarray([1.0]))  # batch 1 (its replay must not double-count)
+            q(jnp.asarray([2.0]))  # batch 2
+            q(jnp.asarray([np.nan]))  # batch 3: dropped
+        assert "guarded batch 3" in q.resilience_report().events[0].detail
+
+    def test_quarantine_event_reports_stream_position(self):
+        # forward() resets _update_count batch-locally; the event must still
+        # name the batch's position in the guarded stream
+        q = MeanSquaredError(nan_policy="quarantine", auto_compile=False)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            for _ in range(4):
+                q(jnp.ones(8), jnp.zeros(8))
+            q(poison_nans(jnp.ones(8)), jnp.zeros(8))  # 5th guarded batch
+        detail = q.resilience_report().events[0].detail
+        assert "guarded batch 5" in detail
+
+    def test_nan_policy_pins_eager_path(self):
+        m = MeanSquaredError(nan_policy="raise")
+        p, t = jnp.ones(8), jnp.zeros(8)
+        for _ in range(4):
+            m.update(p, t)
+        assert "_auto_update_fn" not in m.__dict__  # sentinel must see every update

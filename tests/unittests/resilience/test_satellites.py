@@ -1,0 +1,182 @@
+"""Round-5 ADVICE satellite fixes riding with the resilience PR."""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.classification import BinaryAccuracy, BinaryStatScores
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.wrappers import BootStrapper
+
+RNG = np.random.default_rng(11)
+
+
+class TestResetWithPendingViolation:
+    """metric.py: one reset() must both surface the deferred violation AND
+    leave a clean metric (previously it aborted before resetting)."""
+
+    def _poisoned_metric(self):
+        m = BinaryStatScores()  # validate_args defaults True -> fused checks
+        good_p = jnp.asarray(RNG.random(8).astype(np.float32))
+        good_t = jnp.asarray(RNG.integers(0, 2, 8))
+        for _ in range(3):
+            m.update(good_p, good_t)
+        assert "_auto_update_fn" in m.__dict__
+        m.update(good_p, jnp.asarray(np.full(8, 7)))  # compiled: deferred violation
+        return m
+
+    def test_single_reset_raises_and_resets(self):
+        m = self._poisoned_metric()
+        with pytest.raises(RuntimeError, match="outside of the expected set"):
+            m.reset()
+        # ONE call sufficed: state is already clean
+        assert m._update_count == 0
+        np.testing.assert_array_equal(np.asarray(m.tp), 0)
+        m.update(jnp.asarray(RNG.random(8).astype(np.float32)), jnp.asarray(RNG.integers(0, 2, 8)))
+        assert m._update_count == 1  # fully usable without a second reset()
+
+    def test_forward_with_pending_violation_preserves_accumulation(self):
+        # forward() calls reset() internally on a stashed-state dance; the
+        # clear-then-raise reset must not destroy the accumulation the stash
+        # was protecting (it lives only in a local when reset raises)
+        m = self._poisoned_metric()
+        count_before = m._update_count
+        tp_before = np.asarray(m.tp).copy()
+        with pytest.raises(RuntimeError, match="outside of the expected set"):
+            m(jnp.asarray(RNG.random(8).astype(np.float32)), jnp.asarray(RNG.integers(0, 2, 8)))
+        assert m._update_count == count_before  # accumulation survived
+        np.testing.assert_array_equal(np.asarray(m.tp), tp_before)
+
+    def test_clean_reset_unchanged(self):
+        m = BinaryStatScores()
+        m.update(jnp.asarray(RNG.random(8).astype(np.float32)), jnp.asarray(RNG.integers(0, 2, 8)))
+        m.reset()
+        assert m._update_count == 0
+
+    def test_collection_reset_resets_all_members_despite_violation(self):
+        # one collection.reset() must clean EVERY member even when an early
+        # member's reset surfaces its pending deferred violation
+        from torchmetrics_tpu import MetricCollection
+
+        a = self._poisoned_metric()
+        b = BinaryStatScores()
+        b.update(jnp.asarray(RNG.random(8).astype(np.float32)), jnp.asarray(RNG.integers(0, 2, 8)))
+        mc = MetricCollection({"a": a, "b": b}, compute_groups=False)
+        with pytest.raises(RuntimeError, match="outside of the expected set"):
+            mc.reset()
+        assert a._update_count == 0 and b._update_count == 0  # both clean
+
+
+class TestDeferredMessageWording:
+    """checks.py: the deferred message must match the reference's pattern
+    ("Detected the following values in `target` ... expected only ...") so
+    one matcher catches both the eager and the deferred raise."""
+
+    def test_deferred_message_matches_reference_pattern(self):
+        m = BinaryStatScores()
+        good_p = jnp.asarray(RNG.random(8).astype(np.float32))
+        good_t = jnp.asarray(RNG.integers(0, 2, 8))
+        for _ in range(3):
+            m.update(good_p, good_t)
+        m.update(good_p, jnp.asarray(np.full(8, 7)))
+        with pytest.raises(RuntimeError) as err:
+            m.compute()
+        msg = str(err.value)
+        assert "Detected the following values in `target`" in msg  # reference prefix
+        assert "expected only" in msg  # reference tail
+        assert "outside of the expected set" in msg  # pre-existing matcher keeps working
+        assert "omitted" in msg  # the value-list omission is documented in-message
+
+    def test_eager_message_still_matches_same_pattern(self):
+        m = BinaryStatScores()
+        with pytest.raises(RuntimeError, match="Detected the following values in `target`"):
+            m.update(jnp.asarray(RNG.random(8).astype(np.float32)), jnp.asarray(np.full(8, 7)))
+
+
+class TestLargeContainerFingerprint:
+    """metric.py `_host_attr_snapshot`: >16-entry containers now fold in a
+    sampled content fingerprint, so same-length in-place mutation disables
+    the compiled paths instead of being silently frozen."""
+
+    def _metric_cls(self, container_factory, mutate):
+        class Mutating(Metric):
+            full_state_update = False
+
+            def __init__(self, **kwargs):
+                super().__init__(**kwargs)
+                self.add_state("total", jnp.zeros(()), dist_reduce_fx="sum")
+                self.bag = container_factory()
+
+            def update(self, x):
+                self.total = self.total + jnp.sum(x)
+                mutate(self.bag)
+
+            def compute(self):
+                return self.total
+
+        return Mutating
+
+    def test_large_list_inplace_mutation_detected(self):
+        def mutate(bag):
+            bag[0] += 1  # same length, first element changes
+
+        m = self._metric_cls(lambda: list(range(32)), mutate)()
+        x = jnp.ones(4)
+        for _ in range(3):
+            m.update(x)
+        assert m._auto_disabled  # the sampled fingerprint caught the mutation
+
+    def test_large_dict_inplace_mutation_detected(self):
+        def mutate(bag):
+            bag["k0"] += 1
+
+        m = self._metric_cls(lambda: {f"k{i}": 0 for i in range(32)}, mutate)()
+        x = jnp.ones(4)
+        for _ in range(3):
+            m.update(x)
+        assert m._auto_disabled
+
+    def test_untouched_large_container_keeps_compiled_path(self):
+        m = self._metric_cls(lambda: list(range(32)), lambda bag: None)()
+        x = jnp.ones(4)
+        for _ in range(3):
+            m.update(x)
+        assert not m._auto_disabled
+        assert "_auto_update_fn" in m.__dict__  # still compiles on repeat shapes
+
+
+class TestBootstrapSize1Licensing:
+    """bootstrapping.py: size-1 batches must not self-license the vmapped
+    fast path — only a passed size>1 additivity check licenses them."""
+
+    def _batches(self, size, n):
+        return [
+            (jnp.asarray(RNG.integers(0, 2, size)), jnp.asarray(RNG.integers(0, 2, size)))
+            for _ in range(n)
+        ]
+
+    def test_size1_stream_stays_on_loop_path(self):
+        m = BootStrapper(BinaryAccuracy(validate_args=False), num_bootstraps=4, seed=0)
+        for p, t in self._batches(1, 4):
+            m.update(p, t)
+        assert m._stacked is None  # never entered the fast path
+        assert not m._fast_disabled  # ...but not permanently disabled either
+        assert not m._fast_checked_sizes
+
+    def test_size1_licensed_after_passed_check(self):
+        m = BootStrapper(BinaryAccuracy(validate_args=False), num_bootstraps=4, seed=0)
+        m.update(*self._batches(8, 1)[0])  # warms the loop path
+        m.update(*self._batches(8, 1)[0])  # passes the size-8 additivity check
+        assert m._fast_checked_sizes == {8}
+        m.update(*self._batches(1, 1)[0])  # now size-1 may ride the fast path
+        assert m._stacked is not None
+
+    def test_size1_then_size8_recovers_fast_path(self):
+        m = BootStrapper(BinaryAccuracy(validate_args=False), num_bootstraps=4, seed=0)
+        for p, t in self._batches(1, 3):  # loop path only
+            m.update(p, t)
+        m.update(*self._batches(8, 1)[0])  # size>1 arrives: check runs, licenses
+        assert m._fast_checked_sizes == {8}
+        m.update(*self._batches(1, 1)[0])
+        assert m._stacked is not None
+        float(jnp.asarray(m.compute()["mean"]))  # stream still computes

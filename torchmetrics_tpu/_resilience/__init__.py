@@ -1,0 +1,61 @@
+"""Fault-tolerant metric runtime: guarded sync, state integrity, fault injection.
+
+Three hardened seams (see RESILIENCE.md for the full cookbook):
+
+1. **Guarded distributed sync** — attach a :class:`SyncPolicy` to any metric
+   (``Metric(sync_policy=...)``, ``Metric.set_resilience_policy``, or the
+   process-wide :func:`set_default_sync_policy`) and the eager multi-host
+   sync gains a pre-collective structure handshake, per-attempt timeouts,
+   retry with exponential backoff, and graceful degradation to local-only
+   compute with a recorded :class:`DegradationEvent`
+   (``Metric.resilience_report()``).
+2. **State integrity** — ``Metric.state_dict(..., integrity=True)`` attaches
+   checksummed, versioned metadata; ``load_state_dict`` verifies it, rejects
+   corrupt/NaN-poisoned restores with :class:`StateCorruptionError`, and
+   ``strict="repair"`` resets only the corrupted states. The ``nan_policy``
+   constructor knob (``"raise"``/``"warn"``/``"quarantine"``) guards live
+   updates against NaN/Inf poisoning.
+3. **Fault injection** — :mod:`torchmetrics_tpu._resilience.faultinject`
+   deterministically injects collective failures, stalls, corrupted
+   checkpoints, and NaN batches through the same seams production traffic
+   uses, backing ``tests/unittests/resilience/``.
+"""
+
+from torchmetrics_tpu._resilience.errors import (
+    CollectiveTimeoutError,
+    GuardedSyncError,
+    StateCorruptionError,
+    StateStructureMismatchError,
+    SyncRetriesExhausted,
+)
+from torchmetrics_tpu._resilience.guard import run_guarded, state_structure_digest
+from torchmetrics_tpu._resilience.integrity import INTEGRITY_VERSION, integrity_key, nonfinite_state_report
+from torchmetrics_tpu._resilience.policy import (
+    NAN_POLICIES,
+    DegradationEvent,
+    ResilienceReport,
+    RetryPolicy,
+    SyncPolicy,
+    default_sync_policy,
+    set_default_sync_policy,
+)
+
+__all__ = [
+    "CollectiveTimeoutError",
+    "DegradationEvent",
+    "GuardedSyncError",
+    "INTEGRITY_VERSION",
+    "NAN_POLICIES",
+    "ResilienceReport",
+    "RetryPolicy",
+    "StateCorruptionError",
+    "StateStructureMismatchError",
+    "SyncPolicy",
+    "SyncRetriesExhausted",
+    "default_sync_policy",
+    "integrity_key",
+    "nonfinite_state_report",
+    "run_guarded",
+    "set_default_sync_policy",
+    "state_structure_digest",
+]

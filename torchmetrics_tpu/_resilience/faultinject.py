@@ -1,0 +1,254 @@
+"""Deterministic fault injection for the metric runtime.
+
+Everything here is a context manager (or pure helper) that perturbs exactly
+one seam and restores it on exit:
+
+- :func:`simulated_world` — make one host look like an ``N``-process world:
+  ``distributed_available()`` flips true and the eager transport returns
+  ``N`` stacked copies of the local value (every simulated process
+  contributing identical data). All other injectors compose inside it.
+- :func:`inject_collective_failure` — the first ``first_n`` transport calls
+  raise, then the underlying transport resumes: exercises retry + backoff.
+- :func:`inject_collective_timeout` — the first ``first_n`` transport calls
+  block (bounded by ``hang`` seconds and released at context exit, so a test
+  can never truly deadlock): exercises the watchdog + degradation path.
+- :func:`corrupt_state_dict` / :func:`poison_nans` — deterministic
+  checkpoint corruption and NaN batch poisoning.
+- :func:`nan_batches` — poison selected ``update()`` calls of one metric.
+
+The injectors patch module-level seams in
+``torchmetrics_tpu.utilities.distributed`` (``_transport`` /
+``_world_override``) — the same indirection the real multi-host transport
+flows through, so the production code path under test is byte-identical to
+the one that runs on a real DCN fabric.
+"""
+
+from __future__ import annotations
+
+import copy
+import functools
+import threading
+from contextlib import contextmanager
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, Iterator, Optional, Sequence
+
+import jax
+import numpy as np
+
+from torchmetrics_tpu.utilities import distributed as _dist
+
+__all__ = [
+    "InjectionStats",
+    "simulated_world",
+    "inject_collective_failure",
+    "inject_collective_timeout",
+    "corrupt_state_dict",
+    "poison_nans",
+    "nan_batches",
+]
+
+
+@dataclass
+class InjectionStats:
+    """Live counters yielded by the injectors (assertable mid-context)."""
+
+    calls: int = 0  # transport invocations observed
+    injected: int = 0  # invocations that were perturbed
+
+
+def _current_transport() -> Callable[[Any], Any]:
+    return _dist._transport if _dist._transport is not None else _dist._default_transport
+
+
+@contextmanager
+def simulated_world(size: int = 2, transport: Optional[Callable[[Any], Any]] = None) -> Iterator[None]:
+    """Simulate an ``size``-process world on a single host.
+
+    The default transport stacks ``size`` copies of the local value along a
+    new leading axis — exactly the shape contract of
+    ``multihost_utils.process_allgather`` — so every simulated process
+    contributes identical data and sum-reduced states come back multiplied
+    by the world size. Pass ``transport`` to model per-process divergence.
+    """
+    if size < 1:
+        raise ValueError(f"simulated world size must be >= 1, got {size}")
+
+    def _stack_world(x: Any) -> Any:
+        return jax.tree_util.tree_map(lambda v: np.stack([np.asarray(v)] * size), x)
+
+    prev = (_dist._world_override, _dist._transport)
+    _dist._world_override = size
+    _dist._transport = transport if transport is not None else _stack_world
+    try:
+        yield
+    finally:
+        _dist._world_override, _dist._transport = prev
+
+
+@contextmanager
+def inject_collective_failure(
+    first_n: int = 1, exc_factory: Optional[Callable[[], BaseException]] = None
+) -> Iterator[InjectionStats]:
+    """Fail the first ``first_n`` transport calls with a transient error."""
+    inner = _current_transport()
+    stats = InjectionStats()
+
+    def patched(x: Any) -> Any:
+        stats.calls += 1
+        if stats.injected < first_n:
+            stats.injected += 1
+            if exc_factory is not None:
+                raise exc_factory()
+            raise ConnectionError(
+                f"injected collective failure ({stats.injected}/{first_n}): simulated DCN fault"
+            )
+        return inner(x)
+
+    prev = _dist._transport
+    _dist._transport = patched
+    try:
+        yield stats
+    finally:
+        _dist._transport = prev
+
+
+@contextmanager
+def inject_collective_timeout(first_n: int = 1, hang: float = 60.0) -> Iterator[InjectionStats]:
+    """Stall the first ``first_n`` transport calls (a hung peer / dead link).
+
+    Each stalled call blocks up to ``hang`` seconds on an event that context
+    exit sets, so abandoned watchdog workers wake and die promptly instead of
+    sleeping out the full duration; a stalled call that wakes raises
+    ``TimeoutError`` rather than returning garbage.
+    """
+    inner = _current_transport()
+    stats = InjectionStats()
+    release = threading.Event()
+
+    def patched(x: Any) -> Any:
+        stats.calls += 1
+        if stats.injected < first_n:
+            stats.injected += 1
+            release.wait(hang)
+            raise TimeoutError(f"injected collective stall ({stats.injected}/{first_n}) released")
+        return inner(x)
+
+    prev = _dist._transport
+    _dist._transport = patched
+    try:
+        yield stats
+    finally:
+        release.set()
+        _dist._transport = prev
+
+
+# ---------------------------------------------------------------------------
+# checkpoint + batch corruption
+# ---------------------------------------------------------------------------
+
+
+def _first_matching_key(state_dict: Dict[str, Any], floating_only: bool) -> str:
+    for key in sorted(state_dict):
+        if key.endswith("#integrity"):
+            continue
+        for arr in _as_arrays(state_dict[key]):
+            if arr.size and (not floating_only or np.issubdtype(arr.dtype, np.floating)):
+                return key
+    raise ValueError("state_dict has no corruptible array state")
+
+
+def _as_arrays(value: Any) -> list:
+    return [np.asarray(v) for v in value] if isinstance(value, (list, tuple)) else [np.asarray(value)]
+
+
+def corrupt_state_dict(
+    state_dict: Dict[str, Any], key: Optional[str] = None, mode: str = "bitflip", seed: int = 0
+) -> Dict[str, Any]:
+    """Deterministically corrupted deep copy of a checkpoint.
+
+    ``mode="bitflip"`` inverts one byte in the middle of the state's buffer
+    (a storage/transfer fault); ``mode="nan"`` overwrites a deterministic
+    third of a floating state with NaN (a poisoned-accumulator fault). The
+    integrity block, if present, is left untouched — that is the point: the
+    checksums no longer match the payload.
+    """
+    if mode not in ("bitflip", "nan"):
+        raise ValueError(f"unknown corruption mode {mode!r}; expected 'bitflip' or 'nan'")
+    out = {
+        k: (
+            [np.array(x, copy=True) for x in v]
+            if isinstance(v, (list, tuple))
+            else copy.deepcopy(v) if isinstance(v, dict) else np.array(v, copy=True)
+        )
+        for k, v in state_dict.items()
+    }
+    if key is None:
+        key = _first_matching_key(out, floating_only=(mode == "nan"))
+    value = out[key]
+    target = value[0] if isinstance(value, list) else value
+    rng = np.random.default_rng(seed)
+    if mode == "bitflip":
+        flat = np.ascontiguousarray(target)
+        buf = flat.reshape(-1).view(np.uint8)
+        pos = int(rng.integers(0, buf.size)) if buf.size > 1 else 0
+        buf[pos] ^= 0xFF
+        corrupted = flat.reshape(target.shape)
+    else:
+        if not np.issubdtype(target.dtype, np.floating):
+            raise ValueError(f"state {key!r} has dtype {target.dtype}; 'nan' mode needs a floating state")
+        corrupted = np.array(target, copy=True)
+        cflat = corrupted.reshape(-1)
+        cflat[: max(1, cflat.size // 3)] = np.nan
+    if isinstance(value, list):
+        value[0] = corrupted
+    else:
+        out[key] = corrupted
+    return out
+
+
+def poison_nans(array: Any, frac: float = 0.5) -> Any:
+    """Deterministic NaN-poisoned copy of a floating array (first ``frac`` elems)."""
+    import jax.numpy as jnp
+
+    a = np.array(array, copy=True)
+    if not np.issubdtype(a.dtype, np.floating):
+        raise ValueError(f"poison_nans needs a floating array, got dtype {a.dtype}")
+    flat = a.reshape(-1)
+    flat[: max(1, int(flat.size * frac))] = np.nan
+    return jnp.asarray(a)
+
+
+@contextmanager
+def nan_batches(metric: Any, indices: Sequence[int] = (0,), frac: float = 0.5) -> Iterator[InjectionStats]:
+    """Poison the first floating array argument of selected ``update()`` calls.
+
+    ``indices`` are 0-based positions in the stream of ``update`` calls made
+    while the context is active — ``indices=(2,)`` poisons only the third
+    batch, deterministically.
+    """
+    stats = InjectionStats()
+    wanted = set(int(i) for i in indices)
+    orig_update = metric.update
+
+    @functools.wraps(orig_update)
+    def patched(*args: Any, **kwargs: Any) -> Any:
+        idx, stats.calls = stats.calls, stats.calls + 1
+        if idx in wanted:
+            stats.injected += 1
+            args = _poison_first_float(args, frac)
+        return orig_update(*args, **kwargs)
+
+    metric.update = patched
+    try:
+        yield stats
+    finally:
+        metric.update = orig_update
+
+
+def _poison_first_float(args: tuple, frac: float) -> tuple:
+    out = list(args)
+    for i, a in enumerate(out):
+        if hasattr(a, "dtype") and np.issubdtype(np.asarray(a).dtype, np.floating):
+            out[i] = poison_nans(a, frac)
+            return tuple(out)
+    raise ValueError("nan_batches found no floating array argument to poison")

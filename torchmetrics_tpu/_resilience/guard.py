@@ -1,0 +1,323 @@
+"""Guarded execution of eager multi-host collectives.
+
+The eager sync path (``Metric.sync`` → ``gather_all_tensors`` →
+``process_allgather`` over DCN) is the one seam of the runtime that can
+*block forever*: a peer that died mid-step leaves every other process stuck
+inside the collective. This module wraps that seam with
+
+1. a **structure handshake** — one scalar all-gather of a digest over the
+   metric's state tree (names, dtypes, shapes, reductions) so mismatched
+   collectives fail fast with :class:`StateStructureMismatchError` instead of
+   deadlocking on mismatched buffer counts;
+2. a **watchdog** — each attempt runs on a persistent daemon worker thread
+   and is abandoned after ``RetryPolicy.timeout`` seconds (a stuck worker is
+   replaced; being a daemon it cannot block interpreter exit);
+3. **retry with exponential backoff**, and on exhaustion **graceful
+   degradation**: the metric keeps its local state, records a
+   :class:`DegradationEvent`, and ``compute()`` proceeds local-only.
+
+The gather phase is *pure* (``Metric._dist_gather`` reads state, mutates
+nothing), so an abandoned timed-out attempt that eventually completes on its
+orphaned worker can never corrupt the metric — results are committed on the
+caller's thread only after a successful attempt.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import queue
+import threading
+import time
+from typing import Any, Callable, Optional, Tuple
+
+import numpy as np
+
+from torchmetrics_tpu._resilience.errors import (
+    CollectiveTimeoutError,
+    StateStructureMismatchError,
+    SyncRetriesExhausted,
+)
+from torchmetrics_tpu._resilience.policy import RetryPolicy, SyncPolicy
+from torchmetrics_tpu.utilities.distributed import process_allgather
+from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
+
+__all__ = [
+    "run_guarded",
+    "state_structure_digest",
+    "guarded_metric_sync",
+]
+
+
+# ---------------------------------------------------------------------------
+# watchdog worker
+# ---------------------------------------------------------------------------
+
+
+class _Worker:
+    """One persistent daemon thread executing guarded attempts.
+
+    A fresh thread per attempt would cost ~100µs of spawn latency on every
+    sync; a shared ``ThreadPoolExecutor`` would either queue new attempts
+    behind a stuck worker or hang interpreter exit on its atexit join. This
+    hand-rolled worker gives the cheap steady-state (one queue handoff per
+    attempt) and the right failure mode: on timeout the whole worker is
+    discarded — the stuck thread parks on its orphaned queue as a daemon —
+    and the next attempt gets a new one.
+    """
+
+    def __init__(self) -> None:
+        self._tasks: "queue.Queue[Tuple[Callable[[], Any], list, threading.Event]]" = queue.Queue()
+        self.busy = False  # guarded by _worker_lock
+        self._thread = threading.Thread(target=self._loop, name="tm-tpu-guarded-sync", daemon=True)
+        self._thread.start()
+
+    def _loop(self) -> None:
+        while True:
+            # plain blocking pickup: hot-spinning here would burn scheduler
+            # quota (containers throttle it, delaying the very wakeups the
+            # guard exists to bound) for ~60µs of saved handoff latency
+            fn, box, done = self._tasks.get()
+            try:
+                box.append((True, fn()))
+            except BaseException as err:  # noqa: BLE001 - relayed to the caller
+                box.append((False, err))
+            done.set()
+
+    def run(self, fn: Callable[[], Any], timeout: float) -> Any:
+        box: list = []
+        done = threading.Event()
+        start = time.monotonic()
+        self._tasks.put((fn, box, done))
+        # spin-assist: a blocking futex wait costs ~100µs of wakeup latency
+        # per sync, which would dominate the guard's overhead on fast
+        # (in-process / simulated) transports. Yield-spin briefly — trivial
+        # gathers complete inside the window — then block. The window is
+        # deliberately short: a longer yield-spin GIL-starves the worker
+        # (CPython's GIL hand-off is not FIFO), *adding* latency to real
+        # transports instead of hiding it.
+        spin_until = start + min(0.0002, timeout)
+        while not box and time.monotonic() < spin_until:
+            time.sleep(0)
+        if not box and not done.is_set():
+            remaining = timeout - (time.monotonic() - start)
+            if remaining <= 0 or not done.wait(remaining):
+                raise CollectiveTimeoutError(
+                    f"guarded collective did not complete within {timeout:g}s (attempt abandoned)"
+                )
+        ok, val = box[0]
+        if ok:
+            return val
+        raise val
+
+
+_worker_lock = threading.Lock()
+_workers: list = []  # idle-or-busy pool; stuck (timed-out) workers are evicted
+_METRIC_BASE: Optional[type] = None  # lazily bound to Metric (import-cycle break)
+
+
+def _run_with_timeout(fn: Callable[[], Any], timeout: Optional[float]) -> Any:
+    if timeout is None:
+        return fn()
+    # one worker per concurrent attempt: queueing a second metric's sync
+    # behind a busy worker would burn its timeout budget on waiting, then
+    # discard a healthy worker and fake a degradation on a healthy fabric
+    with _worker_lock:
+        w = next((x for x in _workers if not x.busy and x._thread.is_alive()), None)
+        if w is None:
+            w = _Worker()
+            _workers.append(w)
+        w.busy = True
+    try:
+        result = w.run(fn, timeout)
+    except CollectiveTimeoutError:
+        with _worker_lock:  # the worker may be stuck mid-transport: evict it
+            if w in _workers:
+                _workers.remove(w)
+        raise
+    except BaseException:
+        with _worker_lock:
+            w.busy = False
+        raise
+    with _worker_lock:
+        w.busy = False
+    return result
+
+
+# deterministic programming errors: a wrong-signature dist_sync_fn, a bad
+# process_group, a typo'd attribute — retrying cannot fix them, and degrading
+# would reduce a bug to a warning with silently-local (cross-host divergent)
+# results. Transport faults surface as OSError/ConnectionError/TimeoutError/
+# RuntimeError(XlaRuntimeError) and stay retryable.
+_NON_RETRYABLE = (TypeError, AttributeError, NameError, KeyError, IndexError, ValueError)
+
+
+def run_guarded(fn: Callable[[], Any], retry: RetryPolicy, describe: str = "collective") -> Any:
+    """Run ``fn`` under the retry policy; raise :class:`SyncRetriesExhausted` at the end.
+
+    ``StateStructureMismatchError`` and the ``_NON_RETRYABLE`` programming
+    errors are never retried (and never degraded) — they are deterministic,
+    so retrying only burns the backoff budget and degrading hides a bug.
+
+    Caveat for timeout-armed policies on a *live* fabric: abandoning a
+    timed-out collective and issuing a retry means this process has entered
+    the collective one more time than peers that were merely slow — which can
+    skew collective ordering until the abandoned call drains. Set ``timeout``
+    well above worst-case congestion (it is a deadlock escape hatch, not a
+    latency SLO), and prefer ``max_retries=0`` + degradation where peers may
+    be slow rather than dead.
+    """
+    last_err: Optional[BaseException] = None
+    for attempt in range(retry.attempts):
+        try:
+            return _run_with_timeout(fn, retry.timeout)
+        except StateStructureMismatchError:
+            raise
+        except _NON_RETRYABLE:
+            raise
+        except Exception as err:  # noqa: BLE001 - transport errors are policy-handled
+            last_err = err
+            if attempt + 1 < retry.attempts:
+                delay = retry.backoff(attempt)
+                if delay:
+                    time.sleep(delay)
+    raise SyncRetriesExhausted(
+        f"{describe} failed after {retry.attempts} attempt(s); last error:"
+        f" {type(last_err).__name__}: {last_err}",
+        attempts=retry.attempts,
+        last_error=last_err,
+    )
+
+
+# ---------------------------------------------------------------------------
+# structure handshake
+# ---------------------------------------------------------------------------
+
+
+def state_structure_digest(metric: Any) -> Tuple[int, str]:
+    """``(digest, description)`` of the metric's state structure.
+
+    Covers exactly what must agree across processes for the collective to be
+    well-formed: sorted state names, each state's declared reduction, and for
+    plain array states the dtype and shape (shape mismatches would stack into
+    garbage reductions). List and ring-buffer ("cat") states contribute only
+    their kind — their lengths and row counts legitimately differ per process
+    and are handled by the uneven-gather protocol.
+    """
+    parts = []
+    for name in sorted(metric._defaults):
+        red = metric._reductions.get(name)
+        red_desc = red if isinstance(red, str) or red is None else f"callable:{getattr(red, '__name__', 'fn')}"
+        value = getattr(metric, name)
+        if isinstance(value, RingBuffer):
+            kind: Tuple[Any, ...] = ("ring", int(value.capacity))
+        elif isinstance(value, list):
+            kind = ("list",)
+        else:
+            kind = ("array", str(value.dtype), tuple(int(s) for s in value.shape))
+        parts.append((name, red_desc, kind))
+    description = repr(tuple(parts))
+    digest = int.from_bytes(hashlib.sha256(description.encode()).digest()[:8], "big")
+    return digest, description
+
+
+def _handshake(metric: Any, policy: SyncPolicy) -> bool:
+    """Exchange structure digests; True on success, False on degraded transport.
+
+    Raises :class:`StateStructureMismatchError` when digests disagree — that
+    is a fail-fast diagnosis, not a degradable transient.
+    """
+    # one successful handshake certifies the structure for the metric's
+    # lifetime: every structure-changing entry point (`add_state`,
+    # `set_resilience_policy`, `set_dtype`, `load_state_dict`) drops this
+    # cache. The skip
+    # decision is LOCAL — it stays collective-count-symmetric only while
+    # every process runs the same code path (see SyncPolicy.handshake docs);
+    # `handshake_every_sync=True` trades one scalar all-gather per sync for
+    # a fail-fast diagnostic even under mid-stream structure divergence.
+    if not policy.handshake_every_sync and metric.__dict__.get("_handshake_ok_digest") is not None:
+        return True
+    digest, description = state_structure_digest(metric)
+    # the digest travels as TWO uint32 words: the real transport routes
+    # through jax arrays, and with jax_enable_x64 off (the default) a
+    # uint64 scalar would be silently truncated to its low 32 bits —
+    # turning every production handshake into a spurious mismatch
+    local_words = np.array([(digest >> 32) & 0xFFFFFFFF, digest & 0xFFFFFFFF], dtype=np.uint32)
+    try:
+        gathered = run_guarded(
+            lambda: process_allgather(local_words),
+            policy.retry,
+            describe=f"{type(metric).__name__} pre-sync structure handshake",
+        )
+    except SyncRetriesExhausted as err:
+        if policy.on_exhausted == "raise":
+            raise
+        metric._record_degradation("handshake_degraded", detail=str(err), attempts=err.attempts)
+        return False
+    words = np.asarray(gathered).astype(np.uint64).reshape(-1, 2)
+    digests = (words[:, 0] << np.uint64(32)) | words[:, 1]
+    if not (digests == np.uint64(digest)).all():
+        mismatched = sorted({int(d) for d in digests.tolist()})
+        raise StateStructureMismatchError(
+            f"State-structure handshake failed for {type(metric).__name__}: processes reported"
+            f" {len(mismatched)} distinct structure digests {[f'{d:016x}' for d in mismatched]}."
+            " Entering the collective would deadlock or mis-reduce. This process's structure is:"
+            f" {description}. Check that every process constructed the metric with identical"
+            " configuration (state names, dtypes, shapes, and reductions must all match)."
+        )
+    object.__setattr__(metric, "_handshake_ok_digest", digest)
+    return True
+
+
+# ---------------------------------------------------------------------------
+# metric-level guarded sync
+# ---------------------------------------------------------------------------
+
+
+def guarded_metric_sync(metric: Any, dist_sync_fn: Callable, process_group: Any, policy: SyncPolicy) -> bool:
+    """Run one guarded sync; True = gathered state committed, False = degraded.
+
+    Degradation (False) means the caller must keep the metric's local state
+    and skip marking it synced. Structure mismatches raise. Metrics that
+    override ``_sync_dist`` wholesale (fusing gather and commit) run their
+    override inline — retries and backoff still apply, but no watchdog
+    thread: a timed-out override could commit state from an abandoned worker,
+    which the split gather/commit protocol exists to prevent.
+    """
+    global _METRIC_BASE
+    if _METRIC_BASE is None:  # lazy: guard must stay importable before metric
+        from torchmetrics_tpu.metric import Metric as _METRIC_BASE  # noqa: N806
+
+    Metric = _METRIC_BASE
+    if policy.handshake and not _handshake(metric, policy):
+        return False
+
+    overridden = type(metric)._sync_dist is not Metric._sync_dist
+    if overridden:
+        retry = policy.retry if policy.retry.timeout is None else dataclasses.replace(policy.retry, timeout=None)
+
+        def attempt() -> None:
+            try:
+                metric._sync_dist(dist_sync_fn, process_group=process_group)
+            except BaseException:
+                # a fused override may have committed some states before the
+                # transport failed; undo the partial commit so the retry does
+                # not re-reduce already-reduced values (double counting)
+                if metric._cache is not None:
+                    metric._restore_state(metric._cache)
+                raise
+
+        commit: Callable[[Any], None] = lambda _out: None  # noqa: E731
+    else:
+        retry = policy.retry
+        attempt = lambda: metric._dist_gather(dist_sync_fn, process_group)  # noqa: E731
+        commit = metric._commit_gathered
+    try:
+        gathered = run_guarded(attempt, retry, describe=f"{type(metric).__name__} state gather")
+    except SyncRetriesExhausted as err:
+        if policy.on_exhausted == "raise":
+            raise
+        metric._record_degradation("sync_degraded", detail=str(err), attempts=err.attempts)
+        return False
+    commit(gathered)
+    return True

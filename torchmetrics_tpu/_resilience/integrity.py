@@ -1,0 +1,225 @@
+"""Checksummed, versioned metric checkpoints + NaN/Inf state sentinels.
+
+``Metric.state_dict(..., integrity=True)`` attaches one metadata block per
+metric under the non-identifier key ``{prefix}#integrity`` (state names are
+python identifiers, so the key can never collide with a real state):
+
+.. code-block:: python
+
+    {"version": 1, "class": "MulticlassAccuracy",
+     "states": {"tp": {"sha256": "...", "finite": True}, ...}}
+
+``Metric.load_state_dict`` verifies the block when present: unknown schema
+versions and checksum mismatches raise :class:`StateCorruptionError`
+immediately; ``strict="repair"`` instead resets only the corrupted states to
+their registered defaults and loads the rest.
+
+The finiteness sentinels here also back the ``nan_policy`` update guard:
+NaN anywhere is flagged; ±Inf is flagged only for states whose *default* is
+fully finite, so min/max accumulators seeded with ±Inf sentinels stay legal
+while a sum state overflowing to Inf is caught.
+"""
+
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, Iterable, List, Optional
+
+import numpy as np
+
+from torchmetrics_tpu._resilience.errors import StateCorruptionError
+from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
+
+__all__ = [
+    "INTEGRITY_VERSION",
+    "integrity_key",
+    "attach_integrity",
+    "verify_states",
+    "nonfinite_state_report",
+]
+
+INTEGRITY_VERSION = 1
+_INTEGRITY_SUFFIX = "#integrity"
+
+
+def integrity_key(prefix: str = "") -> str:
+    """Checkpoint key of the integrity block for one metric's ``prefix``."""
+    return prefix + _INTEGRITY_SUFFIX
+
+
+def _iter_arrays(value: Any) -> Iterable[np.ndarray]:
+    """Host arrays of one serialized state value (array or list-of-arrays)."""
+    if isinstance(value, (list, tuple)):
+        for v in value:
+            yield np.asarray(v)
+    else:
+        yield np.asarray(value)
+
+
+def _checksum(value: Any) -> str:
+    """sha256 over dtype + shape + bytes of every array in the state value.
+
+    Dtype and shape participate so a reinterpret-cast or reshape of the same
+    bytes cannot masquerade as the original state.
+    """
+    h = hashlib.sha256()
+    for arr in _iter_arrays(value):
+        arr = np.ascontiguousarray(arr)
+        h.update(str(arr.dtype).encode())
+        h.update(repr(tuple(arr.shape)).encode())
+        h.update(arr.tobytes())
+    return h.hexdigest()
+
+
+def _all_finite(value: Any) -> bool:
+    """True when every floating array in the value is fully finite."""
+    for arr in _iter_arrays(value):
+        if np.issubdtype(arr.dtype, np.floating) and arr.size and not np.isfinite(arr).all():
+            return False
+    return True
+
+
+def _has_nan(value: Any) -> bool:
+    for arr in _iter_arrays(value):
+        if np.issubdtype(arr.dtype, np.floating) and arr.size and np.isnan(arr).any():
+            return True
+    return False
+
+
+def attach_integrity(destination: Dict[str, Any], keys: Iterable[str], prefix: str, metric_name: str) -> None:
+    """Write the integrity block for the states already serialized in ``destination``."""
+    states: Dict[str, Dict[str, Any]] = {}
+    for key in keys:
+        full = prefix + key
+        if full not in destination:
+            continue  # non-persistent state: nothing serialized, nothing to cover
+        value = destination[full]
+        states[key] = {"sha256": _checksum(value), "finite": _all_finite(value)}
+    destination[integrity_key(prefix)] = {
+        "version": INTEGRITY_VERSION,
+        "class": metric_name,
+        "states": states,
+    }
+
+
+def validate_version(meta: Dict[str, Any], metric_name: str) -> None:
+    """Raise on an unknown integrity-block schema version (nothing can load)."""
+    version = meta.get("version")
+    if version != INTEGRITY_VERSION:
+        raise StateCorruptionError(
+            f"Cannot restore {metric_name}: checkpoint integrity block has schema version"
+            f" {version!r} but this runtime understands version {INTEGRITY_VERSION}."
+            " The checkpoint is from an incompatible writer or its metadata is corrupted."
+        )
+
+
+def verify_states(
+    state_dict: Dict[str, Any],
+    prefix: str,
+    meta: Dict[str, Any],
+    metric_name: str,
+    include_missing: bool = True,
+) -> Dict[str, str]:
+    """Verify one metric's states against its integrity block.
+
+    Returns ``{state_name: reason}`` for every corrupted state. Raises
+    :class:`StateCorruptionError` on an unknown schema version (a corrupted
+    or future block cannot be meaningfully verified, so nothing loads).
+    ``include_missing=False`` skips block-covered keys absent from the
+    checkpoint — ``load_state_dict(strict=False)``'s tolerate-missing
+    contract must keep holding for deliberately filtered checkpoints.
+    """
+    validate_version(meta, metric_name)
+    corrupted: Dict[str, str] = {}
+    for key, entry in meta.get("states", {}).items():
+        full = prefix + key
+        if full not in state_dict:
+            if include_missing:
+                corrupted[key] = "state covered by the integrity block is missing from the checkpoint"
+            continue
+        value = state_dict[full]
+        if _checksum(value) != entry.get("sha256"):
+            corrupted[key] = "checksum mismatch (bytes differ from what was saved)"
+        elif entry.get("finite", True) and _has_nan(value):
+            # unreachable when the checksum matched, but kept as defense in
+            # depth for blocks regenerated by tools that skip finiteness
+            corrupted[key] = "NaN values in a state recorded as finite at save time"
+    return corrupted
+
+
+def screen_nonfinite(state_dict: Dict[str, Any], prefix: str, keys: Iterable[str]) -> Dict[str, str]:
+    """Best-effort NaN screen for checkpoints without an integrity block.
+
+    Only NaN is flagged (not ±Inf): min/max accumulators legitimately persist
+    infinite sentinels, while NaN in any state poisons every downstream
+    ``compute``.
+    """
+    corrupted: Dict[str, str] = {}
+    for key in keys:
+        full = prefix + key
+        if full in state_dict and _has_nan(state_dict[full]):
+            corrupted[key] = "NaN values in restored state (checkpoint has no integrity block)"
+    return corrupted
+
+
+def raise_corrupted(metric_name: str, corrupted: Dict[str, str]) -> None:
+    detail = "; ".join(f"`{k}`: {v}" for k, v in sorted(corrupted.items()))
+    raise StateCorruptionError(
+        f"Refusing to restore corrupted state_dict into {metric_name} — {len(corrupted)}"
+        f" state(s) failed integrity verification: {detail}. Pass `strict=\"repair\"` to"
+        " reset only the corrupted states to their defaults and load the rest.",
+        corrupted=corrupted,
+    )
+
+
+# ---------------------------------------------------------------------------
+# live-state sentinels (the `nan_policy` update guard)
+# ---------------------------------------------------------------------------
+
+
+def _default_is_finite(default: Any) -> bool:
+    if isinstance(default, (list, RingBuffer)):
+        return True  # empty containers: treat appended data as finite-by-default
+    arr = np.asarray(default)
+    if not np.issubdtype(arr.dtype, np.floating) or not arr.size:
+        return True
+    return bool(np.isfinite(arr).all())
+
+
+def _state_arrays(value: Any, list_from: int = 0) -> List[np.ndarray]:
+    if isinstance(value, RingBuffer):
+        return [np.asarray(value.values())] if value.num_valid else []
+    if isinstance(value, list):
+        return [np.asarray(v) for v in value[list_from:]]
+    return [np.asarray(value)]
+
+
+def nonfinite_state_report(
+    metric: Any, list_scan_from: Optional[Dict[str, int]] = None
+) -> Dict[str, str]:
+    """``{state_name: "nan"|"inf"}`` over the metric's live states.
+
+    NaN always counts. ±Inf counts only when the state's registered default
+    is fully finite — min/max states seeded with ±Inf sentinels are exempt.
+    This is a host readback (one device→host sync per floating state); it
+    runs only when a ``nan_policy`` is enabled on the metric.
+
+    ``list_scan_from`` maps list-state names to the index their scan starts
+    at (the pre-update length): append-mode streams then pay per-batch cost
+    proportional to the batch, not the whole accumulated history.
+    """
+    report: Dict[str, str] = {}
+    for name, default in metric._defaults.items():
+        value = getattr(metric, name)
+        inf_counts = _default_is_finite(default)
+        list_from = (list_scan_from or {}).get(name, 0)
+        for arr in _state_arrays(value, list_from):
+            if not np.issubdtype(arr.dtype, np.floating) or not arr.size:
+                continue
+            if np.isnan(arr).any():
+                report[name] = "nan"
+                break
+            if inf_counts and np.isinf(arr).any():
+                report[name] = "inf"
+                break
+    return report

@@ -251,8 +251,18 @@ class MetricCollection:
 
     # -------------------------------------------------------------- maintenance
     def reset(self) -> None:
+        # a member's reset() may surface its pending deferred violation
+        # (clear-then-raise): every member must still get reset, so one
+        # collection.reset() call both cleans everything and raises the
+        # first violation — not one call per violating member
+        pending: Optional[BaseException] = None
         for m in self._modules.values():
-            m.reset()
+            try:
+                m.reset()
+            except RuntimeError as err:
+                pending = pending or err
+        if pending is not None:
+            raise pending
 
     def clone(self, prefix: Optional[str] = None, postfix: Optional[str] = None) -> "MetricCollection":
         mc = deepcopy(self)
@@ -266,15 +276,68 @@ class MetricCollection:
         for m in self._modules.values():
             m.persistent(mode)
 
-    def state_dict(self, prefix: str = "") -> Dict[str, Any]:
+    def state_dict(self, prefix: str = "", integrity: bool = False) -> Dict[str, Any]:
         destination: Dict[str, Any] = {}
         for name, m in self._modules.items():
-            m.state_dict(destination, prefix=f"{prefix}{name}.")
+            m.state_dict(destination, prefix=f"{prefix}{name}.", integrity=integrity)
         return destination
 
-    def load_state_dict(self, state_dict: Dict[str, Any], strict: bool = True, prefix: str = "") -> None:
+    def load_state_dict(
+        self, state_dict: Dict[str, Any], strict: Union[bool, str] = True, prefix: str = ""
+    ) -> None:
+        """Restore member states; ``strict="repair"`` resets corrupted states only.
+
+        Each member verifies its own integrity block (when present) under its
+        ``{prefix}{name}.`` namespace. Verification of ALL members runs
+        before ANY member loads, so a corrupted later member cannot leave the
+        collection half-restored: either the whole load proceeds (repairing
+        under ``strict="repair"``) or it raises with every member untouched.
+        """
+        from torchmetrics_tpu._resilience import integrity as _integrity
+
+        if strict != "repair":
+            corrupted_all: Dict[str, str] = {}
+            for name, m in self._modules.items():
+                member_prefix = f"{prefix}{name}."
+                meta = state_dict.get(_integrity.integrity_key(member_prefix))
+                if meta is not None:
+                    bad = _integrity.verify_states(
+                        state_dict, member_prefix, meta, type(m).__name__,
+                        include_missing=strict is not False,
+                    )
+                    corrupted_all.update({f"{name}.{k}": v for k, v in bad.items()})
+            if corrupted_all:
+                _integrity.raise_corrupted(f"MetricCollection(prefix={prefix!r})", corrupted_all)
+            # the pre-pass hashed every state: members skip re-verification
+            for name, m in self._modules.items():
+                m.load_state_dict(state_dict, strict=strict, prefix=f"{prefix}{name}.", _verified=True)
+            return
+        # repair mode: member verification never raises EXCEPT on an unknown
+        # schema version — validate every block up front so a bad block on a
+        # later member cannot abort the loop after earlier members loaded
+        for name, m in self._modules.items():
+            meta = state_dict.get(_integrity.integrity_key(f"{prefix}{name}."))
+            if meta is not None:
+                _integrity.validate_version(meta, type(m).__name__)
         for name, m in self._modules.items():
             m.load_state_dict(state_dict, strict=strict, prefix=f"{prefix}{name}.")
+
+    # ------------------------------------------------------------- resilience
+    def set_resilience_policy(self, **kwargs: Any) -> "MetricCollection":
+        """Fan a resilience-policy change out to every member metric.
+
+        Accepts the same keyword arguments as ``Metric.set_resilience_policy``
+        (``sync_policy``, ``nan_policy``); only the arguments passed change.
+        Compute-group heads and members share policies, so degradation
+        semantics stay uniform within a group.
+        """
+        for m in self._modules.values():
+            m.set_resilience_policy(**kwargs)
+        return self
+
+    def resilience_report(self) -> Dict[str, Any]:
+        """Per-member resilience reports, keyed like :meth:`compute` results."""
+        return {self._set_name(name): m.resilience_report() for name, m in self._modules.items()}
 
     def set_dtype(self, dst_type: Any) -> "MetricCollection":
         for m in self._modules.values():

@@ -23,6 +23,7 @@ from __future__ import annotations
 
 import functools
 import inspect
+import itertools
 from abc import ABC, abstractmethod
 from copy import deepcopy
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -56,6 +57,9 @@ _STR_REDUCTIONS = {
     "min": dim_zero_min,
     "cat": dim_zero_cat,
 }
+
+# "argument not passed" sentinel for partial policy updates
+_UNSET = object()
 
 
 def _is_array(x: Any) -> bool:
@@ -159,6 +163,18 @@ class Metric(ABC):
                 "Expected keyword argument `cat_state_capacity` to be `None` or a positive integer"
                 f" but got {self.cat_state_capacity}"
             )
+        # resilience knobs (torchmetrics_tpu/_resilience, RESILIENCE.md):
+        # `sync_policy` opts the eager multi-host sync into the guarded path
+        # (handshake + timeout/retry/backoff + graceful degradation);
+        # `nan_policy` arms the NaN/Inf state sentinel after every eager
+        # update. An EXPLICIT `sync_policy=None` opts out of the process-wide
+        # default policy; omitting the kwarg inherits it.
+        self._sync_policy_explicit = "sync_policy" in kwargs
+        self.sync_policy = kwargs.pop("sync_policy", None)
+        self.nan_policy = kwargs.pop("nan_policy", None)
+        self._validate_resilience_knobs()
+        self._resilience_events: List[Any] = []
+        self._quarantined_updates: int = 0
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -259,6 +275,9 @@ class Metric(ABC):
             self._defaults[name] = list(default) if is_list else default
         self._persistent[name] = persistent
         self._reductions[name] = dist_reduce_fx
+        # registering a state changes the cross-process structure contract:
+        # the next guarded sync must re-run the handshake
+        self.__dict__.pop("_handshake_ok_digest", None)
 
     # --------------------------------------------------------------- forward
     def __call__(self, *args: Any, **kwargs: Any) -> Any:
@@ -287,13 +306,36 @@ class Metric(ABC):
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
         """Double-update path (reference ``metric.py:308-351``)."""
         self.update(*args, **kwargs)
+        if self.nan_policy == "quarantine" and self.__dict__.get("_nan_last_quarantined"):
+            # the NaN sentinel dropped this batch from the global state;
+            # skip the batch-value replay entirely — it would re-update (and
+            # re-record the quarantine) and then compute on an empty state
+            return None
         self._to_sync = self.dist_sync_on_step
 
         cache = self._copy_state_dict()
         update_count = self._update_count
-        self.reset()
-        self.update(*args, **kwargs)
-        batch_val = self.compute()
+        try:
+            self.reset()
+            # the batch-only replay must not advance the NaN-sentinel stream
+            # ordinal a second time (the first update above already did)
+            self.__dict__["_nan_replay"] = True
+            try:
+                self.update(*args, **kwargs)
+            finally:
+                self.__dict__.pop("_nan_replay", None)
+            batch_val = self.compute()
+        except Exception:
+            # reset() may surface a pending deferred violation (it clears,
+            # resets, THEN raises), and the batch replay may fail validation:
+            # either way the accumulated state lives only in the local above
+            # and must be restored before propagating
+            self._update_count = update_count
+            self._restore_state(cache)
+            self._computed = None
+            self._is_synced = False
+            self._to_sync = self.sync_on_compute
+            raise
 
         # restore global state
         self._update_count = update_count
@@ -308,16 +350,47 @@ class Metric(ABC):
         """Single-update path (reference ``metric.py:353-391``)."""
         global_state = self._copy_state_dict()
         update_count = self._update_count
-        self.reset()
+        try:
+            self.reset()
+        except Exception:
+            # reset() surfaces pending deferred violations AFTER resetting:
+            # restore the accumulation (stashed only in the local above)
+            # before propagating
+            self._update_count = update_count
+            self._restore_state(global_state)
+            raise
 
         self._to_sync = self.dist_sync_on_step
         self._should_unsync = False
 
-        self.update(*args, **kwargs)
-        batch_val = self.compute()
+        try:
+            self.update(*args, **kwargs)
+            quarantined = self.nan_policy == "quarantine" and self.__dict__.get("_nan_last_quarantined")
+            # a quarantined batch's state was rolled back to reset-empty:
+            # computing on it would crash cat-state metrics ("no samples to
+            # concatenate"), so the dropped batch yields no batch value
+            batch_val = None if quarantined else self.compute()
+        except Exception:
+            # the batch failed validation (or the NaN sentinel raised): the
+            # accumulated global state lives only in the local above, so it
+            # must be restored before propagating — otherwise one bad batch
+            # destroys the whole accumulation
+            self._update_count = update_count
+            self._restore_state(global_state)
+            self._should_unsync = True
+            self._to_sync = self.sync_on_compute
+            self._computed = None
+            self._is_synced = False
+            raise
 
-        self._update_count = update_count + 1
-        self._reduce_states(global_state)
+        if quarantined:
+            # restore the global state untouched: merging the rolled-back
+            # defaults would contaminate mean-reduced states
+            self._update_count = update_count
+            self._restore_state(global_state)
+        else:
+            self._update_count = update_count + 1
+            self._reduce_states(global_state)
 
         self._should_unsync = True
         self._to_sync = self.sync_on_compute
@@ -405,6 +478,25 @@ class Metric(ABC):
                 # duration of the update, so a freed-and-reallocated object
                 # cannot alias a stale id in the comparison
                 before, _keepalive = self._host_attr_snapshot()
+            # quarantine is the only nan_policy needing a rollback point; the
+            # pre-update list lengths let the sentinel scan only the elements
+            # THIS batch appended (cat-state streams stay O(batch), not O(n))
+            pre_state = pre_lens = None
+            if self.nan_policy is not None:
+                # stream-position ordinal for sentinel telemetry: forward()'s
+                # stash/reset dance makes `_update_count` batch-local, so the
+                # recorded "which batch was dropped" needs its own counter
+                # (the full-state forward's batch-only replay doesn't count)
+                if not self.__dict__.get("_nan_replay"):
+                    self.__dict__["_nan_seen_batches"] = self.__dict__.get("_nan_seen_batches", 0) + 1
+                pre_lens = {}
+                for n in self._defaults:
+                    v = getattr(self, n)
+                    if isinstance(v, list):
+                        pre_lens[n] = len(v)
+                if self.nan_policy == "quarantine":
+                    pre_state = self._quarantine_snapshot()
+                    self.__dict__["_nan_last_quarantined"] = False
             update(*args, **kwargs)
             if guard and self._host_attr_snapshot()[0] != before:
                 # update() mutates plain (unregistered) python attributes; a
@@ -412,6 +504,8 @@ class Metric(ABC):
                 # the compiled paths are permanently off for this instance
                 self._auto_disabled = True
                 self._auto_forward_disabled = True
+            if self.nan_policy is not None:
+                self._guard_nonfinite_states(pre_state, pre_lens)
             if self._dtype_policy is not None:
                 self._apply_dtype_policy()
             if self.compute_on_cpu:
@@ -479,8 +573,23 @@ class Metric(ABC):
                 snap.append((k, id(v), tuple((fp(dk), fp(dv)) for dk, dv in v.items())))
             elif isinstance(v, (list, tuple)) and len(v) <= 16:
                 snap.append((k, id(v), tuple(fp(i) for i in v)))
-            elif isinstance(v, (list, dict, set, tuple)):
-                snap.append((k, id(v), len(v)))
+            elif isinstance(v, (list, tuple)):
+                # >16 entries: (id, len) alone misses same-length in-place
+                # mutation (ADVICE r5), so fold in a spread sample of elements
+                # — O(1) indexing keeps huge lists cheap to fingerprint
+                n = len(v)
+                idxs = sorted({0, 1, 2, n // 4, n // 2, (3 * n) // 4, n - 3, n - 2, n - 1})
+                snap.append((k, id(v), n, tuple((i, fp(v[i])) for i in idxs)))
+            elif isinstance(v, (dict, set)):
+                # unindexable containers: sample the first 8 entries (insertion
+                # order for dicts, hash order for sets — both stable while the
+                # container is unmutated). Mutations confined to unsampled
+                # entries remain out of the guard's reach; see docstring.
+                if isinstance(v, dict):
+                    sample = tuple((fp(dk), fp(dv)) for dk, dv in itertools.islice(v.items(), 8))
+                else:
+                    sample = tuple(fp(i) for i in itertools.islice(v, 8))
+                snap.append((k, id(v), len(v), sample))
             else:
                 keepalive.append(v)
                 snap.append((k, id(v)))
@@ -570,7 +679,16 @@ class Metric(ABC):
         should_sync: bool = True,
         distributed_available: Optional[Callable] = None,
     ) -> None:
-        """Gather + reduce state across processes (reference ``metric.py:490-532``)."""
+        """Gather + reduce state across processes (reference ``metric.py:490-532``).
+
+        With a :class:`~torchmetrics_tpu._resilience.policy.SyncPolicy`
+        attached (per-metric ``sync_policy`` or the process-wide default),
+        the gather runs guarded: structure handshake, per-attempt timeout,
+        retry with backoff, and — on exhaustion — graceful degradation to
+        local-only state with a recorded ``DegradationEvent`` instead of a
+        deadlock or an exception mid-eval. Without a policy the legacy
+        unguarded path runs unchanged.
+        """
         if self._is_synced and should_sync:
             raise TorchMetricsUserError("The Metric has already been synced.")
         if distributed_available is None and self.distributed_available_fn is not None:
@@ -580,14 +698,53 @@ class Metric(ABC):
             return
         if dist_sync_fn is None:
             dist_sync_fn = self.dist_sync_fn or gather_all_tensors
-        self._cache = self._copy_state_dict()
-        self._sync_dist(dist_sync_fn, process_group=process_group or self.process_group)
-        self._is_synced = True
+        self.__dict__.pop("_degraded_unsync_ok", None)  # stale pairing flag
+        group = process_group or self.process_group
+        policy = self.sync_policy
+        if policy is None and not self.__dict__.get("_sync_policy_explicit"):
+            # inherit the process-wide default only when the metric never
+            # expressed a choice: an explicit sync_policy=None means unguarded
+            from torchmetrics_tpu._resilience.policy import default_sync_policy
 
-    def _sync_dist(self, dist_sync_fn: Callable = gather_all_tensors, process_group: Optional[Any] = None) -> None:
-        """Reference ``metric.py:427-457``: pre-concat lists, gather, reduce."""
+            policy = default_sync_policy()
+        self._cache = self._copy_state_dict()
+        if policy is None:
+            self._sync_dist(dist_sync_fn, process_group=group)
+            self._is_synced = True
+            return
+        from torchmetrics_tpu._resilience.guard import guarded_metric_sync  # cached after first sync
+
+        try:
+            synced = guarded_metric_sync(self, dist_sync_fn, group, policy)
+        except Exception:
+            # on_exhausted="raise" or a handshake mismatch: leave the metric
+            # with its intact local state, never half-committed
+            self._restore_state(self._cache)
+            self._cache = None
+            self._is_synced = False
+            raise
+        if synced:
+            self._is_synced = True
+        else:
+            # degraded: retries exhausted — keep local-only state (the gather
+            # phase is pure, but restore from the cache anyway for overridden
+            # `_sync_dist` implementations that fuse gather and commit). The
+            # flag lets a manual sync()/unsync() pairing stay graceful: the
+            # paired unsync becomes a no-op instead of raising
+            self._restore_state(self._cache)
+            self._cache = None
+            self._is_synced = False
+            self.__dict__["_degraded_unsync_ok"] = True
+
+    def _dist_gather(self, dist_sync_fn: Callable, process_group: Optional[Any] = None) -> Dict[str, Any]:
+        """Gather every state across processes — pure read, no state mutation.
+
+        Kept side-effect-free so the guarded sync path can run it on a
+        watchdog worker thread: a timed-out, abandoned attempt that later
+        completes has nothing it can corrupt.
+        """
         input_dict = {attr: getattr(self, attr) for attr in self._reductions}
-        for attr, reduction_fn in self._reductions.items():
+        for attr in self._reductions:
             # ring buffers gather their live rows like a pre-concatenated list
             if isinstance(input_dict[attr], RingBuffer):
                 rb = input_dict[attr]
@@ -602,7 +759,14 @@ class Metric(ABC):
                 output_dict[attr] = _flatten_maybe([dist_sync_fn(v, process_group) for v in value])
             else:
                 output_dict[attr] = dist_sync_fn(value, process_group)
+        return output_dict
 
+    def _sync_dist(self, dist_sync_fn: Callable = gather_all_tensors, process_group: Optional[Any] = None) -> None:
+        """Reference ``metric.py:427-457``: pre-concat lists, gather, reduce."""
+        self._commit_gathered(self._dist_gather(dist_sync_fn, process_group))
+
+    def _commit_gathered(self, output_dict: Dict[str, Any]) -> None:
+        """Reduce gathered per-process states into this metric's states."""
         for attr, reduction_fn in self._reductions.items():
             gathered = output_dict[attr]
             if isinstance(gathered, list) and len(gathered) == 0:
@@ -620,6 +784,8 @@ class Metric(ABC):
         if not should_unsync:
             return
         if not self._is_synced:
+            if self.__dict__.pop("_degraded_unsync_ok", False):
+                return  # the paired sync() degraded to local-only: nothing to undo
             raise TorchMetricsUserError("The Metric has already been un-synced.")
         if self._cache is None:
             raise TorchMetricsUserError("The internal cache should exist to unsync the Metric.")
@@ -680,6 +846,180 @@ class Metric(ABC):
                 " `metric.sync_in_jit(state, 'dp', axis_index_groups=[[0, 1], [2, 3]])`."
             )
         return sync_in_jit(state, self._reductions, axis_name, axis_index_groups=axis_index_groups)
+
+    # ------------------------------------------------------------ resilience
+    def _validate_resilience_knobs(self) -> None:
+        from torchmetrics_tpu._resilience.policy import NAN_POLICIES, SyncPolicy
+
+        if self.sync_policy is not None and not isinstance(self.sync_policy, SyncPolicy):
+            raise ValueError(
+                f"Expected keyword argument `sync_policy` to be a `SyncPolicy` or None but got {self.sync_policy}"
+            )
+        if self.nan_policy not in NAN_POLICIES:
+            raise ValueError(
+                f"Expected keyword argument `nan_policy` to be one of {NAN_POLICIES} but got {self.nan_policy}"
+            )
+
+    def set_resilience_policy(self, sync_policy: Any = _UNSET, nan_policy: Any = _UNSET) -> "Metric":
+        """Attach/replace resilience policies after construction (chainable).
+
+        Only the arguments actually passed change; ``None`` explicitly
+        disables a policy. Replacing the sync policy invalidates the cached
+        handshake digest so the next guarded sync re-verifies structure.
+        """
+        old_sync, old_nan = self.sync_policy, self.nan_policy
+        if sync_policy is not _UNSET:
+            self.sync_policy = sync_policy
+        if nan_policy is not _UNSET:
+            self.nan_policy = nan_policy
+        try:
+            self._validate_resilience_knobs()
+        except ValueError:
+            # a rejected call must not leave the invalid value attached
+            self.sync_policy, self.nan_policy = old_sync, old_nan
+            raise
+        if sync_policy is not _UNSET:
+            # an explicit None here is an opt-out from the process default
+            self._sync_policy_explicit = True
+            self.__dict__.pop("_handshake_ok_digest", None)
+        return self
+
+    def resilience_report(self) -> Any:
+        """Degradation telemetry for this metric (RESILIENCE.md).
+
+        Returns a :class:`~torchmetrics_tpu._resilience.policy.ResilienceReport`
+        with every recorded ``DegradationEvent`` (degraded syncs, quarantined
+        batches, repaired restores). Events survive ``reset()`` — they are
+        operational telemetry about the stream, not metric state.
+        """
+        from torchmetrics_tpu._resilience.policy import ResilienceReport
+
+        return ResilienceReport(
+            metric=type(self).__name__,
+            events=tuple(self.__dict__.get("_resilience_events", ())),
+            quarantined_updates=self.__dict__.get("_quarantined_updates", 0),
+            dropped_events=self.__dict__.get("_resilience_events_dropped", 0),
+        )
+
+    def _record_degradation(self, kind: str, detail: str, attempts: int = 0) -> None:
+        from torchmetrics_tpu._resilience.policy import MAX_EVENTS, DegradationEvent
+        from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserWarning
+
+        event = DegradationEvent(kind=kind, metric=type(self).__name__, detail=detail, attempts=attempts)
+        events = self.__dict__.setdefault("_resilience_events", [])
+        events.append(event)
+        if len(events) > MAX_EVENTS:
+            # a permanently-degraded long-running job records one event per
+            # sync: cap the log, keep the eviction count in the report
+            evict = len(events) - MAX_EVENTS
+            del events[:evict]
+            self.__dict__["_resilience_events_dropped"] = (
+                self.__dict__.get("_resilience_events_dropped", 0) + evict
+            )
+        rank_zero_warn(
+            f"{type(self).__name__} degraded ({kind}): {detail} — see `Metric.resilience_report()`.",
+            TorchMetricsUserWarning,
+        )
+
+    def _quarantine_snapshot(self) -> Dict[str, Any]:
+        """Cheap rollback point for the NaN quarantine.
+
+        jax array states are immutable, so they are kept by reference; list
+        states need only a shallow copy (their elements cannot change, a
+        rollback just restores the old list object's contents); ring buffers
+        mutate in place and get a real copy.
+        """
+        snap: Dict[str, Any] = {}
+        for attr in self._defaults:
+            v = getattr(self, attr)
+            if isinstance(v, RingBuffer):
+                snap[attr] = v.copy()
+            elif isinstance(v, list):
+                snap[attr] = list(v)
+            else:
+                snap[attr] = v
+        return snap
+
+    def _guard_nonfinite_states(
+        self, pre_state: Optional[Dict[str, Any]], pre_lens: Optional[Dict[str, int]] = None
+    ) -> None:
+        """NaN/Inf sentinel after an eager update (the ``nan_policy`` knob).
+
+        ``raise`` surfaces the poisoned state immediately (state left as-is
+        so it can be inspected; ``reset()`` clears it); ``warn`` only warns;
+        ``quarantine`` rolls the whole update back — one bad batch then
+        contributes nothing, mirroring how the compiled validate-args path
+        drops violating batches.
+
+        ``pre_lens`` (per-list-state pre-update lengths) limits the scan to
+        the chunks this batch appended, keeping cat-state streams O(batch)
+        per update. An update that rewrites *existing* list entries (rare:
+        appends and whole-array rebinds are the idioms here) is outside the
+        incremental scan's reach.
+        """
+        from torchmetrics_tpu._resilience.integrity import nonfinite_state_report
+        from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserWarning
+
+        if not self._defaults:
+            # wrapper/compositional metrics hold their accumulators in child
+            # metrics: the sentinel has nothing to guard, and silence here
+            # would read as protection — say so once
+            if not self.__dict__.get("_nan_policy_noop_warned"):
+                self.__dict__["_nan_policy_noop_warned"] = True
+                rank_zero_warn(
+                    f"`nan_policy={self.nan_policy!r}` on {type(self).__name__} guards nothing:"
+                    " this metric registers no states of its own (wrappers and compositions hold"
+                    " their accumulators in child metrics). Set `nan_policy` on the wrapped"
+                    " metric(s) instead.",
+                    TorchMetricsUserWarning,
+                )
+            return
+        bad = nonfinite_state_report(self, list_scan_from=pre_lens)
+        if not bad:
+            return
+        desc = ", ".join(f"`{k}` ({v})" for k, v in sorted(bad.items()))
+        batch = self.__dict__.get("_nan_seen_batches", self._update_count)
+        policy = self.nan_policy
+        if policy == "raise":
+            raise RuntimeError(
+                f"Non-finite values detected in state(s) {desc} of {type(self).__name__} after"
+                f" guarded batch {batch} (`nan_policy='raise'`). The state is poisoned:"
+                " every downstream `compute()` would silently return garbage. Call `reset()`,"
+                " or use `nan_policy='quarantine'` to drop bad batches automatically."
+            )
+        if policy == "warn":
+            rank_zero_warn(
+                f"Non-finite values detected in state(s) {desc} of {type(self).__name__} after"
+                f" guarded batch {batch} (`nan_policy='warn'`): downstream `compute()`"
+                " results are now suspect.",
+                TorchMetricsUserWarning,
+            )
+            return
+        # quarantine: roll back this batch's contribution
+        if pre_state is None:
+            return
+        self._restore_state(pre_state)
+        still_bad = nonfinite_state_report(self, list_scan_from=pre_lens)
+        if still_bad:
+            # the poison predates this batch (policy enabled mid-stream):
+            # rollback cannot recover — surface it instead of looping forever
+            rank_zero_warn(
+                f"State(s) {desc} of {type(self).__name__} were already non-finite before this"
+                " update; `nan_policy='quarantine'` cannot recover a pre-poisoned metric —"
+                " call `reset()`.",
+                TorchMetricsUserWarning,
+            )
+            return
+        self._update_count -= 1
+        self._computed = None
+        # `forward`'s reduce-state path consults this flag so a dropped batch
+        # is not merged into the stashed global state either
+        self.__dict__["_nan_last_quarantined"] = True
+        self.__dict__["_quarantined_updates"] = self.__dict__.get("_quarantined_updates", 0) + 1
+        self._record_degradation(
+            "nan_quarantine",
+            detail=f"guarded batch {batch} produced non-finite state(s) {desc}; batch dropped",
+        )
 
     # ------------------------------------------------------- compiled update
     def _fixed_shape_state_names(self, method_name: str) -> Optional[List[str]]:
@@ -834,6 +1174,9 @@ class Metric(ABC):
             self.auto_compile
             and not self._auto_disabled
             and not self.compute_on_cpu
+            # the NaN sentinel is a per-batch host readback over the states —
+            # it must observe every eager update, so it pins the eager path
+            and self.nan_policy is None
             and (getattr(self, "validate_args", None) is not True or self._supports_traced_validation())
         )
 
@@ -1269,20 +1612,39 @@ class Metric(ABC):
 
     # ---------------------------------------------------------------- reset
     def reset(self) -> None:
-        """Reset states to their defaults (reference ``metric.py:673-688``)."""
-        self._check_pending_violations()
+        """Reset states to their defaults (reference ``metric.py:673-688``).
+
+        A pending deferred violation (compiled ``validate_args=True`` path)
+        still surfaces here, but only *after* the state reset: one ``reset()``
+        call both raises the error and leaves a clean metric, instead of
+        aborting mid-way and requiring a second call (ADVICE r5).
+        """
+        pending: Optional[BaseException] = None
+        try:
+            self._check_pending_violations()
+        except RuntimeError as err:  # flags already cleared by the check
+            pending = err
         self._update_count = 0
         self._forward_cache = None
         self._computed = None
-        for attr, default in self._defaults.items():
-            if isinstance(default, RingBuffer):
-                setattr(self, attr, default.copy_empty())
-            elif isinstance(default, list):
-                setattr(self, attr, [])
-            else:
-                setattr(self, attr, jnp.array(default))
+        for attr in self._defaults:
+            self._reset_state_to_default(attr)
         self._cache = None
         self._is_synced = False
+        if pending is not None:
+            raise pending
+
+    def _reset_state_to_default(self, attr: str) -> None:
+        """Rebind one registered state to its default (shared by ``reset``
+        and ``load_state_dict(strict="repair")`` so repair can never restore
+        a state differently than reset would)."""
+        default = self._defaults[attr]
+        if isinstance(default, RingBuffer):
+            setattr(self, attr, default.copy_empty())
+        elif isinstance(default, list):
+            setattr(self, attr, [])
+        else:
+            setattr(self, attr, jnp.array(default))
 
     def clone(self) -> "Metric":
         """Deep copy of the metric (reference ``metric.py:690-692``)."""
@@ -1310,8 +1672,21 @@ class Metric(ABC):
         for key in self._persistent:
             self._persistent[key] = mode
 
-    def state_dict(self, destination: Optional[Dict] = None, prefix: str = "", keep_vars: bool = False) -> Dict:
-        """Serialize persistent states to host numpy (reference ``metric.py:839-871``)."""
+    def state_dict(
+        self,
+        destination: Optional[Dict] = None,
+        prefix: str = "",
+        keep_vars: bool = False,
+        integrity: bool = False,
+    ) -> Dict:
+        """Serialize persistent states to host numpy (reference ``metric.py:839-871``).
+
+        ``integrity=True`` additionally writes a checksummed, versioned
+        metadata block under the non-identifier key ``{prefix}#integrity``
+        (see ``torchmetrics_tpu/_resilience/integrity.py``): restores then
+        verify per-state checksums and the schema version, rejecting corrupt
+        or NaN-poisoned checkpoints instead of silently loading them.
+        """
         destination = {} if destination is None else destination
         for key in self._defaults:
             if not self._persistent[key]:
@@ -1323,11 +1698,56 @@ class Metric(ABC):
                 destination[prefix + key] = [np.asarray(v) for v in current]
             else:
                 destination[prefix + key] = np.asarray(current)
+        if integrity:
+            from torchmetrics_tpu._resilience.integrity import attach_integrity
+
+            attach_integrity(destination, list(self._defaults), prefix, type(self).__name__)
         return destination
 
-    def load_state_dict(self, state_dict: Dict, strict: bool = True, prefix: str = "") -> None:
-        """Restore states from a :meth:`state_dict` mapping (symmetric with its ``prefix``)."""
+    def load_state_dict(
+        self,
+        state_dict: Dict,
+        strict: Union[bool, str] = True,
+        prefix: str = "",
+        _verified: bool = False,
+    ) -> None:
+        """Restore states from a :meth:`state_dict` mapping (symmetric with its ``prefix``).
+
+        When the checkpoint carries an integrity block (saved with
+        ``state_dict(integrity=True)``) every covered state is verified
+        before anything loads: checksum mismatches, unknown schema versions,
+        and NaN-poisoned payloads raise
+        :class:`~torchmetrics_tpu._resilience.errors.StateCorruptionError`
+        with the offending state names. ``strict="repair"`` instead resets
+        only the corrupted states to their registered defaults, loads the
+        rest, and records a ``state_repair`` degradation event (it also
+        NaN-screens checkpoints without an integrity block).
+        """
+        corrupted: Dict[str, str] = {}
+        from torchmetrics_tpu._resilience import integrity as _integrity
+
+        meta = state_dict.get(_integrity.integrity_key(prefix))
+        if meta is not None and _verified:
+            pass  # the caller (MetricCollection's atomic pre-pass) already hashed every state
+        elif meta is not None:
+            corrupted = _integrity.verify_states(
+                state_dict,
+                prefix,
+                meta,
+                type(self).__name__,
+                # strict=False tolerates missing keys by contract (filtered/
+                # partial checkpoints); present-but-corrupt states still raise
+                include_missing=strict is not False,
+            )
+        elif strict == "repair":
+            corrupted = _integrity.screen_nonfinite(state_dict, prefix, list(self._defaults))
+        if corrupted and strict != "repair":
+            _integrity.raise_corrupted(type(self).__name__, corrupted)
         for key in self._defaults:
+            if key in corrupted:
+                # repair: only the corrupted state goes back to its default
+                self._reset_state_to_default(key)
+                continue
             if prefix + key in state_dict:
                 val = state_dict[prefix + key]
                 if isinstance(self._defaults[key], RingBuffer):
@@ -1349,8 +1769,25 @@ class Metric(ABC):
                     setattr(self, key, [arr] if arr.size else [])
                 else:
                     setattr(self, key, jnp.asarray(val))
+            elif strict == "repair" and self._persistent[key]:
+                # repair semantics must not depend on whether an integrity
+                # block survived: a missing persistent state is repaired to
+                # its default, same as a block-flagged missing one
+                corrupted[key] = "missing from the checkpoint"
+                self._reset_state_to_default(key)
             elif strict and self._persistent[key]:
                 raise KeyError(f"Missing key {key!r} in state_dict for {self.__class__.__name__}")
+        if corrupted:  # strict == "repair"
+            self._record_degradation(
+                "state_repair",
+                detail=(
+                    "load_state_dict(strict=\"repair\") reset corrupted state(s) to defaults: "
+                    + "; ".join(f"`{k}`: {v}" for k, v in sorted(corrupted.items()))
+                ),
+            )
+            self._computed = None
+        # restored dtypes/shapes may differ from what the last handshake saw
+        self.__dict__.pop("_handshake_ok_digest", None)
 
     def __getstate__(self) -> Dict[str, Any]:
         """Pickle support: drop wrapped bound methods, numpy-ify arrays (reference ``metric.py:694-702``)."""
@@ -1411,6 +1848,12 @@ class Metric(ABC):
         self._auto_sigs = {}
         self._auto_fwd_sigs = {}
         self._auto_names = None
+        # pickles written before the resilience subsystem lack these knobs
+        self.__dict__.setdefault("sync_policy", None)
+        self.__dict__.setdefault("nan_policy", None)
+        self.__dict__.setdefault("_sync_policy_explicit", False)
+        self.__dict__.setdefault("_resilience_events", [])
+        self.__dict__.setdefault("_quarantined_updates", 0)
 
     def __setattr__(self, name: str, value: Any) -> None:
         """Class-flag immutability guard (reference ``metric.py:715-726``)."""
@@ -1434,6 +1877,9 @@ class Metric(ABC):
     def set_dtype(self, dst_type: Any) -> "Metric":
         """Cast floating states to ``dst_type`` (reference ``metric.py:770-780``)."""
         self._dtype_policy = dst_type
+        # state dtypes are part of the cross-process structure contract: the
+        # next guarded sync must re-run the handshake
+        self.__dict__.pop("_handshake_ok_digest", None)
         for attr in self._defaults:
             current = getattr(self, attr)
             if isinstance(current, RingBuffer):
@@ -1694,10 +2140,17 @@ class CompositionalMetric(Metric):
         return self._forward_cache
 
     def reset(self) -> None:
-        if isinstance(self.metric_a, Metric):
-            self.metric_a.reset()
-        if isinstance(self.metric_b, Metric):
-            self.metric_b.reset()
+        # reset BOTH children even when one surfaces a pending deferred
+        # violation from its own reset (clear-then-raise contract)
+        pending: Optional[BaseException] = None
+        for child in (self.metric_a, self.metric_b):
+            if isinstance(child, Metric):
+                try:
+                    child.reset()
+                except RuntimeError as err:
+                    pending = pending or err
+        if pending is not None:
+            raise pending
 
     def persistent(self, mode: bool = False) -> None:
         if isinstance(self.metric_a, Metric):

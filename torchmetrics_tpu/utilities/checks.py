@@ -77,13 +77,26 @@ def _num_samples_check(preds: Array, target: Array) -> None:
 
 
 def _target_set_value_flags(target: Array, ignore_index: Optional[int] = None):
-    """Flag for "target values outside {0, 1} (∪ ignore_index)"."""
+    """Flag for "target values outside {0, 1} (∪ ignore_index)".
+
+    The message prefix ("Detected the following values in `target` ...
+    expected only ...") deliberately matches the eager/reference wording
+    (``stat_scores.py``), so code matching the reference's message pattern
+    catches both the eager raise and this deferred one. The offending value
+    list itself is necessarily omitted: this check runs fused on-device
+    inside the compiled update, where the values cannot be read back without
+    the host sync the fused path exists to avoid.
+    """
     target = jnp.asarray(target)
     allowed = {0, 1} if ignore_index is None else {0, 1, ignore_index}
     ok = (target == 0) | (target == 1)
     if ignore_index is not None:
         ok = ok | (target == ignore_index)
-    msgs = (f"Detected values in `target` outside of the expected set {sorted(allowed)}.",)
+    msgs = (
+        "Detected the following values in `target` outside of the expected set, but expected"
+        f" only the following values {sorted(allowed)} (offending value list omitted: check"
+        " ran fused on-device).",
+    )
     return msgs, jnp.any(~ok)[None]
 
 

@@ -60,12 +60,39 @@ def class_reduce(
 # ---------------------------------------------------------------------------
 
 
+# Transport seam: every eager collective flows through `process_allgather`,
+# so the resilience harness (torchmetrics_tpu/_resilience/faultinject.py) can
+# simulate worlds, failures, and stalls by patching these two module globals —
+# the code path under test stays byte-identical to the real multi-host one.
+_world_override: Optional[int] = None  # simulated world size (None = real)
+_transport: Optional[Callable[[Any], Any]] = None  # transport override (None = real)
+
+
+def _default_transport(x: Any) -> Any:
+    from jax.experimental import multihost_utils
+
+    return multihost_utils.process_allgather(x)
+
+
+def process_allgather(x: Any) -> Any:
+    """All-gather ``x`` across processes (leading world axis on every leaf)."""
+    fn = _transport if _transport is not None else _default_transport
+    return fn(x)
+
+
+def world_size() -> int:
+    """Number of participating processes (honors the simulated-world override)."""
+    if _world_override is not None:
+        return _world_override
+    try:
+        return jax.process_count()
+    except Exception:
+        return 1
+
+
 def distributed_available() -> bool:
     """True when more than one JAX process participates (multi-host)."""
-    try:
-        return jax.process_count() > 1
-    except Exception:
-        return False
+    return world_size() > 1
 
 
 def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array]:
@@ -85,11 +112,9 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
     if not distributed_available():
         return [result]
 
-    from jax.experimental import multihost_utils
-
     result = jnp.asarray(result)
     local_shape = jnp.asarray(result.shape, dtype=jnp.int32)
-    all_shapes = multihost_utils.process_allgather(local_shape)  # (world, ndim)
+    all_shapes = process_allgather(local_shape)  # (world, ndim)
     import numpy as np
 
     all_shapes = np.asarray(all_shapes)
@@ -103,13 +128,13 @@ def gather_all_tensors(result: Array, group: Optional[Any] = None) -> List[Array
         members = list(range(all_shapes.shape[0]))
 
     if (all_shapes == all_shapes[0]).all():
-        gathered = multihost_utils.process_allgather(result)
+        gathered = process_allgather(result)
         return [jnp.asarray(gathered[i]) for i in members]
 
     max_shape = all_shapes.max(axis=0)
     pad = [(0, int(m - s)) for m, s in zip(max_shape, result.shape)]
     padded = jnp.pad(result, pad)
-    gathered = multihost_utils.process_allgather(padded)
+    gathered = process_allgather(padded)
     out = []
     for i in members:
         slices = tuple(slice(0, int(d)) for d in all_shapes[i])

@@ -202,13 +202,18 @@ class BootStrapper(WrapperMetric):
             self._fast_disabled = True
             return False
         size = dims.pop()
+        if size == 1 and not self._fast_checked_sizes:
+            # a size-1 batch passes the additivity check trivially for ANY
+            # metric (full delta == the one per-sample delta), yet the count
+            # matmul still scales that delta by the resample count k — which
+            # only equals updating on k repeated samples when the update IS
+            # sample-additive (ADVICE r5). So size-1 batches ride the loop
+            # path until some size>1 batch has actually passed the check;
+            # they never license the fast path themselves.
+            return False
         try:
-            # the check is keyed per batch size: a size-1 batch passes it
-            # trivially for ANY metric (full delta == the one per-sample
-            # delta), so it must never license larger batches. Size 1 itself
-            # needs no check — for sum states, k resamples of the single
-            # sample contribute exactly k*delta, which is what the count
-            # matmul computes.
+            # the check is keyed per batch size, and only size>1 passes
+            # license anything (see above)
             if size > 1 and size not in self._fast_checked_sizes:
                 if not self._additivity_holds(names, treedef, statics, dynamic):
                     self._fast_disabled = True
