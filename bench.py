@@ -1196,6 +1196,103 @@ def _bench_resilience_guard() -> tuple:
     return pair_ratio / p_med, 1.0 / p_med
 
 
+# --------------------------------------------------------------------- #
+# resilience: snapshot journal-hook hot-path overhead                     #
+# (torchmetrics_tpu/_resilience/snapshot.py — RESILIENCE.md)              #
+# --------------------------------------------------------------------- #
+
+SNAP_BENCH_UPDATES = 16  # updates per timed cycle — short, so pair members sit adjacent in time
+SNAP_BENCH_REPS = 240  # interleaved cycle pairs
+
+
+def _bench_snapshot_overhead() -> tuple:
+    """(hooked updates/sec, plain updates/sec, journaling updates/sec).
+
+    One cycle = ``SNAP_BENCH_UPDATES`` eager ``update()`` calls on a
+    MeanSquaredError. The hooked side carries an attached-but-paused
+    SnapshotManager — snapshots disabled, exactly the journal hook's inline
+    dispatch on the hot path (the ISSUE-5 acceptance bar: retention >= 0.97);
+    the plain side is the production default with no manager (hook probe
+    only). Both sides run on the caller's thread with a synchronous-write
+    policy, so no secondary thread is in play (the container's scheduler
+    throttles those by 15-60% — measuring them would bench the container,
+    not the hook). Paired-interleaved per-pair-ratio interquartile mean,
+    same pairing design as the guarded-sync line. The third rate measures
+    ACTIVE journaling (host
+    copy + pickle + framed flush per update) for the unit string — the cost
+    of durability when it is actually on.
+    """
+    import shutil
+    import tempfile
+
+    import jax
+
+    from torchmetrics_tpu._resilience import SnapshotManager, SnapshotPolicy
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    preds = jax.random.normal(jax.random.PRNGKey(0), (BATCH,))
+    target = jax.random.normal(jax.random.PRNGKey(1), (BATCH,))
+    d = tempfile.mkdtemp(prefix="tm_bench_snap_")
+    metric = MeanSquaredError()
+    # no cadence triggers: the active phase below measures pure journaling
+    policy = SnapshotPolicy(
+        every_n_updates=None, every_seconds=None, journal_max_entries=1 << 30, async_write=False
+    )
+    mgr = SnapshotManager(metric, d, policy)
+    mgr.pause()  # snapshots disabled; record() is the hook's earliest exit
+
+    def cycle() -> float:
+        t0 = time.perf_counter()
+        for _ in range(SNAP_BENCH_UPDATES):
+            metric.update(preds, target)
+        # drain the async dispatch queue inside the timed window: without
+        # this, each cycle's device work spills into the NEXT cycle's timing,
+        # which systematically penalizes whichever side runs second in a pair
+        jax.block_until_ready(metric.sum_squared_error)
+        return time.perf_counter() - t0
+
+    def toggle(hook) -> None:
+        object.__setattr__(metric, "_snapshot_hook", hook)
+
+    try:
+        for _ in range(8):  # warm jit caches + the auto-compile signature cache
+            cycle()
+        # ONE instance, hook toggled between adjacent cycles: distinct metric
+        # instances differ by several percent from dict-layout/cache-line
+        # luck alone, which would swamp the sub-µs dispatch under test
+        h_times, p_times = [], []
+        for rep in range(SNAP_BENCH_REPS):
+            # alternate which side leads the pair: the second cycle in a pair
+            # systematically measures a few percent off the first (scheduler
+            # quantum / cache position), and that bias must not pick a side
+            first_hooked = rep % 2 == 0
+            for hooked_side in (first_hooked, not first_hooked):
+                toggle(mgr if hooked_side else None)
+                (h_times if hooked_side else p_times).append(cycle())
+        toggle(mgr)
+        # per-pair ratios: this host's throughput drifts ±30% across a run,
+        # so only statistics paired tightly in time are meaningful — cycles
+        # are ~2ms and pair members adjacent. Interquartile MEAN of the
+        # ratios, not the bare median: the per-pair ratio is symmetric-noisy
+        # here and a single middle order statistic swings ±4% run to run;
+        # averaging the central half keeps stall robustness and roughly
+        # halves the estimator variance
+        ratios = sorted(p / h for h, p in zip(h_times, p_times))
+        core = ratios[len(ratios) // 4 : -(len(ratios) // 4)]
+        pair_ratio = sum(core) / len(core)
+        p_med = sorted(p_times)[len(p_times) // 2]
+        # enabled-mode journaling cost, for the unit string
+        mgr.resume()
+        cycle()  # base snapshot + first journal frames
+        a_times = sorted(cycle() for _ in range(8))
+        active_rate = SNAP_BENCH_UPDATES / a_times[len(a_times) // 2]
+    finally:
+        mgr.close()
+        shutil.rmtree(d, ignore_errors=True)
+    plain_rate = SNAP_BENCH_UPDATES / p_med
+    return pair_ratio * plain_rate, plain_rate, active_rate
+
+
 def _emit(line: dict) -> None:
     """Print one bench line and record it for the final summary line.
 
@@ -1464,6 +1561,24 @@ def main() -> None:
         )
     )
 
+    snap_hooked, snap_plain, snap_active = _bench_snapshot_overhead()
+    _emit((
+            {
+                "metric": "resilience_snapshot_overhead_per_sec",
+                "value": round(snap_hooked, 1),
+                "unit": (
+                    f"eager updates/sec (MeanSquaredError batch={BATCH}, SnapshotManager attached"
+                    " with snapshots disabled — the inline journal hook's hot-path dispatch;"
+                    " baseline = no manager attached, paired-interleaved per-pair-ratio"
+                    " interquartile mean — vs_baseline is the retention ratio, target >= 0.97 i.e. <3% hook"
+                    f" overhead; active journaling (host copy + pickle + framed flush per"
+                    f" update) sustains {snap_active:,.0f} updates/sec)"
+                ),
+                "vs_baseline": round(snap_hooked / snap_plain, 3),
+            }
+        )
+    )
+
     _emit_summary()
 
 
@@ -1523,6 +1638,7 @@ _README_LABELS = {
     "cer_long_transcript_samples_per_sec": ("CER long transcripts", "{v:,.0f} samples/s"),
     "collection_sync_p50_latency": ("Collection mesh-sync p50", "{v:.2f} ms"),
     "resilience_guarded_sync_overhead_per_sec": ("Guarded sync (resilience) happy path", "{v:,.0f} cycles/s"),
+    "resilience_snapshot_overhead_per_sec": ("Snapshot journal hook (disabled) eager `update()`", "{v:,.0f} updates/s"),
     "eager_update_fingerprint_skip_per_sec": ("Certified fingerprint-skip eager `update()`", "{v:,.0f} updates/s"),
 }
 

@@ -113,6 +113,10 @@ def pytest_configure(config):
         "markers",
         "tm_exact: this test asserts exact/near-bit invariants; the TM_TPU_SUITE tolerance floors must not apply",
     )
+    config.addinivalue_line(
+        "markers",
+        "slow: long-running suites (multi-seed chaos soak) excluded from the tier-1 `-m 'not slow'` run",
+    )
 
 
 def pytest_sessionfinish(session, exitstatus):

@@ -1,0 +1,245 @@
+"""Regression suite for the two PR-2-KNOWN analyzer defects (fixed in ISSUE 5).
+
+1. Single-file / subpackage scans used to name modules by bare stem, so
+   cross-module base classes failed to resolve and the class rules silently
+   skipped every class whose chain crosses a module boundary
+   (``module_name_for`` root-anchor fallback + context indexing).
+2. The ``check_r1`` mutation walk had drifted from the registry's
+   certification walk: a ``getattr(self, ...)`` -receiver mutation
+   uncertified a class but produced no R1 report. Both sides now consume
+   one shared walker (``iter_self_mutations``).
+
+Each test here fails on the pre-fix code.
+"""
+
+import textwrap
+
+from torchmetrics_tpu._analysis import analyze_paths, analyze_source
+
+# ---------------------------------------------------------------------------
+# defect 1: partial scans must run the class rules
+# ---------------------------------------------------------------------------
+
+_BASE = '''
+import jax.numpy as jnp
+from torchmetrics_tpu.metric import Metric
+
+
+class Base(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds) -> None:
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+'''
+
+_CHILD = '''
+from pkg_under_test.base import Base
+
+
+class Child(Base):
+    def update(self, preds) -> None:
+        self.total = self.total + preds.sum()
+        self.leaked_counter = 1  # R1: never registered via add_state
+
+    def compute(self):
+        return self.total
+'''
+
+
+def _make_pkg(tmp_path):
+    pkg = tmp_path / "pkg_under_test"
+    pkg.mkdir()
+    (pkg / "__init__.py").write_text("")
+    (pkg / "base.py").write_text(textwrap.dedent(_BASE))
+    (pkg / "child.py").write_text(textwrap.dedent(_CHILD))
+    return pkg
+
+
+def test_single_file_scan_runs_class_rules(tmp_path):
+    """Scanning ONLY child.py must resolve Base from the unscanned sibling
+    (context indexing) and emit the R1 finding — pre-fix this scan was
+    silently empty."""
+    pkg = _make_pkg(tmp_path)
+    result = analyze_paths([str(pkg / "child.py")])
+    assert result.files_scanned == 1  # context siblings are indexed, not scanned
+    hits = [(v.rule, v.scope) for v in result.violations]
+    assert ("R1", "Child.update") in hits, hits
+    assert "pkg_under_test.child.Child" not in result.certified
+
+
+def test_single_file_scan_certifies_clean_cross_module_class(tmp_path):
+    pkg = _make_pkg(tmp_path)
+    (pkg / "clean_child.py").write_text(
+        textwrap.dedent(
+            '''
+            from pkg_under_test.base import Base
+
+
+            class CleanChild(Base):
+                def update(self, preds) -> None:
+                    self.total = self.total + preds.sum()
+
+                def compute(self):
+                    return self.total
+            '''
+        )
+    )
+    result = analyze_paths([str(pkg / "clean_child.py")])
+    assert result.violations == []
+    assert "pkg_under_test.clean_child.CleanChild" in result.certified
+
+
+def test_subpackage_scan_matches_full_scan_class_findings(tmp_path):
+    """A subpackage scan and a full scan must agree on that subpackage's
+    class-rule findings AND report them under full-scan baseline paths."""
+    pkg = _make_pkg(tmp_path)
+    sub_result = analyze_paths([str(pkg)])
+    file_result = analyze_paths([str(pkg / "child.py")])
+    sub = {(v.rule, v.scope, v.path) for v in sub_result.violations}
+    single = {(v.rule, v.scope, v.path) for v in file_result.violations}
+    assert single <= sub
+    assert all(v.path.startswith("pkg_under_test/") for v in sub_result.violations)
+
+
+def test_partial_scan_does_not_stale_unscanned_baseline_entries():
+    """A single-file scan must not report baseline entries of UNSCANNED files
+    as stale — staleness is only decidable for files the rules actually ran
+    on (pre-fix, a partial scan invited pruning every other suppression)."""
+    from torchmetrics_tpu._analysis import load_baseline, split_baselined
+    from pathlib import Path
+
+    baseline = load_baseline(Path("tools/lint_baseline.json"))
+    assert baseline, "shipped baseline must be non-empty for this test to bite"
+    result = analyze_paths(["torchmetrics_tpu/classification/calibration_error.py"])
+    assert result.scanned_paths == ["torchmetrics_tpu/classification/calibration_error.py"]
+    _new, suppressed, stale = split_baselined(result.violations, baseline, scanned_paths=result.scanned_paths)
+    assert suppressed, "calibration_error's baselined findings must be suppressed"
+    assert stale == [], [e.path for e in stale]
+
+
+def test_real_package_single_file_emits_known_findings():
+    """The shipped baseline's calibration_error R4 class findings must
+    surface in a single-file scan exactly as they do in the full scan."""
+    result = analyze_paths(["torchmetrics_tpu/classification/calibration_error.py"])
+    scopes = {(v.rule, v.scope) for v in result.violations}
+    assert ("R4", "BinaryCalibrationError.update") in scopes
+    assert ("R4", "MulticlassCalibrationError.update") in scopes
+    # and under the same display path the baseline keys use
+    assert {v.path for v in result.violations} == {"torchmetrics_tpu/classification/calibration_error.py"}
+
+
+# ---------------------------------------------------------------------------
+# defect 2: getattr-receiver mutations must report AND uncertify
+# ---------------------------------------------------------------------------
+
+_GETATTR_LITERAL = '''
+import jax.numpy as jnp
+from torchmetrics_tpu.metric import Metric
+
+
+class GetattrMutator(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+        self.bag = []
+
+    def update(self, preds) -> None:
+        self.total = self.total + preds.sum()
+        getattr(self, "bag").append(preds)
+
+    def compute(self):
+        return self.total
+'''
+
+_GETATTR_DYNAMIC = '''
+import jax.numpy as jnp
+from torchmetrics_tpu.metric import Metric
+
+
+class DynamicGetattrMutator(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+        self.bag = []
+
+    def update(self, preds, key) -> None:
+        self.total = self.total + preds.sum()
+        getattr(self, "b" + key).append(preds)
+
+    def compute(self):
+        return self.total
+'''
+
+
+def test_getattr_literal_receiver_reports_and_uncertifies():
+    result = analyze_source(textwrap.dedent(_GETATTR_LITERAL), path="getattr_literal.py")
+    hits = [(v.rule, v.scope) for v in result.violations]
+    assert ("R1", "GetattrMutator.update") in hits, hits
+    assert "`.append()` on" in [v for v in result.violations if v.rule == "R1"][0].message
+    assert not any(c.endswith("GetattrMutator") for c in result.certified)
+
+
+def test_getattr_dynamic_receiver_reports_and_uncertifies():
+    result = analyze_source(textwrap.dedent(_GETATTR_DYNAMIC), path="getattr_dynamic.py")
+    r1 = [v for v in result.violations if v.rule == "R1"]
+    assert any("dynamic `getattr" in v.message for v in r1), [v.message for v in r1]
+    assert not any(c.endswith("DynamicGetattrMutator") for c in result.certified)
+
+
+def test_registered_state_getattr_receiver_stays_clean():
+    """Mutating a REGISTERED cat state through a literal getattr is fine."""
+    clean = _GETATTR_LITERAL.replace('self.bag = []', '').replace(
+        'self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")',
+        'self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")\n'
+        '        self.add_state("bag", default=[], dist_reduce_fx="cat")',
+    )
+    result = analyze_source(textwrap.dedent(clean), path="getattr_clean.py")
+    assert [v for v in result.violations if v.rule == "R1"] == []
+    assert any(c.endswith("GetattrMutator") for c in result.certified)
+
+
+def test_write_baseline_refuses_partial_scan(tmp_path, capsys):
+    """--write-baseline on a partial scan would silently drop every baseline
+    entry belonging to an unscanned file; the CLI must refuse instead."""
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        import lint_metrics
+    finally:
+        sys.path.pop(0)
+    rc = lint_metrics.main(
+        ["torchmetrics_tpu/classification/calibration_error.py", "--write-baseline"]
+    )
+    assert rc == 2
+    assert "refusing --write-baseline" in capsys.readouterr().out
+
+
+def test_write_manifest_refuses_partial_scan(capsys):
+    import sys
+    sys.path.insert(0, "tools")
+    try:
+        import lint_metrics
+    finally:
+        sys.path.pop(0)
+    rc = lint_metrics.main(
+        ["torchmetrics_tpu/classification/calibration_error.py", "--write-manifest"]
+    )
+    assert rc == 2
+    assert "refusing --write-manifest" in capsys.readouterr().out
+
+
+def test_relative_scan_root_inside_package_terminates(tmp_path, monkeypatch):
+    """A relative scan root with the CWD itself inside a package used to spin
+    forever: ``_package_top`` walked ``Path('.').parent`` (== ``Path('.')``)
+    while ``./__init__.py`` kept existing. The walk must resolve first."""
+    pkg = _make_pkg(tmp_path)
+    monkeypatch.chdir(pkg)
+    result = analyze_paths(["."])
+    assert result.files_scanned == 3
+    hits = [(v.rule, v.scope) for v in result.violations]
+    assert ("R1", "Child.update") in hits, hits

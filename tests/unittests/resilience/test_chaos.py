@@ -1,0 +1,111 @@
+"""Chaos soak: seeded randomized fault schedules against real metrics.
+
+Tier-1 runs a small fixed-seed smoke (seconds); the full multi-seed soak —
+the ISSUE-5 acceptance bar of 20+ distinct seeds across metric, collection,
+and stall variants — runs under ``-m slow``. Every schedule asserts all
+three invariants via ``ChaosResult.ok``: fault-free golden equality,
+idempotent restore+replay, and the wall-clock budget (no deadlocks).
+"""
+
+import warnings
+
+import pytest
+
+from torchmetrics_tpu._resilience.chaos import (
+    ChaosSpec,
+    default_collection_factory,
+    run_chaos_schedule,
+)
+
+
+def _run(seed, **kwargs):
+    # degradation warnings (quarantine drops, restore fallbacks) are the
+    # stack WORKING as designed mid-schedule — only the invariants matter
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        result = run_chaos_schedule(seed, **kwargs)
+    assert result.ok, result.describe()
+    return result
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: fixed seeds, seconds of wall clock
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1, 2])
+def test_chaos_smoke_metric(seed):
+    _run(seed)
+
+
+def test_chaos_smoke_collection():
+    _run(100, factory=default_collection_factory)
+
+
+def test_chaos_smoke_watchdog_stall():
+    _run(101, spec=ChaosSpec(stall_final=True))
+
+
+def test_chaos_exercises_the_fault_surface():
+    """The smoke seeds must actually hit the interesting faults, not idle."""
+    kinds = set()
+    for seed in (0, 1, 2, 3, 4, 5):
+        result = _run(seed)
+        kinds |= {e.kind for e in result.events}
+        if {"preempt", "restore", "nan", "final_fault", "corrupt"} <= kinds:
+            break
+    assert {"preempt", "restore", "nan", "final_fault", "corrupt"} <= kinds, kinds
+
+
+# ---------------------------------------------------------------------------
+# full soak: >= 20 distinct seeds across target/fault variants
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(12))
+def test_chaos_soak_metric(seed):
+    _run(seed)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(200, 206))
+def test_chaos_soak_collection(seed):
+    _run(seed, factory=default_collection_factory)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(300, 304))
+def test_chaos_soak_watchdog_stall(seed):
+    _run(seed, spec=ChaosSpec(stall_final=True, wallclock_budget_s=12.0))
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(400, 404))
+def test_chaos_soak_sync_writes(seed):
+    _run(seed, spec=ChaosSpec(async_write=False))
+
+
+def test_failing_schedule_does_not_leak_writer_thread(tmp_path):
+    """A schedule that raises mid-stream must still close its manager —
+    otherwise every failed soak seed parks a daemon writer thread and an
+    open journal fd."""
+    import threading
+
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    class _Boom(MeanSquaredError):
+        def update(self, preds, target):
+            if self._update_count >= 2:
+                raise RuntimeError("boom")
+            super().update(preds, target)
+
+    before = threading.active_count()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        result = run_chaos_schedule(seed=0, factory=_Boom, directory=tmp_path)
+    assert not result.ok and any("boom" in f for f in result.failures)
+    assert not [
+        t for t in threading.enumerate() if t.name == "tm-tpu-snapshot-writer" and t.is_alive()
+    ]
+    assert threading.active_count() <= before

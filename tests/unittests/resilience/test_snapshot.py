@@ -1,0 +1,455 @@
+"""SnapshotManager unit suite: atomic rotation, fallback, journal replay.
+
+Chaos-schedule composition lives in ``test_chaos.py``; this file pins each
+mechanism in isolation so a soak failure bisects cleanly.
+"""
+
+import os
+import pickle
+import shutil
+from copy import deepcopy
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import SumMetric
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.regression import MeanAbsoluteError, MeanSquaredError
+from torchmetrics_tpu._resilience import (
+    SnapshotManager,
+    SnapshotPolicy,
+    SnapshotRestoreError,
+)
+from torchmetrics_tpu._resilience.faultinject import corrupt_file, poison_nans
+
+SYNC = dict(async_write=False)
+
+
+def _batches(n, size=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        (jnp.asarray(rng.normal(size=size).astype(np.float32)),
+         jnp.asarray(rng.normal(size=size).astype(np.float32)))
+        for _ in range(n)
+    ]
+
+
+def _snaps(d):
+    return sorted(f for f in os.listdir(d) if f.startswith("snap-"))
+
+
+def _journals(d):
+    return sorted(f for f in os.listdir(d) if f.startswith("journal-"))
+
+
+def test_atomic_rotation_keeps_last_k(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=1, keep=2, **SYNC))
+    for p, t in _batches(7):
+        m.update(p, t)
+    mgr.close()
+    snaps = _snaps(tmp_path)
+    assert len(snaps) == 2, snaps
+    # generations are contiguous and the newest matches the manager's counter
+    gens = [int(s.split("-")[1].split(".")[0]) for s in snaps]
+    assert gens == [mgr.generation - 1, mgr.generation]
+    # no torn temp files survive the rename protocol
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+    # journals are retained only from the oldest kept snapshot forward
+    jgens = [int(s.split("-")[1].split(".")[0]) for s in _journals(tmp_path)]
+    assert min(jgens) >= gens[0]
+
+
+def test_restore_roundtrip_into_fresh_instance(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=3, **SYNC))
+    for p, t in _batches(8):
+        m.update(p, t)
+    mgr.close()
+    fresh = MeanSquaredError()
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+        report = mgr2.restore_latest()
+    assert fresh._update_count == m._update_count
+    np.testing.assert_allclose(np.asarray(fresh.compute()), np.asarray(m.compute()))
+    assert not report.fell_back
+
+
+def test_corrupt_newest_generation_falls_back(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=2, **SYNC))
+    for p, t in _batches(7):
+        m.update(p, t)
+    mgr.close()
+    corrupt_file(tmp_path / _snaps(tmp_path)[-1], "bitflip", seed=1)
+    fresh = MeanSquaredError()
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2, pytest.warns(UserWarning):
+        report = mgr2.restore_latest()
+    assert report.skipped, "the corrupted newest generation must be recorded as skipped"
+    # fallback generation + journal replay reconstruct the exact stream
+    np.testing.assert_allclose(np.asarray(fresh.compute()), np.asarray(m.compute()))
+    assert any(e.kind == "snapshot_restore" for e in fresh.resilience_report().events)
+
+
+def test_every_generation_corrupt_raises(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=2, **SYNC))
+    for p, t in _batches(5):
+        m.update(p, t)
+    mgr.close()
+    for s in _snaps(tmp_path):
+        corrupt_file(tmp_path / s, "bitflip", seed=2)
+    fresh = MeanSquaredError()
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+        with pytest.raises(SnapshotRestoreError) as err:
+            mgr2.restore_latest()
+    assert err.value.failures
+
+
+def test_journal_bound_forces_rotation(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(
+        m, tmp_path, SnapshotPolicy(every_n_updates=None, every_seconds=None, journal_max_entries=3, **SYNC)
+    )
+    for p, t in _batches(10):
+        m.update(p, t)
+    # the journal can never exceed its bound: overflow rolls a snapshot
+    assert mgr.journal_len < 3
+    assert mgr.snapshots_taken >= 3
+    mgr.close()
+
+
+def test_truncated_journal_replays_clean_prefix(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=100, **SYNC))
+    batches = _batches(5)
+    for p, t in batches:
+        m.update(p, t)
+    mgr.close()
+    # tear the journal tail: a crash mid-append
+    journal = tmp_path / _journals(tmp_path)[-1]
+    raw = journal.read_bytes()
+    journal.write_bytes(raw[: len(raw) - 7])
+    fresh = MeanSquaredError()
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2, pytest.warns(UserWarning):
+        report = mgr2.restore_latest()
+    assert report.truncated_journal
+    # base snapshot covered batch 1; entries 2..4 replay, the torn 5th is lost
+    assert report.replayed == 3
+    golden = MeanSquaredError()
+    for p, t in batches[:4]:
+        golden.update(p, t)
+    np.testing.assert_allclose(np.asarray(fresh.compute()), np.asarray(golden.compute()))
+
+
+def test_restore_is_idempotent(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=2, **SYNC))
+    for p, t in _batches(6):
+        m.update(p, t)
+    mgr.simulate_preemption()
+    states = []
+    for _ in range(3):
+        fresh = MeanSquaredError()
+        with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+            mgr2.restore_latest()
+        states.append({k: np.asarray(v) for k, v in fresh.state_dict(all_states=True).items()})
+    for later in states[1:]:
+        for key in states[0]:
+            np.testing.assert_array_equal(states[0][key], later[key])
+
+
+def test_async_preemption_with_dropped_writes_restores_everything(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=3, async_write=True))
+    batches = _batches(8)
+    for p, t in batches:
+        m.update(p, t)
+    mgr.simulate_preemption()  # pending async snapshot writes die with the "process"
+    fresh = MeanSquaredError()
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+        mgr2.restore_latest()
+    golden = MeanSquaredError()
+    for p, t in batches:
+        golden.update(p, t)
+    np.testing.assert_allclose(np.asarray(fresh.compute()), np.asarray(golden.compute()))
+
+
+def test_forward_journals_once_per_batch(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=100, **SYNC))
+    batches = _batches(4)
+    for p, t in batches:
+        m(p, t)  # forward: stash/reset dance must journal exactly once
+    assert mgr.journaled_updates == 3  # batch 1 is covered by the base snapshot
+    mgr.close()
+    fresh = MeanSquaredError()
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+        mgr2.restore_latest()
+    assert fresh._update_count == 4
+    np.testing.assert_allclose(np.asarray(fresh.compute()), np.asarray(m.compute()))
+
+
+def test_quarantined_batch_replays_to_same_state(tmp_path):
+    m = MeanSquaredError(nan_policy="quarantine")
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=100, **SYNC))
+    batches = _batches(4)
+    with pytest.warns(UserWarning):
+        for i, (p, t) in enumerate(batches):
+            m.update(poison_nans(p) if i == 2 else p, t)
+    mgr.close()
+    fresh = MeanSquaredError(nan_policy="quarantine")
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2, pytest.warns(UserWarning):
+        # replay re-runs the poisoned entry through the real update path and
+        # re-quarantines it — restored state matches the live stream exactly
+        mgr2.restore_latest()
+    np.testing.assert_allclose(np.asarray(fresh.compute()), np.asarray(m.compute()))
+    assert fresh._update_count == m._update_count == 3
+
+
+def test_scan_update_entries_replay_through_scan(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=100, **SYNC))
+    rng = np.random.default_rng(3)
+    stream = jnp.asarray(rng.normal(size=(5, 8)).astype(np.float32))
+    target = jnp.asarray(rng.normal(size=(5, 8)).astype(np.float32))
+    m.update(stream[0], target[0])  # base snapshot anchor
+    m.scan_update(stream[1:], target[1:])
+    assert m._update_count == 5
+    mgr.close()
+    fresh = MeanSquaredError()
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+        mgr2.restore_latest()
+    assert fresh._update_count == 5
+    np.testing.assert_allclose(np.asarray(fresh.compute()), np.asarray(m.compute()), rtol=1e-6)
+
+
+def test_collection_roundtrip_and_counts(tmp_path):
+    col = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    mgr = SnapshotManager(col, tmp_path, SnapshotPolicy(every_n_updates=2, **SYNC))
+    for p, t in _batches(5):
+        col.update(p, t)
+    mgr.close()
+    fresh = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+        mgr2.restore_latest()
+    a, b = col.compute(), fresh.compute()
+    assert set(a) == set(b)
+    for k in a:
+        np.testing.assert_allclose(np.asarray(a[k]), np.asarray(b[k]))
+    for name, member in fresh._modules.items():
+        assert member._update_count == col._modules[name]._update_count == 5
+
+
+def test_io_failure_degrades_without_breaking_updates(tmp_path):
+    d = tmp_path / "snaps"
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, d, SnapshotPolicy(every_n_updates=1, **SYNC))
+    batches = _batches(4)
+    m.update(*batches[0])
+    shutil.rmtree(d)  # yank the durability volume out from under the manager
+    with pytest.warns(UserWarning, match="snapshot_degraded|degraded"):
+        m.update(*batches[1])
+    m.update(*batches[2])  # stream keeps flowing, no further warnings/raises
+    assert mgr.last_error is not None
+    assert any(e.kind == "snapshot_degraded" for e in m.resilience_report().events)
+    golden = MeanSquaredError()
+    for p, t in batches[:3]:
+        golden.update(p, t)
+    np.testing.assert_allclose(np.asarray(m.compute()), np.asarray(golden.compute()))
+    mgr.close()
+
+
+def test_second_manager_on_same_target_rejected(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path / "a", SnapshotPolicy(**SYNC))
+    with pytest.raises(ValueError, match="already has an active SnapshotManager"):
+        SnapshotManager(m, tmp_path / "b", SnapshotPolicy(**SYNC))
+    mgr.close()
+    # after close, a replacement is legal
+    mgr2 = SnapshotManager(m, tmp_path / "b", SnapshotPolicy(**SYNC))
+    mgr2.close()
+
+
+def test_clone_and_pickle_travel_without_the_hook(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(**SYNC))
+    for p, t in _batches(2):
+        m.update(p, t)
+    clone = deepcopy(m)
+    assert clone.__dict__.get("_snapshot_hook") is None
+    revived = pickle.loads(pickle.dumps(m))
+    assert revived.__dict__.get("_snapshot_hook") is None
+    np.testing.assert_allclose(np.asarray(revived.compute()), np.asarray(m.compute()))
+    mgr.close()
+
+
+def test_state_dict_all_states_covers_non_persistent():
+    m = SumMetric()  # aggregation states default to non-persistent
+    m.update(jnp.asarray(3.0))
+    assert not any(m._persistent.values())
+    assert m.state_dict() == {}
+    full = m.state_dict(all_states=True, integrity=True)
+    assert "value" in full and "#integrity" in full
+    fresh = SumMetric()
+    fresh.load_state_dict(full, strict=True)
+    np.testing.assert_allclose(np.asarray(fresh.compute()), 3.0)
+
+
+def test_pause_resume_gates_journaling(tmp_path):
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=1, **SYNC))
+    batches = _batches(4)
+    m.update(*batches[0])
+    taken = mgr.snapshots_taken
+    mgr.pause()
+    m.update(*batches[1])
+    assert mgr.snapshots_taken == taken
+    mgr.resume()
+    m.update(*batches[2])
+    assert mgr.snapshots_taken > taken
+    mgr.close()
+
+
+def test_mid_stream_reset_is_journaled_and_replayed(tmp_path):
+    """A reset between snapshots must not resurrect pre-reset accumulation
+    on restore: the reset is a journaled state transition like any other."""
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=100, **SYNC))
+    batches = _batches(6)
+    for p, t in batches[:3]:
+        m.update(p, t)
+    m.reset()  # epoch boundary: discard everything so far
+    for p, t in batches[3:]:
+        m.update(p, t)
+    expected = np.asarray(m.compute())
+    mgr.simulate_preemption()
+
+    fresh = MeanSquaredError()
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+        report = mgr2.restore_latest()
+    assert fresh._update_count == 3
+    np.testing.assert_allclose(np.asarray(fresh.compute()), expected)
+    # update 1 is covered by the base snapshot (not journaled); the journal
+    # then carries updates 2-3, the reset, and updates 4-6
+    assert report.replayed == 6
+
+    # restore's own internal reset() must NOT have been journaled: a second
+    # fresh restore replays to the identical state (idempotence)
+    again = MeanSquaredError()
+    with SnapshotManager(again, tmp_path, SnapshotPolicy(**SYNC)) as mgr3:
+        mgr3.restore_latest()
+    np.testing.assert_allclose(np.asarray(again.compute()), expected)
+
+
+def test_collection_mid_stream_reset_restores(tmp_path):
+    coll = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    mgr = SnapshotManager(coll, tmp_path, SnapshotPolicy(every_n_updates=100, **SYNC))
+    batches = _batches(4)
+    for p, t in batches[:2]:
+        coll.update(p, t)
+    coll.reset()
+    for p, t in batches[2:]:
+        coll.update(p, t)
+    expected = {k: np.asarray(v) for k, v in coll.compute().items()}
+    mgr.simulate_preemption()
+
+    fresh = MetricCollection([MeanSquaredError(), MeanAbsoluteError()])
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+        mgr2.restore_latest()
+    got = {k: np.asarray(v) for k, v in fresh.compute().items()}
+    assert got.keys() == expected.keys()
+    for k in got:
+        np.testing.assert_allclose(got[k], expected[k])
+
+
+def test_rejected_double_attach_leaks_no_writer_thread(tmp_path):
+    import threading
+
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(async_write=True))
+    before = sum(1 for t in threading.enumerate() if t.name.startswith("tm-tpu-snapshot-writer"))
+    for _ in range(3):
+        with pytest.raises(ValueError, match="already has an active SnapshotManager"):
+            SnapshotManager(m, tmp_path, SnapshotPolicy(async_write=True))
+    after = sum(1 for t in threading.enumerate() if t.name.startswith("tm-tpu-snapshot-writer"))
+    assert after == before
+    mgr.close()
+
+
+def test_total_restore_failure_rolls_back_live_state(tmp_path):
+    """Failed load attempts reset the live target along the way; when every
+    generation is unrestorable the pre-restore stash must put the accumulated
+    state (and update count) back before the error propagates."""
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=2, **SYNC))
+    for p, t in _batches(5):
+        m.update(p, t)
+    expected = np.asarray(m.compute())
+    count = m._update_count
+    for s in _snaps(tmp_path):
+        corrupt_file(tmp_path / s, "bitflip", seed=3)
+    with pytest.raises(SnapshotRestoreError):
+        mgr.restore_latest()
+    assert m._update_count == count
+    np.testing.assert_allclose(np.asarray(m.compute()), expected)
+    mgr.close()
+
+
+def test_class_mismatch_generation_is_rejected(tmp_path):
+    """A snapshot written by one metric class must not load into another even
+    when the kind matches — the recorded class name is verified pre-reset."""
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(every_n_updates=2, **SYNC))
+    for p, t in _batches(4):
+        m.update(p, t)
+    mgr.close()
+    other = MeanAbsoluteError()
+    with SnapshotManager(other, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+        with pytest.raises(SnapshotRestoreError) as err:
+            mgr2.restore_latest()
+    assert any("MeanSquaredError" in reason for reason in err.value.failures.values())
+
+
+def test_merge_state_is_journaled_and_replayed(tmp_path):
+    """A shard merge is a real stream transition: restore must replay it, or
+    the merged contribution silently vanishes after a crash."""
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(**SYNC))
+    bs = _batches(4)
+    for p, t in bs[:2]:
+        m.update(p, t)
+    shard = MeanSquaredError()
+    for p, t in bs[2:]:
+        shard.update(p, t)
+    m.merge_state(shard)
+    expected = np.asarray(m.compute())
+    mgr.simulate_preemption()
+    fresh = MeanSquaredError()
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+        mgr2.restore_latest()
+    assert fresh._update_count == m._update_count
+    np.testing.assert_allclose(np.asarray(fresh.compute()), expected)
+
+
+def test_manual_mid_stream_load_survives_preemption(tmp_path):
+    """load_state_dict is un-journalable; the hook anchors it with an inline
+    snapshot so post-load updates replay against the loaded state."""
+    m = MeanSquaredError()
+    mgr = SnapshotManager(m, tmp_path, SnapshotPolicy(**SYNC))
+    bs = _batches(6)
+    for p, t in bs[:2]:
+        m.update(p, t)
+    donor = MeanSquaredError()
+    for p, t in bs[2:4]:
+        donor.update(p, t)
+    m.load_state_dict(donor.state_dict())
+    for p, t in bs[4:]:
+        m.update(p, t)
+    expected = np.asarray(m.compute())
+    mgr.simulate_preemption()
+    fresh = MeanSquaredError()
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(**SYNC)) as mgr2:
+        report = mgr2.restore_latest()
+    assert report.replayed == 2, report  # only the post-load updates replay
+    np.testing.assert_allclose(np.asarray(fresh.compute()), expected)

@@ -54,15 +54,46 @@ def main(argv=None) -> int:
     elapsed = time.perf_counter() - t0
 
     baseline = {} if args.no_baseline else load_baseline(args.baseline)
-    new, suppressed, stale = split_baselined(result.violations, baseline)
+    new, suppressed, stale = split_baselined(result.violations, baseline, scanned_paths=result.scanned_paths)
+
+    # the write modes rewrite their file to exactly the current scan's view,
+    # so a partial (single-file / subpackage) scan would silently drop every
+    # entry belonging to an unscanned file — refuse instead of corrupting
+    scanned = set(result.scanned_paths)
 
     if args.write_baseline:
+        undecided = sorted({e.path for e in baseline.values() if e.path not in scanned})
+        if undecided:
+            print(
+                f"refusing --write-baseline on a partial scan: {len(undecided)} baselined"
+                " file(s) were not scanned and their entries would be dropped"
+                f" (e.g. {undecided[0]}); rerun on the package root"
+            )
+            return 2
         n = write_baseline(result.violations, args.baseline, baseline)
         print(f"wrote {n} baseline entries to {args.baseline}")
         return 0
 
     if args.write_manifest:
+        from torchmetrics_tpu._analysis.manifest import load_manifest
+
         out = args.manifest or MANIFEST_PATH
+        def _module_files(qualname: str) -> tuple:
+            mod = qualname.rsplit(".", 1)[0].replace(".", "/")
+            return (f"{mod}.py", f"{mod}/__init__.py")
+        prior = load_manifest(out) if out.exists() else frozenset()
+        dropped = sorted(
+            c
+            for c in prior
+            if c not in result.certified and not any(f in scanned for f in _module_files(c))
+        )
+        if dropped:
+            print(
+                f"refusing --write-manifest on a partial scan: {len(dropped)} previously"
+                " certified class(es) live in unscanned files and would lose their"
+                f" fingerprint-skip certification (e.g. {dropped[0]}); rerun on the package root"
+            )
+            return 2
         n = write_manifest(result.certified, out)
         print(f"wrote {n} certified R1-clean classes to {out}")
         return 0

@@ -13,7 +13,7 @@ from __future__ import annotations
 import json
 from dataclasses import dataclass
 from pathlib import Path
-from typing import Dict, Iterable, List, Tuple
+from typing import Dict, Iterable, List, Optional, Tuple
 
 from torchmetrics_tpu._analysis.model import Violation
 
@@ -52,10 +52,18 @@ def load_baseline(path: Path) -> Dict[Fingerprint, BaselineEntry]:
 
 
 def split_baselined(
-    violations: Iterable[Violation], baseline: Dict[Fingerprint, BaselineEntry]
+    violations: Iterable[Violation],
+    baseline: Dict[Fingerprint, BaselineEntry],
+    scanned_paths: Optional[Iterable[str]] = None,
 ) -> Tuple[List[Violation], List[Violation], List[BaselineEntry]]:
     """Partition into (new, suppressed) and report stale baseline entries
-    whose violation no longer exists (fixed code keeps the file honest)."""
+    whose violation no longer exists (fixed code keeps the file honest).
+
+    ``scanned_paths`` limits staleness to entries whose file was actually
+    rule-checked: on a partial (single-file / subpackage) scan, an entry for
+    an unscanned file is simply undecided — reporting it stale would invite
+    pruning suppressions that are still live.
+    """
     new: List[Violation] = []
     suppressed: List[Violation] = []
     hit: set = set()
@@ -65,7 +73,12 @@ def split_baselined(
             hit.add(v.fingerprint)
         else:
             new.append(v)
-    stale = [entry for fp, entry in baseline.items() if fp not in hit]
+    decided = None if scanned_paths is None else set(scanned_paths)
+    stale = [
+        entry
+        for fp, entry in baseline.items()
+        if fp not in hit and (decided is None or entry.path in decided)
+    ]
     return new, suppressed, stale
 
 

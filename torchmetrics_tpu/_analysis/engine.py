@@ -30,6 +30,9 @@ _SKIP_DIR_PARTS = {"__pycache__", ".git"}
 class AnalysisResult:
     violations: List[Violation] = field(default_factory=list)
     certified: List[str] = field(default_factory=list)  # R1-clean class qualnames
+    # display paths of rule-checked files (context siblings excluded):
+    # baseline staleness is only decidable for files that were scanned
+    scanned_paths: List[str] = field(default_factory=list)
     files_scanned: int = 0
     classes_seen: int = 0
     parse_errors: List[str] = field(default_factory=list)
@@ -54,10 +57,41 @@ def iter_py_files(paths: Sequence[str]) -> List[Path]:
     return files
 
 
+def _package_top(directory: Path) -> Optional[Path]:
+    """Topmost package directory containing ``directory`` (walking the
+    ``__init__.py`` chain upward), or None when ``directory`` is not a
+    package at all."""
+    directory = directory.resolve()
+    if not (directory / "__init__.py").exists():
+        return None
+    top = directory
+    while top.parent != top and (top.parent / "__init__.py").exists():
+        top = top.parent
+    return top
+
+
+def _anchor_parts(directory: Path) -> List[str]:
+    """Dotted-prefix parts for a directory: the package chain from the
+    topmost ``__init__.py`` ancestor down to ``directory`` (empty for a
+    non-package directory)."""
+    top = _package_top(directory)
+    if top is None:
+        return []
+    return list(directory.resolve().parts[len(top.resolve().parts) - 1 :])
+
+
 def module_name_for(path: Path, roots: Sequence[Path]) -> str:
-    """Dotted module name for ``path``: relative to the scan root that holds
-    the package directory, so ``torchmetrics_tpu/regression/mae.py`` maps to
-    ``torchmetrics_tpu.regression.mae`` regardless of cwd."""
+    """Dotted module name for ``path``, anchored at its true package root.
+
+    ``torchmetrics_tpu/regression/mae.py`` maps to
+    ``torchmetrics_tpu.regression.mae`` regardless of cwd, of whether the
+    scan root is the package, a subpackage, or the file itself: the anchor
+    walks the ``__init__.py`` chain up from the file to the topmost package
+    directory. Without that fallback, single-file and subpackage scans named
+    modules by bare stem, absolute imports between scanned modules failed to
+    resolve, and the class rules silently skipped every class whose base
+    lives in another module.
+    """
     resolved = path.resolve()
     for root in roots:
         try:
@@ -65,23 +99,34 @@ def module_name_for(path: Path, roots: Sequence[Path]) -> str:
         except ValueError:
             continue
         parts = list(rel.parts)
-        anchor = root.name if root.is_dir() else ""
+        anchor = _anchor_parts(root) if root.is_dir() else []
         if parts[-1] == "__init__.py":
             parts = parts[:-1]
         else:
             parts[-1] = parts[-1][:-3]
-        dotted = ".".join([anchor] + parts) if anchor and root.name == "torchmetrics_tpu" else ".".join(parts)
-        return dotted or anchor
+        dotted = ".".join(anchor + parts)
+        return dotted or (anchor[-1] if anchor else path.stem)
+    # no scan root holds the file (single-file scans): anchor on the file's
+    # own package chain
+    anchor = _anchor_parts(resolved.parent)
+    if anchor:
+        stem = [] if resolved.name == "__init__.py" else [resolved.stem]
+        return ".".join(anchor + stem)
     return path.stem
 
 
 def _display_path(path: Path, roots: Sequence[Path] = ()) -> str:
     """Stable repo-relative posix path for baseline keys.
 
-    Anchored on the scan root first (`torchmetrics_tpu/...` no matter where
-    the CLI runs from), falling back to cwd-relative for loose files.
+    Anchored on the scanned file's topmost package directory first
+    (`torchmetrics_tpu/...` no matter where the CLI runs from or which
+    subpackage was scanned — baseline fingerprints must match across full,
+    subpackage, and single-file scans), then the scan root, then cwd.
     """
     resolved = path.resolve()
+    top = _package_top(resolved.parent)
+    if top is not None:
+        return (Path(top.name) / resolved.relative_to(top)).as_posix()
     for root in roots:
         root_resolved = root.resolve()
         try:
@@ -96,6 +141,27 @@ def _display_path(path: Path, roots: Sequence[Path] = ()) -> str:
     return resolved.as_posix()
 
 
+def _context_files(file_list: Sequence[Path]) -> List[Path]:
+    """Package siblings of the scanned files, for registry indexing only.
+
+    A partial scan (single file, subpackage) still needs the *whole* package
+    in the registry so base classes defined in unscanned modules resolve —
+    otherwise every class whose chain crosses a module boundary fails
+    ``is_metric_subclass`` and the class rules silently skip it. Context
+    files are parsed and indexed (pass 1) but no rules run on them.
+    """
+    requested = {p.resolve() for p in file_list}
+    tops = {top for p in file_list if (top := _package_top(p.resolve().parent)) is not None}
+    out: List[Path] = []
+    for top in sorted(tops):
+        out.extend(
+            f
+            for f in sorted(top.rglob("*.py"))
+            if not (_SKIP_DIR_PARTS & set(f.parts)) and f.resolve() not in requested
+        )
+    return out
+
+
 def analyze_paths(paths: Sequence[str]) -> AnalysisResult:
     result = AnalysisResult()
     registry = Registry()
@@ -106,20 +172,25 @@ def analyze_paths(paths: Sequence[str]) -> AnalysisResult:
     file_list = iter_py_files(paths)
 
     # pass 1: parse + index everything (cross-module base resolution needs
-    # the full registry before any rule runs)
-    for path in file_list:
+    # the full registry before any rule runs); context files — unscanned
+    # package siblings of a partial scan — are indexed but never rule-checked
+    for is_context, path in [(False, p) for p in file_list] + [(True, p) for p in _context_files(file_list)]:
         display = _display_path(path, roots)
         try:
             text = path.read_text(encoding="utf-8")
             tree = ast.parse(text)
         except (SyntaxError, UnicodeDecodeError, OSError) as err:
-            result.parse_errors.append(f"{display}: {err}")
+            if not is_context:
+                result.parse_errors.append(f"{display}: {err}")
             continue
         module = module_name_for(path, roots)
         source = SourceInfo.from_source(display, text)
         registry.add_module(module, display, tree, source)
+        if is_context:
+            continue
         sources[module] = source
         modules.append((module, path))
+        result.scanned_paths.append(display)
         result.files_scanned += 1
 
     # pass 2: rules
@@ -174,6 +245,7 @@ def analyze_source(text: str, path: str = "<string>", module: Optional[str] = No
     source = SourceInfo.from_source(path, text)
     mod_name = module or Path(path).stem
     mod = registry.add_module(mod_name, path, tree, source)
+    result.scanned_paths.append(path)
     result.files_scanned = 1
     # kernels always scanned here: single-blob callers (tests, fixtures) have
     # no package layout to gate on

@@ -29,6 +29,87 @@ METRIC_QUALNAMES = {f"{PACKAGE}.metric.Metric", f"{PACKAGE}.Metric"}
 MUTATOR_METHODS = {"append", "extend", "insert", "remove", "pop", "clear", "add", "update", "popitem", "setdefault"}
 
 
+@dataclass(frozen=True)
+class MutationSite:
+    """One ``self``-attribute mutation found inside a method body.
+
+    The single source of truth for "what counts as a mutation": both the
+    registry's per-class index (certification) and the R1 rule (reporting)
+    consume :func:`iter_self_mutations`, so a pattern one side recognizes can
+    never silently escape the other (the pre-fix drift: getattr-receiver
+    mutations uncertified a class but produced no R1 report).
+
+    ``attr`` is None for dynamic sites (receiver or attribute name not
+    statically known). ``kind`` is one of ``"assign"`` (plain/aug/ann
+    assignment), ``"item"`` (subscript assignment), ``"call"``
+    (``self.x.append(...)``-style mutator), ``"setattr"``
+    (``setattr(self, "x", ...)``), ``"getattr-call"``
+    (``getattr(self, "x").append(...)``). ``method`` carries the mutator
+    method name for the call kinds.
+    """
+
+    attr: Optional[str]
+    lineno: int
+    kind: str
+    method: Optional[str] = None
+
+
+def iter_self_mutations(func: ast.FunctionDef) -> List[MutationSite]:
+    """Every ``self``-attribute mutation site in ``func``'s body."""
+    out: List[MutationSite] = []
+    for sub in ast.walk(func):
+        if isinstance(sub, ast.Call):
+            fn = sub.func
+            # setattr(self, <name>, ...)
+            if isinstance(fn, ast.Name) and fn.id == "setattr" and sub.args:
+                tgt = sub.args[0]
+                if isinstance(tgt, ast.Name) and tgt.id == "self":
+                    name_arg = sub.args[1] if len(sub.args) > 1 else None
+                    if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
+                        out.append(MutationSite(name_arg.value, sub.lineno, "setattr"))
+                    else:
+                        out.append(MutationSite(None, sub.lineno, "setattr"))
+            # self.<attr>.append(...) / getattr(self, <name>).append(...)
+            if isinstance(fn, ast.Attribute) and fn.attr in MUTATOR_METHODS:
+                if (
+                    isinstance(fn.value, ast.Attribute)
+                    and isinstance(fn.value.value, ast.Name)
+                    and fn.value.value.id == "self"
+                ):
+                    out.append(MutationSite(fn.value.attr, sub.lineno, "call", method=fn.attr))
+                elif (
+                    isinstance(fn.value, ast.Call)
+                    and isinstance(fn.value.func, ast.Name)
+                    and fn.value.func.id == "getattr"
+                    and fn.value.args
+                    and isinstance(fn.value.args[0], ast.Name)
+                    and fn.value.args[0].id == "self"
+                ):
+                    name_arg = fn.value.args[1] if len(fn.value.args) > 1 else None
+                    if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
+                        out.append(MutationSite(name_arg.value, sub.lineno, "getattr-call", method=fn.attr))
+                    else:
+                        out.append(MutationSite(None, sub.lineno, "getattr-call", method=fn.attr))
+            continue
+        targets: Iterable[ast.expr] = ()
+        if isinstance(sub, ast.Assign):
+            targets = sub.targets
+        elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
+            targets = (sub.target,)
+        for tgt in targets:
+            for leaf in _assign_leaves(tgt):
+                if isinstance(leaf, ast.Attribute) and isinstance(leaf.value, ast.Name) and leaf.value.id == "self":
+                    out.append(MutationSite(leaf.attr, leaf.lineno, "assign"))
+                elif (
+                    isinstance(leaf, ast.Subscript)
+                    and isinstance(leaf.value, ast.Attribute)
+                    and isinstance(leaf.value.value, ast.Name)
+                    and leaf.value.value.id == "self"
+                ):
+                    out.append(MutationSite(leaf.value.attr, leaf.lineno, "item"))
+    return out
+
+
 @dataclass
 class ClassInfo:
     name: str
@@ -104,7 +185,6 @@ def _scan_class(node: ast.ClassDef, module: str, path: str) -> ClassInfo:
         if isinstance(item, ast.AsyncFunctionDef):
             continue
         info.methods[item.name] = item
-        mutated: Set[str] = set()
         for sub in ast.walk(item):
             if isinstance(sub, ast.Call):
                 fn = sub.func
@@ -122,57 +202,16 @@ def _scan_class(node: ast.ClassDef, module: str, path: str) -> ClassInfo:
                         info.own_states.add(name_arg.value)
                     else:
                         info.dynamic_add_state = True
-                # setattr(self, <dynamic>, ...)
-                if isinstance(fn, ast.Name) and fn.id == "setattr" and sub.args:
-                    tgt = sub.args[0]
-                    if isinstance(tgt, ast.Name) and tgt.id == "self":
-                        name_arg = sub.args[1] if len(sub.args) > 1 else None
-                        if not (isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str)):
-                            info.dynamic_setattr_methods.add(item.name)
-                        else:
-                            mutated.add(name_arg.value)
-                # self.<attr>.append(...) etc.
-                if isinstance(fn, ast.Attribute) and fn.attr in MUTATOR_METHODS:
-                    if (
-                        isinstance(fn.value, ast.Attribute)
-                        and isinstance(fn.value.value, ast.Name)
-                        and fn.value.value.id == "self"
-                    ):
-                        mutated.add(fn.value.attr)
-                    elif (
-                        # getattr(self, <dynamic>).append(...): the receiver
-                        # cannot be named statically, so R1 certification must
-                        # treat the whole method as dynamically mutating
-                        isinstance(fn.value, ast.Call)
-                        and isinstance(fn.value.func, ast.Name)
-                        and fn.value.func.id == "getattr"
-                        and fn.value.args
-                        and isinstance(fn.value.args[0], ast.Name)
-                        and fn.value.args[0].id == "self"
-                    ):
-                        name_arg = fn.value.args[1] if len(fn.value.args) > 1 else None
-                        if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
-                            mutated.add(name_arg.value)
-                        else:
-                            info.dynamic_setattr_methods.add(item.name)
-            targets: Iterable[ast.expr] = ()
-            if isinstance(sub, ast.Assign):
-                targets = sub.targets
-            elif isinstance(sub, (ast.AugAssign, ast.AnnAssign)):
-                targets = (sub.target,)
-            for tgt in targets:
-                for leaf in _assign_leaves(tgt):
-                    if isinstance(leaf, ast.Attribute) and isinstance(leaf.value, ast.Name) and leaf.value.id == "self":
-                        mutated.add(leaf.attr)
-                        if leaf.attr == "validate_args":
-                            info.sets_validate_args = True
-                    elif (
-                        isinstance(leaf, ast.Subscript)
-                        and isinstance(leaf.value, ast.Attribute)
-                        and isinstance(leaf.value.value, ast.Name)
-                        and leaf.value.value.id == "self"
-                    ):
-                        mutated.add(leaf.value.attr)
+        # the mutation index and the R1 rule share one walker (MutationSite),
+        # so certification and reporting can never drift apart again
+        mutated: Set[str] = set()
+        for site in iter_self_mutations(item):
+            if site.attr is None:
+                info.dynamic_setattr_methods.add(item.name)
+                continue
+            mutated.add(site.attr)
+            if site.kind == "assign" and site.attr == "validate_args":
+                info.sets_validate_args = True
         if mutated:
             info.mutated_attrs[item.name] = mutated
     info.declares_traced_flags = "_traced_value_flags" in info.methods

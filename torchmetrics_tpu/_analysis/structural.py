@@ -7,20 +7,42 @@ declarations) rather than value flow, so they live on top of the
 
 from __future__ import annotations
 
-import ast
 from typing import List, Set
 
 from torchmetrics_tpu._analysis.model import SourceInfo, Violation
-from torchmetrics_tpu._analysis.registry import MUTATOR_METHODS, ClassInfo, Registry
+from torchmetrics_tpu._analysis.registry import ClassInfo, MutationSite, Registry, iter_self_mutations
 
 # methods whose bodies replay under trace and are fingerprint-guarded
 TRACED_METHODS = ("update", "compute")
+
+# how one MutationSite kind reads in the R1 message
+_SITE_VERBS = {
+    "assign": "assignment to",
+    "item": "item-assignment into",
+    "setattr": "assignment to",
+}
+
+_DYNAMIC_SITE_MESSAGES = {
+    "setattr": "dynamic `setattr(self, ...)` in a traced method cannot be proven state-safe",
+    "getattr-call": (
+        "mutating call on a dynamic `getattr(self, ...)` receiver in a traced method"
+        " cannot be proven state-safe"
+    ),
+}
+
+
+def _site_verb(site: MutationSite) -> str:
+    if site.kind in ("call", "getattr-call"):
+        return f"`.{site.method}()` on"
+    return _SITE_VERBS[site.kind]
 
 
 def check_r1(cls: ClassInfo, registry: Registry, source: SourceInfo) -> List[Violation]:
     """Flag ``self.<attr>`` mutation in ``update``/``compute`` for attrs never
     registered via ``add_state`` (underscore attrs are metric machinery and
-    exempt, mirroring the runtime guard)."""
+    exempt, mirroring the runtime guard). Mutation discovery is shared with
+    the registry's certification index (:func:`iter_self_mutations`), so any
+    site that uncertifies a class also reports here."""
     out: List[Violation] = []
     states, dynamic = registry.registered_states(cls)
 
@@ -29,48 +51,20 @@ def check_r1(cls: ClassInfo, registry: Registry, source: SourceInfo) -> List[Vio
         if func is None:
             continue
         scope = f"{cls.name}.{method_name}"
-        for node in ast.walk(func):
-            if isinstance(node, ast.Call):
-                fn = node.func
-                if isinstance(fn, ast.Name) and fn.id == "setattr" and node.args:
-                    tgt, name_arg = node.args[0], node.args[1] if len(node.args) > 1 else None
-                    if isinstance(tgt, ast.Name) and tgt.id == "self":
-                        if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
-                            _flag_attr(out, cls, source, scope, node.lineno, name_arg.value, states, dynamic)
-                        else:
-                            v = source.violation(
-                                "R1", node.lineno, scope,
-                                "dynamic `setattr(self, ...)` in a traced method cannot be proven state-safe",
-                            )
-                            if v:
-                                out.append(v)
-                if (
-                    isinstance(fn, ast.Attribute)
-                    and fn.attr in MUTATOR_METHODS
-                    and isinstance(fn.value, ast.Attribute)
-                    and isinstance(fn.value.value, ast.Name)
-                    and fn.value.value.id == "self"
-                ):
-                    _flag_attr(out, cls, source, scope, node.lineno, fn.value.attr, states, dynamic,
-                               verb=f"`.{fn.attr}()` on")
-                continue
-            targets: List[ast.expr] = []
-            if isinstance(node, ast.Assign):
-                targets = list(node.targets)
-            elif isinstance(node, (ast.AugAssign, ast.AnnAssign)):
-                targets = [node.target]
-            for tgt in targets:
-                for leaf in _leaves(tgt):
-                    if isinstance(leaf, ast.Attribute) and isinstance(leaf.value, ast.Name) and leaf.value.id == "self":
-                        _flag_attr(out, cls, source, scope, leaf.lineno, leaf.attr, states, dynamic)
-                    elif (
-                        isinstance(leaf, ast.Subscript)
-                        and isinstance(leaf.value, ast.Attribute)
-                        and isinstance(leaf.value.value, ast.Name)
-                        and leaf.value.value.id == "self"
-                    ):
-                        _flag_attr(out, cls, source, scope, leaf.lineno, leaf.value.attr, states, dynamic,
-                                   verb="item-assignment into")
+        for site in iter_self_mutations(func):
+            if site.attr is None:
+                if dynamic:
+                    # some chain class registers states dynamically, so a
+                    # dynamic site is as likely a registered-state mutation
+                    # as not — same guesswork gate as named attrs below
+                    # (certification still refuses the class either way)
+                    continue
+                v = source.violation("R1", site.lineno, scope, _DYNAMIC_SITE_MESSAGES[site.kind])
+                if v:
+                    out.append(v)
+            else:
+                _flag_attr(out, cls, source, scope, site.lineno, site.attr, states, dynamic,
+                           verb=_site_verb(site))
     return out
 
 
@@ -136,13 +130,3 @@ def r1_certifiable(cls: ClassInfo, registry: Registry) -> bool:
         if any(m not in ("__init__",) for m in c.dynamic_setattr_methods):
             return False
     return True
-
-
-def _leaves(tgt: ast.expr):
-    if isinstance(tgt, (ast.Tuple, ast.List)):
-        for elt in tgt.elts:
-            yield from _leaves(elt)
-    elif isinstance(tgt, ast.Starred):
-        yield from _leaves(tgt.value)
-    else:
-        yield tgt

@@ -24,6 +24,7 @@ Three hardened seams (see RESILIENCE.md for the full cookbook):
 from torchmetrics_tpu._resilience.errors import (
     CollectiveTimeoutError,
     GuardedSyncError,
+    SnapshotRestoreError,
     StateCorruptionError,
     StateStructureMismatchError,
     SyncRetriesExhausted,
@@ -35,10 +36,12 @@ from torchmetrics_tpu._resilience.policy import (
     DegradationEvent,
     ResilienceReport,
     RetryPolicy,
+    SnapshotPolicy,
     SyncPolicy,
     default_sync_policy,
     set_default_sync_policy,
 )
+from torchmetrics_tpu._resilience.snapshot import SNAPSHOT_VERSION, RestoreReport, SnapshotManager
 
 __all__ = [
     "CollectiveTimeoutError",
@@ -47,7 +50,12 @@ __all__ = [
     "INTEGRITY_VERSION",
     "NAN_POLICIES",
     "ResilienceReport",
+    "RestoreReport",
     "RetryPolicy",
+    "SNAPSHOT_VERSION",
+    "SnapshotManager",
+    "SnapshotPolicy",
+    "SnapshotRestoreError",
     "StateCorruptionError",
     "StateStructureMismatchError",
     "SyncPolicy",

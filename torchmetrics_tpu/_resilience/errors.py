@@ -49,6 +49,20 @@ class StateStructureMismatchError(TorchMetricsUserError):
     """
 
 
+class SnapshotRestoreError(TorchMetricsUserError):
+    """No snapshot generation could be restored.
+
+    Raised by ``SnapshotManager.restore_latest`` when the snapshot directory
+    holds no generation at all, or when every retained generation failed
+    verification (file checksum, unpickling, or per-state integrity).
+    Carries ``failures``: ``{generation: reason}`` for each attempt.
+    """
+
+    def __init__(self, message: str, failures: dict | None = None):
+        super().__init__(message)
+        self.failures = dict(failures or {})
+
+
 class StateCorruptionError(TorchMetricsUserError):
     """A checkpoint failed integrity verification on restore.
 

@@ -43,6 +43,7 @@ __all__ = [
     "inject_collective_failure",
     "inject_collective_timeout",
     "corrupt_state_dict",
+    "corrupt_file",
     "poison_nans",
     "nan_batches",
 ]
@@ -204,6 +205,36 @@ def corrupt_state_dict(
     else:
         out[key] = corrupted
     return out
+
+
+def corrupt_file(path: Any, mode: str = "bitflip", seed: int = 0) -> None:
+    """Deterministically corrupt one on-disk file in place.
+
+    ``mode="bitflip"`` inverts one byte at a seed-chosen offset past any
+    header region (a storage fault the snapshot layer's file checksum must
+    catch); ``mode="truncate"`` cuts the file at a seed-chosen point (a
+    crash mid-write / torn journal tail). Backs the chaos harness's
+    corrupted-generation and truncated-journal faults.
+    """
+    import pathlib
+
+    if mode not in ("bitflip", "truncate"):
+        raise ValueError(f"unknown file corruption mode {mode!r}; expected 'bitflip' or 'truncate'")
+    p = pathlib.Path(path)
+    raw = bytearray(p.read_bytes())
+    if not raw:
+        return
+    rng = np.random.default_rng(seed)
+    if mode == "bitflip":
+        # skip the first 8 bytes so a magic-prefix check alone can't mask a
+        # payload corruption — the checksum must do the catching
+        lo = min(8, len(raw) - 1)
+        pos = int(rng.integers(lo, len(raw)))
+        raw[pos] ^= 0xFF
+        p.write_bytes(bytes(raw))
+    else:
+        cut = int(rng.integers(1, len(raw))) if len(raw) > 1 else 0
+        p.write_bytes(bytes(raw[:cut]))
 
 
 def poison_nans(array: Any, frac: float = 0.5) -> Any:

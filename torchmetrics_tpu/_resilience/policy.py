@@ -20,6 +20,7 @@ from typing import Optional, Tuple
 __all__ = [
     "RetryPolicy",
     "SyncPolicy",
+    "SnapshotPolicy",
     "DegradationEvent",
     "ResilienceReport",
     "NAN_POLICIES",
@@ -111,15 +112,67 @@ class SyncPolicy:
 
 
 @dataclass(frozen=True)
+class SnapshotPolicy:
+    """Cadence/durability configuration for a :class:`~torchmetrics_tpu._resilience.snapshot.SnapshotManager`.
+
+    A snapshot is taken whenever any armed trigger fires, evaluated at
+    update boundaries (there is no timer thread — an idle metric is not
+    re-snapshotted): after ``every_n_updates`` journaled updates, after
+    ``every_seconds`` of wall clock since the last snapshot, or when the
+    post-snapshot journal reaches ``journal_max_entries`` (the journal bound
+    that keeps restore replay small). ``keep`` is the number of snapshot
+    generations retained for corruption fallback (journals are kept for
+    every retained generation, so a lost/corrupt newest snapshot is bridged
+    by replaying the older generation's journal chain).
+
+    ``async_write`` serializes state inline (a consistent capture on the
+    caller's thread) but performs the write+fsync+rename on a background
+    daemon writer; a crash before the write lands is covered by the journal
+    chain. ``fsync_journal`` additionally fsyncs after every journal entry:
+    per-entry flush (the default) already survives process death —
+    preemption kills the process, not the kernel — while fsync extends
+    durability to machine crashes at a per-update IO cost.
+    """
+
+    every_n_updates: Optional[int] = None
+    every_seconds: Optional[float] = 30.0
+    keep: int = 2
+    journal_max_entries: int = 256
+    async_write: bool = True
+    fsync_journal: bool = False
+
+    def __post_init__(self) -> None:
+        if self.every_n_updates is not None and self.every_n_updates < 1:
+            raise ValueError(f"`every_n_updates` must be >= 1 or None, got {self.every_n_updates}")
+        if self.every_seconds is not None and self.every_seconds <= 0:
+            raise ValueError(f"`every_seconds` must be positive or None, got {self.every_seconds}")
+        if self.keep < 1:
+            raise ValueError(f"`keep` must be >= 1, got {self.keep}")
+        if self.keep < 2:
+            import warnings
+
+            warnings.warn(
+                "SnapshotPolicy(keep=1) leaves no older generation to fall back to when the"
+                " newest snapshot is corrupted; keep >= 2 is strongly recommended.",
+                stacklevel=3,
+            )
+        if self.journal_max_entries < 1:
+            raise ValueError(f"`journal_max_entries` must be >= 1, got {self.journal_max_entries}")
+
+
+@dataclass(frozen=True)
 class DegradationEvent:
     """One recorded degradation on a metric (queryable via ``resilience_report``).
 
     ``kind`` is a stable short string: ``"sync_degraded"`` (collective
     retries exhausted, local-only compute), ``"handshake_degraded"``
     (handshake transport failed, local-only compute), ``"nan_quarantine"``
-    (a batch's state contribution was rolled back by the NaN sentinel), or
+    (a batch's state contribution was rolled back by the NaN sentinel),
     ``"state_repair"`` (``load_state_dict(strict="repair")`` reset corrupted
-    states).
+    states), ``"snapshot_degraded"`` (the attached SnapshotManager hit an
+    IO error and disabled itself), or ``"snapshot_restore"``
+    (``restore_latest`` fell back past a corrupted generation or a
+    truncated journal).
     """
 
     kind: str

@@ -76,6 +76,10 @@ class MetricCollection:
         self._groups_checked = False
         self._state_is_copy = False
         self._groups: Dict[int, List[str]] = {}
+        # collection-level update-journal hook: one SnapshotManager attached
+        # here journals whole-collection updates (members stay hook-free, so
+        # nothing is double-journaled)
+        self._snapshot_hook: Optional[Any] = None
 
         self.add_metrics(metrics, *additional_metrics)
 
@@ -154,6 +158,18 @@ class MetricCollection:
             else:
                 self._groups = {i: [name] for i, name in enumerate(self._modules)}
                 self._groups_checked = True
+        self._journal_record("update", args, kwargs)
+
+    def _journal_record(self, method: str, args: tuple, kwargs: Dict[str, Any]) -> None:
+        """Feed one completed collection-wide update to the SnapshotManager.
+
+        Fires after every member (or group head + state rebind) committed,
+        so a snapshot triggered here always captures a mutually consistent
+        member-state set.
+        """
+        hook = self.__dict__.get("_snapshot_hook")
+        if hook is not None:
+            hook.record(self, method, args, kwargs)
 
     def _merge_compute_groups(self) -> None:
         """Pairwise-merge metrics whose states are identical (reference ``collections.py:228-262``)."""
@@ -221,6 +237,9 @@ class MetricCollection:
         res = {name: m(*args, **m._filter_kwargs(**kwargs)) for name, m in self._modules.items()}
         if not self._groups_checked and self._enable_compute_groups:
             self._merge_compute_groups()
+        # forward and update produce the same accumulated state, so the
+        # journal replays either through collection.update()
+        self._journal_record("update", args, kwargs)
         return self._flatten_results(res)
 
     def compute(self) -> Dict[str, Any]:
@@ -261,6 +280,9 @@ class MetricCollection:
                 m.reset()
             except RuntimeError as err:
                 pending = pending or err
+        # journaled for the same reason as Metric.reset: restore must not
+        # resurrect accumulation a mid-stream reset discarded
+        self._journal_record("reset", (), {})
         if pending is not None:
             raise pending
 
@@ -276,10 +298,10 @@ class MetricCollection:
         for m in self._modules.values():
             m.persistent(mode)
 
-    def state_dict(self, prefix: str = "", integrity: bool = False) -> Dict[str, Any]:
+    def state_dict(self, prefix: str = "", integrity: bool = False, all_states: bool = False) -> Dict[str, Any]:
         destination: Dict[str, Any] = {}
         for name, m in self._modules.items():
-            m.state_dict(destination, prefix=f"{prefix}{name}.", integrity=integrity)
+            m.state_dict(destination, prefix=f"{prefix}{name}.", integrity=integrity, all_states=all_states)
         return destination
 
     def load_state_dict(
@@ -311,6 +333,7 @@ class MetricCollection:
             # the pre-pass hashed every state: members skip re-verification
             for name, m in self._modules.items():
                 m.load_state_dict(state_dict, strict=strict, prefix=f"{prefix}{name}.", _verified=True)
+            self._journal_record("external", (), {})
             return
         # repair mode: member verification never raises EXCEPT on an unknown
         # schema version — validate every block up front so a bad block on a
@@ -321,6 +344,8 @@ class MetricCollection:
                 _integrity.validate_version(meta, type(m).__name__)
         for name, m in self._modules.items():
             m.load_state_dict(state_dict, strict=strict, prefix=f"{prefix}{name}.")
+        # mid-stream manual load: anchor the un-journalable transition
+        self._journal_record("external", (), {})
 
     # ------------------------------------------------------------- resilience
     def set_resilience_policy(self, **kwargs: Any) -> "MetricCollection":

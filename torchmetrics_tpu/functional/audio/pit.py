@@ -22,14 +22,16 @@ Array = jax.Array
 
 _MAX_EXHAUSTIVE_SPK = 6
 
-# permutation tables are static per speaker count
+# permutation tables are static per speaker count; cached as HOST numpy —
+# caching the jnp array would capture a tracer constant when the first call
+# happens inside a jit trace, poisoning every later eager call
 _ps_cache: dict = {}
 
 
 def _gen_permutations(spk_num: int) -> Array:
     if spk_num not in _ps_cache:
-        _ps_cache[spk_num] = jnp.asarray(np.asarray(list(permutations(range(spk_num))), dtype=np.int32))
-    return _ps_cache[spk_num]
+        _ps_cache[spk_num] = np.asarray(list(permutations(range(spk_num))), dtype=np.int32)
+    return jnp.asarray(_ps_cache[spk_num])
 
 
 def _find_best_perm_by_exhaustive_method(metric_mtx: Array, eval_func: str) -> Tuple[Array, Array]:

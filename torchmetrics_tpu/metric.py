@@ -32,6 +32,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+# module scope (not inside `_fingerprint_exempt`): the exemption check sits on
+# the eager per-update hot path, where a function-level import costs a dict
+# lookup + lock round-trip per call; manifest.py imports nothing heavy
+from torchmetrics_tpu._analysis.manifest import fingerprint_skip_allowed
 from torchmetrics_tpu.utilities.data import (
     dim_zero_cat,
     dim_zero_max,
@@ -175,6 +179,11 @@ class Metric(ABC):
         self._validate_resilience_knobs()
         self._resilience_events: List[Any] = []
         self._quarantined_updates: int = 0
+        # update-journal hook: a SnapshotManager (RESILIENCE.md "Snapshots")
+        # binds itself here; every completed update/forward then journals
+        # its batch arguments for preemption-safe restore+replay. None (the
+        # default) costs one dict probe per update on the hot path.
+        self._snapshot_hook: Optional[Any] = None
         if kwargs:
             kwargs_ = [f"`{a}`" for a in sorted(kwargs)]
             raise ValueError(f"Unexpected keyword arguments: {', '.join(kwargs_)}")
@@ -296,11 +305,25 @@ class Metric(ABC):
                 "The Metric shouldn't be synced when performing ``forward``. "
                 "HINT: Did you forget to call ``unsync``?"
             )
-        if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
-            self._forward_cache = self._forward_full_state_update(*args, **kwargs)
-        else:
-            handled, batch_val = self._try_auto_forward(args, kwargs)
-            self._forward_cache = batch_val if handled else self._forward_reduce_state_update(*args, **kwargs)
+        # the stash/reset/update/compute/merge dance runs update() on
+        # batch-local state: suspend the snapshot journal for its duration
+        # and record the batch ONCE below, when the global state is final
+        suspended = "_journal_suspend" in self.__dict__
+        if not suspended:
+            self.__dict__["_journal_suspend"] = True
+        try:
+            if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
+                self._forward_cache = self._forward_full_state_update(*args, **kwargs)
+            else:
+                handled, batch_val = self._try_auto_forward(args, kwargs)
+                self._forward_cache = batch_val if handled else self._forward_reduce_state_update(*args, **kwargs)
+        finally:
+            if not suspended:
+                self.__dict__.pop("_journal_suspend", None)
+        # replay re-runs forward entries through plain update(): the state
+        # transition is identical, only the (recomputed-anyway) batch value
+        # differs — so the journal tags them "update"
+        self._journal_record("update", args, kwargs)
         return self._forward_cache
 
     def _forward_full_state_update(self, *args: Any, **kwargs: Any) -> Any:
@@ -464,6 +487,7 @@ class Metric(ABC):
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
             if self._try_auto_update(args, kwargs):
+                self._journal_record("update", args, kwargs)
                 return None
             self._check_pending_violations()
             self._computed = None
@@ -510,10 +534,25 @@ class Metric(ABC):
                 self._apply_dtype_policy()
             if self.compute_on_cpu:
                 self._move_list_states_to_cpu()
+            self._journal_record("update", args, kwargs)
             return None
 
         wrapped_func.__wrapped_by_metric__ = True  # type: ignore[attr-defined]
         return wrapped_func
+
+    def _journal_record(self, method: str, args: tuple, kwargs: Dict[str, Any]) -> None:
+        """Feed one *completed* state transition to the attached SnapshotManager.
+
+        Runs only after the update committed (and after quarantine rollback,
+        dtype policy, and CPU offload), so the journal never records a batch
+        whose effects are not durably represented by replaying it. Inner
+        updates of the forward stash/reset dance are suppressed via
+        ``_journal_suspend`` — mid-dance state is batch-local and must not
+        be journaled or snapshotted.
+        """
+        hook = self.__dict__.get("_snapshot_hook")
+        if hook is not None and "_journal_suspend" not in self.__dict__:
+            hook.record(self, method, args, kwargs)
 
     def _fingerprint_exempt(self) -> bool:
         """True when the R1-certified manifest covers this instance's class.
@@ -524,8 +563,6 @@ class Metric(ABC):
         ``_host_attr_snapshot`` fingerprint is redundant work. Any class the
         analyzer has not seen (user subclasses included) keeps the guard.
         """
-        from torchmetrics_tpu._analysis.manifest import fingerprint_skip_allowed
-
         # per-class memoization lives in the manifest module, so the runtime
         # toggle (set_fingerprint_skip_enabled) invalidates in one place
         return fingerprint_skip_allowed(type(self))
@@ -1543,6 +1580,7 @@ class Metric(ABC):
         self._computed = None
         self._update_count += 1
         self._commit_compiled_states(names, states, new_states, sig)
+        self._journal_record("update", args, kwargs)
 
     def scan_update(self, *args: Any, **kwargs: Any) -> None:
         """Consume a whole stacked stream of batches in one ``lax.scan``.
@@ -1584,6 +1622,9 @@ class Metric(ABC):
         self._computed = None
         self._update_count += n_steps
         self._commit_compiled_states(names, states, new_states, sig)
+        # "scan" replays through scan_update: the args carry a leading
+        # stream axis that plain update() must not see as one batch
+        self._journal_record("scan", args, kwargs)
 
     def merge_state(self, incoming: Union["Metric", Dict[str, Any]]) -> None:
         """Merge another metric's (or raw state dict's) state into this one.
@@ -1601,6 +1642,14 @@ class Metric(ABC):
         else:
             incoming_state = incoming
             incoming_count = 1
+        self._merge_from(incoming_state, incoming_count)
+        # a merge is a real stream transition: journal it (state + count) so
+        # a post-crash restore replays the merged contribution too
+        self._journal_record(
+            "merge", ({k: incoming_state[k] for k in self._defaults}, incoming_count), {}
+        )
+
+    def _merge_from(self, incoming_state: Dict[str, Any], incoming_count: int) -> None:
         prev_count = self._update_count
         self._update_count = prev_count + incoming_count
         current = self._copy_state_dict()
@@ -1631,6 +1680,10 @@ class Metric(ABC):
             self._reset_state_to_default(attr)
         self._cache = None
         self._is_synced = False
+        # a mid-stream reset is a state transition like any other: without a
+        # journal entry, a post-reset crash would restore (pre-reset snapshot
+        # + full journal) and resurrect the accumulation reset() discarded
+        self._journal_record("reset", (), {})
         if pending is not None:
             raise pending
 
@@ -1678,6 +1731,7 @@ class Metric(ABC):
         prefix: str = "",
         keep_vars: bool = False,
         integrity: bool = False,
+        all_states: bool = False,
     ) -> Dict:
         """Serialize persistent states to host numpy (reference ``metric.py:839-871``).
 
@@ -1686,10 +1740,15 @@ class Metric(ABC):
         (see ``torchmetrics_tpu/_resilience/integrity.py``): restores then
         verify per-state checksums and the schema version, rejecting corrupt
         or NaN-poisoned checkpoints instead of silently loading them.
+
+        ``all_states=True`` serializes every registered state regardless of
+        its ``persistent`` flag — the contract the snapshot/durability layer
+        needs (a preemption must not lose non-persistent accumulators), as
+        opposed to the portability contract of ordinary checkpoints.
         """
         destination = {} if destination is None else destination
         for key in self._defaults:
-            if not self._persistent[key]:
+            if not (all_states or self._persistent[key]):
                 continue
             current = getattr(self, key)
             if isinstance(current, RingBuffer):
@@ -1788,6 +1847,9 @@ class Metric(ABC):
             self._computed = None
         # restored dtypes/shapes may differ from what the last handshake saw
         self.__dict__.pop("_handshake_ok_digest", None)
+        # a mid-stream manual load is a state transition replay can't
+        # reconstruct from update entries: anchor it with a fresh snapshot
+        self._journal_record("external", (), {})
 
     def __getstate__(self) -> Dict[str, Any]:
         """Pickle support: drop wrapped bound methods, numpy-ify arrays (reference ``metric.py:694-702``)."""
@@ -1807,6 +1869,9 @@ class Metric(ABC):
                 "_auto_fwd_sigs",
                 "_auto_cnt",
                 "_ring_count_deltas",
+                # a SnapshotManager holds threads + file handles: clones and
+                # pickles travel without it (re-attach at the destination)
+                "_snapshot_hook",
             )
         }
         for attr in self._defaults:
@@ -1854,6 +1919,7 @@ class Metric(ABC):
         self.__dict__.setdefault("_sync_policy_explicit", False)
         self.__dict__.setdefault("_resilience_events", [])
         self.__dict__.setdefault("_quarantined_updates", 0)
+        self.__dict__.setdefault("_snapshot_hook", None)
 
     def __setattr__(self, name: str, value: Any) -> None:
         """Class-flag immutability guard (reference ``metric.py:715-726``)."""
