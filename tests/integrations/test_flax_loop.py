@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 import optax
 from flax import linen as nn
-from jax import shard_map
+from torchmetrics_tpu.utilities.distributed import shard_map  # version-portable (jax<0.6 lacks jax.shard_map)
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from torchmetrics_tpu import MetricCollection

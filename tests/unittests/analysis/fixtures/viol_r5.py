@@ -1,4 +1,9 @@
-"""True-positive fixture for R5: `validate_args` without a traced validator."""
+"""True-positive fixture for R5: `validate_args` without a traced validator.
+
+The eager path carries a genuine VALUE check (host-synced range check in a
+helper), so the eligibility prover classifies the class verdict-(b) — it
+cannot auto-compile without a `_traced_value_flags` port, and R5 must fire.
+"""
 
 import jax.numpy as jnp
 
@@ -11,7 +16,13 @@ class BadMissingValidator(Metric):
         self.validate_args = validate_args
         self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
 
+    def _check_values(self, preds) -> None:
+        if bool(jnp.any(preds < 0)):
+            raise ValueError("Expected only non-negative predictions.")
+
     def update(self, preds) -> None:
+        if self.validate_args:
+            self._check_values(preds)
         self.total = self.total + preds.sum()
 
     def compute(self):

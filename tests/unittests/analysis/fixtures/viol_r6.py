@@ -1,0 +1,32 @@
+"""True-positive fixture for R6: a declared `_traced_value_flags` that misses
+a value check the eligibility prover finds on the eager update path (here the
+finiteness check on `preds` — only the target range check is mirrored)."""
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+
+class BadIncompleteValidator(Metric):
+    def __init__(self, validate_args: bool = True, **kwargs):
+        super().__init__(**kwargs)
+        self.validate_args = validate_args
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def _check_values(self, preds, target) -> None:
+        if bool(jnp.any(target > 1)):
+            raise RuntimeError("Detected values in `target` outside the expected set.")
+        if bool(jnp.any(jnp.isnan(preds))):
+            raise RuntimeError("Encountered `nan` values in `preds`.")
+
+    def update(self, preds, target) -> None:
+        if self.validate_args:
+            self._check_values(preds, target)
+        self.total = self.total + preds.sum()
+
+    def _traced_value_flags(self, preds, target):
+        msgs = ("Detected values in `target` outside the expected set.",)
+        return msgs, jnp.any(target > 1)[None]
+
+    def compute(self):
+        return self.total

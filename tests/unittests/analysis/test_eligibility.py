@@ -1,0 +1,455 @@
+"""Unit suite for the interprocedural compile-eligibility prover.
+
+Each test feeds a small in-memory module through ``analyze_source`` and pins
+one prover behavior: verdict assignment, interprocedural check discovery
+with subject substitution, concrete-gate handling, pattern kinds, blocker
+citation, and the R6 completeness gate (including negative cases).
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu._analysis import analyze_source, compiled_validation_eligible
+from torchmetrics_tpu._analysis.manifest import set_eligibility_enabled
+
+HEADER = """
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu.metric import Metric
+
+
+def _is_concrete(x):
+    return True
+
+"""
+
+
+def _eligibility(src, cls_name):
+    result = analyze_source(HEADER + src, path="fixture.py")
+    assert not result.parse_errors, result.parse_errors
+    hits = [v for q, v in result.eligibility.items() if q.endswith(f".{cls_name}")]
+    assert hits, f"{cls_name} not analyzed; saw {list(result.eligibility)}"
+    return hits[0], result
+
+
+class TestVerdicts:
+    def test_metadata_only_shape_checks(self):
+        src = """
+def _validate(preds, target):
+    if preds.shape != target.shape:
+        raise ValueError("shape mismatch")
+    if preds.ndim > 2:
+        raise ValueError("too many dims")
+
+
+class M(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.validate_args = True
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target):
+        _validate(preds, target)
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+"""
+        res, _ = _eligibility(src, "M")
+        assert res.verdict == "metadata_only"
+        assert res.checks == [] and res.blockers == []
+
+    def test_value_check_through_functional_helper_substitutes_subject(self):
+        # class update -> helper -> nested helper: the check surfaces with the
+        # UPDATE-level argument name, not the helper's formal name
+        src = """
+def _inner_range(t, n):
+    if _is_concrete(t):
+        arr = np.asarray(t)
+        if arr.size and (arr.min() < 0 or arr.max() >= n):
+            raise RuntimeError("label out of range")
+
+
+def _validate(p, t, n):
+    _inner_range(t, n)
+
+
+class M(Metric):
+    def __init__(self, num_classes: int = 3, **kw):
+        super().__init__(**kw)
+        self.validate_args = True
+        self.num_classes = num_classes
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target):
+        _validate(preds, target, self.num_classes)
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+"""
+        res, _ = _eligibility(src, "M")
+        assert res.verdict == "value_flags"
+        assert [(c.kind, c.subject) for c in res.checks] == [("range", "target")]
+        assert res.checks[0].severity == "error"
+        assert res.checks[0].line > 0 and res.checks[0].path == "fixture.py"
+
+    def test_concrete_gate_hides_hazards_but_not_checks(self):
+        # np.* on traced values inside an `_is_concrete` block is a host
+        # fallback, not a blocker — but the check it guards is inventory
+        src = """
+class M(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.validate_args = True
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        if _is_concrete(preds):
+            vals = np.asarray(preds)
+            if (vals > 1).any() or (vals < 0).any():
+                raise ValueError("probabilities expected")
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+"""
+        res, _ = _eligibility(src, "M")
+        assert res.verdict == "value_flags"
+        assert [(c.kind, c.subject) for c in res.checks] == [("range", "preds")]
+        assert res.blockers == []
+
+    def test_finiteness_and_set_kinds(self):
+        src = """
+class M(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.validate_args = True
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target):
+        nans = jnp.isnan(preds)
+        if bool(jnp.any(nans)):
+            raise RuntimeError("nan")
+        if bool(jnp.any((target != 0) & (target != 1))):
+            raise RuntimeError("bad target")
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total
+"""
+        res, _ = _eligibility(src, "M")
+        kinds = {(c.kind, c.subject) for c in res.checks}
+        assert ("finite", "preds") in kinds
+        assert ("set", "target") in kinds
+
+    def test_warn_severity(self):
+        src = """
+def rank_zero_warn(msg, cat=None):
+    pass
+
+
+class M(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.validate_args = True
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        if bool(jnp.any(jnp.isnan(preds))):
+            rank_zero_warn("nan values will be removed")
+        self.total = self.total + jnp.nansum(preds)
+
+    def compute(self):
+        return self.total
+"""
+        res, _ = _eligibility(src, "M")
+        assert res.verdict == "value_flags"
+        assert res.checks[0].severity == "warn"
+
+    def test_list_state_is_hard_blocker(self):
+        src = """
+class M(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("chunks", default=[], dist_reduce_fx="cat")
+
+    def update(self, preds):
+        self.chunks.append(preds)
+
+    def compute(self):
+        return jnp.concatenate(self.chunks)
+"""
+        res, _ = _eligibility(src, "M")
+        assert res.verdict == "host_bound"
+        assert any("append-mode list state `chunks`" in b.reason for b in res.blockers)
+
+    def test_none_default_branch_is_decidable(self):
+        # `thresholds is None` with default None: the list branch IS the
+        # default path -> hard blocker; flipping the test makes it conditional
+        src = """
+class DefaultList(Metric):
+    def __init__(self, thresholds=None, **kw):
+        super().__init__(**kw)
+        if thresholds is None:
+            self.add_state("chunks", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("confmat", default=jnp.zeros((2, 2)), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        self.chunks.append(preds)
+
+    def compute(self):
+        return jnp.array(0.0)
+
+
+class NonDefaultList(Metric):
+    def __init__(self, num_classes=None, **kw):
+        super().__init__(**kw)
+        if num_classes is not None:
+            self.add_state("chunks", default=[], dist_reduce_fx="cat")
+        else:
+            self.add_state("confmat", default=jnp.zeros((2, 2)), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        self.confmat = self.confmat + preds
+
+    def compute(self):
+        return self.confmat
+"""
+        hard, result = _eligibility(src, "DefaultList")
+        assert hard.verdict == "host_bound"
+        soft = next(v for q, v in result.eligibility.items() if q.endswith(".NonDefaultList"))
+        assert soft.verdict == "metadata_only"
+        assert any("some configurations" in b.reason for b in soft.conditional)
+
+    def test_host_typed_update_is_host_bound(self):
+        src = """
+class M(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds: str, target: str):
+        self.total = self.total + float(len(preds))
+
+    def compute(self):
+        return self.total
+"""
+        res, _ = _eligibility(src, "M")
+        assert res.verdict == "host_bound"
+        assert any("host-typed" in b.reason for b in res.blockers)
+
+    def test_delegating_wrapper_is_host_bound(self):
+        src = """
+class M(Metric):
+    def __init__(self, inner, **kw):
+        super().__init__(**kw)
+        self.inner = inner
+
+    def update(self, preds):
+        self.inner.update(preds)
+
+    def compute(self):
+        return self.inner.compute()
+"""
+        res, _ = _eligibility(src, "M")
+        assert res.verdict == "host_bound"
+        assert any("registers no states" in b.reason for b in res.blockers)
+
+    def test_blockers_in_both_branches_stay_hard(self):
+        # a config `if/else` where EVERY path host-syncs: no configuration
+        # can compile, so the conditional softening must not apply
+        src = """
+class M(Metric):
+    def __init__(self, average: str = "micro", **kw):
+        super().__init__(**kw)
+        self.average = average
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        if self.average == "micro":
+            self.total = self.total + float(preds.sum())
+        else:
+            self.total = self.total + float(preds.mean())
+
+    def compute(self):
+        return self.total
+"""
+        res, _ = _eligibility(src, "M")
+        assert res.verdict == "host_bound"
+        assert sum("host-syncs" in b.reason for b in res.blockers) == 2
+
+    def test_blocker_in_one_branch_stays_conditional(self):
+        src = """
+class M(Metric):
+    def __init__(self, average: str = "micro", **kw):
+        super().__init__(**kw)
+        self.average = average
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        if self.average == "micro":
+            self.total = self.total + float(preds.sum())
+        else:
+            self.total = self.total + preds.mean()
+
+    def compute(self):
+        return self.total
+"""
+        res, _ = _eligibility(src, "M")
+        assert res.verdict == "metadata_only"
+        assert any("host-syncs" in b.reason for b in res.conditional)
+
+    def test_unconditional_host_sync_blocks(self):
+        src = """
+class M(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds):
+        n = float(preds.sum())
+        self.total = self.total + n
+
+    def compute(self):
+        return self.total
+"""
+        res, _ = _eligibility(src, "M")
+        assert res.verdict == "host_bound"
+        assert any("host-syncs" in b.reason for b in res.blockers)
+
+
+class TestR6Completeness:
+    BASE = """
+def _check(preds, target):
+    if bool(jnp.any(target > 1)):
+        raise RuntimeError("bad target")
+    if bool(jnp.any(jnp.isnan(preds))):
+        raise RuntimeError("nan preds")
+
+
+class M(Metric):
+    def __init__(self, **kw):
+        super().__init__(**kw)
+        self.validate_args = True
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target):
+        _check(preds, target)
+        self.total = self.total + preds.sum()
+
+    def _traced_value_flags(self, preds, target):
+{flags_body}
+
+    def compute(self):
+        return self.total
+"""
+
+    def test_incomplete_validator_fires(self):
+        src = self.BASE.format(
+            flags_body='        return ("bad target",), jnp.any(target > 1)[None]'
+        )
+        res, result = _eligibility(src, "M")
+        assert [c.kind for c in res.missing] == ["finite"]
+        assert [v.rule for v in result.violations if v.rule == "R6"] == ["R6"]
+
+    def test_complete_validator_is_silent(self):
+        src = self.BASE.format(
+            flags_body=(
+                '        flags = jnp.stack([jnp.any(target > 1), jnp.any(jnp.isnan(preds))])\n'
+                '        return ("bad target", "nan preds"), flags'
+            )
+        )
+        res, result = _eligibility(src, "M")
+        assert res.missing == []
+        assert not [v for v in result.violations if v.rule == "R6"]
+
+    def test_kind_match_with_wrong_subject_still_fires(self):
+        # a finiteness check on the WRONG argument does not cover preds
+        src = self.BASE.format(
+            flags_body=(
+                '        flags = jnp.stack([jnp.any(target > 1), jnp.any(jnp.isnan(target))])\n'
+                '        return ("bad target", "nan target"), flags'
+            )
+        )
+        res, result = _eligibility(src, "M")
+        assert [c.kind for c in res.missing] == ["finite"]
+        assert [v for v in result.violations if v.rule == "R6"]
+
+    def test_pure_inheritor_does_not_duplicate_base_finding(self):
+        src = self.BASE.format(
+            flags_body='        return ("bad target",), jnp.any(target > 1)[None]'
+        ) + """
+
+class Child(M):
+    pass
+"""
+        _, result = _eligibility(src, "M")
+        r6 = [v for v in result.violations if v.rule == "R6"]
+        assert len(r6) == 1 and r6[0].scope.startswith("M")
+
+    def test_super_call_resolves_inherited_validator(self):
+        # a subclass validator delegating to super() inherits its coverage
+        src = self.BASE.format(
+            flags_body=(
+                '        flags = jnp.stack([jnp.any(target > 1), jnp.any(jnp.isnan(preds))])\n'
+                '        return ("bad target", "nan preds"), flags'
+            )
+        ) + """
+
+class Child(M):
+    def update(self, preds, target):
+        _check(preds, target)
+        self.total = self.total + preds.sum()
+
+    def _traced_value_flags(self, preds, target):
+        return super()._traced_value_flags(preds, target)
+"""
+        _, result = _eligibility(src, "Child")
+        child = next(v for q, v in result.eligibility.items() if q.endswith(".Child"))
+        assert child.missing == []
+        assert not [v for v in result.violations if v.rule == "R6"]
+
+
+class TestRuntimeManifestGate:
+    def test_real_manifest_certifies_known_metadata_only_class(self):
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        assert compiled_validation_eligible(MeanSquaredError)
+
+    def test_user_subclass_not_certified(self):
+        from torchmetrics_tpu.regression import MeanSquaredError
+
+        class Sub(MeanSquaredError):
+            pass
+
+        assert not compiled_validation_eligible(Sub)
+
+    def test_kill_switch(self):
+        from torchmetrics_tpu.regression import MeanAbsoluteError
+
+        try:
+            set_eligibility_enabled(False)
+            assert not compiled_validation_eligible(MeanAbsoluteError)
+        finally:
+            set_eligibility_enabled(True)
+        assert compiled_validation_eligible(MeanAbsoluteError)
+
+    def test_unknown_severity_raises_loudly(self):
+        from torchmetrics_tpu.metric import Metric
+        from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+        with pytest.raises(TorchMetricsUserError, match="severities"):
+            Metric._split_value_flags((("msg",), jnp.zeros(1, bool), ("warning",)))
+        msgs, _, sevs = Metric._split_value_flags((("msg",), jnp.zeros(1, bool), ("warn",)))
+        assert msgs == ("msg",) and sevs == ("warn",)
+
+    def test_value_flags_and_host_bound_not_certified(self):
+        from torchmetrics_tpu.aggregation import MeanMetric
+        from torchmetrics_tpu.retrieval import RetrievalMRR
+
+        assert not compiled_validation_eligible(MeanMetric)
+        assert not compiled_validation_eligible(RetrievalMRR)

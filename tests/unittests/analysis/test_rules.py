@@ -19,7 +19,8 @@ EXPECTED = {
     "viol_r2.py": [("R2", 19), ("R2", 20), ("R2", 24)],
     "viol_r3.py": [("R3", 14), ("R3", 16), ("R3", 19)],
     "viol_r4.py": [("R4", 14), ("R4", 15), ("R4", 16)],
-    "viol_r5.py": [("R5", 8)],
+    "viol_r5.py": [("R5", 13)],
+    "viol_r6.py": [("R6", 27)],
 }
 
 
@@ -31,7 +32,10 @@ def test_true_positives_fire_with_exact_lines(fixture):
     assert got == EXPECTED[fixture]
 
 
-@pytest.mark.parametrize("fixture", ["clean_r1.py", "clean_r2.py", "clean_r3.py", "clean_r4.py", "clean_r5.py"])
+@pytest.mark.parametrize(
+    "fixture",
+    ["clean_r1.py", "clean_r2.py", "clean_r3.py", "clean_r4.py", "clean_r5.py", "clean_r6.py"],
+)
 def test_clean_twins_stay_silent(fixture):
     result = analyze_paths([str(FIXTURES / fixture)])
     assert not result.parse_errors

@@ -11,7 +11,7 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+from torchmetrics_tpu.utilities.distributed import shard_map  # version-portable (jax<0.6 lacks jax.shard_map)
 
 from torchmetrics_tpu.utilities.distributed import sync_in_jit
 

@@ -15,7 +15,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from torchmetrics_tpu.utilities.distributed import shard_map  # version-portable (jax<0.6 lacks jax.shard_map)
 from jax.sharding import Mesh, PartitionSpec as P
 
 from torchmetrics_tpu.utilities.distributed import sync_in_jit

@@ -31,7 +31,7 @@ import pytest
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+from torchmetrics_tpu.utilities.distributed import shard_map  # version-portable (jax<0.6 lacks jax.shard_map)
 from jax.sharding import Mesh, PartitionSpec as P
 
 import torchmetrics_tpu as tm
@@ -486,6 +486,9 @@ def _run_merge_leg(name, kwargs, maker):
 _TOL = {
     "SignalDistortionRatio": 5e-3,
     "ComplexScaleInvariantSignalNoiseRatio": 1e-3,
+    # covariance sqrtm (Newton–Schulz in f32) drifts ~1.4e-4 between the
+    # merged-shard and single-replica paths
+    "FrechetInceptionDistance": 1e-3,
 }
 
 
@@ -513,7 +516,10 @@ MESH_REQUIRED = {
 _LEG_RAN: Dict[str, str] = {}
 
 
-@pytest.mark.parametrize("name", sorted(REGISTRY))
+from tests.unittests.test_precision_differentiability_sweep import sweep_params
+
+
+@pytest.mark.parametrize("name", sweep_params(sorted(REGISTRY)))
 def test_metric_over_mesh(name, mesh):
     kwargs, maker = REGISTRY[name]
     expected = _single_replica_result(name, kwargs, maker)
@@ -708,7 +714,7 @@ SPECIAL: Dict[str, Tuple[Callable[[], Metric], Callable[[int], tuple]]] = {
 }
 
 
-@pytest.mark.parametrize("name", sorted(SPECIAL))
+@pytest.mark.parametrize("name", sweep_params(sorted(SPECIAL)))
 def test_special_merge_leg(name):
     ctor, maker = SPECIAL[name]
     single = ctor()

@@ -11,10 +11,14 @@ a justification (see ANALYSIS.md).
 import time
 from pathlib import Path
 
+import json
+
 from torchmetrics_tpu._analysis import (
+    ELIGIBILITY_PATH,
     MANIFEST_PATH,
     RULES,
     analyze_paths,
+    eligibility_to_json,
     load_baseline,
     load_manifest,
     split_baselined,
@@ -85,6 +89,68 @@ def test_checked_in_manifest_matches_code():
         " `python tools/lint_metrics.py torchmetrics_tpu/ --write-manifest`."
         f" newly certified: {missing[:10]}; no longer certified: {removed[:10]}"
     )
+
+
+def test_checked_in_eligibility_matches_code():
+    """Staleness gate: the eligibility manifest silently rots as metrics are
+    edited unless a fresh scan reproduces it exactly."""
+    result, _ = _scan()
+    current = eligibility_to_json(result.eligibility)
+    checked_in = json.loads(ELIGIBILITY_PATH.read_text(encoding="utf-8"))
+    cur_classes, old_classes = current["classes"], checked_in.get("classes", {})
+    added = sorted(set(cur_classes) - set(old_classes))
+    removed = sorted(set(old_classes) - set(cur_classes))
+    changed = sorted(
+        q for q in set(cur_classes) & set(old_classes) if cur_classes[q] != old_classes[q]
+    )
+    assert current == checked_in, (
+        "eligibility.json is out of sync with the prover — regenerate with"
+        " `python tools/lint_metrics.py torchmetrics_tpu/ --write-eligibility`."
+        f" added: {added[:5]}; removed: {removed[:5]}; changed verdicts: {changed[:5]}"
+    )
+
+
+def test_eligibility_covers_every_public_metric_class():
+    """Every public Metric subclass in the scanned tree gets a verdict."""
+    result, _ = _scan()
+    public = {q for q, v in result.eligibility.items() if v.public}
+    manifest = set(json.loads(ELIGIBILITY_PATH.read_text(encoding="utf-8"))["classes"])
+    assert public == manifest
+    assert all(
+        v.verdict in ("metadata_only", "value_flags", "host_bound")
+        for v in result.eligibility.values()
+    )
+    # the compiled-default unlock is non-trivial: a healthy share of the
+    # catalog proves metadata-only or portable value checks
+    verdicts = [v.verdict for q, v in result.eligibility.items() if v.public]
+    assert verdicts.count("metadata_only") >= 40
+    assert verdicts.count("value_flags") >= 20
+
+
+def test_eligibility_spot_checks():
+    """Pin the verdicts the runtime and docs lean on."""
+    result, _ = _scan()
+    ele = result.eligibility
+
+    def verdict(qual):
+        return ele[qual].verdict
+
+    # (a) metadata-only: compiles with validate_args=True and NO validator
+    assert verdict("torchmetrics_tpu.regression.mse.MeanSquaredError") == "metadata_only"
+    assert verdict("torchmetrics_tpu.classification.ranking.MultilabelRankingLoss") == "metadata_only"
+    assert verdict("torchmetrics_tpu.classification.hinge.BinaryHingeLoss") == "metadata_only"
+    # (b) value checks, ported validators declared
+    assert verdict("torchmetrics_tpu.classification.stat_scores.BinaryStatScores") == "value_flags"
+    assert ele["torchmetrics_tpu.classification.stat_scores.BinaryStatScores"].declares_flags
+    assert verdict("torchmetrics_tpu.aggregation.MeanMetric") == "value_flags"
+    assert ele["torchmetrics_tpu.aggregation.MeanMetric"].declares_flags
+    # (c) host-bound, blockers cited by path:line
+    retrieval = ele["torchmetrics_tpu.retrieval.base.RetrievalMetric"]
+    assert retrieval.verdict == "host_bound"
+    assert any("append-mode list state" in b.reason for b in retrieval.blockers)
+    assert all(":" in b.site and b.line > 0 for b in retrieval.blockers)
+    curve = ele["torchmetrics_tpu.classification.precision_recall_curve.BinaryPrecisionRecallCurve"]
+    assert curve.verdict == "host_bound"  # default thresholds=None grows host lists
 
 
 def test_manifest_is_nontrivial_and_scoped():

@@ -37,14 +37,26 @@ def main(argv=None) -> int:
     parser.add_argument("--write-baseline", action="store_true", help="rewrite the baseline to the current violations")
     parser.add_argument("--write-manifest", action="store_true", help="regenerate the certified-clean manifest")
     parser.add_argument("--manifest", type=Path, default=None, help="manifest output path (default: package location)")
+    parser.add_argument(
+        "--write-eligibility", action="store_true",
+        help="regenerate the compile-eligibility manifest (verdict per public Metric subclass)",
+    )
+    parser.add_argument(
+        "--explain", metavar="CLASS", default=None,
+        help="print the proven eligibility verdict, check inventory, and blockers for one class"
+        " (bare class name or dotted qualname)",
+    )
     args = parser.parse_args(argv)
 
     from torchmetrics_tpu._analysis import (
+        ELIGIBILITY_PATH,
         MANIFEST_PATH,
         analyze_paths,
+        eligibility_to_json,
         load_baseline,
         split_baselined,
         write_baseline,
+        write_eligibility,
         write_manifest,
     )
 
@@ -60,6 +72,10 @@ def main(argv=None) -> int:
     # so a partial (single-file / subpackage) scan would silently drop every
     # entry belonging to an unscanned file — refuse instead of corrupting
     scanned = set(result.scanned_paths)
+
+    def _module_files(qualname: str) -> tuple:
+        mod = qualname.rsplit(".", 1)[0].replace(".", "/")
+        return (f"{mod}.py", f"{mod}/__init__.py")
 
     if args.write_baseline:
         undecided = sorted({e.path for e in baseline.values() if e.path not in scanned})
@@ -78,9 +94,6 @@ def main(argv=None) -> int:
         from torchmetrics_tpu._analysis.manifest import load_manifest
 
         out = args.manifest or MANIFEST_PATH
-        def _module_files(qualname: str) -> tuple:
-            mod = qualname.rsplit(".", 1)[0].replace(".", "/")
-            return (f"{mod}.py", f"{mod}/__init__.py")
         prior = load_manifest(out) if out.exists() else frozenset()
         dropped = sorted(
             c
@@ -98,6 +111,62 @@ def main(argv=None) -> int:
         print(f"wrote {n} certified R1-clean classes to {out}")
         return 0
 
+    if args.write_eligibility:
+        from torchmetrics_tpu._analysis.manifest import load_eligibility
+
+        prior = load_eligibility(ELIGIBILITY_PATH) if ELIGIBILITY_PATH.exists() else {}
+        current = {q for q, v in result.eligibility.items() if v.public}
+        dropped = sorted(
+            q for q in prior
+            if q not in current and not any(f in scanned for f in _module_files(q))
+        )
+        if dropped:
+            print(
+                f"refusing --write-eligibility on a partial scan: {len(dropped)} previously"
+                f" recorded class(es) live in unscanned files (e.g. {dropped[0]});"
+                " rerun on the package root"
+            )
+            return 2
+        n = write_eligibility(eligibility_to_json(result.eligibility), ELIGIBILITY_PATH)
+        print(f"wrote {n} eligibility verdicts to {ELIGIBILITY_PATH}")
+        return 0
+
+    if args.explain:
+        wanted = args.explain
+        matches = [
+            v for q, v in sorted(result.eligibility.items())
+            if q == wanted or q.rsplit(".", 1)[-1] == wanted
+        ]
+        if not matches:
+            print(f"no Metric subclass named {wanted!r} found in the scanned tree")
+            return 2
+        for v in matches:
+            print(f"{v.qualname}  ({v.path}:{v.line})")
+            print(f"  verdict: {v.verdict}"
+                  f"{'  [declares _traced_value_flags]' if v.declares_flags else ''}")
+            if v.checks:
+                print("  proven eager value checks:")
+                for c in v.checks:
+                    print(f"    - {c.describe()}")
+            if v.traced:
+                print("  traced-validator coverage:")
+                for c in v.traced:
+                    print(f"    - {c.kind}({c.subject}) at {c.site}")
+            if v.missing:
+                print("  MISSING from the traced validator (R6):")
+                for c in v.missing:
+                    print(f"    - {c.describe()}")
+            if v.blockers:
+                print("  host-bound blockers:")
+                for b in v.blockers:
+                    print(f"    - {b.describe()}")
+            if v.conditional:
+                print("  config-conditional notes:")
+                for b in v.conditional:
+                    print(f"    - {b.describe()}")
+            print()
+        return 0
+
     if args.json:
         print(
             json.dumps(
@@ -105,6 +174,12 @@ def main(argv=None) -> int:
                     "files_scanned": result.files_scanned,
                     "classes_seen": result.classes_seen,
                     "certified_count": len(result.certified),
+                    "eligibility": {
+                        verdict: sum(
+                            1 for v in result.eligibility.values() if v.public and v.verdict == verdict
+                        )
+                        for verdict in ("metadata_only", "value_flags", "host_bound")
+                    },
                     "elapsed_seconds": round(elapsed, 3),
                     "violations": [v.to_json() for v in new],
                     "suppressed_count": len(suppressed),

@@ -16,12 +16,24 @@ from torchmetrics_tpu._analysis.baseline import (
     split_baselined,
     write_baseline,
 )
+from torchmetrics_tpu._analysis.eligibility import (
+    Blocker,
+    CheckSite,
+    ClassEligibility,
+    EligibilityPass,
+    eligibility_to_json,
+)
 from torchmetrics_tpu._analysis.engine import AnalysisResult, analyze_paths, analyze_source
 from torchmetrics_tpu._analysis.manifest import (
+    ELIGIBILITY_PATH,
     MANIFEST_PATH,
+    compiled_validation_eligible,
     fingerprint_skip_allowed,
+    load_eligibility,
     load_manifest,
+    set_eligibility_enabled,
     set_fingerprint_skip_enabled,
+    write_eligibility,
     write_manifest,
 )
 from torchmetrics_tpu._analysis.model import Violation
@@ -30,18 +42,28 @@ from torchmetrics_tpu._analysis.rules import RULES, Rule, rule
 __all__ = [
     "AnalysisResult",
     "BaselineEntry",
+    "Blocker",
+    "CheckSite",
+    "ClassEligibility",
+    "ELIGIBILITY_PATH",
+    "EligibilityPass",
     "MANIFEST_PATH",
     "RULES",
     "Rule",
     "Violation",
     "analyze_paths",
     "analyze_source",
+    "compiled_validation_eligible",
+    "eligibility_to_json",
     "fingerprint_skip_allowed",
     "load_baseline",
+    "load_eligibility",
     "load_manifest",
     "rule",
+    "set_eligibility_enabled",
     "set_fingerprint_skip_enabled",
     "split_baselined",
     "write_baseline",
+    "write_eligibility",
     "write_manifest",
 ]

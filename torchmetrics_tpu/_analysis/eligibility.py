@@ -1,0 +1,916 @@
+"""Interprocedural compile-eligibility prover.
+
+For every Metric subclass, walks the full static call graph of ``update``
+*through the functional mirror* (class method → ``functional/...`` helpers →
+``utilities/checks.py``) and proves one of three verdicts:
+
+- ``metadata_only`` (a): every check reachable from ``update`` depends only on
+  static trace-time facts (shapes, dtypes, ctor args). Compiling the update
+  loses nothing — ``Metric._auto_eligible`` consults this verdict to
+  auto-compile ``validate_args=True`` metrics *without* a hand-written
+  ``_traced_value_flags`` validator.
+- ``value_flags`` (b): the eager path contains per-batch *value* checks, each
+  a recognizable branchless-portable pattern (range / set-membership /
+  finiteness / sum-to-one over a traced array). The proven check inventory
+  makes a ``_traced_value_flags`` port mechanical — and rule R6 verifies a
+  declared validator covers every check the prover found (completeness gate).
+- ``host_bound`` (c): the update path contains a construct that cannot live
+  inside a compiled step — growing host-side list states, data-dependent
+  shapes, host-by-design eager helpers, host-typed (non-array) inputs — each
+  cited by ``path:line``.
+
+Like the rest of the analyzer this is pure-AST: nothing is imported or
+executed. Function bodies are summarized once (checks/blockers expressed in
+terms of their formal parameters) and summaries are substituted at call
+sites, so the whole-package pass stays inside the CI scan budget.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field, replace
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from torchmetrics_tpu._analysis.model import SourceInfo
+from torchmetrics_tpu._analysis.registry import ClassInfo, ModuleInfo, Registry
+from torchmetrics_tpu._analysis.taint import TaintTracker, annotation_is_host_only
+
+ELIGIBILITY_VERSION = 1
+
+VERDICT_METADATA_ONLY = "metadata_only"  # (a)
+VERDICT_VALUE_FLAGS = "value_flags"  # (b)
+VERDICT_HOST_BOUND = "host_bound"  # (c)
+
+# check-pattern kinds the prover recognizes (and a traced port can express
+# branchlessly); "value" is the catch-all for tainted checks that do not
+# match a finer pattern — still portable, just without a canned recipe
+KIND_RANGE = "range"
+KIND_SET = "set"
+KIND_FINITE = "finite"
+KIND_SUM_TO_ONE = "sum_to_one"
+KIND_VALUE = "value"
+
+_FINITE_CALLS = {"isnan", "isinf", "isfinite", "isneginf", "isposinf", "nonfinite"}
+_SUM_CALLS = {"sum", "nansum"}
+_SET_CALLS = {"issubset", "isin", "in1d", "unique"}
+# calls that gate a host-only (concrete-values) fallback region: the body
+# never executes under trace, so hazards inside are invisible to XLA while
+# value checks inside are exactly the ones a compiled replay silently skips
+_CONCRETE_GUARD_CALLS = {"_is_concrete"}
+# data-dependent output shapes (mirrors hostsync.DATA_DEPENDENT_SHAPE_FNS)
+_DYNSHAPE_CALLS = {
+    "unique", "nonzero", "argwhere", "flatnonzero", "extract", "compress",
+    "union1d", "intersect1d", "setdiff1d",
+}
+_HOST_CONVERTERS = {"float", "int", "bool", "complex"}
+_HOST_SYNC_METHODS = {"item", "tolist"}
+_NUMPY_ALIASES = {"np", "numpy"}
+_WARN_CALLS = {"rank_zero_warn", "warn", "warning"}
+
+_MAX_DEPTH = 10
+
+
+@dataclass(frozen=True)
+class CheckSite:
+    """One value-dependent check proven reachable from ``update``."""
+
+    kind: str  # KIND_* pattern
+    subject: str  # update-level argument name ("?" when not resolvable)
+    severity: str  # "error" (guards a raise) | "warn" (guards a warning)
+    path: str
+    line: int
+    snippet: str
+
+    @property
+    def site(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "kind": self.kind,
+            "subject": self.subject,
+            "severity": self.severity,
+            "site": self.site,
+            "snippet": self.snippet,
+        }
+
+    def describe(self) -> str:
+        return f"{self.kind}({self.subject}) [{self.severity}] at {self.site}: {self.snippet}"
+
+
+@dataclass(frozen=True)
+class Blocker:
+    """One construct that pins the update path to host execution."""
+
+    reason: str
+    path: str
+    line: int
+    snippet: str
+    conditional: bool = False  # only reachable under a non-default config branch
+
+    @property
+    def site(self) -> str:
+        return f"{self.path}:{self.line}"
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "reason": self.reason,
+            "site": self.site,
+            "snippet": self.snippet,
+            "conditional": self.conditional,
+        }
+
+    def describe(self) -> str:
+        tag = " (config-conditional)" if self.conditional else ""
+        return f"{self.reason}{tag} at {self.site}: {self.snippet}"
+
+
+@dataclass
+class FnSummary:
+    """Checks/blockers of one function, subjects = its formal parameters.
+
+    ``truncated`` marks a summary cut short by the recursion depth cap or the
+    cycle guard (directly, or through a callee): such summaries may be
+    missing checks and are never memoized as complete.
+    """
+
+    params: List[str] = field(default_factory=list)
+    checks: List[CheckSite] = field(default_factory=list)
+    blockers: List[Blocker] = field(default_factory=list)
+    truncated: bool = False
+
+
+@dataclass
+class ClassEligibility:
+    """The prover's verdict for one Metric subclass."""
+
+    qualname: str
+    path: str
+    line: int
+    verdict: str
+    checks: List[CheckSite] = field(default_factory=list)  # eager update path
+    traced: List[CheckSite] = field(default_factory=list)  # _traced_value_flags path
+    blockers: List[Blocker] = field(default_factory=list)
+    conditional: List[Blocker] = field(default_factory=list)
+    declares_flags: bool = False
+    missing: List[CheckSite] = field(default_factory=list)  # eager - traced (R6)
+    public: bool = True
+
+    def to_json(self) -> Dict[str, object]:
+        return {
+            "verdict": self.verdict,
+            "declares_flags": self.declares_flags,
+            "checks": [c.to_json() for c in self.checks],
+            "blockers": [b.to_json() for b in self.blockers],
+            "conditional": [b.to_json() for b in self.conditional],
+            "missing": [c.to_json() for c in self.missing],
+        }
+
+
+class _FunctionWalker(ast.NodeVisitor):
+    """Single-function walk: collect check sites and blockers.
+
+    ``collect_all_patterns`` is the traced-validator mode: every value
+    comparison counts as a (coverage) pattern, no raise/warn required.
+    """
+
+    def __init__(
+        self,
+        pass_: "EligibilityPass",
+        module: ModuleInfo,
+        func: ast.FunctionDef,
+        is_method: bool,
+        tainted_self_attrs: Set[str],
+        owner_cls: Optional[ClassInfo],
+        depth: int,
+        stack: Set[Tuple[str, str]],
+        collect_all_patterns: bool = False,
+    ) -> None:
+        self.pass_ = pass_
+        self.module = module
+        self.func = func
+        self.owner_cls = owner_cls
+        self.is_method = is_method
+        self.depth = depth
+        self.stack = stack
+        self.collect_all = collect_all_patterns
+        self.tracker = TaintTracker(func, tainted_self_attrs, is_method=is_method)
+        self.checks: List[CheckSite] = []
+        self.blockers: List[Blocker] = []
+        self._blocker_depths: List[int] = []  # config-branch depth per blocker
+        self.truncated = False  # a callee summary was depth/cycle-truncated
+        # local-name provenance: which formal parameter a local derives from,
+        # and which check pattern its defining expression carried
+        self.subject_of: Dict[str, str] = {}
+        self.kind_of: Dict[str, str] = {}
+        self.concrete_locals: Set[str] = set()
+        args = func.args
+        params = list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        if is_method and params and params[0].arg in ("self", "cls"):
+            params = params[1:]
+        self.params = [p.arg for p in params]
+        for p in params:
+            if not annotation_is_host_only(p.annotation):
+                self.subject_of[p.arg] = p.arg
+
+    # --------------------------------------------------------------- helpers
+    def _snippet(self, lineno: int) -> str:
+        return self.module.source.line_text(lineno)
+
+    def _emit_check(self, kind: str, subject: str, severity: str, lineno: int) -> None:
+        self.checks.append(
+            CheckSite(kind, subject, severity, self.module.path, lineno, self._snippet(lineno))
+        )
+
+    def _emit_blocker(self, reason: str, lineno: int, cond_depth: int) -> None:
+        # cond_depth = number of enclosing config branches; 0 means the
+        # blocker is hit on every configuration path
+        self.blockers.append(
+            Blocker(reason, self.module.path, lineno, self._snippet(lineno), cond_depth > 0)
+        )
+        self._blocker_depths.append(cond_depth)
+
+    def _subject(self, expr: ast.expr) -> str:
+        """Best-effort root subject of an expression (formal-param name).
+
+        Preorder DFS, not ``ast.walk`` (BFS): in ``arr.max() >= n`` the data
+        operand ``arr`` must win over the bound ``n`` even though ``n`` sits
+        shallower in the tree.
+        """
+        def dfs(node):
+            yield node
+            for child in ast.iter_child_nodes(node):
+                yield from dfs(child)
+
+        for node in dfs(expr):
+            if isinstance(node, ast.Name) and node.id in self.subject_of:
+                return self.subject_of[node.id]
+        return "?"
+
+    def _expr_kinds(self, expr: ast.expr) -> List[Tuple[str, str]]:
+        """(kind, subject) pairs for the value patterns inside ``expr``."""
+        out: List[Tuple[str, str]] = []
+
+        def name_of(fn: ast.expr) -> Optional[str]:
+            if isinstance(fn, ast.Name):
+                return fn.id
+            if isinstance(fn, ast.Attribute):
+                return fn.attr
+            return None
+
+        attr_receivers = {
+            id(node.value) for node in ast.walk(expr) if isinstance(node, ast.Attribute)
+        }
+
+        def value_bearing(operand: ast.expr) -> bool:
+            """Tainted, or taint laundered through a host converter
+            (``int(np.max(groups))``) or a pattern-carrying local."""
+            if self.tracker.is_tainted(operand):
+                return True
+            for sub in ast.walk(operand):
+                if isinstance(sub, ast.Call):
+                    cname = name_of(sub.func)
+                    if cname in _HOST_CONVERTERS and any(self.tracker.is_tainted(a) for a in sub.args):
+                        return True
+                    if (
+                        cname in _HOST_SYNC_METHODS
+                        and isinstance(sub.func, ast.Attribute)
+                        and self.tracker.is_tainted(sub.func.value)
+                    ):
+                        return True
+                elif isinstance(sub, ast.Name) and sub.id in self.kind_of and id(sub) not in attr_receivers:
+                    return True
+            return False
+
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                cname = name_of(node.func)
+                if cname in _FINITE_CALLS:
+                    sub = self._subject(node)
+                    if isinstance(node.func, ast.Attribute) and sub == "?":
+                        sub = self._subject(node.func.value)
+                    out.append((KIND_FINITE, sub))
+                elif cname in _SET_CALLS:
+                    out.append((KIND_SET, self._subject(node)))
+            elif isinstance(node, ast.Compare):
+                # untainted comparisons are metadata (shapes, ctor args)
+                # unless an operand carries values through laundered taint
+                if not (value_bearing(node.left) or any(value_bearing(c) for c in node.comparators)):
+                    continue
+                ops = node.ops
+                operands = [node.left] + list(node.comparators)
+                if any(isinstance(op, (ast.In, ast.NotIn)) for op in ops):
+                    out.append((KIND_SET, self._subject(node)))
+                elif any(isinstance(op, (ast.Lt, ast.Gt, ast.LtE, ast.GtE)) for op in ops):
+                    kind = KIND_RANGE
+                    for operand in operands:
+                        for sub in ast.walk(operand):
+                            if isinstance(sub, ast.Call) and name_of(sub.func) in _SUM_CALLS:
+                                kind = KIND_SUM_TO_ONE
+                    out.append((kind, self._subject(node)))
+                elif any(isinstance(op, (ast.Eq, ast.NotEq)) for op in ops):
+                    if any(isinstance(o, ast.Call) and name_of(o.func) in _SUM_CALLS for o in operands):
+                        out.append((KIND_SUM_TO_ONE, self._subject(node)))
+                    else:
+                        out.append((KIND_SET, self._subject(node)))
+            elif isinstance(node, ast.Name):
+                # pattern carried through a local (`nans = isnan(x); if any(nans)`)
+                # — but not when the name is merely dereferenced (`t.size`):
+                # attribute access reads metadata, not the carried pattern
+                if node.id in self.kind_of and id(node) not in attr_receivers:
+                    out.append((self.kind_of[node.id], self.subject_of.get(node.id, "?")))
+        # de-dup preserving order
+        seen: Set[Tuple[str, str]] = set()
+        uniq = []
+        for pair in out:
+            if pair not in seen:
+                seen.add(pair)
+                uniq.append(pair)
+        return uniq
+
+    def _is_concrete_guard(self, expr: ast.expr) -> bool:
+        """True when ``expr`` (or a conjunct of it) gates on concreteness:
+        ``_is_concrete(x)``, ``isinstance(x, Tracer)`` forms, or a local
+        assigned from one of those."""
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                name = fn.id if isinstance(fn, ast.Name) else fn.attr if isinstance(fn, ast.Attribute) else None
+                if name in _CONCRETE_GUARD_CALLS:
+                    return True
+                if name == "isinstance" and len(node.args) == 2:
+                    target = node.args[1]
+                    tname = target.attr if isinstance(target, ast.Attribute) else (
+                        target.id if isinstance(target, ast.Name) else None
+                    )
+                    if tname == "Tracer":
+                        return True
+            elif isinstance(node, ast.Name) and node.id in self.concrete_locals:
+                return True
+        return False
+
+    @staticmethod
+    def _body_outcome(body: Sequence[ast.stmt]) -> Optional[str]:
+        """"error" when the block (transitively) raises, else "warn" when it
+        warns, else None."""
+        outcome = None
+        for stmt in body:
+            for node in ast.walk(stmt):
+                if isinstance(node, ast.Raise):
+                    return "error"
+                if isinstance(node, ast.Call):
+                    fn = node.func
+                    name = fn.id if isinstance(fn, ast.Name) else fn.attr if isinstance(fn, ast.Attribute) else None
+                    if name in _WARN_CALLS:
+                        outcome = "warn"
+        return outcome
+
+    # ------------------------------------------------------------ statements
+    def walk_function(self) -> None:
+        self._walk_body(self.func.body, host_gated=False, cond_depth=0)
+
+    def _walk_body(self, body: Sequence[ast.stmt], host_gated: bool, cond_depth: int) -> None:
+        for stmt in body:
+            self._walk_stmt(stmt, host_gated, cond_depth)
+
+    def _walk_stmt(self, stmt: ast.stmt, host_gated: bool, cond_depth: int) -> None:
+        if isinstance(stmt, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+            value = stmt.value
+            if value is not None:
+                self._record_provenance(stmt)
+                self._scan_expr(value, stmt.lineno, host_gated, cond_depth)
+            return
+        if isinstance(stmt, ast.Expr):
+            self._scan_expr(stmt.value, stmt.lineno, host_gated, cond_depth)
+            return
+        if isinstance(stmt, ast.Return):
+            if stmt.value is not None:
+                if self.collect_all:
+                    for kind, subject in self._expr_kinds(stmt.value):
+                        self._emit_check(kind, subject, "coverage", stmt.lineno)
+                self._scan_expr(stmt.value, stmt.lineno, host_gated, cond_depth)
+            return
+        if isinstance(stmt, ast.If):
+            self._walk_if(stmt, host_gated, cond_depth)
+            return
+        if isinstance(stmt, ast.Assert):
+            if self.tracker.is_tainted(stmt.test):
+                kinds = self._expr_kinds(stmt.test) or [(KIND_VALUE, self._subject(stmt.test))]
+                for kind, subject in kinds:
+                    self._emit_check(kind, subject, "error", stmt.lineno)
+            return
+        if isinstance(stmt, (ast.For, ast.AsyncFor)):
+            if self.tracker.is_tainted(stmt.iter) and not host_gated:
+                self._emit_blocker("python loop over a traced value", stmt.lineno, cond_depth)
+            self._walk_body(stmt.body + stmt.orelse, host_gated, cond_depth)
+            return
+        if isinstance(stmt, ast.While):
+            if self.tracker.is_tainted(stmt.test) and not host_gated:
+                self._emit_blocker("`while` on a traced value", stmt.lineno, cond_depth)
+            self._walk_body(stmt.body + stmt.orelse, host_gated, cond_depth)
+            return
+        if isinstance(stmt, ast.With):
+            for item in stmt.items:
+                self._scan_expr(item.context_expr, stmt.lineno, host_gated, cond_depth)
+            self._walk_body(stmt.body, host_gated, cond_depth)
+            return
+        if isinstance(stmt, ast.Try):
+            self._walk_body(stmt.body + stmt.orelse + stmt.finalbody, host_gated, cond_depth)
+            for handler in stmt.handlers:
+                self._walk_body(handler.body, host_gated, cond_depth)
+            return
+        if isinstance(stmt, ast.Raise):
+            return  # message formatting inside a raise is never traced
+        # nested defs, pass, etc.: nothing to do
+
+    def _record_provenance(self, stmt: ast.stmt) -> None:
+        """Track subject/pattern provenance of simple local assignments."""
+        targets: List[ast.expr] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, (ast.AnnAssign, ast.AugAssign)):
+            targets = [stmt.target]
+        value = stmt.value
+        if value is None:
+            return
+        subject = self._subject(value)
+        kinds = self._expr_kinds(value)
+        concrete = self._is_concrete_guard(value)
+        for tgt in targets:
+            names = [tgt] if isinstance(tgt, ast.Name) else [
+                e for e in (tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) else []) if isinstance(e, ast.Name)
+            ]
+            for name in names:
+                if subject != "?":
+                    self.subject_of[name.id] = subject
+                if concrete:
+                    # a concreteness predicate is a gate, not a value pattern
+                    self.concrete_locals.add(name.id)
+                elif kinds:
+                    self.kind_of[name.id] = kinds[0][0]
+
+    def _test_value_dependent(self, test: ast.expr) -> bool:
+        """True when an ``if`` test reads traced VALUES — directly tainted, or
+        laundered through a host converter (``bool(jnp.any(nans))``) or a
+        pattern-carrying local the taint tracker sanitized."""
+        if self.tracker.is_tainted(test):
+            return True
+        for node in ast.walk(test):
+            if isinstance(node, ast.Call):
+                fn = node.func
+                name = fn.id if isinstance(fn, ast.Name) else fn.attr if isinstance(fn, ast.Attribute) else None
+                if name in _HOST_CONVERTERS and any(self.tracker.is_tainted(a) for a in node.args):
+                    return True
+                if name in _HOST_SYNC_METHODS and isinstance(fn, ast.Attribute) and self.tracker.is_tainted(fn.value):
+                    return True
+            elif isinstance(node, ast.Name) and node.id in self.kind_of:
+                # a local carrying a value pattern (`unique = set(np.unique(
+                # target).tolist())`) keeps its value-dependence even though
+                # the host conversion sanitized its taint
+                return True
+        return False
+
+    def _walk_if(self, stmt: ast.If, host_gated: bool, cond_depth: int) -> None:
+        test = stmt.test
+        gated = host_gated or self._is_concrete_guard(test)
+        tainted_test = self._test_value_dependent(test)
+        outcome = self._body_outcome(stmt.body)
+        is_check = tainted_test and outcome is not None
+        if is_check:
+            kinds = self._expr_kinds(test) or [(KIND_VALUE, self._subject(test))]
+            for kind, subject in kinds:
+                self._emit_check(kind, subject, outcome, stmt.lineno)
+        elif tainted_test and not gated:
+            # branching on data without raising: real traced control flow
+            self._emit_blocker(
+                "python `if` branches on a traced value (not a validation check)",
+                stmt.lineno,
+                cond_depth,
+            )
+        if not is_check:
+            # the test expression itself may hide hazards (bool() on traced)
+            self._scan_expr(test, stmt.lineno, gated, cond_depth)
+        # a config-dependent branch (`if self.ignore_index is not None:`) may
+        # hold hazards that only some ctor configurations reach: record them
+        # as conditional so they inform without demoting the default verdict
+        branch_depth = cond_depth if (tainted_test or gated) else cond_depth + 1
+        n_before = len(self.blockers)
+        self._walk_body(stmt.body, gated, branch_depth if not is_check else cond_depth)
+        n_mid = len(self.blockers)
+        self._walk_body(stmt.orelse, host_gated, branch_depth)
+        if branch_depth == cond_depth + 1:
+            # re-harden only when BOTH branches hit blockers at THIS level
+            # (every config path through this if is blocked); blockers under
+            # further-nested config branches keep their own conditionality
+            direct_body = [
+                i for i in range(n_before, n_mid) if self._blocker_depths[i] == branch_depth
+            ]
+            direct_else = [
+                i for i in range(n_mid, len(self.blockers)) if self._blocker_depths[i] == branch_depth
+            ]
+            if direct_body and direct_else:
+                for i in direct_body + direct_else:
+                    self.blockers[i] = replace(self.blockers[i], conditional=cond_depth > 0)
+                    self._blocker_depths[i] = cond_depth
+
+    # ----------------------------------------------------------- expressions
+    def _scan_expr(self, expr: ast.expr, lineno: int, host_gated: bool, cond_depth: int) -> None:
+        if self.collect_all:
+            for kind, subject in self._expr_kinds(expr):
+                self._emit_check(kind, subject, "coverage", lineno)
+        for node in ast.walk(expr):
+            if isinstance(node, ast.Call):
+                self._scan_call(node, host_gated, cond_depth)
+            elif isinstance(node, ast.Subscript) and not isinstance(node.ctx, ast.Store):
+                if (
+                    not host_gated
+                    and self.tracker.is_tainted(node.value)
+                    and self.tracker.is_tainted(node.slice)
+                    and isinstance(node.slice, (ast.Compare, ast.BoolOp))
+                ):
+                    self._emit_blocker(
+                        "boolean-mask indexing (value-dependent output shape)", node.lineno, cond_depth
+                    )
+
+    def _scan_call(self, node: ast.Call, host_gated: bool, cond_depth: int) -> None:
+        fn = node.func
+        name = fn.id if isinstance(fn, ast.Name) else fn.attr if isinstance(fn, ast.Attribute) else None
+        mod_head = fn.value.id if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) else None
+        any_tainted = any(self.tracker.is_tainted(a) for a in node.args) or any(
+            self.tracker.is_tainted(kw.value) for kw in node.keywords
+        )
+
+        resolved = self._resolve_call(node)
+        if resolved is not None:
+            owner_mod, callee, callee_cls, callee_is_method = resolved
+            if owner_mod.source.is_eager_helper(callee.lineno):
+                if not host_gated:
+                    self._emit_blocker(
+                        f"calls host-by-design eager helper `{name}`", node.lineno, cond_depth
+                    )
+                return
+            summary = self.pass_.summarize(
+                owner_mod, callee, callee_cls, callee_is_method, self.depth + 1, self.stack,
+                collect_all_patterns=self.collect_all,
+            )
+            self._substitute(summary, node, host_gated, cond_depth)
+            return
+
+        if host_gated:
+            return  # host-fallback region: hazards never execute under trace
+        if name in _HOST_CONVERTERS and isinstance(fn, ast.Name) and any_tainted:
+            self._emit_blocker(f"`{name}()` host-syncs a traced value", node.lineno, cond_depth)
+            return
+        if isinstance(fn, ast.Attribute) and fn.attr in _HOST_SYNC_METHODS and self.tracker.is_tainted(fn.value):
+            self._emit_blocker(f"`.{fn.attr}()` host-syncs a traced value", node.lineno, cond_depth)
+            return
+        if mod_head in _NUMPY_ALIASES and any_tainted:
+            self._emit_blocker(f"`{mod_head}.{name}` pulls a traced value to host", node.lineno, cond_depth)
+            return
+        has_static_size = any(kw.arg == "size" for kw in node.keywords)
+        if name in _DYNSHAPE_CALLS and any_tainted and not has_static_size:
+            self._emit_blocker(
+                f"`{name}` has a value-dependent output shape", node.lineno, cond_depth
+            )
+            return
+        if name == "where" and len(node.args) == 1 and any_tainted:
+            self._emit_blocker(
+                "single-argument `where` (nonzero in disguise)", node.lineno, cond_depth
+            )
+
+    def _resolve_call(self, node: ast.Call):
+        """Resolve a call to an indexed function/method definition.
+
+        Returns ``(module, funcdef, owner_class_or_None, is_method)`` or None.
+        """
+        fn = node.func
+        # plain function name: same module or `from x import f`
+        if isinstance(fn, ast.Name):
+            hit = self.pass_.registry.resolve_function(self.module.module, fn.id)
+            if hit is not None:
+                return hit[0], hit[1], None, False
+            return None
+        if not isinstance(fn, ast.Attribute):
+            return None
+        # self.method(...) / cls chain, and class-body fn aliases
+        recv = fn.value
+        if isinstance(recv, ast.Name) and recv.id == "self" and self.owner_cls is not None:
+            hit = self.pass_.registry.resolve_method(self.owner_cls, fn.attr)
+            if hit is not None:
+                owner_cls, func = hit
+                owner_mod = self.pass_.registry.modules.get(owner_cls.module)
+                if owner_mod is not None:
+                    return owner_mod, func, self.owner_cls, True
+            alias = self._resolve_alias(fn.attr)
+            if alias is not None:
+                return alias
+            return None
+        # super().method(...): next definition along the static chain after
+        # the one currently being summarized
+        if (
+            isinstance(recv, ast.Call)
+            and isinstance(recv.func, ast.Name)
+            and recv.func.id == "super"
+            and self.owner_cls is not None
+        ):
+            chain, _, _ = self.pass_.registry.chain(self.owner_cls)
+            passed_current = False
+            for c in chain:
+                func_def = c.methods.get(fn.attr)
+                if func_def is None:
+                    continue
+                if func_def is self.func or (not passed_current and fn.attr == self.func.name):
+                    passed_current = True
+                    continue
+                owner_mod = self.pass_.registry.modules.get(c.module)
+                if owner_mod is not None:
+                    return owner_mod, func_def, self.owner_cls, True
+            return None
+        # type(self)._update_fn(...) — class attr alias
+        if (
+            isinstance(recv, ast.Call)
+            and isinstance(recv.func, ast.Name)
+            and recv.func.id == "type"
+            and self.owner_cls is not None
+        ):
+            alias = self._resolve_alias(fn.attr)
+            if alias is not None:
+                return alias
+            hit = self.pass_.registry.resolve_method(self.owner_cls, fn.attr)
+            if hit is not None:
+                owner_cls, func = hit
+                owner_mod = self.pass_.registry.modules.get(owner_cls.module)
+                if owner_mod is not None:
+                    return owner_mod, func, self.owner_cls, True
+            return None
+        # module.f(...) where module was imported
+        if isinstance(recv, ast.Name):
+            hit = self.pass_.registry.resolve_module_attr(self.module.module, recv.id, fn.attr)
+            if hit is not None:
+                return hit[0], hit[1], None, False
+        return None
+
+    def _resolve_alias(self, attr: str):
+        """Resolve `_update_fn = staticmethod(f)`-style class attributes."""
+        if self.owner_cls is None:
+            return None
+        chain, _, _ = self.pass_.registry.chain(self.owner_cls)
+        for c in chain:
+            target = c.fn_aliases.get(attr)
+            if target is None:
+                continue
+            hit = self.pass_.registry.resolve_function(c.module, target)
+            if hit is not None:
+                return hit[0], hit[1], None, False
+        return None
+
+    def _substitute(
+        self,
+        summary: FnSummary,
+        node: ast.Call,
+        host_gated: bool,
+        cond_depth: int,
+    ) -> None:
+        """Map a callee summary's formal-param subjects to this call's actuals.
+
+        (Methods need no self-arg shift here: ``FnSummary.params`` already
+        excludes ``self``/``cls``.)
+        """
+        actual_subject: Dict[str, str] = {}
+        pos = list(node.args)
+        for i, formal in enumerate(summary.params):
+            if i < len(pos):
+                actual_subject[formal] = self._subject(pos[i])
+        for kw in node.keywords:
+            if kw.arg is not None and kw.arg in summary.params:
+                actual_subject[kw.arg] = self._subject(kw.value)
+        self.truncated = self.truncated or summary.truncated
+        for check in summary.checks:
+            subject = actual_subject.get(check.subject, check.subject if check.subject == "?" else "?")
+            self.checks.append(replace(check, subject=subject))
+        if host_gated:
+            return
+        for blocker in summary.blockers:
+            depth = cond_depth + (1 if blocker.conditional else 0)
+            self.blockers.append(replace(blocker, conditional=depth > 0))
+            self._blocker_depths.append(depth)
+
+
+class EligibilityPass:
+    """Whole-registry driver with per-function summary memoization."""
+
+    def __init__(self, registry: Registry) -> None:
+        self.registry = registry
+        self._memo: Dict[Tuple[str, str, int, bool], FnSummary] = {}
+
+    # ------------------------------------------------------------- summaries
+    def summarize(
+        self,
+        module: ModuleInfo,
+        func: ast.FunctionDef,
+        owner_cls: Optional[ClassInfo],
+        is_method: bool,
+        depth: int,
+        stack: Set[Tuple[str, str]],
+        collect_all_patterns: bool = False,
+    ) -> FnSummary:
+        key = (
+            owner_cls.qualname if (is_method and owner_cls is not None) else module.module,
+            func.name,
+            func.lineno,
+            collect_all_patterns,
+        )
+        if key in self._memo:
+            return self._memo[key]
+        if depth > _MAX_DEPTH or key[:3] in {k[:3] for k in stack}:
+            return FnSummary(truncated=True)
+        stack = stack | {key}
+        tainted_self_attrs: Set[str] = set()
+        if is_method and owner_cls is not None:
+            tainted_self_attrs, _ = self.registry.registered_states(owner_cls)
+        walker = _FunctionWalker(
+            self, module, func, is_method, tainted_self_attrs, owner_cls, depth, stack,
+            collect_all_patterns=collect_all_patterns,
+        )
+        walker.walk_function()
+        summary = FnSummary(
+            params=walker.params, checks=walker.checks, blockers=walker.blockers,
+            truncated=walker.truncated,
+        )
+        # summaries cut short by the cycle guard / depth cap may be missing
+        # checks — never cache them as complete (a cycle participant gets a
+        # full walk of its own when summarized from the top)
+        if not summary.truncated:
+            self._memo[key] = summary
+        return summary
+
+    # ----------------------------------------------------------- class-level
+    def analyze_class(self, cls: ClassInfo) -> Optional[ClassEligibility]:
+        """Verdict for one metric class; None for non-metric classes."""
+        registry = self.registry
+        if not registry.is_metric_subclass(cls):
+            return None
+        result = ClassEligibility(
+            qualname=cls.qualname,
+            path=cls.path,
+            line=cls.lineno,
+            verdict=VERDICT_METADATA_ONLY,
+            declares_flags=registry.declares_traced_flags(cls),
+            public=not cls.name.startswith("_"),
+        )
+        update = registry.resolve_method(cls, "update")
+        if update is None:
+            result.verdict = VERDICT_HOST_BOUND
+            result.blockers.append(
+                Blocker("no `update` implementation along the static chain", cls.path, cls.lineno,
+                        f"class {cls.name}")
+            )
+            return result
+        owner, func = update
+        owner_mod = registry.modules.get(owner.module)
+        if owner_mod is None:
+            return result
+
+        # dispatch-style updates that only raise (task wrappers) are host-bound
+        if all(isinstance(s, (ast.Raise, ast.Expr, ast.Pass)) for s in func.body) and any(
+            isinstance(s, ast.Raise) for s in func.body
+        ):
+            result.verdict = VERDICT_HOST_BOUND
+            result.blockers.append(
+                Blocker("`update` is a dispatch stub that always raises", owner_mod.path, func.lineno,
+                        owner_mod.source.line_text(func.lineno))
+            )
+            return result
+
+        # growing host states: statically-literal list defaults along the chain
+        always_list, config_list = registry.list_states(cls)
+        for state in sorted(always_list):
+            result.blockers.append(
+                Blocker(
+                    f"append-mode list state `{state}` grows on host (bound it with"
+                    " `cat_state_capacity=` to compile)",
+                    cls.path, cls.lineno, f"add_state(\"{state}\", default=[], ...)",
+                )
+            )
+        config_state_blockers = [
+            Blocker(
+                f"state `{state}` is an append-mode list in some configurations"
+                " (array default on the default path)",
+                cls.path, cls.lineno, f"add_state(\"{state}\", ...)", conditional=True,
+            )
+            for state in sorted(config_list)
+        ]
+
+        # host-typed updates (e.g. Sequence[str] text kernels) have no traced
+        # array inputs: there is nothing to compile
+        args = func.args
+        params = list(args.posonlyargs) + list(args.args) + list(args.kwonlyargs)
+        if params and params[0].arg in ("self", "cls"):
+            params = params[1:]
+        if params and all(annotation_is_host_only(p.annotation) for p in params):
+            result.blockers.append(
+                Blocker("`update` takes only host-typed (non-array) arguments", owner_mod.path,
+                        func.lineno, owner_mod.source.line_text(func.lineno))
+            )
+
+        # wrapper/delegator metrics: no states registered anywhere on the
+        # chain (a dynamic add_state counts as registration — stat-scores
+        # style `for name in (...): self.add_state(name, ...)` loops)
+        states, dynamic = registry.registered_states(cls)
+        if not states and not dynamic and not result.blockers:
+            result.blockers.append(
+                Blocker(
+                    "registers no states of its own (delegates to child metrics)",
+                    cls.path, cls.lineno, f"class {cls.name}",
+                )
+            )
+
+        summary = self.summarize(owner_mod, func, cls, True, 0, set())
+        if summary.truncated:
+            # a depth/cycle-truncated walk may have missed checks: claiming
+            # metadata-only would be unsound, so the class stays host-bound
+            result.blockers.append(
+                Blocker(
+                    "update call graph truncated (recursion depth/cycle) — eligibility unprovable",
+                    owner_mod.path, func.lineno, owner_mod.source.line_text(func.lineno),
+                )
+            )
+        hard = _dedup_blockers([b for b in summary.blockers if not b.conditional] + result.blockers)
+        soft = _dedup_blockers([b for b in summary.blockers if b.conditional] + config_state_blockers)
+        result.checks = _dedup_checks(summary.checks)
+        result.blockers = hard
+        result.conditional = soft
+        if hard:
+            result.verdict = VERDICT_HOST_BOUND
+        elif result.checks:
+            result.verdict = VERDICT_VALUE_FLAGS
+        else:
+            result.verdict = VERDICT_METADATA_ONLY
+
+        # validator coverage: everything reachable from _traced_value_flags
+        if result.declares_flags:
+            flags = registry.resolve_method(cls, "_traced_value_flags")
+            if flags is not None:
+                fowner, ffunc = flags
+                fmod = registry.modules.get(fowner.module)
+                if fmod is not None:
+                    fsummary = self.summarize(
+                        fmod, ffunc, cls, True, 0, set(), collect_all_patterns=True
+                    )
+                    result.traced = _dedup_checks(fsummary.checks)
+            covered = {(c.kind, c.subject) for c in result.traced}
+            kinds_covered = {c.kind for c in result.traced}
+
+            def is_covered(c: CheckSite) -> bool:
+                # subject-resolvable checks need a matching (kind, subject)
+                # pattern (a kind-only match with an unresolved traced subject
+                # also counts); unresolvable subjects fall back to kind-level
+                if c.subject == "?":
+                    return c.kind in kinds_covered
+                return (c.kind, c.subject) in covered or (c.kind, "?") in covered
+
+            result.missing = [c for c in result.checks if not is_covered(c)]
+        return result
+
+    def analyze_all(self) -> Dict[str, ClassEligibility]:
+        out: Dict[str, ClassEligibility] = {}
+        for mod in self.registry.modules.values():
+            for cls in mod.classes.values():
+                res = self.analyze_class(cls)
+                if res is not None:
+                    out[res.qualname] = res
+        return out
+
+
+def _dedup_blockers(blockers: Sequence[Blocker]) -> List[Blocker]:
+    seen: Set[Tuple[str, str, int]] = set()
+    out: List[Blocker] = []
+    for b in blockers:
+        key = (b.reason, b.path, b.line)
+        if key not in seen:
+            seen.add(key)
+            out.append(b)
+    return out
+
+
+def _dedup_checks(checks: Sequence[CheckSite]) -> List[CheckSite]:
+    seen: Set[Tuple[str, str, str, int]] = set()
+    out: List[CheckSite] = []
+    for c in checks:
+        key = (c.kind, c.subject, c.path, c.line)
+        if key not in seen:
+            seen.add(key)
+            out.append(c)
+    return out
+
+
+def eligibility_to_json(eligibility: Dict[str, ClassEligibility]) -> Dict[str, object]:
+    """Versioned manifest payload: every PUBLIC metric class gets a verdict."""
+    return {
+        "version": ELIGIBILITY_VERSION,
+        "classes": {
+            qual: res.to_json()
+            for qual, res in sorted(eligibility.items())
+            if res.public
+        },
+    }

@@ -14,6 +14,11 @@ from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from torchmetrics_tpu._analysis import hostsync, structural
+from torchmetrics_tpu._analysis.eligibility import (
+    VERDICT_METADATA_ONLY,
+    ClassEligibility,
+    EligibilityPass,
+)
 from torchmetrics_tpu._analysis.model import SourceInfo, Violation
 from torchmetrics_tpu._analysis.registry import Registry
 
@@ -30,6 +35,10 @@ _SKIP_DIR_PARTS = {"__pycache__", ".git"}
 class AnalysisResult:
     violations: List[Violation] = field(default_factory=list)
     certified: List[str] = field(default_factory=list)  # R1-clean class qualnames
+    # compile-eligibility verdicts (qualname -> ClassEligibility) for every
+    # metric class in a *scanned* module — the R6 gate and the eligibility
+    # manifest both read from here
+    eligibility: Dict[str, ClassEligibility] = field(default_factory=dict)
     # display paths of rule-checked files (context siblings excluded):
     # baseline staleness is only decidable for files that were scanned
     scanned_paths: List[str] = field(default_factory=list)
@@ -193,26 +202,65 @@ def analyze_paths(paths: Sequence[str]) -> AnalysisResult:
         result.scanned_paths.append(display)
         result.files_scanned += 1
 
-    # pass 2: rules
+    # pass 2: eligibility verdicts (interprocedural, whole-registry) — the
+    # per-class verdict feeds both the R5/R6 rules and the manifest
+    eligibility = EligibilityPass(registry)
+
+    # pass 3: rules
     for module, path in modules:
         mod = registry.modules[module]
         source = sources[module]
         scan_kernels = ".functional" in f".{module}" or "/functional/" in source.path
-        _run_rules_for_module(registry, mod, source, result, scan_kernels=scan_kernels)
+        _run_rules_for_module(registry, mod, source, result, scan_kernels=scan_kernels, eligibility=eligibility)
 
     result.violations.sort(key=lambda v: (v.path, v.line, v.rule))
     result.certified.sort()
     return result
 
 
-def _run_rules_for_module(registry, mod, source, result, scan_kernels: bool) -> None:
+def _check_r6(cls, verdict: Optional[ClassEligibility], source) -> List[Violation]:
+    """R6 (validator-completeness): a declared/inherited ``_traced_value_flags``
+    must cover every value check the prover found on the eager update path.
+
+    Fires only on classes that *locally* define ``update`` or
+    ``_traced_value_flags`` — pure inheritors share their base's behavior and
+    would only duplicate its finding.
+    """
+    if verdict is None or not verdict.declares_flags or not verdict.missing:
+        return []
+    if "update" not in cls.methods and "_traced_value_flags" not in cls.methods:
+        return []
+    anchor = cls.methods.get("_traced_value_flags")
+    lineno = anchor.lineno if anchor is not None else cls.lineno
+    scope = f"{cls.name}._traced_value_flags" if anchor is not None else cls.name
+    inventory = "; ".join(c.describe() for c in verdict.missing[:4])
+    more = f" (+{len(verdict.missing) - 4} more)" if len(verdict.missing) > 4 else ""
+    v = source.violation(
+        "R6", lineno, scope,
+        f"`_traced_value_flags` misses {len(verdict.missing)} value check(s) proven reachable from"
+        f" `update`: {inventory}{more} — compiled `validate_args=True` replays silently skip them",
+    )
+    return [v] if v else []
+
+
+def _run_rules_for_module(registry, mod, source, result, scan_kernels: bool, eligibility=None) -> None:
     """Rule dispatch for one indexed module — the single copy both
     :func:`analyze_paths` and :func:`analyze_source` drive."""
     for cls in mod.classes.values():
         result.classes_seen += 1
         if registry.is_metric_subclass(cls):
+            verdict = None
+            if eligibility is not None:
+                verdict = eligibility.analyze_class(cls)
+                if verdict is not None:
+                    result.eligibility[cls.qualname] = verdict
             result.violations.extend(structural.check_r1(cls, registry, source))
-            result.violations.extend(structural.check_r5(cls, registry, source))
+            # a class PROVEN metadata-only compiles without a hand-written
+            # validator (the runtime consults the eligibility manifest), so
+            # R5's "pinned to the eager path" no longer holds for it
+            if verdict is None or verdict.verdict != VERDICT_METADATA_ONLY:
+                result.violations.extend(structural.check_r5(cls, registry, source))
+            result.violations.extend(_check_r6(cls, verdict, source))
             states, _ = registry.registered_states(cls)
             for method_name in TRACED_CLASS_METHODS:
                 func = cls.methods.get(method_name)
@@ -249,7 +297,9 @@ def analyze_source(text: str, path: str = "<string>", module: Optional[str] = No
     result.files_scanned = 1
     # kernels always scanned here: single-blob callers (tests, fixtures) have
     # no package layout to gate on
-    _run_rules_for_module(registry, mod, source, result, scan_kernels=True)
+    _run_rules_for_module(
+        registry, mod, source, result, scan_kernels=True, eligibility=EligibilityPass(registry)
+    )
     result.violations.sort(key=lambda v: (v.path, v.line, v.rule))
     result.certified.sort()
     return result

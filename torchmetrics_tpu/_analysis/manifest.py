@@ -22,11 +22,27 @@ from typing import Dict, FrozenSet, Iterable, Optional
 MANIFEST_PATH = Path(__file__).parent / "certified.json"
 MANIFEST_VERSION = 1
 
+ELIGIBILITY_PATH = Path(__file__).parent / "eligibility.json"
+
 _manifest_cache: Optional[FrozenSet[str]] = None
 _class_cache: Dict[type, bool] = {}
+# eligibility verdicts (qualname -> verdict string) + per-class memo for the
+# compiled-validation gate
+_eligibility_cache: Optional[Dict[str, str]] = None
+_eligibility_class_cache: Dict[type, bool] = {}
 # runtime toggle (benchmarks flip it to measure the guard's cost); the env
 # var gives operators a kill switch without code changes
 _enabled = os.environ.get("TM_TPU_DISABLE_FP_SKIP", "") != "1"
+# independent kill switch for the compiled-validation eligibility gate (a
+# metadata-only-certified class auto-compiling without a traced validator)
+_eligibility_enabled = os.environ.get("TM_TPU_DISABLE_ELIGIBILITY", "") != "1"
+
+
+def set_eligibility_enabled(flag: bool) -> None:
+    """Benchmark/diagnostic toggle for the eligibility gate."""
+    global _eligibility_enabled
+    _eligibility_enabled = bool(flag)
+    _eligibility_class_cache.clear()
 
 
 def write_manifest(certified: Iterable[str], path: Optional[Path] = None) -> int:
@@ -63,9 +79,63 @@ def fingerprint_skip_enabled() -> bool:
 
 
 def invalidate_cache() -> None:
-    global _manifest_cache
+    global _manifest_cache, _eligibility_cache
     _manifest_cache = None
     _class_cache.clear()
+    _eligibility_cache = None
+    _eligibility_class_cache.clear()
+
+
+def write_eligibility(payload: Dict[str, object], path: Optional[Path] = None) -> int:
+    """Write the compile-eligibility manifest (see ``eligibility.py``)."""
+    (path or ELIGIBILITY_PATH).write_text(
+        json.dumps(payload, indent=2, sort_keys=True) + "\n", encoding="utf-8"
+    )
+    classes = payload.get("classes", {})
+    return len(classes) if isinstance(classes, dict) else 0
+
+
+def load_eligibility(path: Optional[Path] = None) -> Dict[str, str]:
+    """qualname -> verdict map from the checked-in eligibility manifest."""
+    global _eligibility_cache
+    if path is None and _eligibility_cache is not None:
+        return _eligibility_cache
+    p = path or ELIGIBILITY_PATH
+    try:
+        data = json.loads(p.read_text(encoding="utf-8"))
+        classes = data.get("classes", {})
+        verdicts = {
+            qual: str(entry.get("verdict", ""))
+            for qual, entry in classes.items()
+            if isinstance(entry, dict)
+        }
+    except (OSError, ValueError, AttributeError):
+        verdicts = {}
+    if path is None:
+        _eligibility_cache = verdicts
+    return verdicts
+
+
+def compiled_validation_eligible(cls: type) -> bool:
+    """True when the eligibility prover certified ``cls`` metadata-only.
+
+    A metadata-only class runs no per-batch VALUE checks on its eager
+    ``validate_args=True`` path (all its validation is decidable from static
+    shapes/dtypes/ctor args, which trace-time re-runs on every compile), so
+    auto-compiling it cannot skip a check — no hand-written
+    ``_traced_value_flags`` needed. The gate keys on the EXACT class: a user
+    subclass (whose update the prover never saw) stays on the guarded path.
+    """
+    if not _eligibility_enabled:
+        return False
+    cached = _eligibility_class_cache.get(cls)
+    if cached is not None:
+        return cached
+    verdicts = load_eligibility()
+    qualname = f"{cls.__module__}.{cls.__qualname__}"
+    allowed = verdicts.get(qualname) == "metadata_only"
+    _eligibility_class_cache[cls] = allowed
+    return allowed
 
 
 def fingerprint_skip_allowed(cls: type) -> bool:

@@ -118,7 +118,19 @@ class ClassInfo:
     lineno: int
     base_names: List[str] = field(default_factory=list)  # as written in source
     own_states: Set[str] = field(default_factory=set)  # literal add_state names
+    # literal add_state names whose default is a list literal (append-mode
+    # "cat" states — they grow on host and pin the class to the eager path);
+    # a name in BOTH sets is config-dependent (e.g. list only for
+    # `reduction="none"`), which softens the eligibility blocker
+    list_states: Set[str] = field(default_factory=set)
+    array_states: Set[str] = field(default_factory=set)
+    # list registrations nested under an `if` (config-dependent branches like
+    # `thresholds=None` / `num_classes=None` / `return_full_image=True`)
+    conditional_list_states: Set[str] = field(default_factory=set)
     dynamic_add_state: bool = False  # add_state with a non-literal name
+    # class-body function aliases (`_update_fn = staticmethod(f)` style):
+    # alias name -> name of the aliased function as written in source
+    fn_aliases: Dict[str, str] = field(default_factory=dict)
     sets_validate_args: bool = False
     declares_traced_flags: bool = False
     methods: Dict[str, ast.FunctionDef] = field(default_factory=dict)
@@ -181,11 +193,36 @@ def _scan_class(node: ast.ClassDef, module: str, path: str) -> ClassInfo:
     )
     for item in node.body:
         if not isinstance(item, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            # `_update_fn = staticmethod(_foo)` / `_update_fn = _foo` class
+            # attributes dispatch into the functional mirror; the eligibility
+            # pass resolves them like direct calls
+            if isinstance(item, ast.Assign) and len(item.targets) == 1 and isinstance(item.targets[0], ast.Name):
+                value = item.value
+                if (
+                    isinstance(value, ast.Call)
+                    and isinstance(value.func, ast.Name)
+                    and value.func.id in ("staticmethod", "classmethod")
+                    and value.args
+                ):
+                    value = value.args[0]
+                if isinstance(value, ast.Name):
+                    info.fn_aliases[item.targets[0].id] = value.id
             continue
         if isinstance(item, ast.AsyncFunctionDef):
             continue
         info.methods[item.name] = item
-        for sub in ast.walk(item):
+        # params whose declared default IS None: `if <param> is None:` branches
+        # in this method are then statically decidable as the default config
+        none_defaults: Set[str] = set()
+        fn_args = list(item.args.posonlyargs) + list(item.args.args)
+        defaults = list(item.args.defaults)
+        for arg, default in zip(fn_args[len(fn_args) - len(defaults):], defaults):
+            if isinstance(default, ast.Constant) and default.value is None:
+                none_defaults.add(arg.arg)
+        for arg, default in zip(item.args.kwonlyargs, item.args.kw_defaults):
+            if isinstance(default, ast.Constant) and default.value is None:
+                none_defaults.add(arg.arg)
+        for sub, under_if in _walk_with_branch_flag(item.body, False, none_defaults):
             if isinstance(sub, ast.Call):
                 fn = sub.func
                 # self.add_state("name", ...)
@@ -198,8 +235,17 @@ def _scan_class(node: ast.ClassDef, module: str, path: str) -> ClassInfo:
                     name_arg = sub.args[0] if sub.args else next(
                         (kw.value for kw in sub.keywords if kw.arg == "name"), None
                     )
+                    default_arg = sub.args[1] if len(sub.args) > 1 else next(
+                        (kw.value for kw in sub.keywords if kw.arg == "default"), None
+                    )
                     if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
                         info.own_states.add(name_arg.value)
+                        if isinstance(default_arg, ast.List):
+                            info.list_states.add(name_arg.value)
+                            if under_if:
+                                info.conditional_list_states.add(name_arg.value)
+                        else:
+                            info.array_states.add(name_arg.value)
                     else:
                         info.dynamic_add_state = True
         # the mutation index and the R1 rule share one walker (MutationSite),
@@ -336,6 +382,128 @@ class Registry:
     def declares_traced_flags(self, cls: ClassInfo) -> bool:
         chain, _, _ = self.chain(cls)
         return any(c.declares_traced_flags for c in chain)
+
+    def list_states(self, cls: ClassInfo) -> Tuple[Set[str], Set[str]]:
+        """``(always_list, config_dependent)`` append-mode state names.
+
+        A name registered with a list default in one branch and an array
+        default in another (``reduction="none"`` idiom) is config-dependent:
+        the default configuration may still compile.
+        """
+        chain, _, _ = self.chain(cls)
+        lists: Set[str] = set()
+        arrays: Set[str] = set()
+        conditional: Set[str] = set()
+        for c in chain:
+            lists |= c.list_states
+            arrays |= c.array_states
+            conditional |= c.conditional_list_states
+        return lists - arrays - conditional, lists & (arrays | conditional)
+
+    def resolve_method(self, cls: ClassInfo, name: str) -> Optional[Tuple[ClassInfo, ast.FunctionDef]]:
+        """First definition of method ``name`` along ``cls``'s static chain."""
+        chain, _, _ = self.chain(cls)
+        for c in chain:
+            if name in c.methods:
+                return c, c.methods[name]
+        return None
+
+    def resolve_function(self, module: str, name: str) -> Optional[Tuple["ModuleInfo", ast.FunctionDef]]:
+        """Resolve a bare function name used inside ``module`` to its def.
+
+        Looks at same-module functions first, then follows ``from x import f``
+        imports into other indexed modules (the class → functional-mirror →
+        utilities edge the eligibility pass walks).
+        """
+        mod = self.modules.get(module)
+        if mod is None:
+            return None
+        if name in mod.functions:
+            return mod, mod.functions[name]
+        origin = mod.imports.get(name)
+        if origin is None:
+            return None
+        owner_mod, _, fname = origin.rpartition(".")
+        owner = self.modules.get(owner_mod)
+        if owner is not None and fname in owner.functions:
+            return owner, owner.functions[fname]
+        # `from package import module` then `module.f` is resolved by the
+        # caller via resolve_module_attr; a dotted origin naming a module
+        # re-exported function lands here
+        whole = self.modules.get(origin)
+        if whole is not None and name in whole.functions:  # pragma: no cover
+            return whole, whole.functions[name]
+        return None
+
+    def resolve_module_attr(self, module: str, head: str, attr: str) -> Optional[Tuple["ModuleInfo", ast.FunctionDef]]:
+        """Resolve ``head.attr`` calls where ``head`` is an imported module."""
+        mod = self.modules.get(module)
+        if mod is None:
+            return None
+        origin = mod.imports.get(head)
+        if origin is None:
+            return None
+        owner = self.modules.get(origin)
+        if owner is not None and attr in owner.functions:
+            return owner, owner.functions[attr]
+        return None
+
+
+def _none_default_test(test: ast.expr, none_defaults: Set[str]) -> Optional[bool]:
+    """For ``x is None`` / ``x is not None`` tests on a parameter whose
+    declared default IS None: True when the BODY is the default-config branch,
+    False when the ELSE is. None when undecidable."""
+    if not (isinstance(test, ast.Compare) and len(test.ops) == 1 and isinstance(test.left, ast.Name)):
+        return None
+    if test.left.id not in none_defaults:
+        return None
+    comparator = test.comparators[0]
+    if not (isinstance(comparator, ast.Constant) and comparator.value is None):
+        return None
+    if isinstance(test.ops[0], ast.Is):
+        return True
+    if isinstance(test.ops[0], ast.IsNot):
+        return False
+    return None
+
+
+def _walk_with_branch_flag(
+    body: Iterable[ast.stmt], under_if: bool, none_defaults: Optional[Set[str]] = None
+) -> Iterable[Tuple[ast.AST, bool]]:
+    """Yield every AST node in ``body`` with a flag marking whether it sits
+    under a config-dependent ``if``/``else`` branch.
+
+    The one statically-decidable case keeps its default branch unconditional:
+    ``if x is None:`` where parameter ``x`` defaults to None (the
+    ``thresholds=None`` idiom) — its body IS the out-of-the-box path.
+    """
+    none_defaults = none_defaults or set()
+    for stmt in body:
+        if isinstance(stmt, ast.If):
+            for node in ast.walk(stmt.test):
+                yield node, under_if
+            default_is_body = _none_default_test(stmt.test, none_defaults)
+            body_flag = under_if if default_is_body is True else True
+            else_flag = under_if if default_is_body is False else True
+            yield from _walk_with_branch_flag(stmt.body, body_flag, none_defaults)
+            yield from _walk_with_branch_flag(stmt.orelse, else_flag, none_defaults)
+        elif isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
+            yield stmt, under_if
+            yield from _walk_with_branch_flag(
+                list(getattr(stmt, "body", [])) + list(getattr(stmt, "orelse", [])), under_if, none_defaults
+            )
+            for node in ast.walk(stmt.iter if isinstance(stmt, (ast.For, ast.AsyncFor)) else stmt.test):
+                yield node, under_if
+        elif isinstance(stmt, (ast.With, ast.Try)):
+            inner = list(getattr(stmt, "body", [])) + list(getattr(stmt, "orelse", [])) + list(
+                getattr(stmt, "finalbody", [])
+            )
+            for handler in getattr(stmt, "handlers", []):
+                inner += list(handler.body)
+            yield from _walk_with_branch_flag(inner, under_if, none_defaults)
+        else:
+            for node in ast.walk(stmt):
+                yield node, under_if
 
 
 def _assign_leaves(tgt: ast.expr) -> Iterable[ast.expr]:

@@ -68,14 +68,28 @@ RULES: Dict[str, Rule] = {
             ),
         ),
         Rule(
+            id="R6",
+            name="validator-completeness",
+            summary="`_traced_value_flags` misses value checks the eligibility prover found in the eager update path",
+            rationale=(
+                "The compiled `validate_args=True` path replaces the eager host-side value checks"
+                " with the fused flag vector; any eager check the validator does not mirror is"
+                " silently skipped on every compiled replay. The interprocedural eligibility pass"
+                " proves the eager check inventory (range/set/finiteness/sum-to-one patterns with"
+                " `path:line` citations); this gate keeps declared validators complete against it."
+            ),
+        ),
+        Rule(
             id="R5",
             name="missing-traced-validator",
             summary="class sets `self.validate_args` but declares no `_traced_value_flags` vector",
             rationale=(
-                "Metrics constructed with `validate_args=True` only auto-compile when they provide"
-                " a traced validator (`Metric._supports_traced_validation`); without one the"
-                " per-batch host checks permanently pin the metric to the eager path. Every class"
-                " carrying `validate_args` must declare (or inherit) its flag vector."
+                "Metrics constructed with `validate_args=True` auto-compile when they provide a"
+                " traced validator (`Metric._supports_traced_validation`) or when the eligibility"
+                " prover certifies their validation metadata-only (verdict (a) in"
+                " `eligibility.json`); otherwise the per-batch host checks permanently pin the"
+                " metric to the eager path. R5 therefore fires only on classes whose eager path"
+                " the prover could NOT certify metadata-only and that declare no flag vector."
             ),
         ),
     )

@@ -23,8 +23,12 @@ from typing import Optional, Set
 SANITIZER_ATTRS = {"shape", "ndim", "dtype", "size", "itemsize", "device", "sharding", "name", "names"}
 
 # calls that always return host scalars/metadata regardless of args;
-# `concrete_or_none` (utilities.data) returns None under trace by contract
-SANITIZER_CALLS = {"len", "isinstance", "hasattr", "callable", "type", "id", "repr", "str", "format", "concrete_or_none"}
+# `concrete_or_none` (utilities.data) returns None under trace by contract;
+# `jnp.ndim/shape/size` read static metadata even on tracers
+SANITIZER_CALLS = {
+    "len", "isinstance", "hasattr", "callable", "type", "id", "repr", "str", "format",
+    "concrete_or_none", "ndim", "shape", "size",
+}
 
 # explicit host-converting calls: their *call* is the R2 hazard, but the
 # result is a concrete python scalar — treating it as clean keeps each

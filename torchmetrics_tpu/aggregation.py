@@ -3,10 +3,12 @@
 Parity target: reference ``torchmetrics/aggregation.py`` (727 LoC) — the
 primitive aggregators built directly on the state DSL. TPU-first notes:
 
-- NaN handling (``nan_strategy``) runs eagerly in the shim ``update`` on
-  concrete arrays; inside jit, use the functional kernels with masking instead
-  (``ignore`` becomes a zero-weight mask, which is the static-shape form of the
-  reference's boolean filtering).
+- NaN handling (``nan_strategy``) is dual-form: concrete (eager) arrays get
+  the reference's exact raise/warn/filter behavior, while traced arrays get
+  branchless neutral-imputation (``ignore`` becomes a zero-weight mask — the
+  static-shape form of the boolean filtering) with the raise/warn side
+  effects deferred through the fused-validation flags. Out-of-the-box
+  aggregators therefore auto-compile (eligibility-prover round).
 - ``MeanMetric`` keeps (weighted-sum, weight-sum) — both plain ``sum`` states,
   so the distributed merge is a single fused psum.
 """
@@ -19,6 +21,7 @@ import jax
 import jax.numpy as jnp
 
 from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.checks import _is_concrete
 from torchmetrics_tpu.utilities.data import dim_zero_cat
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
 
@@ -37,11 +40,30 @@ __all__ = [
 
 
 class BaseAggregator(Metric):
-    """Base class for aggregation metrics (reference ``aggregation.py:30-113``)."""
+    """Base class for aggregation metrics (reference ``aggregation.py:30-113``).
+
+    The NaN strategy runs in two equivalent forms: on concrete (eager)
+    arrays it keeps the exact reference behavior — raise for ``"error"``,
+    warn + dynamically drop NaN elements for ``"warn"``/``"ignore"`` — while
+    under trace it imputes branchlessly (NaNs become the aggregator's neutral
+    element with zero weight, which reduces identically to dropping). The
+    raise/warn side effects ride the fused-validation flag vector
+    (:meth:`_traced_value_flags`, severity ``"error"``/``"warn"``) and
+    surface at the next host sync, so the out-of-the-box aggregators
+    (``nan_strategy="warn"``) auto-compile instead of being pinned eager by
+    the per-batch host NaN check.
+    """
 
     is_differentiable = None
     higher_is_better = None
     full_state_update: bool = False
+
+    # the value NaNs impute to under trace: a no-op for the reduction
+    # (0 for sum/mean; Max/Min override with ∓inf)
+    _nan_neutral: float = 0.0
+    # CatMetric appends rows, so imputation would KEEP dropped elements —
+    # it refuses the traced form and stays on the eager path
+    _nan_imputation_traceable: bool = True
 
     def __init__(
         self,
@@ -59,8 +81,22 @@ class BaseAggregator(Metric):
                 f" but got {nan_strategy}."
             )
         self.nan_strategy = nan_strategy
+        # raise/warn strategies carry a per-batch value check; declaring it
+        # via validate_args opts the compiled path into the fused flag vector
+        # ("ignore" and float imputation are pure value rewrites — no flags)
+        self.validate_args = nan_strategy in ("error", "warn")
         self.add_state(state_name, default=default_value, dist_reduce_fx=fn)
         self.state_name = state_name
+
+    def _traced_value_flags(self, value: Union[float, Array], weight: Optional[Union[float, Array]] = None):
+        """Fused NaN check: one flag, severity matching the strategy."""
+        x = jnp.asarray(value).astype(jnp.float32)
+        bad = jnp.any(jnp.isnan(x))
+        if weight is not None:
+            bad = bad | jnp.any(jnp.isnan(jnp.asarray(weight, dtype=jnp.float32)))
+        if self.nan_strategy == "error":
+            return ("Encountered `nan` values in tensor",), bad[None], ("error",)
+        return ("Encountered `nan` values in tensor. Will be removed.",), bad[None], ("warn",)
 
     def _cast_and_nan_check_input(
         self, x: Union[float, Array], weight: Optional[Union[float, Array]] = None
@@ -77,28 +113,58 @@ class BaseAggregator(Metric):
         if self.nan_strategy == "disable":
             return x, weight
         nans = jnp.isnan(x) | jnp.isnan(weight)
-        if bool(jnp.any(nans)):
+        concrete = _is_concrete(nans)
+        if concrete and bool(jnp.any(nans)):
+            # eager/concrete: exact reference behavior (raise, warn, true
+            # dynamic filtering); float imputation falls through below
             if self.nan_strategy == "error":
                 raise RuntimeError("Encountered `nan` values in tensor")
             if self.nan_strategy in ("ignore", "warn"):
                 if self.nan_strategy == "warn":
                     rank_zero_warn("Encountered `nan` values in tensor. Will be removed.", UserWarning)
-                # eager path on concrete arrays: dynamic filtering is fine here
                 keep = jnp.nonzero(~nans.reshape(-1))[0]
-                x = x.reshape(-1)[keep]
-                weight = weight.reshape(-1)[keep]
-            else:
-                x = jnp.where(nans, float(self.nan_strategy), x)
-                if weight_was_scalar:
-                    # reference parity quirk: it broadcasts the scalar weight
-                    # BEFORE the nan check (aggregation.py:563), so its
-                    # in-place `weight[nans] = value` writes the one underlying
-                    # element through the 0-stride view and EVERY weight
-                    # becomes the replacement value (nan_strategy=0.0 thus
-                    # yields 0/0 = nan from MeanMetric)
-                    weight = jnp.full_like(weight, float(self.nan_strategy))
-                else:
-                    weight = jnp.where(nans, float(self.nan_strategy), weight)
+                return x.reshape(-1)[keep], weight.reshape(-1)[keep]
+        if self.nan_strategy in ("error", "warn", "ignore"):
+            if not concrete and not self._nan_imputation_traceable:
+                from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__} keeps dropped elements out of an append-mode state;"
+                    " its NaN filtering is value-dependent and cannot trace"
+                )
+            if not concrete and self.nan_strategy == "error" and not self.__dict__.get("_fused_flags_tracing"):
+                # a trace WITHOUT the fused-flag machinery (jit_update,
+                # scan_update, external jit/vmap) has no way to raise-or-drop
+                # on a NaN batch: silently imputing would commit a partial
+                # batch the eager path refuses, so fail the trace loudly
+                from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+                raise TorchMetricsUserError(
+                    f"{type(self).__name__}(nan_strategy='error') cannot run under a trace without"
+                    " the fused violation flags (plain `update()` auto-compiles them; `jit_update`/"
+                    "`scan_update` skip validation): use nan_strategy='ignore'/'disable' or the"
+                    " plain update path"
+                )
+            # branchless neutral imputation: reduces identically to dropping
+            # ("error" batches are additionally dropped whole by the fused
+            # flag on the compiled path, mirroring the eager raise)
+            x = jnp.where(nans, self._nan_neutral, x)
+            weight = jnp.where(nans, 0.0, weight)
+            return x, weight
+        x = jnp.where(nans, float(self.nan_strategy), x)
+        if weight_was_scalar:
+            # reference parity quirk: it broadcasts the scalar weight
+            # BEFORE the nan check (aggregation.py:563), so its
+            # in-place `weight[nans] = value` writes the one underlying
+            # element through the 0-stride view and EVERY weight
+            # becomes the replacement value (nan_strategy=0.0 thus
+            # yields 0/0 = nan from MeanMetric) — but only when the batch
+            # actually contains NaNs (jnp.where keeps this branchless)
+            weight = jnp.where(
+                jnp.any(nans), jnp.full_like(weight, float(self.nan_strategy)), weight
+            )
+        else:
+            weight = jnp.where(nans, float(self.nan_strategy), weight)
         return x, weight
 
     def update(self, value: Union[float, Array]) -> None:
@@ -122,6 +188,7 @@ class MaxMetric(BaseAggregator):
     """
 
     full_state_update = True
+    _nan_neutral = float("-inf")  # maximum(-inf, state) == state
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("max", jnp.array(-jnp.inf, dtype=jnp.float32), nan_strategy, **kwargs)
@@ -136,6 +203,7 @@ class MinMetric(BaseAggregator):
     """Running minimum of a stream of values (reference ``aggregation.py:219``)."""
 
     full_state_update = True
+    _nan_neutral = float("inf")  # minimum(inf, state) == state
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("min", jnp.array(jnp.inf, dtype=jnp.float32), nan_strategy, **kwargs)
@@ -160,6 +228,10 @@ class SumMetric(BaseAggregator):
 
 class CatMetric(BaseAggregator):
     """Concatenate a stream of values (reference ``aggregation.py:429``)."""
+
+    # appended rows would keep neutral-imputed elements that the eager path
+    # truly drops: the traced NaN form is refused (metric stays eager)
+    _nan_imputation_traceable = False
 
     def __init__(self, nan_strategy: Union[str, float] = "warn", **kwargs: Any) -> None:
         super().__init__("cat", [], nan_strategy, **kwargs)

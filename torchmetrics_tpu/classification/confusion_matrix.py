@@ -15,7 +15,6 @@ from torchmetrics_tpu.functional.classification.confusion_matrix import (
     _binary_confusion_matrix_tensor_validation,
     _binary_confusion_matrix_update,
     _binary_confusion_matrix_value_flags,
-    _confusion_matrix_no_value_flags,
     _multiclass_confusion_matrix_arg_validation,
     _multiclass_confusion_matrix_compute,
     _multiclass_confusion_matrix_format,
@@ -127,8 +126,7 @@ class MulticlassConfusionMatrix(Metric):
         confmat = _multiclass_confusion_matrix_update(preds, target, valid, self.num_classes)
         self.confmat = self.confmat + confmat
 
-    def _traced_value_flags(self, preds: Array, target: Array):
-        return _confusion_matrix_no_value_flags(preds, target)
+    # metadata-only validation: auto-compiles via the eligibility manifest
 
     def compute(self) -> Array:
         return _multiclass_confusion_matrix_compute(self.confmat, self.normalize)
@@ -168,9 +166,6 @@ class MultilabelConfusionMatrix(Metric):
         )
         confmat = _multilabel_confusion_matrix_update(preds, target, valid, self.num_labels)
         self.confmat = self.confmat + confmat
-
-    def _traced_value_flags(self, preds: Array, target: Array):
-        return _confusion_matrix_no_value_flags(preds, target)
 
     def compute(self) -> Array:
         return _multilabel_confusion_matrix_compute(self.confmat, self.normalize)

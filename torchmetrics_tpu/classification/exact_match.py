@@ -21,7 +21,6 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
     _multilabel_stat_scores_arg_validation,
     _multilabel_stat_scores_format,
     _multilabel_stat_scores_tensor_validation,
-    _multilabel_stat_scores_value_flags,
 )
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utilities.data import dim_zero_cat
@@ -145,9 +144,6 @@ class MultilabelExactMatch(_AbstractExactMatch):
         )
         correct, total = _multilabel_exact_match_update(preds, target, valid, self.multidim_average)
         self._update_state(correct, total)
-
-    def _traced_value_flags(self, preds: Array, target: Array):
-        return _multilabel_stat_scores_value_flags(preds, target, self.ignore_index)
 
     def compute(self) -> Array:
         correct, total = self._final_state()

@@ -17,7 +17,6 @@ from torchmetrics_tpu.functional.classification.hinge import (
     _multiclass_hinge_loss_update,
 )
 from torchmetrics_tpu.metric import Metric
-from torchmetrics_tpu.utilities.checks import _no_value_flags
 from torchmetrics_tpu.utilities.compute import normalize_logits_if_needed
 from torchmetrics_tpu.utilities.enums import ClassificationTaskNoMultilabel
 
@@ -70,9 +69,8 @@ class BinaryHingeLoss(Metric):
         self.measures = self.measures + measures
         self.total = self.total + total
 
-    def _traced_value_flags(self, preds, target):
-        # eager validation is metadata-only (float dtype / shape)
-        return _no_value_flags(preds, target)
+    # metadata-only validation (float dtype / shape): auto-compiles via the
+    # eligibility manifest, no traced validator needed
 
     def compute(self) -> Array:
         return _hinge_loss_compute(self.measures, self.total)
@@ -123,9 +121,6 @@ class MulticlassHingeLoss(Metric):
         )
         self.measures = self.measures + measures
         self.total = self.total + total
-
-    def _traced_value_flags(self, preds, target):
-        return _no_value_flags(preds, target)
 
     def compute(self) -> Array:
         return _hinge_loss_compute(self.measures, self.total)

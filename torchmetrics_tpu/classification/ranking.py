@@ -15,7 +15,6 @@ from torchmetrics_tpu.functional.classification.ranking import (
     _multilabel_ranking_tensor_validation,
 )
 from torchmetrics_tpu.metric import Metric
-from torchmetrics_tpu.utilities.checks import _no_value_flags
 
 Array = jax.Array
 
@@ -50,9 +49,9 @@ class _RankingMetricBase(Metric):
         self.measure = self.measure + measure
         self.total = self.total + total
 
-    def _traced_value_flags(self, preds, target):
-        # eager validation is metadata-only (label axis / float dtype)
-        return _no_value_flags(preds, target)
+    # no `_traced_value_flags` needed: the eligibility prover certifies this
+    # family metadata-only (label axis / float dtype checks re-run at trace
+    # time), so `validate_args=True` auto-compiles via the manifest verdict
 
     def compute(self) -> Array:
         return self.measure / self.total

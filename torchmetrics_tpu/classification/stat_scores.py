@@ -32,7 +32,6 @@ from torchmetrics_tpu.functional.classification.stat_scores import (
     _multilabel_stat_scores_format,
     _multilabel_stat_scores_tensor_validation,
     _multilabel_stat_scores_update,
-    _multilabel_stat_scores_value_flags,
 )
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utilities.data import dim_zero_cat
@@ -233,8 +232,8 @@ class MultilabelStatScores(_AbstractStatScores):
         tp, fp, tn, fn = _multilabel_stat_scores_update(preds, target, valid, self.multidim_average)
         self._update_state(tp, fp, tn, fn)
 
-    def _traced_value_flags(self, preds: Array, target: Array):
-        return _multilabel_stat_scores_value_flags(preds, target, self.ignore_index)
+    # multilabel validation is metadata-only (shape / label axis): the
+    # eligibility manifest certifies the compiled path, no validator needed
 
     def compute(self) -> Array:
         tp, fp, tn, fn = self._final_state()

@@ -17,7 +17,6 @@ import jax.numpy as jnp
 from torchmetrics_tpu.utilities.checks import (
     _check_same_shape,
     _is_concrete,
-    _no_value_flags,
     _target_set_value_flags,
 )
 from torchmetrics_tpu.utilities.compute import _safe_divide, normalize_logits_if_needed
@@ -83,11 +82,6 @@ def _binary_confusion_matrix_value_flags(
     the eager validator checks nothing else): ``(messages, violation_flags)``
     per the ``Metric._traced_value_flags`` fused-validation contract."""
     return _target_set_value_flags(target, ignore_index)
-
-
-# multiclass/multilabel confmat validation is metadata-only (checked at trace
-# time) — no value checks to fuse
-_confusion_matrix_no_value_flags = _no_value_flags
 
 
 def _binary_confusion_matrix_format(

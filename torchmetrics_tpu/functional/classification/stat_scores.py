@@ -24,7 +24,6 @@ import jax.numpy as jnp
 from torchmetrics_tpu.utilities.checks import (
     _check_same_shape,
     _is_concrete,
-    _no_value_flags,
     _target_set_value_flags,
 )
 from torchmetrics_tpu.utilities.compute import _safe_divide, normalize_logits_if_needed
@@ -459,17 +458,6 @@ def _multilabel_stat_scores_tensor_validation(
         )
     if multidim_average != "global" and preds.ndim < 3:
         raise ValueError("Expected input to be at least 3D when multidim_average is set to `samplewise`")
-
-
-def _multilabel_stat_scores_value_flags(
-    preds: Array,
-    target: Array,
-    ignore_index: Optional[int] = None,
-) -> Tuple[Tuple[str, ...], Array]:
-    """Multilabel validation is metadata-only (shapes/dims, checked at trace
-    time), so there are no value checks to fuse — the empty tuple tells the
-    auto-compile path it may compile ``validate_args=True`` updates freely."""
-    return _no_value_flags(preds, target)
 
 
 def _multilabel_stat_scores_format(

@@ -35,7 +35,7 @@ import numpy as np
 # module scope (not inside `_fingerprint_exempt`): the exemption check sits on
 # the eager per-update hot path, where a function-level import costs a dict
 # lookup + lock round-trip per call; manifest.py imports nothing heavy
-from torchmetrics_tpu._analysis.manifest import fingerprint_skip_allowed
+from torchmetrics_tpu._analysis.manifest import compiled_validation_eligible, fingerprint_skip_allowed
 from torchmetrics_tpu.utilities.data import (
     dim_zero_cat,
     dim_zero_max,
@@ -217,6 +217,7 @@ class Metric(ABC):
         # fused inside the compiled update and OR-accumulate device-side here;
         # violations surface at the next host synchronization point
         self._viol_msgs: Optional[Tuple[str, ...]] = None
+        self._viol_sevs: Optional[Tuple[str, ...]] = None
         self._viol_flags: Optional[Array] = None
         self._traced_validation_supported: Optional[bool] = None
 
@@ -1199,10 +1200,14 @@ class Metric(ABC):
     def _auto_eligible(self) -> bool:
         """Base gate for transparent compilation of ``update``/``forward``.
 
-        Metrics with ``validate_args=True`` compile only when they provide a
-        traced validator (:meth:`_traced_value_flags`): the per-batch value
+        Metrics with ``validate_args=True`` compile when they provide a
+        traced validator (:meth:`_traced_value_flags`) — the per-batch value
         checks then run fused inside the XLA step and surface asynchronously
-        (see :meth:`_check_pending_violations`). Without a traced validator
+        (see :meth:`_check_pending_violations`) — OR when the static
+        eligibility prover certified the class *metadata-only* (verdict (a)
+        in ``_analysis/eligibility.json``: every check on its eager path
+        depends only on shapes/dtypes/ctor args, which trace time re-runs, so
+        compiling cannot skip a check and no validator is needed). Otherwise
         the eager path keeps running the host-side checks. ``compute_on_cpu``
         implies host-resident growing states, which the compiled path cannot
         maintain.
@@ -1214,18 +1219,36 @@ class Metric(ABC):
             # the NaN sentinel is a per-batch host readback over the states —
             # it must observe every eager update, so it pins the eager path
             and self.nan_policy is None
-            and (getattr(self, "validate_args", None) is not True or self._supports_traced_validation())
+            and (
+                getattr(self, "validate_args", None) is not True
+                or self._supports_traced_validation()
+                or self._metadata_only_validation()
+            )
         )
 
-    def _traced_value_flags(self, *args: Any, **kwargs: Any) -> Optional[Tuple[Tuple[str, ...], Array]]:
+    def _metadata_only_validation(self) -> bool:
+        """Eligibility-manifest gate: proven metadata-only class.
+
+        Per-class memoization lives in the manifest module so the runtime
+        toggle (``set_eligibility_enabled``) invalidates in one place.
+        """
+        return compiled_validation_eligible(type(self))
+
+    def _traced_value_flags(self, *args: Any, **kwargs: Any) -> Optional[Tuple]:
         """Traceable value-dependent input validation: ``(messages, flags)``.
 
         Subclasses that support compiled validation return a static tuple of
         violation messages and a same-length boolean array (``flags[i]=True``
         means the batch violates check ``i``), computed with jnp ops only —
         no host synchronization. The message tuple must not depend on the
-        argument values. The base returns ``None``: metrics without a traced
-        validator keep the eager path whenever ``validate_args=True``.
+        argument values. An optional third element gives per-check severities
+        (``"error"`` — default — or ``"warn"``): error checks drop the
+        violating batch's contribution and raise at the next sync point;
+        warn checks keep the batch and only warn (the traced twin of
+        warn-and-continue eager checks like the aggregators' NaN strategy).
+        The base returns ``None``: metrics without a traced validator keep
+        the eager path whenever ``validate_args=True`` (unless the
+        eligibility prover certified their validation metadata-only).
         """
         return None
 
@@ -1240,6 +1263,24 @@ class Metric(ABC):
         """True when compiled updates must carry the fused value checks."""
         return getattr(self, "validate_args", None) is True and self._supports_traced_validation()
 
+    @staticmethod
+    def _split_value_flags(res) -> Tuple[Tuple[str, ...], Any, Tuple[str, ...]]:
+        """Normalize a ``_traced_value_flags`` result to (msgs, flags, sevs).
+
+        Severities are validated loudly: an unknown string would otherwise
+        make a fired flag match neither the error nor the warn filter and
+        the violation would vanish silently.
+        """
+        msgs, flags = res[0], res[1]
+        sevs = tuple(res[2]) if len(res) > 2 else tuple("error" for _ in msgs)
+        bad = [s for s in sevs if s not in ("error", "warn")]
+        if bad or len(sevs) != len(msgs):
+            raise TorchMetricsUserError(
+                "`_traced_value_flags` severities must be 'error' or 'warn', one per message;"
+                f" got {sevs!r} for {len(tuple(msgs))} message(s)"
+            )
+        return tuple(msgs), flags, sevs
+
     def _prime_violation_state(self, treedef, dynamic: List[Any], statics) -> bool:
         """Learn the violation-message vector (once) before the first compile.
 
@@ -1249,8 +1290,13 @@ class Metric(ABC):
         """
         if self._viol_msgs is None:
             a, kw = self._merge_batch_args(treedef, dynamic, statics)
-            msgs, _ = self._traced_value_flags(*a, **kw)
-            self._viol_msgs = tuple(msgs)
+            msgs, _, sevs = self._split_value_flags(self._traced_value_flags(*a, **kw))
+            self._viol_msgs = msgs
+            self._viol_sevs = sevs
+        elif self._viol_sevs is None:
+            # metric unpickled from a pre-severity version with msgs already
+            # primed: backfill so the trace-time consistency check holds
+            self._viol_sevs = tuple("error" for _ in self._viol_msgs)
         if self._viol_flags is None and self._viol_msgs:
             object.__setattr__(self, "_viol_flags", jnp.zeros(len(self._viol_msgs), dtype=bool))
         return bool(self._viol_msgs)
@@ -1274,13 +1320,22 @@ class Metric(ABC):
             return
         vals = np.asarray(flags)
         if vals.any():
-            msgs = [m for m, v in zip(self._viol_msgs, vals) if v]
+            sevs = self._viol_sevs or tuple("error" for _ in self._viol_msgs)
+            errors = [m for m, s, v in zip(self._viol_msgs, sevs, vals) if v and s == "error"]
+            warns = [m for m, s, v in zip(self._viol_msgs, sevs, vals) if v and s == "warn"]
             object.__setattr__(self, "_viol_flags", jnp.zeros_like(flags))
-            raise RuntimeError(
-                f"{msgs[0]} (raised asynchronously: with `auto_compile` the `validate_args=True`"
-                " value checks run fused inside the compiled update and surface at the next host"
-                " synchronization point)"
-            )
+            for msg in warns:
+                rank_zero_warn(
+                    f"{msg} (surfaced asynchronously: this warn-severity check ran fused inside"
+                    " the compiled update)",
+                    UserWarning,
+                )
+            if errors:
+                raise RuntimeError(
+                    f"{errors[0]} (raised asynchronously: with `auto_compile` the `validate_args=True`"
+                    " value checks run fused inside the compiled update and surface at the next host"
+                    " synchronization point)"
+                )
 
     def _auto_state_names(self, method_name: str) -> Optional[List[str]]:
         """Fixed-shape state names for the auto paths (cached when stable)."""
@@ -1353,16 +1408,19 @@ class Metric(ABC):
                 a, kw = self._merge_batch_args(treedef, dyn, statics)
                 new_states_ = self._traced_update(names, states_, a, kw)
                 if validate:
-                    msgs, flags = self._traced_value_flags(*a, **kw)
-                    if tuple(msgs) != self._viol_msgs:  # static, checked at trace time
+                    msgs, flags, sevs = self._split_value_flags(self._traced_value_flags(*a, **kw))
+                    if msgs != self._viol_msgs or sevs != self._viol_sevs:  # static, checked at trace time
                         raise TorchMetricsUserError(
                             "traced validation messages changed across argument signatures"
                         )
                     viol = viol | flags
                     # a violating batch must not contaminate the state — the
                     # eager/reference path raises before committing, so the
-                    # compiled path drops the batch's contribution instead
-                    bad = jnp.any(flags)
+                    # compiled path drops the batch's contribution instead.
+                    # Warn-severity checks keep the batch (their eager twin
+                    # warns and continues), so only error flags gate the drop
+                    err_mask = np.array([s == "error" for s in sevs], dtype=bool)
+                    bad = jnp.any(flags & jnp.asarray(err_mask)) if err_mask.any() else jnp.zeros((), jnp.bool_)
                     new_states_ = jax.tree_util.tree_map(
                         lambda old, new: jnp.where(bad, old, new), states_, new_states_
                     )
@@ -1371,8 +1429,16 @@ class Metric(ABC):
             return _pure
 
         try:
-            fn = self._compiled_update("_auto_update_fn", (treedef, statics, validate), build)
-            new_states, new_viol = fn(states, self._viol_flags if validate else None, dynamic)
+            # the fused-flag marker lets traced bodies that need a raise-or-
+            # drop escape hatch (aggregator NaN "error") know their violation
+            # will be carried by the flag vector instead of silently lost
+            if validate:
+                self.__dict__["_fused_flags_tracing"] = True
+            try:
+                fn = self._compiled_update("_auto_update_fn", (treedef, statics, validate), build)
+                new_states, new_viol = fn(states, self._viol_flags if validate else None, dynamic)
+            finally:
+                self.__dict__.pop("_fused_flags_tracing", None)
         except Exception:
             self._auto_disabled = True
             return False
@@ -1452,13 +1518,16 @@ class Metric(ABC):
                 batch_val = _squeeze_if_scalar(self._traced_compute(names, batch))
                 bad = jnp.zeros((), dtype=jnp.bool_)
                 if validate:
-                    msgs, flags = self._traced_value_flags(*a, **kw)
-                    if tuple(msgs) != self._viol_msgs:  # static, checked at trace time
+                    msgs, flags, sevs = self._split_value_flags(self._traced_value_flags(*a, **kw))
+                    if msgs != self._viol_msgs or sevs != self._viol_sevs:  # static, checked at trace time
                         raise TorchMetricsUserError(
                             "traced validation messages changed across argument signatures"
                         )
                     viol = viol | flags
-                    bad = jnp.any(flags)
+                    # warn-severity checks never poison the batch value or
+                    # drop the merge — only error flags do
+                    err_mask = np.array([s == "error" for s in sevs], dtype=bool)
+                    bad = jnp.any(flags & jnp.asarray(err_mask)) if err_mask.any() else jnp.zeros((), jnp.bool_)
 
                     def _poison(v):
                         # the eager/reference contract raises and never
@@ -1505,10 +1574,15 @@ class Metric(ABC):
         if cnt is None or cnt[0] != self._update_count:
             cnt = (self._update_count, jnp.int32(self._update_count))
         try:
-            fn = self._compiled_update("_auto_forward_fn", (treedef, statics, validate), build)
-            new_states, batch_val, new_viol, new_cnt = fn(
-                states, self._viol_flags if validate else None, dynamic, cnt[1]
-            )
+            if validate:
+                self.__dict__["_fused_flags_tracing"] = True
+            try:
+                fn = self._compiled_update("_auto_forward_fn", (treedef, statics, validate), build)
+                new_states, batch_val, new_viol, new_cnt = fn(
+                    states, self._viol_flags if validate else None, dynamic, cnt[1]
+                )
+            finally:
+                self.__dict__.pop("_fused_flags_tracing", None)
         except Exception:
             self._auto_forward_disabled = True
             return False, None
@@ -1920,6 +1994,8 @@ class Metric(ABC):
         self.__dict__.setdefault("_resilience_events", [])
         self.__dict__.setdefault("_quarantined_updates", 0)
         self.__dict__.setdefault("_snapshot_hook", None)
+        # pickles written before severity-carrying traced validators
+        self.__dict__.setdefault("_viol_sevs", None)
 
     def __setattr__(self, name: str, value: Any) -> None:
         """Class-flag immutability guard (reference ``metric.py:715-726``)."""

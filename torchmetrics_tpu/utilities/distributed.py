@@ -26,6 +26,28 @@ from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
 Array = jax.Array
 
 
+def shard_map(f: Callable, *, mesh: Any, in_specs: Any, out_specs: Any, check_vma: Optional[bool] = None, **kwargs: Any):
+    """Version-portable ``shard_map``.
+
+    jax ≥ 0.6 exposes ``jax.shard_map`` with a ``check_vma`` kwarg; earlier
+    releases only have ``jax.experimental.shard_map.shard_map`` whose
+    equivalent kwarg is ``check_rep``. Tests and examples import from here so
+    the suite collects on either line (the bare ``from jax import shard_map``
+    was a hard collection error on 0.4.x).
+    """
+    try:
+        from jax import shard_map as _shard_map  # type: ignore[attr-defined]  # jax >= 0.6
+
+        if check_vma is not None:
+            kwargs["check_vma"] = check_vma
+    except ImportError:
+        from jax.experimental.shard_map import shard_map as _shard_map  # jax <= 0.5
+
+        if check_vma is not None:
+            kwargs["check_rep"] = check_vma
+    return _shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kwargs)
+
+
 def reduce(x: Array, reduction: Optional[str]) -> Array:
     """Reduce a tensor: ``elementwise_mean``/``sum``/``none`` (reference ``distributed.py:22``)."""
     if reduction == "elementwise_mean":
