@@ -1343,6 +1343,171 @@ def _bench_snapshot_overhead() -> tuple:
     return pair_ratio * plain_rate, plain_rate, active_rate
 
 
+# --------------------------------------------------------------------- #
+# observability: telemetry layer hot-path cost (OBSERVABILITY.md)         #
+# --------------------------------------------------------------------- #
+
+TEL_BENCH_UPDATES = 16  # updates per timed cycle — short, so pair members sit adjacent in time
+TEL_BENCH_REPS = 240  # interleaved cycle pairs
+# mirrors _observability.state.DEFAULT_SAMPLE_EVERY (kept literal: bench.py
+# must stay importable before _ensure_backend decides whether to re-exec)
+_TEL_DEFAULT_SAMPLING = 16
+
+
+def _bench_telemetry() -> tuple:
+    """(disabled updates/sec, shim-baseline updates/sec, enabled updates/sec).
+
+    The workload is the ``default_update_per_sec`` configuration: ctor-default
+    ``MulticlassAccuracy`` (``validate_args=True``) streaming one repeat-shape
+    batch through the auto-compiled path. Side A runs the shipped binary with
+    telemetry DISABLED (the instrumentation reduced to its cached-bool
+    branches); side B dispatches the same compiled hot path through a
+    telemetry-free shim replicating the pre-instrumentation wrapper — the
+    closest runtime approximation of "compiled out". Same paired-interleave /
+    alternating-lead / interquartile-mean-of-pair-ratios estimator as the
+    snapshot and guarded-sync overhead lines. The third rate re-runs the
+    loop with telemetry ENABLED at default sampling for the
+    ``telemetry_enabled_update_per_sec`` line (target: <=5% overhead).
+    """
+    import jax
+
+    from torchmetrics_tpu._observability import set_telemetry_enabled
+    from torchmetrics_tpu._observability.state import DEFAULT_SAMPLE_EVERY
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    assert DEFAULT_SAMPLE_EVERY == _TEL_DEFAULT_SAMPLING, "unit-string mirror drifted"
+    preds = jax.random.uniform(jax.random.PRNGKey(0), (BATCH, NUM_CLASSES))
+    target = jax.random.randint(jax.random.PRNGKey(1), (BATCH,), 0, NUM_CLASSES)
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES)  # out-of-the-box ctor
+    wrapped = metric.update
+
+    def bare_update(*args, **kwargs):
+        # the pre-instrumentation wrapper's compiled-path body: auto dispatch
+        # + journal probe, with no telemetry branch anywhere in THIS frame
+        # (branches inside _try_auto_update itself cannot be compiled out at
+        # runtime — they are the single-cached-bool checks under test)
+        if metric._try_auto_update(args, kwargs):
+            metric._journal_record("update", args, kwargs)
+            return None
+        return wrapped(*args, **kwargs)
+
+    set_telemetry_enabled(False)
+
+    def cycle() -> float:
+        t0 = time.perf_counter()
+        for _ in range(TEL_BENCH_UPDATES):
+            metric.update(preds, target)
+        jax.block_until_ready(metric.tp)
+        return time.perf_counter() - t0
+
+    try:
+        for _ in range(8):  # warm the compile + signature caches
+            cycle()
+        d_times, s_times = [], []
+        for rep in range(TEL_BENCH_REPS):
+            first_disabled = rep % 2 == 0
+            for disabled_side in (first_disabled, not first_disabled):
+                object.__setattr__(metric, "update", wrapped if disabled_side else bare_update)
+                (d_times if disabled_side else s_times).append(cycle())
+        object.__setattr__(metric, "update", wrapped)
+        ratios = sorted(s / d for d, s in zip(d_times, s_times))
+        core = ratios[len(ratios) // 4 : -(len(ratios) // 4)]
+        pair_ratio = sum(core) / len(core)
+        shim_med = sorted(s_times)[len(s_times) // 2]
+        shim_rate = TEL_BENCH_UPDATES / shim_med
+        disabled_rate = pair_ratio * shim_rate
+        # enabled-mode cost at default sampling: paired against disabled with
+        # the same alternating-lead interleave — this host's throughput
+        # drifts several percent over a run, so an unpaired median would
+        # report drift as "overhead"
+        set_telemetry_enabled(True)
+        cycle()  # lazily registers the telemetry object outside the timing
+        e_times, d2_times = [], []
+        for rep in range(TEL_BENCH_REPS):
+            first_enabled = rep % 2 == 0
+            for enabled_side in (first_enabled, not first_enabled):
+                set_telemetry_enabled(enabled_side)
+                (e_times if enabled_side else d2_times).append(cycle())
+        e_ratios = sorted(d / e for e, d in zip(e_times, d2_times))
+        e_core = e_ratios[len(e_ratios) // 4 : -(len(e_ratios) // 4)]
+        enabled_rate = (sum(e_core) / len(e_core)) * disabled_rate
+    finally:
+        set_telemetry_enabled(False)
+    return disabled_rate, shim_rate, enabled_rate
+
+
+_STAMP: dict = {}
+
+
+def _init_stamp() -> None:
+    """Compute the run-provenance stamp ONCE, outside every benched region.
+
+    Every emitted line then carries ``platform``/``jax_version``/``timestamp``
+    (ISSUE-10 satellite: artifacts must be attributable without re-deriving
+    the environment). The timestamp rides env ``TM_TPU_BENCH_TS`` so a
+    mid-run degrade re-exec keeps one run identity instead of re-reading the
+    clock inside the restarted run.
+    """
+    import datetime
+
+    import jax
+
+    ts = os.environ.get("TM_TPU_BENCH_TS")
+    if not ts:
+        ts = datetime.datetime.now(datetime.timezone.utc).isoformat(timespec="seconds")
+        os.environ["TM_TPU_BENCH_TS"] = ts  # inherited by any degrade re-exec
+    _STAMP.update({"platform": jax.default_backend(), "jax_version": jax.__version__, "timestamp": ts})
+
+
+def _on_cpu_backend() -> bool:
+    if _DEGRADED or os.environ.get("JAX_PLATFORMS", "").strip().lower() == "cpu":
+        return True
+    try:
+        import jax
+
+        return jax.default_backend() == "cpu"
+    except Exception:  # noqa: BLE001 - backend introspection itself failing
+        return False
+
+
+def _run_section(name: str, fn) -> None:
+    """Run one bench section; a backend death mid-run degrades instead of rc=1.
+
+    BENCH_r05 died INSIDE ``lax._convert_element_type`` after startup
+    succeeded, so :func:`_ensure_backend`'s startup-time fallback never
+    triggered. Any ``RuntimeError`` escaping a section while on an
+    accelerator backend now re-execs the whole bench on ``JAX_PLATFORMS=cpu``
+    with ``degraded=true`` (same recipe as the startup fallback); already on
+    the CPU backend — nothing left to fall back to — the section emits a
+    degraded stub line and the run continues, so one broken section can
+    never zero out the whole artifact again.
+    """
+    try:
+        fn()
+    except RuntimeError as err:
+        reason = f"{type(err).__name__}: {err}"
+        if not _on_cpu_backend():
+            sys.stderr.write(
+                f"accelerator backend failed mid-run in section {name!r} ({reason});"
+                " restarting on JAX_PLATFORMS=cpu with degraded=true\n"
+            )
+            sys.stderr.flush()
+            env = dict(os.environ, JAX_PLATFORMS="cpu", TM_TPU_BENCH_DEGRADED="1")
+            os.execvpe(sys.executable, [sys.executable] + sys.argv, env)
+        _emit(
+            {
+                # a distinct stub name: the section's representative metric
+                # may ALREADY have emitted a real line before a later bench
+                # in the same section died — the stub must never collide
+                # with (and supersede) a real measurement in the artifact
+                "metric": f"{name}.section_failed",
+                "value": None,
+                "unit": f"section failed on the fallback backend: {reason}",
+                "degraded": True,
+            }
+        )
+
+
 def _emit(line: dict) -> None:
     """Print one bench line and record it for the final summary line.
 
@@ -1352,12 +1517,15 @@ def _emit(line: dict) -> None:
     field carries every ``metric -> [value, vs_baseline]`` compactly — the
     full result set always survives in the recorded tail.
 
-    When the run fell back to the CPU backend (see :func:`_ensure_backend`)
-    every line carries ``"degraded": true`` so downstream consumers never
-    mistake fallback numbers for on-chip ones.
+    Every line is stamped with the run provenance computed by
+    :func:`_init_stamp`. When the run fell back to the CPU backend (see
+    :func:`_ensure_backend` / :func:`_run_section`) every line carries
+    ``"degraded": true`` so downstream consumers never mistake fallback
+    numbers for on-chip ones.
     """
+    line = dict(line, **_STAMP)
     if _DEGRADED:
-        line = dict(line, degraded=True)
+        line["degraded"] = True
     _RESULTS.append(line)
     print(json.dumps(line))
 
@@ -1377,274 +1545,338 @@ def _emit_summary() -> None:
 
 def main() -> None:
     _ensure_backend()
-    ours = _bench_ours()
-    base = _bench_torch_cpu_baseline()
-    _emit((
-            {
-                "metric": "multiclass_accuracy_updates_per_sec",
-                "value": round(ours, 2),
-                "unit": f"updates/sec (batch={BATCH}, C={NUM_CLASSES})",
-                "vs_baseline": round(ours / base, 3),
-            }
-        )
-    )
+    _init_stamp()
 
-    eager_rate, jit_rate, fwd_rate, default_rate = _bench_class_api()
-    class_base, class_base_fwd, class_base_default, have_ref = _bench_class_api_torch_baseline()
-    base_label = "reference class API on torch CPU" if have_ref else "plain torch stat-scores loop (reference unavailable)"
-    _emit((
-            {
-                "metric": "class_api_updates_per_sec",
-                "value": round(eager_rate, 2),
-                "unit": f"updates/sec (default Metric.update — auto-compiled on repeat shapes, batch={BATCH},"
-                f" C={NUM_CLASSES}; baseline = {base_label})",
-                "vs_baseline": round(eager_rate / class_base, 3),
-            }
-        )
-    )
-    _emit((
-            {
-                # the ROADMAP-1 default-vs-default line: out-of-the-box ctor,
-                # validate_args=True, no manual jit_update on either side
-                "metric": "default_update_per_sec",
-                "value": round(default_rate, 2),
-                "unit": f"updates/sec (ctor-default Metric.update, validate_args=True on BOTH sides —"
-                f" fused compiled value checks vs the reference's per-batch host checks, batch={BATCH},"
-                f" C={NUM_CLASSES}; baseline = {base_label} — ctor-default)",
-                "vs_baseline": round(default_rate / class_base_default, 3),
-            }
-        )
-    )
-    agg_rate, agg_base, agg_have_ref = _bench_default_aggregator()
-    agg_line = {
-        # out-of-the-box aggregator stream: previously pinned eager by the
-        # host-side NaN check, now compiled with the check fused as a
-        # deferred warn/error flag (eligibility prover round)
-        "metric": "default_aggregator_update_per_sec",
-        "value": round(agg_rate, 2),
-        "unit": f"updates/sec (ctor-default MeanMetric.update — nan_strategy='warn' traced as a"
-        f" fused deferred flag, batch={BATCH};"
-        + (" baseline = reference MeanMetric on torch CPU, ctor-default)" if agg_have_ref
-           else " no torch reference measurable)"),
-    }
-    if agg_base:
-        agg_line["vs_baseline"] = round(agg_rate / agg_base, 3)
-    _emit((agg_line))
-    _emit((
-            {
-                "metric": "class_api_jit_updates_per_sec",
-                "value": round(jit_rate, 2),
-                "unit": f"updates/sec (Metric.jit_update, batch={BATCH}, C={NUM_CLASSES};"
-                f" baseline = {base_label})",
-                "vs_baseline": round(jit_rate / class_base, 3),
-            }
-        )
-    )
-    _emit((
-            {
-                "metric": "class_api_forward_per_sec",
-                "value": round(fwd_rate, 2),
-                "unit": f"forwards/sec (dual-mode Metric.forward — batch value + accumulation, auto-compiled,"
-                f" batch={BATCH}, C={NUM_CLASSES}; baseline = {base_label} — forward)",
-                "vs_baseline": round(fwd_rate / class_base_fwd, 3),
-            }
-        )
-    )
-
-    data = _map_dataset()
-    map_t = _bench_map_ours(data)
-    map_base = _bench_map_cpu_baseline(data)
-    _emit((
-            {
-                "metric": "map_compute_wallclock_100k_boxes",
-                "value": round(map_t * 1000, 1),
-                "unit": f"ms ({MAP_IMGS} imgs x {MAP_DETS} dets, C={MAP_CLASSES}; baseline = pycocotools-profile CPU loops)",
-                "vs_baseline": round(map_base / map_t, 2),
-            }
-        )
-    )
-
-    map_upd, map_upd_base, map_base_label = _bench_map_streaming(data)
-    map_upd_line = {
-        "metric": "map_streaming_updates_per_sec",
-        "value": round(map_upd, 1),
-        "unit": f"updates/sec (1 img/update, {MAP_DETS} dets + {MAP_GTS} gts each;"
-        + (f" baseline = {map_base_label})" if map_upd_base else " no CPU reference measurable)"),
-    }
-    if map_upd_base:
-        map_upd_line["vs_baseline"] = round(map_upd / map_upd_base, 2)
-    _emit((map_upd_line))
-
-    fid_rate, fid_mfu, fid_roof, fid_weights_note = _bench_fid_imgs_per_sec()
-    _emit((
-            {
-                "metric": "fid_inception_images_per_sec",
-                "value": round(fid_rate, 1),
-                "unit": (
-                    f"imgs/sec (batch={FID_BATCH}, 299x299, InceptionV3 2048-d + cov fold; {fid_weights_note};"
-                    f" MFU={fid_mfu:.1%} of v5e bf16 peak per XLA cost analysis"
-                    + (
-                        f" — the trunk is HBM-bound: arithmetic intensity caps the roofline at"
-                        f" {fid_roof:.0%} MFU, so achieved = {fid_mfu / fid_roof:.0%} of the"
-                        f" memory-bound ceiling (batch sweep + analysis: tools/fid_mfu_experiment.py)"
-                        if fid_roof
-                        else ""
-                    )
-                    + "; no CPU reference measurable: torch-fidelity/torchvision absent)"
-                ),
-                "vs_baseline": 1.0,
-            }
-        )
-    )
-
-    lpips_rate, lpips_mfu, lpips_base = _bench_lpips()
-    _emit((
-            {
-                "metric": "lpips_images_per_sec",
-                "value": round(lpips_rate, 1),
-                "unit": (
-                    f"imgs/sec (batch={LPIPS_BATCH}, {LPIPS_RES}x{LPIPS_RES}, VGG16 trunk + LPIPS heads;"
-                    f" MFU={lpips_mfu:.1%} of v5e bf16 peak per XLA cost analysis;"
-                    " baseline = same-architecture VGG16 forward in plain torch on CPU)"
-                ),
-                "vs_baseline": round(lpips_rate / lpips_base, 2),
-            }
-        )
-    )
-
-    bert_enc_rate, bert_enc_mfu = _bench_bert_encoder()
-    _emit((
-            {
-                "metric": "bert_encoder_tokens_per_sec",
-                "value": round(bert_enc_rate, 1),
-                "unit": (
-                    f"tokens/sec (BERT-base, batch={BERT_BATCH}, len={BERT_LEN}, bf16;"
-                    f" MFU={bert_enc_mfu:.1%} of v5e bf16 peak per XLA cost analysis;"
-                    " no CPU reference measurable)"
-                ),
-            }
-        )
-    )
-
-    text_preds, text_target = _text_corpus()
-    rouge_rate, rouge_base = _bench_rouge(text_preds, text_target)
-    rouge_line = {
-        "metric": "rouge_samples_per_sec",
-        "value": round(rouge_rate, 1),
-        "unit": f"samples/sec ({TEXT_SAMPLES} pairs, rouge1/2/L;"
-        + (
-            " baseline = reference rouge_score on CPU)"
-            if rouge_base
-            else " no CPU reference measurable)"
-        ),
-    }
-    if rouge_base:
-        rouge_line["vs_baseline"] = round(rouge_rate / rouge_base, 2)
-    _emit((rouge_line))
-
-    bert_rate = _bench_bertscore_samples_per_sec(text_preds, text_target)
-    bert_base = _bench_bertscore_torch_cpu_baseline()
-    cer_rate, cer_base = _bench_cer()
-    _emit((
-            {
-                "metric": "bertscore_samples_per_sec",
-                "value": round(bert_rate, 1),
-                "unit": (
-                    f"samples/sec ({TEXT_SAMPLES} sentence pairs, batched greedy cosine matching;"
-                    " baseline = reference scoring math on torch CPU, embeddings precomputed)"
-                ),
-                "vs_baseline": round(bert_rate / bert_base, 2),
-            }
-        )
-    )
-    _emit((
-            {
-                "metric": "cer_long_transcript_samples_per_sec",
-                "value": round(cer_rate, 1),
-                "unit": f"samples/sec ({CER_SAMPLES} pairs x {CER_CHARS} chars; baseline = reference's per-sample python DP)",
-                "vs_baseline": round(cer_rate / cer_base, 2),
-            }
-        )
-    )
-
-    chip_pass, chip_total, on_chip, chip_failed = _bench_chip_parity()
-    _emit((
-            {
-                "metric": "chip_vs_cpu_parity",
-                "value": chip_pass,
-                "unit": (
-                    f"kernels matching the CPU oracle within on-chip tolerance floors, out of {chip_total}"
-                    + (f"; FAILED: {','.join(chip_failed)}" if chip_failed else "")
-                    + ("" if on_chip else " (cpu-only session: both legs on CPU)")
-                ),
-                "vs_baseline": round(chip_pass / chip_total, 3),
-            }
-        )
-    )
-
-    sync = _bench_collection_sync()
-    if sync is not None:
+    def sec_headline_accuracy() -> None:
+        ours = _bench_ours()
+        base = _bench_torch_cpu_baseline()
         _emit((
                 {
-                    "metric": "collection_sync_p50_latency",
-                    "value": round(sync["p50_ms"], 3),
-                    "unit": "ms (8-device mesh, fused jit psum step; baseline = eager per-shard host reduce)",
-                    "vs_baseline": round(sync["eager_p50_ms"] / sync["p50_ms"], 2),
+                    "metric": "multiclass_accuracy_updates_per_sec",
+                    "value": round(ours, 2),
+                    "unit": f"updates/sec (batch={BATCH}, C={NUM_CLASSES})",
+                    "vs_baseline": round(ours / base, 3),
                 }
             )
         )
 
-    guarded_rate, unguarded_rate = _bench_resilience_guard()
-    _emit((
-            {
-                "metric": "resilience_guarded_sync_overhead_per_sec",
-                "value": round(guarded_rate, 1),
-                "unit": (
-                    "guarded sync+unsync cycles/sec (simulated 2-process world, free in-process"
-                    " transport — the harshest denominator: real DCN collectives cost ms and"
-                    " dwarf the guard's ~6us/sync cost; MulticlassConfusionMatrix 128x128 state;"
-                    " default SyncPolicy: handshake + retry/backoff/degradation armed;"
-                    " baseline = same cycles unguarded, paired-interleaved per-pair-ratio median"
-                    " — vs_baseline is the happy-path retention ratio, target >= 0.97 i.e."
-                    " <3% guard overhead)"
-                ),
-                "vs_baseline": round(guarded_rate / unguarded_rate, 3),
-            }
+    def sec_class_api() -> None:
+        eager_rate, jit_rate, fwd_rate, default_rate = _bench_class_api()
+        class_base, class_base_fwd, class_base_default, have_ref = _bench_class_api_torch_baseline()
+        base_label = "reference class API on torch CPU" if have_ref else "plain torch stat-scores loop (reference unavailable)"
+        _emit((
+                {
+                    "metric": "class_api_updates_per_sec",
+                    "value": round(eager_rate, 2),
+                    "unit": f"updates/sec (default Metric.update — auto-compiled on repeat shapes, batch={BATCH},"
+                    f" C={NUM_CLASSES}; baseline = {base_label})",
+                    "vs_baseline": round(eager_rate / class_base, 3),
+                }
+            )
         )
-    )
+        _emit((
+                {
+                    # the ROADMAP-1 default-vs-default line: out-of-the-box ctor,
+                    # validate_args=True, no manual jit_update on either side
+                    "metric": "default_update_per_sec",
+                    "value": round(default_rate, 2),
+                    "unit": f"updates/sec (ctor-default Metric.update, validate_args=True on BOTH sides —"
+                    f" fused compiled value checks vs the reference's per-batch host checks, batch={BATCH},"
+                    f" C={NUM_CLASSES}; baseline = {base_label} — ctor-default)",
+                    "vs_baseline": round(default_rate / class_base_default, 3),
+                }
+            )
+        )
+        agg_rate, agg_base, agg_have_ref = _bench_default_aggregator()
+        agg_line = {
+            # out-of-the-box aggregator stream: previously pinned eager by the
+            # host-side NaN check, now compiled with the check fused as a
+            # deferred warn/error flag (eligibility prover round)
+            "metric": "default_aggregator_update_per_sec",
+            "value": round(agg_rate, 2),
+            "unit": f"updates/sec (ctor-default MeanMetric.update — nan_strategy='warn' traced as a"
+            f" fused deferred flag, batch={BATCH};"
+            + (" baseline = reference MeanMetric on torch CPU, ctor-default)" if agg_have_ref
+               else " no torch reference measurable)"),
+        }
+        if agg_base:
+            agg_line["vs_baseline"] = round(agg_rate / agg_base, 3)
+        _emit((agg_line))
+        _emit((
+                {
+                    "metric": "class_api_jit_updates_per_sec",
+                    "value": round(jit_rate, 2),
+                    "unit": f"updates/sec (Metric.jit_update, batch={BATCH}, C={NUM_CLASSES};"
+                    f" baseline = {base_label})",
+                    "vs_baseline": round(jit_rate / class_base, 3),
+                }
+            )
+        )
+        _emit((
+                {
+                    "metric": "class_api_forward_per_sec",
+                    "value": round(fwd_rate, 2),
+                    "unit": f"forwards/sec (dual-mode Metric.forward — batch value + accumulation, auto-compiled,"
+                    f" batch={BATCH}, C={NUM_CLASSES}; baseline = {base_label} — forward)",
+                    "vs_baseline": round(fwd_rate / class_base_fwd, 3),
+                }
+            )
+        )
 
-    fp_skip_rate, fp_guard_rate = _bench_fingerprint_skip()
-    _emit((
-            {
-                "metric": "eager_update_fingerprint_skip_per_sec",
-                "value": round(fp_skip_rate, 1),
-                "unit": (
-                    f"eager updates/sec (shape-churn MeanSquaredError, {FP_SKIP_UPDATES} distinct batch"
-                    " shapes past the auto-compile signature cache; R1-certified class skips"
-                    " _host_attr_snapshot; baseline = same run with the fingerprint guard forced on)"
-                ),
-                "vs_baseline": round(fp_skip_rate / fp_guard_rate, 3),
-            }
+    def sec_map() -> None:
+        data = _map_dataset()
+        map_t = _bench_map_ours(data)
+        map_base = _bench_map_cpu_baseline(data)
+        _emit((
+                {
+                    "metric": "map_compute_wallclock_100k_boxes",
+                    "value": round(map_t * 1000, 1),
+                    "unit": f"ms ({MAP_IMGS} imgs x {MAP_DETS} dets, C={MAP_CLASSES}; baseline = pycocotools-profile CPU loops)",
+                    "vs_baseline": round(map_base / map_t, 2),
+                }
+            )
         )
-    )
 
-    snap_hooked, snap_plain, snap_active = _bench_snapshot_overhead()
-    _emit((
-            {
-                "metric": "resilience_snapshot_overhead_per_sec",
-                "value": round(snap_hooked, 1),
-                "unit": (
-                    f"eager updates/sec (MeanSquaredError batch={BATCH}, SnapshotManager attached"
-                    " with snapshots disabled — the inline journal hook's hot-path dispatch;"
-                    " baseline = no manager attached, paired-interleaved per-pair-ratio"
-                    " interquartile mean — vs_baseline is the retention ratio, target >= 0.97 i.e. <3% hook"
-                    f" overhead; active journaling (host copy + pickle + framed flush per"
-                    f" update) sustains {snap_active:,.0f} updates/sec)"
-                ),
-                "vs_baseline": round(snap_hooked / snap_plain, 3),
-            }
+        map_upd, map_upd_base, map_base_label = _bench_map_streaming(data)
+        map_upd_line = {
+            "metric": "map_streaming_updates_per_sec",
+            "value": round(map_upd, 1),
+            "unit": f"updates/sec (1 img/update, {MAP_DETS} dets + {MAP_GTS} gts each;"
+            + (f" baseline = {map_base_label})" if map_upd_base else " no CPU reference measurable)"),
+        }
+        if map_upd_base:
+            map_upd_line["vs_baseline"] = round(map_upd / map_upd_base, 2)
+        _emit((map_upd_line))
+
+    def sec_fid() -> None:
+        fid_rate, fid_mfu, fid_roof, fid_weights_note = _bench_fid_imgs_per_sec()
+        _emit((
+                {
+                    "metric": "fid_inception_images_per_sec",
+                    "value": round(fid_rate, 1),
+                    "unit": (
+                        f"imgs/sec (batch={FID_BATCH}, 299x299, InceptionV3 2048-d + cov fold; {fid_weights_note};"
+                        f" MFU={fid_mfu:.1%} of v5e bf16 peak per XLA cost analysis"
+                        + (
+                            f" — the trunk is HBM-bound: arithmetic intensity caps the roofline at"
+                            f" {fid_roof:.0%} MFU, so achieved = {fid_mfu / fid_roof:.0%} of the"
+                            f" memory-bound ceiling (batch sweep + analysis: tools/fid_mfu_experiment.py)"
+                            if fid_roof
+                            else ""
+                        )
+                        + "; no CPU reference measurable: torch-fidelity/torchvision absent)"
+                    ),
+                    "vs_baseline": 1.0,
+                }
+            )
         )
-    )
+
+    def sec_lpips() -> None:
+        lpips_rate, lpips_mfu, lpips_base = _bench_lpips()
+        _emit((
+                {
+                    "metric": "lpips_images_per_sec",
+                    "value": round(lpips_rate, 1),
+                    "unit": (
+                        f"imgs/sec (batch={LPIPS_BATCH}, {LPIPS_RES}x{LPIPS_RES}, VGG16 trunk + LPIPS heads;"
+                        f" MFU={lpips_mfu:.1%} of v5e bf16 peak per XLA cost analysis;"
+                        " baseline = same-architecture VGG16 forward in plain torch on CPU)"
+                    ),
+                    "vs_baseline": round(lpips_rate / lpips_base, 2),
+                }
+            )
+        )
+
+    def sec_bert_encoder() -> None:
+        bert_enc_rate, bert_enc_mfu = _bench_bert_encoder()
+        _emit((
+                {
+                    "metric": "bert_encoder_tokens_per_sec",
+                    "value": round(bert_enc_rate, 1),
+                    "unit": (
+                        f"tokens/sec (BERT-base, batch={BERT_BATCH}, len={BERT_LEN}, bf16;"
+                        f" MFU={bert_enc_mfu:.1%} of v5e bf16 peak per XLA cost analysis;"
+                        " no CPU reference measurable)"
+                    ),
+                }
+            )
+        )
+
+    def sec_text() -> None:
+        text_preds, text_target = _text_corpus()
+        rouge_rate, rouge_base = _bench_rouge(text_preds, text_target)
+        rouge_line = {
+            "metric": "rouge_samples_per_sec",
+            "value": round(rouge_rate, 1),
+            "unit": f"samples/sec ({TEXT_SAMPLES} pairs, rouge1/2/L;"
+            + (
+                " baseline = reference rouge_score on CPU)"
+                if rouge_base
+                else " no CPU reference measurable)"
+            ),
+        }
+        if rouge_base:
+            rouge_line["vs_baseline"] = round(rouge_rate / rouge_base, 2)
+        _emit((rouge_line))
+
+        bert_rate = _bench_bertscore_samples_per_sec(text_preds, text_target)
+        bert_base = _bench_bertscore_torch_cpu_baseline()
+        cer_rate, cer_base = _bench_cer()
+        _emit((
+                {
+                    "metric": "bertscore_samples_per_sec",
+                    "value": round(bert_rate, 1),
+                    "unit": (
+                        f"samples/sec ({TEXT_SAMPLES} sentence pairs, batched greedy cosine matching;"
+                        " baseline = reference scoring math on torch CPU, embeddings precomputed)"
+                    ),
+                    "vs_baseline": round(bert_rate / bert_base, 2),
+                }
+            )
+        )
+        _emit((
+                {
+                    "metric": "cer_long_transcript_samples_per_sec",
+                    "value": round(cer_rate, 1),
+                    "unit": f"samples/sec ({CER_SAMPLES} pairs x {CER_CHARS} chars; baseline = reference's per-sample python DP)",
+                    "vs_baseline": round(cer_rate / cer_base, 2),
+                }
+            )
+        )
+
+    def sec_chip_parity() -> None:
+        chip_pass, chip_total, on_chip, chip_failed = _bench_chip_parity()
+        _emit((
+                {
+                    "metric": "chip_vs_cpu_parity",
+                    "value": chip_pass,
+                    "unit": (
+                        f"kernels matching the CPU oracle within on-chip tolerance floors, out of {chip_total}"
+                        + (f"; FAILED: {','.join(chip_failed)}" if chip_failed else "")
+                        + ("" if on_chip else " (cpu-only session: both legs on CPU)")
+                    ),
+                    "vs_baseline": round(chip_pass / chip_total, 3),
+                }
+            )
+        )
+
+    def sec_collection_sync() -> None:
+        sync = _bench_collection_sync()
+        if sync is not None:
+            _emit((
+                    {
+                        "metric": "collection_sync_p50_latency",
+                        "value": round(sync["p50_ms"], 3),
+                        "unit": "ms (8-device mesh, fused jit psum step; baseline = eager per-shard host reduce)",
+                        "vs_baseline": round(sync["eager_p50_ms"] / sync["p50_ms"], 2),
+                    }
+                )
+            )
+
+    def sec_resilience_guard() -> None:
+        guarded_rate, unguarded_rate = _bench_resilience_guard()
+        _emit((
+                {
+                    "metric": "resilience_guarded_sync_overhead_per_sec",
+                    "value": round(guarded_rate, 1),
+                    "unit": (
+                        "guarded sync+unsync cycles/sec (simulated 2-process world, free in-process"
+                        " transport — the harshest denominator: real DCN collectives cost ms and"
+                        " dwarf the guard's ~6us/sync cost; MulticlassConfusionMatrix 128x128 state;"
+                        " default SyncPolicy: handshake + retry/backoff/degradation armed;"
+                        " baseline = same cycles unguarded, paired-interleaved per-pair-ratio median"
+                        " — vs_baseline is the happy-path retention ratio, target >= 0.97 i.e."
+                        " <3% guard overhead)"
+                    ),
+                    "vs_baseline": round(guarded_rate / unguarded_rate, 3),
+                }
+            )
+        )
+
+    def sec_fingerprint_skip() -> None:
+        fp_skip_rate, fp_guard_rate = _bench_fingerprint_skip()
+        _emit((
+                {
+                    "metric": "eager_update_fingerprint_skip_per_sec",
+                    "value": round(fp_skip_rate, 1),
+                    "unit": (
+                        f"eager updates/sec (shape-churn MeanSquaredError, {FP_SKIP_UPDATES} distinct batch"
+                        " shapes past the auto-compile signature cache; R1-certified class skips"
+                        " _host_attr_snapshot; baseline = same run with the fingerprint guard forced on)"
+                    ),
+                    "vs_baseline": round(fp_skip_rate / fp_guard_rate, 3),
+                }
+            )
+        )
+
+    def sec_snapshot_overhead() -> None:
+        snap_hooked, snap_plain, snap_active = _bench_snapshot_overhead()
+        _emit((
+                {
+                    "metric": "resilience_snapshot_overhead_per_sec",
+                    "value": round(snap_hooked, 1),
+                    "unit": (
+                        f"eager updates/sec (MeanSquaredError batch={BATCH}, SnapshotManager attached"
+                        " with snapshots disabled — the inline journal hook's hot-path dispatch;"
+                        " baseline = no manager attached, paired-interleaved per-pair-ratio"
+                        " interquartile mean — vs_baseline is the retention ratio, target >= 0.97 i.e. <3% hook"
+                        f" overhead; active journaling (host copy + pickle + framed flush per"
+                        f" update) sustains {snap_active:,.0f} updates/sec)"
+                    ),
+                    "vs_baseline": round(snap_hooked / snap_plain, 3),
+                }
+            )
+        )
+
+    def sec_telemetry() -> None:
+        tel_disabled, tel_shim, tel_enabled = _bench_telemetry()
+        _emit((
+                {
+                    "metric": "telemetry_disabled_retention",
+                    "value": round(tel_disabled, 1),
+                    "unit": (
+                        f"compiled default updates/sec (ctor-default MulticlassAccuracy batch={BATCH},"
+                        " telemetry OFF — the shipped single-cached-bool instrumentation branches;"
+                        " baseline = same compiled hot path dispatched through a telemetry-free"
+                        " wrapper shim (runtime approximation of the instrumentation compiled out),"
+                        " paired-interleaved per-pair-ratio interquartile mean — vs_baseline is the"
+                        " retention ratio, target >= 0.97)"
+                    ),
+                    "vs_baseline": round(tel_disabled / tel_shim, 3),
+                }
+            )
+        )
+        _emit((
+                {
+                    "metric": "telemetry_enabled_update_per_sec",
+                    "value": round(tel_enabled, 1),
+                    "unit": (
+                        f"compiled default updates/sec (same workload with telemetry ENABLED at default"
+                        f" sampling (1/{_TEL_DEFAULT_SAMPLING} latency samples): per-path counters, churn"
+                        " tracking, profiler annotations; baseline = the telemetry-off rate —"
+                        " vs_baseline is enabled/off, target >= 0.95 i.e. <=5% overhead)"
+                    ),
+                    "vs_baseline": round(tel_enabled / tel_disabled, 3),
+                }
+            )
+        )
+
+    for name, section in (
+        ("multiclass_accuracy_updates_per_sec", sec_headline_accuracy),
+        ("class_api_updates_per_sec", sec_class_api),
+        ("map_compute_wallclock_100k_boxes", sec_map),
+        ("fid_inception_images_per_sec", sec_fid),
+        ("lpips_images_per_sec", sec_lpips),
+        ("bert_encoder_tokens_per_sec", sec_bert_encoder),
+        ("rouge_samples_per_sec", sec_text),
+        ("chip_vs_cpu_parity", sec_chip_parity),
+        ("collection_sync_p50_latency", sec_collection_sync),
+        ("resilience_guarded_sync_overhead_per_sec", sec_resilience_guard),
+        ("eager_update_fingerprint_skip_per_sec", sec_fingerprint_skip),
+        ("resilience_snapshot_overhead_per_sec", sec_snapshot_overhead),
+        ("telemetry_disabled_retention", sec_telemetry),
+    ):
+        _run_section(name, section)
 
     _emit_summary()
 
@@ -1686,7 +1918,13 @@ def _parse_bench_artifact(path: str):
                 recovered.append(row)
             rows = recovered + detailed
             break
-    return rows
+    # a mid-run degrade re-exec restarts the whole bench, so an artifact can
+    # carry a partial on-chip pass followed by a full degraded pass: keep only
+    # the LAST line per metric (the restarted run's), never duplicate rows
+    deduped: dict = {}
+    for row in rows:
+        deduped[row["metric"]] = row
+    return list(deduped.values())
 
 
 _README_LABELS = {
@@ -1708,6 +1946,8 @@ _README_LABELS = {
     "resilience_guarded_sync_overhead_per_sec": ("Guarded sync (resilience) happy path", "{v:,.0f} cycles/s"),
     "resilience_snapshot_overhead_per_sec": ("Snapshot journal hook (disabled) eager `update()`", "{v:,.0f} updates/s"),
     "eager_update_fingerprint_skip_per_sec": ("Certified fingerprint-skip eager `update()`", "{v:,.0f} updates/s"),
+    "telemetry_disabled_retention": ("Telemetry (disabled) compiled default `update()`", "{v:,.0f} updates/s"),
+    "telemetry_enabled_update_per_sec": ("Telemetry (enabled, default sampling) `update()`", "{v:,.0f} updates/s"),
 }
 
 
@@ -1730,6 +1970,8 @@ def update_readme(artifact_path: str, readme_path: str = "README.md") -> None:
     ]
     for d in rows:
         label, fmt = _README_LABELS.get(d["metric"], (d["metric"], "{v:g}"))
+        if d["value"] is None:  # degraded stub line from a failed section
+            continue
         value = fmt.format(v=d["value"])
         vsb = d.get("vs_baseline")
         # placeholder ratios (no measurable reference on this machine) render

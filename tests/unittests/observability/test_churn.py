@@ -1,0 +1,111 @@
+"""Recompile-churn detection tests (ISSUE-10 acceptance: varying an input
+shape fires EXACTLY ONE rate-limited warning that names the differing
+cache-key component)."""
+
+from __future__ import annotations
+
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._observability import (
+    BUS,
+    REGISTRY,
+    RecompileChurnWarning,
+    set_telemetry_enabled,
+)
+
+
+@pytest.fixture()
+def telemetry():
+    set_telemetry_enabled(True)
+    yield
+    set_telemetry_enabled(False)
+    REGISTRY.reset()
+    BUS.clear()
+
+
+def _churn_warnings(record):
+    return [w for w in record if issubclass(w.category, RecompileChurnWarning)]
+
+
+def test_shape_variation_fires_exactly_one_warning_naming_shapes(telemetry):
+    metric = tm.MeanSquaredError()
+    with warnings.catch_warnings(record=True) as record:
+        warnings.simplefilter("always")
+        for n in (8, 9, 10, 11):
+            for _ in range(2):  # each signature: one eager warm-up + one replay
+                metric.update(jnp.ones(n), jnp.zeros(n))
+    churn = _churn_warnings(record)
+    assert len(churn) == 1, [str(w.message) for w in churn]
+    message = str(churn[0].message)
+    assert "shapes" in message  # names the differing cache-key component
+    assert "(8,)" in message and "(9,)" in message  # old -> new values
+    rep = metric.telemetry_report()
+    assert rep.churn["warnings"] == 1
+    assert rep.churn["suppressed"] == 2  # the 10- and 11-element recompiles
+    assert rep.counter("recompiles|kind=auto_update") == 3
+    assert rep.counter("compiles|kind=auto_update") == 4
+
+
+def test_dtype_variation_names_dtypes_component(telemetry):
+    metric = tm.MeanSquaredError()
+    with warnings.catch_warnings(record=True) as record:
+        warnings.simplefilter("always")
+        metric.update(jnp.ones(8, jnp.float32), jnp.zeros(8, jnp.float32))
+        metric.update(jnp.ones(8, jnp.int32), jnp.zeros(8, jnp.int32))
+    churn = _churn_warnings(record)
+    assert len(churn) == 1
+    assert "dtypes" in str(churn[0].message)
+    assert "shapes" not in str(churn[0].message).split("changed (")[1].split(")")[0]
+
+
+def test_stable_shapes_never_warn(telemetry):
+    metric = tm.MeanSquaredError()
+    with warnings.catch_warnings(record=True) as record:
+        warnings.simplefilter("always")
+        for _ in range(10):
+            metric.update(jnp.ones(8), jnp.zeros(8))
+    assert not _churn_warnings(record)
+    rep = metric.telemetry_report()
+    assert rep.counter("compiles|kind=auto_update") == 1
+    assert rep.counter("recompiles|kind=auto_update") == 0
+
+
+def test_churn_events_reach_the_bus(telemetry):
+    metric = tm.MeanSquaredError()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for n in (8, 9):
+            metric.update(jnp.ones(n), jnp.zeros(n))
+    events = BUS.events(kind="recompile_churn", source="MeanSquaredError")
+    assert len(events) == 1
+    assert events[0].data["changed"] == ["shapes"]
+
+
+def test_signature_overflow_counts_under_relentless_churn(telemetry):
+    metric = tm.MeanSquaredError()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for n in range(4, 4 + metric._AUTO_MAX_SIGNATURES + 3):
+            metric.update(jnp.ones(n), jnp.zeros(n))
+    rep = metric.telemetry_report()
+    # the signature cache saturated: every further shape streams eagerly and
+    # is counted so the pathology is visible, not silent — but NOT as a
+    # "compile": no executable is ever built for the overflow signatures
+    assert rep.counter("signature_overflow") == 3
+    assert rep.counter("uncompiled_signatures|kind=auto_update") == 3
+    assert rep.counter("compiles|kind=auto_update") == metric._AUTO_MAX_SIGNATURES
+    assert rep.path_counts.get("auto_compiled") is None
+
+
+def test_disabled_telemetry_never_warns_on_churn():
+    metric = tm.MeanSquaredError()
+    with warnings.catch_warnings(record=True) as record:
+        warnings.simplefilter("always")
+        for n in (8, 9, 10):
+            for _ in range(2):
+                metric.update(jnp.ones(n), jnp.zeros(n))
+    assert not _churn_warnings(record)

@@ -1,0 +1,90 @@
+"""MULTICHIP harness heartbeat tests (ISSUE-10 satellite).
+
+An rc=124 round must leave a journal naming the phase that hung. These
+tests drive the ``_Heartbeat`` protocol directly (the full dryrun is the
+multichip harness's job) and assert the post-mortem contract: durable
+JSONL records, deadline-exceeded watchdog firing, and hang attribution
+via the last ``phase_start`` without a matching ``phase_end``.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import pytest
+
+import __graft_entry__ as graft
+from torchmetrics_tpu._observability import BUS
+
+
+@pytest.fixture()
+def journal(tmp_path, monkeypatch):
+    path = tmp_path / "heartbeat.jsonl"
+    monkeypatch.setenv("TM_TPU_MULTICHIP_JOURNAL", str(path))
+    yield path
+    BUS.clear()
+
+
+def _records(path):
+    return [json.loads(line) for line in path.read_text().splitlines() if line.strip()]
+
+
+def test_phases_journal_start_end_and_run_end(journal, capsys):
+    hb = graft._Heartbeat(n_devices=8)
+    hb.begin("phase1:x")
+    hb.begin("phase2:y")  # flat protocol: begin closes the prior phase
+    hb.close(ok=True)
+    events = [(r["event"], r["phase"]) for r in _records(journal)]
+    assert events == [
+        ("run_start", None),
+        ("phase_start", "phase1:x"),
+        ("phase_end", "phase1:x"),
+        ("phase_start", "phase2:y"),
+        ("phase_end", "phase2:y"),
+        ("run_end", None),
+    ]
+    # every record is also on flushed stdout for the driver's recorded tail
+    out = capsys.readouterr().out
+    assert out.count("[multichip-heartbeat]") == len(events)
+    # and force-published past the telemetry kill switch onto the event bus
+    assert BUS.events(kind="multichip_phase_start")
+
+
+def test_kill_leaves_hanging_phase_attributable(journal):
+    hb = graft._Heartbeat(n_devices=8)
+    hb.begin("phase1:x")
+    hb.end()
+    hb.begin("phase3:hangs")
+    # simulate SIGKILL: no end(), no close() — only the fsynced journal stays
+    records = _records(journal)
+    started = [r["phase"] for r in records if r["event"] == "phase_start"]
+    ended = [r["phase"] for r in records if r["event"] == "phase_end"]
+    hanging = [p for p in started if p not in ended]
+    assert hanging == ["phase3:hangs"]
+    assert all("deadline_s" in r for r in records if r["event"] == "phase_start")
+    hb.close(ok=True)  # cleanup
+
+
+def test_watchdog_records_deadline_exceeded(journal, monkeypatch):
+    monkeypatch.setenv("TM_TPU_MULTICHIP_PHASE_DEADLINE", "0.05")
+    hb = graft._Heartbeat(n_devices=8)
+    hb.begin("phase2:slow")
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        if any(r["event"] == "phase_deadline_exceeded" for r in _records(journal)):
+            break
+        time.sleep(0.02)
+    hb.close(ok=True)
+    exceeded = [r for r in _records(journal) if r["event"] == "phase_deadline_exceeded"]
+    assert exceeded and exceeded[0]["phase"] == "phase2:slow"
+
+
+def test_failure_records_phase_failed(journal):
+    hb = graft._Heartbeat(n_devices=8)
+    hb.begin("phase4:boom")
+    hb.close(ok=False, error="RuntimeError: collective failed")
+    records = _records(journal)
+    failed = [r for r in records if r["event"] == "phase_failed"]
+    assert failed and failed[0]["phase"] == "phase4:boom"
+    assert records[-1]["event"] == "run_end" and records[-1]["ok"] is False

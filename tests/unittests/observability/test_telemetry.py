@@ -1,0 +1,447 @@
+"""Unit tests for the runtime telemetry layer (OBSERVABILITY.md).
+
+Covers the registry/reservoir/event-bus building blocks, the per-seam
+counters recorded by the instrumented runtime, the export surfaces
+(Prometheus text exposition — validated with the standard
+``prometheus_client`` parser — and round-trippable JSON), the kill
+switches, and the zero-footprint contract of the disabled path.
+"""
+
+from __future__ import annotations
+
+import gc
+import json
+import math
+import warnings
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import MetricCollection, aggregation
+from torchmetrics_tpu._observability import (
+    BUS,
+    EventBus,
+    LatencyReservoir,
+    REGISTRY,
+    TelemetryReport,
+    set_telemetry_enabled,
+    set_telemetry_sampling,
+    telemetry_enabled,
+)
+from torchmetrics_tpu._observability.state import DEFAULT_SAMPLE_EVERY
+
+
+@pytest.fixture()
+def telemetry():
+    """Enable collection for one test; restore the pristine disabled state."""
+    set_telemetry_enabled(True)
+    set_telemetry_sampling(1)  # deterministic reservoirs in tests
+    yield REGISTRY
+    set_telemetry_enabled(False)
+    set_telemetry_sampling(DEFAULT_SAMPLE_EVERY)
+    REGISTRY.reset()
+    BUS.clear()
+
+
+# --------------------------------------------------------------- reservoir
+def test_reservoir_ring_and_stats():
+    res = LatencyReservoir(capacity=4)
+    assert res.stats() == {"count": 0}
+    assert math.isnan(res.quantile(0.5))
+    for v in (1.0, 2.0, 3.0):
+        res.push(v)
+    assert res.values() == [1.0, 2.0, 3.0]
+    for v in (4.0, 5.0):  # wraps: retains the most recent 4
+        res.push(v)
+    assert res.values() == [2.0, 3.0, 4.0, 5.0]
+    stats = res.stats()
+    assert stats["count"] == 5  # lifetime-exact even after eviction
+    assert stats["min"] == 1.0 and stats["max"] == 5.0
+    assert stats["sum"] == pytest.approx(15.0)
+    assert stats["p50"] == 3.0  # over the retained window
+    assert LatencyReservoir(capacity=1).capacity == 1
+    with pytest.raises(ValueError):
+        LatencyReservoir(capacity=0)
+
+
+# --------------------------------------------------------------- event bus
+def test_event_bus_publish_subscribe_and_bounds(telemetry):
+    bus = EventBus(capacity=3)
+    seen = []
+    unsubscribe = bus.subscribe(seen.append)
+    for i in range(5):
+        bus.publish("k", "src", f"event {i}")
+    assert len(bus) == 3 and bus.dropped == 2
+    assert [e.detail for e in bus.events()] == ["event 2", "event 3", "event 4"]
+    assert len(seen) == 5  # subscribers see every publish, eviction or not
+    seqs = [e.seq for e in bus.events()]
+    assert seqs == sorted(seqs)
+    unsubscribe()
+    bus.publish("k", "src", "after unsubscribe")
+    assert len(seen) == 5
+    assert bus.kind_counts() == {"k": 3}
+
+
+def test_event_bus_lifetime_totals_survive_eviction(telemetry):
+    bus = EventBus(capacity=3)
+    for i in range(5):
+        bus.publish("k", "src", f"event {i}")
+    # window counts shrink with eviction; exported totals are monotonic
+    assert bus.kind_counts() == {"k": 3}
+    assert bus.kind_totals() == {"k": 5}
+    bus.clear()
+    assert bus.kind_totals() == {}
+
+
+def test_event_bus_disabled_is_silent():
+    set_telemetry_enabled(False)
+    bus = EventBus()
+    assert bus.publish("k", "src", "dropped") is None
+    assert len(bus) == 0
+    # force=True bypasses the switch (harness heartbeats)
+    assert bus.publish("k", "src", "forced", force=True) is not None
+    assert len(bus) == 1
+
+
+def test_event_bus_bad_subscriber_dropped(telemetry):
+    bus = EventBus()
+
+    def bad(_e):
+        raise RuntimeError("boom")
+
+    bus.subscribe(bad)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        bus.publish("k", "src", "first")
+    bus.publish("k", "src", "second")  # must not raise
+    assert len(bus) == 2
+
+
+# ----------------------------------------------------------- path counters
+def test_update_path_counters_eager_then_compiled(telemetry):
+    metric = tm.MeanSquaredError()
+    p, t = jnp.ones(8), jnp.zeros(8)
+    for _ in range(4):
+        metric.update(p, t)
+    rep = metric.telemetry_report()
+    assert rep.enabled
+    # first signature occurrence runs eagerly, repeats replay the executable
+    assert rep.path_counts == {"eager": 1, "auto_compiled": 3}
+    assert rep.total_updates == 4
+    assert rep.counter("compiles|kind=auto_update") == 1
+    assert rep.counter("trace_seconds") > 0
+    # R1-certified class skips the fingerprint on its eager pass
+    assert rep.counter("fingerprint|outcome=skip") == 1
+
+
+def test_jit_and_scan_path_counters(telemetry):
+    metric = tm.MeanSquaredError()
+    p, t = jnp.ones(8), jnp.zeros(8)
+    metric.jit_update(p, t)
+    metric.jit_update(p, t)
+    metric.scan_update(jnp.ones((3, 8)), jnp.zeros((3, 8)))
+    rep = metric.telemetry_report()
+    assert rep.path_counts["jit"] == 2
+    assert rep.path_counts["scan"] == 1
+    assert rep.counter("scan_steps") == 3
+    assert rep.counter("compiles|kind=jit_update") == 1
+    assert rep.counter("compiles|kind=scan_update") == 1
+
+
+def test_compute_cache_hit_counter(telemetry):
+    metric = tm.MeanSquaredError()
+    metric.update(jnp.ones(4), jnp.zeros(4))
+    metric.compute()
+    metric.compute()  # cached
+    rep = metric.telemetry_report()
+    assert rep.counter("compute_calls|outcome=computed") == 1
+    assert rep.counter("compute_calls|outcome=cache_hit") == 1
+
+
+def test_quarantine_counter_and_degradation_on_bus(telemetry):
+    metric = tm.MeanSquaredError(nan_policy="quarantine")
+    metric.update(jnp.ones(4), jnp.zeros(4))
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        metric.update(jnp.array([1.0, jnp.nan]), jnp.zeros(2))
+    rep = metric.telemetry_report()
+    assert rep.counter("quarantined_batches") == 1
+    assert rep.counter("degradations|kind=nan_quarantine") == 1
+    events = BUS.events(kind="degradation", source="MeanSquaredError")
+    assert events and events[-1].data["kind"] == "nan_quarantine"
+
+
+def test_deferred_violation_counters(telemetry):
+    # drive the real compiled validate_args path: MeanMetric's NaN check
+    # traces as a warn-severity deferred flag (PR-9 aggregation port)
+    metric = aggregation.MeanMetric(nan_strategy="warn")
+    good = jnp.ones(8)
+    metric.update(good)   # eager first pass
+    metric.update(good)   # compiled replay
+    bad = jnp.array([1.0, jnp.nan, 3.0, 4.0, 5.0, 6.0, 7.0, 8.0])
+    metric.update(bad)    # violation OR-accumulates device-side
+    with pytest.warns(UserWarning, match="surfaced asynchronously"):
+        metric.compute()  # next host sync point surfaces it
+    rep = metric.telemetry_report()
+    assert rep.counter("deferred_violations|severity=warn") >= 1
+
+
+def test_latency_reservoirs_sampled(telemetry):
+    metric = tm.MeanSquaredError()
+    p, t = jnp.ones(8), jnp.zeros(8)
+    for _ in range(5):
+        metric.update(p, t)
+    metric.compute()
+    rep = metric.telemetry_report()
+    assert rep.latency["update_eager"]["count"] == 1
+    assert rep.latency["update_compiled"]["count"] == 4
+    assert rep.latency["compute"]["count"] == 1
+    assert rep.latency["update_compiled"]["p50"] > 0
+
+
+def test_sampling_rate_bounds_reservoir_growth(telemetry):
+    set_telemetry_sampling(4)
+    metric = tm.MeanSquaredError()
+    p, t = jnp.ones(8), jnp.zeros(8)
+    for _ in range(9):
+        metric.update(p, t)
+    rep = metric.telemetry_report()
+    # counters stay exact; latency samples are 1-in-4
+    assert rep.total_updates == 9
+    sampled = sum(r["count"] for r in rep.latency.values() if r)
+    assert sampled <= 3
+
+
+# ------------------------------------------------------------ kill switches
+def test_disabled_records_nothing():
+    assert not telemetry_enabled()  # the shipped default
+    metric = tm.MeanSquaredError()
+    metric.update(jnp.ones(4), jnp.zeros(4))
+    rep = metric.telemetry_report()
+    assert rep.counters == {} and not rep.enabled
+    assert "_telem" not in metric.__dict__  # no allocation on the disabled path
+
+
+def test_runtime_toggle_stops_and_resumes_counting(telemetry):
+    metric = tm.MeanSquaredError()
+    p, t = jnp.ones(4), jnp.zeros(4)
+    metric.update(p, t)
+    set_telemetry_enabled(False)
+    metric.update(p, t)
+    set_telemetry_enabled(True)
+    metric.update(p, t)
+    assert metric.telemetry_report().total_updates == 2
+
+
+def test_env_kill_switch_shape():
+    # the env var is read once at import; validate the documented contract
+    # against the live state module rather than re-importing the package
+    from torchmetrics_tpu._observability import state
+
+    assert state.OBS.sample_every >= 1
+    with pytest.raises(ValueError):
+        set_telemetry_sampling(0)
+
+
+# ---------------------------------------------------------------- registry
+def test_registry_retires_collected_metrics(telemetry):
+    metric = tm.MeanSquaredError()
+    metric.update(jnp.ones(4), jnp.zeros(4))
+    metric.update(jnp.ones(4), jnp.zeros(4))
+    del metric
+    gc.collect()
+    agg = REGISTRY.aggregate()
+    entry = agg["MeanSquaredError"]
+    assert entry["retired_instances"] == 1
+    assert entry["counters"]["update_calls|path=eager"] == 1
+    assert entry["counters"]["update_calls|path=auto_compiled"] == 1
+
+
+def test_registry_aggregates_across_instances(telemetry):
+    a, b = tm.MeanSquaredError(), tm.MeanSquaredError()
+    a.update(jnp.ones(4), jnp.zeros(4))
+    b.update(jnp.ones(4), jnp.zeros(4))
+    agg = REGISTRY.aggregate()
+    assert agg["MeanSquaredError"]["instances"] == 2
+    assert agg["MeanSquaredError"]["counters"]["update_calls|path=eager"] == 2
+
+
+def test_clone_starts_a_fresh_telemetry_stream(telemetry):
+    metric = tm.MeanSquaredError()
+    metric.update(jnp.ones(4), jnp.zeros(4))
+    clone = metric.clone()
+    assert clone.telemetry_report().counters == {}
+    clone.update(jnp.ones(4), jnp.zeros(4))
+    assert clone.telemetry_report().total_updates == 1
+    assert metric.telemetry_report().total_updates == 1
+
+
+# ----------------------------------------------------------------- exports
+def test_prometheus_output_parses_with_standard_parser(telemetry):
+    parser = pytest.importorskip("prometheus_client.parser")
+    metric = tm.MeanSquaredError()
+    for _ in range(3):
+        metric.update(jnp.ones(8), jnp.zeros(8))
+    metric.compute()
+    BUS.publish("degradation", "MeanSquaredError", "synthetic")
+    text = REGISTRY.render_prometheus()
+    families = {f.name: f for f in parser.text_string_to_metric_families(text)}
+    assert "tmtpu_update_calls" in families
+    samples = {
+        tuple(sorted(s.labels.items())): s.value
+        for s in families["tmtpu_update_calls"].samples
+    }
+    assert samples[(("metric", "MeanSquaredError"), ("path", "auto_compiled"))] == 2
+    assert samples[(("metric", "MeanSquaredError"), ("path", "eager"))] == 1
+    assert "tmtpu_telemetry_enabled" in families
+    assert "tmtpu_events" in families
+    # exposition-format invariants the parser does not enforce
+    assert text.endswith("\n")
+    for family in families.values():
+        assert family.documentation  # every family carries HELP text
+
+
+def test_prometheus_label_escaping(telemetry):
+    from torchmetrics_tpu._observability.export import _escape_label
+
+    assert _escape_label('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
+def test_json_export_round_trips(telemetry):
+    metric = tm.MeanSquaredError()
+    metric.update(jnp.ones(4), jnp.zeros(4))
+    payload = REGISTRY.to_json()
+    rehydrated = json.loads(json.dumps(payload))
+    assert rehydrated == payload
+    assert rehydrated["enabled"] is True
+    counters = rehydrated["metrics"]["MeanSquaredError"]["counters"]
+    assert counters["update_calls|path=eager"] == 1
+
+
+# --------------------------------------------------------------- collection
+def test_collection_telemetry_report_and_aggregation(telemetry):
+    mc = MetricCollection(
+        {"mse": tm.MeanSquaredError(), "mae": tm.MeanAbsoluteError()}, compute_groups=False
+    )
+    p, t = jnp.ones(8), jnp.zeros(8)
+    for _ in range(3):
+        mc.update(p, t)
+    reports = mc.telemetry_report()
+    assert set(reports) == {"mse", "mae"}
+    assert all(rep.total_updates == 3 for rep in reports.values())
+    merged = mc.telemetry_report(aggregate=True)
+    assert isinstance(merged, TelemetryReport)
+    assert merged.total_updates == 6
+
+
+def test_cloned_collection_telemetry_reaches_the_registry(telemetry, tmp_path):
+    from torchmetrics_tpu._resilience import SnapshotManager, SnapshotPolicy
+
+    mc = MetricCollection({"mse": tm.MeanSquaredError()}, compute_groups=False)
+    mgr = SnapshotManager(mc, tmp_path, SnapshotPolicy(every_n_updates=2, async_write=False))
+    mc.update(jnp.ones(4), jnp.zeros(4))  # registers collection-level telemetry
+    mgr.close()
+    clone = mc.clone()
+    # the clone's _telem slot must NOT be a registry-invisible copy
+    assert clone.__dict__.get("_telem") is None
+    mgr2 = SnapshotManager(clone, tmp_path / "clone", SnapshotPolicy(every_n_updates=1, async_write=False))
+    clone.update(jnp.ones(4), jnp.zeros(4))
+    clone.update(jnp.ones(4), jnp.zeros(4))
+    mgr2.close()
+    agg = REGISTRY.aggregate()["MetricCollection"]
+    # both the original's and the clone's counters are visible process-wide
+    assert agg["instances"] == 2
+    assert agg["counters"]["snapshot_writes"] >= 2
+
+
+def test_collection_level_snapshot_telemetry_surfaces(telemetry, tmp_path):
+    from torchmetrics_tpu._resilience import SnapshotManager, SnapshotPolicy
+
+    mc = MetricCollection({"mse": tm.MeanSquaredError()}, compute_groups=False)
+    mgr = SnapshotManager(mc, tmp_path, SnapshotPolicy(every_n_updates=2, async_write=False))
+    for _ in range(4):
+        mc.update(jnp.ones(4), jnp.zeros(4))
+    mgr.close()
+    reports = mc.telemetry_report()
+    # the manager attributes durability counters to the COLLECTION object
+    assert reports["__collection__"].counter("snapshot_writes") >= 1
+    merged = mc.telemetry_report(aggregate=True)
+    assert merged.counter("snapshot_writes") >= 1
+    assert merged.counter("journal_entries") >= 1
+
+
+def test_report_merged_sums_counters():
+    a = TelemetryReport("A", True, {"update_calls|path=eager": 2, "scan_steps": 1}, {}, {"warnings": 1, "suppressed": 0})
+    b = TelemetryReport("B", True, {"update_calls|path=eager": 3}, {}, {"warnings": 0, "suppressed": 2})
+    merged = TelemetryReport.merged([a, b])
+    assert merged.counter("update_calls|path=eager") == 5
+    assert merged.counter("scan_steps") == 1
+    assert merged.churn == {"warnings": 1, "suppressed": 2, "last_diff": None}
+
+
+# ------------------------------------------------- resilience + durability
+def test_guarded_sync_attempt_and_retry_counters(telemetry):
+    from torchmetrics_tpu._resilience.faultinject import (
+        inject_collective_failure,
+        simulated_world,
+    )
+    from torchmetrics_tpu._resilience.policy import RetryPolicy, SyncPolicy
+
+    with simulated_world(2):
+        metric = tm.MeanSquaredError(
+            sync_policy=SyncPolicy(retry=RetryPolicy(max_retries=1, backoff_base=0.0))
+        )
+        metric.update(jnp.ones(4), jnp.zeros(4))
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            with inject_collective_failure(first_n=10):
+                metric.compute()
+    rep = metric.telemetry_report()
+    assert rep.counter("sync_calls|mode=guarded") == 1
+    assert rep.counter("sync_attempts") == 2
+    assert rep.counter("sync_retries") == 1
+    assert rep.counter("degradations|kind=handshake_degraded") == 1
+    assert BUS.events(kind="degradation")
+
+
+def test_snapshot_and_restore_counters(telemetry, tmp_path):
+    from torchmetrics_tpu._resilience import SnapshotManager, SnapshotPolicy
+
+    metric = tm.MeanSquaredError()
+    mgr = SnapshotManager(metric, tmp_path, SnapshotPolicy(every_n_updates=2, async_write=False))
+    for i in range(5):
+        metric.update(jnp.ones(4) * i, jnp.zeros(4))
+    mgr.close()
+    rep = metric.telemetry_report()
+    assert rep.counter("snapshot_writes") >= 2
+    assert rep.counter("snapshot_bytes") > 0
+    assert rep.counter("journal_entries") >= 1
+    assert rep.counter("journal_bytes") > 0
+    assert BUS.events(kind="snapshot_write")
+
+    fresh = tm.MeanSquaredError()
+    mgr2 = SnapshotManager(fresh, tmp_path, SnapshotPolicy(async_write=False))
+    mgr2.restore_latest()
+    mgr2.close()
+    assert fresh.telemetry_report().counter("restores|outcome=ok") == 1
+    restore_events = BUS.events(kind="snapshot_restore")
+    assert restore_events and restore_events[-1].data["outcome"] == "ok"
+    assert bool(np.allclose(np.asarray(fresh.compute()), np.asarray(metric.compute())))
+
+
+# -------------------------------------------------------------- trace-safety
+def test_observability_package_lints_clean():
+    """The ISSUE contract: all instrumentation mutates host state only at
+    eager boundaries — the trace-safety analyzer must find zero hazards in
+    the new package (run as its own scan so a future baseline entry for the
+    package cannot silently mask a regression here)."""
+    from pathlib import Path
+
+    from torchmetrics_tpu._analysis import analyze_paths
+
+    package = Path(__file__).resolve().parents[3] / "torchmetrics_tpu" / "_observability"
+    result = analyze_paths([str(package)])
+    assert not result.parse_errors
+    assert not result.violations, [v.render() for v in result.violations]

@@ -1,0 +1,138 @@
+"""E2E sweep: telemetry path-counters must agree with the compile-eligibility
+manifest (ISSUE-10 acceptance).
+
+The eligibility prover (PR 9) statically certifies which classes auto-compile
+out of the box; the telemetry layer independently observes which path each
+live update actually took. This sweep drives real metrics at ctor defaults
+and asserts the two sources of truth agree: certified metadata-only /
+value-flags classes report ``auto_compiled`` updates, host-bound classes
+report eager-only.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import aggregation
+from torchmetrics_tpu._observability import BUS, REGISTRY, set_telemetry_enabled
+
+ELIGIBILITY = json.loads(
+    (Path(__file__).resolve().parents[3] / "torchmetrics_tpu" / "_analysis" / "eligibility.json").read_text()
+)["classes"]
+
+RNG = np.random.default_rng(4321)
+N = 32
+
+
+@pytest.fixture()
+def telemetry():
+    set_telemetry_enabled(True)
+    yield
+    set_telemetry_enabled(False)
+    REGISTRY.reset()
+    BUS.clear()
+
+
+def _bin():
+    return (jnp.asarray(RNG.random(N).astype(np.float32)), jnp.asarray(RNG.integers(0, 2, N)))
+
+
+def _mc(c=4):
+    p = RNG.random((N, c)).astype(np.float32)
+    return (jnp.asarray(p / p.sum(1, keepdims=True)), jnp.asarray(RNG.integers(0, c, N)))
+
+
+def _reg():
+    return (
+        jnp.asarray(RNG.standard_normal(N).astype(np.float32)),
+        jnp.asarray(RNG.standard_normal(N).astype(np.float32)),
+    )
+
+
+def _agg():
+    return (jnp.asarray(RNG.random(N).astype(np.float32)),)
+
+
+# ctor + input maker, spanning the three manifest verdicts. Compiled cases
+# mirror tests/unittests/analysis/test_compiled_default_path.py (the full
+# 42-class sweep lives there; this one closes the telemetry loop).
+COMPILED_CASES = {
+    "MeanMetric": (lambda: aggregation.MeanMetric(), _agg),
+    "MaxMetric": (lambda: aggregation.MaxMetric(), _agg),
+    "BinaryAccuracy": (lambda: tm.BinaryAccuracy(), _bin),
+    "MulticlassAccuracy": (lambda: tm.MulticlassAccuracy(num_classes=4), _mc),
+    "BinaryStatScores": (lambda: tm.BinaryStatScores(), _bin),
+    "MulticlassConfusionMatrix": (lambda: tm.MulticlassConfusionMatrix(num_classes=4), _mc),
+    "MeanSquaredError": (lambda: tm.MeanSquaredError(), _reg),
+}
+
+HOST_BOUND_CASES = {
+    # always-list states (curve family thresholds=None defaults)
+    "BinaryAUROC": (lambda: tm.BinaryAUROC(), _bin),
+    "BinaryPrecisionRecallCurve": (lambda: tm.BinaryPrecisionRecallCurve(), _bin),
+    "MulticlassAUROC": (lambda: tm.MulticlassAUROC(num_classes=4), _mc),
+}
+
+
+def _verdict(metric) -> str:
+    cls = type(metric)
+    return ELIGIBILITY.get(f"{cls.__module__}.{cls.__qualname__}", {}).get("verdict", "absent")
+
+
+@pytest.mark.parametrize("name", sorted(COMPILED_CASES))
+def test_certified_classes_report_compiled_updates(name, telemetry):
+    ctor, maker = COMPILED_CASES[name]
+    metric = ctor()
+    assert _verdict(metric) in ("metadata_only", "value_flags"), (
+        f"{name} is no longer certified compile-eligible — update this sweep"
+    )
+    batch = maker()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(4):
+            metric.update(*batch)
+    paths = metric.telemetry_report().path_counts
+    assert paths.get("auto_compiled", 0) >= 3, (
+        f"{name} is manifest-certified for the compiled path but telemetry saw {paths}"
+    )
+    assert paths.get("eager", 0) == 1  # the signature warm-up pass
+
+
+@pytest.mark.parametrize("name", sorted(HOST_BOUND_CASES))
+def test_host_bound_classes_report_eager_only(name, telemetry):
+    ctor, maker = HOST_BOUND_CASES[name]
+    metric = ctor()
+    assert _verdict(metric) == "host_bound", (
+        f"{name} is no longer host-bound in the manifest — update this sweep"
+    )
+    batch = maker()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(4):
+            metric.update(*batch)
+    paths = metric.telemetry_report().path_counts
+    assert paths.get("auto_compiled", 0) == 0, (
+        f"{name} is manifest host-bound but telemetry saw compiled updates: {paths}"
+    )
+    assert paths.get("eager", 0) == 4
+
+
+def test_sweep_totals_match_update_counts(telemetry):
+    """Every update is attributed to exactly one path — no double counting."""
+    metric = tm.MulticlassAccuracy(num_classes=4)
+    batch = _mc()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(6):
+            metric.update(*batch)
+        metric.jit_update(*batch)
+    rep = metric.telemetry_report()
+    assert rep.total_updates == 7
+    assert rep.total_updates == metric.update_count

@@ -59,9 +59,26 @@ def _to_jnp(x):
 
 _CASES = [(f"{idx:03d}_{spec.fn}", spec) for idx, spec in enumerate(SPECS)]
 
+# These cases run neural trunks whose pretrained weights cannot be downloaded
+# in this image, so their goldens were frozen under RANDOM initialization —
+# and random init depends on the jax version's PRNG/initializer
+# implementation, not on this package's numerics. They are only meaningful
+# when real converted weights are available (tools/convert_weights.py);
+# otherwise they fail on every jax upgrade without any code change here.
+_RANDOM_WEIGHT_FNS = ("learned_perceptual_image_patch_similarity", "bert_score", "infolm")
+_GOLDEN_WEIGHTS_DIR = os.environ.get("TM_TPU_GOLDEN_WEIGHTS_DIR", "")
+
 
 @pytest.mark.parametrize(("case_id", "spec"), _CASES, ids=[c[0] for c in _CASES])
 def test_golden(case_id, spec):
+    if spec.fn in _RANDOM_WEIGHT_FNS and not _GOLDEN_WEIGHTS_DIR:
+        pytest.skip(
+            f"{spec.fn} golden was frozen under random-initialized trunk weights (pretrained"
+            " weights are unavailable in this image) and random init is jax-version-dependent;"
+            " set TM_TPU_GOLDEN_WEIGHTS_DIR to converted real weights and regenerate the pack"
+            " (tools/make_goldens.py) to re-enable. Numeric parity for these trunks is covered"
+            " by the weight-converting equivalence suites (e.g. test_bert_encoder_equivalence)."
+        )
     meta = _MANIFEST.get(case_id)
     if meta is None:
         pytest.fail(f"{case_id} missing from the golden pack — regenerate tools/make_goldens.py")

@@ -1,0 +1,78 @@
+"""Runtime telemetry & trace attribution (OBSERVABILITY.md).
+
+The runtime has three sophisticated execution regimes — the auto-compiled
+default update, guarded resilient sync, and journaled snapshots — and this
+package makes them *observable* in production:
+
+- **Per-metric counters + latency reservoirs** (:class:`MetricTelemetry`),
+  recorded at the existing seams: which path every update actually took
+  (eager / auto-compiled / ``jit_update`` / ``scan_update``), fingerprint
+  guard outcomes, quarantined batches, deferred violations, compute cache
+  hits, sync attempts/retries/degradations, snapshot writes and restores.
+- **Recompile-churn detection** — every compiled-path cache key is tracked;
+  churn raises a rate-limited :class:`RecompileChurnWarning` naming the
+  differing cache-key component(s) (the runtime twin of analyzer rule R4).
+- **A unified event bus** (:data:`BUS`) carrying degradations, restores,
+  churn, and harness heartbeats as one ordered stream.
+- **Profiler scopes** — ``jax.named_scope`` inside traced update/compute
+  bodies and ``jax.profiler.TraceAnnotation`` around eager/sync work, so
+  device and host profiles attribute time to ``ClassName.method``.
+- **Export surfaces** — ``Metric.telemetry_report()``,
+  ``MetricCollection.telemetry_report()``, and process-wide
+  :meth:`TelemetryRegistry.render_prometheus` / :meth:`TelemetryRegistry.to_json`.
+
+Everything is **off by default**: the disabled hot path is a single
+cached-bool branch (``state.OBS.enabled``) with no dict lookups and no
+allocation. Enable with ``TM_TPU_TELEMETRY=1`` or
+:func:`set_telemetry_enabled`; all recording mutates host state only at
+eager boundaries — never inside traced functions (CI-verified by the
+trace-safety analyzer).
+"""
+
+from torchmetrics_tpu._observability.events import BUS, EventBus, TelemetryEvent
+from torchmetrics_tpu._observability.reservoir import LatencyReservoir
+from torchmetrics_tpu._observability.scopes import (
+    annotation,
+    named_scope,
+    profiling_scopes_active,
+    set_profile_scopes,
+)
+from torchmetrics_tpu._observability.state import (
+    OBS,
+    set_telemetry_enabled,
+    set_telemetry_sampling,
+    telemetry_enabled,
+)
+from torchmetrics_tpu._observability.telemetry import (
+    REGISTRY,
+    MetricTelemetry,
+    RecompileChurnWarning,
+    TelemetryRegistry,
+    TelemetryReport,
+    get_registry,
+    report_for,
+    telemetry_for,
+)
+
+__all__ = [
+    "BUS",
+    "EventBus",
+    "LatencyReservoir",
+    "MetricTelemetry",
+    "OBS",
+    "REGISTRY",
+    "RecompileChurnWarning",
+    "TelemetryEvent",
+    "TelemetryRegistry",
+    "TelemetryReport",
+    "annotation",
+    "get_registry",
+    "named_scope",
+    "profiling_scopes_active",
+    "report_for",
+    "set_profile_scopes",
+    "set_telemetry_enabled",
+    "set_telemetry_sampling",
+    "telemetry_enabled",
+    "telemetry_for",
+]

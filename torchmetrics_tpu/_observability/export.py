@@ -1,0 +1,153 @@
+"""Export surfaces: Prometheus text exposition + JSON.
+
+:func:`render_prometheus` emits the classic text exposition format
+(``text/plain; version=0.0.4``): one ``# HELP``/``# TYPE`` header per metric
+family, all samples of a family contiguous, label values escaped per the
+spec (backslash, double-quote, newline). The output is validated against
+``prometheus_client.parser`` in the test suite.
+
+Counter keys arrive in the registry's flat ``"family|label=value"``
+convention and are re-expanded into label sets here; every sample
+additionally carries a ``metric="<ClassName>"`` label identifying the
+aggregated metric class.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Tuple
+
+from torchmetrics_tpu._observability.telemetry import _split_key
+
+__all__ = ["render_prometheus", "to_json", "EXPORT_VERSION"]
+
+EXPORT_VERSION = 1
+
+_PREFIX = "tmtpu"
+
+# family -> help text; families not listed get a generic line. Counter
+# families (monotonic) are exported with the `_total` suffix per convention.
+_HELP: Dict[str, str] = {
+    "update_calls": "Metric update/forward executions by path taken.",
+    "scan_steps": "Individual batches consumed through scan_update streams.",
+    "fingerprint": "Host-attribute fingerprint guard outcomes on eager updates.",
+    "quarantined_batches": "Batches dropped by the nan_policy='quarantine' sentinel.",
+    "deferred_violations": "Compiled validate_args violations surfaced at host sync points.",
+    "compute_calls": "compute() invocations by cache outcome.",
+    "compiles": "Compiled-executable cache keys built, by compile kind.",
+    "recompiles": "Additional cache keys beyond the first per compile kind (churn).",
+    "churn_warnings": "Recompile-churn warnings emitted.",
+    "churn_suppressed": "Recompile-churn warnings suppressed by rate limiting.",
+    "trace_seconds": "Wall-clock seconds spent in first-call trace+lower+execute of compiled paths.",
+    "sync_calls": "Distributed state synchronizations started, by guard mode.",
+    "sync_attempts": "Guarded-sync collective attempts (includes retries).",
+    "sync_retries": "Guarded-sync attempts beyond the first.",
+    "degradations": "Recorded degradation events by kind (also on the event bus).",
+    "snapshot_writes": "Snapshot generations written by the durability layer.",
+    "snapshot_bytes": "Serialized snapshot payload bytes written.",
+    "journal_entries": "Update-journal frames appended.",
+    "journal_bytes": "Update-journal bytes appended.",
+    "restores": "Snapshot restore outcomes.",
+    "restore_replayed_updates": "Journaled updates replayed during restores.",
+    "events": "Event-bus publishes by kind (lifetime, monotonic).",
+    "uncompiled_signatures": "Distinct signatures streamed eagerly past the saturated auto cache.",
+    "events_dropped": "Event-bus entries evicted by the capacity bound.",
+    "latency_samples": "Lifetime latency samples recorded per op reservoir (monotonic).",
+    "latency_seconds": "Latency reservoir summary statistics per op (retained window).",
+    "telemetry_enabled": "1 while the telemetry layer is collecting.",
+}
+
+def _escape_label(value: str) -> str:
+    return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _fmt_value(value: float) -> str:
+    if isinstance(value, bool):
+        return "1" if value else "0"
+    if isinstance(value, int) or (isinstance(value, float) and value.is_integer()):
+        return str(int(value))
+    return repr(float(value))
+
+
+def _sample(name: str, labels: Dict[str, str], value: float) -> str:
+    if labels:
+        inner = ",".join(f'{k}="{_escape_label(str(v))}"' for k, v in sorted(labels.items()))
+        return f"{name}{{{inner}}} {_fmt_value(value)}"
+    return f"{name} {_fmt_value(value)}"
+
+
+def render_prometheus(aggregate: Dict[str, Dict[str, Any]], bus: Any, enabled: bool) -> str:
+    """Text exposition of the registry aggregate + event-bus counts."""
+    # family -> (type, help, [sample lines]) — assembled first so each
+    # family renders contiguously regardless of per-class interleaving
+    families: Dict[str, Tuple[str, str, List[str]]] = {}
+
+    def emit(family: str, labels: Dict[str, str], value: float, kind: str = "counter") -> None:
+        name = f"{_PREFIX}_{family}"
+        if kind == "counter":
+            name += "_total"
+        entry = families.get(name)
+        if entry is None:
+            help_text = _HELP.get(family, f"torchmetrics_tpu runtime telemetry: {family}.")
+            entry = families[name] = (kind, help_text, [])
+        entry[2].append(_sample(name, labels, value))
+
+    emit("telemetry_enabled", {}, 1 if enabled else 0, kind="gauge")
+    for cls_name in sorted(aggregate):
+        entry = aggregate[cls_name]
+        base = {"metric": cls_name}
+        for key in sorted(entry["counters"]):
+            family, labels = _split_key(key)
+            emit(family, {**base, **labels}, entry["counters"][key])
+        for op in sorted(entry["latency"]):
+            stats = entry["latency"][op]
+            for stat, val in sorted(stats.items()):
+                if stat == "count":
+                    # lifetime sample counts ride the regular counter path
+                    # (`latency_samples|op=...`) — the retained-window count
+                    # here would shrink on GC, breaking counter monotonicity
+                    continue
+                emit("latency_seconds", {**base, "op": op, "stat": stat}, val, kind="gauge")
+    for kind_name, count in sorted(bus.kind_totals().items()):
+        emit("events", {"kind": kind_name}, count)
+    emit("events_dropped", {}, bus.dropped)
+
+    lines: List[str] = []
+    for name in sorted(families):
+        kind, help_text, samples = families[name]
+        lines.append(f"# HELP {name} {help_text}")
+        lines.append(f"# TYPE {name} {kind}")
+        lines.extend(samples)
+    return "\n".join(lines) + "\n"
+
+
+def to_json(aggregate: Dict[str, Dict[str, Any]], bus: Any, enabled: bool) -> Dict[str, Any]:
+    """JSON-serializable snapshot (validated round-trippable in tests)."""
+    payload = {
+        "version": EXPORT_VERSION,
+        "enabled": bool(enabled),
+        "metrics": {
+            name: {
+                "counters": {k: v for k, v in sorted(entry["counters"].items())},
+                "latency": entry["latency"],
+                "instances": entry["instances"],
+                "retired_instances": entry["retired_instances"],
+            }
+            for name, entry in sorted(aggregate.items())
+        },
+        "events": [
+            {
+                "seq": e.seq,
+                "ts": e.ts,
+                "kind": e.kind,
+                "source": e.source,
+                "detail": e.detail,
+                "data": e.data,
+            }
+            for e in bus.events()
+        ],
+        "events_dropped": bus.dropped,
+    }
+    # guarantee serializability at the source rather than at the caller
+    json.dumps(payload)
+    return payload

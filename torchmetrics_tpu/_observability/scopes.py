@@ -1,0 +1,61 @@
+"""Trace-attribution scopes for XLA/host profilers.
+
+Two complementary mechanisms:
+
+- :func:`named_scope` — ``jax.named_scope``: attaches a name to every HLO op
+  emitted while the scope is open, so a *device* profile (XLA trace) groups
+  time under ``ClassName.update`` / ``ClassName.compute`` instead of a soup
+  of anonymous fusions. Zero runtime cost after compilation (the names live
+  in compile-time metadata), so the traced update/compute bodies open it
+  unconditionally.
+- :func:`annotation` — ``jax.profiler.TraceAnnotation``: a *host* profiler
+  range (visible in ``jax.profiler.trace`` / TensorBoard) around eager
+  update bodies, compiled dispatches, and sync. It costs a context entry
+  per call, so instrumented sites open it only while telemetry is enabled
+  (``state.OBS.profile_scopes`` additionally gates it for
+  counters-without-profiling deployments).
+
+Both degrade to ``nullcontext`` on jax versions lacking the API.
+"""
+
+from __future__ import annotations
+
+from contextlib import nullcontext
+from typing import Any
+
+from torchmetrics_tpu._observability.state import OBS
+
+__all__ = ["named_scope", "annotation", "profiling_scopes_active"]
+
+try:  # pragma: no cover - version portability
+    from jax import named_scope as _named_scope
+except ImportError:  # pragma: no cover
+    _named_scope = None
+
+try:  # pragma: no cover - version portability
+    from jax.profiler import TraceAnnotation as _TraceAnnotation
+except ImportError:  # pragma: no cover
+    _TraceAnnotation = None
+
+
+def named_scope(name: str) -> Any:
+    """HLO name scope (device-profile attribution); nullcontext fallback."""
+    if _named_scope is None:
+        return nullcontext()
+    return _named_scope(name)
+
+
+def annotation(name: str) -> Any:
+    """Host profiler range; callers gate on :func:`profiling_scopes_active`."""
+    if _TraceAnnotation is None:
+        return nullcontext()
+    return _TraceAnnotation(name)
+
+
+def profiling_scopes_active() -> bool:
+    return OBS.enabled and OBS.profile_scopes
+
+
+def set_profile_scopes(flag: bool) -> None:
+    """Enable/disable host profiler annotations independently of counters."""
+    OBS.profile_scopes = bool(flag)

@@ -33,6 +33,8 @@ from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu._observability.state import OBS as _OBS
+from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
 from torchmetrics_tpu._resilience.errors import (
     CollectiveTimeoutError,
     StateStructureMismatchError,
@@ -152,7 +154,12 @@ def _run_with_timeout(fn: Callable[[], Any], timeout: Optional[float]) -> Any:
 _NON_RETRYABLE = (TypeError, AttributeError, NameError, KeyError, IndexError, ValueError)
 
 
-def run_guarded(fn: Callable[[], Any], retry: RetryPolicy, describe: str = "collective") -> Any:
+def run_guarded(
+    fn: Callable[[], Any],
+    retry: RetryPolicy,
+    describe: str = "collective",
+    on_attempt: Optional[Callable[[int], None]] = None,
+) -> Any:
     """Run ``fn`` under the retry policy; raise :class:`SyncRetriesExhausted` at the end.
 
     ``StateStructureMismatchError`` and the ``_NON_RETRYABLE`` programming
@@ -169,6 +176,8 @@ def run_guarded(fn: Callable[[], Any], retry: RetryPolicy, describe: str = "coll
     """
     last_err: Optional[BaseException] = None
     for attempt in range(retry.attempts):
+        if on_attempt is not None:
+            on_attempt(attempt)
         try:
             return _run_with_timeout(fn, retry.timeout)
         except StateStructureMismatchError:
@@ -187,6 +196,24 @@ def run_guarded(fn: Callable[[], Any], retry: RetryPolicy, describe: str = "coll
         attempts=retry.attempts,
         last_error=last_err,
     )
+
+
+def _attempt_recorder(metric: Any) -> Optional[Callable[[int], None]]:
+    """Telemetry hook counting collective attempts/retries for a metric.
+
+    Returns None while telemetry is disabled so :func:`run_guarded`'s loop
+    pays nothing (one is-None check per attempt, and attempts are rare).
+    """
+    if not _OBS.enabled:
+        return None
+    telem = _telemetry_for(metric)
+
+    def record(attempt: int) -> None:
+        telem.inc("sync_attempts")
+        if attempt:
+            telem.inc("sync_retries")
+
+    return record
 
 
 # ---------------------------------------------------------------------------
@@ -248,6 +275,7 @@ def _handshake(metric: Any, policy: SyncPolicy) -> bool:
             lambda: process_allgather(local_words),
             policy.retry,
             describe=f"{type(metric).__name__} pre-sync structure handshake",
+            on_attempt=_attempt_recorder(metric),
         )
     except SyncRetriesExhausted as err:
         if policy.on_exhausted == "raise":
@@ -313,7 +341,11 @@ def guarded_metric_sync(metric: Any, dist_sync_fn: Callable, process_group: Any,
         attempt = lambda: metric._dist_gather(dist_sync_fn, process_group)  # noqa: E731
         commit = metric._commit_gathered
     try:
-        gathered = run_guarded(attempt, retry, describe=f"{type(metric).__name__} state gather")
+        gathered = run_guarded(
+            attempt, retry,
+            describe=f"{type(metric).__name__} state gather",
+            on_attempt=_attempt_recorder(metric),
+        )
     except SyncRetriesExhausted as err:
         if policy.on_exhausted == "raise":
             raise

@@ -53,6 +53,9 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import jax
 import numpy as np
 
+from torchmetrics_tpu._observability.events import BUS as _BUS
+from torchmetrics_tpu._observability.state import OBS as _OBS
+from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
 from torchmetrics_tpu._resilience.errors import SnapshotRestoreError
 from torchmetrics_tpu._resilience.policy import SnapshotPolicy
 from torchmetrics_tpu.utilities.prints import rank_zero_warn
@@ -376,6 +379,10 @@ class SnapshotManager:
             self._journal_len += 1
             self._updates_since += 1
             self.journaled_updates += 1
+            if _OBS.enabled:
+                telem = _telemetry_for(self.target)
+                telem.inc("journal_entries")
+                telem.inc("journal_bytes", _FRAME_HEAD.size + len(blob))
             if self._snapshot_due():
                 self.snapshot_now()
         except Exception as err:  # noqa: BLE001 - durability must never break the stream
@@ -432,6 +439,15 @@ class SnapshotManager:
         else:
             job()
         self.snapshots_taken += 1
+        if _OBS.enabled:
+            telem = _telemetry_for(self.target)
+            telem.inc("snapshot_writes")
+            telem.inc("snapshot_bytes", len(_MAGIC) + len(digest) + len(blob))
+            _BUS.publish(
+                "snapshot_write", type(self.target).__name__,
+                f"generation {gen} ({len(blob)} payload bytes)",
+                data={"generation": gen, "bytes": len(blob)},
+            )
         return gen
 
     def _capture_state(self) -> Dict[str, Any]:
@@ -505,6 +521,13 @@ class SnapshotManager:
                     pass
                 finally:
                     self._replaying = False
+            if _OBS.enabled:
+                _telemetry_for(self.target).inc("restores|outcome=failed")
+                _BUS.publish(
+                    "snapshot_restore", type(self.target).__name__,
+                    f"restore failed: {len(skipped)} generation(s) rejected",
+                    data={"outcome": "failed", "skipped": {str(k): v for k, v in skipped.items()}},
+                )
             raise SnapshotRestoreError(
                 f"no restorable snapshot generation in {self.directory}"
                 + (f" — {len(skipped)} generation(s) failed verification: {skipped}" if skipped else ""),
@@ -515,6 +538,18 @@ class SnapshotManager:
         report = RestoreReport(
             generation=loaded, replayed=replayed, skipped=dict(skipped), truncated_journal=truncated
         )
+        if _OBS.enabled:
+            telem = _telemetry_for(self.target)
+            telem.inc(f"restores|outcome={'fallback' if report.fell_back else 'ok'}")
+            if replayed:
+                telem.inc("restore_replayed_updates", replayed)
+            _BUS.publish(
+                "snapshot_restore", type(self.target).__name__,
+                f"restored generation {loaded}, replayed {replayed} journaled update(s)"
+                + (" (fell back past corruption)" if report.fell_back else ""),
+                data={"outcome": "fallback" if report.fell_back else "ok",
+                      "generation": loaded, "replayed": replayed},
+            )
         if report.fell_back:
             self._record_degradation(
                 "snapshot_restore",

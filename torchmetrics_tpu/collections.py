@@ -364,6 +364,33 @@ class MetricCollection:
         """Per-member resilience reports, keyed like :meth:`compute` results."""
         return {self._set_name(name): m.resilience_report() for name, m in self._modules.items()}
 
+    # ------------------------------------------------------------- telemetry
+    def telemetry_report(self, aggregate: bool = False) -> Any:
+        """Runtime telemetry for the collection (OBSERVABILITY.md).
+
+        With ``aggregate=False`` (default) returns per-member
+        :class:`~torchmetrics_tpu._observability.telemetry.TelemetryReport`
+        objects keyed like :meth:`compute` results. With ``aggregate=True``
+        returns ONE merged report whose counters sum every member — the
+        shape a scrape/log line wants for "how is this eval suite behaving".
+        Note that with compute groups active only group heads execute
+        ``update``, so member path-counters reflect the runtime's actual
+        dispatch, not the logical metric count.
+        """
+        reports = {self._set_name(name): m.telemetry_report() for name, m in self._modules.items()}
+        if self.__dict__.get("_telem") is not None:
+            # a collection-level SnapshotManager attributes its snapshot/
+            # journal/restore counters to the COLLECTION object — surface
+            # them instead of silently dropping collection-level telemetry
+            from torchmetrics_tpu._observability.telemetry import report_for
+
+            reports["__collection__"] = report_for(self)
+        if not aggregate:
+            return reports
+        from torchmetrics_tpu._observability.telemetry import TelemetryReport
+
+        return TelemetryReport.merged(list(reports.values()), name="MetricCollection")
+
     def set_dtype(self, dst_type: Any) -> "MetricCollection":
         for m in self._modules.values():
             m.set_dtype(dst_type)

@@ -35,8 +35,8 @@ def universal_image_quality_index(
         >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu.functional.image import universal_image_quality_index
         >>> preds = jax.random.uniform(jax.random.PRNGKey(0), (2, 3, 32, 32))
-        >>> universal_image_quality_index(preds, preds)
-        Array(0.9999982, dtype=float32)
+        >>> round(float(universal_image_quality_index(preds, preds)), 4)
+        1.0
     """
     preds, target = _check_image_pair(preds, target)
     kh = _gaussian_kernel_1d(kernel_size[0], sigma[0])

@@ -65,11 +65,12 @@ def perplexity(preds: Array, target: Array, ignore_index: Optional[int] = None) 
     """Perplexity of a language-model prediction.
 
     Example:
-        >>> import jax, jax.numpy as jnp
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(22), (2, 8, 5))
-        >>> target = jax.random.randint(jax.random.PRNGKey(89), (2, 8), 0, 5)
+        >>> import jax.numpy as jnp
+        >>> probs = jnp.array([0.1, 0.2, 0.3, 0.25, 0.15])
+        >>> preds = jnp.log(jnp.tile(probs, (2, 8, 1)))  # log-probabilities
+        >>> target = jnp.tile(jnp.array([0, 1, 2, 3, 4, 0, 1, 2]), (2, 1))
         >>> round(float(perplexity(preds, target, ignore_index=-100)), 3)
-        4.999
+        5.416
     """
     total, count = _perplexity_update(preds, target, ignore_index)
     return _perplexity_compute(total, count)

@@ -24,6 +24,7 @@ from __future__ import annotations
 import functools
 import inspect
 import itertools
+import time
 from abc import ABC, abstractmethod
 from copy import deepcopy
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple, Union
@@ -36,6 +37,16 @@ import numpy as np
 # the eager per-update hot path, where a function-level import costs a dict
 # lookup + lock round-trip per call; manifest.py imports nothing heavy
 from torchmetrics_tpu._analysis.manifest import compiled_validation_eligible, fingerprint_skip_allowed
+
+# telemetry hot switch + light helpers (OBSERVABILITY.md). `_OBS.enabled` is
+# the ONE check instrumented hot paths pay while telemetry is off: a slot
+# attribute load + branch, no dict lookups, no allocation. Everything heavier
+# lives behind it. state/events/telemetry import no jax/numpy at module
+# scope; scopes pulls only jax symbol lookups (jax is already imported here).
+from torchmetrics_tpu._observability import scopes as _obs_scopes
+from torchmetrics_tpu._observability.events import BUS as _BUS
+from torchmetrics_tpu._observability.state import OBS as _OBS
+from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
 from torchmetrics_tpu.utilities.data import (
     dim_zero_cat,
     dim_zero_max,
@@ -497,7 +508,11 @@ class Metric(ABC):
             # the static analyzer hasn't already proven the whole class chain
             # free of unregistered-attribute mutation (R1 certification —
             # see torchmetrics_tpu/_analysis and ANALYSIS.md)
-            guard = self._auto_eligible() and not self._fingerprint_exempt()
+            eligible = self._auto_eligible()
+            guard = eligible and not self._fingerprint_exempt()
+            if _OBS.enabled:
+                _t = _telemetry_for(self)
+                _t.inc("fingerprint|outcome=check" if guard else "fingerprint|outcome=skip" if eligible else "fingerprint|outcome=ineligible")
             if guard:
                 # the keep-alive list pins every fingerprinted object for the
                 # duration of the update, so a freed-and-reallocated object
@@ -522,13 +537,18 @@ class Metric(ABC):
                 if self.nan_policy == "quarantine":
                     pre_state = self._quarantine_snapshot()
                     self.__dict__["_nan_last_quarantined"] = False
-            update(*args, **kwargs)
+            if _OBS.enabled:
+                self._obs_call("update_calls|path=eager", "update_eager", "update", lambda: update(*args, **kwargs))
+            else:
+                update(*args, **kwargs)
             if guard and self._host_attr_snapshot()[0] != before:
                 # update() mutates plain (unregistered) python attributes; a
                 # traced replay would silently freeze those side effects, so
                 # the compiled paths are permanently off for this instance
                 self._auto_disabled = True
                 self._auto_forward_disabled = True
+                if _OBS.enabled:
+                    self._obs_auto_disabled("update mutated unregistered host attributes")
             if self.nan_policy is not None:
                 self._guard_nonfinite_states(pre_state, pre_lens)
             if self._dtype_policy is not None:
@@ -554,6 +574,75 @@ class Metric(ABC):
         hook = self.__dict__.get("_snapshot_hook")
         if hook is not None and "_journal_suspend" not in self.__dict__:
             hook.record(self, method, args, kwargs)
+
+    # ------------------------------------------------------------- telemetry
+    # Helpers below only ever run with telemetry ENABLED (callers guard on
+    # `_OBS.enabled`); they may allocate, probe dicts, and read the clock.
+    # All mutation is host-side at eager boundaries — never under trace.
+
+    def _obs_call(self, counter_key: Optional[str], op: str, method: str, fn: Callable) -> Any:
+        """Run ``fn`` counted, latency-sampled, and profiler-annotated."""
+        telem = _telemetry_for(self)
+        if counter_key:
+            telem.inc(counter_key)
+        sample = telem.sample_due(op)
+        t0 = time.perf_counter() if sample else 0.0
+        if _OBS.profile_scopes:
+            with _obs_scopes.annotation(f"{type(self).__name__}.{method}"):
+                out = fn()
+        else:
+            out = fn()
+        if sample:
+            telem.observe(op, time.perf_counter() - t0)
+        return out
+
+    def _obs_compile_event(
+        self, kind: str, treedef: Any, statics: Any, shapes_dtypes: Any, built: bool = True
+    ) -> None:
+        """Report one compiled-path cache key for recompile-churn tracking.
+
+        Deduplicated on the HASHABLE signature before any string building, so
+        steady-state repeat-signature callers (``jit_update``/``scan_update``
+        report per call) pay one set probe, not four ``repr()``s.
+        """
+        seen = self.__dict__.setdefault("_obs_seen_sigs", set())
+        sig_key = (kind, treedef, statics, shapes_dtypes, self._dtype_policy is not None and str(self._dtype_policy))
+        if sig_key in seen:
+            return
+        if len(seen) < 512:  # churn streams must not grow host memory unboundedly
+            seen.add(sig_key)
+        policy = "none" if self._dtype_policy is None else str(jnp.dtype(self._dtype_policy).name)
+        _telemetry_for(self).compile_event(
+            kind,
+            {
+                "arg_structure": str(treedef),
+                "static_args": repr(statics),
+                "shapes": repr(tuple(s for s, _ in shapes_dtypes)),
+                "dtypes": repr(tuple(d for _, d in shapes_dtypes)),
+                "dtype_policy": policy,
+            },
+            built=built,
+        )
+
+    def _obs_auto_disabled(self, reason: str) -> None:
+        """Record why the transparent compiled path switched off (event bus)."""
+        _telemetry_for(self).inc("auto_path_disabled")
+        _BUS.publish("auto_path_disabled", type(self).__name__, reason)
+
+    def telemetry_report(self) -> Any:
+        """Runtime telemetry snapshot for this metric (OBSERVABILITY.md).
+
+        Returns a :class:`~torchmetrics_tpu._observability.telemetry.TelemetryReport`
+        with per-path update counters, fingerprint/quarantine/deferred-violation
+        counts, compile + recompile-churn statistics, sync attempts, and
+        sampled latency reservoirs. With telemetry disabled (the default) the
+        report is empty with ``enabled=False`` — enable collection with
+        ``TM_TPU_TELEMETRY=1`` or
+        :func:`torchmetrics_tpu._observability.set_telemetry_enabled`.
+        """
+        from torchmetrics_tpu._observability.telemetry import report_for
+
+        return report_for(self)
 
     def _fingerprint_exempt(self) -> bool:
         """True when the R1-certified manifest covers this instance's class.
@@ -687,13 +776,23 @@ class Metric(ABC):
                     UserWarning,
                 )
             if self._computed is not None:
+                if _OBS.enabled:
+                    _telemetry_for(self).inc("compute_calls|outcome=cache_hit")
                 return self._computed
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
                 should_unsync=self._should_unsync,
             ):
-                value = _squeeze_if_scalar(compute(*args, **kwargs))
+                if _OBS.enabled:
+                    value = _squeeze_if_scalar(
+                        self._obs_call(
+                            "compute_calls|outcome=computed", "compute", "compute",
+                            lambda: compute(*args, **kwargs),
+                        )
+                    )
+                else:
+                    value = _squeeze_if_scalar(compute(*args, **kwargs))
             if self.compute_with_cache:
                 self._computed = value
             return value
@@ -747,13 +846,25 @@ class Metric(ABC):
             policy = default_sync_policy()
         self._cache = self._copy_state_dict()
         if policy is None:
-            self._sync_dist(dist_sync_fn, process_group=group)
+            if _OBS.enabled:
+                self._obs_call(
+                    "sync_calls|mode=unguarded", "sync", "sync",
+                    lambda: self._sync_dist(dist_sync_fn, process_group=group),
+                )
+            else:
+                self._sync_dist(dist_sync_fn, process_group=group)
             self._is_synced = True
             return
         from torchmetrics_tpu._resilience.guard import guarded_metric_sync  # cached after first sync
 
         try:
-            synced = guarded_metric_sync(self, dist_sync_fn, group, policy)
+            if _OBS.enabled:
+                synced = self._obs_call(
+                    "sync_calls|mode=guarded", "sync", "sync",
+                    lambda: guarded_metric_sync(self, dist_sync_fn, group, policy),
+                )
+            else:
+                synced = guarded_metric_sync(self, dist_sync_fn, group, policy)
         except Exception:
             # on_exhausted="raise" or a handshake mismatch: leave the metric
             # with its intact local state, never half-committed
@@ -944,6 +1055,14 @@ class Metric(ABC):
         from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserWarning
 
         event = DegradationEvent(kind=kind, metric=type(self).__name__, detail=detail, attempts=attempts)
+        if _OBS.enabled:
+            # fold resilience degradations into the unified telemetry stream:
+            # one bus for degradations, restores, churn, and heartbeats
+            _telemetry_for(self).inc(f"degradations|kind={kind}")
+            _BUS.publish(
+                "degradation", type(self).__name__, f"{kind}: {detail}",
+                data={"kind": kind, "attempts": attempts},
+            )
         events = self.__dict__.setdefault("_resilience_events", [])
         events.append(event)
         if len(events) > MAX_EVENTS:
@@ -1054,6 +1173,8 @@ class Metric(ABC):
         # is not merged into the stashed global state either
         self.__dict__["_nan_last_quarantined"] = True
         self.__dict__["_quarantined_updates"] = self.__dict__.get("_quarantined_updates", 0) + 1
+        if _OBS.enabled:
+            _telemetry_for(self).inc("quarantined_batches")
         self._record_degradation(
             "nan_quarantine",
             detail=f"guarded batch {batch} produced non-finite state(s) {desc}; batch dropped",
@@ -1134,7 +1255,11 @@ class Metric(ABC):
         try:
             for n in names:
                 object.__setattr__(self, n, states[n])
-            self.update.__wrapped__(*args, **kwargs)
+            # named_scope runs at TRACE time only (compiled replays carry the
+            # name in HLO metadata for free), so device profiles attribute
+            # this body's ops to `ClassName.update`
+            with _obs_scopes.named_scope(f"{type(self).__name__}.update"):
+                self.update.__wrapped__(*args, **kwargs)
             new_states = {n: getattr(self, n) for n in names}
             if self._dtype_policy is not None:
                 # mirror _wrap_update's post-update cast so compiled carries
@@ -1191,8 +1316,31 @@ class Metric(ABC):
         policy = None if self._dtype_policy is None else jnp.dtype(self._dtype_policy).name
         key = (key, policy)
         if key not in cache:
-            cache[key] = jax.jit(build())
+            fn = jax.jit(build())
+            if _OBS.enabled:
+                # trace+lowering happen lazily on the first invocation: shim
+                # that one call to time it, then self-replace with the raw
+                # executable so steady-state dispatch pays nothing
+                fn = self._obs_timed_first_call(cache, key, fn)
+            cache[key] = fn
         return cache[key]
+
+    def _obs_timed_first_call(self, cache: Dict, key: Any, fn: Callable) -> Callable:
+        """Wrap a fresh jitted callable to record its first-call (trace +
+        lower + execute) wall time, attributed to this metric's telemetry."""
+
+        def timed(*args: Any, **kwargs: Any) -> Any:
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            elapsed = time.perf_counter() - t0
+            cache[key] = fn
+            if _OBS.enabled:
+                telem = _telemetry_for(self)
+                telem.inc("trace_seconds", elapsed)
+                telem.observe("trace", elapsed)
+            return out
+
+        return timed
 
     # ---------------------------------------------------- transparent auto-jit
     _AUTO_MAX_SIGNATURES = 8
@@ -1324,6 +1472,12 @@ class Metric(ABC):
             errors = [m for m, s, v in zip(self._viol_msgs, sevs, vals) if v and s == "error"]
             warns = [m for m, s, v in zip(self._viol_msgs, sevs, vals) if v and s == "warn"]
             object.__setattr__(self, "_viol_flags", jnp.zeros_like(flags))
+            if _OBS.enabled:
+                telem = _telemetry_for(self)
+                if errors:
+                    telem.inc("deferred_violations|severity=error", len(errors))
+                if warns:
+                    telem.inc("deferred_violations|severity=warn", len(warns))
             for msg in warns:
                 rank_zero_warn(
                     f"{msg} (surfaced asynchronously: this warn-severity check ran fused inside"
@@ -1374,8 +1528,10 @@ class Metric(ABC):
             return False
         try:
             sig, treedef, dynamic, statics = self._auto_signature(args, kwargs)
-        except (TorchMetricsUserError, TypeError):
+        except (TorchMetricsUserError, TypeError) as err:
             self._auto_disabled = True
+            if _OBS.enabled:
+                self._obs_auto_disabled(f"unhashable/unsupported update arguments: {err}")
             return False
         if not dynamic:
             # pure-static call (e.g. `update(1.0)` streams of python scalars):
@@ -1384,13 +1540,26 @@ class Metric(ABC):
         seen = self._auto_sigs
         if sig not in seen:
             if len(seen) >= self._AUTO_MAX_SIGNATURES:
+                if _OBS.enabled:
+                    # the signature cache is saturated and shapes keep
+                    # churning: every further new shape streams eagerly —
+                    # exactly the pathology the churn counters exist to name
+                    # (built=False: no executable is ever built for these)
+                    _telemetry_for(self).inc("signature_overflow")
+                    self._obs_compile_event("auto_update", treedef, statics, sig[2], built=False)
                 return False  # shape churn: keep known sigs compiled, new ones eager
             seen[sig] = 0
+            if _OBS.enabled:
+                # a new signature means a new compiled executable (traced on
+                # the first replay): report the cache key for churn tracking
+                self._obs_compile_event("auto_update", treedef, statics, sig[2])
             return False  # first occurrence runs eagerly (validation + warm-up)
         try:
             names = self._auto_state_names("update")
-        except TorchMetricsUserError:
+        except TorchMetricsUserError as err:
             self._auto_disabled = True
+            if _OBS.enabled:
+                self._obs_auto_disabled(f"states unsupported by the compiled path: {err}")
             return False
         if names is None:
             return False
@@ -1428,6 +1597,12 @@ class Metric(ABC):
 
             return _pure
 
+        obs_sample = False
+        t0 = 0.0
+        if _OBS.enabled:
+            obs_sample = _telemetry_for(self).sample_due("update_compiled")
+            if obs_sample:
+                t0 = time.perf_counter()
         try:
             # the fused-flag marker lets traced bodies that need a raise-or-
             # drop escape hatch (aggregator NaN "error") know their violation
@@ -1436,12 +1611,23 @@ class Metric(ABC):
                 self.__dict__["_fused_flags_tracing"] = True
             try:
                 fn = self._compiled_update("_auto_update_fn", (treedef, statics, validate), build)
-                new_states, new_viol = fn(states, self._viol_flags if validate else None, dynamic)
+                if _OBS.enabled and _OBS.profile_scopes:
+                    with _obs_scopes.annotation(f"{type(self).__name__}.update[compiled]"):
+                        new_states, new_viol = fn(states, self._viol_flags if validate else None, dynamic)
+                else:
+                    new_states, new_viol = fn(states, self._viol_flags if validate else None, dynamic)
             finally:
                 self.__dict__.pop("_fused_flags_tracing", None)
-        except Exception:
+        except Exception as err:
             self._auto_disabled = True
+            if _OBS.enabled:
+                self._obs_auto_disabled(f"compiled update failed: {type(err).__name__}: {err}")
             return False
+        if _OBS.enabled:
+            telem = _telemetry_for(self)
+            telem.inc("update_calls|path=auto_compiled")
+            if obs_sample:
+                telem.observe("update_compiled", time.perf_counter() - t0)
         if validate:
             object.__setattr__(self, "_viol_flags", new_viol)
         seen[sig] += 1
@@ -1456,7 +1642,8 @@ class Metric(ABC):
         try:
             for n in names:
                 object.__setattr__(self, n, states[n])
-            return self.compute.__wrapped__()
+            with _obs_scopes.named_scope(f"{type(self).__name__}.compute"):
+                return self.compute.__wrapped__()
         finally:
             for n, v in saved.items():
                 object.__setattr__(self, n, v)
@@ -1481,24 +1668,35 @@ class Metric(ABC):
             return False, None
         try:
             sig, treedef, dynamic, statics = self._auto_signature(args, kwargs)
-        except (TorchMetricsUserError, TypeError):
+        except (TorchMetricsUserError, TypeError) as err:
             self._auto_forward_disabled = True
+            if _OBS.enabled:
+                self._obs_auto_disabled(f"unhashable/unsupported forward arguments: {err}")
             return False, None
         if not dynamic:
             return False, None
         seen = self._auto_fwd_sigs
         if sig not in seen:
             if len(seen) >= self._AUTO_MAX_SIGNATURES:
+                if _OBS.enabled:
+                    _telemetry_for(self).inc("signature_overflow")
+                    self._obs_compile_event("auto_forward", treedef, statics, sig[2], built=False)
                 return False, None
             seen[sig] = 0
+            if _OBS.enabled:
+                self._obs_compile_event("auto_forward", treedef, statics, sig[2])
             return False, None
         try:
             names = self._auto_state_names("forward")
-        except TorchMetricsUserError:
+        except TorchMetricsUserError as err:
             self._auto_forward_disabled = True
+            if _OBS.enabled:
+                self._obs_auto_disabled(f"states unsupported by the compiled forward: {err}")
             return False, None
         if names is None or not self._auto_forward_mergeable(names):
             self._auto_forward_disabled = True
+            if names is not None and _OBS.enabled:
+                self._obs_auto_disabled("state reductions do not merge functionally under trace")
             return False, None
         states = {n: getattr(self, n) for n in names}
         reductions = {n: self._reductions[n] for n in names}
@@ -1573,19 +1771,38 @@ class Metric(ABC):
         cnt = self.__dict__.get("_auto_cnt")
         if cnt is None or cnt[0] != self._update_count:
             cnt = (self._update_count, jnp.int32(self._update_count))
+        obs_sample = False
+        t0 = 0.0
+        if _OBS.enabled:
+            obs_sample = _telemetry_for(self).sample_due("forward_compiled")
+            if obs_sample:
+                t0 = time.perf_counter()
         try:
             if validate:
                 self.__dict__["_fused_flags_tracing"] = True
             try:
                 fn = self._compiled_update("_auto_forward_fn", (treedef, statics, validate), build)
-                new_states, batch_val, new_viol, new_cnt = fn(
-                    states, self._viol_flags if validate else None, dynamic, cnt[1]
-                )
+                if _OBS.enabled and _OBS.profile_scopes:
+                    with _obs_scopes.annotation(f"{type(self).__name__}.forward[compiled]"):
+                        new_states, batch_val, new_viol, new_cnt = fn(
+                            states, self._viol_flags if validate else None, dynamic, cnt[1]
+                        )
+                else:
+                    new_states, batch_val, new_viol, new_cnt = fn(
+                        states, self._viol_flags if validate else None, dynamic, cnt[1]
+                    )
             finally:
                 self.__dict__.pop("_fused_flags_tracing", None)
-        except Exception:
+        except Exception as err:
             self._auto_forward_disabled = True
+            if _OBS.enabled:
+                self._obs_auto_disabled(f"compiled forward failed: {type(err).__name__}: {err}")
             return False, None
+        if _OBS.enabled:
+            telem = _telemetry_for(self)
+            telem.inc("update_calls|path=forward_compiled")
+            if obs_sample:
+                telem.observe("forward_compiled", time.perf_counter() - t0)
         if validate:
             object.__setattr__(self, "_viol_flags", new_viol)
         object.__setattr__(self, "_auto_cnt", (self._update_count + 1, new_cnt))
@@ -1650,7 +1867,11 @@ class Metric(ABC):
 
         fn = self._compiled_update("_jit_update_fn", (treedef, statics), build)
         states = {n: getattr(self, n) for n in names}
-        new_states = fn(states, dynamic)
+        if _OBS.enabled:
+            self._obs_compile_event("jit_update", treedef, statics, sig[2])
+            new_states = self._obs_call("update_calls|path=jit", "update_jit", "jit_update", lambda: fn(states, dynamic))
+        else:
+            new_states = fn(states, dynamic)
         self._computed = None
         self._update_count += 1
         self._commit_compiled_states(names, states, new_states, sig)
@@ -1692,7 +1913,12 @@ class Metric(ABC):
         fn = self._compiled_update("_scan_update_fn", (treedef, statics), build)
         n_steps = int(dynamic[0].shape[0])
         states = {n: getattr(self, n) for n in names}
-        new_states = fn(states, dynamic)
+        if _OBS.enabled:
+            self._obs_compile_event("scan_update", treedef, statics, sig[2])
+            new_states = self._obs_call("update_calls|path=scan", "update_scan", "scan_update", lambda: fn(states, dynamic))
+            _telemetry_for(self).inc("scan_steps", n_steps)
+        else:
+            new_states = fn(states, dynamic)
         self._computed = None
         self._update_count += n_steps
         self._commit_compiled_states(names, states, new_states, sig)
@@ -1946,6 +2172,10 @@ class Metric(ABC):
                 # a SnapshotManager holds threads + file handles: clones and
                 # pickles travel without it (re-attach at the destination)
                 "_snapshot_hook",
+                # telemetry is per-instance stream history: a pickled/cloned
+                # metric is a new stream and re-registers lazily on first use
+                "_telem",
+                "_obs_seen_sigs",
             )
         }
         for attr in self._defaults:

@@ -22,8 +22,8 @@ class KLDivergence(Metric):
         >>> from torchmetrics_tpu.regression import KLDivergence
         >>> metric = KLDivergence()
         >>> metric.update(jnp.array([[0.36, 0.48, 0.16]]), jnp.array([[1/3, 1/3, 1/3]]))
-        >>> metric.compute()
-        Array(0.0852996, dtype=float32)
+        >>> round(float(metric.compute()), 4)
+        0.0853
     """
 
     is_differentiable = True

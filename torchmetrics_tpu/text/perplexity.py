@@ -17,13 +17,14 @@ class Perplexity(Metric):
     """Perplexity of a language model: exp of the mean negative log likelihood.
 
     Example:
-        >>> import jax
+        >>> import jax.numpy as jnp
         >>> from torchmetrics_tpu.text import Perplexity
-        >>> preds = jax.random.uniform(jax.random.PRNGKey(22), (2, 8, 5))
-        >>> target = jax.random.randint(jax.random.PRNGKey(89), (2, 8), 0, 5)
+        >>> probs = jnp.array([0.1, 0.2, 0.3, 0.25, 0.15])
+        >>> preds = jnp.log(jnp.tile(probs, (2, 8, 1)))  # log-probabilities
+        >>> target = jnp.tile(jnp.array([0, 1, 2, 3, 4, 0, 1, 2]), (2, 1))
         >>> perp = Perplexity(ignore_index=-100)
         >>> round(float(perp(preds, target)), 3)
-        4.999
+        5.416
     """
 
     is_differentiable = True
