@@ -1,0 +1,180 @@
+"""Degradation contract: injected collective failure → eager guarded fallback."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu._spmd import faultinject
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+WORLD = len(jax.devices())
+RNG = np.random.default_rng(21)
+B = 8 * WORLD
+C = 4
+
+
+def _batch():
+    return (
+        jnp.asarray(RNG.random((B, C)).astype(np.float32)),
+        jnp.asarray(RNG.integers(0, C, B)),
+    )
+
+
+def _quiet_step(eng, *args):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        return eng.step(*args)
+
+
+def test_injected_failure_degrades_and_stream_continues():
+    m = tm.MulticlassAccuracy(num_classes=C)
+    eng = m.to_spmd()
+    eager = tm.MulticlassAccuracy(num_classes=C)
+    eager.auto_compile = False
+    batches = [_batch() for _ in range(4)]
+    eng.step(*batches[0])
+    eager.update(*batches[0])
+    with faultinject.inject_step_failure():
+        v = _quiet_step(eng, *batches[1])
+    eager.update(*batches[1])
+    assert eng.degraded
+    # the failed batch was NOT lost: the degraded step re-ran it eagerly
+    want = eager.compute()
+    eager._computed = None
+    np.testing.assert_allclose(np.asarray(v), np.asarray(want), rtol=1e-6)
+    # stream keeps flowing on the eager path
+    for p, t in batches[2:]:
+        v = _quiet_step(eng, p, t)
+        eager.update(p, t)
+        want = eager.compute()
+        eager._computed = None
+        np.testing.assert_allclose(np.asarray(v), np.asarray(want), rtol=1e-6)
+
+
+def test_degradation_recorded_in_resilience_report():
+    m = tm.MulticlassAccuracy(num_classes=C)
+    eng = m.to_spmd()
+    eng.step(*_batch())
+    with faultinject.inject_step_failure():
+        _quiet_step(eng, *_batch())
+    events = m.resilience_report().events
+    assert any(e.kind == "spmd_degraded" for e in events)
+    assert any("eager guarded sync" in e.detail for e in events)
+
+
+def test_fold_preserves_every_reduction_kind():
+    """The degrade fold must merge per-device rows with the state's OWN
+    reduction — sum/mean/max/min each verified against the eager stream."""
+
+    class Kinds(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__()
+            self.add_state("s_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+            self.add_state("s_max", default=jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+            self.add_state("s_min", default=jnp.asarray(jnp.inf), dist_reduce_fx="min")
+
+        def update(self, x):
+            self.s_sum = self.s_sum + jnp.sum(x)
+            self.s_max = jnp.maximum(self.s_max, jnp.max(x))
+            self.s_min = jnp.minimum(self.s_min, jnp.min(x))
+
+        def compute(self):
+            return jnp.stack([self.s_sum, self.s_max, self.s_min])
+
+    eng = Kinds().to_spmd(enforce_manifest=False)
+    eager = Kinds()
+    xs = [jnp.asarray(RNG.random(B).astype(np.float32)) for _ in range(3)]
+    for x in xs[:2]:
+        eng.step(x)
+        eager.update(x)
+    with faultinject.inject_step_failure():
+        v = _quiet_step(eng, xs[2])
+    eager.update(xs[2])
+    np.testing.assert_allclose(np.asarray(v), np.asarray(eager.compute()), rtol=1e-5)
+
+
+def test_collection_degradation_rebinds_members():
+    mc = MetricCollection(
+        [tm.MulticlassAccuracy(num_classes=C), tm.MulticlassPrecision(num_classes=C)]
+    )
+    eng = mc.to_spmd()
+    eager = MetricCollection(
+        [tm.MulticlassAccuracy(num_classes=C), tm.MulticlassPrecision(num_classes=C)]
+    )
+    b1, b2 = _batch(), _batch()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        eng.step(*b1)
+        eager.update(*b1)
+        with faultinject.inject_step_failure():
+            v = eng.step(*b2)
+        eager.update(*b2)
+        want = eager.compute()
+    assert eng.degraded
+    for key in want:
+        np.testing.assert_allclose(np.asarray(v[key]), np.asarray(want[key]), rtol=1e-6, err_msg=key)
+
+
+def test_programming_errors_raise_instead_of_degrading():
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    eng.step(*_batch())
+    with faultinject.inject_step_failure(exc_factory=lambda: TypeError("bug")):
+        with pytest.raises(TypeError, match="bug"):
+            eng.step(*_batch())
+    assert not eng.degraded
+
+
+def test_bounded_injection_recovers():
+    """A single-shot fault degrades THIS engine; a fresh engine on a healthy
+    seam takes the fused path again (times= bounds the injection)."""
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    eng.step(*_batch())
+    with faultinject.inject_step_failure(times=1):
+        _quiet_step(eng, *_batch())
+        assert eng.degraded
+        eng2 = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+        eng2.step(*_batch())  # injection exhausted: fused path healthy
+        assert not eng2.degraded
+
+
+def test_post_donation_fault_restarts_without_crash():
+    """An EXECUTE-time fault of the donated step has already consumed the
+    input buffers: the fold is impossible, but degradation must still land
+    on a working eager stream (restarted from defaults, loss recorded) —
+    never crash inside the handler reading deleted arrays."""
+    m = tm.MulticlassAccuracy(num_classes=C)
+    eng = m.to_spmd()
+    b1, b2 = _batch(), _batch()
+    eng.step(*b1)
+
+    def consume_then_fail():
+        # model donation-then-death: the buffers are gone when the error
+        # surfaces from the executable
+        for leaf in jax.tree_util.tree_leaves(eng._states):
+            leaf.delete()
+        return RuntimeError("backend died mid-execution")
+
+    with faultinject.inject_step_failure(exc_factory=consume_then_fail):
+        v = _quiet_step(eng, *b2)
+    assert eng.degraded
+    events = m.resilience_report().events
+    assert any("restarts from defaults" in e.detail for e in events)
+    # the eager stream restarted: the degraded step's value is a 1-batch value
+    fresh = tm.MulticlassAccuracy(num_classes=C)
+    fresh.auto_compile = False
+    fresh.update(*b2)
+    np.testing.assert_allclose(np.asarray(v), np.asarray(fresh.compute()), rtol=1e-6)
+
+
+def test_no_batch_arrays_is_user_error():
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    with pytest.raises(TorchMetricsUserError, match="array argument"):
+        eng.step()

@@ -1,0 +1,156 @@
+"""SPMD engine core: fused-step golden equality, donation, collections."""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import MetricCollection
+from torchmetrics_tpu._spmd import SpmdEngine
+from torchmetrics_tpu.metric import Metric
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+WORLD = len(jax.devices())
+RNG = np.random.default_rng(7)
+B = 8 * WORLD
+C = 4
+
+
+def _batch():
+    return (
+        jnp.asarray(RNG.random((B, C)).astype(np.float32)),
+        jnp.asarray(RNG.integers(0, C, B)),
+    )
+
+
+def test_fused_step_matches_eager_stream():
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    eager = tm.MulticlassAccuracy(num_classes=C)
+    eager.auto_compile = False
+    for _ in range(4):
+        p, t = _batch()
+        fused = eng.step(p, t)
+        eager.update(p, t)
+        want = eager.compute()
+        eager._computed = None
+        np.testing.assert_allclose(np.asarray(fused), np.asarray(want), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(eng.compute()), np.asarray(want), rtol=1e-6)
+    assert eng.steps == 4 and not eng.degraded
+
+
+def test_donation_no_copy():
+    """The donated state buffers must be REUSED: inputs deleted after the step."""
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    eng.step(*_batch())
+    pre = jax.tree_util.tree_leaves(eng._states)
+    eng.step(*_batch())
+    assert all(leaf.is_deleted() for leaf in pre)
+
+
+def test_donate_false_keeps_buffers():
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd(donate=False)
+    eng.step(*_batch())
+    pre = jax.tree_util.tree_leaves(eng._states)
+    eng.step(*_batch())
+    assert not any(leaf.is_deleted() for leaf in pre)
+
+
+def test_collection_compute_groups_share_one_step():
+    mc = MetricCollection(
+        [tm.MulticlassAccuracy(num_classes=C), tm.MulticlassPrecision(num_classes=C)]
+    )
+    eng = mc.to_spmd()
+    eager = MetricCollection(
+        [tm.MulticlassAccuracy(num_classes=C), tm.MulticlassPrecision(num_classes=C)]
+    )
+    for m in eager.values():
+        m.auto_compile = False
+    for _ in range(3):
+        p, t = _batch()
+        fused = eng.step(p, t)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            eager.update(p, t)
+    # the fused step formed ONE compute group (shared stat-scores state)
+    assert len(eng._units) == 1
+    assert sorted(eng.target._groups[0]) == ["MulticlassAccuracy", "MulticlassPrecision"]
+    want = eager.compute()
+    assert set(fused) == set(want)
+    for key in want:
+        np.testing.assert_allclose(np.asarray(fused[key]), np.asarray(want[key]), rtol=1e-6, err_msg=key)
+
+
+def test_ring_cat_state_all_gathers():
+    class CatMean(Metric):
+        full_state_update = False
+
+        def __init__(self):
+            super().__init__(cat_state_capacity=B * 8)
+            self.add_state("vals", default=[], dist_reduce_fx="cat")
+
+        def update(self, x):
+            self.vals.append(x)
+
+        def compute(self):
+            data, valid = self.vals.masked()
+            return jnp.sum(jnp.where(valid, data, 0.0)) / jnp.sum(valid)
+
+    eng = CatMean().to_spmd(enforce_manifest=False)
+    chunks = []
+    for _ in range(3):
+        x = jnp.asarray(RNG.random(B).astype(np.float32))
+        chunks.append(np.asarray(x))
+        fused = eng.step(x)
+    want = float(np.mean(np.concatenate(chunks)))
+    assert abs(float(fused) - want) < 1e-5
+
+
+def test_fresh_metric_required():
+    m = tm.MulticlassAccuracy(num_classes=C)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        m.update(*_batch())
+    with pytest.raises(Exception, match="fresh metric"):
+        m.to_spmd()
+
+
+def test_batch_must_divide_mesh():
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    p = jnp.asarray(RNG.random((WORLD + 1, C)).astype(np.float32))
+    t = jnp.asarray(RNG.integers(0, C, WORLD + 1))
+    with pytest.raises(TorchMetricsUserError, match="divisible"):
+        eng.step(p, t)
+
+
+def test_reset_restores_defaults():
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    p, t = _batch()
+    v1 = eng.step(p, t)
+    eng.reset()
+    assert eng.steps == 0
+    v2 = eng.step(p, t)
+    np.testing.assert_allclose(np.asarray(v1), np.asarray(v2), rtol=1e-6)
+
+
+def test_engine_rejects_non_metric():
+    with pytest.raises(Exception, match="Metric or MetricCollection"):
+        SpmdEngine(object())
+
+
+def test_telemetry_path_spmd_counters():
+    from torchmetrics_tpu._observability import set_telemetry_enabled
+
+    set_telemetry_enabled(True)
+    try:
+        m = tm.MulticlassAccuracy(num_classes=C)
+        eng = m.to_spmd()
+        for _ in range(3):
+            eng.step(*_batch())
+        counters = m.telemetry_report().counters
+        assert counters.get("update_calls|path=spmd") == 3
+        assert counters.get("compiles|kind=spmd_step") == 1
+    finally:
+        set_telemetry_enabled(False)

@@ -1,0 +1,165 @@
+"""Snapshot/restore of donated SPMD states via boundary device_get."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._resilience import SnapshotManager, SnapshotPolicy
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+WORLD = len(jax.devices())
+RNG = np.random.default_rng(33)
+B = 8 * WORLD
+C = 4
+
+
+def _batches(n):
+    return [
+        (jnp.asarray(RNG.random((B, C)).astype(np.float32)), jnp.asarray(RNG.integers(0, C, B)))
+        for _ in range(n)
+    ]
+
+
+def test_restore_returns_to_newest_boundary(tmp_path):
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    mgr = SnapshotManager(eng, tmp_path, SnapshotPolicy(every_n_updates=2, async_write=False))
+    vals = []
+    for p, t in _batches(4):
+        vals.append(float(eng.step(p, t)))
+    mgr.close()
+    # boundaries: base snapshot after step 1, periodic after step 3; step 4
+    # falls between boundaries and is the (documented) loss window
+    fresh = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    mgr2 = SnapshotManager(fresh, tmp_path, SnapshotPolicy(async_write=False))
+    report = mgr2.restore_latest()
+    assert report.replayed == 0  # opaque in-graph steps are not arg-journaled
+    assert fresh.steps == 3
+    assert abs(float(fresh.compute()) - vals[2]) < 1e-6
+    mgr2.close()
+
+
+def test_restored_engine_keeps_streaming_fused(tmp_path):
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    mgr = SnapshotManager(eng, tmp_path, SnapshotPolicy(every_n_updates=1, async_write=False))
+    batches = _batches(3)
+    for p, t in batches[:2]:
+        live = eng.step(p, t)
+    mgr.close()
+    fresh = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    mgr2 = SnapshotManager(fresh, tmp_path, SnapshotPolicy(async_write=False))
+    mgr2.restore_latest()
+    np.testing.assert_allclose(float(fresh.compute()), float(live), rtol=1e-6)
+    v_fresh = fresh.step(*batches[2])
+    v_live = eng.step(*batches[2])
+    assert not fresh.degraded
+    np.testing.assert_allclose(float(v_fresh), float(v_live), rtol=1e-6)
+    mgr2.close()
+
+
+def test_snapshot_counts_and_integrity_block(tmp_path):
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    mgr = SnapshotManager(eng, tmp_path, SnapshotPolicy(every_n_updates=2, async_write=False))
+    for p, t in _batches(4):
+        eng.step(p, t)
+    assert mgr.snapshots_taken == 2
+    sd = eng.state_dict(integrity=True)
+    assert "#integrity" in sd and "#spmd" in sd
+    assert sd["#spmd"]["world"] == WORLD
+    for key, val in sd.items():
+        if not key.startswith("#"):
+            assert val.shape[0] == WORLD  # stacked per-device rows
+    mgr.close()
+
+
+def test_collection_snapshot_roundtrip(tmp_path):
+    def make():
+        return tm.MetricCollection(
+            [tm.MulticlassAccuracy(num_classes=C), tm.MulticlassPrecision(num_classes=C)]
+        )
+
+    eng = make().to_spmd()
+    mgr = SnapshotManager(eng, tmp_path, SnapshotPolicy(every_n_updates=1, async_write=False))
+    for p, t in _batches(2):
+        live = eng.step(p, t)
+    mgr.close()
+    fresh = make().to_spmd()
+    mgr2 = SnapshotManager(fresh, tmp_path, SnapshotPolicy(async_write=False))
+    mgr2.restore_latest()
+    restored = fresh.compute()
+    for key in live:
+        np.testing.assert_allclose(
+            np.asarray(restored[key]), np.asarray(live[key]), rtol=1e-6, err_msg=key
+        )
+    mgr2.close()
+
+
+def test_mesh_mismatch_rejected(tmp_path):
+    if WORLD < 2:
+        pytest.skip("needs >= 2 devices")
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    for p, t in _batches(1):
+        eng.step(p, t)
+    sd = eng.state_dict(integrity=True)
+    from torchmetrics_tpu._spmd import build_mesh
+
+    small = tm.MulticlassAccuracy(num_classes=C).to_spmd(mesh=build_mesh("dp", jax.devices()[:1]))
+    with pytest.raises(TorchMetricsUserError, match="identical mesh layout"):
+        small.load_state_dict(sd)
+
+
+def test_reset_after_restore_returns_to_defaults(tmp_path):
+    """A pre-first-batch restore must leave reset() functional: the device
+    states go back to DEFAULTS, not silently keep the checkpoint."""
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    mgr = SnapshotManager(eng, tmp_path, SnapshotPolicy(every_n_updates=1, async_write=False))
+    batches = _batches(3)
+    for p, t in batches[:2]:
+        eng.step(p, t)
+    mgr.close()
+    fresh = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    mgr2 = SnapshotManager(fresh, tmp_path, SnapshotPolicy(async_write=False))
+    mgr2.restore_latest()
+    mgr2.close()
+    fresh.reset()
+    assert fresh.steps == 0
+    brand_new = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    np.testing.assert_allclose(
+        float(fresh.step(*batches[2])), float(brand_new.step(*batches[2])), rtol=1e-6
+    )
+
+
+def test_degradation_takes_final_boundary_snapshot_and_pauses(tmp_path):
+    """A degrade mid-stream must not silently freeze durability: the manager
+    captures one final boundary (the folded state) and is explicitly paused,
+    with the hand-off recorded in the degradation event."""
+    import warnings
+
+    from torchmetrics_tpu._spmd import faultinject
+
+    m = tm.MulticlassAccuracy(num_classes=C)
+    eng = m.to_spmd()
+    mgr = SnapshotManager(eng, tmp_path, SnapshotPolicy(every_n_updates=10, async_write=False))
+    batches = _batches(3)
+    for p, t in batches[:2]:
+        pre_degrade = eng.step(p, t)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faultinject.inject_step_failure():
+            eng.step(*batches[2])
+    assert eng.degraded and mgr._paused
+    assert any("PAUSED" in e.detail for e in m.resilience_report().events)
+    mgr.close()
+    # the final boundary snapshot holds the state as of the LAST fused step
+    fresh = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    mgr2 = SnapshotManager(fresh, tmp_path, SnapshotPolicy(async_write=False))
+    mgr2.restore_latest()
+    np.testing.assert_allclose(float(fresh.compute()), float(pre_degrade), rtol=1e-6)
+    mgr2.close()
+
+
+def test_state_dict_before_first_step_raises():
+    eng = tm.MulticlassAccuracy(num_classes=C).to_spmd()
+    with pytest.raises(TorchMetricsUserError, match="no device states"):
+        eng.state_dict()

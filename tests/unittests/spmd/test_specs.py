@@ -1,0 +1,151 @@
+"""Spec correctness per ``dist_reduce_fx`` kind + the eligibility facet gate."""
+
+import json
+from pathlib import Path
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._analysis.manifest import in_graph_sync_eligible
+from torchmetrics_tpu._spmd import (
+    COLLECTIVE_FOR,
+    InGraphSyncUnsupported,
+    build_mesh,
+    state_specs,
+    sync_plan,
+    validate_reductions,
+)
+from torchmetrics_tpu.metric import Metric
+
+ELIGIBILITY = json.loads(
+    (Path(__file__).resolve().parents[3] / "torchmetrics_tpu" / "_analysis" / "eligibility.json").read_text()
+)["classes"]
+
+
+class _AllKinds(Metric):
+    full_state_update = False
+
+    def __init__(self, **kw):
+        super().__init__(cat_state_capacity=64, **kw)
+        self.add_state("s_sum", default=jnp.zeros(()), dist_reduce_fx="sum")
+        self.add_state("s_mean", default=jnp.zeros(()), dist_reduce_fx="mean")
+        self.add_state("s_max", default=jnp.asarray(-jnp.inf), dist_reduce_fx="max")
+        self.add_state("s_min", default=jnp.asarray(jnp.inf), dist_reduce_fx="min")
+        self.add_state("s_cat", default=[], dist_reduce_fx="cat")
+
+    def update(self, x):
+        self.s_sum = self.s_sum + jnp.sum(x)
+        self.s_mean = self.s_mean + 0 * jnp.mean(x) + jnp.mean(x) - self.s_mean / max(1, 1)
+        self.s_max = jnp.maximum(self.s_max, jnp.max(x))
+        self.s_min = jnp.minimum(self.s_min, jnp.min(x))
+        self.s_cat.append(x)
+
+    def compute(self):
+        return self.s_sum
+
+
+def test_collective_per_reduction_kind():
+    """Every dist_reduce_fx kind maps onto its declared in-graph collective."""
+    m = _AllKinds()
+    plan = validate_reductions(m)
+    assert plan == {
+        "s_sum": "psum",
+        "s_mean": "pmean",
+        "s_max": "pmax",
+        "s_min": "pmin",
+        "s_cat": "all_gather",
+    }
+    assert set(COLLECTIVE_FOR) == {"sum", "mean", "max", "min", "cat"}
+
+
+def test_state_specs_shard_leading_device_axis():
+    specs = state_specs(["a", "b"], "dp")
+    assert specs == {"a": PartitionSpec("dp"), "b": PartitionSpec("dp")}
+
+
+def test_unbounded_cat_state_rejected():
+    class _Unbounded(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("vals", default=[], dist_reduce_fx="cat")
+
+        def update(self, x):
+            self.vals.append(x)
+
+        def compute(self):
+            return jnp.zeros(())
+
+    with pytest.raises(InGraphSyncUnsupported, match="cat_state_capacity"):
+        validate_reductions(_Unbounded())
+
+
+def test_none_and_callable_reductions_rejected():
+    with pytest.raises(InGraphSyncUnsupported, match="no in-graph collective"):
+        sync_plan({"a": None})
+    with pytest.raises(InGraphSyncUnsupported, match="callable"):
+        sync_plan({"a": lambda x: x})
+
+
+def test_build_mesh_default_axis():
+    mesh = build_mesh("dp")
+    assert mesh.axis_names == ("dp",)
+    assert mesh.shape["dp"] == len(jax.devices())
+
+
+class TestFacetGate:
+    def test_certified_safe_class(self):
+        assert in_graph_sync_eligible(tm.MulticlassAccuracy) in ("safe", "runtime")
+
+    def test_host_bound_class_keeps_eager_gather(self):
+        from torchmetrics_tpu.text import WordErrorRate
+
+        assert in_graph_sync_eligible(WordErrorRate) == "host_bound"
+        with pytest.raises(InGraphSyncUnsupported, match="eager gather"):
+            WordErrorRate().to_spmd()
+
+    def test_unknown_user_subclass_requires_opt_in(self):
+        assert in_graph_sync_eligible(_AllKinds) == "unknown"
+        with pytest.raises(InGraphSyncUnsupported, match="absent from the eligibility manifest"):
+            _AllKinds().to_spmd()
+
+    def test_eligibility_kill_switch_falls_back_to_runtime_check(self):
+        """Disabling the STATIC analysis must not disable the SPMD API: the
+        facet reads `runtime` and the engine's live-instance reduction check
+        decides (an untraceable compute then degrades at trace time)."""
+        from torchmetrics_tpu._analysis.manifest import set_eligibility_enabled
+
+        set_eligibility_enabled(False)
+        try:
+            assert in_graph_sync_eligible(tm.MulticlassAccuracy) == "runtime"
+            eng = tm.MulticlassAccuracy(num_classes=4).to_spmd()
+            assert not eng.degraded
+        finally:
+            set_eligibility_enabled(True)
+
+    def test_manifest_facet_consistent_with_verdicts(self):
+        """host_bound verdicts never certify in-graph; non-host-bound never
+        land on the host_bound facet."""
+        for qual, entry in ELIGIBILITY.items():
+            facet = entry["in_graph_sync"]["verdict"]
+            if entry["verdict"] == "host_bound":
+                assert facet == "host_bound", qual
+            else:
+                assert facet in ("safe", "runtime", "unsupported"), (qual, facet)
+
+    def test_facet_reasons_cited_for_unsupported(self):
+        unsupported = [
+            (q, e) for q, e in ELIGIBILITY.items() if e["in_graph_sync"]["verdict"] == "unsupported"
+        ]
+        for qual, entry in unsupported:
+            assert entry["in_graph_sync"]["reasons"], qual
+
+
+def test_pearson_unsupported_by_facet_and_engine():
+    """PearsonCorrCoef declares dist_reduce_fx=None states: the facet marks it
+    unsupported and the engine refuses it with the same diagnosis."""
+    assert in_graph_sync_eligible(tm.PearsonCorrCoef) == "unsupported"
+    with pytest.raises(InGraphSyncUnsupported):
+        tm.PearsonCorrCoef().to_spmd()

@@ -41,6 +41,22 @@ VERDICT_METADATA_ONLY = "metadata_only"  # (a)
 VERDICT_VALUE_FLAGS = "value_flags"  # (b)
 VERDICT_HOST_BOUND = "host_bound"  # (c)
 
+# ---- in-graph-sync facet (the SPMD engine's gate, see torchmetrics_tpu/_spmd) ----
+# "safe": every state's dist_reduce_fx is statically a string the in-graph
+#   collectives implement (psum/pmean/pmax/pmin/all_gather) and the class is
+#   not host-bound — the fused update→sync→compute step is certified.
+# "runtime": not host-bound, but at least one reduction is only decidable
+#   from the live instance (ctor pass-through, dynamic add_state) — the
+#   engine re-checks `metric._reductions` at construction.
+# "unsupported": a state provably declares a reduction with no in-graph
+#   collective semantics (None / an unknown string).
+# "host_bound": the class keeps the eager gather path.
+SYNC_SAFE = "safe"
+SYNC_RUNTIME = "runtime"
+SYNC_UNSUPPORTED = "unsupported"
+SYNC_HOST_BOUND = "host_bound"
+IN_GRAPH_REDUCTIONS = frozenset(("sum", "mean", "max", "min", "cat"))
+
 # check-pattern kinds the prover recognizes (and a traced port can express
 # branchlessly); "value" is the catch-all for tainted checks that do not
 # match a finer pattern — still portable, just without a canned recipe
@@ -155,6 +171,8 @@ class ClassEligibility:
     declares_flags: bool = False
     missing: List[CheckSite] = field(default_factory=list)  # eager - traced (R6)
     public: bool = True
+    in_graph_sync: str = SYNC_HOST_BOUND
+    in_graph_reasons: List[str] = field(default_factory=list)
 
     def to_json(self) -> Dict[str, object]:
         return {
@@ -164,6 +182,10 @@ class ClassEligibility:
             "blockers": [b.to_json() for b in self.blockers],
             "conditional": [b.to_json() for b in self.conditional],
             "missing": [c.to_json() for c in self.missing],
+            "in_graph_sync": {
+                "verdict": self.in_graph_sync,
+                "reasons": sorted(self.in_graph_reasons),
+            },
         }
 
 
@@ -870,6 +892,78 @@ class EligibilityPass:
                 return (c.kind, c.subject) in covered or (c.kind, "?") in covered
 
             result.missing = [c for c in result.checks if not is_covered(c)]
+
+        # ---- in-graph-sync facet: can the SPMD engine fuse this class's
+        # cross-device sync into the compiled step? Host-bound classes keep
+        # the eager gather; otherwise every state's declared reduction must
+        # map onto an in-graph collective (psum/pmean/pmax/pmin/all_gather).
+        if result.verdict == VERDICT_HOST_BOUND:
+            result.in_graph_sync = SYNC_HOST_BOUND
+            result.in_graph_reasons = ["host-bound verdict: the class keeps the eager gather"]
+        else:
+            reductions, dynamic_kinds = registry.state_reductions(cls)
+            reasons: List[str] = []
+            runtime_only = False
+            for state, kind in sorted(reductions.items()):
+                if kind == "?":
+                    runtime_only = True
+                elif kind not in IN_GRAPH_REDUCTIONS:
+                    reasons.append(
+                        f"state `{state}` declares dist_reduce_fx={kind!r}, which has no"
+                        " in-graph collective semantics"
+                    )
+            for kind in sorted(dynamic_kinds):
+                if kind == "?":
+                    runtime_only = True
+                elif kind not in IN_GRAPH_REDUCTIONS:
+                    reasons.append(
+                        f"a dynamically-named state declares dist_reduce_fx={kind!r}, which has"
+                        " no in-graph collective semantics"
+                    )
+            # the fused step traces COMPUTE as well as update — the update
+            # verdicts above never looked at it. Walk compute's call graph
+            # with the same interprocedural summarizer (registered states are
+            # the taint roots): a host-sync blocker there means the compute
+            # body cannot lower into the step.
+            compute_runtime_only = False
+            compute_hit = registry.resolve_method(cls, "compute")
+            if compute_hit is None:
+                compute_runtime_only = True
+            else:
+                cowner, cfunc = compute_hit
+                cmod = registry.modules.get(cowner.module)
+                if cmod is None:
+                    compute_runtime_only = True
+                else:
+                    csummary = self.summarize(cmod, cfunc, cls, True, 0, set())
+                    hard_compute = [b for b in csummary.blockers if not b.conditional]
+                    if hard_compute:
+                        reasons.extend(
+                            f"compute does not trace: {b.reason} ({b.site})"
+                            for b in _dedup_blockers(hard_compute)
+                        )
+                    # a truncated walk may have missed a host sync: the claim
+                    # downgrades to runtime (the engine degrades on a trace
+                    # failure instead of trusting an unprovable "safe")
+                    compute_runtime_only = csummary.truncated
+            if reasons:
+                result.in_graph_sync = SYNC_UNSUPPORTED
+                result.in_graph_reasons = reasons
+            elif (
+                runtime_only
+                or compute_runtime_only
+                or (not reductions and not dynamic_kinds)
+            ):
+                # no statically-visible add_state at all also means the live
+                # instance must be consulted (wrapper chains, exec-time
+                # registration the early blockers did not already catch)
+                result.in_graph_sync = SYNC_RUNTIME
+                result.in_graph_reasons = [
+                    "reduction kinds or compute traceability are only decidable at runtime;"
+                    " the engine re-checks at construction and degrades on a trace failure"
+                ]
+            else:
+                result.in_graph_sync = SYNC_SAFE
         return result
 
     def analyze_all(self) -> Dict[str, ClassEligibility]:

@@ -43,6 +43,7 @@ def set_eligibility_enabled(flag: bool) -> None:
     global _eligibility_enabled
     _eligibility_enabled = bool(flag)
     _eligibility_class_cache.clear()
+    _in_graph_class_cache.clear()
 
 
 def write_manifest(certified: Iterable[str], path: Optional[Path] = None) -> int:
@@ -79,11 +80,13 @@ def fingerprint_skip_enabled() -> bool:
 
 
 def invalidate_cache() -> None:
-    global _manifest_cache, _eligibility_cache
+    global _manifest_cache, _eligibility_cache, _in_graph_cache
     _manifest_cache = None
     _class_cache.clear()
     _eligibility_cache = None
     _eligibility_class_cache.clear()
+    _in_graph_cache = None
+    _in_graph_class_cache.clear()
 
 
 def write_eligibility(payload: Dict[str, object], path: Optional[Path] = None) -> int:
@@ -114,6 +117,57 @@ def load_eligibility(path: Optional[Path] = None) -> Dict[str, str]:
     if path is None:
         _eligibility_cache = verdicts
     return verdicts
+
+
+_in_graph_cache: Optional[Dict[str, str]] = None
+_in_graph_class_cache: Dict[type, str] = {}
+
+
+def load_in_graph_sync(path: Optional[Path] = None) -> Dict[str, str]:
+    """qualname -> in-graph-sync facet verdict from the eligibility manifest."""
+    global _in_graph_cache
+    if path is None and _in_graph_cache is not None:
+        return _in_graph_cache
+    p = path or ELIGIBILITY_PATH
+    try:
+        data = json.loads(p.read_text(encoding="utf-8"))
+        classes = data.get("classes", {})
+        facets = {
+            qual: str((entry.get("in_graph_sync") or {}).get("verdict", ""))
+            for qual, entry in classes.items()
+            if isinstance(entry, dict)
+        }
+    except (OSError, ValueError, AttributeError):
+        facets = {}
+    if path is None:
+        _in_graph_cache = facets
+    return facets
+
+
+def in_graph_sync_eligible(cls: type) -> str:
+    """The SPMD engine's gate: ``"safe"``/``"runtime"``/``"unsupported"``/
+    ``"host_bound"``/``"unknown"`` for the EXACT class.
+
+    ``safe`` certifies the fused in-graph update→sync→compute step outright;
+    ``runtime`` means the engine must verify the live instance's
+    ``_reductions`` itself; ``unknown`` (class absent from the manifest —
+    user subclasses) and ``host_bound``/``unsupported`` keep the eager
+    gather path. With the eligibility kill switch thrown
+    (``TM_TPU_DISABLE_ELIGIBILITY=1`` / ``set_eligibility_enabled(False)``)
+    every class reads ``runtime``: disabling the STATIC analysis must not
+    disable the SPMD API — the engine's live-instance reduction check still
+    runs, and an untraceable compute degrades at trace time.
+    """
+    if not _eligibility_enabled:
+        return "runtime"
+    cached = _in_graph_class_cache.get(cls)
+    if cached is not None:
+        return cached
+    facets = load_in_graph_sync()
+    qualname = f"{cls.__module__}.{cls.__qualname__}"
+    facet = facets.get(qualname) or "unknown"
+    _in_graph_class_cache[cls] = facet
+    return facet
 
 
 def compiled_validation_eligible(cls: type) -> bool:

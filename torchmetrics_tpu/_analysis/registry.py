@@ -128,6 +128,16 @@ class ClassInfo:
     # `thresholds=None` / `num_classes=None` / `return_full_image=True`)
     conditional_list_states: Set[str] = field(default_factory=set)
     dynamic_add_state: bool = False  # add_state with a non-literal name
+    # literal add_state name -> statically-decided `dist_reduce_fx` kind:
+    # a string literal carries through as-is, an absent/None argument becomes
+    # "none", and any non-literal expression (a ctor-parameter pass-through, a
+    # callable) becomes "?" — the in-graph-sync facet treats "?" as
+    # runtime-decidable, not as a blocker
+    state_reductions: Dict[str, str] = field(default_factory=dict)
+    # reduction kinds of dynamically-named add_state calls (stat-scores style
+    # `for name in (...): self.add_state(name, ...)` loops): names are
+    # unknown, the reduction kind usually still a literal
+    dynamic_state_reductions: Set[str] = field(default_factory=set)
     # class-body function aliases (`_update_fn = staticmethod(f)` style):
     # alias name -> name of the aliased function as written in source
     fn_aliases: Dict[str, str] = field(default_factory=dict)
@@ -238,8 +248,20 @@ def _scan_class(node: ast.ClassDef, module: str, path: str) -> ClassInfo:
                     default_arg = sub.args[1] if len(sub.args) > 1 else next(
                         (kw.value for kw in sub.keywords if kw.arg == "default"), None
                     )
+                    reduce_arg = sub.args[2] if len(sub.args) > 2 else next(
+                        (kw.value for kw in sub.keywords if kw.arg == "dist_reduce_fx"), None
+                    )
+                    if reduce_arg is None or (
+                        isinstance(reduce_arg, ast.Constant) and reduce_arg.value is None
+                    ):
+                        reduction = "none"
+                    elif isinstance(reduce_arg, ast.Constant) and isinstance(reduce_arg.value, str):
+                        reduction = reduce_arg.value
+                    else:
+                        reduction = "?"  # ctor pass-through / callable: runtime-decidable
                     if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
                         info.own_states.add(name_arg.value)
+                        info.state_reductions.setdefault(name_arg.value, reduction)
                         if isinstance(default_arg, ast.List):
                             info.list_states.add(name_arg.value)
                             if under_if:
@@ -248,6 +270,7 @@ def _scan_class(node: ast.ClassDef, module: str, path: str) -> ClassInfo:
                             info.array_states.add(name_arg.value)
                     else:
                         info.dynamic_add_state = True
+                        info.dynamic_state_reductions.add(reduction)
         # the mutation index and the R1 rule share one walker (MutationSite),
         # so certification and reporting can never drift apart again
         mutated: Set[str] = set()
@@ -378,6 +401,26 @@ class Registry:
             states |= c.own_states
             dynamic = dynamic or c.dynamic_add_state
         return states, dynamic
+
+    def state_reductions(self, cls: ClassInfo) -> Tuple[Dict[str, str], Set[str]]:
+        """``(name -> reduction-kind, dynamic-call reduction kinds)`` along the chain.
+
+        Chain order is subclass-first, so a re-registered name keeps the
+        most-derived declaration. Kinds are the literal ``dist_reduce_fx``
+        strings, ``"none"`` for an absent/None argument, and ``"?"`` for a
+        non-literal expression (decidable only at runtime from the live
+        instance's ``_reductions``).
+        """
+        chain, _, fully_resolved = self.chain(cls)
+        reductions: Dict[str, str] = {}
+        dynamic: Set[str] = set()
+        for c in chain:
+            for name, kind in c.state_reductions.items():
+                reductions.setdefault(name, kind)
+            dynamic |= c.dynamic_state_reductions
+        if not fully_resolved:
+            dynamic.add("?")  # an unscanned base may register anything
+        return reductions, dynamic
 
     def declares_traced_flags(self, cls: ClassInfo) -> bool:
         chain, _, _ = self.chain(cls)

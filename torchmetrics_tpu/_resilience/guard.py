@@ -48,6 +48,7 @@ __all__ = [
     "run_guarded",
     "state_structure_digest",
     "guarded_metric_sync",
+    "handshake_at_trace",
 ]
 
 
@@ -295,6 +296,30 @@ def _handshake(metric: Any, policy: SyncPolicy) -> bool:
         )
     object.__setattr__(metric, "_handshake_ok_digest", digest)
     return True
+
+
+def handshake_at_trace(metric: Any) -> bool:
+    """One structure handshake for a compiled (SPMD) path, at trace time.
+
+    The in-graph engine checks the cross-process structure contract ONCE,
+    before building the fused executable — a per-step handshake would
+    re-introduce the eager round-trip the engine removes. Policy resolution
+    mirrors ``Metric.sync``: the metric's own ``sync_policy``, else the
+    process-wide default unless the metric explicitly opted out. Returns
+    False when the handshake transport degraded (caller must keep the eager
+    path); raises :class:`StateStructureMismatchError` on digest mismatch;
+    True when single-process, unguarded, or verified.
+    """
+    if not callable(getattr(metric, "distributed_available_fn", None)) or not metric.distributed_available_fn():
+        return True
+    policy = metric.sync_policy
+    if policy is None and not metric.__dict__.get("_sync_policy_explicit"):
+        from torchmetrics_tpu._resilience.policy import default_sync_policy
+
+        policy = default_sync_policy()
+    if policy is None or not policy.handshake:
+        return True
+    return _handshake(metric, policy)
 
 
 # ---------------------------------------------------------------------------

@@ -388,6 +388,32 @@ class SnapshotManager:
         except Exception as err:  # noqa: BLE001 - durability must never break the stream
             self._disable(err)
 
+    def note_update(self, n: int = 1) -> None:
+        """Count ``n`` completed *opaque* updates without journaling them.
+
+        The SPMD engine's donated device states cannot be arg-journaled per
+        step — the batch lives sharded on-device, and a host copy per step
+        would reintroduce exactly the round-trip the fused path removes. The
+        engine reports step boundaries here instead: snapshots still fire
+        per policy (captured via host-side ``device_get`` through the
+        engine's ``state_dict``), and a restore returns to the newest
+        snapshot boundary, losing at most the steps since it — the
+        documented durability trade of the in-graph path (RESILIENCE.md).
+        """
+        if self._paused or self._replaying or self._disabled or self._closed:
+            return
+        try:
+            if self._journal_fh is None:
+                # first boundary: anchor the chain with a synchronous base
+                # snapshot, same contract as the first journaled update
+                self.snapshot_now(_inline=True)
+                return
+            self._updates_since += n
+            if self._snapshot_due():
+                self.snapshot_now()
+        except Exception as err:  # noqa: BLE001 - durability must never break the stream
+            self._disable(err)
+
     def _snapshot_due(self) -> bool:
         p = self.policy
         if self._journal_len >= p.journal_max_entries:

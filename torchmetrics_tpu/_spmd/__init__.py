@@ -1,0 +1,33 @@
+"""SPMD in-graph metric engine (README "SPMD engine", ROADMAP item 1).
+
+Metric states as sharded pytrees with explicit ``PartitionSpec``s over a
+named mesh; update + cross-device sync + compute lowered to ONE donated
+compiled step whose reductions come from each state's declared
+``dist_reduce_fx`` as in-graph collectives. Gated by the eligibility
+manifest's ``in_graph_sync`` facet; wrapped by the resilience handshake and
+degradation; observable through the telemetry registry; durable through the
+SnapshotManager's boundary ``device_get``.
+
+Entry points: :class:`SpmdEngine` (or the ``Metric.to_spmd()`` /
+``MetricCollection.to_spmd()`` conveniences).
+"""
+
+from torchmetrics_tpu._spmd.engine import SpmdEngine
+from torchmetrics_tpu._spmd.specs import (
+    COLLECTIVE_FOR,
+    InGraphSyncUnsupported,
+    build_mesh,
+    state_specs,
+    sync_plan,
+    validate_reductions,
+)
+
+__all__ = [
+    "COLLECTIVE_FOR",
+    "InGraphSyncUnsupported",
+    "SpmdEngine",
+    "build_mesh",
+    "state_specs",
+    "sync_plan",
+    "validate_reductions",
+]

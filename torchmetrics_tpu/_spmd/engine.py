@@ -1,0 +1,759 @@
+"""SPMD in-graph metric engine: one donated compiled update→sync→compute step.
+
+The eager runtime streams ``update()`` per process and bolts distributed
+sync on *after* accumulation — an eager multi-host gather guarded by
+``_resilience``. This engine is the TPU-native inversion for data-parallel
+streaming over a named device mesh:
+
+- **Sharded state pytrees.** Every registered state lives stacked: a
+  per-device value of shape ``(*s,)`` becomes one global ``(D, *s)`` array
+  sharded ``PartitionSpec(axis)`` (``specs.py``), so each device owns its
+  local accumulator row. Ring-buffer cat states stack their
+  ``data/valid/count`` leaves the same way.
+- **One donated compiled step.** ``step(batch)`` lowers update (on the
+  device's batch shard), cross-device sync (``sync_in_jit``: the declared
+  ``dist_reduce_fx`` of each state picked as an in-graph
+  ``psum``/``pmean``/``pmax``/``pmin``/``all_gather``), and ``compute`` into
+  a single ``jax.jit(shard_map(...), donate_argnums=(0,))`` executable. The
+  state buffers are donated — XLA updates them in place, and steady-state
+  streaming performs zero per-step host round-trips. The *carried* state
+  stays local (unsynced); the sync feeds only the returned value, so
+  accumulation semantics match the reference's sync/unsync dance.
+- **Eligibility-gated.** The compile-eligibility manifest's
+  ``in_graph_sync`` facet gates which classes may take this path
+  (host-bound classes keep the eager gather); ``"runtime"``-facet classes
+  are re-checked against the live instance's ``_reductions``.
+- **Resilience-wrapped.** The structure digest is checked once at trace
+  time (multi-host: through the guarded handshake), and any degradable
+  failure of the compiled step — an injected or real collective fault —
+  folds the device states back into the host metric and falls back to the
+  current eager guarded-sync path, recording a ``DegradationEvent``.
+- **Observable & durable.** ``update_calls|path=spmd`` counters and sampled
+  ``spmd_step`` latency reservoirs flow into the existing telemetry
+  registry; a :class:`~torchmetrics_tpu._resilience.snapshot.SnapshotManager`
+  attached to the engine snapshots the donated states via host-side
+  ``device_get`` at snapshot boundaries (``note_update``).
+
+``MetricCollection`` support fuses *compute groups* into the same single
+step: group heads update+sync once, members compute from the head's synced
+states in-graph.
+"""
+
+from __future__ import annotations
+
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec
+
+from torchmetrics_tpu._analysis.manifest import in_graph_sync_eligible
+from torchmetrics_tpu._observability.state import OBS as _OBS
+from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
+from torchmetrics_tpu._spmd import faultinject as _faultinject
+from torchmetrics_tpu._spmd.specs import (
+    InGraphSyncUnsupported,
+    build_mesh,
+    stack_default,
+    state_sharding,
+    state_specs,
+    validate_reductions,
+)
+from torchmetrics_tpu.utilities.distributed import shard_map, sync_in_jit
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
+
+__all__ = ["SpmdEngine"]
+
+# deterministic programming errors re-raise instead of degrading (degrading
+# would reduce a bug to a warning with silently-diverged results — the same
+# philosophy as the guard's _NON_RETRYABLE set, minus ValueError, which jax
+# trace machinery also uses for transient shape/sharding complaints)
+_FATAL = (TorchMetricsUserError, TypeError, AttributeError, NameError, KeyError, IndexError)
+
+
+def _is_array(x: Any) -> bool:
+    return isinstance(x, (jnp.ndarray, np.ndarray)) or (hasattr(x, "dtype") and hasattr(x, "shape"))
+
+
+@dataclass
+class _Unit:
+    """One fused-step participant: a metric (or compute-group head + members)."""
+
+    key: str  # "" for a bare metric; the head's collection key otherwise
+    metric: Any  # the head — its update runs, its states carry
+    members: List[Tuple[str, Any]] = field(default_factory=list)  # (name, metric) incl. head
+    names: List[str] = field(default_factory=list)
+    rings: Dict[str, int] = field(default_factory=dict)  # ring states -> capacity
+
+
+class SpmdEngine:
+    """Drive a Metric or MetricCollection as sharded state + one fused step.
+
+    The target must be fresh (``update_count == 0``): the engine owns the
+    stream from the first batch. ``step(*batch)`` consumes a *global* batch
+    whose array leaves carry a leading axis divisible by the mesh size, and
+    returns the globally-synced metric value for the stream so far.
+    """
+
+    def __init__(
+        self,
+        target: Any,
+        *,
+        mesh: Any = None,
+        axis_name: str = "dp",
+        donate: bool = True,
+        enforce_manifest: bool = True,
+    ) -> None:
+        from torchmetrics_tpu.collections import MetricCollection
+        from torchmetrics_tpu.metric import Metric
+
+        self._collection = target if isinstance(target, MetricCollection) else None
+        if self._collection is None and not isinstance(target, Metric):
+            raise InGraphSyncUnsupported(
+                f"SpmdEngine target must be a Metric or MetricCollection, got {type(target).__name__}"
+            )
+        self.target = target
+        self.axis_name = axis_name
+        self.mesh = mesh if mesh is not None else build_mesh(axis_name)
+        if self.axis_name not in self.mesh.axis_names:
+            raise InGraphSyncUnsupported(
+                f"axis {axis_name!r} not in mesh axes {self.mesh.axis_names}"
+            )
+        if len(self.mesh.axis_names) != 1:
+            raise InGraphSyncUnsupported(
+                "SpmdEngine shards states over a 1-D data-parallel mesh; build sub-meshes for"
+                " multi-axis layouts (tp/pp state sharding composes at the model level)"
+            )
+        self.donate = donate
+        self.world = int(self.mesh.shape[self.axis_name])
+        self._sharding = state_sharding(self.mesh, self.axis_name)
+        metrics = list(target._modules.values()) if self._collection is not None else [target]
+        for m in metrics:
+            facet = in_graph_sync_eligible(type(m))
+            if facet in ("host_bound", "unsupported") and enforce_manifest:
+                raise InGraphSyncUnsupported(
+                    f"{type(m).__name__} is certified `{facet}` by the eligibility manifest's"
+                    " in_graph_sync facet: it keeps the eager gather path"
+                    " (`Metric.sync`). Pass enforce_manifest=False only if you know the"
+                    " class traces and its reductions map onto in-graph collectives."
+                )
+            if facet == "unknown" and enforce_manifest:
+                raise InGraphSyncUnsupported(
+                    f"{type(m).__name__} is absent from the eligibility manifest (user"
+                    " subclass?); the in-graph path is certified per-class. Pass"
+                    " enforce_manifest=False to opt in without certification."
+                )
+            # the "runtime" facet (and defense-in-depth for "safe"): the live
+            # instance's declared reductions must map onto in-graph collectives
+            validate_reductions(m)
+            if m._update_count != 0:
+                raise InGraphSyncUnsupported(
+                    f"{type(m).__name__} has already accumulated {m._update_count} update(s);"
+                    " attach the SPMD engine to a fresh metric (the engine owns the stream)"
+                )
+        # lazy build state (first step learns ring shapes + compute groups)
+        self._units: Optional[List[_Unit]] = None
+        self._states: Optional[Dict[str, Dict[str, Any]]] = None
+        self._stacked_defaults: Optional[Dict[str, Dict[str, Any]]] = None
+        self._steps = 0
+        self._degraded = False
+        self._step_fns: Dict[Any, Any] = {}
+        self._compute_fn: Optional[Any] = None
+        # SnapshotManager target surface (populated at prepare)
+        self._defaults: Dict[str, Any] = {}
+        self._snapshot_hook: Optional[Any] = None
+
+    # ------------------------------------------------------------- properties
+    @property
+    def degraded(self) -> bool:
+        """True once the engine fell back to the eager guarded-sync path."""
+        return self._degraded
+
+    @property
+    def steps(self) -> int:
+        return self._steps
+
+    @property
+    def _update_count(self) -> int:  # SnapshotManager count-capture surface
+        return self._steps
+
+    @_update_count.setter
+    def _update_count(self, value: int) -> None:
+        self._steps = int(value)
+
+    # ------------------------------------------------------------------ step
+    def step(self, *args: Any, **kwargs: Any) -> Any:
+        """One fused update+sync+compute over the sharded batch.
+
+        Returns the globally-synced value (a dict keyed like
+        ``MetricCollection.compute()`` for collections). In degraded mode
+        this is ``target.update(batch); target.compute()`` — the eager
+        guarded-sync path the engine replaced.
+        """
+        if self._degraded:
+            return self._eager_step(args, kwargs)
+        if self._units is None:
+            self._prepare(args, kwargs)
+            if self._degraded:  # trace-time handshake degraded the transport
+                return self._eager_step(args, kwargs)
+        from torchmetrics_tpu.metric import Metric
+
+        treedef, dynamic, statics = Metric._split_batch_args("spmd_step", args, kwargs)
+        if not dynamic:
+            raise TorchMetricsUserError("`step` needs at least one array argument to shard")
+        for leaf in dynamic:
+            if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] % self.world:
+                raise TorchMetricsUserError(
+                    f"every array argument must carry a leading batch axis divisible by the"
+                    f" mesh size ({self.world}); got shape {getattr(leaf, 'shape', ())}"
+                )
+        sig = (treedef, statics, tuple((tuple(d.shape), str(d.dtype)) for d in dynamic))
+        key = (sig, tuple(
+            None if u.metric._dtype_policy is None else jnp.dtype(u.metric._dtype_policy).name
+            for u in self._units
+        ))
+        fn = self._step_fns.get(key)
+        built = fn is None
+        if built:
+            fn = self._build_step(treedef, statics, len(dynamic))
+            if _OBS.enabled:
+                # first call = trace+lower+execute: time it once, then the
+                # shim self-replaces under this cache key (same contract as
+                # Metric._compiled_update)
+                fn = self._units[0].metric._obs_timed_first_call(self._step_fns, key, fn)
+            self._step_fns[key] = fn
+        obs_sample = False
+        t0 = 0.0
+        if _OBS.enabled:
+            telem = _telemetry_for(self.target)
+            if built:
+                self._units[0].metric._obs_compile_event("spmd_step", treedef, statics, sig[2])
+            obs_sample = telem.sample_due("spmd_step")
+            if obs_sample:
+                t0 = time.perf_counter()
+        try:
+            new_states, value = _faultinject.dispatch(fn, self._states, dynamic)
+        except jax.errors.JAXTypeError as err:
+            # trace-time concretization/tracer-leak failures (a compute body
+            # the facet could only certify "runtime") are not programming
+            # errors in the CALLER: fall back to the eager path the class
+            # would have kept without the engine
+            self._degrade(f"fused step does not trace: {type(err).__name__}: {err}")
+            return self._eager_step(args, kwargs)
+        except _FATAL:
+            raise
+        except Exception as err:  # noqa: BLE001 - collective/backend faults degrade
+            self._degrade(f"fused step failed: {type(err).__name__}: {err}")
+            return self._eager_step(args, kwargs)
+        self._states = new_states
+        self._steps += 1
+        if _OBS.enabled:
+            telem = _telemetry_for(self.target)
+            telem.inc("update_calls|path=spmd")
+            if obs_sample:
+                telem.observe("spmd_step", time.perf_counter() - t0)
+        hook = self.__dict__.get("_snapshot_hook")
+        if hook is not None:
+            hook.note_update()
+        if self._collection is not None:
+            return self._collection._flatten_results(value)
+        return value
+
+    def compute(self) -> Any:
+        """Sync+compute on the current sharded states (no update, no donation)."""
+        if self._degraded or self._units is None:
+            return self.target.compute()
+        if self._compute_fn is None:
+            self._compute_fn = self._build_compute()
+        try:
+            value = _faultinject.dispatch(self._compute_fn, self._states)
+        except _FATAL:
+            raise
+        except Exception as err:  # noqa: BLE001
+            self._degrade(f"fused compute failed: {type(err).__name__}: {err}")
+            return self.target.compute()
+        if self._collection is not None:
+            return self._collection._flatten_results(value)
+        return value
+
+    def reset(self) -> None:
+        """Reset sharded states (and the host target) to defaults."""
+        self._steps = 0
+        if self._units is not None and self._stacked_defaults is not None:
+            self._states = jax.tree_util.tree_map(
+                lambda d: jax.device_put(d, self._sharding), self._stacked_defaults
+            )
+        self.target.reset()
+
+    # ------------------------------------------------------------ degradation
+    def _degrade(self, detail: str) -> None:
+        """Fold device states into the host target; future steps go eager.
+
+        The fold merges each state's per-device rows with its own declared
+        reduction — exactly what a successful sync would have produced — so
+        the eager stream resumes without losing a batch. One fault class
+        cannot fold: an EXECUTE-time failure of the donated step has already
+        consumed the input buffers (donation deletes them whether or not the
+        executable completed), so there is nothing left to read back. The
+        stream then restarts from defaults, says so in the degradation
+        event, and points at the SnapshotManager — whose boundary
+        ``device_get`` snapshots exist precisely to bound this loss.
+        """
+        folded = False
+        if self._units is not None and self._states is not None:
+            leaves = jax.tree_util.tree_leaves(self._states)
+            consumed = any(
+                leaf.is_deleted() for leaf in leaves if hasattr(leaf, "is_deleted")
+            )
+            if consumed:
+                detail += (
+                    f"; the failed step had already consumed the donated state buffers —"
+                    f" {self._steps} fused step(s) of accumulation are lost and the eager"
+                    " stream restarts from defaults (an attached SnapshotManager bounds"
+                    " this: restore_latest() returns to the newest snapshot boundary)"
+                )
+                self._steps = 0
+            else:
+                try:
+                    for unit in self._units:
+                        self._fold_unit_to_host(unit)
+                    if self._collection is not None:
+                        self._collection._sync_compute_groups()
+                    folded = True
+                except Exception as fold_err:  # noqa: BLE001 - degrade must never crash
+                    detail += (
+                        f"; folding device states back failed too"
+                        f" ({type(fold_err).__name__}: {fold_err}) — the eager stream"
+                        " restarts from defaults"
+                    )
+                    self._steps = 0
+        hook = self.__dict__.get("_snapshot_hook")
+        if hook is not None:
+            # the manager snapshots THROUGH the engine's state_dict, which
+            # needs live device states: capture one final boundary while they
+            # exist, then pause — the eager continuation is outside the
+            # engine-targeted manager's reach, and that must be said, not
+            # discovered at restore time
+            if folded:
+                try:
+                    hook.snapshot_now(_inline=True)
+                except Exception:  # noqa: BLE001 - durability must not break the degrade
+                    pass
+            hook.pause()
+            detail += (
+                "; the attached SnapshotManager captured a final boundary snapshot and"
+                " was PAUSED (it snapshots the fused device states, which no longer"
+                " exist) — attach a manager to the target metric for eager-path"
+                " durability"
+                if folded
+                else "; the attached SnapshotManager was PAUSED (no device states left"
+                " to snapshot) — attach a manager to the target metric for eager-path"
+                " durability"
+            )
+        self._degraded = True
+        self._states = None
+        self._step_fns.clear()
+        self._compute_fn = None
+        primary = self._units[0].metric if self._units else (
+            next(iter(self.target._modules.values())) if self._collection is not None else self.target
+        )
+        primary._record_degradation("spmd_degraded", detail=f"{detail}; falling back to the eager guarded sync path")
+
+    def _eager_step(self, args: tuple, kwargs: Dict[str, Any]) -> Any:
+        self.target.update(*args, **kwargs)
+        self._steps += 1
+        return self.target.compute()
+
+    def _fold_unit_to_host(self, unit: _Unit) -> None:
+        m = unit.metric
+        states = self._states[unit.key]
+        for n in unit.names:
+            red = m._reductions[n]
+            if n in unit.rings:
+                st = jax.device_get(states[n])
+                # world-capacity buffer, matching what sync_in_jit's
+                # all_gather produces — folding world*cap rows into a
+                # cap-sized ring would silently drop all but 1/world of them
+                rb = RingBuffer(unit.rings[n] * self.world)
+                for d in range(self.world):
+                    rows = np.asarray(st["data"][d])[np.asarray(st["valid"][d])]
+                    if rows.shape[0]:
+                        rb.append(jnp.asarray(rows))
+                object.__setattr__(m, n, rb)
+                continue
+            stacked = np.asarray(jax.device_get(states[n]))
+            if red == "sum":
+                merged = stacked.sum(axis=0)
+            elif red == "mean":
+                merged = stacked.mean(axis=0)
+            elif red == "max":
+                merged = stacked.max(axis=0)
+            else:  # "min" — validate_reductions admitted nothing else
+                merged = stacked.min(axis=0)
+            object.__setattr__(m, n, jnp.asarray(merged))
+        m._update_count = self._steps * self.world
+        m._computed = None
+
+    def sync_to_target(self) -> Any:
+        """Populate the host target from the device states (reduction-merged).
+
+        A host-side escape hatch (one ``device_get`` per state): after it,
+        ``target.compute()``/``state_dict()`` observe the stream so far. The
+        engine keeps streaming on its device states — this is a read, not a
+        hand-over.
+        """
+        if self._units is not None and self._states is not None:
+            for unit in self._units:
+                self._fold_unit_to_host(unit)
+            if self._collection is not None:
+                self._collection._sync_compute_groups()
+        return self.target
+
+    # ----------------------------------------------------------- preparation
+    def _prepare(self, args: tuple, kwargs: Dict[str, Any]) -> None:
+        from copy import deepcopy
+
+        probe = None
+        if self._collection is not None or any(
+            isinstance(getattr(m, n), RingBuffer)
+            for m in ([self.target] if self._collection is None else self.target._modules.values())
+            for n in m._defaults
+        ):
+            # one shard-sized eager probe on a throwaway clone: learns ring
+            # row shapes, and for collections forms the compute groups the
+            # fused step shares (group detection needs post-update states)
+            probe = deepcopy(self.target)
+            shard_args, shard_kwargs = jax.tree_util.tree_map(
+                lambda x: x[: max(1, x.shape[0] // self.world)] if _is_array(x) else x,
+                (args, kwargs),
+            )
+            probe.update(*shard_args, **shard_kwargs)
+
+        units: List[_Unit] = []
+        if self._collection is not None:
+            groups = probe._groups  # formed by the probe update
+            # adopt the probe's grouping: heads drive the fused step, members
+            # rebind from their head at fold boundaries
+            self._collection._groups = {i: list(g) for i, g in groups.items()}
+            self._collection._groups_checked = True
+            for g in groups.values():
+                head_key = g[0]
+                head = self.target._modules[head_key]
+                members = [(name, self.target._modules[name]) for name in g]
+                units.append(self._make_unit(head_key, head, members, probe._modules[head_key]))
+        else:
+            units.append(self._make_unit("", self.target, [("", self.target)], probe))
+
+        # resilience: structure digest checked once, at trace time
+        self._handshake_at_trace(units)
+        if self._degraded:
+            return
+
+        self._units = units
+
+        def ring_default(unit: _Unit, n: str) -> Dict[str, Any]:
+            row_shape, row_dtype = unit.ring_rows[n]  # learned from the probe
+            cap = unit.rings[n]
+            return {
+                "data": np.zeros((self.world, cap, *row_shape), row_dtype),
+                "valid": np.zeros((self.world, cap), bool),
+                "count": np.zeros((self.world,), np.int32),
+            }
+
+        self._install_stacked_defaults(units, ring_default)
+        self._states = jax.tree_util.tree_map(
+            lambda d: jax.device_put(d, self._sharding), self._stacked_defaults
+        )
+
+    def _install_stacked_defaults(self, units: List[_Unit], ring_default: Any) -> None:
+        """Build ``_stacked_defaults`` + the flat ``_defaults`` mirror.
+
+        ``ring_default(unit, name)`` supplies one ring state's stacked
+        zero-leaves — row shapes come from the probe on the fresh path and
+        from the restored leaves on the restore path; everything else is
+        identical and must STAY identical (a layout change in one path would
+        make snapshot restore silently diverge from the fresh stream).
+        """
+        self._stacked_defaults = {}
+        self._defaults = {}
+        for unit in units:
+            defaults: Dict[str, Any] = {}
+            for n in unit.names:
+                if n in unit.rings:
+                    defaults[n] = ring_default(unit, n)
+                else:
+                    defaults[n] = stack_default(unit.metric._defaults[n], self.world)
+            self._stacked_defaults[unit.key] = defaults
+            pre = f"{unit.key}." if unit.key else ""
+            for n in unit.names:
+                if n in unit.rings:
+                    for part in ("data", "valid", "count"):
+                        self._defaults[f"{pre}{n}#{part}"] = defaults[n][part]
+                else:
+                    self._defaults[f"{pre}{n}"] = defaults[n]
+
+    def _make_unit(self, key: str, metric: Any, members: List[Tuple[str, Any]], probe: Any) -> _Unit:
+        names = list(metric._defaults)
+        rings: Dict[str, int] = {}
+        ring_rows: Dict[str, Tuple[tuple, Any]] = {}
+        for n in names:
+            state = getattr(metric, n)
+            if isinstance(state, RingBuffer):
+                rings[n] = state.capacity
+                warmed = getattr(probe, n) if probe is not None else None
+                if warmed is None or not isinstance(warmed, RingBuffer) or not warmed.initialized:
+                    raise TorchMetricsUserError(
+                        f"ring state `{n}` row shape could not be learned from the first batch"
+                    )
+                ring_rows[n] = (tuple(int(s) for s in warmed.data.shape[1:]), warmed.data.dtype)
+        unit = _Unit(key=key, metric=metric, members=members, names=names, rings=rings)
+        unit.ring_rows = ring_rows  # type: ignore[attr-defined]
+        return unit
+
+    def _handshake_at_trace(self, units: List[_Unit]) -> None:
+        from torchmetrics_tpu._resilience.guard import handshake_at_trace
+
+        for unit in units:
+            if not handshake_at_trace(unit.metric):
+                # transport degraded during the handshake: never compile —
+                # the eager guarded path owns the stream from the start
+                self._degrade("trace-time structure handshake degraded")
+                return
+
+    # ----------------------------------------------------------- compilation
+    def _traced_unit_step(self, unit: _Unit, states: Dict[str, Any], a: tuple, kw: Dict[str, Any]):
+        """(new local states, per-member values) for one unit, under trace."""
+        from torchmetrics_tpu.metric import _squeeze_if_scalar
+
+        m = unit.metric
+        local = {}
+        for n in unit.names:
+            if n in unit.rings:
+                s = states[n]
+                local[n] = RingBuffer(
+                    unit.rings[n], _data=s["data"][0], _valid=s["valid"][0], _count=s["count"][0]
+                )
+            else:
+                local[n] = states[n][0]
+        kw_m = m._filter_kwargs(**kw) if kw else kw
+        new_local = m._traced_update(unit.names, local, a, kw_m)
+        synced = sync_in_jit(
+            {n: new_local[n] for n in unit.names},
+            {n: m._reductions[n] for n in unit.names},
+            self.axis_name,
+        )
+        values = {}
+        for name, member in unit.members:
+            values[name] = _squeeze_if_scalar(member._traced_compute(unit.names, synced))
+        out_states: Dict[str, Any] = {}
+        for n in unit.names:
+            v = new_local[n]
+            if isinstance(v, RingBuffer):
+                out_states[n] = {"data": v.data[None], "valid": v.valid[None], "count": v.count[None]}
+            else:
+                out_states[n] = v[None]
+        return out_states, values
+
+    def _build_step(self, treedef: Any, statics: Any, n_dyn: int):
+        from torchmetrics_tpu.metric import Metric
+
+        units = self._units
+
+        def local_step(states, dyn):
+            a, kw = Metric._merge_batch_args(treedef, list(dyn), statics)
+            new_states: Dict[str, Dict[str, Any]] = {}
+            values: Dict[str, Any] = {}
+            for unit in units:
+                out, vals = self._traced_unit_step(unit, states[unit.key], a, kw)
+                new_states[unit.key] = out
+                if self._collection is None:
+                    values = vals[""]
+                else:
+                    values.update(vals)
+            return new_states, values
+
+        specs = {u.key: state_specs(u.names, self.axis_name) for u in units}
+        dyn_specs = [PartitionSpec(self.axis_name) for _ in range(n_dyn)]
+        mapped = shard_map(
+            local_step,
+            mesh=self.mesh,
+            in_specs=(specs, dyn_specs),
+            out_specs=(specs, PartitionSpec()),
+            check_vma=False,
+        )
+        return jax.jit(mapped, donate_argnums=(0,) if self.donate else ())
+
+    def _build_compute(self):
+        from torchmetrics_tpu.metric import _squeeze_if_scalar
+
+        units = self._units
+
+        def local_compute(states):
+            values: Dict[str, Any] = {}
+            for unit in units:
+                m = unit.metric
+                local = {}
+                for n in unit.names:
+                    if n in unit.rings:
+                        s = states[unit.key][n]
+                        local[n] = RingBuffer(
+                            unit.rings[n], _data=s["data"][0], _valid=s["valid"][0], _count=s["count"][0]
+                        )
+                    else:
+                        local[n] = states[unit.key][n][0]
+                synced = sync_in_jit(
+                    local, {n: m._reductions[n] for n in unit.names}, self.axis_name
+                )
+                for name, member in unit.members:
+                    values[name] = _squeeze_if_scalar(member._traced_compute(unit.names, synced))
+            if self._collection is None:
+                return values[""]
+            return values
+
+        specs = {u.key: state_specs(u.names, self.axis_name) for u in units}
+        return jax.jit(
+            shard_map(
+                local_compute,
+                mesh=self.mesh,
+                in_specs=(specs,),
+                out_specs=PartitionSpec(),
+                check_vma=False,
+            )
+        )
+
+    # ----------------------------------------------- snapshot/restore surface
+    def state_dict(
+        self,
+        destination: Optional[Dict] = None,
+        prefix: str = "",
+        keep_vars: bool = False,
+        integrity: bool = False,
+        all_states: bool = False,
+    ) -> Dict:
+        """Host-numpy copy of the donated device states (``device_get``).
+
+        The SnapshotManager calls this at snapshot boundaries; between
+        boundaries the states never leave the device. The reserved
+        ``{prefix}#spmd`` block records the mesh/unit skeleton so a fresh
+        engine (same mesh size) can restore without having seen a batch.
+        """
+        if self._units is None or self._states is None:
+            raise TorchMetricsUserError(
+                "SpmdEngine has no device states yet (no step() has run)"
+            )
+        destination = {} if destination is None else destination
+        keys: List[str] = []
+        for unit in self._units:
+            pre = f"{unit.key}." if unit.key else ""
+            states = self._states[unit.key]
+            for n in unit.names:
+                if n in unit.rings:
+                    st = jax.device_get(states[n])
+                    for part in ("data", "valid", "count"):
+                        k = f"{pre}{n}#{part}"
+                        destination[prefix + k] = np.asarray(st[part])
+                        keys.append(k)
+                else:
+                    k = f"{pre}{n}"
+                    destination[prefix + k] = np.asarray(jax.device_get(states[n]))
+                    keys.append(k)
+        destination[prefix + "#spmd"] = {
+            "world": self.world,
+            "axis": self.axis_name,
+            "units": [
+                {
+                    "key": u.key,
+                    "members": [name for name, _ in u.members],
+                    "names": list(u.names),
+                    "rings": dict(u.rings),
+                }
+                for u in self._units
+            ],
+        }
+        if integrity:
+            from torchmetrics_tpu._resilience.integrity import attach_integrity
+
+            attach_integrity(destination, keys, prefix, type(self).__name__)
+        return destination
+
+    def load_state_dict(self, state_dict: Dict, strict: Any = True, prefix: str = "") -> None:
+        """Re-place checkpointed stacked states onto the mesh (same world size)."""
+        from torchmetrics_tpu._resilience import integrity as _integrity
+
+        meta = state_dict.get(_integrity.integrity_key(prefix))
+        if meta is not None:
+            corrupted = _integrity.verify_states(
+                state_dict, prefix, meta, type(self).__name__, include_missing=strict is not False
+            )
+            if corrupted:
+                _integrity.raise_corrupted(type(self).__name__, corrupted)
+        blk = state_dict.get(prefix + "#spmd")
+        if blk is None:
+            raise TorchMetricsUserError("checkpoint lacks the `#spmd` block (not an SpmdEngine snapshot)")
+        if int(blk["world"]) != self.world or blk["axis"] != self.axis_name:
+            raise TorchMetricsUserError(
+                f"snapshot was taken on a {blk['world']}-device `{blk['axis']}` mesh; this engine"
+                f" runs {self.world}-device `{self.axis_name}` — donated states restore only onto"
+                " an identical mesh layout"
+            )
+        if self._units is None:
+            self._rebuild_units(blk)
+        states: Dict[str, Dict[str, Any]] = {}
+        for unit in self._units:
+            pre = f"{unit.key}." if unit.key else ""
+            ustates: Dict[str, Any] = {}
+            for n in unit.names:
+                if n in unit.rings:
+                    ustates[n] = {
+                        part: jax.device_put(
+                            jnp.asarray(state_dict[f"{prefix}{pre}{n}#{part}"]), self._sharding
+                        )
+                        for part in ("data", "valid", "count")
+                    }
+                else:
+                    ustates[n] = jax.device_put(
+                        jnp.asarray(state_dict[f"{prefix}{pre}{n}"]), self._sharding
+                    )
+            states[unit.key] = ustates
+        self._states = states
+        if self._stacked_defaults is None:
+            # a pre-first-batch restore skipped _prepare: derive the stacked
+            # defaults now (plain states from the metric's registered
+            # defaults, ring shapes from the restored leaves) so reset()
+            # has something to reset TO
+
+            def ring_default(unit: _Unit, n: str) -> Dict[str, Any]:
+                data = np.asarray(jax.device_get(states[unit.key][n]["data"]))
+                return {
+                    "data": np.zeros_like(data),
+                    "valid": np.zeros(data.shape[:2], bool),
+                    "count": np.zeros((self.world,), np.int32),
+                }
+
+            self._install_stacked_defaults(self._units, ring_default)
+
+    def _rebuild_units(self, blk: Dict[str, Any]) -> None:
+        """Unit skeleton from a checkpoint's ``#spmd`` block (pre-first-batch restore)."""
+        units: List[_Unit] = []
+        for u in blk["units"]:
+            key = u["key"]
+            metric = self.target._modules[key] if self._collection is not None else self.target
+            members = (
+                [(name, self.target._modules[name]) for name in u["members"]]
+                if self._collection is not None
+                else [("", self.target)]
+            )
+            units.append(
+                _Unit(key=key, metric=metric, members=members, names=list(u["names"]), rings=dict(u["rings"]))
+            )
+        if self._collection is not None:
+            self._collection._groups = {i: list(u["members"]) for i, u in enumerate(blk["units"])}
+            self._collection._groups_checked = True
+        self._units = units
+        # stacked defaults are derived by load_state_dict once the restored
+        # leaves are in hand (ring row shapes come from them)
+        self._stacked_defaults = None

@@ -1,0 +1,58 @@
+"""Fault injection for the compiled SPMD step (test/chaos harness).
+
+In-graph collectives cannot be reached through the eager transport seam
+(``utilities.distributed._transport``) — they are burned into the XLA
+executable. The dispatch seam here is the compiled-path analogue: every
+fused-step execution flows through :func:`dispatch`, so tests can make the
+*step itself* fail the way a dying ICI fabric or evicted backend does
+(``XlaRuntimeError`` out of a dispatched executable) and assert the engine's
+degradation contract without needing real hardware faults.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Callable, Iterator, Optional
+
+__all__ = ["dispatch", "inject_step_failure"]
+
+# None = healthy; otherwise a zero-arg callable invoked before every
+# dispatch — it raises to simulate the failure
+_failure: Optional[Callable[[], None]] = None
+
+
+def dispatch(fn: Callable, *args: Any) -> Any:
+    """Execute one compiled step through the patchable seam."""
+    if _failure is not None:
+        _failure()
+    return fn(*args)
+
+
+@contextlib.contextmanager
+def inject_step_failure(
+    exc_factory: Optional[Callable[[], BaseException]] = None,
+    times: Optional[int] = None,
+) -> Iterator[None]:
+    """Make fused-step dispatches raise while the context is active.
+
+    ``times`` bounds how many dispatches fail (None = all of them); the
+    default exception models an XLA runtime fault (a ``RuntimeError``, which
+    the engine treats as degradable — programming errors are not).
+    """
+    make = exc_factory or (lambda: RuntimeError("injected in-graph collective failure"))
+    remaining = [times]
+
+    def fail() -> None:
+        if remaining[0] is not None:
+            if remaining[0] <= 0:
+                return
+            remaining[0] -= 1
+        raise make()
+
+    global _failure
+    prev = _failure
+    _failure = fail
+    try:
+        yield
+    finally:
+        _failure = prev

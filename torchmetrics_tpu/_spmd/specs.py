@@ -1,0 +1,128 @@
+"""PartitionSpec plumbing for sharded metric-state pytrees.
+
+The SPMD engine (``engine.py``) stores every metric state *stacked*: a state
+whose per-device value has shape ``(*s,)`` lives as one global ``(D, *s)``
+array sharded ``PartitionSpec(axis_name)`` over a named 1-D mesh — each
+device owns exactly its row, which is its local accumulator. Ring-buffer
+("cat") states stack the same way as a ``{"data", "valid", "count"}`` leaf
+dict. This module derives those specs, the per-state collective plan the
+fused step's in-graph sync uses, and validates that a live metric's declared
+reductions map onto in-graph collectives at all.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec
+
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
+
+__all__ = [
+    "COLLECTIVE_FOR",
+    "InGraphSyncUnsupported",
+    "build_mesh",
+    "state_specs",
+    "state_sharding",
+    "stack_default",
+    "sync_plan",
+    "validate_reductions",
+]
+
+
+class InGraphSyncUnsupported(TorchMetricsUserError):
+    """The metric cannot take the fused in-graph sync path.
+
+    Raised at engine construction — never mid-stream — so callers keep the
+    eager gather path (``Metric.sync``) with zero state committed.
+    """
+
+
+# reduction kind -> the XLA collective the fused step lowers it to; the
+# actual lowering lives in ``utilities.distributed.sync_in_jit`` — this map
+# is the declarative contract tests assert against
+COLLECTIVE_FOR: Dict[str, str] = {
+    "sum": "psum",
+    "mean": "pmean",
+    "max": "pmax",
+    "min": "pmin",
+    "cat": "all_gather",
+}
+
+
+def build_mesh(axis_name: str = "dp", devices: Optional[Sequence[Any]] = None) -> Mesh:
+    """A 1-D named mesh over ``devices`` (default: every local device)."""
+    devs = list(devices) if devices is not None else list(jax.devices())
+    if not devs:
+        raise InGraphSyncUnsupported("no devices available to build a mesh over")
+    return Mesh(np.array(devs), (axis_name,))
+
+
+def state_specs(names: Sequence[str], axis_name: str) -> Dict[str, PartitionSpec]:
+    """Stacked-layout specs: the leading device axis shards over ``axis_name``.
+
+    A :class:`PartitionSpec` is a valid tree *prefix*, so the same spec
+    covers a plain stacked array and a ring state's ``{data, valid, count}``
+    leaf dict (every leaf carries the stacked device axis first).
+    """
+    return {name: PartitionSpec(axis_name) for name in names}
+
+
+def state_sharding(mesh: Mesh, axis_name: str) -> NamedSharding:
+    return NamedSharding(mesh, PartitionSpec(axis_name))
+
+
+def stack_default(default: Any, world: int) -> np.ndarray:
+    """Host ``(world, *shape)`` stack of one per-device default value."""
+    base = np.asarray(default)
+    return np.broadcast_to(base[None], (world, *base.shape)).copy()
+
+
+def sync_plan(reductions: Dict[str, Any]) -> Dict[str, str]:
+    """``state -> collective`` plan for a metric's declared reductions.
+
+    Raises :class:`InGraphSyncUnsupported` (listing every offending state)
+    when any reduction has no in-graph collective. This is the runtime twin
+    of the manifest's ``in_graph_sync`` facet: the facet proves it statically
+    where it can; this check decides the ``"runtime"``-facet classes from the
+    live instance.
+    """
+    plan: Dict[str, str] = {}
+    bad: List[str] = []
+    for name, red in reductions.items():
+        if isinstance(red, str) and red in COLLECTIVE_FOR:
+            plan[name] = COLLECTIVE_FOR[red]
+        else:
+            desc = red if isinstance(red, str) or red is None else f"callable:{getattr(red, '__name__', 'fn')}"
+            bad.append(f"`{name}` (dist_reduce_fx={desc!r})")
+    if bad:
+        raise InGraphSyncUnsupported(
+            "These states declare reductions with no in-graph collective semantics: "
+            + ", ".join(sorted(bad))
+            + ". The fused SPMD step supports sum/mean/max/min (psum/pmean/pmax/pmin) and"
+            " ring-buffer cat states (all_gather); keep the eager gather path for the rest."
+        )
+    return plan
+
+
+def validate_reductions(metric: Any) -> Dict[str, str]:
+    """Validate a live metric's states for the fused step; return the plan.
+
+    Beyond reduction kinds, array states with ``dist_reduce_fx="cat"`` are
+    rejected unless they are ring buffers: a growing concatenated carry
+    changes shape every step, which would retrace the step per batch —
+    exactly the pathology ``cat_state_capacity`` exists to bound.
+    """
+    plan = sync_plan(dict(metric._reductions))
+    for name, red in metric._reductions.items():
+        value = getattr(metric, name)
+        if red == "cat" and not isinstance(value, RingBuffer):
+            raise InGraphSyncUnsupported(
+                f"state `{name}` is an unbounded cat state; its carried shape would grow"
+                " every fused step (one recompile per batch). Construct the metric with"
+                " `cat_state_capacity=N` to bound it into a ring buffer."
+            )
+    return plan

@@ -391,6 +391,19 @@ class MetricCollection:
 
         return TelemetryReport.merged(list(reports.values()), name="MetricCollection")
 
+    def to_spmd(self, *, mesh: Any = None, axis_name: str = "dp", **kwargs: Any) -> Any:
+        """Hand the (fresh) collection to the SPMD in-graph engine.
+
+        Compute groups share ONE fused step: each group's head updates and
+        syncs once in-graph, every member computes from the head's synced
+        states inside the same executable, and ``step()`` returns a dict
+        keyed like :meth:`compute`. Every member class must pass the
+        eligibility manifest's ``in_graph_sync`` gate.
+        """
+        from torchmetrics_tpu._spmd import SpmdEngine
+
+        return SpmdEngine(self, mesh=mesh, axis_name=axis_name, **kwargs)
+
     def set_dtype(self, dst_type: Any) -> "MetricCollection":
         for m in self._modules.values():
             m.set_dtype(dst_type)

@@ -173,14 +173,22 @@ def _union_lcs(pred_tokens_list: Sequence[Sequence[str]], target_tokens: Sequenc
     return [target_tokens[i] for i in indices]
 
 
+# corpus scoring calls this twice per sample: precompiled pattern + C-level
+# whitespace split (str.split drops empties, so the default path skips the
+# per-token filter entirely) measurably move the samples/sec bench line
+_NON_ALNUM = re.compile(r"[^a-z0-9]+")
+
+
 def _normalize_and_tokenize_text(
     text: str,
     stemmer: Optional[Any] = None,
     normalizer: Optional[Callable[[str], str]] = None,
     tokenizer: Optional[Callable[[str], Sequence[str]]] = None,
 ) -> Sequence[str]:
-    text = normalizer(text) if callable(normalizer) else re.sub(r"[^a-z0-9]+", " ", text.lower())
-    tokens = tokenizer(text) if callable(tokenizer) else re.split(r"\s+", text)
+    if normalizer is None and tokenizer is None and stemmer is None:
+        return _NON_ALNUM.sub(" ", text.lower()).split()
+    text = normalizer(text) if callable(normalizer) else _NON_ALNUM.sub(" ", text.lower())
+    tokens = tokenizer(text) if callable(tokenizer) else text.split()
     if stemmer:
         tokens = [stemmer.stem(x) if len(x) > 3 else x for x in tokens]
     return [x for x in tokens if (isinstance(x, str) and len(x) > 0)]
@@ -194,13 +202,24 @@ def _create_ngrams(tokens: Sequence[str], n: int) -> Counter:
 
 
 def _rouge_n_score(pred: Sequence[str], target: Sequence[str], n_gram: int) -> Dict[str, float]:
-    pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
-    pred_len, target_len = sum(pred_ngrams.values()), sum(target_ngrams.values())
+    # ngram counts are exactly len - n + 1 (clamped), so the totals need no
+    # Counter pass at all
+    pred_len = max(0, len(pred) - n_gram + 1)
+    target_len = max(0, len(target) - n_gram + 1)
     if 0 in (pred_len, target_len):
         return {"precision": 0.0, "recall": 0.0, "fmeasure": 0.0}
-    # clipped hits = multiset intersection, computed in C by Counter.__and__
-    hits = sum((pred_ngrams & target_ngrams).values())
-    return _compute_metrics(hits, max(pred_len, 1), max(target_len, 1))
+    pred_ngrams, target_ngrams = _create_ngrams(pred, n_gram), _create_ngrams(target, n_gram)
+    # clipped hits = multiset intersection; summing min-counts over the
+    # smaller counter beats Counter.__and__ (which allocates a third Counter)
+    if len(target_ngrams) < len(pred_ngrams):
+        pred_ngrams, target_ngrams = target_ngrams, pred_ngrams
+    get = target_ngrams.get
+    hits = 0
+    for gram, count in pred_ngrams.items():
+        other = get(gram, 0)
+        if other:
+            hits += count if count < other else other
+    return _compute_metrics(hits, pred_len, target_len)
 
 
 def _rouge_l_score(

@@ -975,6 +975,22 @@ class Metric(ABC):
             {"should_unsync": should_unsync},
         )
 
+    def to_spmd(self, *, mesh: Any = None, axis_name: str = "dp", **kwargs: Any) -> Any:
+        """Hand this (fresh) metric to the SPMD in-graph engine.
+
+        Returns a :class:`~torchmetrics_tpu._spmd.SpmdEngine` whose
+        ``step(batch)`` lowers update + cross-device sync + compute into one
+        donated compiled executable over a named device mesh — the
+        TPU-native replacement for streaming ``update()`` and bolting an
+        eager multi-host gather on afterwards. Gated by the eligibility
+        manifest's ``in_graph_sync`` facet: host-bound classes raise
+        :class:`~torchmetrics_tpu._spmd.InGraphSyncUnsupported` and keep the
+        eager path. See README "SPMD in-graph engine".
+        """
+        from torchmetrics_tpu._spmd import SpmdEngine
+
+        return SpmdEngine(self, mesh=mesh, axis_name=axis_name, **kwargs)
+
     def sync_in_jit(
         self,
         state: Dict[str, Array],

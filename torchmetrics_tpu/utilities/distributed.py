@@ -20,6 +20,7 @@ from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
 
@@ -89,11 +90,109 @@ def class_reduce(
 _world_override: Optional[int] = None  # simulated world size (None = real)
 _transport: Optional[Callable[[Any], Any]] = None  # transport override (None = real)
 
+# XLA's process_allgather lowers to a jitted computation over a global mesh,
+# which the CPU backend rejects outright ("Multiprocess computations aren't
+# implemented on the CPU backend"). Multi-process CPU worlds are exactly what
+# tests and local dev clusters run, so the transport falls back to the
+# distributed coordination service's KV store — the control-plane channel
+# `jax.distributed.initialize` already established. The decision is cached:
+# the probe failure is deterministic per backend, so every process flips
+# together and collective ordering stays symmetric.
+_kv_fallback: Optional[bool] = None
+_kv_seq = 0
+
+
+def _kv_timeout_ms() -> int:
+    import os
+
+    try:
+        return int(os.environ.get("TM_TPU_KV_GATHER_TIMEOUT_MS", "120000"))
+    except ValueError:
+        return 120000
+
+
+def _kv_allgather_leaf(x: Any) -> Any:
+    """All-gather one host array through the coordination-service KV store.
+
+    Protocol per call: publish this process's shard bytes under a sequenced
+    key, blocking-read every peer's, barrier (so no peer deletes a key
+    before everyone read it), then delete own key so a long-running stream
+    cannot grow the coordinator's memory without bound. Callers issue
+    gathers in the same order on every process (the same property the XLA
+    collective needs), so the per-process sequence numbers agree.
+    """
+    import io
+
+    from jax._src import distributed
+
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "KV-store allgather fallback needs jax.distributed.initialize() (no coordination client)"
+        )
+    global _kv_seq
+    seq = _kv_seq
+    _kv_seq += 1
+    pid, nproc = jax.process_index(), jax.process_count()
+    base = f"tm_tpu/allgather/{seq}"
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(x), allow_pickle=False)
+    client.key_value_set_bytes(f"{base}/{pid}", buf.getvalue())
+    timeout = _kv_timeout_ms()
+    try:
+        shards = []
+        for i in range(nproc):
+            raw = client.blocking_key_value_get_bytes(f"{base}/{i}", timeout)
+            shards.append(np.load(io.BytesIO(bytes(raw)), allow_pickle=False))
+        client.wait_at_barrier(f"{base}/done", timeout)
+    finally:
+        # the barrier guarantees no peer still needs our key on the success
+        # path; on failure the barrier has coupled every peer into the same
+        # failure (they retry with the next sequence number together), so
+        # deleting here can strand nobody — and NOT deleting would leak one
+        # key into the coordinator per transient fault, forever
+        try:
+            client.key_value_delete(f"{base}/{pid}")
+        except Exception:  # noqa: BLE001 - cleanup must not mask the gather error
+            pass
+    return np.stack(shards)
+
+
+def _kv_allgather(x: Any) -> Any:
+    return jax.tree_util.tree_map(_kv_allgather_leaf, x)
+
 
 def _default_transport(x: Any) -> Any:
+    global _kv_fallback
+    if _kv_fallback:
+        return _kv_allgather(x)
     from jax.experimental import multihost_utils
 
-    return multihost_utils.process_allgather(x)
+    try:
+        out = multihost_utils.process_allgather(x)
+    except Exception as err:  # noqa: BLE001 - backend-capability probe
+        # the capability error surfaces locally (compile/execute of the
+        # jitted gather fails before any cross-process exchange), so falling
+        # back here cannot leave peers stranded mid-collective. The message
+        # match is the precise signal; the structural condition keeps the
+        # fallback alive if a jax upgrade rewords the text — but it must
+        # only match the DETERMINISTIC capability error (every process flips
+        # together), so it additionally requires the INVALID_ARGUMENT status
+        # class: transient per-process faults surface as INTERNAL /
+        # RESOURCE_EXHAUSTED, and flipping ONE process to the KV transport
+        # while its peers stay on the XLA collective would deadlock both
+        structural = (
+            type(err).__name__ == "XlaRuntimeError"
+            and "INVALID_ARGUMENT" in str(err)
+            and jax.default_backend() == "cpu"
+            and jax.process_count() > 1
+        )
+        if "Multiprocess computations aren't implemented" not in str(err) and not structural:
+            raise
+        _kv_fallback = True
+        return _kv_allgather(x)
+    _kv_fallback = False
+    return out
 
 
 def process_allgather(x: Any) -> Any:
