@@ -1,0 +1,136 @@
+"""``axis_index_groups`` through the SPMD engine (ROADMAP 1b).
+
+``sync_in_jit`` has supported subgroup replicas since the eager runtime
+grew the in-jit sync; the engine now plumbs them: ``to_spmd(groups=...)``
+keeps disjoint equal-sized device subgroups as independent data-parallel
+replicas inside ONE fused step, and ``step()`` returns one synced value per
+group.
+"""
+
+import warnings
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._spmd import InGraphSyncUnsupported, faultinject
+
+WORLD = len(jax.devices())
+RNG = np.random.default_rng(33)
+
+pytestmark = pytest.mark.skipif(WORLD < 4, reason="grouped replicas need >=4 devices")
+
+HALF = WORLD // 2
+GROUPS = [list(range(HALF)), list(range(HALF, WORLD))]
+PER_DEV = 8
+B = PER_DEV * WORLD
+
+
+def _batch():
+    return (
+        jnp.asarray(RNG.standard_normal(B).astype(np.float32)),
+        jnp.asarray(RNG.standard_normal(B).astype(np.float32)),
+    )
+
+
+def test_grouped_step_returns_one_value_per_replica():
+    """Each group syncs independently: group g's value equals an eager metric
+    fed exactly that group's device shards."""
+    eng = tm.MeanSquaredError().to_spmd(groups=GROUPS)
+    eagers = [tm.MeanSquaredError() for _ in GROUPS]
+    for _ in range(3):
+        preds, target = _batch()
+        out = eng.step(preds, target)
+        assert set(out) == {0, 1}
+        for gi, g in enumerate(GROUPS):
+            rows = np.concatenate(
+                [np.arange(d * PER_DEV, (d + 1) * PER_DEV) for d in g]
+            )
+            eagers[gi].update(preds[rows], target[rows])
+    assert not eng.degraded
+    for gi in range(len(GROUPS)):
+        np.testing.assert_allclose(
+            np.asarray(out[gi]), np.asarray(eagers[gi].compute()), rtol=1e-5, atol=1e-7
+        )
+    # compute() (no update) agrees with the last step's values
+    again = eng.compute()
+    for gi in range(len(GROUPS)):
+        np.testing.assert_allclose(np.asarray(again[gi]), np.asarray(out[gi]), rtol=1e-6)
+
+
+def test_grouped_ring_cat_states():
+    """Ring cat states gather within the group only (group-capacity buffer)."""
+    eng = tm.PearsonCorrCoef().to_spmd(groups=GROUPS)
+    eagers = [tm.PearsonCorrCoef() for _ in GROUPS]
+    for _ in range(2):
+        preds, target = _batch()
+        out = eng.step(preds, target)
+        for gi, g in enumerate(GROUPS):
+            rows = np.concatenate([np.arange(d * PER_DEV, (d + 1) * PER_DEV) for d in g])
+            eagers[gi].update(preds[rows], target[rows])
+    assert not eng.degraded
+    for gi in range(len(GROUPS)):
+        np.testing.assert_allclose(
+            np.asarray(out[gi]), np.asarray(eagers[gi].compute()), rtol=1e-4, atol=1e-6
+        )
+
+
+def test_bad_group_partitions_rejected():
+    with pytest.raises(InGraphSyncUnsupported, match="partitioning"):
+        tm.MeanSquaredError().to_spmd(groups=[[0, 1], [2]])
+    with pytest.raises(InGraphSyncUnsupported, match="partitioning"):
+        tm.MeanSquaredError().to_spmd(groups=[list(range(WORLD)), list(range(WORLD))])
+
+
+def test_grouped_degradation_folds_home_group():
+    """A faulted step under groups degrades gracefully: the host fold merges
+    the HOME replica group only (the host target is one stream), the event
+    says so, and the eager continuation keeps flowing."""
+    eng = tm.MeanSquaredError().to_spmd(groups=GROUPS)
+    home_eager = tm.MeanSquaredError()
+    preds, target = _batch()
+    eng.step(preds, target)
+    home_rows = np.concatenate([np.arange(d * PER_DEV, (d + 1) * PER_DEV) for d in GROUPS[0]])
+    home_eager.update(preds[home_rows], target[home_rows])
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with faultinject.inject_step_failure():
+            eng.step(preds, target)
+    assert eng.degraded
+    events = eng.target.resilience_report().events
+    assert any(
+        e.kind == "spmd_degraded" and "home replica group" in e.detail for e in events
+    )
+    # the fold carried exactly the home group's pre-fault accumulation; the
+    # failed batch was re-run eagerly on the FULL batch (eager semantics)
+    home_eager.update(preds, target)
+    np.testing.assert_allclose(
+        np.asarray(eng.target.compute()), np.asarray(home_eager.compute()), rtol=1e-5
+    )
+
+
+def test_group_mismatched_handshake_degrades():
+    """A handshake transport fault at trace time under groups never compiles:
+    the engine degrades to the eager guarded path with zero state committed."""
+    from torchmetrics_tpu._resilience import faultinject as eager_fi
+    from torchmetrics_tpu._resilience.policy import RetryPolicy, SyncPolicy
+
+    m = tm.MeanSquaredError(
+        sync_policy=SyncPolicy(
+            handshake=True, retry=RetryPolicy(max_retries=1, backoff_base=0.0)
+        )
+    )
+    eng = m.to_spmd(groups=GROUPS)
+    preds, target = _batch()
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with eager_fi.simulated_world(2):
+            with eager_fi.inject_collective_failure(first_n=8):
+                out = eng.step(preds, target)
+    assert eng.degraded
+    # degraded BEFORE the first compile: the eager path owns the whole stream
+    eager = tm.MeanSquaredError()
+    eager.update(preds, target)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(eager.compute()), rtol=1e-6)

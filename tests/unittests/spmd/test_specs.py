@@ -58,7 +58,7 @@ def test_collective_per_reduction_kind():
         "s_min": "pmin",
         "s_cat": "all_gather",
     }
-    assert set(COLLECTIVE_FOR) == {"sum", "mean", "max", "min", "cat"}
+    assert set(COLLECTIVE_FOR) == {"sum", "mean", "max", "min", "cat", None}
 
 
 def test_state_specs_shard_leading_device_axis():
@@ -82,11 +82,28 @@ def test_unbounded_cat_state_rejected():
         validate_reductions(_Unbounded())
 
 
-def test_none_and_callable_reductions_rejected():
-    with pytest.raises(InGraphSyncUnsupported, match="no in-graph collective"):
-        sync_plan({"a": None})
+def test_callable_reductions_rejected_none_gathers():
+    # None is the gather-don't-reduce kind (Pearson moment states): it maps
+    # onto all_gather; custom callables still have no in-graph semantics
+    assert sync_plan({"a": None}) == {"a": "all_gather"}
     with pytest.raises(InGraphSyncUnsupported, match="callable"):
         sync_plan({"a": lambda x: x})
+
+
+def test_list_typed_gather_state_rejected():
+    class _ListNone(Metric):
+        def __init__(self):
+            super().__init__()
+            self.add_state("vals", default=[], dist_reduce_fx=None)
+
+        def update(self, x):
+            self.vals.append(x)
+
+        def compute(self):
+            return jnp.zeros(())
+
+    with pytest.raises(InGraphSyncUnsupported, match="fixed per-device shape"):
+        validate_reductions(_ListNone())
 
 
 def test_build_mesh_default_axis():
@@ -143,9 +160,60 @@ class TestFacetGate:
             assert entry["in_graph_sync"]["reasons"], qual
 
 
-def test_pearson_unsupported_by_facet_and_engine():
-    """PearsonCorrCoef declares dist_reduce_fx=None states: the facet marks it
-    unsupported and the engine refuses it with the same diagnosis."""
-    assert in_graph_sync_eligible(tm.PearsonCorrCoef) == "unsupported"
-    with pytest.raises(InGraphSyncUnsupported):
-        tm.PearsonCorrCoef().to_spmd()
+def test_pearson_certified_and_in_graph_matches_eager():
+    """PearsonCorrCoef's dist_reduce_fx=None moment states gather in-graph
+    (stacked (D, num_outputs) sets folded by `_final_aggregation` inside the
+    fused step) — the facet certifies it and the engine matches eager."""
+    import numpy as np
+
+    assert in_graph_sync_eligible(tm.PearsonCorrCoef) == "safe"
+    eng = tm.PearsonCorrCoef().to_spmd()
+    eager = tm.PearsonCorrCoef()
+    rng = np.random.default_rng(7)
+    for _ in range(3):
+        x = jnp.asarray(rng.standard_normal(64).astype(np.float32))
+        y = jnp.asarray(0.5 * np.asarray(x) + rng.standard_normal(64).astype(np.float32))
+        fused = eng.step(x, y)
+        eager.update(x, y)
+    assert not eng.degraded
+    np.testing.assert_allclose(np.asarray(fused), np.asarray(eager.compute()), rtol=1e-4, atol=1e-6)
+
+
+def test_matthews_family_certified_branchless():
+    """The MCC reduce is branchless now: the facet certifies the family and
+    the 6-unsupported set shrank to <=2 (ROADMAP 1c acceptance)."""
+    assert in_graph_sync_eligible(tm.BinaryMatthewsCorrCoef) == "safe"
+    unsupported = [
+        q for q, e in ELIGIBILITY.items() if e["in_graph_sync"]["verdict"] == "unsupported"
+    ]
+    assert len(unsupported) <= 2, unsupported
+
+
+def test_pearson_degrade_folds_gathered_moments():
+    """A collective fault mid-stream folds Pearson's gathered (D, num_outputs)
+    moment sets back into ONE local set via the parallel-variance merge, so
+    the eager continuation computes the full stream."""
+    import numpy as np
+
+    from torchmetrics_tpu._spmd.faultinject import inject_step_failure
+
+    eng = tm.PearsonCorrCoef().to_spmd()
+    eager = tm.PearsonCorrCoef()
+    rng = np.random.default_rng(11)
+    x = jnp.asarray(rng.standard_normal(64).astype(np.float32))
+    y = jnp.asarray(rng.standard_normal(64).astype(np.float32))
+    eng.step(x, y)
+    eager.update(x, y)
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        with inject_step_failure(times=1):
+            eng.step(x + 1, y)
+        eager.update(x + 1, y)
+    assert eng.degraded
+    # folded states are local-form (1-D), not stacked
+    assert eng.target.mean_x.ndim == 1
+    np.testing.assert_allclose(
+        np.asarray(eng.target.compute()), np.asarray(eager.compute()), rtol=1e-4, atol=1e-6
+    )

@@ -1,0 +1,88 @@
+"""Wrapper fast paths: pooled MultitaskWrapper / ClasswiseWrapper."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._streams import StreamPoolUnsupported
+from torchmetrics_tpu.wrappers import ClasswiseWrapper, MultitaskWrapper
+
+RNG = np.random.default_rng(55)
+
+
+def test_pooled_multitask_matches_eager_wrapper():
+    tasks = {"head_a": tm.MeanSquaredError(), "head_b": tm.MeanSquaredError(), "head_c": tm.MeanSquaredError()}
+    pooled = MultitaskWrapper(dict(tasks)).to_stream_pool()
+    eager = MultitaskWrapper(
+        {k: tm.MeanSquaredError() for k in tasks}
+    )
+    for _ in range(4):
+        preds = {k: jnp.asarray(RNG.standard_normal(8).astype(np.float32)) for k in tasks}
+        targets = {k: jnp.asarray(RNG.standard_normal(8).astype(np.float32)) for k in tasks}
+        pooled.update(preds, targets)
+        eager.update(preds, targets)
+    got, want = pooled.compute(), eager.compute()
+    assert sorted(got) == sorted(want)
+    for k in want:
+        np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), rtol=1e-6)
+    pooled.reset()
+    one = {k: jnp.ones(4) for k in tasks}
+    zero = {k: jnp.zeros(4) for k in tasks}
+    pooled.update(one, zero)
+    np.testing.assert_allclose(np.asarray(pooled.compute()["head_a"]), 1.0)
+
+
+def test_pooled_multitask_prefix_postfix():
+    mt = MultitaskWrapper(
+        {"t1": tm.MeanSquaredError(), "t2": tm.MeanSquaredError()}, prefix="p_", postfix="_s"
+    )
+    pooled = mt.to_stream_pool()
+    preds = {k: jnp.ones(4) for k in ("t1", "t2")}
+    pooled.update(preds, {k: jnp.zeros(4) for k in ("t1", "t2")})
+    assert sorted(pooled.compute()) == ["p_t1_s", "p_t2_s"]
+
+
+def test_heterogeneous_multitask_keeps_eager_path():
+    mt = MultitaskWrapper({"cls": tm.BinaryAccuracy(), "reg": tm.MeanSquaredError()})
+    with pytest.raises(StreamPoolUnsupported, match="homogeneous"):
+        mt.to_stream_pool()
+
+
+def test_pooled_classwise_multi_tenant():
+    wrapper = ClasswiseWrapper(tm.MulticlassAccuracy(num_classes=3, average=None))
+    pooled = wrapper.to_stream_pool(capacity=2)
+    a, b = pooled.attach(), pooled.attach()
+    eagers = {
+        a: ClasswiseWrapper(tm.MulticlassAccuracy(num_classes=3, average=None)),
+        b: ClasswiseWrapper(tm.MulticlassAccuracy(num_classes=3, average=None)),
+    }
+    for _ in range(3):
+        ids = np.array([a, b], np.int32)
+        p = jnp.asarray(RNG.random((2, 16, 3)).astype(np.float32))
+        t = jnp.asarray(RNG.integers(0, 3, (2, 16)))
+        pooled.update(ids, p, t)
+        for i, sid in enumerate(ids.tolist()):
+            eagers[sid].update(p[i], t[i])
+    for sid in (a, b):
+        got, want = pooled.compute(sid), eagers[sid].compute()
+        assert sorted(got) == sorted(want)
+        for k in want:
+            np.testing.assert_allclose(np.asarray(got[k]), np.asarray(want[k]), rtol=1e-5)
+    # per-tenant lifecycle flows through
+    pooled.reset(a)
+    allv = pooled.compute_all()
+    assert sorted(allv) == [a, b]
+
+
+def test_pooled_classwise_labels():
+    wrapper = ClasswiseWrapper(
+        tm.MulticlassAccuracy(num_classes=2, average=None), labels=["cat", "dog"]
+    )
+    pooled = wrapper.to_stream_pool(capacity=1)
+    s = pooled.attach()
+    p = jnp.asarray(RNG.random((1, 8, 2)).astype(np.float32))
+    t = jnp.asarray(RNG.integers(0, 2, (1, 8)))
+    pooled.update(np.array([s], np.int32), p, t)
+    assert sorted(pooled.compute(s)) == ["multiclassaccuracy_cat", "multiclassaccuracy_dog"]

@@ -1,0 +1,187 @@
+"""Per-stream sharded durability: tagged journal shards + selective restore."""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._resilience import faultinject
+from torchmetrics_tpu._resilience.errors import SnapshotRestoreError
+from torchmetrics_tpu._resilience.policy import SnapshotPolicy
+from torchmetrics_tpu._streams import StreamPool, StreamSnapshotManager
+
+RNG = np.random.default_rng(123)
+N_STREAMS = 64
+
+
+def _batch(b, n=8):
+    return (
+        jnp.asarray(RNG.standard_normal((b, n)).astype(np.float32)),
+        jnp.asarray(RNG.standard_normal((b, n)).astype(np.float32)),
+    )
+
+
+def _fresh_pool(capacity=N_STREAMS):
+    return tm.MeanSquaredError().to_stream_pool(capacity=capacity)
+
+
+def test_restore_stream_replays_only_that_streams_segment(tmp_path):
+    """The preemption chaos case the ISSUE names: interleaved multi-tenant
+    traffic, SIGKILL, then one tenant's restore replays ONLY the journal
+    frames tagged with that tenant — not everyone's."""
+    pool = _fresh_pool()
+    mgr = StreamSnapshotManager(
+        pool, tmp_path, SnapshotPolicy(every_n_updates=1000, journal_max_entries=1000, async_write=False)
+    )
+    eagers = {pool.attach(): tm.MeanSquaredError() for _ in range(N_STREAMS)}
+    segment = {sid: 0 for sid in eagers}
+    total_update_frames = 0
+    first_call = True
+    for step in range(12):
+        # rotate through overlapping tenant subsets (uneven per-stream traffic)
+        members = sorted(eagers)[step % 4 :: 2 + step % 3]
+        if not members:
+            continue
+        ids = np.asarray(members, dtype=np.int32)
+        p, t = _batch(len(ids))
+        pool.update(ids, p, t)
+        for b, sid in enumerate(ids.tolist()):
+            eagers[sid].update(p[b], t[b])
+            if not first_call:
+                # the very first journaled call anchors the BASE snapshot
+                # instead of writing a frame (the snapshot, taken post-update,
+                # already covers it) — its rows are restored from the
+                # snapshot, not replayed
+                segment[sid] += 1
+        if not first_call:
+            total_update_frames += 1
+        first_call = False
+    mgr.simulate_preemption()
+
+    victim = sorted(eagers)[5]
+    fresh = _fresh_pool()
+    mgr2 = StreamSnapshotManager(fresh, tmp_path, SnapshotPolicy(async_write=False))
+    for _ in range(N_STREAMS):
+        fresh.attach()
+    report = mgr2.restore_stream(victim)
+    # only the victim's logical segment replayed — strictly fewer frames than
+    # the whole journal (the base snapshot covers nothing here: the journal
+    # bound was set high so every update lives in journal frames)
+    assert report.stream == victim
+    assert report.replayed == segment[victim]
+    assert report.replayed < total_update_frames
+    np.testing.assert_allclose(
+        np.asarray(fresh.compute(victim)), np.asarray(eagers[victim].compute()), rtol=1e-5
+    )
+    assert fresh.stream_update_count(victim) == segment[victim]
+    # undisturbed slots stay at defaults (their restore is theirs to request)
+    assert fresh.stream_update_count(sorted(eagers)[6]) == 0
+
+
+def test_restore_latest_rebuilds_whole_pool_with_lifecycle(tmp_path):
+    pool = _fresh_pool(capacity=8)
+    mgr = StreamSnapshotManager(
+        pool, tmp_path, SnapshotPolicy(every_n_updates=4, async_write=False)
+    )
+    eagers = {pool.attach(): tm.MeanSquaredError() for _ in range(6)}
+    for step in range(9):
+        ids = np.asarray(sorted(eagers), dtype=np.int32)
+        p, t = _batch(len(ids))
+        pool.update(ids, p, t)
+        for b, sid in enumerate(ids.tolist()):
+            eagers[sid].update(p[b], t[b])
+        if step == 4:
+            # mid-stream lifecycle rides the journal: detach one tenant,
+            # reset another, attach a new one (reuses the freed lowest slot)
+            victim = sorted(eagers)[0]
+            pool.detach(victim)
+            del eagers[victim]
+            resettee = sorted(eagers)[0]
+            pool.reset(resettee)
+            eagers[resettee] = tm.MeanSquaredError()
+            sid = pool.attach()
+            eagers[sid] = tm.MeanSquaredError()
+    mgr.simulate_preemption()
+
+    fresh = _fresh_pool(capacity=8)
+    mgr2 = StreamSnapshotManager(fresh, tmp_path, SnapshotPolicy(async_write=False))
+    report = mgr2.restore_latest()
+    assert report.replayed > 0
+    assert fresh.active_streams == sorted(eagers)
+    for sid, eager in eagers.items():
+        np.testing.assert_allclose(
+            np.asarray(fresh.compute(sid)), np.asarray(eager.compute()), rtol=1e-5
+        )
+
+
+def test_restore_stream_attached_after_snapshot_starts_from_journal(tmp_path):
+    """A tenant attached AFTER the loaded snapshot boundary restores from its
+    journal segment alone (defaults + replay), never from another tenant's
+    stale snapshot rows."""
+    pool = _fresh_pool(capacity=4)
+    mgr = StreamSnapshotManager(
+        pool, tmp_path, SnapshotPolicy(every_n_updates=1000, journal_max_entries=1000, async_write=False)
+    )
+    s0 = pool.attach()
+    p, t = _batch(1)
+    pool.update(np.array([s0], np.int32), p, t)  # anchors the base snapshot
+    late = pool.attach()  # journaled lifecycle record
+    eager = tm.MeanSquaredError()
+    p2, t2 = _batch(1)
+    pool.update(np.array([late], np.int32), p2, t2)
+    eager.update(p2[0], t2[0])
+    mgr.simulate_preemption()
+
+    fresh = _fresh_pool(capacity=4)
+    mgr2 = StreamSnapshotManager(fresh, tmp_path, SnapshotPolicy(async_write=False))
+    fresh.attach()
+    fresh.attach()
+    report = mgr2.restore_stream(late)
+    # attach boundary + one tagged update frame
+    assert report.replayed == 2
+    np.testing.assert_allclose(
+        np.asarray(fresh.compute(late)), np.asarray(eager.compute()), rtol=1e-5
+    )
+
+
+def test_corrupt_newest_snapshot_falls_back(tmp_path):
+    pool = _fresh_pool(capacity=4)
+    mgr = StreamSnapshotManager(
+        pool, tmp_path, SnapshotPolicy(every_n_updates=2, async_write=False)
+    )
+    eagers = {pool.attach(): tm.MeanSquaredError() for _ in range(2)}
+    for _ in range(6):
+        ids = np.asarray(sorted(eagers), dtype=np.int32)
+        p, t = _batch(len(ids))
+        pool.update(ids, p, t)
+        for b, sid in enumerate(ids.tolist()):
+            eagers[sid].update(p[b], t[b])
+    mgr.simulate_preemption()
+    newest = max(int(p.name[5:13]) for p in tmp_path.iterdir() if p.name.startswith("snap-"))
+    faultinject.corrupt_file(tmp_path / f"snap-{newest:08d}.ckpt")
+
+    fresh = _fresh_pool(capacity=4)
+    mgr2 = StreamSnapshotManager(fresh, tmp_path, SnapshotPolicy(async_write=False))
+    for _ in range(2):
+        fresh.attach()
+    report = mgr2.restore_stream(0)
+    assert report.skipped, "corrupted newest generation must be recorded as skipped"
+    np.testing.assert_allclose(
+        np.asarray(fresh.compute(0)), np.asarray(eagers[0].compute()), rtol=1e-5
+    )
+
+
+def test_restore_stream_nothing_on_disk_raises(tmp_path):
+    pool = _fresh_pool(capacity=2)
+    mgr = StreamSnapshotManager(pool, tmp_path, SnapshotPolicy(async_write=False))
+    pool.attach()
+    with pytest.raises(SnapshotRestoreError):
+        mgr.restore_stream(0)
+
+
+def test_base_record_path_is_sealed(tmp_path):
+    pool = _fresh_pool(capacity=2)
+    mgr = StreamSnapshotManager(pool, tmp_path, SnapshotPolicy(async_write=False))
+    with pytest.raises(TypeError, match="record_streams"):
+        mgr.record(pool, "update", (), {})

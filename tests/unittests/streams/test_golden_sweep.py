@@ -1,0 +1,120 @@
+"""Pool-vs-eager golden equality across the certified class sweep.
+
+Every class the manifest certifies for the vmapped batched-instance path
+(:func:`stream_pool_eligible` ``safe``/``runtime``) that the compiled-default
+sweep can construct at ctor defaults is driven through a REAL 64-stream
+pool — stacked states, masked micro-batch vmapped updates, interleaved
+attach/detach/reset lifecycle — and every surviving stream must match its
+independently-driven eager twin on every computed leaf.
+"""
+
+import warnings
+
+import jax
+import numpy as np
+import pytest
+
+from tests.unittests.analysis.test_compiled_default_path import CASES
+from torchmetrics_tpu._analysis.manifest import stream_pool_eligible
+
+N_STREAMS = 64
+
+
+def _sweep_names():
+    names = []
+    for name, (ctor, _maker) in sorted(CASES.items()):
+        metric = ctor()
+        if stream_pool_eligible(type(metric)) in ("safe", "runtime"):
+            names.append(name)
+    return names
+
+
+SWEEP = _sweep_names()
+
+
+def test_sweep_covers_a_real_population():
+    # the pool path must engage for the bulk of the certified sweep (ISSUE
+    # floor: >= 30 distinct classes), not a cherry-picked handful
+    assert len(SWEEP) >= 30, SWEEP
+
+
+def _stack_args(per_stream_args):
+    """[(a, b), ...] per stream -> one (S, ...) leading-axis arg tuple."""
+    import jax.numpy as jnp
+
+    n_args = len(per_stream_args[0])
+    return tuple(
+        jnp.stack([jnp.asarray(args[i]) for args in per_stream_args]) for i in range(n_args)
+    )
+
+
+@pytest.mark.parametrize("name", SWEEP)
+def test_pool_matches_eager_64_streams(name):
+    ctor, maker = CASES[name]
+    pool = ctor().to_stream_pool(capacity=N_STREAMS)
+    eagers = {}
+    for _ in range(N_STREAMS):
+        sid = pool.attach()
+        m = ctor()
+        m.auto_compile = False
+        eagers[sid] = m
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        # round 1: every stream gets its own batch through ONE vmapped step
+        ids = np.asarray(sorted(eagers), dtype=np.int32)
+        batches = [maker() for _ in ids]
+        pool.update(ids, *_stack_args(batches))
+        for sid, args in zip(ids.tolist(), batches):
+            eagers[sid].update(*args)
+        # interleaved lifecycle: reset some tenants, churn others through
+        # detach/attach (the freed slots are recycled for NEW tenants)
+        for sid in range(0, 8):
+            pool.reset(sid)
+            eagers[sid] = ctor()
+            eagers[sid].auto_compile = False
+        for sid in range(8, 16):
+            pool.detach(sid)
+            del eagers[sid]
+        for _ in range(8):
+            sid = pool.attach()
+            assert sid not in eagers
+            m = ctor()
+            m.auto_compile = False
+            eagers[sid] = m
+        # round 2: same micro-batch width (64 active again) -> same executable
+        ids = np.asarray(sorted(eagers), dtype=np.int32)
+        batches = [maker() for _ in ids]
+        pool.update(ids, *_stack_args(batches))
+        for sid, args in zip(ids.tolist(), batches):
+            eagers[sid].update(*args)
+        got = pool.compute_all()
+        assert sorted(got) == sorted(eagers)
+        for sid in ids.tolist():
+            want = eagers[sid].compute()
+            got_leaves = [np.asarray(x, np.float64) for x in jax.tree_util.tree_leaves(got[sid])]
+            want_leaves = [np.asarray(x, np.float64) for x in jax.tree_util.tree_leaves(want)]
+            assert len(got_leaves) == len(want_leaves), (name, sid)
+            for g, w in zip(got_leaves, want_leaves):
+                np.testing.assert_allclose(g, w, rtol=1e-4, atol=1e-6, err_msg=f"{name}[{sid}]")
+
+
+def test_pool_facet_consistent_with_update_verdicts():
+    """Bookkeeping: pool-eligible classes are exactly the traceable-update,
+    traceable-compute population."""
+    import json
+    from pathlib import Path
+
+    eligibility = json.loads(
+        (
+            Path(__file__).resolve().parents[3]
+            / "torchmetrics_tpu"
+            / "_analysis"
+            / "eligibility.json"
+        ).read_text()
+    )["classes"]
+    for name in SWEEP:
+        metric = CASES[name][0]()
+        qual = f"{type(metric).__module__}.{type(metric).__qualname__}"
+        entry = eligibility.get(qual, {})
+        assert entry.get("verdict") in ("metadata_only", "value_flags"), name
+        assert entry.get("in_graph_sync", {}).get("verdict") != "host_bound", name

@@ -55,7 +55,11 @@ SYNC_SAFE = "safe"
 SYNC_RUNTIME = "runtime"
 SYNC_UNSUPPORTED = "unsupported"
 SYNC_HOST_BOUND = "host_bound"
-IN_GRAPH_REDUCTIONS = frozenset(("sum", "mean", "max", "min", "cat"))
+# "none" is the reference's gather-don't-reduce kind: fixed-shape array
+# states all_gather into stacked (D, *s) sets the class's compute folds
+# itself (PearsonCorrCoef) — list-typed "none" states are already hard
+# update blockers (always-list states), so they never reach this set
+IN_GRAPH_REDUCTIONS = frozenset(("sum", "mean", "max", "min", "cat", "none"))
 
 # check-pattern kinds the prover recognizes (and a traced port can express
 # branchlessly); "value" is the catch-all for tainted checks that do not

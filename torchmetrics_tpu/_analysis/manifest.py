@@ -44,6 +44,7 @@ def set_eligibility_enabled(flag: bool) -> None:
     _eligibility_enabled = bool(flag)
     _eligibility_class_cache.clear()
     _in_graph_class_cache.clear()
+    _stream_pool_class_cache.clear()
 
 
 def write_manifest(certified: Iterable[str], path: Optional[Path] = None) -> int:
@@ -87,6 +88,7 @@ def invalidate_cache() -> None:
     _eligibility_class_cache.clear()
     _in_graph_cache = None
     _in_graph_class_cache.clear()
+    _stream_pool_class_cache.clear()
 
 
 def write_eligibility(payload: Dict[str, object], path: Optional[Path] = None) -> int:
@@ -167,6 +169,51 @@ def in_graph_sync_eligible(cls: type) -> str:
     qualname = f"{cls.__module__}.{cls.__qualname__}"
     facet = facets.get(qualname) or "unknown"
     _in_graph_class_cache[cls] = facet
+    return facet
+
+
+_stream_pool_class_cache: Dict[type, str] = {}
+
+
+def stream_pool_eligible(cls: type) -> str:
+    """The multi-tenant StreamPool's gate: ``"safe"``/``"runtime"``/
+    ``"host_bound"``/``"unsupported"``/``"unknown"`` for the EXACT class.
+
+    The pool vmaps one metric's ``update`` and ``compute`` over N stacked
+    independent state copies, so eligibility is exactly "does the whole
+    update→compute body trace" — no cross-stream collectives are involved.
+    Both existing facets together prove that:
+
+    - the class verdict (``metadata_only``/``value_flags``) proves the
+      *update* call graph traces (host-bound updates cannot vmap);
+    - the ``in_graph_sync`` facet's compute walk proves the *compute* body
+      traces (its reduction-kind half is irrelevant here, but after the
+      gather-state widening the only reduction-blocked classes are also
+      compute-blocked, so the facet is a sound conservative proxy).
+
+    No separate ``vmap_safe`` facet is written until a class appears that
+    vmaps differently than it traces (none in the current 204-class sweep).
+    With the eligibility kill switch thrown every class reads ``runtime``:
+    the pool still builds and an untraceable body fails at trace time with
+    the real diagnostic.
+    """
+    if not _eligibility_enabled:
+        return "runtime"
+    cached = _stream_pool_class_cache.get(cls)
+    if cached is not None:
+        return cached
+    qualname = f"{cls.__module__}.{cls.__qualname__}"
+    verdict = load_eligibility().get(qualname)
+    sync_facet = load_in_graph_sync().get(qualname)
+    if verdict is None:
+        facet = "unknown"
+    elif verdict not in ("metadata_only", "value_flags"):
+        facet = "host_bound"
+    elif sync_facet in ("safe", "runtime"):
+        facet = sync_facet
+    else:
+        facet = "unsupported"
+    _stream_pool_class_cache[cls] = facet
     return facet
 
 

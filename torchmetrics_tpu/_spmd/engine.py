@@ -107,6 +107,7 @@ class SpmdEngine:
         axis_name: str = "dp",
         donate: bool = True,
         enforce_manifest: bool = True,
+        groups: Optional[Any] = None,
     ) -> None:
         from torchmetrics_tpu.collections import MetricCollection
         from torchmetrics_tpu.metric import Metric
@@ -130,6 +131,27 @@ class SpmdEngine:
             )
         self.donate = donate
         self.world = int(self.mesh.shape[self.axis_name])
+        # axis_index_groups: the in-jit process_group analogue — disjoint
+        # equal-sized subgroups of the mesh axis sync independently, keeping
+        # e.g. two data-parallel replicas inside ONE fused step. step() then
+        # returns a {group_index: value} dict (one synced value per replica).
+        self.groups: Optional[Tuple[Tuple[int, ...], ...]] = None
+        if groups is not None:
+            from torchmetrics_tpu.utilities.distributed import validate_axis_groups
+
+            parsed = tuple(tuple(int(i) for i in g) for g in groups)
+            try:
+                # one shared invariant with the in-jit grouped selector —
+                # surfaced eagerly here, at construction, as the engine's
+                # gating error type
+                validate_axis_groups(parsed, self.world)
+            except ValueError as err:
+                raise InGraphSyncUnsupported(
+                    f"`groups` must be equal-sized disjoint subgroups partitioning the"
+                    f" {self.world}-device `{axis_name}` axis: {err}"
+                ) from None
+            self.groups = parsed
+            self._home_group = next(g for g in parsed if 0 in g)
         self._sharding = state_sharding(self.mesh, self.axis_name)
         metrics = list(target._modules.values()) if self._collection is not None else [target]
         for m in metrics:
@@ -259,26 +281,53 @@ class SpmdEngine:
         hook = self.__dict__.get("_snapshot_hook")
         if hook is not None:
             hook.note_update()
-        if self._collection is not None:
-            return self._collection._flatten_results(value)
-        return value
+        return self._shape_value(value)
 
     def compute(self) -> Any:
         """Sync+compute on the current sharded states (no update, no donation)."""
         if self._degraded or self._units is None:
             return self.target.compute()
-        if self._compute_fn is None:
-            self._compute_fn = self._build_compute()
+        # the executable bakes in each unit's dtype policy (states cast inside
+        # _traced_update/_traced_compute), so a set_dtype between calls must
+        # rebuild — same cache-key component the step fns carry
+        policies = tuple(
+            None if u.metric._dtype_policy is None else jnp.dtype(u.metric._dtype_policy).name
+            for u in self._units
+        )
+        if self._compute_fn is None or self._compute_fn[0] != policies:
+            self._compute_fn = (policies, self._build_compute())
         try:
-            value = _faultinject.dispatch(self._compute_fn, self._states)
+            value = _faultinject.dispatch(self._compute_fn[1], self._states)
+        except jax.errors.JAXTypeError as err:
+            # first-ever trace of the compute body can happen HERE (restore
+            # before any step): a host-syncing compute is the class's problem,
+            # not the caller's — degrade exactly as step() does
+            self._degrade(f"fused compute does not trace: {type(err).__name__}: {err}")
+            return self.target.compute()
         except _FATAL:
             raise
         except Exception as err:  # noqa: BLE001
             self._degrade(f"fused compute failed: {type(err).__name__}: {err}")
             return self.target.compute()
-        if self._collection is not None:
-            return self._collection._flatten_results(value)
-        return value
+        return self._shape_value(value)
+
+    def _shape_value(self, value: Any) -> Any:
+        """Host-facing result: flatten collection dicts; slice replica groups.
+
+        With ``groups`` the fused step returns the per-device value stack
+        (each device carries its own group's synced value), so the result is
+        ``{group_index: value}`` — one lazily-sliced device array per replica
+        group, no forced host sync.
+        """
+        if self.groups is None:
+            if self._collection is not None:
+                return self._collection._flatten_results(value)
+            return value
+        out: Dict[int, Any] = {}
+        for gi, g in enumerate(self.groups):
+            v = jax.tree_util.tree_map(lambda x, _lead=g[0]: x[_lead], value)
+            out[gi] = self._collection._flatten_results(v) if self._collection is not None else v
+        return out
 
     def reset(self) -> None:
         """Reset sharded states (and the host target) to defaults."""
@@ -324,6 +373,13 @@ class SpmdEngine:
                     if self._collection is not None:
                         self._collection._sync_compute_groups()
                     folded = True
+                    if self.groups is not None:
+                        detail += (
+                            f"; axis_index_groups were active — the host target can carry"
+                            f" only one stream, so the fold merged the home replica group"
+                            f" (devices {list(self._home_group)}) and the other groups'"
+                            " accumulation stays on their processes"
+                        )
                 except Exception as fold_err:  # noqa: BLE001 - degrade must never crash
                     detail += (
                         f"; folding device states back failed too"
@@ -371,31 +427,50 @@ class SpmdEngine:
     def _fold_unit_to_host(self, unit: _Unit) -> None:
         m = unit.metric
         states = self._states[unit.key]
+        # under axis_index_groups each group is an independent replica; the
+        # host target can carry only one stream, so the fold merges the HOME
+        # group (the one containing device 0) and says so in the event detail
+        devs = list(self._home_group) if self.groups is not None else list(range(self.world))
+        gathered: Dict[str, Any] = {}  # dist_reduce_fx=None states fold together
         for n in unit.names:
             red = m._reductions[n]
             if n in unit.rings:
                 st = jax.device_get(states[n])
-                # world-capacity buffer, matching what sync_in_jit's
-                # all_gather produces — folding world*cap rows into a
+                # group-capacity buffer, matching what sync_in_jit's
+                # all_gather produces — folding len(devs)*cap rows into a
                 # cap-sized ring would silently drop all but 1/world of them
-                rb = RingBuffer(unit.rings[n] * self.world)
-                for d in range(self.world):
+                rb = RingBuffer(unit.rings[n] * len(devs))
+                for d in devs:
                     rows = np.asarray(st["data"][d])[np.asarray(st["valid"][d])]
                     if rows.shape[0]:
                         rb.append(jnp.asarray(rows))
                 object.__setattr__(m, n, rb)
                 continue
-            stacked = np.asarray(jax.device_get(states[n]))
+            stacked = np.asarray(jax.device_get(states[n]))[devs]
             if red == "sum":
                 merged = stacked.sum(axis=0)
             elif red == "mean":
                 merged = stacked.mean(axis=0)
             elif red == "max":
                 merged = stacked.max(axis=0)
-            else:  # "min" — validate_reductions admitted nothing else
+            elif red == "min":
                 merged = stacked.min(axis=0)
+            else:  # None — gather-stack; validate_reductions admitted nothing else
+                gathered[n] = jnp.asarray(stacked)
+                continue
             object.__setattr__(m, n, jnp.asarray(merged))
-        m._update_count = self._steps * self.world
+        if gathered:
+            # gather states have no per-state reduction: either the class
+            # folds its own gathered moment sets back into local form
+            # (PearsonCorrCoef's `_fold_gathered_states` parallel-variance
+            # merge), or the stacked (D, *s) form binds as-is — exactly the
+            # eager post-sync state shape its compute already consumes
+            fold = getattr(m, "_fold_gathered_states", None)
+            if callable(fold):
+                gathered = fold(gathered)
+            for n, v in gathered.items():
+                object.__setattr__(m, n, jnp.asarray(v))
+        m._update_count = self._steps * len(devs)
         m._computed = None
 
     def sync_to_target(self) -> Any:
@@ -427,8 +502,13 @@ class SpmdEngine:
             # row shapes, and for collections forms the compute groups the
             # fused step shares (group detection needs post-update states)
             probe = deepcopy(self.target)
+            # 0-d leaves pass through unsliced: step() right after this probe
+            # rejects them with the user-facing leading-axis message instead
+            # of an IndexError from inside the probe
             shard_args, shard_kwargs = jax.tree_util.tree_map(
-                lambda x: x[: max(1, x.shape[0] // self.world)] if _is_array(x) else x,
+                lambda x: x[: max(1, x.shape[0] // self.world)]
+                if _is_array(x) and getattr(x, "ndim", 0) >= 1
+                else x,
                 (args, kwargs),
             )
             probe.update(*shard_args, **shard_kwargs)
@@ -545,6 +625,7 @@ class SpmdEngine:
             {n: new_local[n] for n in unit.names},
             {n: m._reductions[n] for n in unit.names},
             self.axis_name,
+            axis_index_groups=self.groups,
         )
         values = {}
         for name, member in unit.members:
@@ -574,15 +655,20 @@ class SpmdEngine:
                     values = vals[""]
                 else:
                     values.update(vals)
+            if self.groups is not None:
+                # each shard's value is group-local: stack them over the axis
+                # so the host slices one synced value per replica group
+                values = jax.tree_util.tree_map(lambda v: v[None], values)
             return new_states, values
 
         specs = {u.key: state_specs(u.names, self.axis_name) for u in units}
         dyn_specs = [PartitionSpec(self.axis_name) for _ in range(n_dyn)]
+        value_spec = PartitionSpec() if self.groups is None else PartitionSpec(self.axis_name)
         mapped = shard_map(
             local_step,
             mesh=self.mesh,
             in_specs=(specs, dyn_specs),
-            out_specs=(specs, PartitionSpec()),
+            out_specs=(specs, value_spec),
             check_vma=False,
         )
         return jax.jit(mapped, donate_argnums=(0,) if self.donate else ())
@@ -606,21 +692,27 @@ class SpmdEngine:
                     else:
                         local[n] = states[unit.key][n][0]
                 synced = sync_in_jit(
-                    local, {n: m._reductions[n] for n in unit.names}, self.axis_name
+                    local, {n: m._reductions[n] for n in unit.names}, self.axis_name,
+                    axis_index_groups=self.groups,
                 )
                 for name, member in unit.members:
                     values[name] = _squeeze_if_scalar(member._traced_compute(unit.names, synced))
+            if self.groups is not None:
+                # group-local values: stack over the axis so the host can
+                # slice one synced value per replica group
+                values = jax.tree_util.tree_map(lambda v: v[None], values)
             if self._collection is None:
                 return values[""]
             return values
 
         specs = {u.key: state_specs(u.names, self.axis_name) for u in units}
+        value_spec = PartitionSpec() if self.groups is None else PartitionSpec(self.axis_name)
         return jax.jit(
             shard_map(
                 local_compute,
                 mesh=self.mesh,
                 in_specs=(specs,),
-                out_specs=PartitionSpec(),
+                out_specs=value_spec,
                 check_vma=False,
             )
         )
@@ -664,6 +756,7 @@ class SpmdEngine:
         destination[prefix + "#spmd"] = {
             "world": self.world,
             "axis": self.axis_name,
+            "groups": None if self.groups is None else [list(g) for g in self.groups],
             "units": [
                 {
                     "key": u.key,
@@ -699,6 +792,14 @@ class SpmdEngine:
                 f"snapshot was taken on a {blk['world']}-device `{blk['axis']}` mesh; this engine"
                 f" runs {self.world}-device `{self.axis_name}` — donated states restore only onto"
                 " an identical mesh layout"
+            )
+        snap_groups = blk.get("groups")
+        live_groups = None if self.groups is None else [list(g) for g in self.groups]
+        if snap_groups != live_groups:
+            raise TorchMetricsUserError(
+                f"snapshot was taken with axis_index_groups={snap_groups!r}; this engine runs"
+                f" {live_groups!r} — per-group replica accumulation only restores onto the"
+                " same group partition"
             )
         if self._units is None:
             self._rebuild_units(blk)

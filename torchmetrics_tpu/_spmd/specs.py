@@ -43,13 +43,17 @@ class InGraphSyncUnsupported(TorchMetricsUserError):
 
 # reduction kind -> the XLA collective the fused step lowers it to; the
 # actual lowering lives in ``utilities.distributed.sync_in_jit`` — this map
-# is the declarative contract tests assert against
-COLLECTIVE_FOR: Dict[str, str] = {
+# is the declarative contract tests assert against. ``None`` is the
+# reference's "gather, don't reduce" kind (PearsonCorrCoef's algorithmic
+# merge): fixed-shape array states all_gather into a stacked ``(D, *s)``
+# moment set that the class's own compute folds (``_final_aggregation``).
+COLLECTIVE_FOR: Dict[Optional[str], str] = {
     "sum": "psum",
     "mean": "pmean",
     "max": "pmax",
     "min": "pmin",
     "cat": "all_gather",
+    None: "all_gather",
 }
 
 
@@ -93,17 +97,18 @@ def sync_plan(reductions: Dict[str, Any]) -> Dict[str, str]:
     plan: Dict[str, str] = {}
     bad: List[str] = []
     for name, red in reductions.items():
-        if isinstance(red, str) and red in COLLECTIVE_FOR:
+        if red is None or (isinstance(red, str) and red in COLLECTIVE_FOR):
             plan[name] = COLLECTIVE_FOR[red]
         else:
-            desc = red if isinstance(red, str) or red is None else f"callable:{getattr(red, '__name__', 'fn')}"
+            desc = red if isinstance(red, str) else f"callable:{getattr(red, '__name__', 'fn')}"
             bad.append(f"`{name}` (dist_reduce_fx={desc!r})")
     if bad:
         raise InGraphSyncUnsupported(
             "These states declare reductions with no in-graph collective semantics: "
             + ", ".join(sorted(bad))
-            + ". The fused SPMD step supports sum/mean/max/min (psum/pmean/pmax/pmin) and"
-            " ring-buffer cat states (all_gather); keep the eager gather path for the rest."
+            + ". The fused SPMD step supports sum/mean/max/min (psum/pmean/pmax/pmin),"
+            " ring-buffer cat states and fixed-shape gather (None) states (all_gather);"
+            " keep the eager gather path for the rest."
         )
     return plan
 
@@ -124,5 +129,11 @@ def validate_reductions(metric: Any) -> Dict[str, str]:
                 f"state `{name}` is an unbounded cat state; its carried shape would grow"
                 " every fused step (one recompile per batch). Construct the metric with"
                 " `cat_state_capacity=N` to bound it into a ring buffer."
+            )
+        if red is None and isinstance(value, list):
+            raise InGraphSyncUnsupported(
+                f"state `{name}` is a list state with dist_reduce_fx=None; in-graph gather"
+                " needs a fixed per-device shape (an array state, as the Pearson moment"
+                " states are). Keep the eager gather path."
             )
     return plan

@@ -1,0 +1,22 @@
+"""Multi-tenant vectorized metric streams (STREAMS.md).
+
+A :class:`StreamPool` holds N *independent* instances of one metric (or one
+``MetricCollection`` compute group set) as stacked state pytrees —
+``(N, *shape)`` leaves, ring-stacked cat states — and drives an arbitrary
+micro-batch of them with a single compiled ``vmap``-ped update step. Per-
+stream lifecycle (attach/detach/reset) is O(1), durability shards the
+snapshot journal per stream (:class:`StreamSnapshotManager`), and telemetry
+gains a bounded ``stream=`` label dimension (:class:`StreamLabeler`).
+"""
+
+from torchmetrics_tpu._streams.durability import StreamRestoreReport, StreamSnapshotManager
+from torchmetrics_tpu._streams.pool import StreamPool, StreamPoolUnsupported
+from torchmetrics_tpu._streams.telemetry import StreamLabeler
+
+__all__ = [
+    "StreamLabeler",
+    "StreamPool",
+    "StreamPoolUnsupported",
+    "StreamRestoreReport",
+    "StreamSnapshotManager",
+]

@@ -1,0 +1,932 @@
+"""StreamPool: N independent metric streams behind one vmapped compiled step.
+
+Serving metric traffic for millions of users means thousands of
+*independent* streams (per-user, per-slice, per-model-variant), not one big
+accumulator. Driving N Python ``Metric`` objects costs N dispatches and N
+compiled executables per batch; the pool turns that into an XLA batching
+problem instead:
+
+- **Stacked state pytrees.** Every registered state of one metric class
+  (or of each ``MetricCollection`` compute-group head) lives stacked along
+  a leading *slot* axis: a per-stream value of shape ``(*s,)`` becomes one
+  ``(P, *s)`` array (``P`` = capacity + 1; the last row is a scratch slot
+  masked writes land in). Ring-buffer cat states stack their
+  ``data/valid/count`` leaves the same way, exactly as ``_spmd/specs.py``
+  stacks them along the device axis.
+- **One vmapped donated step.** ``pool.update(stream_ids, *args)`` updates
+  an arbitrary micro-batch of tenants: rows of the batched arguments are
+  gathered to their slots, ``jax.vmap`` runs the metric's real (traced)
+  ``update`` body per lane, and a masked scatter writes the survivors back
+  — absent streams (``stream_id == -1`` padding), quarantined rows, and
+  error-severity validation violations all land in the scratch slot, so
+  one compiled executable serves every micro-batch of the same shape.
+- **O(1) lifecycle.** ``attach()`` pops a slot from a free-list; when the
+  free-list is empty the capacity doubles (one re-pad of the stacked
+  states, ONE recompile on the next update — named by the recompile-churn
+  detector via a ``capacity`` cache-key component, never mysterious).
+  ``detach(i)``/``reset(i)`` zero one row through a tiny donated
+  executable whose slot index is traced, so no recompile per slot.
+- **Per-stream compute with cache bits.** ``compute(i)`` runs a single-slot
+  compiled compute (one executable for every slot); ``compute_all()`` runs
+  the vmapped compute across the whole pool in one call. Both fill a
+  per-stream host value cache invalidated by that stream's updates only.
+- **Manifest-gated.** Pool construction is gated on the compile-eligibility
+  manifest (:func:`~torchmetrics_tpu._analysis.manifest.stream_pool_eligible`):
+  the class verdict proves the update body traces, the ``in_graph_sync``
+  facet's compute walk proves compute does. No collectives are involved —
+  streams are independent by construction.
+
+Durability (per-stream journal shards) lives in
+:mod:`~torchmetrics_tpu._streams.durability`; bounded per-stream telemetry
+labels in :mod:`~torchmetrics_tpu._streams.telemetry`. See STREAMS.md.
+"""
+
+from __future__ import annotations
+
+import heapq
+import time
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from torchmetrics_tpu._analysis.manifest import stream_pool_eligible
+from torchmetrics_tpu._observability.events import BUS as _BUS
+from torchmetrics_tpu._observability.state import OBS as _OBS
+from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
+from torchmetrics_tpu._streams.telemetry import StreamLabeler
+from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
+
+__all__ = ["StreamPool", "StreamPoolUnsupported"]
+
+
+class StreamPoolUnsupported(TorchMetricsUserError):
+    """The metric cannot take the vmapped batched-instance path.
+
+    Raised at pool construction — never mid-stream — so callers keep the
+    plain per-instance eager path with zero state committed.
+    """
+
+
+def _is_array(x: Any) -> bool:
+    return isinstance(x, (jnp.ndarray, np.ndarray)) or (hasattr(x, "dtype") and hasattr(x, "shape"))
+
+
+@dataclass
+class _Unit:
+    """One pooled participant: a metric (or compute-group head + members)."""
+
+    key: str  # "" for a bare metric; the head's collection key otherwise
+    metric: Any  # the head — its update runs, its states carry
+    members: List[Tuple[str, Any]] = field(default_factory=list)  # (name, metric) incl. head
+    names: List[str] = field(default_factory=list)
+    rings: Dict[str, int] = field(default_factory=dict)  # ring states -> capacity
+    ring_rows: Dict[str, Tuple[tuple, Any]] = field(default_factory=dict)
+    nan_exempt: frozenset = frozenset()  # states with non-finite defaults (min/max)
+
+
+class StreamPool:
+    """Drive N independent copies of one metric as stacked state + one step.
+
+    The target must be fresh (``update_count == 0``): it is the *template*
+    whose class, configuration, and (for collections) compute groups define
+    every stream; it never accumulates itself. ``capacity`` is the initial
+    slot count — :meth:`attach` doubles it on demand.
+    """
+
+    def __init__(
+        self,
+        target: Any,
+        *,
+        capacity: int = 8,
+        donate: bool = True,
+        enforce_manifest: bool = True,
+        telemetry_streams: int = 8,
+    ) -> None:
+        from torchmetrics_tpu.collections import MetricCollection
+        from torchmetrics_tpu.metric import Metric
+
+        self._collection = target if isinstance(target, MetricCollection) else None
+        if self._collection is None and not isinstance(target, Metric):
+            raise StreamPoolUnsupported(
+                f"StreamPool target must be a Metric or MetricCollection, got {type(target).__name__}"
+            )
+        if not (isinstance(capacity, int) and capacity >= 1):
+            raise StreamPoolUnsupported(f"`capacity` must be a positive int, got {capacity!r}")
+        self.target = target
+        self.donate = donate
+        metrics = list(target._modules.values()) if self._collection is not None else [target]
+        for m in metrics:
+            facet = stream_pool_eligible(type(m))
+            if facet in ("host_bound", "unsupported") and enforce_manifest:
+                raise StreamPoolUnsupported(
+                    f"{type(m).__name__} is `{facet}` for the vmapped batched-instance path"
+                    " (the eligibility manifest proves its update or compute body does not"
+                    " trace); drive independent eager instances instead. Pass"
+                    " enforce_manifest=False only if you know the full update+compute"
+                    " body traces."
+                )
+            if facet == "unknown" and enforce_manifest:
+                raise StreamPoolUnsupported(
+                    f"{type(m).__name__} is absent from the eligibility manifest (user"
+                    " subclass?); the vmapped path is certified per-class. Pass"
+                    " enforce_manifest=False to opt in without certification."
+                )
+            if m._update_count != 0:
+                raise StreamPoolUnsupported(
+                    f"{type(m).__name__} has already accumulated {m._update_count} update(s);"
+                    " the pool target is a fresh template, not a live stream"
+                )
+            if m.nan_policy not in (None, "quarantine"):
+                raise StreamPoolUnsupported(
+                    f"{type(m).__name__} has nan_policy={m.nan_policy!r}: the vmapped step"
+                    " can quarantine per-row (masked write + per-stream counter) but cannot"
+                    " warn/raise from inside the executable; construct the template with"
+                    " nan_policy='quarantine' or None"
+                )
+        self.capacity = int(capacity)
+        # slot bookkeeping: a min-heap free-list gives deterministic O(log N)
+        # attach (lowest slot first — replay-stable for the journal), and
+        # detach pushes the zeroed slot back
+        self._free: List[int] = list(range(self.capacity))
+        heapq.heapify(self._free)
+        self._active: set = set()
+        self._counts = np.zeros(self.capacity, dtype=np.int64)
+        self._dirty = np.zeros(self.capacity, dtype=bool)
+        self._value_cache: Dict[int, Any] = {}
+        self._violations = np.zeros(self.capacity, dtype=np.int64)
+        self._quarantined = np.zeros(self.capacity, dtype=np.int64)
+        self.labeler = StreamLabeler(k=telemetry_streams)
+        # lazy build state (first update learns ring shapes + compute groups)
+        self._units: Optional[List[_Unit]] = None
+        self._states: Optional[Dict[str, Dict[str, Any]]] = None
+        self._stacked_defaults: Optional[Dict[str, Dict[str, Any]]] = None
+        self._row_defaults: Optional[Dict[str, Dict[str, Any]]] = None
+        self._step_fns: Dict[Any, Any] = {}
+        self._compute_one_fn: Optional[Any] = None
+        self._compute_all_fn: Optional[Any] = None
+        self._zero_fn: Optional[Any] = None
+        self.growths = 0
+        self.total_row_updates = 0
+        self._row_guards = False
+        # durability surface (StreamSnapshotManager binds here)
+        self._defaults: Dict[str, Any] = {}
+        self._snapshot_hook: Optional[Any] = None
+
+    # ------------------------------------------------------------- properties
+    @property
+    def physical(self) -> int:
+        """Stacked leading-axis length: ``capacity`` slots + 1 scratch row."""
+        return self.capacity + 1
+
+    @property
+    def active_streams(self) -> List[int]:
+        return sorted(self._active)
+
+    @property
+    def num_active(self) -> int:
+        return len(self._active)
+
+    def stream_update_count(self, stream_id: int) -> int:
+        self._check_slot(stream_id)
+        return int(self._counts[stream_id])
+
+    # -------------------------------------------------------------- lifecycle
+    def attach(self) -> int:
+        """Hand out a fresh stream slot (amortized O(1); doubles when full)."""
+        if not self._free:
+            self._grow()
+        slot = heapq.heappop(self._free)
+        self._active.add(slot)
+        self._counts[slot] = 0
+        self._dirty[slot] = True
+        self._value_cache.pop(slot, None)
+        if _OBS.enabled:
+            _telemetry_for(self).inc("pool_attach")
+        hook = self.__dict__.get("_snapshot_hook")
+        if hook is not None:
+            hook.record_lifecycle("attach", slot)
+        return slot
+
+    def detach(self, stream_id: int) -> None:
+        """Return a slot to the free-list; its row resets to defaults."""
+        self._check_slot(stream_id, attached=True)
+        self._zero_row(stream_id)
+        self._active.remove(stream_id)
+        heapq.heappush(self._free, int(stream_id))
+        self._counts[stream_id] = 0
+        self._violations[stream_id] = 0
+        self._quarantined[stream_id] = 0
+        self._dirty[stream_id] = True
+        self._value_cache.pop(int(stream_id), None)
+        self.labeler.retire(stream_id)
+        if _OBS.enabled:
+            _telemetry_for(self).inc("pool_detach")
+        hook = self.__dict__.get("_snapshot_hook")
+        if hook is not None:
+            hook.record_lifecycle("detach", int(stream_id))
+
+    def reset(self, stream_id: Optional[int] = None) -> None:
+        """Reset one stream's accumulation (or, with ``None``, every slot)."""
+        if stream_id is None:
+            if self._states is not None:
+                self._states = self._place_defaults()
+            self._counts[:] = 0
+            self._dirty[:] = True
+            self._value_cache.clear()
+            hook = self.__dict__.get("_snapshot_hook")
+            if hook is not None:
+                hook.record_lifecycle("reset_all", -1)
+            return
+        self._check_slot(stream_id, attached=True)
+        self._zero_row(stream_id)
+        self._counts[stream_id] = 0
+        self._dirty[stream_id] = True
+        self._value_cache.pop(int(stream_id), None)
+        hook = self.__dict__.get("_snapshot_hook")
+        if hook is not None:
+            hook.record_lifecycle("reset", int(stream_id))
+
+    def _check_slot(self, stream_id: Any, attached: bool = False) -> None:
+        sid = int(stream_id)
+        if not 0 <= sid < self.capacity:
+            raise TorchMetricsUserError(
+                f"stream id {sid} out of range for pool capacity {self.capacity}"
+            )
+        if attached and sid not in self._active:
+            raise TorchMetricsUserError(f"stream {sid} is not attached")
+
+    def _grow(self) -> None:
+        """Double capacity: re-pad every stacked leaf, one recompile next step."""
+        old_cap = self.capacity
+        new_cap = old_cap * 2
+        self._free.extend(range(old_cap, new_cap))
+        heapq.heapify(self._free)
+        self.capacity = new_cap
+        self._counts = np.concatenate([self._counts, np.zeros(new_cap - old_cap, np.int64)])
+        self._dirty = np.concatenate([self._dirty, np.ones(new_cap - old_cap, bool)])
+        self._violations = np.concatenate([self._violations, np.zeros(new_cap - old_cap, np.int64)])
+        self._quarantined = np.concatenate([self._quarantined, np.zeros(new_cap - old_cap, np.int64)])
+        self.growths += 1
+        if self._units is not None:
+            old_states = self._states
+            self._install_stacked_defaults(self._units)
+            grown: Dict[str, Dict[str, Any]] = {}
+            for unit in self._units:
+                ust: Dict[str, Any] = {}
+                for n in unit.names:
+                    old = old_states[unit.key][n]
+                    fresh = self._stacked_defaults[unit.key][n]
+                    if n in unit.rings:
+                        ust[n] = {
+                            part: jnp.concatenate(
+                                [jnp.asarray(old[part][:old_cap]), jnp.asarray(fresh[part][old_cap:])]
+                            )
+                            for part in ("data", "valid", "count")
+                        }
+                    else:
+                        ust[n] = jnp.concatenate(
+                            [jnp.asarray(old[:old_cap]), jnp.asarray(fresh[old_cap:])]
+                        )
+                grown[unit.key] = ust
+            self._states = grown
+            # shape change invalidates every compiled path; the next update's
+            # compile_event carries the new `capacity` component so the churn
+            # detector NAMES the growth recompile instead of counting it as
+            # mystery churn
+            self._step_fns.clear()
+            self._compute_one_fn = None
+            self._compute_all_fn = None
+            self._zero_fn = None
+        if _OBS.enabled:
+            telem = _telemetry_for(self)
+            telem.inc("pool_growths")
+            _BUS.publish(
+                "stream_pool_growth",
+                type(self).__name__,
+                f"capacity {old_cap} -> {new_cap} (stacked states re-padded; one named"
+                " recompile on the next update)",
+                data={"old": old_cap, "new": new_cap},
+            )
+
+    def _zero_row(self, stream_id: int) -> None:
+        if self._states is None:
+            return
+        if self._zero_fn is None:
+            row_defaults = self._row_defaults
+
+            def zero(states: Dict[str, Any], i: Any) -> Dict[str, Any]:
+                return jax.tree_util.tree_map(
+                    lambda s, d: s.at[i].set(jnp.asarray(d)), states, row_defaults
+                )
+
+            self._zero_fn = jax.jit(zero, donate_argnums=(0,) if self.donate else ())
+        self._states = self._zero_fn(self._states, jnp.int32(int(stream_id)))
+
+    # ------------------------------------------------------------------ update
+    def update(self, stream_ids: Any, *args: Any, **kwargs: Any) -> None:
+        """One vmapped update over a micro-batch of streams.
+
+        ``stream_ids`` is a length-B sequence of attached slot ids (``-1``
+        entries are padding: their rows are masked into the scratch slot).
+        Every array argument must carry a leading axis of length B — row
+        ``b`` is stream ``stream_ids[b]``'s batch for this call.
+        """
+        from torchmetrics_tpu.metric import Metric
+
+        ids = np.asarray(stream_ids, dtype=np.int32).reshape(-1)
+        if ids.size == 0:
+            raise TorchMetricsUserError("`update` needs at least one stream id")
+        live = ids[ids >= 0]
+        if live.size == 0:
+            return
+        if np.unique(live).size != live.size:
+            raise TorchMetricsUserError(
+                "duplicate stream ids in one micro-batch: the masked scatter would apply"
+                " only one of the duplicate rows (split the call instead)"
+            )
+        for sid in live.tolist():
+            self._check_slot(sid, attached=True)
+        if self._units is None:
+            self._prepare(ids, args, kwargs)
+        treedef, dynamic, statics = Metric._split_batch_args("stream_update", args, kwargs)
+        if not dynamic:
+            raise TorchMetricsUserError("`update` needs at least one array argument")
+        for leaf in dynamic:
+            if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] != ids.size:
+                raise TorchMetricsUserError(
+                    f"every array argument must carry a leading stream axis of length"
+                    f" {ids.size} (one row per stream id); got shape {getattr(leaf, 'shape', ())}"
+                )
+        sig = (treedef, statics, tuple((tuple(d.shape), str(d.dtype)) for d in dynamic))
+        key = (
+            sig,
+            self.physical,
+            tuple(
+                None if u.metric._dtype_policy is None else jnp.dtype(u.metric._dtype_policy).name
+                for u in self._units
+            ),
+        )
+        fn = self._step_fns.get(key)
+        built = fn is None
+        if built:
+            fn = self._build_step(treedef, statics, len(dynamic))
+            if _OBS.enabled:
+                fn = self._obs_timed_first_call(key, fn)
+            self._step_fns[key] = fn
+        obs_sample = False
+        t0 = 0.0
+        if _OBS.enabled:
+            telem = _telemetry_for(self)
+            if built:
+                # the same cache-key components the churn detector diffs for
+                # metric executables, plus `capacity`: a growth recompile is
+                # then NAMED ("capacity: '65' -> '129'"), not mysterious
+                telem.compile_event(
+                    "stream_step",
+                    {
+                        "arg_structure": str(treedef),
+                        "static_args": repr(statics),
+                        "shapes": repr(tuple(s for s, _ in sig[2])),
+                        "dtypes": repr(tuple(d for _, d in sig[2])),
+                        "capacity": str(self.physical),
+                    },
+                )
+            obs_sample = telem.sample_due("stream_step")
+            if obs_sample:
+                t0 = time.perf_counter()
+        new_states, row_flags = fn(self._states, jnp.asarray(ids), dynamic)
+        self._states = new_states
+        applied = ids >= 0
+        if self._row_guards:
+            # quarantine/violation masks decide which rows actually landed;
+            # pools without guards skip this device->host readback entirely
+            quarantined = np.asarray(row_flags["quarantined"])
+            violated = np.asarray(row_flags["violated"])
+            applied = applied & ~quarantined & ~violated
+            for b, sid in enumerate(ids.tolist()):
+                if sid < 0:
+                    continue
+                if quarantined[b]:
+                    self._quarantined[sid] += 1
+                    if _OBS.enabled:
+                        _telemetry_for(self).inc(
+                            f"pool_quarantined|stream={self.labeler.label(sid)}"
+                        )
+                if violated[b]:
+                    self._violations[sid] += 1
+                    if _OBS.enabled:
+                        _telemetry_for(self).inc(
+                            f"pool_violations|stream={self.labeler.label(sid)}"
+                        )
+        applied_ids = ids[applied]
+        self._counts[applied_ids] += 1
+        self._dirty[applied_ids] = True
+        for sid in applied_ids.tolist():
+            self._value_cache.pop(sid, None)
+            label = self.labeler.note(sid)
+            if _OBS.enabled:
+                _telemetry_for(self).inc(f"pool_stream_updates|stream={label}")
+        self.total_row_updates += int(applied_ids.size)
+        if _OBS.enabled:
+            telem = _telemetry_for(self)
+            telem.inc("update_calls|path=stream_pool")
+            if obs_sample:
+                telem.observe("stream_step", time.perf_counter() - t0)
+        hook = self.__dict__.get("_snapshot_hook")
+        if hook is not None:
+            hook.record_streams(ids, args, kwargs)
+
+    # ----------------------------------------------------------------- compute
+    def compute(self, stream_id: int) -> Any:
+        """One stream's metric value (single-slot compiled compute, cached)."""
+        self._check_slot(stream_id, attached=True)
+        sid = int(stream_id)
+        if not self._dirty[sid] and sid in self._value_cache:
+            return self._value_cache[sid]
+        if self._units is None:
+            raise TorchMetricsUserError(
+                "the pool has no states yet (no update() has run); stream values are"
+                " undefined before the first batch"
+            )
+        if self._compute_one_fn is None:
+            self._compute_one_fn = self._build_compute_one()
+        value = self._shape_value(self._compute_one_fn(self._states, jnp.int32(sid)))
+        self._value_cache[sid] = value
+        self._dirty[sid] = False
+        if _OBS.enabled:
+            _telemetry_for(self).inc("pool_computes|kind=one")
+        return value
+
+    def compute_all(self) -> Dict[int, Any]:
+        """Every attached stream's value from ONE vmapped compiled compute."""
+        if self._units is None:
+            return {}
+        if self._compute_all_fn is None:
+            self._compute_all_fn = self._build_compute_all()
+        stacked = self._compute_all_fn(self._states)
+        out: Dict[int, Any] = {}
+        for sid in sorted(self._active):
+            value = self._shape_value(
+                jax.tree_util.tree_map(lambda v, _s=sid: v[_s], stacked)
+            )
+            out[sid] = value
+            self._value_cache[sid] = value
+            self._dirty[sid] = False
+        if _OBS.enabled:
+            _telemetry_for(self).inc("pool_computes|kind=all")
+        return out
+
+    def _shape_value(self, value: Any) -> Any:
+        if self._collection is not None:
+            return self._collection._flatten_results(value)
+        return value
+
+    def pending_violations(self, stream_id: int) -> int:
+        """Error-severity validation violations dropped for this stream."""
+        self._check_slot(stream_id)
+        return int(self._violations[stream_id])
+
+    def quarantined_updates(self, stream_id: int) -> int:
+        """Rows rolled back by the per-row NaN quarantine for this stream."""
+        self._check_slot(stream_id)
+        return int(self._quarantined[stream_id])
+
+    # ------------------------------------------------------------- preparation
+    def _prepare(self, ids: np.ndarray, args: tuple, kwargs: Dict[str, Any]) -> None:
+        from copy import deepcopy
+
+        # one single-stream eager probe on a throwaway clone: learns ring row
+        # shapes, and for collections forms the compute groups the vmapped
+        # step shares (group detection needs post-update states)
+        probe = deepcopy(self.target)
+        row_args, row_kwargs = jax.tree_util.tree_map(
+            lambda x: x[0] if _is_array(x) else x, (args, kwargs)
+        )
+        probe.update(*row_args, **row_kwargs)
+
+        units: List[_Unit] = []
+        if self._collection is not None:
+            groups = probe._groups
+            self._collection._groups = {i: list(g) for i, g in groups.items()}
+            self._collection._groups_checked = True
+            for g in groups.values():
+                head_key = g[0]
+                head = self.target._modules[head_key]
+                members = [(name, self.target._modules[name]) for name in g]
+                units.append(self._make_unit(head_key, head, members, probe._modules[head_key]))
+        else:
+            units.append(self._make_unit("", self.target, [("", self.target)], probe))
+        self._units = units
+        self._row_guards = any(
+            u.metric.nan_policy == "quarantine" or self._unit_flags(u) for u in units
+        )
+        self._install_stacked_defaults(units)
+        self._states = self._place_defaults()
+
+    @staticmethod
+    def _unit_flags(unit: _Unit) -> bool:
+        """True when the unit's head runs a traced validator per lane."""
+        from torchmetrics_tpu.metric import Metric
+
+        m = unit.metric
+        return bool(getattr(m, "validate_args", False)) and (
+            type(m)._traced_value_flags is not Metric._traced_value_flags
+        )
+
+    def _make_unit(self, key: str, metric: Any, members: List[Tuple[str, Any]], probe: Any) -> _Unit:
+        names = list(metric._defaults)
+        rings: Dict[str, int] = {}
+        ring_rows: Dict[str, Tuple[tuple, Any]] = {}
+        for n in names:
+            state = getattr(metric, n)
+            if isinstance(state, list):
+                raise StreamPoolUnsupported(
+                    f"state `{n}` is an append-mode list state; its stacked shape would"
+                    " grow per batch. Construct the template with `cat_state_capacity=N`"
+                    " to bound it into a ring buffer."
+                )
+            if isinstance(state, RingBuffer):
+                rings[n] = state.capacity
+                warmed = getattr(probe, n) if probe is not None else None
+                if warmed is None or not isinstance(warmed, RingBuffer) or not warmed.initialized:
+                    raise TorchMetricsUserError(
+                        f"ring state `{n}` row shape could not be learned from the first batch"
+                    )
+                ring_rows[n] = (tuple(int(s) for s in warmed.data.shape[1:]), warmed.data.dtype)
+        exempt = frozenset(
+            n
+            for n in names
+            if n not in rings and not np.all(np.isfinite(np.asarray(metric._defaults[n])))
+        )
+        return _Unit(
+            key=key, metric=metric, members=members, names=names, rings=rings,
+            ring_rows=ring_rows, nan_exempt=exempt,
+        )
+
+    def _install_stacked_defaults(self, units: List[_Unit]) -> None:
+        """Stacked ``(P, *s)`` defaults + per-row defaults + flat mirror."""
+        from torchmetrics_tpu._spmd.specs import stack_default
+
+        self._stacked_defaults = {}
+        self._row_defaults = {}
+        self._defaults = {}
+        P = self.physical
+        for unit in units:
+            defaults: Dict[str, Any] = {}
+            rows: Dict[str, Any] = {}
+            for n in unit.names:
+                if n in unit.rings:
+                    row_shape, row_dtype = unit.ring_rows[n]
+                    cap = unit.rings[n]
+                    defaults[n] = {
+                        "data": np.zeros((P, cap, *row_shape), row_dtype),
+                        "valid": np.zeros((P, cap), bool),
+                        "count": np.zeros((P,), np.int32),
+                    }
+                    rows[n] = {
+                        "data": np.zeros((cap, *row_shape), row_dtype),
+                        "valid": np.zeros((cap,), bool),
+                        "count": np.zeros((), np.int32),
+                    }
+                else:
+                    defaults[n] = stack_default(unit.metric._defaults[n], P)
+                    rows[n] = np.asarray(unit.metric._defaults[n])
+            self._stacked_defaults[unit.key] = defaults
+            self._row_defaults[unit.key] = rows
+            pre = f"{unit.key}." if unit.key else ""
+            for n in unit.names:
+                if n in unit.rings:
+                    for part in ("data", "valid", "count"):
+                        self._defaults[f"{pre}{n}#{part}"] = defaults[n][part]
+                else:
+                    self._defaults[f"{pre}{n}"] = defaults[n]
+
+    def _place_defaults(self) -> Dict[str, Dict[str, Any]]:
+        return jax.tree_util.tree_map(jnp.asarray, self._stacked_defaults)
+
+    # ------------------------------------------------------------- compilation
+    def _lane_states(self, unit: _Unit, lane: Dict[str, Any]) -> Dict[str, Any]:
+        """Per-lane state dict: rebuild RingBuffers from their stacked leaves."""
+        local = {}
+        for n in unit.names:
+            if n in unit.rings:
+                s = lane[n]
+                local[n] = RingBuffer(
+                    unit.rings[n], _data=s["data"], _valid=s["valid"], _count=s["count"]
+                )
+            else:
+                local[n] = lane[n]
+        return local
+
+    @staticmethod
+    def _lane_leaves(unit: _Unit, states: Dict[str, Any]) -> Dict[str, Any]:
+        out: Dict[str, Any] = {}
+        for n in unit.names:
+            v = states[n]
+            if isinstance(v, RingBuffer):
+                out[n] = {"data": v.data, "valid": v.valid, "count": v.count}
+            else:
+                out[n] = v
+        return out
+
+    def _build_step(self, treedef: Any, statics: Any, n_dyn: int):
+        from torchmetrics_tpu.metric import Metric
+
+        units = self._units
+        row_guards = self._row_guards
+
+        def lane_step(lane_states: Dict[str, Dict[str, Any]], dyn: Tuple[Any, ...]):
+            """One stream's update: lane_states/dyn carry NO lead axis here."""
+            a, kw = Metric._merge_batch_args(treedef, list(dyn), statics)
+            new_lane: Dict[str, Dict[str, Any]] = {}
+            quarantined = jnp.asarray(False)
+            violated = jnp.asarray(False)
+            for unit in units:
+                m = unit.metric
+                kw_m = m._filter_kwargs(**kw) if kw else kw
+                local = self._lane_states(unit, lane_states[unit.key])
+                new_local = m._traced_update(unit.names, local, a, kw_m)
+                if m.nan_policy == "quarantine":
+                    bad = jnp.asarray(False)
+                    for n in unit.names:
+                        v = new_local[n]
+                        if isinstance(v, RingBuffer):
+                            rows_ok = jnp.where(
+                                v.valid[(...,) + (None,) * (v.data.ndim - 1)],
+                                jnp.isfinite(v.data),
+                                True,
+                            )
+                            bad = bad | ~rows_ok.all()
+                        elif n not in unit.nan_exempt and jnp.issubdtype(
+                            jnp.asarray(v).dtype, jnp.inexact
+                        ):
+                            bad = bad | ~jnp.isfinite(v).all()
+                    quarantined = quarantined | bad
+                if bool(getattr(m, "validate_args", False)):
+                    res = m._traced_value_flags(*a, **kw_m)
+                    if res is not None:
+                        msgs, flags, sevs = Metric._split_value_flags(res)
+                        err = [i for i, s in enumerate(sevs) if s == "error"]
+                        if err:
+                            violated = violated | jnp.asarray(flags)[jnp.asarray(err)].any()
+                new_lane[unit.key] = self._lane_leaves(unit, new_local)
+            return new_lane, quarantined, violated
+
+        def step(states: Dict[str, Dict[str, Any]], ids: Any, dyn: List[Any]):
+            valid = ids >= 0
+            scratch = jnp.int32(self.physical - 1)
+            safe = jnp.where(valid, ids, scratch)
+            lanes = jax.tree_util.tree_map(lambda s: s[safe], states)
+            new_lanes, quarantined, violated = jax.vmap(lane_step)(lanes, tuple(dyn))
+            # masked write: rejected rows scatter into the scratch slot so a
+            # single compiled executable covers every mask pattern — and two
+            # rejected rows colliding there is harmless by construction
+            keep = valid & ~quarantined & ~violated if row_guards else valid
+            write = jnp.where(keep, safe, scratch)
+            out = jax.tree_util.tree_map(
+                lambda s, nl: s.at[write].set(nl), states, new_lanes
+            )
+            return out, {"quarantined": quarantined & valid, "violated": violated & valid}
+
+        return jax.jit(step, donate_argnums=(0,) if self.donate else ())
+
+    def _build_compute_one(self):
+        from torchmetrics_tpu.metric import _squeeze_if_scalar
+
+        units = self._units
+
+        def compute_one(states: Dict[str, Dict[str, Any]], i: Any):
+            values: Dict[str, Any] = {}
+            for unit in units:
+                lane = jax.tree_util.tree_map(lambda s: s[i], states[unit.key])
+                local = self._lane_states(unit, lane)
+                for name, member in unit.members:
+                    values[name] = _squeeze_if_scalar(member._traced_compute(unit.names, local))
+            if self._collection is None:
+                return values[""]
+            return values
+
+        return jax.jit(compute_one)
+
+    def _build_compute_all(self):
+        from torchmetrics_tpu.metric import _squeeze_if_scalar
+
+        units = self._units
+
+        def lane_compute(lane_states: Dict[str, Dict[str, Any]]):
+            values: Dict[str, Any] = {}
+            for unit in units:
+                local = self._lane_states(unit, lane_states[unit.key])
+                for name, member in unit.members:
+                    values[name] = _squeeze_if_scalar(member._traced_compute(unit.names, local))
+            if self._collection is None:
+                return values[""]
+            return values
+
+        return jax.jit(lambda states: jax.vmap(lane_compute)(states))
+
+    def _obs_timed_first_call(self, key: Any, fn: Any) -> Any:
+        cache = self._step_fns
+
+        def timed(*args: Any, **kwargs: Any) -> Any:
+            t0 = time.perf_counter()
+            out = fn(*args, **kwargs)
+            elapsed = time.perf_counter() - t0
+            cache[key] = fn
+            if _OBS.enabled:
+                telem = _telemetry_for(self)
+                telem.inc("trace_seconds", elapsed)
+                telem.observe("trace", elapsed)
+            return out
+
+        return timed
+
+    # -------------------------------------------------- snapshot/restore surface
+    def state_dict(
+        self,
+        destination: Optional[Dict] = None,
+        prefix: str = "",
+        keep_vars: bool = False,
+        integrity: bool = False,
+        all_states: bool = False,
+    ) -> Dict:
+        """Host-numpy copy of the stacked states + the ``#streams`` skeleton."""
+        if self._units is None or self._states is None:
+            raise TorchMetricsUserError("StreamPool has no states yet (no update() has run)")
+        destination = {} if destination is None else destination
+        keys: List[str] = []
+        for unit in self._units:
+            pre = f"{unit.key}." if unit.key else ""
+            states = self._states[unit.key]
+            for n in unit.names:
+                if n in unit.rings:
+                    st = jax.device_get(states[n])
+                    for part in ("data", "valid", "count"):
+                        k = f"{pre}{n}#{part}"
+                        destination[prefix + k] = np.asarray(st[part])
+                        keys.append(k)
+                else:
+                    k = f"{pre}{n}"
+                    destination[prefix + k] = np.asarray(jax.device_get(states[n]))
+                    keys.append(k)
+        destination[prefix + "#streams"] = {
+            "capacity": self.capacity,
+            "active": sorted(int(i) for i in self._active),
+            "counts": self._counts.copy(),
+            "units": [
+                {
+                    "key": u.key,
+                    "members": [name for name, _ in u.members],
+                    "names": list(u.names),
+                    "rings": dict(u.rings),
+                }
+                for u in self._units
+            ],
+        }
+        if integrity:
+            from torchmetrics_tpu._resilience.integrity import attach_integrity
+
+            attach_integrity(destination, keys, prefix, type(self).__name__)
+        return destination
+
+    def load_state_dict(self, state_dict: Dict, strict: Any = True, prefix: str = "") -> None:
+        """Restore the whole pool (capacity adopts the snapshot's)."""
+        from torchmetrics_tpu._resilience import integrity as _integrity
+
+        meta = state_dict.get(_integrity.integrity_key(prefix))
+        if meta is not None:
+            corrupted = _integrity.verify_states(
+                state_dict, prefix, meta, type(self).__name__, include_missing=strict is not False
+            )
+            if corrupted:
+                _integrity.raise_corrupted(type(self).__name__, corrupted)
+        blk = state_dict.get(prefix + "#streams")
+        if blk is None:
+            raise TorchMetricsUserError(
+                "checkpoint lacks the `#streams` block (not a StreamPool snapshot)"
+            )
+        cap = int(blk["capacity"])
+        if self._units is None:
+            self._adopt_skeleton(blk)
+        self.capacity = cap
+        self._counts = np.asarray(blk["counts"], dtype=np.int64).copy()
+        self._active = set(int(i) for i in blk["active"])
+        self._free = [i for i in range(cap) if i not in self._active]
+        heapq.heapify(self._free)
+        self._dirty = np.ones(cap, bool)
+        self._violations = np.zeros(cap, np.int64)
+        self._quarantined = np.zeros(cap, np.int64)
+        self._value_cache.clear()
+        states: Dict[str, Dict[str, Any]] = {}
+        for unit in self._units:
+            pre = f"{unit.key}." if unit.key else ""
+            ustates: Dict[str, Any] = {}
+            for n in unit.names:
+                if n in unit.rings:
+                    ustates[n] = {
+                        part: jnp.asarray(state_dict[f"{prefix}{pre}{n}#{part}"])
+                        for part in ("data", "valid", "count")
+                    }
+                else:
+                    ustates[n] = jnp.asarray(state_dict[f"{prefix}{pre}{n}"])
+            states[unit.key] = ustates
+        self._states = states
+        self._rebuild_defaults_from_states()
+        self._step_fns.clear()
+        self._compute_one_fn = None
+        self._compute_all_fn = None
+        self._zero_fn = None
+        hook = self.__dict__.get("_snapshot_hook")
+        if hook is not None:
+            hook.record_lifecycle("external", -1)
+
+    def load_stream_state(self, stream_id: int, rows: Dict[str, Any], count: int) -> None:
+        """Bind ONE stream's state rows (sliced from a snapshot) into its slot."""
+        self._check_slot(stream_id, attached=True)
+        if self._units is None or self._states is None:
+            raise TorchMetricsUserError(
+                "the pool has no stacked states to restore into; run load_state_dict()"
+                " (or one update) first, or restore through StreamSnapshotManager"
+            )
+        sid = jnp.int32(int(stream_id))
+        states = self._states
+        for unit in self._units:
+            pre = f"{unit.key}." if unit.key else ""
+            ust = dict(states[unit.key])
+            for n in unit.names:
+                if n in unit.rings:
+                    ust[n] = {
+                        part: ust[n][part].at[sid].set(jnp.asarray(rows[f"{pre}{n}#{part}"]))
+                        for part in ("data", "valid", "count")
+                    }
+                else:
+                    ust[n] = ust[n].at[sid].set(jnp.asarray(rows[f"{pre}{n}"]))
+            states = dict(states)
+            states[unit.key] = ust
+        self._states = states
+        self._counts[stream_id] = int(count)
+        self._dirty[stream_id] = True
+        self._value_cache.pop(int(stream_id), None)
+
+    def ensure_ready_from_snapshot(self, blk: Dict[str, Any], state_dict: Dict[str, Any], prefix: str = "") -> None:
+        """Build units + default stacked states from a snapshot skeleton.
+
+        Used by a per-stream restore into a pool that has never seen a
+        batch: the unit layout comes from the checkpoint's ``#streams``
+        block, ring row shapes from the checkpointed leaves, and every slot
+        starts at defaults (the restore then binds the one stream's rows).
+        """
+        if self._units is None:
+            self._adopt_skeleton(blk)
+        if self._states is None:
+            for unit in self._units:
+                pre = f"{unit.key}." if unit.key else ""
+                for n in unit.rings:
+                    data = np.asarray(state_dict[f"{prefix}{pre}{n}#data"])
+                    unit.ring_rows[n] = (tuple(int(s) for s in data.shape[2:]), data.dtype)
+            self._install_stacked_defaults(self._units)
+            self._states = self._place_defaults()
+
+    def _adopt_skeleton(self, blk: Dict[str, Any]) -> None:
+        """Unit skeleton from a checkpoint's ``#streams`` block (pre-first-update)."""
+        units: List[_Unit] = []
+        for u in blk["units"]:
+            key = u["key"]
+            metric = self.target._modules[key] if self._collection is not None else self.target
+            members = (
+                [(name, self.target._modules[name]) for name in u["members"]]
+                if self._collection is not None
+                else [("", self.target)]
+            )
+            names = list(u["names"])
+            exempt = frozenset(
+                n
+                for n in names
+                if n not in u["rings"]
+                and not np.all(np.isfinite(np.asarray(metric._defaults[n])))
+            )
+            units.append(
+                _Unit(
+                    key=key, metric=metric, members=members, names=names,
+                    rings=dict(u["rings"]), nan_exempt=exempt,
+                )
+            )
+        if self._collection is not None:
+            self._collection._groups = {i: list(u["members"]) for i, u in enumerate(blk["units"])}
+            self._collection._groups_checked = True
+        self._units = units
+        self._row_guards = any(
+            u.metric.nan_policy == "quarantine" or self._unit_flags(u) for u in units
+        )
+
+    def _rebuild_defaults_from_states(self) -> None:
+        """Derive stacked/row defaults after a restore (ring shapes from leaves)."""
+        for unit in self._units:
+            for n in unit.rings:
+                data = np.asarray(jax.device_get(self._states[unit.key][n]["data"]))
+                unit.ring_rows[n] = (tuple(int(s) for s in data.shape[2:]), data.dtype)
+        self._install_stacked_defaults(self._units)

@@ -404,6 +404,19 @@ class MetricCollection:
 
         return SpmdEngine(self, mesh=mesh, axis_name=axis_name, **kwargs)
 
+    def to_stream_pool(self, *, capacity: int = 8, **kwargs: Any) -> Any:
+        """N independent streams of this (fresh) collection, one vmapped step.
+
+        Compute groups share stacked states: each group's head updates once
+        per lane, every member computes from the head's slot rows inside the
+        same compiled executable, and ``pool.compute(i)`` returns a dict
+        keyed like :meth:`compute`. Every member class must pass the
+        eligibility manifest's stream-pool gate. See STREAMS.md.
+        """
+        from torchmetrics_tpu._streams import StreamPool
+
+        return StreamPool(self, capacity=capacity, **kwargs)
+
     def set_dtype(self, dst_type: Any) -> "MetricCollection":
         for m in self._modules.values():
             m.set_dtype(dst_type)

@@ -43,17 +43,25 @@ def _matthews_corrcoef_reduce(confmat: Array) -> Array:
     numerator = cov_ytyp
     denom = cov_ypyp * cov_ytyt
 
-    # reference edge case: a single row/column of the confmat nonzero
+    # reference edge case: a single row/column of the confmat nonzero. The
+    # branch on `confmat.shape[0]` is static (shape, not value); the value
+    # conditions are branchless `where` selects so the whole reduce traces —
+    # this is what certifies the class for the fused in-graph sync path.
     if confmat.shape[0] == 2:
         tn, fp, fn, tp = confmat.reshape(-1)
-        if bool(denom == 0):
-            if bool(tp == 0 and fn == 0) or bool(tp == 0 and fp == 0) or bool(tn == 0 and fn == 0) or bool(tn == 0 and fp == 0):
-                eps = jnp.finfo(jnp.float32).eps
-                numerator = tp * tn - fp * fn
-                denom = (tp + fp + eps) * (tp + fn + eps) * (tn + fp + eps) * (tn + fn + eps)
-    if bool(denom == 0):
-        return jnp.asarray(0.0, dtype=jnp.float32)
-    return numerator / jnp.sqrt(denom)
+        eps = jnp.finfo(jnp.float32).eps
+        degenerate = (denom == 0) & (
+            ((tp == 0) & (fn == 0))
+            | ((tp == 0) & (fp == 0))
+            | ((tn == 0) & (fn == 0))
+            | ((tn == 0) & (fp == 0))
+        )
+        numerator = jnp.where(degenerate, tp * tn - fp * fn, numerator)
+        denom = jnp.where(
+            degenerate, (tp + fp + eps) * (tp + fn + eps) * (tn + fp + eps) * (tn + fn + eps), denom
+        )
+    zero = denom == 0
+    return jnp.where(zero, jnp.asarray(0.0, dtype=jnp.float32), numerator / jnp.sqrt(jnp.where(zero, 1.0, denom)))
 
 
 def binary_matthews_corrcoef(

@@ -991,6 +991,25 @@ class Metric(ABC):
 
         return SpmdEngine(self, mesh=mesh, axis_name=axis_name, **kwargs)
 
+    def to_stream_pool(self, *, capacity: int = 8, **kwargs: Any) -> Any:
+        """N independent streams of this (fresh) metric behind one vmapped step.
+
+        Returns a :class:`~torchmetrics_tpu._streams.StreamPool` that stacks
+        ``capacity`` independent copies of this metric's state along a
+        leading slot axis and updates an arbitrary micro-batch of them per
+        compiled call (``pool.update(stream_ids, *args)``), with O(1)
+        ``attach``/``detach``/``reset(i)`` and per-stream ``compute(i)``.
+        The metric itself is the *template*: it never accumulates. Gated by
+        the eligibility manifest
+        (:func:`~torchmetrics_tpu._analysis.manifest.stream_pool_eligible`);
+        ineligible classes raise
+        :class:`~torchmetrics_tpu._streams.StreamPoolUnsupported` and keep
+        independent eager instances. See STREAMS.md.
+        """
+        from torchmetrics_tpu._streams import StreamPool
+
+        return StreamPool(self, capacity=capacity, **kwargs)
+
     def sync_in_jit(
         self,
         state: Dict[str, Array],

@@ -72,6 +72,23 @@ class PearsonCorrCoef(Metric):
             return _final_aggregation(self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total)
         return self.mean_x, self.mean_y, self.var_x, self.var_y, self.corr_xy, self.n_total
 
+    def _fold_gathered_states(self, gathered: dict) -> dict:
+        """Fold gathered ``(D, num_outputs)`` moment sets into ONE local set.
+
+        The SPMD engine's degradation fold calls this when handing device
+        states back to the eager stream: plain reductions merge per-state,
+        but these moment states merge *jointly* with the parallel-variance
+        update — the same ``_final_aggregation`` the compute path uses.
+        """
+        mean_x, mean_y, var_x, var_y, corr_xy, n_total = _final_aggregation(
+            gathered["mean_x"], gathered["mean_y"], gathered["var_x"],
+            gathered["var_y"], gathered["corr_xy"], gathered["n_total"],
+        )
+        return {
+            "mean_x": mean_x, "mean_y": mean_y, "var_x": var_x,
+            "var_y": var_y, "corr_xy": corr_xy, "n_total": n_total,
+        }
+
     def compute(self) -> Array:
         _, _, var_x, var_y, corr_xy, n_total = self._aggregate()
         return _pearson_corrcoef_compute(var_x, var_y, corr_xy, n_total)

@@ -352,17 +352,28 @@ _COLLECTIVES: Dict[Any, Any] = {
 }
 
 
+def validate_axis_groups(groups: Sequence[Sequence[int]], world: Optional[int] = None) -> None:
+    """The `axis_index_groups` invariant, in ONE place: equal-sized disjoint
+    subgroups partitioning ``0..world-1`` (the same constraints the native
+    primitives have). ``world`` defaults to the total membership; callers who
+    know their axis size pass it so a wrong-sized partition fails too. Both
+    the in-jit grouped selector and the SPMD engine's eager construction
+    check call this — the invariant cannot drift between them."""
+    sizes = {len(g) for g in groups}
+    if len(sizes) != 1:
+        raise ValueError(f"All `axis_index_groups` must have the same size, got sizes {sorted(sizes)}")
+    expected = sum(len(g) for g in groups) if world is None else world
+    seen = sorted(i for g in groups for i in g)
+    if seen != list(range(expected)):
+        raise ValueError(f"`axis_index_groups` must partition 0..{expected - 1}, got {groups}")
+
+
 def _grouped_member_selector(axis_name: str, groups: Sequence[Sequence[int]]) -> Callable[[Array], Array]:
     """Build ``value -> (group_size, ...)`` selecting this shard's group rows
     from a full all_gather. Groups must be equal-sized and partition the axis
     (the same constraints the native ``axis_index_groups`` primitives have)."""
-    sizes = {len(g) for g in groups}
-    if len(sizes) != 1:
-        raise ValueError(f"All `axis_index_groups` must have the same size, got sizes {sorted(sizes)}")
+    validate_axis_groups(groups)
     world = sum(len(g) for g in groups)
-    seen = sorted(i for g in groups for i in g)
-    if seen != list(range(world)):
-        raise ValueError(f"`axis_index_groups` must partition 0..{world - 1}, got {groups}")
 
     group_of = [0] * world
     for gid, g in enumerate(groups):

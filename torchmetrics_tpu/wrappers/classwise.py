@@ -63,3 +63,16 @@ class ClasswiseWrapper(WrapperMetric):
 
     def reset(self) -> None:
         self.metric.reset()
+
+    def to_stream_pool(self, *, capacity: int = 8, **kwargs: Any) -> Any:
+        """Multi-tenant fast path: N independent classwise streams, one pool.
+
+        Returns a
+        :class:`~torchmetrics_tpu._streams.adapters.PooledClasswise` whose
+        ``compute(i)`` yields this wrapper's labelled per-class dict for
+        stream ``i`` while all streams share one vmapped compiled update
+        step (STREAMS.md).
+        """
+        from torchmetrics_tpu._streams.adapters import PooledClasswise
+
+        return PooledClasswise(self, capacity=capacity, **kwargs)

@@ -85,6 +85,21 @@ class MultitaskWrapper(WrapperMetric):
             mt._postfix = postfix
         return mt
 
+    def to_stream_pool(self, **kwargs: Any) -> Any:
+        """Homogeneous-task fast path: one vmapped pool slot per task.
+
+        Returns a
+        :class:`~torchmetrics_tpu._streams.adapters.PooledMultitask` that
+        updates every task in ONE compiled vmapped step instead of one
+        Python dispatch per task. Requires every task metric to be the same
+        class with the same state structure (heterogeneous wrappers keep
+        this eager path); per-task batch rows must share one shape
+        (STREAMS.md).
+        """
+        from torchmetrics_tpu._streams.adapters import PooledMultitask
+
+        return PooledMultitask(self, **kwargs)
+
     def items(self, flatten: bool = True):
         """Iterate over (task name, metric) pairs (reference ``wrappers/multitask.py:106-119``).
 
