@@ -1,0 +1,193 @@
+"""Concurrency-safety pass (R7/R8/R9) suites over the seeded fixtures, plus
+the guard-map manifest contract (ISSUE-13 tentpole).
+
+Each ``viol_r[789]`` fixture plants known hazards at known lines; each
+``clean_r[789]`` twin exercises the same code shapes disciplined and must
+stay silent. The manifest tests pin the shape the locksan runtime
+sanitizer consumes (``ClassName.field -> [locks]``).
+"""
+
+from pathlib import Path
+
+import pytest
+
+from torchmetrics_tpu._analysis import analyze_paths, analyze_source, thread_safety_to_json
+from torchmetrics_tpu._analysis.concurrency import is_runtime_path
+
+FIXTURES = Path(__file__).parent / "fixtures"
+
+
+def _report(result, name):
+    """The ModuleConcurrency report for a fixture, keyed by display path."""
+    for path, rep in result.thread_safety.items():
+        if path.endswith(name):
+            return rep
+    raise AssertionError(f"no thread-safety report for {name}: {list(result.thread_safety)}")
+
+EXPECTED = {
+    # note() rmw, top() iterate, HalfGuarded.close inconsistent iterate,
+    # _enqueue module-global rmw
+    "viol_r7.py": [("R7", 13), ("R7", 16), ("R7", 34), ("R7", 41)],
+    # sleep + fsync under the lock, Event.wait under the lock,
+    # jax.block_until_ready under a module lock
+    "viol_r8.py": [("R8", 16), ("R8", 17), ("R8", 21), ("R8", 31)],
+    # B->A closes the A->B cycle, non-daemon never joined, abandoned daemon
+    "viol_r9.py": [("R9", 17), ("R9", 23), ("R9", 27)],
+}
+
+
+@pytest.mark.parametrize("fixture", sorted(EXPECTED))
+def test_true_positives_fire_with_exact_lines(fixture):
+    result = analyze_paths([str(FIXTURES / fixture)])
+    assert not result.parse_errors
+    got = [(v.rule, v.line) for v in result.violations]
+    assert got == EXPECTED[fixture]
+
+
+@pytest.mark.parametrize("fixture", ["clean_r7.py", "clean_r8.py", "clean_r9.py"])
+def test_clean_twins_stay_silent(fixture):
+    result = analyze_paths([str(FIXTURES / fixture)])
+    assert not result.parse_errors
+    assert result.violations == []
+
+
+# ------------------------------------------------------------ finding shape
+def test_r7_messages_cite_the_shared_reason_and_missing_guard():
+    result = analyze_paths([str(FIXTURES / "viol_r7.py")])
+    by_line = {v.line: v for v in result.violations}
+    assert "scrapes read while workers write" in by_line[13].message  # marker reason
+    assert "other sites guard it with" in by_line[34].message  # inconsistent case
+    assert "module global" in by_line[41].message
+
+
+def test_r9_distinguishes_nondaemon_leak_from_abandoned_daemon():
+    result = analyze_paths([str(FIXTURES / "viol_r9.py")])
+    msgs = {v.line: v.message for v in result.violations}
+    assert "blocks interpreter exit" in msgs[23]
+    assert "baselined with a justification" in msgs[27]
+    assert "lock-order cycle" in msgs[17]
+
+
+def test_inline_suppression_works_for_concurrency_rules():
+    src = (FIXTURES / "viol_r8.py").read_text()
+    src = src.replace(
+        "time.sleep(0.01)  # R8: sleep while holding the lock",
+        "time.sleep(0.01)  # lint-ok: R8 startup-only path, contention impossible",
+    )
+    result = analyze_source(src, path="viol_r8.py")
+    assert ("R8", 16) not in [(v.rule, v.line) for v in result.violations]
+
+
+# --------------------------------------------------------------- guard maps
+def test_guard_map_inferred_from_with_lock_scopes():
+    result = analyze_paths([str(FIXTURES / "clean_r7.py")])
+    rep = _report(result, "clean_r7.py")
+    disc = rep.classes["Disciplined"]
+    assert disc.shared_reason  # marker recognized
+    assert disc.fields["volumes"].verdict == "guarded"
+    assert disc.fields["volumes"].guards == ["_lock"]
+    # plain scalar flag stores are exempt (GIL-atomic)
+    assert "flag" not in disc.fields
+    # memo caches (keyed store + keyed read, no iterate/rmw) are exempt
+    assert "MemoCache" not in {
+        name for name, c in rep.classes.items() if c.fields
+    }
+
+
+def test_guarded_by_marker_counts_as_held():
+    result = analyze_paths([str(FIXTURES / "clean_r7.py")])
+    assert not [v for v in result.violations if v.rule == "R7"]
+
+
+def test_thread_inventory_records_target_daemon_join_and_captures():
+    result = analyze_paths([str(FIXTURES / "clean_r9.py")])
+    rep = _report(result, "clean_r9.py")
+    by_scope = {t.scope: t for t in rep.threads}
+    tidy = by_scope["TidyWorker.__init__"]
+    assert tidy.target == "self._loop" and tidy.daemon is True and tidy.joined
+    assert tidy.captures == ["self"]
+    scoped = by_scope["scoped_worker"]
+    assert scoped.daemon is False and scoped.joined
+
+
+def test_module_global_guard_map():
+    result = analyze_paths([str(FIXTURES / "clean_r9.py")])
+    # clean_r9 has locks but no tracked global containers; viol_r7's
+    # _PENDING is tracked and (inconsistently) unguarded
+    result = analyze_paths([str(FIXTURES / "viol_r7.py")])
+    rep = _report(result, "viol_r7.py")
+    assert rep.global_guards["_PENDING"].verdict == "inconsistent"
+
+
+# ----------------------------------------------------------------- manifest
+def test_manifest_payload_shape_and_runtime_scoping():
+    result = analyze_paths([str(Path(__file__).parents[3] / "torchmetrics_tpu" / "_streams")])
+    payload = thread_safety_to_json(result.thread_safety.values())
+    assert payload["version"] == 1
+    assert payload["rules"] == ["R7", "R8", "R9"]
+    mod = payload["modules"]["torchmetrics_tpu/_streams/telemetry.py"]
+    assert mod["verdict"] == "guarded"
+    labeler = mod["classes"]["StreamLabeler"]
+    assert labeler["fields"]["volumes"] == {"guards": ["_lock"], "verdict": "guarded"}
+
+
+def test_runtime_path_predicate():
+    assert is_runtime_path("torchmetrics_tpu/_observability/telemetry.py")
+    assert is_runtime_path("torchmetrics_tpu/metric.py")
+    assert is_runtime_path("torchmetrics_tpu/utilities/distributed.py")
+    assert not is_runtime_path("torchmetrics_tpu/regression/mse.py")
+    assert not is_runtime_path("torchmetrics_tpu/utilities/data.py")
+
+
+# ------------------------------------------------- the bugs this pass found
+def test_streamlabeler_rebalance_is_guarded_against_concurrent_note():
+    """The pre-fix hazard: rebalance() iterated volumes.items() while a
+    concurrent note() inserted — 'dictionary changed size during iteration'.
+    Drive it live: many writer threads + a rebalancer; must not raise."""
+    import threading
+
+    from torchmetrics_tpu._streams.telemetry import StreamLabeler
+
+    labeler = StreamLabeler(k=4, rebalance_every=7)
+    errors = []
+
+    def hammer(base):
+        try:
+            for i in range(800):
+                labeler.note(base + (i % 97))
+                labeler.label(i % 97)
+        except Exception as err:  # noqa: BLE001 - the regression under test
+            errors.append(err)
+
+    threads = [threading.Thread(target=hammer, args=(w * 1000,)) for w in range(4)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors
+    labeler.rebalance()
+    assert sum(labeler.volumes.values()) == 4 * 800
+
+
+def test_telemetry_registry_weakref_retire_is_reentrancy_safe():
+    """The pre-fix hazard: the weakref callback took the registry lock, so a
+    gc triggered while THIS thread held it (allocation inside aggregate)
+    self-deadlocked. The callback must stay lock-free: dropping the last
+    reference while holding the lock retires cleanly via the pending queue."""
+    from torchmetrics_tpu._observability.telemetry import TelemetryRegistry
+
+    registry = TelemetryRegistry()
+
+    class Obj:
+        pass
+
+    obj = Obj()
+    telem = registry.register(obj)
+    telem.inc("update_calls|path=eager")
+    with registry._lock:
+        # old code: _on_collect -> _retire -> self._lock.acquire() -> deadlock
+        del obj
+    assert len(registry._pending_retire) == 1
+    agg = registry.aggregate()
+    assert agg["Obj"]["retired_instances"] == 1
+    assert agg["Obj"]["counters"]["update_calls|path=eager"] == 1

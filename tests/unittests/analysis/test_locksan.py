@@ -1,0 +1,130 @@
+"""Runtime lock-discipline sanitizer (``TM_TPU_LOCKSAN``) contract tests.
+
+The sanitizer verifies live what the static pass inferred statically:
+guard-map field accesses, reentrant acquisition of non-reentrant locks,
+and cross-lock acquisition-order cycles. Disabled it must hand out plain
+``threading.Lock`` objects (the one-branch contract measured by the
+``locksan_disabled_retention`` bench line).
+"""
+
+import threading
+
+import pytest
+
+from torchmetrics_tpu._analysis import locksan
+from torchmetrics_tpu._analysis.locksan import (
+    LockDisciplineError,
+    SanLock,
+    check_access,
+    new_lock,
+    set_locksan_enabled,
+)
+
+
+@pytest.fixture()
+def san():
+    set_locksan_enabled(True)
+    locksan.reset()
+    yield locksan
+    set_locksan_enabled(False)
+    locksan.reset()
+
+
+def test_disabled_factory_returns_a_plain_lock():
+    set_locksan_enabled(False)
+    lock = new_lock("X._lock")
+    assert not isinstance(lock, SanLock)
+    with lock:  # still a working lock
+        pass
+
+
+def test_enabled_factory_returns_an_instrumented_lock(san):
+    lock = new_lock("X._lock")
+    assert isinstance(lock, SanLock)
+    with lock:
+        assert lock.held_by_current_thread()
+    assert not lock.held_by_current_thread()
+
+
+def test_reentrant_acquire_is_reported(san):
+    lock = SanLock("X._lock")
+    with lock:
+        with pytest.raises(LockDisciplineError, match="reentrant acquire"):
+            lock.acquire()
+    assert any("reentrant" in v for v in locksan.violations())
+
+
+def test_lock_order_cycle_is_reported_at_the_closing_edge(san):
+    a, b = SanLock("A"), SanLock("B")
+    with a:
+        with b:  # records A -> B
+            pass
+    with b:
+        with pytest.raises(LockDisciplineError, match="lock-order cycle"):
+            with a:  # closes the cycle: B -> A
+                pass
+
+
+def test_consistent_order_never_fires(san):
+    a, b = SanLock("A"), SanLock("B")
+    for _ in range(3):
+        with a:
+            with b:
+                pass
+    assert locksan.violations() == []
+
+
+def test_guard_map_assertion_catches_an_unguarded_access(san):
+    # StreamLabeler.volumes -> ["_lock"] in the checked-in manifest; a
+    # labeler constructed with the sanitizer on carries a SanLock
+    from torchmetrics_tpu._streams.telemetry import StreamLabeler
+
+    labeler = StreamLabeler(k=2)
+    assert isinstance(labeler._lock, SanLock)
+    with pytest.raises(LockDisciplineError, match="StreamLabeler.volumes"):
+        check_access(labeler, "volumes")
+    with labeler._lock:
+        check_access(labeler, "volumes")  # held: clean
+
+
+def test_instrumented_hot_paths_run_clean(san):
+    # the real instrumentation sites (note/publish/aggregate) must satisfy
+    # their own declared discipline with the sanitizer armed
+    from torchmetrics_tpu._observability import set_telemetry_enabled
+    from torchmetrics_tpu._observability.events import BUS
+    from torchmetrics_tpu._observability.telemetry import REGISTRY
+    from torchmetrics_tpu._streams.telemetry import StreamLabeler
+
+    labeler = StreamLabeler(k=2, rebalance_every=3)
+    for i in range(10):
+        labeler.note(i % 5)
+    set_telemetry_enabled(True)
+    try:
+        BUS.publish("locksan_test", "test", "hello")
+        REGISTRY.aggregate()
+    finally:
+        set_telemetry_enabled(False)
+        BUS.clear()
+    assert locksan.violations() == []
+
+
+def test_setter_retrofits_the_process_singletons(san):
+    from torchmetrics_tpu._observability.events import BUS
+    from torchmetrics_tpu._observability.telemetry import REGISTRY
+    from torchmetrics_tpu._resilience import guard
+
+    assert isinstance(BUS._lock, SanLock)
+    assert isinstance(REGISTRY._lock, SanLock)
+    assert isinstance(guard._worker_lock, SanLock)
+
+
+def test_violations_survive_for_harness_assertions(san):
+    lock = SanLock("Y._lock")
+    with lock:
+        try:
+            lock.acquire()
+        except LockDisciplineError:
+            pass
+    assert len(locksan.violations()) == 1
+    locksan.reset()
+    assert locksan.violations() == []

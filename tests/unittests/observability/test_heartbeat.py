@@ -88,3 +88,84 @@ def test_failure_records_phase_failed(journal):
     failed = [r for r in records if r["event"] == "phase_failed"]
     assert failed and failed[0]["phase"] == "phase4:boom"
     assert records[-1]["event"] == "run_end" and records[-1]["ok"] is False
+
+
+# ------------------------------------------------------- run-id attribution
+# ISSUE-13 satellite (PR-12 review bug): a child that died before
+# _Heartbeat.__init__ truncated the journal left the PREVIOUS run's records
+# in place, and _journal_hung_phase blamed a stale phase from that run.
+
+
+def _stale_journal(path, run_id="stale-run", phase="phase3:from_last_round"):
+    records = [
+        {"event": "run_start", "phase": None, "run": run_id, "t": 0.0},
+        {"event": "phase_start", "phase": "phase1:done", "run": run_id, "t": 0.1},
+        {"event": "phase_end", "phase": "phase1:done", "run": run_id, "t": 0.2},
+        {"event": "phase_start", "phase": phase, "run": run_id, "t": 0.3},
+        # no phase_end: the previous round was killed mid-phase
+    ]
+    path.write_text("\n".join(json.dumps(r) for r in records) + "\n")
+
+
+def test_every_record_is_stamped_with_the_run_id(journal, monkeypatch):
+    monkeypatch.setenv("TM_TPU_MULTICHIP_RUN_ID", "run-abc")
+    hb = graft._Heartbeat(n_devices=8)
+    hb.begin("phase1:x")
+    hb.close(ok=True)
+    records = _records(journal)
+    assert records and all(r["run"] == "run-abc" for r in records)
+
+
+def test_child_dead_before_init_is_not_blamed_on_a_stale_phase(journal):
+    # the failure mode: parent's truncation failed / was skipped, the child
+    # wedged inside `import jax`, and only last round's records are on disk
+    _stale_journal(journal)
+    assert graft._journal_hung_phase("this-round") == "<child died before heartbeat init>"
+    # without an expected run id (legacy callers) the newest run on disk is
+    # still attributed — but never a run OLDER than the newest run_start
+    assert graft._journal_hung_phase() == "phase3:from_last_round"
+
+
+def test_new_run_records_shadow_the_stale_ones(journal, monkeypatch):
+    _stale_journal(journal)
+    # a real child appends (mode "w" truncates — emulate an append-only FS
+    # failure by re-writing stale + fresh records, the worst case)
+    stale = journal.read_text()
+    monkeypatch.setenv("TM_TPU_MULTICHIP_RUN_ID", "fresh-run")
+    hb = graft._Heartbeat(n_devices=8)
+    hb.begin("phase2:current")
+    hb.end()
+    fresh = journal.read_text()
+    journal.write_text(stale + fresh)
+    # attribution follows the newest run_start's id; the stale unclosed
+    # phase3 must not resurface
+    assert graft._journal_hung_phase("fresh-run") == "<none open>"
+    assert graft._journal_hung_phase() == "<none open>"
+    hb.close(ok=True)
+
+
+def test_parent_truncates_journal_before_spawn(journal, monkeypatch, tmp_path):
+    # _run_dryrun_child must empty the journal before exec'ing the child so
+    # even a pre-init death leaves "<none started>", not last round's phase.
+    # Intercept subprocess.run so no real child (and no jax import) happens.
+    import subprocess
+
+    _stale_journal(journal)
+    captured = {}
+
+    def fake_run(cmd, env=None, **kwargs):
+        captured["env"] = env
+
+        class R:
+            returncode = 0
+            stdout = ""
+            stderr = ""
+
+        return R()
+
+    monkeypatch.setattr(subprocess, "run", fake_run)
+    rc, _out, run_id = graft._run_dryrun_child(2, simulate=True)
+    assert rc == 0
+    assert journal.read_text() == ""  # truncated before spawn
+    assert captured["env"]["TM_TPU_MULTICHIP_RUN_ID"] == run_id
+    assert graft._journal_hung_phase(run_id) == "<child died before heartbeat init>"

@@ -46,6 +46,24 @@ def test_chaos_smoke_watchdog_stall():
     _run(101, spec=ChaosSpec(stall_final=True))
 
 
+def test_chaos_smoke_under_locksan():
+    """ISSUE-13 acceptance: the chaos schedule runs clean with the lock
+    sanitizer armed — the guarded-sync workers, snapshot writer, event bus
+    and telemetry registry must satisfy the statically-declared discipline
+    live, including under the watchdog-stall path."""
+    from torchmetrics_tpu._analysis import locksan
+
+    locksan.set_locksan_enabled(True)
+    locksan.reset()
+    try:
+        _run(7)
+        _run(102, spec=ChaosSpec(stall_final=True))
+        assert locksan.violations() == []
+    finally:
+        locksan.set_locksan_enabled(False)
+        locksan.reset()
+
+
 def test_chaos_exercises_the_fault_surface():
     """The smoke seeds must actually hit the interesting faults, not idle."""
     kinds = set()

@@ -453,3 +453,48 @@ def test_manual_mid_stream_load_survives_preemption(tmp_path):
         report = mgr2.restore_latest()
     assert report.replayed == 2, report  # only the post-load updates replay
     np.testing.assert_allclose(np.asarray(fresh.compute()), expected)
+
+
+# ------------------------------------------------- writer shutdown ordering
+# ISSUE-13: the async writer's queue accepted jobs after its loop-exit
+# sentinel (a job nobody would ever run — silent durability loss) and a
+# drain() after close() parked on a barrier event that could never fire
+# (a full 30 s stall on every flush-after-close).
+
+
+def test_writer_drain_after_close_returns_immediately(tmp_path):
+    import time
+
+    metric = MeanSquaredError()
+    mgr = SnapshotManager(metric, tmp_path, SnapshotPolicy(async_write=True))
+    metric.update(jnp.ones(4), jnp.zeros(4))
+    mgr.close()
+    t0 = time.perf_counter()
+    mgr.flush()  # pre-fix: blocked the full drain timeout
+    assert time.perf_counter() - t0 < 1.0
+
+
+def test_writer_refuses_jobs_after_close(tmp_path):
+    from torchmetrics_tpu._resilience.snapshot import _Writer
+
+    w = _Writer()
+    ran = []
+    w.submit(lambda: ran.append(1))
+    w.drain()
+    w.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        w.submit(lambda: ran.append(2))  # pre-fix: silently swallowed
+    assert ran == [1]
+    w.close()  # idempotent
+
+
+def test_closed_manager_degrades_not_corrupts_on_late_snapshot(tmp_path):
+    # a snapshot forced through a closed manager must not leave a queued-
+    # but-never-written generation: the refusal surfaces as an exception
+    # the durability seams turn into a degradation, never silence
+    metric = MeanSquaredError()
+    mgr = SnapshotManager(metric, tmp_path, SnapshotPolicy(async_write=True))
+    metric.update(jnp.ones(4), jnp.zeros(4))
+    mgr.close()
+    with pytest.raises(RuntimeError, match="closed"):
+        mgr.snapshot_now()

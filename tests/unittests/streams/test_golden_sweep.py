@@ -20,6 +20,25 @@ from torchmetrics_tpu._analysis.manifest import stream_pool_eligible
 N_STREAMS = 64
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _locksan_armed():
+    """ISSUE-13 acceptance: the whole golden sweep runs with the lock
+    sanitizer armed, so every pool's StreamLabeler (and the process
+    singletons it publishes telemetry through) must satisfy the declared
+    guard map live — the statically-inferred discipline is verified, not
+    assumed. Zero recorded violations at module teardown."""
+    from torchmetrics_tpu._analysis import locksan
+
+    locksan.set_locksan_enabled(True)
+    locksan.reset()
+    yield
+    try:
+        assert locksan.violations() == [], locksan.violations()
+    finally:
+        locksan.set_locksan_enabled(False)
+        locksan.reset()
+
+
 def _sweep_names():
     names = []
     for name, (ctor, _maker) in sorted(CASES.items()):

@@ -6,6 +6,7 @@ Usage:
     python tools/lint_metrics.py torchmetrics_tpu/ --json     # CI / machines
     python tools/lint_metrics.py torchmetrics_tpu/ --write-baseline
     python tools/lint_metrics.py torchmetrics_tpu/ --write-manifest
+    python tools/lint_metrics.py torchmetrics_tpu/ --write-thread-safety
 
 Exit status: 0 when no un-baselined violations (and no parse errors),
 1 otherwise. ``--write-baseline`` rewrites the suppression file to the
@@ -42,6 +43,10 @@ def main(argv=None) -> int:
         help="regenerate the compile-eligibility manifest (verdict per public Metric subclass)",
     )
     parser.add_argument(
+        "--write-thread-safety", action="store_true",
+        help="regenerate the concurrency guard-map manifest (per-module verdicts, R7-R9)",
+    )
+    parser.add_argument(
         "--explain", metavar="CLASS", default=None,
         help="print the proven eligibility verdict, check inventory, and blockers for one class"
         " (bare class name or dotted qualname)",
@@ -51,13 +56,17 @@ def main(argv=None) -> int:
     from torchmetrics_tpu._analysis import (
         ELIGIBILITY_PATH,
         MANIFEST_PATH,
+        RULES,
+        THREAD_SAFETY_PATH,
         analyze_paths,
         eligibility_to_json,
         load_baseline,
         split_baselined,
+        thread_safety_to_json,
         write_baseline,
         write_eligibility,
         write_manifest,
+        write_thread_safety,
     )
 
     t0 = time.perf_counter()
@@ -131,6 +140,24 @@ def main(argv=None) -> int:
         print(f"wrote {n} eligibility verdicts to {ELIGIBILITY_PATH}")
         return 0
 
+    if args.write_thread_safety:
+        from torchmetrics_tpu._analysis.manifest import load_thread_safety
+
+        prior = load_thread_safety(THREAD_SAFETY_PATH) if THREAD_SAFETY_PATH.exists() else {}
+        dropped = sorted(p for p in prior if p not in scanned)
+        if dropped:
+            print(
+                f"refusing --write-thread-safety on a partial scan: {len(dropped)} previously"
+                f" recorded module(s) were not scanned (e.g. {dropped[0]}); rerun on the"
+                " package root"
+            )
+            return 2
+        n = write_thread_safety(
+            thread_safety_to_json(result.thread_safety.values()), THREAD_SAFETY_PATH
+        )
+        print(f"wrote {n} module thread-safety verdicts to {THREAD_SAFETY_PATH}")
+        return 0
+
     if args.explain:
         wanted = args.explain
         matches = [
@@ -168,12 +195,26 @@ def main(argv=None) -> int:
         return 0
 
     if args.json:
+        # per-rule finding counts over the FULL catalog (zeros included), so
+        # a CI diff of two reports shows exactly which rule moved; schema in
+        # ANALYSIS.md ("--json schema")
+        def _rule_key(rule_id):
+            return int(rule_id[1:])
+
+        rule_counts = {
+            rule_id: {
+                "new": sum(1 for v in new if v.rule == rule_id),
+                "baselined": sum(1 for v in suppressed if v.rule == rule_id),
+            }
+            for rule_id in sorted(RULES, key=_rule_key)
+        }
         print(
             json.dumps(
                 {
                     "files_scanned": result.files_scanned,
                     "classes_seen": result.classes_seen,
                     "certified_count": len(result.certified),
+                    "rule_counts": rule_counts,
                     "eligibility": {
                         verdict: sum(
                             1 for v in result.eligibility.values() if v.public and v.verdict == verdict

@@ -23,18 +23,27 @@ from torchmetrics_tpu._analysis.eligibility import (
     EligibilityPass,
     eligibility_to_json,
 )
+from torchmetrics_tpu._analysis.concurrency import (
+    ModuleConcurrency,
+    ThreadSite,
+    is_runtime_path,
+    thread_safety_to_json,
+)
 from torchmetrics_tpu._analysis.engine import AnalysisResult, analyze_paths, analyze_source
 from torchmetrics_tpu._analysis.manifest import (
     ELIGIBILITY_PATH,
     MANIFEST_PATH,
+    THREAD_SAFETY_PATH,
     compiled_validation_eligible,
     fingerprint_skip_allowed,
     load_eligibility,
     load_manifest,
+    load_thread_safety,
     set_eligibility_enabled,
     set_fingerprint_skip_enabled,
     write_eligibility,
     write_manifest,
+    write_thread_safety,
 )
 from torchmetrics_tpu._analysis.model import Violation
 from torchmetrics_tpu._analysis.rules import RULES, Rule, rule
@@ -48,18 +57,25 @@ __all__ = [
     "ELIGIBILITY_PATH",
     "EligibilityPass",
     "MANIFEST_PATH",
+    "ModuleConcurrency",
     "RULES",
     "Rule",
+    "THREAD_SAFETY_PATH",
+    "ThreadSite",
     "Violation",
     "analyze_paths",
     "analyze_source",
     "compiled_validation_eligible",
     "eligibility_to_json",
     "fingerprint_skip_allowed",
+    "is_runtime_path",
     "load_baseline",
     "load_eligibility",
     "load_manifest",
+    "load_thread_safety",
     "rule",
+    "thread_safety_to_json",
+    "write_thread_safety",
     "set_eligibility_enabled",
     "set_fingerprint_skip_enabled",
     "split_baselined",

@@ -13,7 +13,7 @@ from dataclasses import dataclass, field
 from pathlib import Path
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from torchmetrics_tpu._analysis import hostsync, structural
+from torchmetrics_tpu._analysis import concurrency, hostsync, structural
 from torchmetrics_tpu._analysis.eligibility import (
     VERDICT_METADATA_ONLY,
     ClassEligibility,
@@ -39,6 +39,10 @@ class AnalysisResult:
     # metric class in a *scanned* module — the R6 gate and the eligibility
     # manifest both read from here
     eligibility: Dict[str, ClassEligibility] = field(default_factory=dict)
+    # concurrency-safety reports (path -> ModuleConcurrency) for every
+    # rule-checked module — the thread_safety.json manifest writer and the
+    # locksan guard-map loader both read from here
+    thread_safety: Dict[str, "concurrency.ModuleConcurrency"] = field(default_factory=dict)
     # display paths of rule-checked files (context siblings excluded):
     # baseline staleness is only decidable for files that were scanned
     scanned_paths: List[str] = field(default_factory=list)
@@ -246,6 +250,12 @@ def _check_r6(cls, verdict: Optional[ClassEligibility], source) -> List[Violatio
 def _run_rules_for_module(registry, mod, source, result, scan_kernels: bool, eligibility=None) -> None:
     """Rule dispatch for one indexed module — the single copy both
     :func:`analyze_paths` and :func:`analyze_source` drive."""
+    # concurrency rules run on every scanned module: they are inert where no
+    # threads/locks/shared markers exist, and the per-module report feeds the
+    # thread_safety.json manifest for the serving-runtime subset
+    conc_violations, conc_report = concurrency.check_module(mod, source)
+    result.violations.extend(conc_violations)
+    result.thread_safety[mod.path] = conc_report
     for cls in mod.classes.values():
         result.classes_seen += 1
         if registry.is_metric_subclass(cls):

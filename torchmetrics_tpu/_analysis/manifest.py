@@ -24,6 +24,8 @@ MANIFEST_VERSION = 1
 
 ELIGIBILITY_PATH = Path(__file__).parent / "eligibility.json"
 
+THREAD_SAFETY_PATH = Path(__file__).parent / "thread_safety.json"
+
 _manifest_cache: Optional[FrozenSet[str]] = None
 _class_cache: Dict[type, bool] = {}
 # eligibility verdicts (qualname -> verdict string) + per-class memo for the
@@ -82,6 +84,7 @@ def fingerprint_skip_enabled() -> bool:
 
 def invalidate_cache() -> None:
     global _manifest_cache, _eligibility_cache, _in_graph_cache
+    global _thread_safety_cache, _guard_map_cache
     _manifest_cache = None
     _class_cache.clear()
     _eligibility_cache = None
@@ -89,6 +92,8 @@ def invalidate_cache() -> None:
     _in_graph_cache = None
     _in_graph_class_cache.clear()
     _stream_pool_class_cache.clear()
+    _thread_safety_cache = None
+    _guard_map_cache = None
 
 
 def write_eligibility(payload: Dict[str, object], path: Optional[Path] = None) -> int:
@@ -237,6 +242,62 @@ def compiled_validation_eligible(cls: type) -> bool:
     allowed = verdicts.get(qualname) == "metadata_only"
     _eligibility_class_cache[cls] = allowed
     return allowed
+
+
+_thread_safety_cache: Optional[Dict[str, object]] = None
+_guard_map_cache: Optional[Dict[str, tuple]] = None
+
+
+def write_thread_safety(payload: Dict[str, object], path: Optional[Path] = None) -> int:
+    """Write the concurrency guard-map manifest (see ``concurrency.py``)."""
+    (path or THREAD_SAFETY_PATH).write_text(
+        json.dumps(payload, indent=2, sort_keys=True) + "\n", encoding="utf-8"
+    )
+    modules = payload.get("modules", {})
+    return len(modules) if isinstance(modules, dict) else 0
+
+
+def load_thread_safety(path: Optional[Path] = None) -> Dict[str, object]:
+    """Raw per-module verdicts + guard maps from the checked-in manifest."""
+    global _thread_safety_cache
+    if path is None and _thread_safety_cache is not None:
+        return _thread_safety_cache
+    p = path or THREAD_SAFETY_PATH
+    try:
+        data = json.loads(p.read_text(encoding="utf-8"))
+        modules = data.get("modules", {})
+        if not isinstance(modules, dict):
+            modules = {}
+    except (OSError, ValueError, AttributeError):
+        modules = {}
+    if path is None:
+        _thread_safety_cache = modules
+    return modules
+
+
+def guard_map() -> Dict[str, tuple]:
+    """``"ClassName.field" -> (lock attr names...)`` from the manifest.
+
+    The flat view the ``locksan`` runtime sanitizer asserts against: a
+    declared-guarded field accessed without its lock held is a discipline
+    violation. Keys use bare class names — the serving-runtime classes the
+    manifest covers are unique by name, and the sanitizer looks instances
+    up by ``type(obj).__name__``.
+    """
+    global _guard_map_cache
+    if _guard_map_cache is not None:
+        return _guard_map_cache
+    flat: Dict[str, tuple] = {}
+    for entry in load_thread_safety().values():
+        if not isinstance(entry, dict):
+            continue
+        for cls_name, cls_entry in (entry.get("classes") or {}).items():
+            for fname, fentry in (cls_entry.get("fields") or {}).items():
+                guards = tuple(fentry.get("guards") or ())
+                if guards and fentry.get("verdict") == "guarded":
+                    flat[f"{cls_name}.{fname}"] = guards
+    _guard_map_cache = flat
+    return flat
 
 
 def fingerprint_skip_allowed(cls: type) -> bool:

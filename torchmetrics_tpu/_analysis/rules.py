@@ -1,8 +1,9 @@
 """Rule catalog for the trace-safety static analyzer.
 
-Each rule encodes one XLA-semantics hazard class specific to this codebase
+Each rule encodes one hazard class specific to this codebase — XLA
+semantics for R1-R6, thread-safety of the serving runtime for R7-R9
 (see ``ANALYSIS.md`` for the full catalog with examples and baselining
-instructions). Rules are identified by stable short IDs (``R1``..``R5``)
+instructions). Rules are identified by stable short IDs (``R1``..``R9``)
 that appear in violations, baseline entries, and inline suppressions.
 """
 
@@ -90,6 +91,44 @@ RULES: Dict[str, Rule] = {
                 " `eligibility.json`); otherwise the per-batch host checks permanently pin the"
                 " metric to the eager path. R5 therefore fires only on classes whose eager path"
                 " the prover could NOT certify metadata-only and that declare no flag vector."
+            ),
+        ),
+        Rule(
+            id="R7",
+            name="unguarded-cross-thread-access",
+            summary="shared mutable state accessed without (or with inconsistent) lock discipline",
+            rationale=(
+                "The serving runtime has real concurrency: watchdog workers, the off-thread"
+                " snapshot writer, Prometheus scrapes against live registries, multi-tenant"
+                " ingestion. A container field reachable from more than one thread that is"
+                " mutated at one site and iterated/mutated at another without one common lock"
+                " is a 'dict changed size during iteration' / lost-update bug waiting for load"
+                " — exactly the class of bug post-review hardening kept finding by hand."
+            ),
+        ),
+        Rule(
+            id="R8",
+            name="blocking-call-under-lock",
+            summary="blocking call (jax dispatch, file IO/fsync, transport wait, Event.wait, sleep) while holding a lock",
+            rationale=(
+                "A lock held across a host-blocking call serializes every other thread behind"
+                " device dispatch, disk latency, or a transport stall — the deadlock/stall shape"
+                " the guarded-sync watchdog exists to catch at runtime. Locks in this runtime"
+                " guard host-side bookkeeping only; anything that can block must run outside"
+                " the critical section."
+            ),
+        ),
+        Rule(
+            id="R9",
+            name="lock-order-and-thread-lifecycle",
+            summary="lock-acquisition-order cycles, or spawned threads with no join/daemon lifecycle",
+            rationale=(
+                "Two locks taken in opposite orders on two paths deadlock under load; a"
+                " non-daemon thread that is started and never joined blocks interpreter exit,"
+                " and an abandoned-by-design daemon worker must say so explicitly (baseline"
+                " entry with a justification) so the abandonment is a decision, not an"
+                " accident — the chaos harness's `_run_schedule` leaked its writer thread"
+                " exactly this way before it grew a `finally: close()`."
             ),
         ),
     )

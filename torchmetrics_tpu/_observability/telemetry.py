@@ -24,11 +24,14 @@ needed to pin down why a "compiled" metric keeps paying trace time.
 
 from __future__ import annotations
 
-import threading
 import weakref
+from collections import deque
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
 
+from torchmetrics_tpu._analysis.locksan import SAN as _SAN
+from torchmetrics_tpu._analysis.locksan import check_access as _san_check
+from torchmetrics_tpu._analysis.locksan import new_lock as _san_lock
 from torchmetrics_tpu._observability.events import BUS
 from torchmetrics_tpu._observability.reservoir import LatencyReservoir
 from torchmetrics_tpu._observability.state import OBS
@@ -61,8 +64,18 @@ def _split_key(key: str) -> Tuple[str, Dict[str, str]]:
     return family, labels
 
 
-class MetricTelemetry:
-    """Counters + latency reservoirs for ONE metric instance (host-side)."""
+class MetricTelemetry:  # concurrency: shared exporters scrape via the registry while hot paths mutate
+    """Counters + latency reservoirs for ONE metric instance (host-side).
+
+    Deliberately lock-free: each instance has ONE writer (the thread
+    driving its metric) and scrape-side readers copy containers with
+    C-level ``dict(...)`` under the GIL before iterating (see
+    ``TelemetryRegistry.aggregate``). A lock here would put a contended
+    acquire on the telemetry-enabled hot path for every counter bump. The
+    static concurrency pass (R7) flags this class's container accesses;
+    the findings are baselined with this justification rather than locked
+    — the single-writer contract is the design.
+    """
 
     __slots__ = (
         "name",
@@ -261,12 +274,21 @@ class TelemetryRegistry:
     """Process-wide directory of live metric telemetries + retired totals."""
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = _san_lock("TelemetryRegistry._lock")
         # id(metric) -> (weakref-to-metric, telemetry); the weakref callback
-        # retires the entry, folding its counters into per-class totals
+        # queues the entry for retirement, folding its counters into
+        # per-class totals at the next locked entry point
         self._live: Dict[int, Tuple[Any, MetricTelemetry]] = {}
         self._retired: Dict[str, Dict[str, float]] = {}
         self._retired_instances: Dict[str, int] = {}
+        # oids whose metric was collected but not yet folded. The weakref
+        # callback must NOT take _lock: gc can run it on ANY thread at ANY
+        # allocation — including inside this registry's own critical
+        # sections, where a non-reentrant acquire self-deadlocks (and a
+        # reentrant one would mutate _live mid-iteration). deque.append is
+        # GIL-atomic, so the callback stays lock-free and every locked
+        # entry point drains the queue first.
+        self._pending_retire: "deque[int]" = deque()
 
     # ------------------------------------------------------------- lifecycle
     def register(self, obj: Any) -> MetricTelemetry:
@@ -274,21 +296,31 @@ class TelemetryRegistry:
         oid = id(obj)
 
         def _on_collect(_ref: Any, registry: "TelemetryRegistry" = self, oid: int = oid) -> None:
-            registry._retire(oid)
+            # lock-free by contract — see _pending_retire above
+            registry._pending_retire.append(oid)
 
         try:
             ref = weakref.ref(obj, _on_collect)
         except TypeError:  # objects without weakref support still get counters
             ref = None
         with self._lock:
+            if _SAN.enabled:
+                _san_check(self, "_live")
+            self._drain_retired()
             self._live[oid] = (ref, telem)
         return telem
 
-    def _retire(self, oid: int) -> None:
-        with self._lock:
+    def _drain_retired(self) -> None:  # concurrency: guarded-by _lock
+        """Fold queued retirements into the per-class totals. Caller holds
+        ``_lock``; never raises on an unknown oid (reset may have dropped it)."""
+        while True:
+            try:
+                oid = self._pending_retire.popleft()
+            except IndexError:
+                return
             entry = self._live.pop(oid, None)
             if entry is None:
-                return
+                continue
             telem = entry[1]
             bucket = self._retired.setdefault(telem.name, {})
             for key, val in telem.counters.items():
@@ -297,6 +329,7 @@ class TelemetryRegistry:
 
     def telemetries(self) -> List[MetricTelemetry]:
         with self._lock:
+            self._drain_retired()
             return [t for _, t in self._live.values()]
 
     def reset(self) -> None:
@@ -305,6 +338,7 @@ class TelemetryRegistry:
             self._live.clear()
             self._retired.clear()
             self._retired_instances.clear()
+            self._pending_retire.clear()
 
     # ------------------------------------------------------------- aggregate
     def aggregate(self) -> Dict[str, Dict[str, Any]]:
@@ -312,6 +346,9 @@ class TelemetryRegistry:
         latency reservoirs pooled over live instances."""
         out: Dict[str, Dict[str, Any]] = {}
         with self._lock:
+            if _SAN.enabled:
+                _san_check(self, "_live,_retired,_retired_instances")
+            self._drain_retired()
             live = [t for _, t in self._live.values()]
             retired = {k: dict(v) for k, v in self._retired.items()}
             retired_n = dict(self._retired_instances)

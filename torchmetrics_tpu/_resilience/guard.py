@@ -33,6 +33,7 @@ from typing import Any, Callable, Optional, Tuple
 
 import numpy as np
 
+from torchmetrics_tpu._analysis.locksan import new_lock as _san_lock
 from torchmetrics_tpu._observability.state import OBS as _OBS
 from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
 from torchmetrics_tpu._resilience.errors import (
@@ -114,7 +115,7 @@ class _Worker:
         raise val
 
 
-_worker_lock = threading.Lock()
+_worker_lock = _san_lock("guard._worker_lock")
 _workers: list = []  # idle-or-busy pool; stuck (timed-out) workers are evicted
 _METRIC_BASE: Optional[type] = None  # lazily bound to Metric (import-cycle break)
 

@@ -143,6 +143,18 @@ class _Writer:
         self._jobs: "queue.Queue[Optional[Any]]" = queue.Queue()
         self.last_error: Optional[BaseException] = None
         self._abandoned = False
+        # shutdown-ordering contract (analyzer R9's lifecycle sibling, found
+        # while deriving the guard map): once the None sentinel is queued the
+        # loop thread exits, so a later submit() would enqueue a job NOBODY
+        # ever runs (silent durability loss) and a later drain()'s barrier
+        # event would never be set (a full-timeout stall on every flush()
+        # after close()). `_closed` makes both misuses loud/cheap instead;
+        # `_gate` orders the flag check against the sentinel put, so a
+        # submit racing a concurrent close can never slip a job in BEHIND
+        # the loop-exit sentinel (the one silent-drop window a bare flag
+        # would leave open).
+        self._closed = False
+        self._gate = threading.Lock()
         self._thread = threading.Thread(target=self._loop, name="tm-tpu-snapshot-writer", daemon=True)
         self._thread.start()
 
@@ -159,27 +171,42 @@ class _Writer:
                 self.last_error = err
 
     def submit(self, job: Any) -> None:
-        self._jobs.put(job)
+        with self._gate:
+            if self._closed:
+                raise RuntimeError("snapshot writer is closed; job refused (would never run)")
+            self._jobs.put(job)
 
     def drain(self, timeout: float = 30.0) -> None:
         """Block until every queued job ran (barrier job + event)."""
         done = threading.Event()
-        self._jobs.put(done.set)
+        with self._gate:
+            if self._closed:
+                # close() queued the loop-exit sentinel (and already joined):
+                # a barrier event enqueued behind it could never fire, and no
+                # job can have been accepted since — return instead of stalling
+                return
+            self._jobs.put(done.set)
         done.wait(timeout)
 
     def close(self, timeout: float = 30.0) -> None:
-        self._jobs.put(None)
+        """Idempotent: stop accepting jobs, stop the loop, join the thread."""
+        with self._gate:
+            if not self._closed:
+                self._closed = True
+                self._jobs.put(None)
         self._thread.join(timeout)
 
     def abandon(self) -> None:
         """Drop queued jobs (simulated preemption: writes die with the process)."""
-        self._abandoned = True
-        try:
-            while True:
-                self._jobs.get_nowait()
-        except queue.Empty:
-            pass
-        self._jobs.put(None)
+        with self._gate:
+            self._closed = True
+            self._abandoned = True
+            try:
+                while True:
+                    self._jobs.get_nowait()
+            except queue.Empty:
+                pass
+            self._jobs.put(None)
 
 
 class SnapshotManager:
@@ -434,6 +461,11 @@ class SnapshotManager:
         is gap-free: the previous generation's snapshot plus both journals
         reconstruct the same state.
         """
+        if self._closed:
+            # refuse BEFORE rotating: rotating first would open a journal fd
+            # close() can never reach (it already ran) and advance the
+            # generation for a snapshot the dead writer will never write
+            raise RuntimeError("SnapshotManager is closed; snapshot refused")
         gen = self._next_gen
         self._next_gen += 1
         payload = {

@@ -11,18 +11,32 @@ re-balanced to top-K *by cumulative volume* every ``rebalance_every``
 notes, so a tenant that turns noisy after the first K arrived still becomes
 attributable (its counter starts at the takeover point; the overflow bucket
 keeps the full history, so nothing is lost — only un-attributed).
+
+Thread-safety: ROADMAP item 3's ingestion runtime drives ``note`` from
+concurrent worker threads while Prometheus scrapes call ``label``. The
+volume dict and label set are therefore guarded by one lock (``_lock`` in
+the ``thread_safety.json`` guard map) — the pre-lock top-K rebalance
+iterated ``volumes.items()`` while concurrent ``note`` calls inserted,
+which is a "dictionary changed size during iteration" crash under load
+(found by analyzer rule R7). ``label`` stays lock-free on purpose: a
+single set-membership probe is GIL-atomic, and the scrape path must not
+contend with ingestion.
 """
 
 from __future__ import annotations
 
 from typing import Dict, Set
 
+from torchmetrics_tpu._analysis.locksan import SAN as _SAN
+from torchmetrics_tpu._analysis.locksan import check_access as _san_check
+from torchmetrics_tpu._analysis.locksan import new_lock as _san_lock
+
 __all__ = ["OVERFLOW_LABEL", "StreamLabeler"]
 
 OVERFLOW_LABEL = "__overflow__"
 
 
-class StreamLabeler:
+class StreamLabeler:  # concurrency: shared ingestion threads note() while scrapes label()
     """Map stream ids onto a bounded set of telemetry label values."""
 
     def __init__(self, k: int = 8, rebalance_every: int = 512) -> None:
@@ -30,6 +44,7 @@ class StreamLabeler:
             raise ValueError(f"`k` must be >= 0, got {k}")
         self.k = int(k)
         self.rebalance_every = max(1, int(rebalance_every))
+        self._lock = _san_lock("StreamLabeler._lock")
         self.volumes: Dict[int, int] = {}
         self._labeled: Set[int] = set()
         self._since_rebalance = 0
@@ -37,20 +52,33 @@ class StreamLabeler:
     def note(self, stream_id: int, n: int = 1) -> str:
         """Record ``n`` events for the stream; return its current label value."""
         sid = int(stream_id)
-        self.volumes[sid] = self.volumes.get(sid, 0) + n
-        self._since_rebalance += 1
-        if sid not in self._labeled and len(self._labeled) < self.k:
-            self._labeled.add(sid)
-        if self._since_rebalance >= self.rebalance_every:
-            self.rebalance()
-        return str(sid) if sid in self._labeled else OVERFLOW_LABEL
+        with self._lock:
+            if _SAN.enabled:
+                _san_check(self, "volumes,_labeled,_since_rebalance")
+            self.volumes[sid] = self.volumes.get(sid, 0) + n
+            self._since_rebalance += 1
+            if sid not in self._labeled and len(self._labeled) < self.k:
+                self._labeled.add(sid)
+            if self._since_rebalance >= self.rebalance_every:
+                self._rebalance_locked()
+            return str(sid) if sid in self._labeled else OVERFLOW_LABEL
 
     def label(self, stream_id: int) -> str:
-        """Current label value for a stream WITHOUT recording an event."""
+        """Current label value for a stream WITHOUT recording an event.
+
+        Lock-free: one GIL-atomic membership probe against a set whose
+        rebalance *replaces* it wholesale (a reference store), so a
+        concurrent rebalance yields the old or the new labeling — never a
+        torn read. The scrape path must not contend with ingestion.
+        """
         return str(int(stream_id)) if int(stream_id) in self._labeled else OVERFLOW_LABEL
 
     def rebalance(self) -> None:
         """Re-assign label ownership to the top-K streams by cumulative volume."""
+        with self._lock:
+            self._rebalance_locked()
+
+    def _rebalance_locked(self) -> None:  # concurrency: guarded-by _lock
         self._since_rebalance = 0
         if len(self.volumes) <= self.k:
             self._labeled = set(self.volumes)
@@ -61,5 +89,6 @@ class StreamLabeler:
     def retire(self, stream_id: int) -> None:
         """Forget a detached stream (its label slot frees up at rebalance)."""
         sid = int(stream_id)
-        self.volumes.pop(sid, None)
-        self._labeled.discard(sid)
+        with self._lock:
+            self.volumes.pop(sid, None)
+            self._labeled.discard(sid)
