@@ -1,0 +1,222 @@
+"""Unit tests for the flight recorder (OBSERVABILITY.md).
+
+Covers trigger selection + exactly-one-dump dedup, seam attribution,
+trace-id correlation (ambient vs last-completed), the merged
+monotonic-ordered span/event timeline, on-disk artifacts, and the arm /
+disarm lifecycle. The chaos-schedule acceptance (every injected fault
+class produces a dump naming the right seam and trace) lives in
+``tests/unittests/resilience/test_chaos.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._observability import (
+    BUS,
+    REGISTRY,
+    arm_flight_recorder,
+    disarm_flight_recorder,
+    get_flight_recorder,
+    set_telemetry_enabled,
+)
+from torchmetrics_tpu._observability.flight import FlightRecorder
+from torchmetrics_tpu._observability.tracing import TRACER, set_tracing_enabled, trace_context
+
+
+@pytest.fixture()
+def flight(tmp_path):
+    """Telemetry + tracing on, recorder armed at a tmp dir; pristine after."""
+    set_telemetry_enabled(True)
+    set_tracing_enabled(True)
+    TRACER.clear()
+    BUS.clear()
+    recorder = arm_flight_recorder(directory=str(tmp_path / "flight"))
+    yield recorder
+    disarm_flight_recorder()
+    set_tracing_enabled(False)
+    set_telemetry_enabled(False)
+    TRACER.clear()
+    BUS.clear()
+    REGISTRY.reset()
+
+
+# ----------------------------------------------------------------- triggers
+def test_degradation_event_dumps_exactly_once(flight):
+    event = BUS.publish("degradation", "MSE", "sync_degraded: x", data={"kind": "sync_degraded"})
+    assert flight.dump_count == 1
+    (dump,) = flight.dumps()
+    assert dump["seam"] == "guard.sync"
+    assert dump["trigger"]["seq"] == event.seq
+    # replaying the same trigger is a no-op (exactly one dump per fault)
+    assert flight.dump(event) is None
+    assert flight.dump_count == 1
+
+
+def test_non_trigger_kinds_do_not_dump(flight):
+    BUS.publish("snapshot_write", "MSE", "generation 3")
+    BUS.publish("auto_path_disabled", "MSE", "reason")
+    BUS.publish("snapshot_restore", "MSE", "ok", data={"outcome": "ok"})
+    BUS.publish("snapshot_restore", "MSE", "fallback", data={"outcome": "fallback"})
+    assert flight.dump_count == 0
+    BUS.publish("snapshot_restore", "MSE", "failed", data={"outcome": "failed"})
+    assert flight.dump_count == 1
+    assert flight.dumps()[0]["seam"] == "snapshot.restore"
+
+
+def test_seam_resolution_table(flight):
+    BUS.publish("degradation", "M", "q", data={"kind": "nan_quarantine"})
+    BUS.publish("degradation", "M", "h", data={"kind": "handshake_degraded"})
+    BUS.publish("degradation", "M", "s", data={"kind": "spmd_degraded"})
+    BUS.publish("recompile_churn", "M", "shapes changed")
+    BUS.publish("chaos_fault", "M", "injected", data={"seam": "guard.sync", "fault": "stall"})
+    seams = [d["seam"] for d in flight.dumps()]
+    assert seams == ["metric.update", "guard.sync", "spmd.step", "compile", "guard.sync"]
+
+
+# --------------------------------------------------------------- correlation
+def test_dump_carries_the_ambient_trace_id(flight):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        metric = tm.MeanSquaredError(nan_policy="quarantine")
+        with trace_context("request") as root:
+            metric.update(jnp.array([float("nan")] * 4), jnp.zeros(4))
+    (dump,) = flight.dumps()
+    assert dump["trigger"]["data"]["kind"] == "nan_quarantine"
+    assert dump["seam"] == "metric.update"
+    assert dump["trace_attribution"] == "ambient"
+    assert dump["trace_id"] == root.trace_id
+
+
+def test_dump_falls_back_to_last_completed_span(flight):
+    with trace_context("earlier"):
+        tm.MeanSquaredError().update(jnp.ones(4), jnp.zeros(4))
+    BUS.publish("degradation", "M", "outside any context", data={"kind": "sync_degraded"})
+    (dump,) = flight.dumps()
+    assert dump["trace_attribution"] == "last_completed"
+    assert dump["trace_id"] is not None
+
+
+# ------------------------------------------------------------------ timeline
+def test_timeline_merges_spans_and_events_in_monotonic_order(flight):
+    metric = tm.MeanSquaredError()
+    with trace_context("req"):
+        metric.update(jnp.ones(4), jnp.zeros(4))
+        BUS.publish("snapshot_write", "MSE", "generation 0")  # non-trigger context
+        metric.compute()
+    BUS.publish("degradation", "MSE", "boom", data={"kind": "sync_degraded"})
+    (dump,) = flight.dumps()
+    monos = [r["mono"] for r in dump["timeline"]]
+    assert monos == sorted(monos)
+    kinds = {r["type"] for r in dump["timeline"]}
+    assert kinds == {"span", "event"}
+    # the trigger itself is not duplicated inside the timeline
+    assert all(
+        r.get("seq") != dump["trigger"]["seq"] for r in dump["timeline"] if r["type"] == "event"
+    )
+    json.dumps(dump)  # self-contained
+
+
+def test_dump_windows_are_bounded(tmp_path):
+    set_telemetry_enabled(True)
+    set_tracing_enabled(True)
+    recorder = FlightRecorder(span_window=4, event_window=3).arm()
+    try:
+        metric = tm.MeanSquaredError()
+        for _ in range(10):
+            with trace_context("r"):
+                metric.update(jnp.ones(2), jnp.zeros(2))
+            BUS.publish("snapshot_write", "M", "noise")
+        BUS.publish("degradation", "M", "boom", data={"kind": "sync_degraded"})
+        (dump,) = recorder.dumps()
+        spans = [r for r in dump["timeline"] if r["type"] == "span"]
+        events = [r for r in dump["timeline"] if r["type"] == "event"]
+        assert len(spans) <= 4 and len(events) <= 3
+    finally:
+        recorder.disarm()
+        set_tracing_enabled(False)
+        set_telemetry_enabled(False)
+        TRACER.clear()
+        BUS.clear()
+        REGISTRY.reset()
+
+
+# ----------------------------------------------------------------- artifacts
+def test_on_disk_artifact_matches_the_in_memory_dump(flight, tmp_path):
+    BUS.publish("degradation", "MSE", "boom", data={"kind": "sync_degraded"})
+    (dump,) = flight.dumps()
+    files = sorted((tmp_path / "flight").glob("flight_*.json"))
+    assert len(files) == 1
+    assert f"{dump['trigger']['seq']:06d}" in files[0].name
+    assert json.loads(files[0].read_text(encoding="utf-8")) == json.loads(json.dumps(dump))
+
+
+def test_unserializable_span_attrs_degrade_to_repr(flight):
+    """A user attr json can't represent must NOT raise inside the bus
+    subscriber (the bus would silently drop the recorder forever while
+    `armed` still reads True) — it is coerced via repr() instead."""
+    import numpy as np
+
+    with trace_context("req", payload=np.int32(7)):
+        tm.MeanSquaredError().update(jnp.ones(2), jnp.zeros(2))
+    BUS.publish("degradation", "M", "boom", data={"kind": "sync_degraded"})
+    assert flight.dump_count == 1
+    (dump,) = flight.dumps()
+    json.dumps(dump)
+    spans = [r for r in dump["timeline"] if r["type"] == "span" and r["name"] == "req"]
+    assert spans and spans[0]["attrs"]["payload"] == repr(np.int32(7))
+    # and the recorder is still alive for the next trigger
+    BUS.publish("degradation", "M", "again", data={"kind": "sync_degraded"})
+    assert flight.dump_count == 2
+
+
+def test_in_memory_only_when_no_directory():
+    set_telemetry_enabled(True)
+    recorder = FlightRecorder().arm()
+    try:
+        BUS.publish("degradation", "M", "x", data={"kind": "sync_degraded"})
+        assert recorder.dump_count == 1 and recorder.directory is None
+    finally:
+        recorder.disarm()
+        set_telemetry_enabled(False)
+        BUS.clear()
+
+
+# ----------------------------------------------------------------- lifecycle
+def test_arm_replaces_and_disarm_stops(flight):
+    assert get_flight_recorder() is flight
+    second = arm_flight_recorder()
+    try:
+        assert get_flight_recorder() is second
+        assert not flight.armed and second.armed
+        BUS.publish("degradation", "M", "x", data={"kind": "sync_degraded"})
+        assert second.dump_count == 1 and flight.dump_count == 0
+    finally:
+        disarm_flight_recorder()
+    assert get_flight_recorder() is None
+    BUS.publish("degradation", "M", "y", data={"kind": "sync_degraded"})
+    assert second.dump_count == 1  # disarmed: no further dumps
+
+
+def test_disabled_telemetry_means_no_triggers(flight):
+    set_telemetry_enabled(False)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        metric = tm.MeanSquaredError(nan_policy="quarantine")
+        metric.update(jnp.array([float("nan")] * 4), jnp.zeros(4))
+    # the degradation was recorded locally but never bus-published, so the
+    # recorder (a bus subscriber) has nothing — the kill switch silences all
+    assert flight.dump_count == 0
+
+
+def test_arming_with_telemetry_off_warns():
+    set_telemetry_enabled(False)
+    with pytest.warns(UserWarning, match="telemetry disabled"):
+        recorder = arm_flight_recorder()
+    recorder.disarm()
+    disarm_flight_recorder()

@@ -1,0 +1,204 @@
+"""Unit tests for the declarative SLO / error-budget layer (OBSERVABILITY.md).
+
+Covers SLO validation, latency objectives evaluated from the pooled
+reservoir windows, error-rate objectives evaluated over windowed counter
+deltas, burn-rate math, the readiness-probe health report, and the
+process-wide tracker.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._observability import (
+    BUS,
+    REGISTRY,
+    set_telemetry_enabled,
+    set_telemetry_sampling,
+)
+from torchmetrics_tpu._observability.slo import (
+    DEFAULT_SLOS,
+    FAST_BURN,
+    SLO,
+    SloTracker,
+    health_report,
+    set_slos,
+)
+from torchmetrics_tpu._observability.state import DEFAULT_SAMPLE_EVERY
+
+
+@pytest.fixture()
+def telemetry():
+    set_telemetry_enabled(True)
+    set_telemetry_sampling(1)  # every call lands in the reservoirs
+    yield REGISTRY
+    set_telemetry_enabled(False)
+    set_telemetry_sampling(DEFAULT_SAMPLE_EVERY)
+    REGISTRY.reset()
+    BUS.clear()
+    set_slos(None)
+
+
+# ---------------------------------------------------------------- validation
+def test_slo_must_pick_exactly_one_mode():
+    with pytest.raises(ValueError, match="exactly one mode"):
+        SLO(name="neither")
+    with pytest.raises(ValueError, match="exactly one mode"):
+        SLO(name="both", op="compute", threshold_ms=1.0, bad=("degradations",))
+    with pytest.raises(ValueError, match="objective"):
+        SLO(name="bad", op="compute", threshold_ms=1.0, objective=1.0)
+    with pytest.raises(ValueError, match="threshold_ms"):
+        SLO(name="half", op="compute")
+    with pytest.raises(ValueError, match="window_s"):
+        SLO(name="w", bad=("degradations",), window_s=0)
+    with pytest.raises(ValueError, match="duplicate"):
+        SloTracker([SLO(name="x", bad=("a",)), SLO(name="x", bad=("b",))])
+
+
+def test_budget_and_kind_properties():
+    lat = SLO(name="l", op="compute", threshold_ms=5.0, objective=0.99)
+    err = SLO(name="e", bad=("degradations",), objective=0.999)
+    assert lat.kind == "latency" and err.kind == "error_rate"
+    assert lat.budget == pytest.approx(0.01)
+    assert err.budget == pytest.approx(0.001)
+
+
+# ------------------------------------------------------------------- latency
+def test_latency_slo_judges_the_pooled_reservoirs(telemetry):
+    metric = tm.MeanSquaredError()
+    for _ in range(8):
+        metric.update(jnp.ones(16), jnp.zeros(16))
+    # MSE auto-compiles after the first (eager) update: the compiled-path
+    # reservoir carries the bulk of the stream
+    ok = SloTracker([SLO(name="lat", op="update_compiled", threshold_ms=60_000.0)])
+    status = ok.health_report().status_of("lat")
+    assert status.status == "ok" and status.compliance == 1.0 and status.burn_rate == 0.0
+    assert status.observed["samples"] >= 4
+    assert status.observed["p99_ms"] <= status.observed["worst_ms"]
+    # an impossible threshold: zero compliance burns 100x a 1% budget
+    bad = SloTracker([SLO(name="lat", op="update_compiled", threshold_ms=1e-9)])
+    status = bad.health_report().status_of("lat")
+    assert status.compliance == 0.0
+    assert status.burn_rate == pytest.approx(1.0 / 0.01)
+    assert status.burn_rate > FAST_BURN and status.status == "violated"
+
+
+def test_latency_slo_with_no_samples_is_ok(telemetry):
+    tracker = SloTracker([SLO(name="lat", op="never_recorded", threshold_ms=1.0)])
+    status = tracker.health_report().status_of("lat")
+    assert status.status == "ok" and status.observed["samples"] == 0
+
+
+# ---------------------------------------------------------------- error rate
+def test_error_rate_slo_lifetime_then_windowed(telemetry):
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        metric = tm.MeanSquaredError(nan_policy="quarantine")
+        good = jnp.ones(8), jnp.zeros(8)
+        poisoned = jnp.array([float("nan")] * 8), jnp.zeros(8)
+        for _ in range(9):
+            metric.update(*good)
+        metric.update(*poisoned)  # 1 quarantined of 10 updates
+    slo = SLO(name="q", bad=("quarantined_batches",), total=("update_calls",), objective=0.8)
+    tracker = SloTracker([slo])
+    status = tracker.health_report().status_of("q")
+    # first evaluation = lifetime totals: 1/10 bad against a 20% budget
+    assert status.compliance == pytest.approx(0.9)
+    assert status.burn_rate == pytest.approx(0.1 / 0.2)
+    assert status.status == "ok"
+    # between evaluations everything is clean: the windowed delta is 0 bad
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        for _ in range(5):
+            metric.update(*good)
+    status = tracker.health_report().status_of("q")
+    assert status.observed["bad"] == 0.0
+    assert status.compliance == 1.0 and status.burn_rate == 0.0
+    # a pure-bad burst: the window base is the OLDEST in-window checkpoint,
+    # so the delta spans both probe intervals (1 bad of 6 updates)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        metric.update(*poisoned)
+    status = tracker.health_report().status_of("q")
+    assert status.compliance == pytest.approx(5.0 / 6.0)
+    assert status.burn_rate == pytest.approx((1.0 / 6.0) / 0.2)
+    assert status.status == "ok"
+
+
+def test_error_rate_burst_after_window_expiry_is_at_risk(telemetry):
+    import time as _time
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        metric = tm.MeanSquaredError(nan_policy="quarantine")
+        for _ in range(50):
+            metric.update(jnp.ones(8), jnp.zeros(8))  # ancient good history
+    slo = SLO(name="q", bad=("quarantined_batches",), total=("update_calls",),
+              objective=0.8, window_s=0.01)
+    tracker = SloTracker([slo])
+    tracker.health_report()  # checkpoint the clean totals
+    _time.sleep(0.05)  # the checkpoint ages past the window
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        metric.update(jnp.array([float("nan")] * 8), jnp.zeros(8))
+    status = tracker.health_report().status_of("q")
+    # delta vs the newest (expired) checkpoint: 1 bad of 1 update — the
+    # ancient good traffic must NOT mask the current burn
+    assert status.compliance == pytest.approx(0.0)
+    assert status.burn_rate == pytest.approx(1.0 / 0.2)
+    assert status.status == "at_risk"  # 5x <= FAST_BURN
+
+
+def test_error_rate_slo_with_no_traffic_is_ok(telemetry):
+    tracker = SloTracker([SLO(name="e", bad=("degradations",), total=("update_calls",))])
+    status = tracker.health_report().status_of("e")
+    assert status.status == "ok" and status.compliance == 1.0
+    assert status.observed["total"] == 0.0
+
+
+def test_bad_events_with_zero_denominator_traffic_never_read_ok(telemetry):
+    """Degradations during an ingest pause (bad delta > 0, total delta == 0)
+    are full burn — a failing-but-idle replica must not probe healthy."""
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        metric = tm.MeanSquaredError(nan_policy="quarantine")
+        metric.update(jnp.array([float("nan")] * 4), jnp.zeros(4))
+    # `bad` counts degradations; `total` names a family with NO traffic here,
+    # modelling a denominator that idles while faults keep firing
+    tracker = SloTracker([SLO(name="d", bad=("degradations",), total=("sync_calls",),
+                              objective=0.9)])
+    status = tracker.health_report().status_of("d")
+    assert status.observed["bad"] >= 1 and status.observed["total"] == 0.0
+    assert status.compliance == 0.0
+    assert status.burn_rate == pytest.approx(1.0 / 0.1)
+    assert status.status == "at_risk"  # 10x burn <= FAST_BURN (14.4) pages as at_risk
+
+
+# ------------------------------------------------------------- health report
+def test_health_report_shape_and_serializability(telemetry):
+    tm.MeanSquaredError().update(jnp.ones(4), jnp.zeros(4))
+    report = health_report()  # module-level tracker, DEFAULT_SLOS
+    assert {s.name for s in report.slos} == {s.name for s in DEFAULT_SLOS}
+    assert report.healthy is True
+    assert report.telemetry_enabled is True
+    payload = report.to_json()
+    assert json.loads(json.dumps(payload)) == payload
+    assert {s["name"] for s in payload["slos"]} == {s.name for s in DEFAULT_SLOS}
+    assert report.status_of("nope") is None
+
+
+def test_health_report_goes_unhealthy_on_violation(telemetry):
+    metric = tm.MeanSquaredError()
+    for _ in range(4):
+        metric.update(jnp.ones(4), jnp.zeros(4))
+    tracker = set_slos([SLO(name="impossible", op="update_eager", threshold_ms=1e-9)])
+    report = tracker.health_report()
+    assert not report.healthy
+    assert report.status_of("impossible").status == "violated"
+    # the module-level entry point sees the installed tracker
+    assert health_report().healthy is False

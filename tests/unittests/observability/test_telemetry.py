@@ -301,6 +301,25 @@ def test_prometheus_output_parses_with_standard_parser(telemetry):
     assert text.endswith("\n")
     for family in families.values():
         assert family.documentation  # every family carries HELP text
+    # reservoir quantiles export as a real Prometheus SUMMARY family
+    latency = families["tmtpu_latency_seconds"]
+    assert latency.type == "summary"
+    by_suffix_op: dict = {}
+    for s in latency.samples:
+        by_suffix_op.setdefault((s.name, s.labels.get("op")), []).append(s)
+    ops = {op for (_n, op) in by_suffix_op}
+    assert "update_eager" in ops and "compute" in ops
+    for op in ops:
+        quant = by_suffix_op.get(("tmtpu_latency_seconds", op), [])
+        assert {s.labels["quantile"] for s in quant} == {"0.5", "0.9", "0.99"}
+        # quantile labels never leak onto the _sum/_count series
+        (count,) = by_suffix_op[("tmtpu_latency_seconds_count", op)]
+        (total,) = by_suffix_op[("tmtpu_latency_seconds_sum", op)]
+        assert "quantile" not in count.labels and "quantile" not in total.labels
+        assert count.value >= 1 and total.value > 0
+    # the count/sum series ride the summary — never doubled as raw counters
+    assert "tmtpu_latency_samples" not in families
+    assert "tmtpu_latency_sum_seconds" not in families
 
 
 def test_prometheus_label_escaping(telemetry):
@@ -312,12 +331,27 @@ def test_prometheus_label_escaping(telemetry):
 def test_json_export_round_trips(telemetry):
     metric = tm.MeanSquaredError()
     metric.update(jnp.ones(4), jnp.zeros(4))
+    BUS.publish("degradation", "MeanSquaredError", "synthetic", data={"kind": "x"})
     payload = REGISTRY.to_json()
     rehydrated = json.loads(json.dumps(payload))
     assert rehydrated == payload
     assert rehydrated["enabled"] is True
     counters = rehydrated["metrics"]["MeanSquaredError"]["counters"]
     assert counters["update_calls|path=eager"] == 1
+    # events carry both clocks: wall (ts) for humans, monotonic (mono) for
+    # ordering flight-recorder timelines across components
+    (event,) = rehydrated["events"]
+    assert event["ts"] > 0 and event["mono"] > 0
+
+
+def test_event_records_carry_monotonic_timestamps(telemetry):
+    import time as _time
+
+    before = _time.monotonic()
+    e1 = BUS.publish("k", "src", "first")
+    e2 = BUS.publish("k", "src", "second")
+    assert before <= e1.mono <= e2.mono <= _time.monotonic()
+    assert e1.ts > 0
 
 
 # --------------------------------------------------------------- collection

@@ -1,0 +1,311 @@
+"""Unit tests for request-scoped span tracing (OBSERVABILITY.md).
+
+Covers span lifecycle + contextvar parentage, the instrumented seams
+(update/compute/forward/sync + guarded attempts, snapshot write/restore,
+StreamPool micro-batches), the bounded recorder ring, the Chrome
+trace-event export (the ISSUE-14 acceptance: a StreamPool micro-batch
+exports as valid Chrome JSON forming ONE causally-linked span tree), and
+the disabled-path contract.
+"""
+
+from __future__ import annotations
+
+import json
+import warnings
+
+import jax.numpy as jnp
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._observability import (
+    BUS,
+    REGISTRY,
+    set_telemetry_enabled,
+)
+from torchmetrics_tpu._observability.state import OBS
+from torchmetrics_tpu._observability.tracing import (
+    TRACER,
+    SpanRecorder,
+    begin_span,
+    current_span,
+    current_trace_id,
+    end_span,
+    export_chrome_trace,
+    set_tracing_enabled,
+    span_tree,
+    trace_context,
+    tracing_enabled,
+)
+
+
+@pytest.fixture()
+def tracing():
+    """Enable span collection for one test; restore the pristine state."""
+    set_tracing_enabled(True)
+    TRACER.clear()
+    yield TRACER
+    set_tracing_enabled(False)
+    TRACER.clear()
+    REGISTRY.reset()
+    BUS.clear()
+
+
+# ----------------------------------------------------------------- lifecycle
+def test_spans_link_parent_child_via_contextvar(tracing):
+    with trace_context("request") as root:
+        assert current_span() is root
+        assert current_trace_id() == root.trace_id
+        child = begin_span("inner", "X", foo=1)
+        assert child.parent_id == root.span_id
+        assert child.trace_id == root.trace_id
+        grandchild = begin_span("leaf", "X")
+        assert grandchild.parent_id == child.span_id
+        end_span(grandchild)
+        assert current_span() is child
+        end_span(child)
+        assert current_span() is root
+    assert current_span() is None
+    names = [s.name for s in TRACER.spans(trace_id=root.trace_id)]
+    # completion order: leaves close first, the root last
+    assert names == ["leaf", "inner", "request"]
+
+
+def test_error_spans_carry_status_and_message(tracing):
+    with pytest.raises(RuntimeError):
+        with trace_context("failing"):
+            raise RuntimeError("boom")
+    span = TRACER.spans(name="failing")[-1]
+    assert span.status == "error"
+    assert "RuntimeError: boom" in span.error
+
+
+def test_disabled_path_records_nothing():
+    from torchmetrics_tpu._observability.tracing import NULL_SPAN
+
+    set_tracing_enabled(False)
+    TRACER.clear()
+    assert not tracing_enabled()
+    with trace_context("request") as sp:
+        # the as-binding stays usable unconditionally: an inert span accepts
+        # (and drops) attribute writes instead of crashing disabled callers
+        assert sp is NULL_SPAN
+        sp.attrs["tenant"] = "42"
+        assert sp.attrs == {} and sp.trace_id is None
+        assert current_trace_id() is None
+        m = tm.MeanSquaredError()
+        m.update(jnp.ones(4), jnp.zeros(4))
+        m.compute()
+    assert len(TRACER) == 0
+
+
+def test_recorder_ring_is_bounded():
+    rec = SpanRecorder(capacity=4)
+    set_tracing_enabled(True)
+    try:
+        for i in range(7):
+            s = begin_span(f"s{i}")
+            end_span(s)
+            rec.record(s)
+    finally:
+        set_tracing_enabled(False)
+    assert len(rec) == 4
+    assert rec.dropped == 3
+    assert rec.recorded == 7
+    assert [s.name for s in rec.recent(2)] == ["s5", "s6"]
+    TRACER.clear()
+
+
+def test_distinct_requests_get_distinct_trace_ids(tracing):
+    with trace_context("a") as a:
+        pass
+    with trace_context("b") as b:
+        pass
+    assert a.trace_id != b.trace_id
+
+
+# ----------------------------------------------------------------- the seams
+def test_metric_update_sync_compute_tree(tracing):
+    """The eager guarded path yields the canonical update -> sync -> compute
+    tree: update and compute are children of the request, the guarded sync
+    (and its per-collective attempts) nest under compute."""
+    from torchmetrics_tpu._resilience.faultinject import simulated_world
+    from torchmetrics_tpu._resilience.policy import RetryPolicy, SyncPolicy
+
+    with simulated_world(2):
+        metric = tm.MeanSquaredError(sync_policy=SyncPolicy(retry=RetryPolicy(max_retries=1)))
+        with trace_context("eval") as root:
+            metric.update(jnp.ones(4), jnp.zeros(4))
+            metric.compute()
+    (tree,) = span_tree(root.trace_id)
+    assert tree["name"] == "eval"
+    children = {c["name"]: c for c in tree["children"]}
+    assert set(children) == {"update", "compute"}
+    assert children["update"]["attrs"]["path"] == "eager"
+    (sync,) = children["compute"]["children"]
+    assert sync["name"] == "sync" and sync["attrs"]["mode"] == "guarded"
+    attempts = [c for c in sync["children"] if c["name"] == "sync_attempt"]
+    assert len(attempts) == 2  # handshake + state gather, one attempt each
+    assert all(a["parent_id"] == sync["span_id"] for a in attempts)
+    # causal order: update completes before compute starts
+    assert children["update"]["t1_mono"] <= children["compute"]["t0_mono"]
+
+
+def test_forward_parents_the_inner_dance(tracing):
+    metric = tm.MeanSquaredError()
+    with trace_context("step") as root:
+        metric.forward(jnp.ones(4), jnp.zeros(4))
+    (tree,) = span_tree(root.trace_id)
+    (fwd,) = tree["children"]
+    assert fwd["name"] == "forward"
+    inner = {c["name"] for c in fwd["children"]}
+    # the stash/reset dance runs update (and compute for the batch value)
+    assert "update" in inner
+
+
+def test_collection_update_parents_member_updates(tracing):
+    mc = tm.MetricCollection(
+        {"mse": tm.MeanSquaredError(), "mae": tm.MeanAbsoluteError()}, compute_groups=False
+    )
+    with trace_context("fanout") as root:
+        mc.update(jnp.ones(4), jnp.zeros(4))
+    (tree,) = span_tree(root.trace_id)
+    (coll,) = tree["children"]
+    assert coll["name"] == "update" and coll["source"] == "MetricCollection"
+    member_sources = sorted(c["source"] for c in coll["children"] if c["name"] == "update")
+    assert member_sources == ["MeanAbsoluteError", "MeanSquaredError"]
+
+
+def test_snapshot_write_and_restore_spans(tracing, tmp_path):
+    from torchmetrics_tpu._resilience import SnapshotManager, SnapshotPolicy
+
+    metric = tm.MeanSquaredError()
+    with SnapshotManager(metric, tmp_path, SnapshotPolicy(every_n_updates=10, async_write=False)):
+        with trace_context("ingest") as root:
+            # first update anchors the base snapshot; the next two journal
+            for i in range(3):
+                metric.update(jnp.ones(4) * i, jnp.zeros(4))
+    writes = [s for s in TRACER.spans(trace_id=root.trace_id) if s.name == "snapshot.write"]
+    assert writes and writes[0].source == "MeanSquaredError"
+    assert writes[0].attrs["generation"] == 0
+    fresh = tm.MeanSquaredError()
+    with SnapshotManager(fresh, tmp_path, SnapshotPolicy(async_write=False)) as mgr:
+        with trace_context("recover") as root2:
+            mgr.restore_latest()
+    restores = [s for s in TRACER.spans(trace_id=root2.trace_id) if s.name == "snapshot.restore"]
+    assert restores and restores[0].attrs["replayed"] == 2
+    # the restore replays through the real update path: replayed update spans
+    # are children of the same recovery trace
+    replays = [s for s in TRACER.spans(trace_id=root2.trace_id) if s.name == "update"]
+    assert replays
+
+
+def test_seam_spans_are_roots_outside_any_context(tracing):
+    metric = tm.MeanSquaredError()
+    metric.update(jnp.ones(4), jnp.zeros(4))
+    span = TRACER.spans(name="update")[-1]
+    assert span.parent_id == 0  # root of its own single-span trace
+
+
+# ------------------------------------------------- acceptance: StreamPool
+def test_stream_pool_micro_batch_exports_one_causal_chrome_tree(tracing, tmp_path):
+    """ISSUE-14 acceptance: one StreamPool micro-batch under one
+    trace_context exports as VALID Chrome trace-event JSON whose spans form
+    a single causally-linked tree with correct parent ids."""
+    pool = tm.MeanSquaredError().to_stream_pool(capacity=4)
+    a, b = pool.attach(), pool.attach()
+    with trace_context("ingest") as root:
+        pool.update([a, b], jnp.ones((2, 8)), jnp.zeros((2, 8)))
+        pool.compute_all()
+
+    # --- valid Chrome trace-event JSON (file round trip) -------------------
+    out = tmp_path / "trace.json"
+    payload = export_chrome_trace(trace_id=root.trace_id, path=str(out))
+    loaded = json.loads(out.read_text(encoding="utf-8"))
+    assert loaded == json.loads(json.dumps(payload))
+    events = loaded["traceEvents"]
+    assert events, "empty trace"
+    for ev in events:
+        assert ev["ph"] == "X"
+        for key in ("name", "cat", "ts", "dur", "pid", "tid", "args"):
+            assert key in ev, f"missing {key} in {ev}"
+        assert ev["dur"] >= 0
+
+    # --- a single causally-linked tree -------------------------------------
+    ids = {ev["args"]["span_id"] for ev in events}
+    roots = [ev for ev in events if ev["args"]["parent_id"] not in ids]
+    assert len(roots) == 1 and roots[0]["name"] == "ingest"
+    assert all(ev["args"]["trace_id"] == root.trace_id for ev in events)
+    trees = span_tree(root.trace_id)
+    assert len(trees) == 1
+    top = {c["name"]: c for c in trees[0]["children"]}
+    # the micro-batch update and its compute, causally ordered
+    assert "update" in top and "compute" in top
+    assert top["update"]["source"] == "StreamPool"
+    assert top["update"]["t1_mono"] <= top["compute"]["t0_mono"]
+    # the compiled vmapped dispatch nests under the micro-batch span
+    step_children = [c["name"] for c in top["update"]["children"]]
+    assert "stream_step" in step_children
+    # bounded stream attribution on the micro-batch span
+    assert top["update"]["attrs"]["rows"] == 2
+    assert "streams" in top["update"]["attrs"]
+
+
+def test_stream_pool_span_attribution_uses_bounded_labels(tracing):
+    pool = tm.MeanSquaredError().to_stream_pool(capacity=4, telemetry_streams=1)
+    a, b = pool.attach(), pool.attach()
+    p, t = jnp.ones((2, 4)), jnp.zeros((2, 4))
+    pool.update([a, b], p, t)  # first batch: labeler assigns its single slot
+    pool.update([a, b], p, t)
+    span = [s for s in TRACER.spans(name="update") if s.source == "StreamPool"][-1]
+    labels = span.attrs["streams"].split(",")
+    # at most k=1 exact ids; the other tenant rides the overflow bucket
+    assert "__overflow__" in labels
+    assert len([x for x in labels if x not in ("__overflow__", "…")]) <= 1
+
+
+# ----------------------------------------------------------------- exports
+def test_chrome_export_is_loadable_without_a_trace_filter(tracing):
+    with trace_context("one"):
+        tm.MeanSquaredError().update(jnp.ones(4), jnp.zeros(4))
+    payload = export_chrome_trace()
+    json.dumps(payload)  # whole retained window serializes
+    assert payload["displayTimeUnit"] == "ms"
+
+
+def test_chrome_export_coerces_unserializable_attrs(tracing):
+    import numpy as np
+
+    with trace_context("req", payload=np.int32(7)) as root:
+        pass
+    payload = export_chrome_trace(trace_id=root.trace_id)
+    json.dumps(payload)  # never raises on user attrs json can't represent
+    (ev,) = payload["traceEvents"]
+    assert ev["args"]["payload"] == repr(np.int32(7))
+
+
+def test_span_tree_survives_evicted_roots(tracing):
+    # children whose parents were evicted from the bounded ring still export
+    rec_spans = []
+    with trace_context("root") as root:
+        for i in range(3):
+            s = begin_span(f"c{i}")
+            end_span(s)
+            rec_spans.append(s)
+    # drop the root: simulate eviction by filtering
+    orphans = tuple(s for s in TRACER.spans(trace_id=root.trace_id) if s.name != "root")
+    trees = span_tree(root.trace_id, spans=orphans)
+    assert len(trees) == 3  # every retained span appears, as its own root
+
+
+def test_telemetry_and_tracing_switch_independently(tracing):
+    assert tracing_enabled() and not OBS.enabled
+    set_telemetry_enabled(True)
+    try:
+        m = tm.MeanSquaredError()
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            m.update(jnp.ones(4), jnp.zeros(4))
+        assert m.telemetry_report().total_updates == 1
+        assert TRACER.spans(name="update")
+    finally:
+        set_telemetry_enabled(False)

@@ -104,6 +104,117 @@ def test_chaos_soak_sync_writes(seed):
     _run(seed, spec=ChaosSpec(async_write=False))
 
 
+# ---------------------------------------------------------------------------
+# ISSUE-14: every injected fault class leaves a flight-recorder post-mortem
+# ---------------------------------------------------------------------------
+
+
+def test_chaos_faults_produce_flight_dumps(tmp_path):
+    """ISSUE-14 acceptance, armed under TM_TPU_LOCKSAN: with telemetry +
+    tracing on and the flight recorder armed, a seeded chaos schedule leaves
+    exactly one post-mortem dump per degradation/fault trigger; every
+    injected fault class (preemption kill/restore, NaN batch, snapshot
+    corruption, collective failure) is represented with the correct seam and
+    the trace id of the failing batch's request context."""
+    import json
+
+    from torchmetrics_tpu._analysis import locksan
+    from torchmetrics_tpu._observability import (
+        BUS,
+        REGISTRY,
+        arm_flight_recorder,
+        disarm_flight_recorder,
+        set_telemetry_enabled,
+    )
+    from torchmetrics_tpu._observability.tracing import TRACER, set_tracing_enabled
+
+    locksan.set_locksan_enabled(True)
+    locksan.reset()
+    set_telemetry_enabled(True)
+    set_tracing_enabled(True)
+    TRACER.clear()
+    BUS.clear()
+    recorder = arm_flight_recorder(directory=str(tmp_path), keep=256)
+    try:
+        # seed 2 covers every fault class (asserted below, so a schedule
+        # change that idles a class fails loudly instead of passing vacuously)
+        result = _run(2)
+        kinds = {e.kind for e in result.events}
+        assert {"nan", "preempt", "restore", "corrupt", "final_fault"} <= kinds, kinds
+        dumps = recorder.dumps()
+        assert dumps, "no flight dumps for a fault-heavy schedule"
+
+        # exactly ONE dump per trigger: seqs unique, count == trigger count
+        seqs = [d["trigger"]["seq"] for d in dumps]
+        assert len(seqs) == len(set(seqs))
+        assert len(dumps) == recorder.dump_count
+
+        def dumps_where(pred):
+            return [d for d in dumps if pred(d)]
+
+        # preemption kills: one chaos_fault dump each, seam snapshot.restore,
+        # trace id of the batch whose context the kill fired in
+        preempts = dumps_where(
+            lambda d: d["trigger"]["kind"] == "chaos_fault"
+            and d["trigger"]["data"].get("fault") == "preemption"
+        )
+        assert len(preempts) == result.preemptions
+        preempt_traces = {e.trace_id for e in result.events if e.kind == "preempt"}
+        for d in preempts:
+            assert d["seam"] == "snapshot.restore"
+            assert d["trace_attribution"] == "ambient"
+            assert d["trace_id"] in preempt_traces
+
+        # NaN batches: the quarantine degradation dumps, seam metric.update;
+        # every poisoned batch's trace id is represented (restores replay
+        # journaled poisoned batches, so extra same-seam dumps may exist —
+        # each still exactly-one-per-trigger, counted above)
+        nans = dumps_where(
+            lambda d: d["trigger"]["kind"] == "degradation"
+            and d["trigger"]["data"].get("kind") == "nan_quarantine"
+        )
+        nan_traces = {e.trace_id for e in result.events if e.kind == "nan"}
+        assert nan_traces <= {d["trace_id"] for d in nans}
+
+        # snapshot corruption: surfaces as the restore's fallback degradation
+        corrupt_traces = {e.trace_id for e in result.events if e.kind == "corrupt"}
+        fallbacks = dumps_where(
+            lambda d: d["trigger"]["kind"] == "degradation"
+            and d["trigger"]["data"].get("kind") == "snapshot_restore"
+        )
+        for d in fallbacks:
+            assert d["seam"] == "snapshot.restore"
+        assert corrupt_traces <= {d["trace_id"] for d in fallbacks}
+
+        # transient collective failures during the final sync: absorbed by the
+        # retry budget, named via chaos_fault, seam guard.sync
+        finals = dumps_where(
+            lambda d: d["trigger"]["data"].get("fault") in ("collective_failure", "collective_stall")
+        )
+        final_traces = {e.trace_id for e in result.events if e.kind == "final_fault"}
+        assert finals and {d["trace_id"] for d in finals} == final_traces
+        for d in finals:
+            assert d["seam"] == "guard.sync"
+
+        # dumps are self-contained artifacts on disk, loadable, trigger-named
+        files = sorted(tmp_path.glob("flight_*.json"))
+        assert len(files) == len(dumps)
+        loaded = json.loads(files[0].read_text(encoding="utf-8"))
+        assert {"seam", "trace_id", "trigger", "timeline"} <= set(loaded)
+
+        # the lock discipline held under the whole schedule (ISSUE-13 rules)
+        assert locksan.violations() == []
+    finally:
+        disarm_flight_recorder()
+        set_tracing_enabled(False)
+        set_telemetry_enabled(False)
+        locksan.set_locksan_enabled(False)
+        locksan.reset()
+        TRACER.clear()
+        BUS.clear()
+        REGISTRY.reset()
+
+
 def test_failing_schedule_does_not_leak_writer_thread(tmp_path):
     """A schedule that raises mid-stream must still close its manager —
     otherwise every failed soak seed parks a daemon writer thread and an

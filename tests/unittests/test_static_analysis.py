@@ -177,6 +177,29 @@ def test_runtime_packages_scan_clean_of_concurrency_rules():
     assert any(v.rule == "R7" for v in suppressed)
 
 
+def test_tracing_flight_slo_modules_scan_clean():
+    """ISSUE-14 acceptance: the request-tracing, flight-recorder, and SLO
+    modules are clean under the FULL R1-R9 rule set with ZERO baseline
+    additions — no entry in the checked-in baseline may reference them, and
+    a fresh scan must find nothing new (their instrumentation mutates host
+    state only at eager boundaries, and every shared container is guarded)."""
+    new_modules = (
+        "torchmetrics_tpu/_observability/tracing.py",
+        "torchmetrics_tpu/_observability/flight.py",
+        "torchmetrics_tpu/_observability/slo.py",
+    )
+    result, _ = _scan()
+    findings = [v for v in result.violations if v.path in new_modules]
+    assert not findings, [v.render() for v in findings]
+    baseline = load_baseline(BASELINE)
+    leaked = [e for e in baseline.values() if e.path in new_modules]
+    assert not leaked, f"baseline entries must never cover the ISSUE-14 modules: {leaked}"
+    # and the guard-map manifest must carry their verdicts (all-guarded)
+    modules = json.loads(THREAD_SAFETY_PATH.read_text(encoding="utf-8"))["modules"]
+    for path in new_modules:
+        assert modules[path]["verdict"] == "guarded", (path, modules[path]["verdict"])
+
+
 def test_checked_in_thread_safety_matches_code():
     """Staleness gate: thread_safety.json silently rots as the runtime grows
     threads unless a fresh scan reproduces it exactly (same contract as the

@@ -19,7 +19,19 @@ package makes them *observable* in production:
   device and host profiles attribute time to ``ClassName.method``.
 - **Export surfaces** — ``Metric.telemetry_report()``,
   ``MetricCollection.telemetry_report()``, and process-wide
-  :meth:`TelemetryRegistry.render_prometheus` / :meth:`TelemetryRegistry.to_json`.
+  :meth:`TelemetryRegistry.render_prometheus` / :meth:`TelemetryRegistry.to_json`
+  (reservoir quantiles export as Prometheus summary families).
+- **Request tracing** (``tracing.py``) — context-var-propagated correlation
+  ids with spans at every seam: one ingest call yields one causally-ordered
+  span tree, exportable as Chrome trace-event JSON (:func:`trace_context`,
+  :func:`export_chrome_trace`; ``TM_TPU_TRACING=1``).
+- **Flight recorder** (``flight.py``) — degradations, recompile churn, and
+  chaos faults freeze a self-contained post-mortem JSON dump naming the
+  failing seam, trace id, and the last N spans/events
+  (:func:`arm_flight_recorder`; ``TM_TPU_FLIGHT_DIR``).
+- **SLOs** (``slo.py``) — declarative latency/error-budget objectives with
+  burn-rate evaluation over the collected signals and a readiness-probe
+  :func:`health_report`.
 
 Everything is **off by default**: the disabled hot path is a single
 cached-bool branch (``state.OBS.enabled``) with no dict lookups and no
@@ -30,7 +42,21 @@ trace-safety analyzer).
 """
 
 from torchmetrics_tpu._observability.events import BUS, EventBus, TelemetryEvent
+from torchmetrics_tpu._observability.flight import (
+    FlightRecorder,
+    arm_flight_recorder,
+    disarm_flight_recorder,
+    get_flight_recorder,
+)
 from torchmetrics_tpu._observability.reservoir import LatencyReservoir
+from torchmetrics_tpu._observability.slo import (
+    SLO,
+    HealthReport,
+    SloStatus,
+    SloTracker,
+    health_report,
+    set_slos,
+)
 from torchmetrics_tpu._observability.scopes import (
     annotation,
     named_scope,
@@ -53,26 +79,58 @@ from torchmetrics_tpu._observability.telemetry import (
     report_for,
     telemetry_for,
 )
+from torchmetrics_tpu._observability.tracing import (
+    TRACER,
+    Span,
+    SpanRecorder,
+    current_span,
+    current_trace_id,
+    export_chrome_trace,
+    set_tracing_enabled,
+    span_tree,
+    trace_context,
+    tracing_enabled,
+)
 
 __all__ = [
     "BUS",
     "EventBus",
+    "FlightRecorder",
+    "HealthReport",
     "LatencyReservoir",
     "MetricTelemetry",
     "OBS",
     "REGISTRY",
     "RecompileChurnWarning",
+    "SLO",
+    "SloStatus",
+    "SloTracker",
+    "Span",
+    "SpanRecorder",
+    "TRACER",
     "TelemetryEvent",
     "TelemetryRegistry",
     "TelemetryReport",
     "annotation",
+    "arm_flight_recorder",
+    "current_span",
+    "current_trace_id",
+    "disarm_flight_recorder",
+    "export_chrome_trace",
+    "get_flight_recorder",
     "get_registry",
+    "health_report",
     "named_scope",
     "profiling_scopes_active",
     "report_for",
     "set_profile_scopes",
+    "set_slos",
     "set_telemetry_enabled",
     "set_telemetry_sampling",
+    "set_tracing_enabled",
+    "span_tree",
     "telemetry_enabled",
     "telemetry_for",
+    "trace_context",
+    "tracing_enabled",
 ]

@@ -35,7 +35,11 @@ class TelemetryEvent:
     """One runtime occurrence on the bus.
 
     ``seq`` is a process-wide monotonically increasing ordinal (gaps mean
-    eviction happened between reads); ``ts`` is ``time.time()`` at publish;
+    eviction happened between reads); ``ts`` is ``time.time()`` at publish
+    (wall clock — human-readable, but steppable by NTP) and ``mono`` is
+    ``time.monotonic()`` at publish — the same clock spans are stamped with
+    (``tracing.Span.t0_mono``), so flight-recorder dumps interleave events
+    and spans from different components on ONE un-steppable axis;
     ``source`` names the emitting object (usually a metric class name);
     ``data`` carries small host-side payload values (must stay
     JSON-serializable — exports embed it verbatim).
@@ -47,6 +51,7 @@ class TelemetryEvent:
     source: str
     detail: str
     data: Dict[str, Any] = field(default_factory=dict)
+    mono: float = 0.0
 
 
 class EventBus:
@@ -85,7 +90,8 @@ class EventBus:
                 _san_check(self, "_events,_kind_totals,_subscribers")
             self._seq += 1
             event = TelemetryEvent(
-                seq=self._seq, ts=time.time(), kind=kind, source=source, detail=detail, data=dict(data or {})
+                seq=self._seq, ts=time.time(), mono=time.monotonic(),
+                kind=kind, source=source, detail=detail, data=dict(data or {}),
             )
             if len(self._events) == self._events.maxlen:
                 self.dropped += 1

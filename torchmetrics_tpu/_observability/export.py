@@ -53,9 +53,23 @@ _HELP: Dict[str, str] = {
     "uncompiled_signatures": "Distinct signatures streamed eagerly past the saturated auto cache.",
     "events_dropped": "Event-bus entries evicted by the capacity bound.",
     "latency_samples": "Lifetime latency samples recorded per op reservoir (monotonic).",
-    "latency_seconds": "Latency reservoir summary statistics per op (retained window).",
+    "latency_sum_seconds": "Lifetime sum of sampled latency seconds per op (monotonic).",
+    "latency_seconds": (
+        "Sampled operation latency as a Prometheus summary: quantiles over the retained"
+        " reservoir window, count/sum lifetime-monotonic."
+    ),
     "telemetry_enabled": "1 while the telemetry layer is collecting.",
+    "pool_stream_updates": "Per-tenant applied StreamPool rows (bounded stream= label dimension).",
+    "pool_quarantined": "Per-tenant StreamPool rows dropped by the NaN quarantine.",
+    "pool_violations": "Per-tenant StreamPool rows dropped by error-severity validation flags.",
+    "pool_attach": "StreamPool attach() calls.",
+    "pool_detach": "StreamPool detach() calls.",
+    "pool_growths": "StreamPool capacity-doubling growth events.",
+    "pool_computes": "StreamPool compute dispatches by kind (cache misses only).",
 }
+
+# reservoir quantiles exported as summary lines (satellite: p50/p90/p99 per op)
+_SUMMARY_QUANTILES = (("p50", "0.5"), ("p90", "0.9"), ("p99", "0.99"))
 
 def _escape_label(value: str) -> str:
     return value.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
@@ -96,18 +110,38 @@ def render_prometheus(aggregate: Dict[str, Dict[str, Any]], bus: Any, enabled: b
     for cls_name in sorted(aggregate):
         entry = aggregate[cls_name]
         base = {"metric": cls_name}
+        # ops with any latency evidence: a live retained window, or lifetime
+        # counters left behind by retired instances (count/sum still export)
+        summary_ops = set(entry["latency"])
         for key in sorted(entry["counters"]):
             family, labels = _split_key(key)
+            if family in ("latency_samples", "latency_sum_seconds"):
+                # these two ride the latency summary below as `_count`/`_sum`
+                # series — re-emitting them as standalone counter families
+                # would export every sample twice under two names
+                if "op" in labels:
+                    summary_ops.add(labels["op"])
+                continue
             emit(family, {**base, **labels}, entry["counters"][key])
-        for op in sorted(entry["latency"]):
-            stats = entry["latency"][op]
-            for stat, val in sorted(stats.items()):
-                if stat == "count":
-                    # lifetime sample counts ride the regular counter path
-                    # (`latency_samples|op=...`) — the retained-window count
-                    # here would shrink on GC, breaking counter monotonicity
-                    continue
-                emit("latency_seconds", {**base, "op": op, "stat": stat}, val, kind="gauge")
+        for op in sorted(summary_ops):
+            # Prometheus summary: quantile-labelled samples over the retained
+            # reservoir window + lifetime-monotonic `_sum`/`_count` drawn from
+            # the regular counters (they survive instance GC; the window
+            # doesn't). An op known only from retired counters emits sum/count
+            # with no quantiles — a valid, honest summary.
+            stats = entry["latency"].get(op, {})
+            labels = {**base, "op": op}
+            name = f"{_PREFIX}_latency_seconds"
+            fam = families.get(name)
+            if fam is None:
+                fam = families[name] = ("summary", _HELP["latency_seconds"], [])
+            for stat, q in _SUMMARY_QUANTILES:
+                if stat in stats:
+                    fam[2].append(_sample(name, {**labels, "quantile": q}, stats[stat]))
+            lifetime_sum = entry["counters"].get(f"latency_sum_seconds|op={op}", stats.get("sum", 0.0))
+            lifetime_count = entry["counters"].get(f"latency_samples|op={op}", stats.get("count", 0))
+            fam[2].append(_sample(f"{name}_sum", labels, lifetime_sum))
+            fam[2].append(_sample(f"{name}_count", labels, lifetime_count))
     for kind_name, count in sorted(bus.kind_totals().items()):
         emit("events", {"kind": kind_name}, count)
     emit("events_dropped", {}, bus.dropped)
@@ -139,6 +173,7 @@ def to_json(aggregate: Dict[str, Dict[str, Any]], bus: Any, enabled: bool) -> Di
             {
                 "seq": e.seq,
                 "ts": e.ts,
+                "mono": e.mono,
                 "kind": e.kind,
                 "source": e.source,
                 "detail": e.detail,

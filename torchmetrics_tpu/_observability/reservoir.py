@@ -12,7 +12,20 @@ from __future__ import annotations
 import math
 from typing import Dict, List
 
-__all__ = ["LatencyReservoir"]
+__all__ = ["LatencyReservoir", "nearest_rank"]
+
+
+def nearest_rank(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank quantile over pre-sorted samples (NaN when empty).
+
+    The ONE quantile formula for the whole observability layer: reservoir
+    stats, the Prometheus summary, and SLO probe numbers all call this, so
+    they agree exactly on identical samples.
+    """
+    if not sorted_vals:
+        return math.nan
+    rank = min(len(sorted_vals) - 1, max(0, int(math.ceil(q * len(sorted_vals))) - 1))
+    return sorted_vals[rank]
 
 
 class LatencyReservoir:
@@ -54,11 +67,7 @@ class LatencyReservoir:
 
     def quantile(self, q: float) -> float:
         """Nearest-rank quantile over the retained window (NaN when empty)."""
-        vals = sorted(self.values())
-        if not vals:
-            return math.nan
-        rank = min(len(vals) - 1, max(0, int(math.ceil(q * len(vals))) - 1))
-        return vals[rank]
+        return nearest_rank(sorted(self.values()), q)
 
     def stats(self) -> Dict[str, float]:
         """Summary for reports/exports.

@@ -20,6 +20,11 @@ Switches:
 - :func:`set_telemetry_sampling` controls how often latency samples are
   taken on the hot paths (every Nth call; counters are always exact).
 
+The request-tracing layer (``tracing.py``) rides the same object with its
+own independent slot bool (``OBS.tracing``, env ``TM_TPU_TRACING=1``): span
+collection can be on while counters are off and vice versa, and each seam
+pays exactly one slot load + branch per switch it honors.
+
 This module must stay import-light (no jax, no numpy): it is imported by
 ``metric.py`` at module scope.
 """
@@ -45,12 +50,16 @@ class _ObsState:
     branch) and makes accidental attribute growth an error.
     """
 
-    __slots__ = ("enabled", "sample_every", "profile_scopes")
+    __slots__ = ("enabled", "sample_every", "profile_scopes", "tracing")
 
     def __init__(self) -> None:
         self.enabled = os.environ.get("TM_TPU_TELEMETRY", "") == "1"
         self.sample_every = DEFAULT_SAMPLE_EVERY
         self.profile_scopes = True
+        # span tracing (tracing.py) — independent of the counter switch so a
+        # deployment can trace sampled requests without paying for counters
+        # (or vice versa); the setter lives in tracing.set_tracing_enabled
+        self.tracing = os.environ.get("TM_TPU_TRACING", "") == "1"
 
 
 OBS = _ObsState()

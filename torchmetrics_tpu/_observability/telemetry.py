@@ -123,10 +123,12 @@ class MetricTelemetry:  # concurrency: shared exporters scrape via the registry 
         if res is None:
             res = self.reservoirs[op] = LatencyReservoir()
         res.push(seconds)
-        # lifetime sample count as a REGULAR counter: it survives instance
-        # retirement and stays monotonic, which the Prometheus export needs
+        # lifetime sample count AND summed seconds as REGULAR counters: they
+        # survive instance retirement and stay monotonic, which the
+        # Prometheus summary export needs for its `_count`/`_sum` series
         # (the reservoir's retained window shrinks/vanishes on GC)
         self.inc(f"latency_samples|op={op}")
+        self.inc(f"latency_sum_seconds|op={op}", seconds)
 
     # ---------------------------------------------------------------- compile
     # distinct cache keys remembered for dedup; beyond this a churn-pathology
@@ -383,6 +385,25 @@ class TelemetryRegistry:
                 summarized[op] = res.stats()
             entry["latency"] = summarized
         return out
+
+    def counter_totals(self) -> Dict[str, float]:
+        """Counter totals summed over live+retired instances of every class,
+        full ``family|label=value`` keys preserved — the counters-only slice
+        of :meth:`aggregate` without the latency pooling/sorting (SLO probes
+        hit this every few seconds; sorting retained samples per probe just
+        to discard them is wasted work)."""
+        with self._lock:
+            if _SAN.enabled:
+                _san_check(self, "_live,_retired,_retired_instances")
+            self._drain_retired()
+            live = [t for _, t in self._live.values()]
+            retired = [dict(v) for v in self._retired.values()]
+        totals: Dict[str, float] = {}
+        # dict(...) copies are C-level (atomic under the GIL) — see aggregate()
+        for counters in [dict(t.counters) for t in live] + retired:
+            for key, val in counters.items():
+                totals[key] = totals.get(key, 0.0) + float(val)
+        return totals
 
     # --------------------------------------------------------------- exports
     def render_prometheus(self) -> str:

@@ -38,6 +38,8 @@ from typing import Any, Callable, Dict, List, Optional, Tuple, Union
 import jax.numpy as jnp
 import numpy as np
 
+from torchmetrics_tpu._observability import tracing as _tracing
+from torchmetrics_tpu._observability.events import BUS as _BUS
 from torchmetrics_tpu._resilience.faultinject import (
     corrupt_file,
     inject_collective_failure,
@@ -97,6 +99,9 @@ class ChaosEvent:
     step: int
     kind: str  # "nan" | "forward" | "preempt" | "corrupt" | "restore" | "final_fault"
     detail: str = ""
+    # correlation id of the batch's trace_context when tracing is enabled:
+    # flight-recorder dumps for this fault must carry the same id
+    trace_id: Optional[int] = None
 
 
 @dataclass
@@ -255,42 +260,66 @@ def _run_schedule(
     corrupted: set = set()  # generations this schedule already destroyed
     try:
         for i, (preds, target) in enumerate(batches):
-            p = poison_nans(preds, frac=0.5) if poisoned[i] else jnp.asarray(preds)
-            t = jnp.asarray(target)
-            if poisoned[i]:
-                result.events.append(ChaosEvent(i, "nan"))
-            if use_forward[i]:
-                live.forward(p, t)
-            else:
-                live.update(p, t)
-            if preempt[i]:
-                if corrupt_roll[i]:
-                    # the corrupt fault models at-rest storage damage to a fully
-                    # written snapshot, so quiesce pending writes+prunes first
-                    # (the race being dodged is in the injector's bookkeeping,
-                    # not in the stack under test), then stay inside the
-                    # recovery envelope: both survivors of the retention window
-                    # must be valid — prune retains by count, so a previously
-                    # corrupted generation can occupy the fallback slot
-                    mgr.flush()
-                    snaps = _snapshots_on_disk(directory)
-                    window = snaps[-2:]
-                    if len(window) >= 2 and all(s.name not in corrupted for s in window):
-                        corrupt_file(window[-1], "bitflip", seed=seed * 1000 + i)
-                        corrupted.add(window[-1].name)
-                        result.events.append(ChaosEvent(i, "corrupt", window[-1].name))
-                mgr.simulate_preemption()
-                result.events.append(ChaosEvent(i, "preempt"))
-                result.preemptions += 1
-                live = factory()
-                mgr = SnapshotManager(live, directory, _policy(spec))
-                report = mgr.restore_latest()
-                result.replayed_total += report.replayed
-                result.events.append(
-                    ChaosEvent(i, "restore", f"gen={report.generation} replayed={report.replayed}")
-                )
-                if report.truncated_journal:
-                    result.failures.append(f"step {i}: restore truncated the journal (entries lost)")
+            # one trace context per batch: the injected faults below fire
+            # inside it, so flight-recorder dumps carry the failing batch's
+            # correlation id (no-op while tracing is disabled)
+            with _tracing.trace_context(f"chaos_batch_{i}", "chaos", step=i):
+                tid = _tracing.current_trace_id()
+                p = poison_nans(preds, frac=0.5) if poisoned[i] else jnp.asarray(preds)
+                t = jnp.asarray(target)
+                if poisoned[i]:
+                    # the quarantine degradation the poisoned batch provokes is
+                    # itself a flight-recorder trigger — no extra event needed
+                    result.events.append(ChaosEvent(i, "nan", trace_id=tid))
+                if use_forward[i]:
+                    live.forward(p, t)
+                else:
+                    live.update(p, t)
+                if preempt[i]:
+                    if corrupt_roll[i]:
+                        # the corrupt fault models at-rest storage damage to a fully
+                        # written snapshot, so quiesce pending writes+prunes first
+                        # (the race being dodged is in the injector's bookkeeping,
+                        # not in the stack under test), then stay inside the
+                        # recovery envelope: both survivors of the retention window
+                        # must be valid — prune retains by count, so a previously
+                        # corrupted generation can occupy the fallback slot
+                        mgr.flush()
+                        snaps = _snapshots_on_disk(directory)
+                        window = snaps[-2:]
+                        if len(window) >= 2 and all(s.name not in corrupted for s in window):
+                            corrupt_file(window[-1], "bitflip", seed=seed * 1000 + i)
+                            corrupted.add(window[-1].name)
+                            # corruption surfaces as the restore's fallback
+                            # degradation (its own trigger), so no chaos_fault
+                            result.events.append(
+                                ChaosEvent(i, "corrupt", window[-1].name, trace_id=tid)
+                            )
+                    mgr.simulate_preemption()
+                    # a clean kill+restore produces NO degradation — name the
+                    # fault on the bus so the flight recorder still dumps it
+                    _BUS.publish(
+                        "chaos_fault", type(live).__name__,
+                        f"preemption kill at batch {i}",
+                        data={"seam": "snapshot.restore", "fault": "preemption", "step": i},
+                    )
+                    result.events.append(ChaosEvent(i, "preempt", trace_id=tid))
+                    result.preemptions += 1
+                    live = factory()
+                    mgr = SnapshotManager(live, directory, _policy(spec))
+                    report = mgr.restore_latest()
+                    result.replayed_total += report.replayed
+                    result.events.append(
+                        ChaosEvent(
+                            i, "restore",
+                            f"gen={report.generation} replayed={report.replayed}",
+                            trace_id=tid,
+                        )
+                    )
+                    if report.truncated_journal:
+                        result.failures.append(
+                            f"step {i}: restore truncated the journal (entries lost)"
+                        )
     finally:
         # a raising schedule must not leak the writer thread / journal fd
         # (close() is idempotent, so the happy path pays nothing extra)
@@ -311,10 +340,13 @@ def _run_schedule(
 
     # -------------------------------------------- idempotent restore+replay
     r1, r2 = factory(), factory()
-    with SnapshotManager(r1, directory, _policy(spec)) as m1:
-        m1.restore_latest()
-    with SnapshotManager(r2, directory, _policy(spec)) as m2:
-        m2.restore_latest()
+    # own trace contexts: these restores re-walk any corrupted generation, so
+    # their fallback degradations (flight triggers) stay correlated
+    with _tracing.trace_context("chaos_restore_check", "chaos"):
+        with SnapshotManager(r1, directory, _policy(spec)) as m1:
+            m1.restore_latest()
+        with SnapshotManager(r2, directory, _policy(spec)) as m2:
+            m2.restore_latest()
     exact, why = _states_allclose(_local_state_blocks(r1), _local_state_blocks(r2), exact=True)
     if not exact:
         result.failures.append(f"restore+replay not idempotent: {why}")
@@ -337,11 +369,24 @@ def _run_schedule(
                 if spec.stall_final
                 else inject_collective_failure(first_n=spec.final_collective_faults)
             )
-            with injector as stats:
-                live_value = live.compute()
+            with _tracing.trace_context("chaos_final_sync", "chaos"):
+                tid = _tracing.current_trace_id()
+                with injector as stats:
+                    live_value = live.compute()
+                # transient collective faults are absorbed by the retry budget
+                # (that is the invariant under test) and so produce no
+                # degradation — name each on the bus for the flight recorder
+                fault_name = "collective_stall" if spec.stall_final else "collective_failure"
+                for k in range(stats.injected):
+                    _BUS.publish(
+                        "chaos_fault", type(live).__name__,
+                        f"{fault_name} {k + 1}/{stats.injected} during final sync",
+                        data={"seam": "guard.sync", "fault": fault_name},
+                    )
             result.events.append(
                 ChaosEvent(spec.n_batches, "final_fault",
-                           f"{'stall' if spec.stall_final else 'failure'} x{stats.injected}")
+                           f"{'stall' if spec.stall_final else 'failure'} x{stats.injected}",
+                           trace_id=tid)
             )
         else:
             live_value = live.compute()

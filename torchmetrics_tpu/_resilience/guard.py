@@ -34,6 +34,7 @@ from typing import Any, Callable, Optional, Tuple
 import numpy as np
 
 from torchmetrics_tpu._analysis.locksan import new_lock as _san_lock
+from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.state import OBS as _OBS
 from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
 from torchmetrics_tpu._resilience.errors import (
@@ -180,18 +181,40 @@ def run_guarded(
     for attempt in range(retry.attempts):
         if on_attempt is not None:
             on_attempt(attempt)
+        # one span per collective attempt, opened on the CALLING thread so a
+        # timed-out, abandoned worker attempt can never write into the trace;
+        # retries appear as sibling spans under the seam's sync span
+        _sp = (
+            _obs_trace.begin_span("sync_attempt", describe, attempt=attempt)
+            if _OBS.tracing
+            else None
+        )
         try:
-            return _run_with_timeout(fn, retry.timeout)
-        except StateStructureMismatchError:
+            result = _run_with_timeout(fn, retry.timeout)
+        except StateStructureMismatchError as err:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, err)
             raise
-        except _NON_RETRYABLE:
+        except _NON_RETRYABLE as err:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, err)
             raise
         except Exception as err:  # noqa: BLE001 - transport errors are policy-handled
+            if _sp is not None:
+                _obs_trace.end_span(_sp, err)
             last_err = err
             if attempt + 1 < retry.attempts:
                 delay = retry.backoff(attempt)
                 if delay:
                     time.sleep(delay)
+        except BaseException as err:  # KeyboardInterrupt/SystemExit: close the span, never swallow
+            if _sp is not None:
+                _obs_trace.end_span(_sp, err)
+            raise
+        else:
+            if _sp is not None:
+                _obs_trace.end_span(_sp)
+            return result
     raise SyncRetriesExhausted(
         f"{describe} failed after {retry.attempts} attempt(s); last error:"
         f" {type(last_err).__name__}: {last_err}",

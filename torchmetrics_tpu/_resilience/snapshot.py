@@ -53,6 +53,7 @@ from typing import Any, Dict, List, Optional, Tuple, Union
 import jax
 import numpy as np
 
+from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.events import BUS as _BUS
 from torchmetrics_tpu._observability.state import OBS as _OBS
 from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
@@ -468,6 +469,25 @@ class SnapshotManager:
             raise RuntimeError("SnapshotManager is closed; snapshot refused")
         gen = self._next_gen
         self._next_gen += 1
+        _sp = None
+        if _OBS.tracing:
+            # the span covers capture + rotation + (inline) write; an async
+            # write's disk time lands on the writer thread, outside the
+            # request — exactly the cost the caller actually paid
+            _sp = _obs_trace.begin_span(
+                "snapshot.write", type(self.target).__name__, generation=gen, inline=bool(_inline)
+            )
+        _sp_err: Optional[BaseException] = None
+        try:
+            return self._snapshot_now_impl(gen, _inline)
+        except BaseException as err:
+            _sp_err = err
+            raise
+        finally:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, _sp_err)
+
+    def _snapshot_now_impl(self, gen: int, _inline: bool) -> int:
         payload = {
             "version": SNAPSHOT_VERSION,
             "kind": "collection" if self._is_collection else "metric",
@@ -541,6 +561,26 @@ class SnapshotManager:
         operation idempotent. Raises :class:`SnapshotRestoreError` when no
         generation is restorable.
         """
+        _sp = (
+            _obs_trace.begin_span("snapshot.restore", type(self.target).__name__)
+            if _OBS.tracing
+            else None
+        )
+        _sp_err: Optional[BaseException] = None
+        try:
+            report = self._restore_latest_impl()
+            if _sp is not None:
+                _sp.attrs["generation"] = report.generation
+                _sp.attrs["replayed"] = report.replayed
+            return report
+        except BaseException as err:
+            _sp_err = err
+            raise
+        finally:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, _sp_err)
+
+    def _restore_latest_impl(self) -> RestoreReport:
         gens = sorted(self._generations_on_disk(), reverse=True)
         skipped: Dict[int, str] = {}
         loaded: Optional[int] = None

@@ -51,6 +51,7 @@ import numpy as np
 from jax.sharding import PartitionSpec
 
 from torchmetrics_tpu._analysis.manifest import in_graph_sync_eligible
+from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.state import OBS as _OBS
 from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
 from torchmetrics_tpu._spmd import faultinject as _faultinject
@@ -216,6 +217,24 @@ class SpmdEngine:
         this is ``target.update(batch); target.compute()`` — the eager
         guarded-sync path the engine replaced.
         """
+        _sp = None
+        if _OBS.tracing:
+            # ONE span for the fused update+sync+compute dispatch; a degraded
+            # step's eager fallback opens the ordinary seam spans as children
+            _sp = _obs_trace.begin_span(
+                "spmd.step", type(self.target).__name__, degraded=self._degraded
+            )
+        _sp_err: Optional[BaseException] = None
+        try:
+            return self._step_impl(args, kwargs)
+        except BaseException as err:
+            _sp_err = err
+            raise
+        finally:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, _sp_err)
+
+    def _step_impl(self, args: tuple, kwargs: Dict[str, Any]) -> Any:
         if self._degraded:
             return self._eager_step(args, kwargs)
         if self._units is None:

@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu._analysis.manifest import stream_pool_eligible
+from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.events import BUS as _BUS
 from torchmetrics_tpu._observability.state import OBS as _OBS
 from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
@@ -335,6 +336,19 @@ class StreamPool:
         Every array argument must carry a leading axis of length B — row
         ``b`` is stream ``stream_ids[b]``'s batch for this call.
         """
+        _sp = _obs_trace.begin_span("update", "StreamPool") if _OBS.tracing else None
+        _sp_err: Optional[BaseException] = None
+        try:
+            return self._update_impl(_sp, stream_ids, args, kwargs)
+        except BaseException as err:
+            _sp_err = err
+            raise
+        finally:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, _sp_err)
+
+    def _update_impl(self, _sp: Any, stream_ids: Any, args: tuple, kwargs: Dict[str, Any]) -> None:
+        """The micro-batch body (``_sp`` = the seam's open span or None)."""
         from torchmetrics_tpu.metric import Metric
 
         ids = np.asarray(stream_ids, dtype=np.int32).reshape(-1)
@@ -343,6 +357,8 @@ class StreamPool:
         live = ids[ids >= 0]
         if live.size == 0:
             return
+        if _sp is not None:
+            _sp.attrs["rows"] = int(ids.size)
         if np.unique(live).size != live.size:
             raise TorchMetricsUserError(
                 "duplicate stream ids in one micro-batch: the masked scatter would apply"
@@ -398,7 +414,18 @@ class StreamPool:
             obs_sample = telem.sample_due("stream_step")
             if obs_sample:
                 t0 = time.perf_counter()
-        new_states, row_flags = fn(self._states, jnp.asarray(ids), dynamic)
+        if _sp is not None:
+            # the compiled vmapped dispatch as a child span: host prep vs
+            # device step separate cleanly in the request tree
+            _step_sp = _obs_trace.begin_span("stream_step", "StreamPool", built=built)
+            try:
+                new_states, row_flags = fn(self._states, jnp.asarray(ids), dynamic)
+            except BaseException as err:
+                _obs_trace.end_span(_step_sp, err)
+                raise
+            _obs_trace.end_span(_step_sp)
+        else:
+            new_states, row_flags = fn(self._states, jnp.asarray(ids), dynamic)
         self._states = new_states
         applied = ids >= 0
         if self._row_guards:
@@ -430,6 +457,13 @@ class StreamPool:
             label = self.labeler.note(sid)
             if _OBS.enabled:
                 _telemetry_for(self).inc(f"pool_stream_updates|stream={label}")
+        if _sp is not None:
+            # bounded `stream=` attribution, read AFTER this batch's note()
+            # calls so the span agrees with the per-row counter labels above
+            # (top-K by volume + __overflow__ — a 10k-tenant pool cannot
+            # explode span-attribute cardinality)
+            labels = sorted({self.labeler.label(sid) for sid in live.tolist()})
+            _sp.attrs["streams"] = ",".join(labels[:16]) + (",…" if len(labels) > 16 else "")
         self.total_row_updates += int(applied_ids.size)
         if _OBS.enabled:
             telem = _telemetry_for(self)
@@ -452,9 +486,22 @@ class StreamPool:
                 "the pool has no states yet (no update() has run); stream values are"
                 " undefined before the first batch"
             )
-        if self._compute_one_fn is None:
-            self._compute_one_fn = self._build_compute_one()
-        value = self._shape_value(self._compute_one_fn(self._states, jnp.int32(sid)))
+        _sp = None
+        if _OBS.tracing:
+            _sp = _obs_trace.begin_span(
+                "compute", "StreamPool", kind="one", stream=self.labeler.label(sid)
+            )
+        _sp_err: Optional[BaseException] = None
+        try:
+            if self._compute_one_fn is None:
+                self._compute_one_fn = self._build_compute_one()
+            value = self._shape_value(self._compute_one_fn(self._states, jnp.int32(sid)))
+        except BaseException as err:
+            _sp_err = err
+            raise
+        finally:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, _sp_err)
         self._value_cache[sid] = value
         self._dirty[sid] = False
         if _OBS.enabled:
@@ -465,9 +512,18 @@ class StreamPool:
         """Every attached stream's value from ONE vmapped compiled compute."""
         if self._units is None:
             return {}
-        if self._compute_all_fn is None:
-            self._compute_all_fn = self._build_compute_all()
-        stacked = self._compute_all_fn(self._states)
+        _sp = _obs_trace.begin_span("compute", "StreamPool", kind="all") if _OBS.tracing else None
+        _sp_err: Optional[BaseException] = None
+        try:
+            if self._compute_all_fn is None:
+                self._compute_all_fn = self._build_compute_all()
+            stacked = self._compute_all_fn(self._states)
+        except BaseException as err:
+            _sp_err = err
+            raise
+        finally:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, _sp_err)
         out: Dict[int, Any] = {}
         for sid in sorted(self._active):
             value = self._shape_value(

@@ -21,6 +21,8 @@ from typing import Any, Dict, Iterable, List, Optional, Sequence, Tuple, Union
 
 import jax.numpy as jnp
 
+from torchmetrics_tpu._observability import tracing as _obs_trace
+from torchmetrics_tpu._observability.state import OBS as _OBS
 from torchmetrics_tpu.metric import Metric
 from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
 
@@ -145,19 +147,30 @@ class MetricCollection:
     # ------------------------------------------------------------------ update
     def update(self, *args: Any, **kwargs: Any) -> None:
         """Update each metric (group heads only once groups are formed)."""
-        if self._groups_checked:
-            for cg in self._groups.values():
-                head = self._modules[cg[0]]
-                head.update(*args, **head._filter_kwargs(**kwargs))
-            self._sync_compute_groups()
-        else:
-            for m in self._modules.values():
-                m.update(*args, **m._filter_kwargs(**kwargs))
-            if self._enable_compute_groups:
-                self._merge_compute_groups()
+        # the collection span parents every member metric's update span, so
+        # one fan-out call stays one causally-ordered request tree
+        _sp = _obs_trace.begin_span("update", "MetricCollection") if _OBS.tracing else None
+        _sp_err: Optional[BaseException] = None
+        try:
+            if self._groups_checked:
+                for cg in self._groups.values():
+                    head = self._modules[cg[0]]
+                    head.update(*args, **head._filter_kwargs(**kwargs))
+                self._sync_compute_groups()
             else:
-                self._groups = {i: [name] for i, name in enumerate(self._modules)}
-                self._groups_checked = True
+                for m in self._modules.values():
+                    m.update(*args, **m._filter_kwargs(**kwargs))
+                if self._enable_compute_groups:
+                    self._merge_compute_groups()
+                else:
+                    self._groups = {i: [name] for i, name in enumerate(self._modules)}
+                    self._groups_checked = True
+        except BaseException as err:
+            _sp_err = err
+            raise
+        finally:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, _sp_err)
         self._journal_record("update", args, kwargs)
 
     def _journal_record(self, method: str, args: tuple, kwargs: Dict[str, Any]) -> None:
@@ -234,18 +247,36 @@ class MetricCollection:
     # ----------------------------------------------------------------- compute
     def forward(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
         """Per-batch value from every metric while accumulating global state."""
-        res = {name: m(*args, **m._filter_kwargs(**kwargs)) for name, m in self._modules.items()}
-        if not self._groups_checked and self._enable_compute_groups:
-            self._merge_compute_groups()
+        _sp = _obs_trace.begin_span("forward", "MetricCollection") if _OBS.tracing else None
+        _sp_err: Optional[BaseException] = None
+        try:
+            res = {name: m(*args, **m._filter_kwargs(**kwargs)) for name, m in self._modules.items()}
+            if not self._groups_checked and self._enable_compute_groups:
+                self._merge_compute_groups()
+        except BaseException as err:
+            _sp_err = err
+            raise
+        finally:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, _sp_err)
         # forward and update produce the same accumulated state, so the
         # journal replays either through collection.update()
         self._journal_record("update", args, kwargs)
         return self._flatten_results(res)
 
     def compute(self) -> Dict[str, Any]:
-        if self._groups_checked:
-            self._sync_compute_groups()
-        res = {name: m.compute() for name, m in self._modules.items()}
+        _sp = _obs_trace.begin_span("compute", "MetricCollection") if _OBS.tracing else None
+        _sp_err: Optional[BaseException] = None
+        try:
+            if self._groups_checked:
+                self._sync_compute_groups()
+            res = {name: m.compute() for name, m in self._modules.items()}
+        except BaseException as err:
+            _sp_err = err
+            raise
+        finally:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, _sp_err)
         return self._flatten_results(res)
 
     def _flatten_results(self, res: Dict[str, Any]) -> Dict[str, Any]:

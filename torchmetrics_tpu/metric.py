@@ -44,6 +44,7 @@ from torchmetrics_tpu._analysis.manifest import compiled_validation_eligible, fi
 # lives behind it. state/events/telemetry import no jax/numpy at module
 # scope; scopes pulls only jax symbol lookups (jax is already imported here).
 from torchmetrics_tpu._observability import scopes as _obs_scopes
+from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.events import BUS as _BUS
 from torchmetrics_tpu._observability.state import OBS as _OBS
 from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
@@ -323,13 +324,22 @@ class Metric(ABC):
         suspended = "_journal_suspend" in self.__dict__
         if not suspended:
             self.__dict__["_journal_suspend"] = True
+        # the forward span parents the dance's inner update/compute spans,
+        # so one forward call still reads as ONE causally-ordered request
+        _sp = _obs_trace.begin_span("forward", type(self).__name__) if _OBS.tracing else None
+        _sp_err: Optional[BaseException] = None
         try:
             if self.full_state_update or self.full_state_update is None or self.dist_sync_on_step:
                 self._forward_cache = self._forward_full_state_update(*args, **kwargs)
             else:
                 handled, batch_val = self._try_auto_forward(args, kwargs)
                 self._forward_cache = batch_val if handled else self._forward_reduce_state_update(*args, **kwargs)
+        except BaseException as err:
+            _sp_err = err
+            raise
         finally:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, _sp_err)
             if not suspended:
                 self.__dict__.pop("_journal_suspend", None)
         # replay re-runs forward entries through plain update(): the state
@@ -498,68 +508,88 @@ class Metric(ABC):
     def _wrap_update(self, update: Callable) -> Callable:
         @functools.wraps(update)
         def wrapped_func(*args: Any, **kwargs: Any) -> None:
-            if self._try_auto_update(args, kwargs):
-                self._journal_record("update", args, kwargs)
-                return None
-            self._check_pending_violations()
-            self._computed = None
-            self._update_count += 1
-            # only pay the fingerprint where a compiled path could engage AND
-            # the static analyzer hasn't already proven the whole class chain
-            # free of unregistered-attribute mutation (R1 certification —
-            # see torchmetrics_tpu/_analysis and ANALYSIS.md)
-            eligible = self._auto_eligible()
-            guard = eligible and not self._fingerprint_exempt()
-            if _OBS.enabled:
-                _t = _telemetry_for(self)
-                _t.inc("fingerprint|outcome=check" if guard else "fingerprint|outcome=skip" if eligible else "fingerprint|outcome=ineligible")
-            if guard:
-                # the keep-alive list pins every fingerprinted object for the
-                # duration of the update, so a freed-and-reallocated object
-                # cannot alias a stale id in the comparison
-                before, _keepalive = self._host_attr_snapshot()
-            # quarantine is the only nan_policy needing a rollback point; the
-            # pre-update list lengths let the sentinel scan only the elements
-            # THIS batch appended (cat-state streams stay O(batch), not O(n))
-            pre_state = pre_lens = None
-            if self.nan_policy is not None:
-                # stream-position ordinal for sentinel telemetry: forward()'s
-                # stash/reset dance makes `_update_count` batch-local, so the
-                # recorded "which batch was dropped" needs its own counter
-                # (the full-state forward's batch-only replay doesn't count)
-                if not self.__dict__.get("_nan_replay"):
-                    self.__dict__["_nan_seen_batches"] = self.__dict__.get("_nan_seen_batches", 0) + 1
-                pre_lens = {}
-                for n in self._defaults:
-                    v = getattr(self, n)
-                    if isinstance(v, list):
-                        pre_lens[n] = len(v)
-                if self.nan_policy == "quarantine":
-                    pre_state = self._quarantine_snapshot()
-                    self.__dict__["_nan_last_quarantined"] = False
-            if _OBS.enabled:
-                self._obs_call("update_calls|path=eager", "update_eager", "update", lambda: update(*args, **kwargs))
-            else:
-                update(*args, **kwargs)
-            if guard and self._host_attr_snapshot()[0] != before:
-                # update() mutates plain (unregistered) python attributes; a
-                # traced replay would silently freeze those side effects, so
-                # the compiled paths are permanently off for this instance
-                self._auto_disabled = True
-                self._auto_forward_disabled = True
-                if _OBS.enabled:
-                    self._obs_auto_disabled("update mutated unregistered host attributes")
-            if self.nan_policy is not None:
-                self._guard_nonfinite_states(pre_state, pre_lens)
-            if self._dtype_policy is not None:
-                self._apply_dtype_policy()
-            if self.compute_on_cpu:
-                self._move_list_states_to_cpu()
-            self._journal_record("update", args, kwargs)
-            return None
+            # request tracing rides its own slot-bool (`_OBS.tracing`): off,
+            # this seam pays one branch and a None store; on, the span links
+            # into the ambient trace_context tree via the contextvar
+            _sp = _obs_trace.begin_span("update", type(self).__name__) if _OBS.tracing else None
+            _sp_err: Optional[BaseException] = None
+            try:
+                return self._update_impl(update, _sp, args, kwargs)
+            except BaseException as err:
+                _sp_err = err
+                raise
+            finally:
+                if _sp is not None:
+                    _obs_trace.end_span(_sp, _sp_err)
 
         wrapped_func.__wrapped_by_metric__ = True  # type: ignore[attr-defined]
         return wrapped_func
+
+    def _update_impl(self, update: Callable, _sp: Any, args: tuple, kwargs: Dict[str, Any]) -> None:
+        """The body of every wrapped ``update`` (``_sp`` = the seam's open span or None)."""
+        if self._try_auto_update(args, kwargs):
+            if _sp is not None:
+                _sp.attrs["path"] = "auto"
+            self._journal_record("update", args, kwargs)
+            return None
+        if _sp is not None:
+            _sp.attrs["path"] = "eager"
+        self._check_pending_violations()
+        self._computed = None
+        self._update_count += 1
+        # only pay the fingerprint where a compiled path could engage AND
+        # the static analyzer hasn't already proven the whole class chain
+        # free of unregistered-attribute mutation (R1 certification —
+        # see torchmetrics_tpu/_analysis and ANALYSIS.md)
+        eligible = self._auto_eligible()
+        guard = eligible and not self._fingerprint_exempt()
+        if _OBS.enabled:
+            _t = _telemetry_for(self)
+            _t.inc("fingerprint|outcome=check" if guard else "fingerprint|outcome=skip" if eligible else "fingerprint|outcome=ineligible")
+        if guard:
+            # the keep-alive list pins every fingerprinted object for the
+            # duration of the update, so a freed-and-reallocated object
+            # cannot alias a stale id in the comparison
+            before, _keepalive = self._host_attr_snapshot()
+        # quarantine is the only nan_policy needing a rollback point; the
+        # pre-update list lengths let the sentinel scan only the elements
+        # THIS batch appended (cat-state streams stay O(batch), not O(n))
+        pre_state = pre_lens = None
+        if self.nan_policy is not None:
+            # stream-position ordinal for sentinel telemetry: forward()'s
+            # stash/reset dance makes `_update_count` batch-local, so the
+            # recorded "which batch was dropped" needs its own counter
+            # (the full-state forward's batch-only replay doesn't count)
+            if not self.__dict__.get("_nan_replay"):
+                self.__dict__["_nan_seen_batches"] = self.__dict__.get("_nan_seen_batches", 0) + 1
+            pre_lens = {}
+            for n in self._defaults:
+                v = getattr(self, n)
+                if isinstance(v, list):
+                    pre_lens[n] = len(v)
+            if self.nan_policy == "quarantine":
+                pre_state = self._quarantine_snapshot()
+                self.__dict__["_nan_last_quarantined"] = False
+        if _OBS.enabled:
+            self._obs_call("update_calls|path=eager", "update_eager", "update", lambda: update(*args, **kwargs))
+        else:
+            update(*args, **kwargs)
+        if guard and self._host_attr_snapshot()[0] != before:
+            # update() mutates plain (unregistered) python attributes; a
+            # traced replay would silently freeze those side effects, so
+            # the compiled paths are permanently off for this instance
+            self._auto_disabled = True
+            self._auto_forward_disabled = True
+            if _OBS.enabled:
+                self._obs_auto_disabled("update mutated unregistered host attributes")
+        if self.nan_policy is not None:
+            self._guard_nonfinite_states(pre_state, pre_lens)
+        if self._dtype_policy is not None:
+            self._apply_dtype_policy()
+        if self.compute_on_cpu:
+            self._move_list_states_to_cpu()
+        self._journal_record("update", args, kwargs)
+        return None
 
     def _journal_record(self, method: str, args: tuple, kwargs: Dict[str, Any]) -> None:
         """Feed one *completed* state transition to the attached SnapshotManager.
@@ -767,6 +797,18 @@ class Metric(ABC):
     def _wrap_compute(self, compute: Callable) -> Callable:
         @functools.wraps(compute)
         def wrapped_func(*args: Any, **kwargs: Any) -> Any:
+            _sp = _obs_trace.begin_span("compute", type(self).__name__) if _OBS.tracing else None
+            _sp_err: Optional[BaseException] = None
+            try:
+                return _compute_impl(_sp, args, kwargs)
+            except BaseException as err:
+                _sp_err = err
+                raise
+            finally:
+                if _sp is not None:
+                    _obs_trace.end_span(_sp, _sp_err)
+
+        def _compute_impl(_sp: Any, args: tuple, kwargs: Dict[str, Any]) -> Any:
             self._check_pending_violations()
             if not self.update_called:
                 rank_zero_warn(
@@ -776,9 +818,13 @@ class Metric(ABC):
                     UserWarning,
                 )
             if self._computed is not None:
+                if _sp is not None:
+                    _sp.attrs["outcome"] = "cache_hit"
                 if _OBS.enabled:
                     _telemetry_for(self).inc("compute_calls|outcome=cache_hit")
                 return self._computed
+            # the sync() inside sync_context opens its own child span, so a
+            # traced compute reads update -> sync -> compute causally
             with self.sync_context(
                 dist_sync_fn=self.dist_sync_fn,
                 should_sync=self._to_sync,
@@ -845,6 +891,24 @@ class Metric(ABC):
 
             policy = default_sync_policy()
         self._cache = self._copy_state_dict()
+        _sp = None
+        if _OBS.tracing:
+            _sp = _obs_trace.begin_span(
+                "sync", type(self).__name__, mode="unguarded" if policy is None else "guarded"
+            )
+        _sp_err: Optional[BaseException] = None
+        try:
+            self._sync_guarded_or_not(dist_sync_fn, group, policy)
+        except BaseException as err:
+            _sp_err = err
+            raise
+        finally:
+            if _sp is not None:
+                _obs_trace.end_span(_sp, _sp_err)
+
+    def _sync_guarded_or_not(self, dist_sync_fn: Callable, group: Any, policy: Any) -> None:
+        """The committed half of :meth:`sync` (split out so the seam span
+        brackets exactly the collective work, guarded attempts included)."""
         if policy is None:
             if _OBS.enabled:
                 self._obs_call(
