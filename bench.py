@@ -1823,6 +1823,225 @@ def _bench_locksan() -> tuple:
     return pair_ratio * shim_rate, shim_rate
 
 
+# --------------------------------------------------------------------- #
+# AOT executable cache: cold start + disabled/enabled-path cost           #
+# (torchmetrics_tpu/_aot — README "Cold start & AOT cache")               #
+# --------------------------------------------------------------------- #
+
+AOT_COLD_PAIRS = 3  # cold/warm subprocess pairs (each child pays a full interpreter+jax start)
+
+# each child drives the FULL certified default-path sweep (the 16 classes the
+# golden recompile manifest pins) and reports monotonic-clock marks:
+# spawn -> first metric result, runtime-ready -> sweep done, the summed
+# `precompile()` wall, and — via the tracing layer's `aot.load` spans — the
+# summed executable-RESOLUTION time, the exact seam the artifact cache
+# serves: trace+XLA-compile+serialize+persist cold vs read+verify+deserialize
+# warm. CLOCK_MONOTONIC is system-wide on Linux, so the parent's pre-spawn
+# timestamp rides the environment and the child can subtract it directly.
+_AOT_COLD_CHILD = """
+import json, os, time, warnings
+t_spawn = float(os.environ["TM_TPU_COLD_T0"])
+import jax
+import torchmetrics_tpu as tm  # noqa: F401 - the import cost rides spawn_to_first
+from torchmetrics_tpu._aot.default_path import DEFAULT_PATH_CASES, canonical_batch
+from torchmetrics_tpu._observability.tracing import TRACER, set_tracing_enabled
+names = sorted(DEFAULT_PATH_CASES.keys())
+set_tracing_enabled(True)
+t_ready = time.monotonic()
+t_first = None
+arm_s = 0.0
+with warnings.catch_warnings():
+    warnings.simplefilter("ignore")
+    for name in names:
+        ctor, _ = DEFAULT_PATH_CASES[name]
+        m = ctor()
+        args = canonical_batch(name)
+        t0 = time.monotonic()
+        m.precompile(*args)
+        arm_s += time.monotonic() - t0
+        m.update(*args)
+        jax.block_until_ready(m.compute())
+        if t_first is None:
+            t_first = time.monotonic()
+t_done = time.monotonic()
+spans = TRACER.spans(name="aot.load")
+print(json.dumps({
+    "spawn_to_first_ms": (t_first - t_spawn) * 1000.0,
+    "ready_to_sweep_ms": (t_done - t_ready) * 1000.0,
+    "arm_ms": arm_s * 1000.0,
+    "resolve_ms": sum(s.duration_s for s in spans) * 1000.0,
+    "resolutions": len(spans),
+    "classes": len(names),
+}))
+"""
+
+
+def _run_aot_cold_child(cache_dir: str):
+    """One fresh-process certified-sweep run against ``cache_dir``; dict or None."""
+    env = dict(os.environ)
+    env["TM_TPU_AOT_CACHE"] = cache_dir
+    env["TM_TPU_COLD_T0"] = repr(time.monotonic())
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", _AOT_COLD_CHILD],
+            env=env,
+            capture_output=True,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=900,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if res.returncode != 0:
+        return None
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+def _bench_aot_cold_start() -> dict:
+    """Fleet cold start, measured as a deployed replica pays it.
+
+    One un-timed child populates a warm artifact directory; then
+    ``AOT_COLD_PAIRS`` alternating-lead cold/warm pairs each spawn a FRESH
+    subprocess — cold children get a fresh empty cache directory (trace +
+    XLA-compile + persist every executable), warm children get the populated
+    one (deserialize only). The speedup line divides the summed ``aot.load``
+    executable-RESOLUTION spans per pair (``resolve_ms``) — the exact seam
+    the artifact cache serves; interpreter + jax import, ctors, canonical
+    batches, `precompile`'s eager validation passes and the eager computes
+    ride both sides identically and no executable cache can address them,
+    so folding them in would understate (and unbound-ly dilute) the
+    machinery actually under test. The full spawn -> first result,
+    ``precompile()`` arming, and ready -> sweep walls are reported
+    alongside, un-cropped.
+    """
+    import tempfile
+
+    records = {"cold": [], "warm": []}
+    with tempfile.TemporaryDirectory(prefix="tm_aot_warm_") as warm_dir:
+        # populate, then one heal pass (both un-timed): a CPU executable can
+        # serialize fine yet fail to deserialize in a FRESH process
+        # (process-local JIT symbols) — the first warm replica re-stores
+        # those artifacts in the stablehlo format, after which the cache is
+        # stable for every later process; timing that one-off heal as "warm"
+        # would misreport the steady fleet state
+        for phase in ("populate", "heal"):
+            if _run_aot_cold_child(warm_dir) is None:
+                raise RuntimeError(f"AOT cold-start child failed during the {phase} pass")
+        for pair in range(AOT_COLD_PAIRS):
+            sides = ("cold", "warm") if pair % 2 == 0 else ("warm", "cold")
+            for side in sides:
+                if side == "cold":
+                    with tempfile.TemporaryDirectory(prefix="tm_aot_cold_") as cold_dir:
+                        rec = _run_aot_cold_child(cold_dir)
+                else:
+                    rec = _run_aot_cold_child(warm_dir)
+                if rec is None:
+                    raise RuntimeError(f"AOT cold-start {side} child failed")
+                records[side].append(rec)
+
+    def p50(side: str, key: str) -> float:
+        vals = sorted(r[key] for r in records[side])
+        return vals[len(vals) // 2]
+
+    pair_ratios = sorted(
+        c["resolve_ms"] / w["resolve_ms"] for c, w in zip(records["cold"], records["warm"])
+    )
+    return {
+        "cold_spawn_first_ms": p50("cold", "spawn_to_first_ms"),
+        "warm_spawn_first_ms": p50("warm", "spawn_to_first_ms"),
+        "cold_sweep_ms": p50("cold", "ready_to_sweep_ms"),
+        "warm_sweep_ms": p50("warm", "ready_to_sweep_ms"),
+        "cold_arm_ms": p50("cold", "arm_ms"),
+        "warm_arm_ms": p50("warm", "arm_ms"),
+        "cold_resolve_ms": p50("cold", "resolve_ms"),
+        "warm_resolve_ms": p50("warm", "resolve_ms"),
+        "speedup_p50": pair_ratios[len(pair_ratios) // 2],
+        "classes": records["cold"][0]["classes"],
+    }
+
+
+def _bench_aot_retention() -> tuple:
+    """(AOT-off updates/sec, shim-baseline updates/sec, AOT-warm updates/sec).
+
+    Same workload and estimator as ``_bench_telemetry`` (ctor-default
+    MulticlassAccuracy through the auto-compiled path, paired-interleave /
+    alternating-lead / interquartile-mean-of-pair-ratios). Side A runs the
+    shipped binary with ``TM_TPU_AOT_CACHE`` unset — ``_AOT.active`` is
+    consulted only when a NEW executable is built, never per update call, so
+    the per-update hot path is instruction-identical to a build without the
+    AOT machinery; side B dispatches through the same wrapper shim the
+    telemetry/tracing retention lines use, confirming that claim end to end
+    (target >= 0.97). The third rate re-pairs with a SECOND metric whose
+    executable was precompiled through a warm disk cache — steady-state
+    serving cost with AOT active: the dispatcher's single fast-slot
+    indirection in front of the deserialized executable.
+    """
+    import tempfile
+
+    import jax
+
+    from torchmetrics_tpu import set_aot_cache
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    preds = jax.random.uniform(jax.random.PRNGKey(0), (BATCH, NUM_CLASSES))
+    target = jax.random.randint(jax.random.PRNGKey(1), (BATCH,), 0, NUM_CLASSES)
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES)  # AOT off: the shipped default
+    wrapped = metric.update
+
+    def bare_update(*args, **kwargs):
+        # the AOT-free wrapper body (same shim as the telemetry/tracing
+        # retention lines): auto dispatch + journal probe — there is no AOT
+        # branch to delete on the per-call path, which is the claim under test
+        if metric._try_auto_update(args, kwargs):
+            metric._journal_record("update", args, kwargs)
+            return None
+        return wrapped(*args, **kwargs)
+
+    def cycle(m) -> float:
+        t0 = time.perf_counter()
+        for _ in range(TEL_BENCH_UPDATES):
+            m.update(preds, target)
+        jax.block_until_ready(m.tp)
+        return time.perf_counter() - t0
+
+    for _ in range(8):  # warm the compile + signature caches
+        cycle(metric)
+    d_times, s_times = [], []
+    for rep in range(TEL_BENCH_REPS):
+        first_off = rep % 2 == 0
+        for off_side in (first_off, not first_off):
+            object.__setattr__(metric, "update", wrapped if off_side else bare_update)
+            (d_times if off_side else s_times).append(cycle(metric))
+    object.__setattr__(metric, "update", wrapped)
+    ratios = sorted(s / d for d, s in zip(d_times, s_times))
+    core = ratios[len(ratios) // 4 : -(len(ratios) // 4)]
+    off_rate = (sum(core) / len(core)) * (TEL_BENCH_UPDATES / sorted(s_times)[len(s_times) // 2])
+    shim_rate = TEL_BENCH_UPDATES / sorted(s_times)[len(s_times) // 2]
+    # steady-state with the machinery ENABLED: a warm disk cache serves the
+    # executable, updates dispatch through the AOT fast slot
+    with tempfile.TemporaryDirectory(prefix="tm_aot_ret_") as cache_dir:
+        set_aot_cache(cache_dir)
+        try:
+            warm = MulticlassAccuracy(num_classes=NUM_CLASSES)
+            warm.precompile(preds, target)
+            for _ in range(8):
+                cycle(warm)
+                cycle(metric)
+            e_times, d2_times = [], []
+            for rep in range(TEL_BENCH_REPS):
+                first_enabled = rep % 2 == 0
+                for enabled_side in (first_enabled, not first_enabled):
+                    (e_times if enabled_side else d2_times).append(
+                        cycle(warm if enabled_side else metric)
+                    )
+            e_ratios = sorted(d / e for e, d in zip(e_times, d2_times))
+            e_core = e_ratios[len(e_ratios) // 4 : -(len(e_ratios) // 4)]
+            enabled_rate = (sum(e_core) / len(e_core)) * off_rate
+        finally:
+            set_aot_cache(None)
+    return off_rate, shim_rate, enabled_rate
+
+
 _STAMP: dict = {}
 
 
@@ -2376,6 +2595,77 @@ def main() -> None:
             )
         )
 
+    def sec_aot_cold_start() -> None:
+        cold = _bench_aot_cold_start()
+        _emit((
+                {
+                    "metric": "cold_start_ms",
+                    "value": round(cold["warm_spawn_first_ms"], 1),
+                    "unit": (
+                        "ms p50 process spawn -> FIRST certified-default-path metric result in a"
+                        " fresh subprocess with a WARM AOT cache (TM_TPU_AOT_CACHE populated:"
+                        " executables deserialize, zero trace/XLA-compile); cold-cache p50 ="
+                        f" {cold['cold_spawn_first_ms']:,.0f} ms — interpreter + jax import ride"
+                        " both sides; vs_baseline is cold/warm spawn->first-result"
+                    ),
+                    "vs_baseline": round(cold["cold_spawn_first_ms"] / cold["warm_spawn_first_ms"], 2),
+                }
+            )
+        )
+        _emit((
+                {
+                    "metric": "aot_warm_vs_cold_speedup",
+                    "value": round(cold["speedup_p50"], 2),
+                    "unit": (
+                        f"x (paired p50 over {AOT_COLD_PAIRS} alternating-lead fresh-subprocess"
+                        " pairs: summed `aot.load` executable-resolution spans across the full"
+                        f" {cold['classes']}-class certified default-path sweep — the seam the"
+                        " cache serves: trace+XLA-compile+serialize+persist cold vs"
+                        f" read+verify+deserialize warm; cold p50 {cold['cold_resolve_ms']:,.0f} ms,"
+                        f" warm p50 {cold['warm_resolve_ms']:,.0f} ms; full `precompile()` walls"
+                        f" incl. eager validation passes: cold {cold['cold_arm_ms']:,.0f} ms, warm"
+                        f" {cold['warm_arm_ms']:,.0f} ms; full ready->sweep walls incl."
+                        f" ctor+eager compute: cold {cold['cold_sweep_ms']:,.0f} ms, warm"
+                        f" {cold['warm_sweep_ms']:,.0f} ms; criterion >= 5x)"
+                    ),
+                }
+            )
+        )
+
+    def sec_aot_retention() -> None:
+        aot_off, aot_shim, aot_warm = _bench_aot_retention()
+        _emit((
+                {
+                    "metric": "aot_disabled_retention",
+                    "value": round(aot_off, 1),
+                    "unit": (
+                        f"compiled default updates/sec (ctor-default MulticlassAccuracy batch={BATCH},"
+                        " TM_TPU_AOT_CACHE unset — `_AOT.active` is consulted only at executable"
+                        " BUILD time, never per update, so the hot path is instruction-identical"
+                        " to a build without the AOT machinery; baseline = the same wrapper shim"
+                        " the telemetry/tracing retention lines use, paired-interleaved"
+                        " per-pair-ratio interquartile mean — vs_baseline is the retention ratio,"
+                        " target >= 0.97)"
+                    ),
+                    "vs_baseline": round(aot_off / aot_shim, 3),
+                }
+            )
+        )
+        _emit((
+                {
+                    "metric": "aot_enabled_update_per_sec",
+                    "value": round(aot_warm, 1),
+                    "unit": (
+                        "compiled default updates/sec (same workload, AOT cache ENABLED and warm:"
+                        " updates dispatch through the AOT fast slot into the deserialized"
+                        " executable; baseline = the AOT-off rate — vs_baseline is enabled/off,"
+                        " steady-state serving cost of leaving the cache armed)"
+                    ),
+                    "vs_baseline": round(aot_warm / aot_off, 3),
+                }
+            )
+        )
+
     for name, section in (
         ("multiclass_accuracy_updates_per_sec", sec_headline_accuracy),
         ("class_api_updates_per_sec", sec_class_api),
@@ -2394,6 +2684,8 @@ def main() -> None:
         ("telemetry_disabled_retention", sec_telemetry),
         ("tracing_disabled_retention", sec_tracing),
         ("locksan_disabled_retention", sec_locksan),
+        ("cold_start_ms", sec_aot_cold_start),
+        ("aot_disabled_retention", sec_aot_retention),
     ):
         _run_section(name, section)
 
@@ -2474,6 +2766,10 @@ _README_LABELS = {
     "tracing_disabled_retention": ("Tracing (disabled) compiled default `update()`", "{v:,.0f} updates/s"),
     "flight_recorder_dump_ms": ("Flight-recorder post-mortem dump", "{v:.2f} ms"),
     "locksan_disabled_retention": ("Lock sanitizer (disabled) `StreamLabeler.note()`", "{v:,.0f} notes/s"),
+    "cold_start_ms": ("Cold start: spawn → first result (warm AOT cache)", "{v:,.0f} ms"),
+    "aot_warm_vs_cold_speedup": ("AOT warm vs cold certified-sweep speedup", "{v:.1f}x"),
+    "aot_disabled_retention": ("AOT cache (disabled) compiled default `update()`", "{v:,.0f} updates/s"),
+    "aot_enabled_update_per_sec": ("AOT cache (enabled, warm) compiled default `update()`", "{v:,.0f} updates/s"),
 }
 
 

@@ -1,0 +1,103 @@
+"""CI recompile gate: the certified default path compiles EXACTLY the keys
+pinned by the golden manifest (``_analysis/compile_golden.json``).
+
+ROADMAP item 4's teeth: the churn detector (PR 10) made recompiles
+detectable; this gate makes them preventable. Driving the canonical sweep
+(``torchmetrics_tpu/_aot/default_path.py``) must produce zero compiled-path
+cache keys beyond the manifest — a PR that perturbs argument structure,
+static values, shapes, dtypes, or the dtype policy on the out-of-the-box
+path fails here with the churn detector naming the component that moved.
+Staleness runs both ways: a golden key the sweep no longer produces fails
+too (regenerate with ``python tools/compile_golden.py --write``, same
+contract as the eligibility.json / thread_safety.json gates).
+"""
+
+import jax.numpy as jnp
+import pytest
+
+from torchmetrics_tpu._aot.default_path import (
+    DEFAULT_PATH_CASES,
+    canonical_batch,
+    collect_compile_keys,
+    drive_default_path,
+)
+from torchmetrics_tpu._aot.golden import GOLDEN_PATH, check_observed, load_golden
+
+
+@pytest.fixture(scope="module")
+def golden():
+    return load_golden()
+
+
+@pytest.fixture(scope="module")
+def observed():
+    return drive_default_path()
+
+
+def test_golden_manifest_checked_in_and_nontrivial(golden):
+    assert GOLDEN_PATH.exists()
+    assert len(golden) >= 12, "the certified sweep must span a cross-family slice"
+    for name, entries in golden.items():
+        assert entries, f"{name}: golden manifest entry with no compile keys"
+        for e in entries:
+            assert set(e["components"]) >= {"arg_structure", "static_args", "shapes", "dtypes", "dtype_policy"}
+
+
+def test_certified_default_path_zero_compiles_beyond_golden(observed, golden):
+    problems = check_observed(observed, golden)
+    assert not problems, "recompile gate failed:\n" + "\n".join(f"  - {p}" for p in problems)
+
+
+def test_every_swept_class_actually_compiled(observed):
+    for name, entries in observed.items():
+        kinds = {e["kind"] for e in entries}
+        assert "auto_update" in kinds, f"{name}: default path never reported an auto_update compile"
+
+
+def test_gate_names_broken_dtype_policy_component(golden):
+    """Deliberately breaking a cache-key component in a fixture sweep must
+    fail the gate with the churn detector NAMING the component."""
+    from torchmetrics_tpu._observability.state import OBS
+
+    ctor, _ = DEFAULT_PATH_CASES["MeanSquaredError"]
+    args = canonical_batch("MeanSquaredError")
+    was = OBS.enabled
+    OBS.enabled = True
+    try:
+        metric = ctor()
+        metric.set_dtype(jnp.float16)  # the fixture's deliberate breakage
+        for _ in range(3):
+            metric.update(*args)
+        broken = {"MeanSquaredError": collect_compile_keys(metric)}
+    finally:
+        OBS.enabled = was
+    problems = check_observed(broken, {"MeanSquaredError": golden["MeanSquaredError"]})
+    assert problems, "the gate must fail on a perturbed cache-key component"
+    text = "\n".join(problems)
+    assert "dtype_policy" in text, text
+    assert "NEW `auto_update` compile beyond the golden manifest" in text
+
+
+def test_gate_names_broken_shape_component(golden):
+    from torchmetrics_tpu._observability.state import OBS
+
+    ctor, _ = DEFAULT_PATH_CASES["BinaryAccuracy"]
+    preds, target = canonical_batch("BinaryAccuracy")
+    was = OBS.enabled
+    OBS.enabled = True
+    try:
+        metric = ctor()
+        for _ in range(3):
+            metric.update(preds[:17], target[:17])  # off-manifest batch shape
+        broken = {"BinaryAccuracy": collect_compile_keys(metric)}
+    finally:
+        OBS.enabled = was
+    problems = check_observed(broken, {"BinaryAccuracy": golden["BinaryAccuracy"]})
+    text = "\n".join(problems)
+    assert "shapes" in text, text
+
+
+def test_stale_manifest_direction_reported(golden):
+    observed = {"MeanSquaredError": []}  # sweep "lost" its compile keys
+    problems = check_observed(observed, {"MeanSquaredError": golden["MeanSquaredError"]})
+    assert any("stale manifest" in p for p in problems)

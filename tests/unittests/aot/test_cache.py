@@ -1,0 +1,370 @@
+"""AOT executable cache: persistence, verification, and fallback contracts.
+
+ISSUE-15 acceptance surface: cross-process artifact reuse, corrupt/
+truncated-artifact and backend-fingerprint-mismatch fallback-to-trace
+(never wrong results), cache-dir-unwritable degradation (event emitted,
+never raised), concurrent ``warm_start()`` under ``TM_TPU_LOCKSAN``, and
+``Metric.precompile`` leaving the stream's state untouched while arming
+the compiled path.
+"""
+
+import glob
+import json
+import os
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu import set_aot_cache
+from torchmetrics_tpu._aot import artifacts as aot_artifacts
+from torchmetrics_tpu._aot.cache import AotCache, aot_stats, reset_aot_stats
+from torchmetrics_tpu._observability.events import BUS
+from torchmetrics_tpu._observability.state import OBS
+
+REPO_ROOT = Path(__file__).resolve().parents[3]
+RNG = np.random.default_rng(7)
+N = 32
+
+
+def _bin_batch():
+    return (jnp.asarray(RNG.random(N).astype(np.float32)), jnp.asarray(RNG.integers(0, 2, N)))
+
+
+def _reg_batch():
+    return (
+        jnp.asarray(RNG.standard_normal(N).astype(np.float32)),
+        jnp.asarray(RNG.standard_normal(N).astype(np.float32)),
+    )
+
+
+@pytest.fixture()
+def cache_dir(tmp_path):
+    d = tmp_path / "aot"
+    set_aot_cache(str(d))
+    reset_aot_stats()
+    yield d
+    set_aot_cache(None)
+
+
+@pytest.fixture()
+def telemetry_on():
+    was = OBS.enabled
+    OBS.enabled = True
+    yield
+    OBS.enabled = was
+
+
+def _delta(before, after):
+    return {k: after.get(k, 0) - before.get(k, 0) for k in set(before) | set(after)}
+
+
+class TestPrecompile:
+    def test_precompile_arms_compiled_path_and_preserves_state(self, cache_dir):
+        preds, target = _bin_batch()
+        metric = tm.BinaryAccuracy()
+        report = metric.precompile(preds, target)
+        assert report["engaged"], report
+        # the warm-up batch left no trace on the stream
+        assert metric._update_count == 0
+        assert all(int(v) == 0 for v in metric.metric_state.values())
+        # the FIRST real update dispatches compiled (signature pre-registered)
+        metric.update(preds, target)
+        assert metric._update_count == 1
+        eager = tm.BinaryAccuracy(auto_compile=False)
+        eager.update(preds, target)
+        np.testing.assert_allclose(float(metric.compute()), float(eager.compute()), rtol=1e-6)
+
+    def test_precompile_writes_then_loads_artifact(self, cache_dir):
+        preds, target = _reg_batch()
+        m1 = tm.MeanSquaredError()
+        assert m1.precompile(preds, target)["engaged"]
+        arts = glob.glob(str(cache_dir / "auto_update.*.aot"))
+        assert len(arts) == 1
+        before = aot_stats()
+        m2 = tm.MeanSquaredError()
+        assert m2.precompile(preds, target)["engaged"]
+        assert _delta(before, aot_stats())["hits"] == 1
+        m2.update(preds, target)
+        eager = tm.MeanSquaredError(auto_compile=False)
+        eager.update(preds, target)
+        np.testing.assert_allclose(float(m2.compute()), float(eager.compute()), rtol=1e-6)
+
+    def test_precompile_reports_eager_pinned_classes(self, cache_dir):
+        metric = tm.BinaryAccuracy(auto_compile=False)
+        report = metric.precompile(*_bin_batch())
+        assert not report["engaged"]
+        assert report["reason"]
+
+    def test_collection_precompile_fans_out(self, cache_dir):
+        preds, target = _bin_batch()
+        coll = tm.MetricCollection([tm.BinaryAccuracy(), tm.BinaryPrecision()])
+        reports = coll.precompile(preds, target)
+        assert set(reports) == {"BinaryAccuracy", "BinaryPrecision"}
+        assert all(r["engaged"] for r in reports.values())
+        for m in coll.values(copy_state=False):
+            assert m._update_count == 0
+
+
+class TestCrossProcess:
+    def test_artifact_written_in_child_loads_in_parent(self, cache_dir):
+        """A fresh subprocess populates the cache; THIS process then loads the
+        executable without tracing (hit counted, value correct)."""
+        child = (
+            "import numpy as np, jax.numpy as jnp\n"
+            "import torchmetrics_tpu as tm\n"
+            "rng = np.random.default_rng(7)\n"
+            f"preds = jnp.asarray(rng.random({N}).astype(np.float32))\n"
+            f"target = jnp.asarray(rng.integers(0, 2, {N}))\n"
+            "m = tm.BinaryF1Score()\n"
+            "assert m.precompile(preds, target)['engaged']\n"
+            "print('CHILD_OK')\n"
+        )
+        env = dict(os.environ, TM_TPU_AOT_CACHE=str(cache_dir), JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "-c", child], env=env, cwd=str(REPO_ROOT),
+            capture_output=True, text=True, timeout=240,
+        )
+        assert "CHILD_OK" in out.stdout, out.stderr[-2000:]
+        assert glob.glob(str(cache_dir / "auto_update.*.aot"))
+        before = aot_stats()
+        preds, target = _bin_batch()
+        metric = tm.BinaryF1Score()
+        assert metric.precompile(preds, target)["engaged"]
+        assert _delta(before, aot_stats())["hits"] == 1
+        metric.update(preds, target)
+        eager = tm.BinaryF1Score(auto_compile=False)
+        eager.update(preds, target)
+        np.testing.assert_allclose(float(metric.compute()), float(eager.compute()), rtol=1e-6)
+
+
+class TestFallbacks:
+    def _arm(self, cache_dir):
+        preds, target = _reg_batch()
+        m = tm.MeanAbsoluteError()
+        assert m.precompile(preds, target)["engaged"]
+        (art,) = glob.glob(str(cache_dir / "auto_update.*.aot"))
+        return Path(art), (preds, target)
+
+    def test_truncated_artifact_falls_back_to_trace(self, cache_dir, telemetry_on):
+        art, (preds, target) = self._arm(cache_dir)
+        raw = art.read_bytes()
+        art.write_bytes(raw[: len(raw) // 2])
+        before = aot_stats()
+        m2 = tm.MeanAbsoluteError()
+        assert m2.precompile(preds, target)["engaged"]
+        delta = _delta(before, aot_stats())
+        assert delta["fallbacks"] == 1
+        assert delta["writes"] == 1  # re-traced AND re-persisted a good artifact
+        assert BUS.events(kind="aot_fallback")
+        m2.update(preds, target)
+        eager = tm.MeanAbsoluteError(auto_compile=False)
+        eager.update(preds, target)
+        np.testing.assert_allclose(float(m2.compute()), float(eager.compute()), rtol=1e-6)
+
+    def test_bitflipped_payload_falls_back(self, cache_dir):
+        art, (preds, target) = self._arm(cache_dir)
+        raw = bytearray(art.read_bytes())
+        raw[-10] ^= 0xFF
+        art.write_bytes(bytes(raw))
+        before = aot_stats()
+        m2 = tm.MeanAbsoluteError()
+        assert m2.precompile(preds, target)["engaged"]
+        assert _delta(before, aot_stats())["fallbacks"] == 1
+
+    def test_undeserializable_payload_self_heals_to_stablehlo(self, cache_dir):
+        """A payload that only fails to deserialize in a fresh process (CPU
+        executables referencing process-local JIT symbols) must not wedge the
+        cache: the loader falls back, rebuilds with the failing format
+        EXCLUDED, and re-stores an artifact that actually loads next time."""
+        art, (preds, target) = self._arm(cache_dir)
+        raw = art.read_bytes()
+        from torchmetrics_tpu._aot.cache import _HEADER_LEN, _MAGIC
+
+        (hlen,) = _HEADER_LEN.unpack(raw[len(_MAGIC) : len(_MAGIC) + _HEADER_LEN.size])
+        header = json.loads(raw[len(_MAGIC) + _HEADER_LEN.size :][:hlen].decode("utf-8"))
+        if header["format"] != aot_artifacts.FORMAT_XLA_EXEC:
+            pytest.skip("backend stored stablehlo already — nothing to heal")
+        # swap the payload for undeserializable bytes with a VALID checksum:
+        # the loader must reach the deserialize step and fail there
+        import hashlib
+        import pickle
+        import struct
+
+        bad_payload = pickle.dumps(("not", "an", "executable"))
+        header["payload_sha256"] = hashlib.sha256(bad_payload).hexdigest()
+        header["payload_bytes"] = len(bad_payload)
+        blob = json.dumps(header, sort_keys=True).encode("utf-8")
+        art.write_bytes(_MAGIC + struct.pack("<Q", len(blob)) + blob + bad_payload)
+        before = aot_stats()
+        m2 = tm.MeanAbsoluteError()
+        assert m2.precompile(preds, target)["engaged"]
+        delta = _delta(before, aot_stats())
+        assert delta["fallbacks"] == 1 and delta["writes"] == 1
+        # the healed artifact carries the fallback format and loads cleanly
+        from torchmetrics_tpu._aot.cache import AotCache
+
+        (entry,) = AotCache(str(cache_dir)).entries()
+        assert entry["status"] == "ok"
+        assert entry["format"] == aot_artifacts.FORMAT_STABLEHLO
+        before = aot_stats()
+        m3 = tm.MeanAbsoluteError()
+        assert m3.precompile(preds, target)["engaged"]
+        assert _delta(before, aot_stats())["hits"] == 1
+        m3.update(preds, target)
+        eager = tm.MeanAbsoluteError(auto_compile=False)
+        eager.update(preds, target)
+        np.testing.assert_allclose(float(m3.compute()), float(eager.compute()), rtol=1e-6)
+
+    def test_jax_version_mismatch_falls_back(self, cache_dir, monkeypatch):
+        art, (preds, target) = self._arm(cache_dir)
+        # a replica running a different jax must refuse the artifact
+        fp = dict(aot_artifacts.backend_fingerprint())
+        fp["jax"] = "0.0.0-other"
+        monkeypatch.setattr(aot_artifacts, "_FINGERPRINT", fp)
+        before = aot_stats()
+        m2 = tm.MeanAbsoluteError()
+        assert m2.precompile(preds, target)["engaged"]
+        delta = _delta(before, aot_stats())
+        assert delta["fallbacks"] >= 1
+        assert delta["hits"] == 0
+
+    def test_unwritable_cache_dir_degrades_with_event(self, tmp_path, telemetry_on):
+        """The cache dir path is a FILE: every write fails, an
+        ``aot_cache_unwritable`` event is emitted, nothing raises, and the
+        metric stream is value-correct throughout."""
+        blocker = tmp_path / "not_a_dir"
+        blocker.write_text("occupied")
+        set_aot_cache(str(blocker))
+        try:
+            preds, target = _reg_batch()
+            metric = tm.MeanSquaredError()
+            assert metric.precompile(preds, target)["engaged"]
+            metric.update(preds, target)
+            eager = tm.MeanSquaredError(auto_compile=False)
+            eager.update(preds, target)
+            np.testing.assert_allclose(float(metric.compute()), float(eager.compute()), rtol=1e-6)
+            events = BUS.events(kind="aot_cache_unwritable")
+            assert events and "artifact write failed" in events[-1].detail
+        finally:
+            set_aot_cache(None)
+
+
+class TestWarmStart:
+    def test_pool_warm_start_cold_then_hit(self, cache_dir):
+        preds, target = _reg_batch()
+        pool = tm.MeanSquaredError().to_stream_pool(capacity=4)
+        ids = [pool.attach() for _ in range(3)]
+        out = pool.warm_start(ids, preds[:3], target[:3])
+        assert out["stream_step"] == "compiled"
+        pool.update(ids, preds[:3], target[:3])
+        values = pool.compute_all()
+        # fresh pool in the same process: artifacts load instead of tracing
+        pool2 = tm.MeanSquaredError().to_stream_pool(capacity=4)
+        ids2 = [pool2.attach() for _ in range(3)]
+        out2 = pool2.warm_start(ids2, preds[:3], target[:3])
+        assert out2 == {
+            "stream_step": "hit", "stream_compute_one": "hit", "stream_compute_all": "hit",
+        }
+        pool2.update(ids2, preds[:3], target[:3])
+        for sid, val in pool2.compute_all().items():
+            np.testing.assert_allclose(float(val), float(values[sid]), rtol=1e-6)
+
+    def test_engine_warm_start_cold_then_hit(self, cache_dir):
+        preds, target = _reg_batch()
+        eng = tm.MeanSquaredError().to_spmd()
+        out = eng.warm_start(preds, target)
+        assert out == {"spmd_step": "compiled", "spmd_compute": "compiled"}
+        v1 = float(eng.step(preds, target))
+        assert eng.steps == 1  # warm_start consumed no batch
+        eng2 = tm.MeanSquaredError().to_spmd()
+        out2 = eng2.warm_start(preds, target)
+        assert out2 == {"spmd_step": "hit", "spmd_compute": "hit"}
+        np.testing.assert_allclose(float(eng2.step(preds, target)), v1, rtol=1e-6)
+
+    def test_warm_start_without_cache_dir_precompiles_in_memory(self):
+        set_aot_cache(None)
+        preds, target = _reg_batch()
+        pool = tm.MeanSquaredError().to_stream_pool(capacity=2)
+        ids = [pool.attach() for _ in range(2)]
+        out = pool.warm_start(ids, preds[:2], target[:2])
+        assert out["stream_step"] == "compiled"
+        # second warm of the same signature is a no-op on the resolved entry
+        assert pool.warm_start(ids, preds[:2], target[:2])["stream_step"] == "hit"
+        pool.update(ids, preds[:2], target[:2])
+        assert set(pool.compute_all()) == set(ids)
+
+    def test_concurrent_warm_start_under_locksan(self, cache_dir):
+        """Two threads warming the same pool signature race benignly: the
+        sanitizer (reentrancy/order/guard-map checks armed) sees no
+        discipline violation and both threads end with a ready executable."""
+        from torchmetrics_tpu._analysis import locksan
+        from torchmetrics_tpu._analysis.locksan import set_locksan_enabled
+
+        set_locksan_enabled(True)
+        try:
+            preds, target = _reg_batch()
+            pool = tm.MeanSquaredError().to_stream_pool(capacity=4)
+            ids = [pool.attach() for _ in range(4)]
+            pool.warm_start(ids[:2], preds[:2], target[:2])  # units prepared serially
+            outcomes, errors = [], []
+
+            def warm(rows):
+                try:
+                    outcomes.append(pool.warm_start(ids[: rows], preds[:rows], target[:rows]))
+                except BaseException as err:  # noqa: BLE001
+                    errors.append(err)
+
+            threads = [threading.Thread(target=warm, args=(r,)) for r in (3, 3, 4, 4)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            assert not errors, errors
+            assert len(outcomes) == 4
+            assert all(o["stream_step"] in ("hit", "compiled", "ready") for o in outcomes)
+            pool.update(ids, preds[:4], target[:4])
+            assert set(pool.compute_all()) == set(ids)
+        finally:
+            set_locksan_enabled(False)
+            locksan.reset()
+
+
+class TestCliSurface:
+    def test_entries_verify_and_evict(self, cache_dir):
+        preds, target = _reg_batch()
+        assert tm.MeanSquaredError().precompile(preds, target)["engaged"]
+        cache = AotCache(str(cache_dir))
+        entries = cache.entries()
+        assert len(entries) == 1 and entries[0]["status"] == "ok" and not entries[0]["stale"]
+        assert entries[0]["kind"] == "auto_update"
+        # corrupt it: verify flags it, stale-eviction removes it
+        p = Path(entries[0]["path"])
+        p.write_bytes(p.read_bytes()[:40])
+        assert cache.entries()[0]["status"] != "ok"
+        removed = cache.evict(stale_only=True)
+        assert removed == [str(p)]
+        assert cache.entries() == []
+
+    def test_cli_list_and_verify_json(self, cache_dir):
+        preds, target = _reg_batch()
+        assert tm.MeanSquaredError().precompile(preds, target)["engaged"]
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "tools/aot_cache.py", "list", "--dir", str(cache_dir), "--json"],
+            env=env, cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=240,
+        )
+        assert out.returncode == 0, out.stderr[-2000:]
+        blob = json.loads(out.stdout)
+        assert len(blob["artifacts"]) == 1
+        out = subprocess.run(
+            [sys.executable, "tools/aot_cache.py", "verify", "--dir", str(cache_dir)],
+            env=env, cwd=str(REPO_ROOT), capture_output=True, text=True, timeout=240,
+        )
+        assert out.returncode == 0, out.stdout + out.stderr[-2000:]

@@ -134,6 +134,68 @@ class TestBERTScore:
         with pytest.raises(ValueError, match="same"):
             bert_score(["a", "b"], ["a"])
 
+    def test_trimmed_fast_path_matches_untrimmed_reference_bytes(self):
+        """The dedup/length-trim fast path must reproduce the plain
+        full-length computation byte for byte (`_hash_embedding` +
+        `_greedy_cosine_matching` are kept as the reference oracle)."""
+        import jax.numpy as jnp
+
+        from torchmetrics_tpu.functional.text.bert import (
+            _greedy_cosine_matching,
+            _hash_embedding,
+            _HashTokenizer,
+        )
+
+        rng = np.random.default_rng(11)
+        vocab = [f"w{i}" for i in range(40)]
+        preds = [" ".join(rng.choice(vocab, int(n))) for n in rng.integers(1, 20, 24)]
+        target = [" ".join(rng.choice(vocab, int(n))) for n in rng.integers(1, 20, 24)]
+        preds[3] = target[5] = ""  # empty-sentence edges ride the same path
+        tok = _HashTokenizer(128)
+        pe = {k: np.asarray(v) for k, v in tok(preds, 128).items()}
+        te = {k: np.asarray(v) for k, v in tok(target, 128).items()}
+        ref = _greedy_cosine_matching(
+            _hash_embedding(jnp.asarray(pe["input_ids"]), jnp.asarray(pe["attention_mask"])),
+            jnp.asarray(pe["attention_mask"]),
+            _hash_embedding(jnp.asarray(te["input_ids"]), jnp.asarray(te["attention_mask"])),
+            jnp.asarray(te["attention_mask"]),
+            jnp.asarray(pe["attention_mask"].astype(np.float32)),
+            jnp.asarray(te["attention_mask"].astype(np.float32)),
+        )
+        fast = bert_score(preds, target)
+        for key, want in zip(("precision", "recall", "f1"), ref):
+            assert np.array_equal(np.asarray(fast[key]), np.asarray(want), equal_nan=True), key
+
+    def test_left_padded_dict_encoding_not_truncated(self):
+        """A user-supplied pre-tokenized encoding may be left-padded: the
+        trim must key on the last REAL column, not the per-row token count."""
+        L = 64
+        ids = np.zeros((2, L), dtype=np.int64)
+        mask = np.zeros((2, L), dtype=np.int64)
+        ids[:, L - 4 :] = [[11, 12, 13, 14], [11, 12, 13, 14]]
+        mask[:, L - 4 :] = 1
+        res = bert_score(
+            {"input_ids": ids, "attention_mask": mask},
+            {"input_ids": ids.copy(), "attention_mask": mask.copy()},
+        )
+        assert np.allclose(np.asarray(res["f1"]), 1.0, atol=1e-5)
+
+    def test_empty_batch_returns_empty_scores(self):
+        res = bert_score([], [])
+        for key in ("precision", "recall", "f1"):
+            assert np.asarray(res[key]).shape == (0,), key
+
+    def test_dict_encoding_narrower_than_trim_floor(self):
+        """A pre-tokenized batch narrower than the /8 trim floor must score
+        at its own width, not crash in the dedup gather reshape."""
+        ids = np.asarray([[7, 9, 0, 0], [7, 9, 11, 0]], dtype=np.int64)
+        mask = np.asarray([[1, 1, 0, 0], [1, 1, 1, 0]], dtype=np.int64)
+        res = bert_score(
+            {"input_ids": ids, "attention_mask": mask},
+            {"input_ids": ids.copy(), "attention_mask": mask.copy()},
+        )
+        assert np.allclose(np.asarray(res["f1"]), 1.0, atol=1e-5)
+
 
 class TestInfoLM:
     def test_identical_corpus_zero_distance(self):

@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""AOT executable-cache CLI: inspect and manage serialized compiled artifacts.
+
+Usage:
+    python tools/aot_cache.py list   [--dir DIR] [--json]
+    python tools/aot_cache.py verify [--dir DIR] [--json]
+    python tools/aot_cache.py evict  [--dir DIR] [--stale] [--kind KIND] [--yes]
+
+``--dir`` defaults to ``$TM_TPU_AOT_CACHE``. ``list`` prints every artifact
+with its kind, owning executable, format, size, and whether its backend
+fingerprint matches THIS machine's runtime (``stale``). ``verify`` re-checks
+magic/header/payload-checksum integrity and exits 1 when any artifact is
+corrupt or stale (CI-friendly). ``evict`` deletes artifacts — all of them,
+one ``--kind``, or ``--stale`` only (fingerprint-mismatched + corrupt);
+``--yes`` skips the confirmation prompt.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from datetime import datetime, timezone
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def _cache(directory: str):
+    from torchmetrics_tpu._aot.cache import AotCache
+
+    return AotCache(directory)
+
+
+def _fmt_created(ts) -> str:
+    try:
+        return datetime.fromtimestamp(float(ts), tz=timezone.utc).strftime("%Y-%m-%d %H:%M:%S")
+    except (TypeError, ValueError):
+        return "?"
+
+
+def cmd_list(directory: str, as_json: bool) -> int:
+    entries = _cache(directory).entries()
+    if as_json:
+        print(json.dumps({"directory": directory, "artifacts": entries}, indent=1, default=str))
+        return 0
+    if not entries:
+        print(f"{directory}: no artifacts")
+        return 0
+    print(f"{directory}: {len(entries)} artifact(s)")
+    header = f"{'kind':<20} {'format':<10} {'bytes':>9} {'created (UTC)':<20} {'status':<10} owner"
+    print(header)
+    print("-" * len(header))
+    for e in entries:
+        status = e["status"] if e["status"] != "ok" else ("stale" if e.get("stale") else "ok")
+        print(
+            f"{e.get('kind', '?'):<20} {str(e.get('format', '?')):<10} {e['file_bytes']:>9}"
+            f" {_fmt_created(e.get('created')):<20} {status:<10} {e.get('owner', '?')}"
+        )
+    return 0
+
+
+def cmd_verify(directory: str, as_json: bool) -> int:
+    entries = _cache(directory).entries()
+    bad = [e for e in entries if e["status"] != "ok" or e.get("stale")]
+    if as_json:
+        print(
+            json.dumps(
+                {
+                    "directory": directory,
+                    "artifacts": len(entries),
+                    "ok": len(entries) - len(bad),
+                    "problems": bad,
+                },
+                indent=1,
+                default=str,
+            )
+        )
+    else:
+        for e in bad:
+            why = e["status"] if e["status"] != "ok" else "backend fingerprint mismatch (stale)"
+            print(f"BAD {e['path']}: {why}")
+        print(f"{len(entries) - len(bad)}/{len(entries)} artifacts verified ok")
+    return 1 if bad else 0
+
+
+def cmd_evict(directory: str, stale: bool, kind, assume_yes: bool) -> int:
+    cache = _cache(directory)
+    targets = [
+        e for e in cache.entries()
+        if (kind is None or e.get("kind") == kind)
+        and (not stale or e["status"] != "ok" or e.get("stale"))
+    ]
+    if not targets:
+        print("nothing to evict")
+        return 0
+    if not assume_yes:
+        print(f"will delete {len(targets)} artifact(s) from {directory}:")
+        for e in targets:
+            print(f"  {e['path']}")
+        answer = input("proceed? [y/N] ").strip().lower()
+        if answer not in ("y", "yes"):
+            print("aborted")
+            return 1
+    removed = cache.evict(stale_only=stale, kind=kind, entries=targets)
+    print(f"evicted {len(removed)} artifact(s)")
+    return 0
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    sub = parser.add_subparsers(dest="command", required=True)
+    for name in ("list", "verify", "evict"):
+        p = sub.add_parser(name)
+        p.add_argument("--dir", default=os.environ.get("TM_TPU_AOT_CACHE", ""), help="cache directory")
+        if name in ("list", "verify"):
+            p.add_argument("--json", action="store_true")
+        else:
+            p.add_argument("--stale", action="store_true", help="only fingerprint-stale/corrupt artifacts")
+            p.add_argument("--kind", default=None, help="only artifacts of this executable kind")
+            p.add_argument("--yes", action="store_true", help="skip the confirmation prompt")
+    args = parser.parse_args(argv)
+    if not args.dir:
+        print("no cache directory: pass --dir or set TM_TPU_AOT_CACHE", file=sys.stderr)
+        return 2
+    if args.command == "list":
+        return cmd_list(args.dir, args.json)
+    if args.command == "verify":
+        return cmd_verify(args.dir, args.json)
+    return cmd_evict(args.dir, args.stale, args.kind, args.yes)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,57 @@
+#!/usr/bin/env python
+"""Golden compile-count manifest CLI for the certified default path.
+
+Usage:
+    python tools/compile_golden.py --check       # CI gate (default)
+    python tools/compile_golden.py --write       # regenerate the manifest
+
+The manifest (``torchmetrics_tpu/_analysis/compile_golden.json``) pins every
+compiled-executable cache key the certified default-path sweep
+(``torchmetrics_tpu/_aot/default_path.py``) may produce. ``--check`` drives
+the sweep with the recompile-churn detector recording and fails (exit 1)
+when any compile beyond the manifest appears — naming the differing
+cache-key component(s) — or when the manifest has gone stale. The tier-1
+gate ``tests/unittests/analysis/test_recompile_gate.py`` runs the same
+comparison on every CI pass.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    parser.add_argument("--write", action="store_true", help="regenerate the golden manifest")
+    parser.add_argument("--check", action="store_true", help="gate the current sweep against the manifest")
+    args = parser.parse_args(argv)
+
+    from torchmetrics_tpu._aot.golden import GOLDEN_PATH, check_observed, load_golden, write_golden
+
+    if args.write:
+        blob = write_golden()
+        n_keys = sum(len(v) for v in blob["classes"].values())
+        print(f"wrote {GOLDEN_PATH}: {len(blob['classes'])} classes, {n_keys} compile keys")
+        return 0
+
+    from torchmetrics_tpu._aot.default_path import drive_default_path
+
+    problems = check_observed(drive_default_path(), load_golden())
+    if problems:
+        print(f"RECOMPILE GATE FAILED ({len(problems)} problem(s)):")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    golden = load_golden()
+    n_keys = sum(len(v) for v in golden.values())
+    print(f"certified default path clean: {len(golden)} classes, {n_keys} compile keys, zero beyond golden")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -8,6 +8,7 @@ mesh, heavy kernels are jit-compiled XLA/Pallas.
 import logging as __logging
 
 from torchmetrics_tpu.__about__ import __version__
+from torchmetrics_tpu._aot import get_aot_cache, set_aot_cache
 from torchmetrics_tpu.metric import CompositionalMetric, Metric
 
 _logger = __logging.getLogger("torchmetrics_tpu")
@@ -75,6 +76,8 @@ __all__ = [
     "utilities",
     "wrappers",
     "__version__",
+    "get_aot_cache",
+    "set_aot_cache",
     *_aggregation_all,
     *_audio_all,
     *_classification_all,

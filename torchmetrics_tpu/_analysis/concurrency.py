@@ -64,6 +64,7 @@ THREAD_SAFETY_VERSION = 1
 # rules themselves run on every scanned module — they are inert where no
 # threads/locks/shared markers exist
 _RUNTIME_PREFIXES = (
+    "torchmetrics_tpu/_aot/",
     "torchmetrics_tpu/_observability/",
     "torchmetrics_tpu/_resilience/",
     "torchmetrics_tpu/_streams/",

@@ -1,0 +1,40 @@
+"""Ahead-of-time executable serialization + warm persistent compile cache.
+
+Kills fleet cold-start: with ``TM_TPU_AOT_CACHE`` (or
+:func:`set_aot_cache`) pointing at a directory, every hot-path executable
+the runtime builds — the certified default update path, ``jit_update``/
+``scan_update``, the SPMD engine's donated fused step, StreamPool's vmapped
+stream step — is serialized after its first compile and loaded (no trace,
+no XLA compile) by every later process. See ``cache.py`` for the artifact
+format and the fallback ladder; ``default_path.py`` for the certified
+default-path sweep the golden recompile manifest locks down.
+
+This ``__init__`` stays import-light: ``metric.py`` pulls the switch from
+``state`` at module scope, everything heavier loads lazily on first use.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from torchmetrics_tpu._aot.state import AOT, get_aot_cache, set_aot_cache
+
+__all__ = [
+    "AOT",
+    "set_aot_cache",
+    "get_aot_cache",
+    "aot_stats",
+    "reset_aot_stats",
+    "get_cache",
+    "wrap_executable",
+]
+
+_LAZY = {"aot_stats", "reset_aot_stats", "get_cache", "wrap_executable"}
+
+
+def __getattr__(name: str) -> Any:
+    if name in _LAZY:
+        from torchmetrics_tpu._aot import cache as _cache
+
+        return getattr(_cache, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,144 @@
+"""AOT artifact (de)serialization: compiled XLA executables on disk.
+
+Two artifact formats, negotiated at build time and recorded in the header:
+
+- ``xla_exec`` (primary): ``jax.experimental.serialize_executable`` round-trip
+  of the *compiled* executable. Loading skips BOTH Python tracing and XLA
+  compilation — a deployed replica pays only deserialization. The payload is
+  backend- and version-specific, which is exactly why every artifact is keyed
+  by :func:`backend_fingerprint` and verified before loading.
+- ``stablehlo`` (fallback): ``jax.export`` StableHLO serialization for
+  backends where the executable round-trip is unsupported. Loading skips
+  Python tracing of the original update body but re-runs XLA compilation on
+  first call (a partial cold-start win, recorded distinctly in telemetry).
+
+Any failure at any stage is reported to the caller as ``None`` — the cache
+layer falls back to ordinary tracing, never to wrong results.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+import jax.export as _jax_export
+
+try:  # the executable round-trip is experimental; absence selects stablehlo
+    from jax.experimental import serialize_executable as _se
+except Exception:  # pragma: no cover - depends on the installed jax build
+    _se = None
+
+__all__ = [
+    "backend_fingerprint",
+    "build_artifact",
+    "load_artifact",
+    "FORMAT_XLA_EXEC",
+    "FORMAT_STABLEHLO",
+]
+
+FORMAT_XLA_EXEC = "xla_exec"
+FORMAT_STABLEHLO = "stablehlo"
+
+_FINGERPRINT: Optional[Dict[str, str]] = None
+
+
+def backend_fingerprint() -> Dict[str, str]:
+    """Stable identity of the runtime a serialized executable is valid for.
+
+    A compiled XLA executable is specific to the jax/jaxlib pair, the backend
+    platform, the device kind, and the addressable device count (SPMD steps
+    bake the mesh in). Any component differing between writer and loader
+    makes the artifact unloadable-by-policy: the cache treats it as a miss.
+    """
+    global _FINGERPRINT
+    if _FINGERPRINT is None:
+        import jaxlib
+
+        devices = jax.devices()
+        try:
+            # explicit import: `jax.extend` is lazy — reading it off the
+            # `jax` module only works if something else imported it first,
+            # which made the fingerprint depend on process import order
+            # (writer said 'cpu', a fresh CLI process said '?', and every
+            # artifact went permanently stale)
+            from jax.extend import backend as _jex_backend
+
+            platform_version = _jex_backend.get_backend().platform_version
+        except Exception:  # pragma: no cover - very old backends
+            platform_version = "?"
+        _FINGERPRINT = {
+            "jax": jax.__version__,
+            "jaxlib": getattr(jaxlib, "__version__", "?"),
+            "platform": devices[0].platform,
+            "device_kind": devices[0].device_kind,
+            "device_count": str(len(devices)),
+            "platform_version": str(platform_version),
+        }
+    return dict(_FINGERPRINT)
+
+
+def build_artifact(
+    jit_fn: Callable, args: tuple, avoid_format: Optional[str] = None, want_payload: bool = True
+) -> Tuple[Optional[Callable], Optional[str], Optional[bytes]]:
+    """Lower+compile ``jit_fn`` for ``args`` and serialize the result.
+
+    Returns ``(compiled_callable, fmt, payload)``. The compiled callable is
+    always usable in-process when lowering succeeded; ``fmt``/``payload`` are
+    ``None`` when neither serialization format worked (the executable still
+    serves this process, it just cannot be cached). Lowering itself failing
+    returns ``(None, None, None)`` — the caller falls back to the plain
+    jitted path.
+
+    ``avoid_format`` is the cache's self-healing hook: some CPU executables
+    serialize fine but reference process-local JIT symbols, so deserialization
+    only fails in a FRESH process — undetectable at build time. When a loaded
+    artifact's payload failed to deserialize, the caller rebuilds with that
+    format excluded so the re-stored artifact actually loads next time.
+    """
+    try:
+        compiled = jit_fn.lower(*args).compile()
+    except Exception:
+        return None, None, None
+    if not want_payload:
+        # memory-only warm (no cache directory): the serialized payload
+        # would be built and immediately discarded — skip the pickle/export
+        return compiled, None, None
+    if _se is not None and avoid_format != FORMAT_XLA_EXEC:
+        try:
+            payload = pickle.dumps(_se.serialize(compiled), protocol=pickle.HIGHEST_PROTOCOL)
+            return compiled, FORMAT_XLA_EXEC, payload
+        except Exception:
+            pass  # backend without executable round-trip: try StableHLO
+    try:
+        exported = _jax_export.export(jit_fn)(*args)
+        return compiled, FORMAT_STABLEHLO, bytes(exported.serialize())
+    except Exception:
+        return compiled, None, None
+
+
+def load_artifact(fmt: str, payload: bytes) -> Optional[Callable]:
+    """Rehydrate a serialized executable; ``None`` on any failure.
+
+    ``xla_exec`` payloads load straight into a ready executable.
+    ``stablehlo`` payloads come back as a jitted call into the deserialized
+    StableHLO module — tracing is skipped, XLA compilation happens lazily on
+    the first invocation.
+    """
+    try:
+        if fmt == FORMAT_XLA_EXEC:
+            if _se is None:
+                return None
+            serialized, in_tree, out_tree = pickle.loads(payload)
+            return _se.deserialize_and_load(serialized, in_tree, out_tree)
+        if fmt == FORMAT_STABLEHLO:
+            exported = _jax_export.deserialize(bytearray(payload))
+            return jax.jit(exported.call)
+    except Exception:
+        return None
+    return None
+
+
+def executable_roundtrip_supported() -> bool:
+    """True when the primary (trace+compile-free) format is available."""
+    return _se is not None

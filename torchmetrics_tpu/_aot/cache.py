@@ -1,0 +1,591 @@
+"""Warm persistent on-disk cache for compiled metric executables.
+
+Every hot-path executable the runtime builds — ``Metric``'s auto
+update/forward, ``jit_update``/``scan_update``, the SPMD engine's donated
+fused step, StreamPool's vmapped stream step — goes through one seam: a
+fresh ``jax.jit`` callable is produced and cached under a structural key.
+With an AOT cache directory set (``TM_TPU_AOT_CACHE`` /
+:func:`~torchmetrics_tpu._aot.state.set_aot_cache`), that seam wraps the
+callable in an :class:`_AotDispatch`: per concrete argument-signature the
+dispatcher loads a serialized executable from disk (skipping trace+compile
+entirely) or, on a miss, lowers+compiles once and writes the artifact for
+the next process.
+
+Artifact layout (one file per executable, ``<kind>.<digest>.aot``)::
+
+    TMAOT1\\n                       magic
+    <8-byte LE header length>
+    <header json>                  key components, fingerprint, format,
+                                   payload sha256, sizes, created timestamp
+    <payload>                      serialized executable (artifacts.py)
+
+Writes are atomic (same-directory temp file -> flush -> fsync -> rename ->
+directory fsync, the snapshot-store idiom) and loads verify the magic, the
+payload checksum, the cache-key digest, and the backend fingerprint before
+deserializing. Any mismatch or corruption falls back silently to tracing —
+never to wrong results — counted as ``aot_cache|result=fallback`` with an
+``aot_fallback`` bus event. An unwritable cache directory degrades the same
+way (``aot_cache_unwritable`` event, never an exception on the update path).
+
+Trust model: artifacts deserialize via pickle (the executable round-trip's
+own wire format), so the cache directory must be operator-controlled — the
+checksummed header defends against corruption, not tampering.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import struct
+import time
+from pathlib import Path
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+
+from torchmetrics_tpu._analysis.locksan import SAN as _SAN
+from torchmetrics_tpu._analysis.locksan import check_access as _san_check
+from torchmetrics_tpu._analysis.locksan import new_lock as _san_lock
+from torchmetrics_tpu._aot import artifacts as _artifacts
+from torchmetrics_tpu._aot.state import AOT
+from torchmetrics_tpu._observability import tracing as _obs_trace
+from torchmetrics_tpu._observability.events import BUS as _BUS
+from torchmetrics_tpu._observability.state import OBS as _OBS
+
+__all__ = [
+    "AotCache",
+    "get_cache",
+    "wrap_executable",
+    "aot_stats",
+    "reset_aot_stats",
+]
+
+_MAGIC = b"TMAOT1\n"
+_HEADER_LEN = struct.Struct("<Q")
+_HEADER_VERSION = 1
+_SUFFIX = ".aot"
+
+
+def _fsync_dir(directory: Path) -> None:
+    """Best-effort directory fsync so a rename survives a machine crash."""
+    try:
+        fd = os.open(str(directory), os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _aval_signature(args: tuple) -> Tuple[str, Tuple[Any, ...]]:
+    """Hashable per-call signature: tree structure + every leaf's aval.
+
+    ``shaped_abstractify`` captures shape, dtype AND weak-type — a serialized
+    executable only replays calls whose avals match exactly, so the
+    dispatcher must key at the same granularity XLA validates at.
+    """
+    from jax.api_util import shaped_abstractify
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    return str(treedef), tuple(shaped_abstractify(leaf) for leaf in leaves)
+
+
+def _digest(owner: str, kind: str, key_repr: str, call_sig: Tuple[str, Tuple[Any, ...]]) -> str:
+    """Stable cross-process cache key: sha256 of the full component record.
+
+    The components are exactly the ones the recompile-churn detector diffs
+    (argument structure, static values, shapes/dtypes, dtype policy — all
+    folded into ``key_repr`` + the call avals) plus the owner class, the
+    executable kind, and the backend fingerprint.
+    """
+    record = json.dumps(
+        {
+            "v": _HEADER_VERSION,
+            "owner": owner,
+            "kind": kind,
+            "key": key_repr,
+            "call_tree": call_sig[0],
+            "call_avals": [str(a) for a in call_sig[1]],
+            # the backend fingerprint is deliberately NOT part of the key: a
+            # jax upgrade must find the OLD artifact and refuse it loudly
+            # (named fallback + re-write), not silently miss beside it
+        },
+        sort_keys=True,
+    )
+    return hashlib.sha256(record.encode("utf-8")).hexdigest()
+
+
+class AotCache:  # concurrency: shared hot paths bump stats while benches/tests scrape
+    """One on-disk artifact store (list/load/store/verify/evict).
+
+    Disk operations never run under the lock — the lock only guards the
+    host-side stats counters (scraped by benches and tests while hot paths
+    record). Concurrent writers of the same artifact are safe by
+    construction: both produce identical bytes and the atomic rename makes
+    one of them win.
+    """
+
+    def __init__(self, directory: str) -> None:
+        self.directory = Path(directory)
+        self._lock = _san_lock("AotCache._lock")
+        # concurrency: shared stats dict guarded-by _lock
+        self._stats: Dict[str, int] = {
+            "hits": 0, "misses": 0, "fallbacks": 0, "writes": 0, "write_errors": 0,
+        }
+
+    # --------------------------------------------------------------- counters
+    def _bump(self, key: str, telem_obj: Any = None, label: Optional[str] = None) -> None:
+        with self._lock:
+            if _SAN.enabled:
+                _san_check(self, "_stats")
+            self._stats[key] = self._stats.get(key, 0) + 1
+        if telem_obj is not None and _OBS.enabled and label is not None:
+            from torchmetrics_tpu._observability.telemetry import telemetry_for
+
+            telemetry_for(telem_obj).inc(f"aot_cache|result={label}")
+
+    def stats(self) -> Dict[str, int]:
+        with self._lock:
+            if _SAN.enabled:
+                _san_check(self, "_stats")
+            return dict(self._stats)
+
+    # ------------------------------------------------------------------ paths
+    def artifact_path(self, kind: str, digest: str) -> Path:
+        return self.directory / f"{kind}.{digest[:24]}{_SUFFIX}"
+
+    # ------------------------------------------------------------------- load
+    def load(self, kind: str, digest: str) -> Tuple[Optional[Callable], Optional[str], Optional[str]]:
+        """Rehydrate one artifact: ``(callable, None, fmt)`` on a verified
+        hit, ``(None, None, None)`` on a clean miss (no artifact),
+        ``(None, reason, fmt-or-None)`` when an artifact exists but cannot
+        be trusted or loaded — ``fmt`` names the stored format so the caller
+        can rebuild around a format whose payloads fail to deserialize on
+        this runtime (see ``build_artifact(avoid_format=...)``)."""
+        path = self.artifact_path(kind, digest)
+        try:
+            raw = path.read_bytes()
+        except FileNotFoundError:
+            return None, None, None
+        except OSError as err:
+            return None, f"unreadable artifact: {type(err).__name__}", None
+        header, payload, reason = self._parse(raw, digest)
+        if header is None:
+            return None, reason, None
+        fn = _artifacts.load_artifact(header["format"], payload)
+        if fn is None:
+            return None, f"deserialization failed (format={header['format']})", header["format"]
+        return fn, None, header["format"]
+
+    def _parse(self, raw: bytes, digest: str) -> Tuple[Optional[Dict], bytes, Optional[str]]:
+        if not raw.startswith(_MAGIC):
+            return None, b"", "bad magic (not an AOT artifact)"
+        body = raw[len(_MAGIC):]
+        if len(body) < _HEADER_LEN.size:
+            return None, b"", "truncated header length"
+        (hlen,) = _HEADER_LEN.unpack(body[: _HEADER_LEN.size])
+        body = body[_HEADER_LEN.size:]
+        if len(body) < hlen:
+            return None, b"", "truncated header"
+        try:
+            header = json.loads(body[:hlen].decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return None, b"", "corrupt header json"
+        payload = body[hlen:]
+        if header.get("version") != _HEADER_VERSION:
+            return None, b"", f"unsupported artifact version {header.get('version')}"
+        if header.get("key_digest") != digest:
+            return None, b"", "cache-key digest mismatch"
+        if header.get("fingerprint") != _artifacts.backend_fingerprint():
+            theirs, ours = header.get("fingerprint") or {}, _artifacts.backend_fingerprint()
+            changed = sorted(k for k in set(theirs) | set(ours) if theirs.get(k) != ours.get(k))
+            return None, b"", f"backend fingerprint mismatch ({', '.join(changed) or '?'})"
+        if header.get("payload_sha256") != hashlib.sha256(payload).hexdigest():
+            return None, b"", "payload checksum mismatch (corrupt artifact)"
+        return header, payload, None
+
+    # ------------------------------------------------------------------ store
+    def store(
+        self, kind: str, digest: str, fmt: str, payload: bytes, meta: Dict[str, Any]
+    ) -> bool:
+        """Atomically write one artifact; degrades (returns False) on IO errors."""
+        header = {
+            "version": _HEADER_VERSION,
+            "format": fmt,
+            "key_digest": digest,
+            "payload_sha256": hashlib.sha256(payload).hexdigest(),
+            "payload_bytes": len(payload),
+            "fingerprint": _artifacts.backend_fingerprint(),
+            "created": time.time(),
+            **meta,
+        }
+        blob = json.dumps(header, sort_keys=True).encode("utf-8")
+        final = self.artifact_path(kind, digest)
+        tmp = final.with_name(final.name + f".tmp.{os.getpid()}")
+        try:
+            self.directory.mkdir(parents=True, exist_ok=True)
+            with open(tmp, "wb") as fh:
+                fh.write(_MAGIC + _HEADER_LEN.pack(len(blob)) + blob + payload)
+                fh.flush()
+                os.fsync(fh.fileno())
+            os.replace(tmp, final)
+            _fsync_dir(self.directory)
+        except OSError as err:
+            self._bump("write_errors")
+            try:
+                tmp.unlink(missing_ok=True)
+            except OSError:
+                pass
+            _BUS.publish(
+                "aot_cache_unwritable",
+                "AotCache",
+                f"artifact write failed: {type(err).__name__}: {err}",
+                data={"kind": kind, "path": str(final)},
+            )
+            return False
+        self._bump("writes")
+        return True
+
+    # ------------------------------------------------------------- inventory
+    def entries(self) -> List[Dict[str, Any]]:
+        """Header + integrity status of every artifact in the directory."""
+        out: List[Dict[str, Any]] = []
+        try:
+            paths = sorted(self.directory.glob(f"*{_SUFFIX}"))
+        except OSError:
+            return out
+        for path in paths:
+            entry: Dict[str, Any] = {"path": str(path)}
+            try:
+                entry["file_bytes"] = path.stat().st_size
+                raw = path.read_bytes()
+            except OSError as err:
+                # a concurrent evict can unlink between glob and stat/read:
+                # report, don't crash the listing
+                entry.setdefault("file_bytes", 0)
+                entry["status"] = f"unreadable: {type(err).__name__}"
+                out.append(entry)
+                continue
+            digest = path.name.rsplit(".", 2)[-2] if path.name.count(".") >= 2 else ""
+            header, _payload, reason = self._parse_for_listing(raw)
+            if header is None:
+                entry["status"] = reason or "corrupt"
+            else:
+                entry.update(
+                    {
+                        "status": "ok",
+                        "kind": header.get("kind", path.name.split(".", 1)[0]),
+                        "owner": header.get("owner", "?"),
+                        "format": header.get("format"),
+                        "created": header.get("created"),
+                        "fingerprint": header.get("fingerprint", {}),
+                        "stale": header.get("fingerprint") != _artifacts.backend_fingerprint(),
+                        "key_digest": header.get("key_digest", digest),
+                    }
+                )
+            out.append(entry)
+        return out
+
+    def _parse_for_listing(self, raw: bytes) -> Tuple[Optional[Dict], bytes, Optional[str]]:
+        """Like ``_parse`` but without a caller-supplied digest (CLI listing):
+        verifies magic/header/checksum, flags (rather than fails) staleness."""
+        if not raw.startswith(_MAGIC):
+            return None, b"", "bad magic"
+        body = raw[len(_MAGIC):]
+        if len(body) < _HEADER_LEN.size:
+            return None, b"", "truncated"
+        (hlen,) = _HEADER_LEN.unpack(body[: _HEADER_LEN.size])
+        body = body[_HEADER_LEN.size:]
+        if len(body) < hlen:
+            return None, b"", "truncated"
+        try:
+            header = json.loads(body[:hlen].decode("utf-8"))
+        except (UnicodeDecodeError, json.JSONDecodeError):
+            return None, b"", "corrupt header"
+        if header.get("version") != _HEADER_VERSION:
+            # keep the listing's verdict aligned with the load path: an
+            # artifact the runtime would refuse must not verify as "ok"
+            return None, b"", f"unsupported artifact version {header.get('version')}"
+        payload = body[hlen:]
+        if header.get("payload_sha256") != hashlib.sha256(payload).hexdigest():
+            return None, b"", "payload checksum mismatch"
+        return header, payload, None
+
+    def evict(
+        self,
+        *,
+        stale_only: bool = False,
+        kind: Optional[str] = None,
+        entries: Optional[List[Dict[str, Any]]] = None,
+    ) -> List[str]:
+        """Delete artifacts (all, one kind, or only fingerprint-stale/corrupt).
+
+        ``entries`` lets a caller that already listed the store (the CLI's
+        confirmation pass) skip a second full read+checksum sweep.
+        """
+        removed: List[str] = []
+        for entry in entries if entries is not None else self.entries():
+            if kind is not None and entry.get("kind") != kind:
+                continue
+            if stale_only and entry.get("status") == "ok" and not entry.get("stale"):
+                continue
+            try:
+                os.unlink(entry["path"])
+                removed.append(entry["path"])
+            except OSError:
+                continue
+        return removed
+
+
+# one AotCache per directory, so re-pointing the cache mid-process works and
+# every dispatcher created while a directory was active keeps using it
+_CACHES: Dict[str, AotCache] = {}
+_CACHES_LOCK = _san_lock("aot._CACHES_LOCK")
+
+
+def get_cache(directory: Optional[str] = None) -> Optional[AotCache]:
+    path = directory if directory is not None else AOT.cache_dir
+    if not path:
+        return None
+    with _CACHES_LOCK:
+        cache = _CACHES.get(path)
+        if cache is None:
+            cache = _CACHES[path] = AotCache(path)
+        return cache
+
+
+def aot_stats() -> Dict[str, int]:
+    """Process-wide AOT counters summed over every active cache directory."""
+    with _CACHES_LOCK:
+        caches = list(_CACHES.values())
+    totals: Dict[str, int] = {}
+    for cache in caches:
+        for key, val in cache.stats().items():
+            totals[key] = totals.get(key, 0) + val
+    return totals
+
+
+def reset_aot_stats() -> None:
+    with _CACHES_LOCK:
+        caches = list(_CACHES.values())
+    for cache in caches:
+        with cache._lock:
+            for key in cache._stats:
+                cache._stats[key] = 0
+
+
+# ALL dispatchers serialize cold resolution through this one lock: resolving
+# traces the owner's update body, and tracing mutates instance-bound caches
+# (traced closures, lazily-shaped states) that two concurrent lowerings —
+# even of DIFFERENT signatures — would corrupt into wrong-arity executables.
+# Steady-state dispatch (the `_resolved` probe) never touches it, and the
+# disk reads/writes it covers are one-time per (process, signature).
+_RESOLVE_LOCK = _san_lock("aot._RESOLVE_LOCK")
+
+
+class _AotDispatch:
+    """Per-executable dispatcher: concrete call signature -> ready executable.
+
+    Wraps ONE freshly-jitted callable (one structural cache-key slot in the
+    owner's compile cache). Per distinct aval signature it resolves exactly
+    once — disk hit, or lower+compile+persist — then steady-state calls pay
+    a tree-flatten + dict probe before invoking the executable directly.
+    Every AOT-machinery failure permanently falls back to the plain jitted
+    callable for that signature: results are never wrong, only cold.
+
+    Thread-safety: cold resolution is serialized process-wide under
+    ``_RESOLVE_LOCK`` (see above) with a double-probe of ``_resolved`` so
+    the losing thread adopts the winner's executable; steady-state reads are
+    GIL-atomic dict probes. The disk layer beneath is lock-guarded only
+    around its stats.
+    """
+
+    __slots__ = ("_jit_fn", "_owner", "_kind", "_key_repr", "_telem_obj", "_use_disk", "_resolved", "_fast")
+
+    def __init__(
+        self,
+        jit_fn: Callable,
+        owner: str,
+        kind: str,
+        key_repr: str,
+        telem_obj: Any = None,
+        use_disk: bool = True,
+    ) -> None:
+        self._jit_fn = jit_fn
+        self._owner = owner
+        self._kind = kind
+        self._key_repr = key_repr
+        self._telem_obj = telem_obj
+        self._use_disk = use_disk
+        self._resolved: Dict[Any, Callable] = {}
+        # steady-state fast slot: every seam's structural cache key already
+        # pins arg structure + shapes + dtypes, so a dispatcher normally sees
+        # exactly ONE aval signature — once it resolves, repeat calls skip
+        # the per-call tree-flatten + abstractify probe (~2us, ~4% of a
+        # compiled default update) and invoke the executable directly. The
+        # executable validates input avals itself: genuine drift raises
+        # TypeError BEFORE executing, landing in the keyed path below.
+        self._fast: Optional[Callable] = None
+
+    def __call__(self, *args: Any) -> Any:
+        fast = self._fast
+        if fast is not None:
+            try:
+                return fast(*args)
+            except (TypeError, ValueError):
+                # aval drift: re-dispatch through the keyed path. Both types
+                # matter: xla_exec executables reject a mismatched call with
+                # TypeError, stablehlo-loaded ones with ValueError — and
+                # both reject BEFORE executing, so no buffer is consumed.
+                pass
+        sig = _aval_signature(args)
+        fn = self._resolved.get(sig)
+        if fn is None:
+            fn = self._resolve(sig, args)
+        try:
+            return fn(*args)
+        except (TypeError, ValueError):
+            if fn is self._jit_fn:
+                raise
+            # a loaded executable REJECTING the call convention (aval drift
+            # the signature missed) must not poison the stream: re-route
+            # through the ordinary jitted path and pin it for this signature.
+            # Only call-convention rejections re-route — a runtime fault
+            # (collective failure, injected fault) must propagate untouched
+            # so the engine/pool degradation handlers see the real error,
+            # not a replay against possibly-donated buffers.
+            self._note_fallback("loaded executable rejected the call")
+            self._resolved[sig] = self._jit_fn
+            if self._fast is fn:
+                self._fast = None
+            return self._jit_fn(*args)
+
+    def warm(self, *args: Any) -> str:
+        """Resolve (load or compile+persist) WITHOUT executing.
+
+        Returns ``"hit"`` (loaded from disk), ``"compiled"`` (traced and, with
+        a cache directory set, persisted), or ``"fallback"`` (AOT machinery
+        unavailable; the plain jitted callable will serve the signature).
+        """
+        sig = _aval_signature(args)
+        fn = self._resolved.get(sig)
+        if fn is not None:
+            return "hit" if fn is not self._jit_fn else "fallback"
+        return self._resolve(sig, args, outcome=True)
+
+    # ------------------------------------------------------------- resolution
+    def _resolve(self, sig: Any, args: tuple, outcome: bool = False) -> Any:
+        with _RESOLVE_LOCK:
+            fn = self._resolved.get(sig)
+            if fn is not None:
+                # another thread resolved this signature while we waited for
+                # the lock: adopt its executable — reported as a hit (it is
+                # warm) unless it pinned the plain jitted fallback
+                result = "hit" if fn is not self._jit_fn else "fallback"
+                return result if outcome else fn
+            return self._resolve_traced(sig, args, outcome)
+
+    def _resolve_traced(self, sig: Any, args: tuple, outcome: bool) -> Any:
+        _sp = None
+        if _OBS.tracing:
+            _sp = _obs_trace.begin_span("aot.load", self._owner, kind=self._kind)
+        try:
+            result, fn = self._resolve_inner(sig, args)
+        except BaseException as err:  # pragma: no cover - defensive
+            if _sp is not None:
+                _obs_trace.end_span(_sp, err)
+            raise
+        if _sp is not None:
+            _sp.attrs["outcome"] = result
+            _obs_trace.end_span(_sp)
+        return result if outcome else fn
+
+    def _resolve_inner(self, sig: Any, args: tuple) -> Tuple[str, Callable]:
+        cache = get_cache() if self._use_disk else None
+        digest = None
+        avoid_fmt = None
+        if cache is not None:
+            try:
+                digest = _digest(self._owner, self._kind, self._key_repr, sig)
+                fn, reason, stored_fmt = cache.load(self._kind, digest)
+            except Exception as err:  # noqa: BLE001 - cache failure never breaks the stream
+                fn, reason, stored_fmt = None, f"cache probe failed: {type(err).__name__}: {err}", None
+            if fn is not None:
+                cache._bump("hits", self._telem_obj, "hit")
+                self._resolved[sig] = fn
+                self._fast = fn if len(self._resolved) == 1 else None
+                return "hit", fn
+            if reason is not None:
+                self._note_fallback(reason, cache)
+                if reason.startswith("deserialization failed"):
+                    # self-heal: the payload only fails to deserialize in a
+                    # fresh process (process-local JIT symbols) — re-storing
+                    # the same format would wedge every future replica, so
+                    # rebuild with the next format down the ladder
+                    avoid_fmt = stored_fmt
+            else:
+                cache._bump("misses", self._telem_obj, "miss")
+        compiled, fmt, payload = _artifacts.build_artifact(
+            self._jit_fn, args, avoid_format=avoid_fmt, want_payload=cache is not None
+        )
+        if compiled is None:
+            # lowering failed (e.g. non-jittable leftovers): the plain jitted
+            # call will surface the real error to the caller's own handler
+            self._resolved[sig] = self._jit_fn
+            self._fast = None
+            if cache is not None:
+                self._note_fallback("lowering failed", cache)
+            return "fallback", self._jit_fn
+        self._resolved[sig] = compiled
+        self._fast = compiled if len(self._resolved) == 1 else None
+        if cache is not None and digest is not None and fmt is not None:
+            cache.store(
+                self._kind, digest, fmt, payload,
+                {"owner": self._owner, "kind": self._kind, "key": self._key_repr},
+            )
+        elif cache is not None:
+            self._note_fallback("no serialization format available", cache)
+        return "compiled", compiled
+
+    def _note_fallback(self, reason: str, cache: Optional[AotCache] = None) -> None:
+        cache = cache if cache is not None else get_cache() if self._use_disk else None
+        if cache is not None:
+            cache._bump("fallbacks", self._telem_obj, "fallback")
+        elif self._telem_obj is not None and _OBS.enabled:
+            from torchmetrics_tpu._observability.telemetry import telemetry_for
+
+            telemetry_for(self._telem_obj).inc("aot_cache|result=fallback")
+        _BUS.publish(
+            "aot_fallback",
+            self._owner,
+            f"{self._kind}: {reason}",
+            data={"kind": self._kind, "reason": reason},
+        )
+
+
+def wrap_executable(
+    jit_fn: Callable,
+    *,
+    owner: str,
+    kind: str,
+    key_repr: str,
+    telem_obj: Any = None,
+    use_disk: Optional[bool] = None,
+) -> _AotDispatch:
+    """Wrap a fresh jitted callable in the AOT dispatcher.
+
+    ``use_disk=None`` follows the process switch at call time (the usual
+    seam integration); ``False`` builds a memory-only dispatcher — used by
+    ``warm_start()`` so explicit pre-compilation works even without a cache
+    directory.
+    """
+    return _AotDispatch(
+        jit_fn,
+        owner=owner,
+        kind=kind,
+        key_repr=key_repr,
+        telem_obj=telem_obj,
+        use_disk=AOT.active if use_disk is None else use_disk,
+    )

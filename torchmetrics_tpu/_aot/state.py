@@ -1,0 +1,144 @@
+"""Process-wide AOT-cache switch — the ONE object compile seams may touch.
+
+Mirrors the telemetry kill-switch contract (``_observability/state.py``):
+every executable-construction site guards itself with::
+
+    if _AOT.active:
+        ...wrap the fresh jitted callable in the AOT dispatcher...
+
+where ``_AOT`` is this module's :data:`AOT` singleton. ``active`` lives in a
+``__slots__`` slot, so the disabled path costs one attribute load and one
+branch — and it is only ever paid when a NEW executable is built (never per
+update call), so with the cache unset the runtime is instruction-identical
+to a build without the AOT machinery on every hot path.
+
+Switches:
+
+- env ``TM_TPU_AOT_CACHE=/path`` points the persistent on-disk executable
+  cache at a directory (read at import);
+- :func:`set_aot_cache` re-points or disables it at runtime.
+
+This module must stay import-light (no jax, no numpy): ``metric.py`` imports
+it at module scope.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+__all__ = ["AOT", "set_aot_cache", "get_aot_cache"]
+
+
+class _AotState:
+    """Mutable singleton holding the global AOT-cache switch.
+
+    ``__slots__`` keeps the ``active`` read a plain slot load and makes
+    accidental attribute growth an error.
+    """
+
+    __slots__ = ("active", "cache_dir")
+
+    def __init__(self) -> None:
+        path = os.environ.get("TM_TPU_AOT_CACHE", "").strip()
+        self.cache_dir: Optional[str] = path or None
+        self.active = bool(path)
+
+
+AOT = _AotState()
+
+_XLA_CACHE_ARMED = False
+_XLA_SAVED: Optional[tuple] = None  # (min_compile_time_secs, min_entry_size_bytes) pre-arm
+_XLA_WROTE: Optional[str] = None  # the exact dir this module set, so disarm never clobbers a user's
+
+
+def _arm_xla_cache(directory: str) -> None:
+    """Layer 2: point JAX's own persistent compilation cache under the dir.
+
+    The artifact store (layer 1) serializes the hot-path executables the
+    dispatcher routes; everything it cannot route — auxiliary jitted helpers,
+    the per-primitive compiles behind eager ``compute`` — still re-compiles
+    per process. JAX's persistent cache at ``<dir>/xla`` catches those (and
+    is the whole-cache fallback on backends without an executable
+    round-trip). Thresholds drop to zero because fleet cold-start is paid in
+    thousands of sub-second compiles, exactly the ones the defaults skip.
+    A user-configured ``jax_compilation_cache_dir`` always wins.
+    """
+    global _XLA_CACHE_ARMED, _XLA_SAVED, _XLA_WROTE
+    try:
+        import jax
+
+        current = jax.config.jax_compilation_cache_dir
+        if current is None or (_XLA_CACHE_ARMED and current == _XLA_WROTE):
+            if not _XLA_CACHE_ARMED:
+                _XLA_SAVED = (
+                    jax.config.jax_persistent_cache_min_compile_time_secs,
+                    jax.config.jax_persistent_cache_min_entry_size_bytes,
+                )
+            _XLA_WROTE = os.path.join(directory, "xla")
+            jax.config.update("jax_compilation_cache_dir", _XLA_WROTE)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+            _XLA_CACHE_ARMED = True
+    except Exception:  # noqa: BLE001 - older jax without the knobs: layer 1 still works
+        pass
+
+
+def _disarm_xla_cache() -> None:
+    global _XLA_CACHE_ARMED, _XLA_SAVED, _XLA_WROTE
+    if not _XLA_CACHE_ARMED:
+        return
+    try:
+        import jax
+
+        if jax.config.jax_compilation_cache_dir == _XLA_WROTE:
+            # only unwind OUR configuration: a dir the user pointed jax at
+            # after we armed (and the thresholds they now rely on) stays
+            jax.config.update("jax_compilation_cache_dir", None)
+            if _XLA_SAVED is not None:
+                # restore the pre-arm thresholds: leaving them zeroed would
+                # make a user's OWN later cache dir persist every sub-second
+                # compile
+                jax.config.update("jax_persistent_cache_min_compile_time_secs", _XLA_SAVED[0])
+                jax.config.update("jax_persistent_cache_min_entry_size_bytes", _XLA_SAVED[1])
+    except Exception:  # noqa: BLE001
+        pass
+    _XLA_CACHE_ARMED = False
+    _XLA_SAVED = None
+    _XLA_WROTE = None
+
+
+def ensure_xla_cache() -> None:
+    """Arm layer 2 for the env-var path (``TM_TPU_AOT_CACHE`` read at import).
+
+    ``metric.py`` calls this once at module scope — jax is already imported
+    there, so this module's own import stays jax-free for the CLI tools.
+    """
+    if AOT.active and AOT.cache_dir:
+        _arm_xla_cache(AOT.cache_dir)
+
+
+def set_aot_cache(directory: Optional[str]) -> None:
+    """Point the persistent AOT executable cache at ``directory``.
+
+    ``None`` (or ``""``) disables the disk cache: already-wrapped executables
+    keep their in-memory entries but stop touching disk, and newly built
+    executables skip the AOT machinery entirely. The directory is created
+    lazily on the first artifact write; an unwritable directory degrades to
+    tracing with an ``aot_cache_unwritable`` bus event — it never raises on
+    the update path. Pointing at a directory also arms JAX's persistent
+    compilation cache under ``<dir>/xla`` (see :func:`_arm_xla_cache`);
+    disabling disarms it unless the user configured their own.
+    """
+    path = (directory or "").strip() if isinstance(directory, str) or directory is None else str(directory)
+    AOT.cache_dir = path or None
+    AOT.active = bool(path)
+    if AOT.active:
+        _arm_xla_cache(path)
+    else:
+        _disarm_xla_cache()
+
+
+def get_aot_cache() -> Optional[str]:
+    """The current AOT cache directory (``None`` when the cache is off)."""
+    return AOT.cache_dir

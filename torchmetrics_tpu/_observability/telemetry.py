@@ -37,6 +37,7 @@ from torchmetrics_tpu._observability.reservoir import LatencyReservoir
 from torchmetrics_tpu._observability.state import OBS
 
 __all__ = [
+    "diff_components",
     "MetricTelemetry",
     "TelemetryRegistry",
     "TelemetryReport",
@@ -50,6 +51,18 @@ __all__ = [
 
 class RecompileChurnWarning(UserWarning):
     """A metric's compiled path keeps rebuilding its executable."""
+
+
+def diff_components(prev: Dict[str, str], cur: Dict[str, str]) -> Tuple[List[str], str]:
+    """Name the cache-key component(s) differing between two compile keys.
+
+    The churn detector's diff, shared with the recompile CI gate
+    (``_aot/golden.py``) so a gate failure names components with exactly the
+    wording a ``RecompileChurnWarning`` would use at runtime.
+    """
+    changed = sorted(k for k in set(prev) | set(cur) if prev.get(k) != cur.get(k))
+    diff = "; ".join(f"{k}: {prev.get(k)!r} -> {cur.get(k)!r}" for k in changed)
+    return changed, diff
 
 
 def _split_key(key: str) -> Tuple[str, Dict[str, str]]:
@@ -175,10 +188,7 @@ class MetricTelemetry:  # concurrency: shared exporters scrape via the registry 
         if prev is None:
             return
         self.inc(f"recompiles|kind={kind}")
-        changed = sorted(
-            k for k in set(prev) | set(components) if prev.get(k) != components.get(k)
-        )
-        diff = "; ".join(f"{k}: {prev.get(k)!r} -> {components.get(k)!r}" for k in changed)
+        changed, diff = diff_components(prev, components)
         self.last_churn_diff = diff or "(identical components, distinct key)"
         BUS.publish(
             "recompile_churn",

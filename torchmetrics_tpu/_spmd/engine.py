@@ -51,6 +51,7 @@ import numpy as np
 from jax.sharding import PartitionSpec
 
 from torchmetrics_tpu._analysis.manifest import in_graph_sync_eligible
+from torchmetrics_tpu._aot.state import AOT as _AOT
 from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.state import OBS as _OBS
 from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
@@ -261,6 +262,8 @@ class SpmdEngine:
         built = fn is None
         if built:
             fn = self._build_step(treedef, statics, len(dynamic))
+            if _AOT.active:
+                fn = self._aot_wrap(fn, "spmd_step", key)
             if _OBS.enabled:
                 # first call = trace+lower+execute: time it once, then the
                 # shim self-replaces under this cache key (same contract as
@@ -314,7 +317,10 @@ class SpmdEngine:
             for u in self._units
         )
         if self._compute_fn is None or self._compute_fn[0] != policies:
-            self._compute_fn = (policies, self._build_compute())
+            fn = self._build_compute()
+            if _AOT.active:
+                fn = self._aot_wrap(fn, "spmd_compute", policies)
+            self._compute_fn = (policies, fn)
         try:
             value = _faultinject.dispatch(self._compute_fn[1], self._states)
         except jax.errors.JAXTypeError as err:
@@ -329,6 +335,72 @@ class SpmdEngine:
             self._degrade(f"fused compute failed: {type(err).__name__}: {err}")
             return self.target.compute()
         return self._shape_value(value)
+
+    def _aot_wrap(self, fn: Any, kind: str, key: Any) -> Any:
+        """Route a fresh fused executable through the AOT dispatcher."""
+        from torchmetrics_tpu._aot.cache import wrap_executable
+
+        return wrap_executable(
+            fn,
+            owner=f"SpmdEngine[{type(self.target).__name__}]",
+            kind=kind,
+            key_repr=repr((key, self.world, self.axis_name)),
+            telem_obj=self.target,
+        )
+
+    def warm_start(self, *args: Any, **kwargs: Any) -> Dict[str, str]:
+        """Pre-resolve the fused step + compute executables for this
+        example-batch signature WITHOUT consuming a batch.
+
+        With an AOT cache directory set (``TM_TPU_AOT_CACHE`` /
+        ``set_aot_cache``) serialized executables load from disk — no trace,
+        no XLA compile; otherwise they are lowered+compiled in memory. The
+        example batch must be shaped exactly like real traffic (leading axis
+        divisible by the mesh size); the donated state buffers are only
+        lowered against, never consumed, and the stream's step count does
+        not advance.
+
+        Returns per-executable outcomes: ``"hit"`` (loaded from the cache),
+        ``"compiled"``, ``"fallback"``, or ``"ready"`` (already resolved).
+        """
+        from torchmetrics_tpu.metric import Metric
+
+        if self._degraded:
+            return {"spmd_step": "degraded", "spmd_compute": "degraded"}
+        if self._units is None:
+            self._prepare(args, kwargs)
+            if self._degraded:
+                return {"spmd_step": "degraded", "spmd_compute": "degraded"}
+        treedef, dynamic, statics = Metric._split_batch_args("spmd_step", args, kwargs)
+        if not dynamic:
+            raise TorchMetricsUserError("`warm_start` needs at least one array argument to shard")
+        for leaf in dynamic:
+            if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] % self.world:
+                raise TorchMetricsUserError(
+                    f"every array argument must carry a leading batch axis divisible by the"
+                    f" mesh size ({self.world}); got shape {getattr(leaf, 'shape', ())}"
+                )
+        sig = (treedef, statics, tuple((tuple(d.shape), str(d.dtype)) for d in dynamic))
+        key = (sig, tuple(
+            None if u.metric._dtype_policy is None else jnp.dtype(u.metric._dtype_policy).name
+            for u in self._units
+        ))
+        outcomes: Dict[str, str] = {}
+        fn = self._step_fns.get(key)
+        if fn is None:
+            fn = self._aot_wrap(self._build_step(treedef, statics, len(dynamic)), "spmd_step", key)
+            # setdefault: concurrent warm_start calls race benignly — both
+            # dispatchers are equivalent, the first insert wins for everyone
+            fn = self._step_fns.setdefault(key, fn)
+            if _OBS.enabled:
+                self._units[0].metric._obs_compile_event("spmd_step", treedef, statics, sig[2])
+        outcomes["spmd_step"] = fn.warm(self._states, dynamic) if hasattr(fn, "warm") else "ready"
+        policies = key[1]
+        if self._compute_fn is None or self._compute_fn[0] != policies:
+            self._compute_fn = (policies, self._aot_wrap(self._build_compute(), "spmd_compute", policies))
+        cfn = self._compute_fn[1]
+        outcomes["spmd_compute"] = cfn.warm(self._states) if hasattr(cfn, "warm") else "ready"
+        return outcomes
 
     def _shape_value(self, value: Any) -> Any:
         """Host-facing result: flatten collection dicts; slice replica groups.

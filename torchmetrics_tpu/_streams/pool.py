@@ -53,6 +53,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from torchmetrics_tpu._analysis.manifest import stream_pool_eligible
+from torchmetrics_tpu._aot.state import AOT as _AOT
 from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.events import BUS as _BUS
 from torchmetrics_tpu._observability.state import OBS as _OBS
@@ -390,6 +391,8 @@ class StreamPool:
         built = fn is None
         if built:
             fn = self._build_step(treedef, statics, len(dynamic))
+            if _AOT.active:
+                fn = self._aot_wrap(fn, "stream_step", key)
             if _OBS.enabled:
                 fn = self._obs_timed_first_call(key, fn)
             self._step_fns[key] = fn
@@ -494,7 +497,7 @@ class StreamPool:
         _sp_err: Optional[BaseException] = None
         try:
             if self._compute_one_fn is None:
-                self._compute_one_fn = self._build_compute_one()
+                self._compute_one_fn = self._maybe_aot(self._build_compute_one(), "stream_compute_one")
             value = self._shape_value(self._compute_one_fn(self._states, jnp.int32(sid)))
         except BaseException as err:
             _sp_err = err
@@ -516,7 +519,7 @@ class StreamPool:
         _sp_err: Optional[BaseException] = None
         try:
             if self._compute_all_fn is None:
-                self._compute_all_fn = self._build_compute_all()
+                self._compute_all_fn = self._maybe_aot(self._build_compute_all(), "stream_compute_all")
             stacked = self._compute_all_fn(self._states)
         except BaseException as err:
             _sp_err = err
@@ -800,6 +803,97 @@ class StreamPool:
             return out
 
         return timed
+
+    # ---------------------------------------------------------- AOT warm start
+    def _aot_wrap(self, fn: Any, kind: str, key: Any, use_disk: Optional[bool] = None) -> Any:
+        """Route a fresh jitted executable through the AOT dispatcher."""
+        from torchmetrics_tpu._aot.cache import wrap_executable
+
+        return wrap_executable(
+            fn,
+            owner=f"StreamPool[{type(self.target).__name__}]",
+            kind=kind,
+            key_repr=repr(key),
+            telem_obj=self,
+            use_disk=use_disk,
+        )
+
+    def _maybe_aot(self, fn: Any, kind: str, force: bool = False) -> Any:
+        if _AOT.active or force:
+            return self._aot_wrap(fn, kind, (self.physical,))
+        return fn
+
+    def warm_start(self, stream_ids: Any, *args: Any, **kwargs: Any) -> Dict[str, str]:
+        """Pre-resolve the pool's compiled executables for this micro-batch
+        signature WITHOUT consuming a batch.
+
+        With an AOT cache directory set (``TM_TPU_AOT_CACHE`` /
+        ``set_aot_cache``) serialized executables load from disk — no trace,
+        no XLA compile; otherwise they are lowered+compiled in memory. Either
+        way the first real :meth:`update` of the same signature dispatches a
+        ready executable. ``stream_ids``/``args`` are an example micro-batch
+        shaped exactly like real traffic (ids must be attached slots; array
+        leaves carry the leading stream axis); no state is mutated and no
+        row lands.
+
+        Returns per-executable outcomes: ``"hit"`` (loaded from the cache),
+        ``"compiled"``, ``"fallback"``, or ``"ready"`` (already resolved).
+        """
+        from torchmetrics_tpu.metric import Metric
+
+        ids = np.asarray(stream_ids, dtype=np.int32).reshape(-1)
+        if ids.size == 0:
+            raise TorchMetricsUserError("`warm_start` needs at least one stream id")
+        for sid in ids[ids >= 0].tolist():
+            self._check_slot(sid, attached=True)
+        if self._units is None:
+            self._prepare(ids, args, kwargs)
+        treedef, dynamic, statics = Metric._split_batch_args("stream_update", args, kwargs)
+        if not dynamic:
+            raise TorchMetricsUserError("`warm_start` needs at least one array argument")
+        for leaf in dynamic:
+            if getattr(leaf, "ndim", 0) < 1 or leaf.shape[0] != ids.size:
+                raise TorchMetricsUserError(
+                    f"every array argument must carry a leading stream axis of length"
+                    f" {ids.size} (one row per stream id); got shape {getattr(leaf, 'shape', ())}"
+                )
+        sig = (treedef, statics, tuple((tuple(d.shape), str(d.dtype)) for d in dynamic))
+        key = (
+            sig,
+            self.physical,
+            tuple(
+                None if u.metric._dtype_policy is None else jnp.dtype(u.metric._dtype_policy).name
+                for u in self._units
+            ),
+        )
+        outcomes: Dict[str, str] = {}
+        fn = self._step_fns.get(key)
+        if fn is None:
+            fn = self._aot_wrap(self._build_step(treedef, statics, len(dynamic)), "stream_step", key)
+            # setdefault: concurrent warm_start calls race benignly — both
+            # dispatchers are equivalent, the first insert wins for everyone
+            fn = self._step_fns.setdefault(key, fn)
+            if _OBS.enabled:
+                _telemetry_for(self).compile_event(
+                    "stream_step",
+                    {
+                        "arg_structure": str(treedef),
+                        "static_args": repr(statics),
+                        "shapes": repr(tuple(s for s, _ in sig[2])),
+                        "dtypes": repr(tuple(d for _, d in sig[2])),
+                        "capacity": str(self.physical),
+                    },
+                )
+        outcomes["stream_step"] = fn.warm(self._states, jnp.asarray(ids), dynamic) if hasattr(fn, "warm") else "ready"
+        if self._compute_one_fn is None:
+            self._compute_one_fn = self._maybe_aot(self._build_compute_one(), "stream_compute_one", force=True)
+        fn1 = self._compute_one_fn
+        outcomes["stream_compute_one"] = fn1.warm(self._states, jnp.int32(0)) if hasattr(fn1, "warm") else "ready"
+        if self._compute_all_fn is None:
+            self._compute_all_fn = self._maybe_aot(self._build_compute_all(), "stream_compute_all", force=True)
+        fna = self._compute_all_fn
+        outcomes["stream_compute_all"] = fna.warm(self._states) if hasattr(fna, "warm") else "ready"
+        return outcomes
 
     # -------------------------------------------------- snapshot/restore surface
     def state_dict(

@@ -184,6 +184,19 @@ class MetricCollection:
         if hook is not None:
             hook.record(self, method, args, kwargs)
 
+    def precompile(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Warm every member's compiled default update path (``Metric.precompile``).
+
+        Fans the example batch out exactly as :meth:`update` does (per-member
+        kwarg filtering), so the executables built — or loaded from the AOT
+        cache — match the signatures real traffic will dispatch. Member
+        states are untouched. Returns ``{member_name: report}``.
+        """
+        return {
+            name: m.precompile(*args, **m._filter_kwargs(**kwargs))
+            for name, m in self._modules.items()
+        }
+
     def _merge_compute_groups(self) -> None:
         """Pairwise-merge metrics whose states are identical (reference ``collections.py:228-262``)."""
         if isinstance(self._enable_compute_groups, list):

@@ -38,6 +38,16 @@ import numpy as np
 # lookup + lock round-trip per call; manifest.py imports nothing heavy
 from torchmetrics_tpu._analysis.manifest import compiled_validation_eligible, fingerprint_skip_allowed
 
+# AOT executable-cache hot switch (_aot/state.py): consulted ONLY when a new
+# executable is built (never per update call), so the unset-cache path stays
+# instruction-identical to a build without the AOT machinery
+from torchmetrics_tpu._aot.state import AOT as _AOT
+from torchmetrics_tpu._aot.state import ensure_xla_cache as _ensure_xla_cache
+
+# env-path arm of JAX's persistent compilation cache (layer 2): a no-op
+# unless TM_TPU_AOT_CACHE was set before this process imported the runtime
+_ensure_xla_cache()
+
 # telemetry hot switch + light helpers (OBSERVABILITY.md). `_OBS.enabled` is
 # the ONE check instrumented hot paths pay while telemetry is off: a slot
 # attribute load + branch, no dict lookups, no allocation. Everything heavier
@@ -1407,6 +1417,16 @@ class Metric(ABC):
             leaves.append(static_map[i] if i in static_map else next(dyn_iter))
         return jax.tree_util.tree_unflatten(treedef, leaves)
 
+    # compile-cache attribute -> the churn detector's compile-event kind; the
+    # AOT disk cache names artifacts by the same kinds so `tools/aot_cache.py
+    # list` output and `telemetry_report()` churn lines read as one vocabulary
+    _AOT_KINDS = {
+        "_auto_update_fn": "auto_update",
+        "_auto_forward_fn": "auto_forward",
+        "_jit_update_fn": "jit_update",
+        "_scan_update_fn": "scan_update",
+    }
+
     def _compiled_update(self, cache_name: str, key, build) -> Callable:
         cache = self.__dict__.setdefault(cache_name, {})
         # the dtype policy is baked into the trace (states are cast inside
@@ -1416,6 +1436,19 @@ class Metric(ABC):
         key = (key, policy)
         if key not in cache:
             fn = jax.jit(build())
+            if _AOT.active:
+                # route trace+compile through the persistent executable cache:
+                # a warm artifact loads instead of tracing, a cold one is
+                # serialized after its first compile for the next process
+                from torchmetrics_tpu._aot.cache import wrap_executable
+
+                fn = wrap_executable(
+                    fn,
+                    owner=f"{type(self).__module__}.{type(self).__qualname__}",
+                    kind=self._AOT_KINDS.get(cache_name, cache_name),
+                    key_repr=repr(key),
+                    telem_obj=self,
+                )
             if _OBS.enabled:
                 # trace+lowering happen lazily on the first invocation: shim
                 # that one call to time it, then self-replace with the raw
@@ -1937,6 +1970,88 @@ class Metric(ABC):
                         deltas[key] = int(nb.count) - ob._host_count
                     nb._sync_host_count(ob._host_count + deltas[key])
             object.__setattr__(self, n, nb)
+
+    def precompile(self, *args: Any, **kwargs: Any) -> Dict[str, Any]:
+        """Warm the compiled default update path for this argument signature.
+
+        Runs the REAL update machinery twice on stashed state — the first
+        pass registers the argument signature and runs eager validation, the
+        second builds (or, with an AOT cache directory set via
+        ``TM_TPU_AOT_CACHE`` / ``set_aot_cache``, loads from disk) the
+        compiled executable — then restores the metric exactly as it was:
+        states, update count, cached compute, and deferred-violation flags
+        are untouched by the warm-up batch. The registered signature
+        persists, so the FIRST real ``update()`` with matching shapes
+        dispatches straight to the warm executable.
+
+        Returns a small report: ``engaged`` (the compiled path is armed),
+        and ``reason`` when it is not (eager-pinned class, prior trace
+        failure, unsupported arguments).
+        """
+        report: Dict[str, Any] = {"engaged": False, "reason": None}
+        if not self._auto_eligible():
+            report["reason"] = (
+                "auto path disabled for this instance"
+                if (self._auto_disabled or not self.auto_compile)
+                else "class streams eagerly (not certified for the compiled default path)"
+            )
+            return report
+        global_state = self._copy_state_dict()
+        saved_count = self._update_count
+        saved_computed = self._computed
+        saved_viol = self._viol_flags
+        saved_nan_batches = self.__dict__.get("_nan_seen_batches")
+        self.__dict__["_journal_suspend"] = True
+        try:
+            import warnings as _warnings
+
+            with _warnings.catch_warnings():
+                _warnings.simplefilter("ignore")
+                # pre-register the argument signature: the warm-up update then
+                # dispatches straight through the compiled path (where the AOT
+                # cache can serve it) instead of paying the ordinary
+                # first-call-eager pass — for certified classes the prover
+                # guarantees the compiled path loses no checks, which is the
+                # same contract the second-call compile relies on
+                try:
+                    sig, treedef, dynamic, statics = self._auto_signature(args, kwargs)
+                except (TorchMetricsUserError, TypeError):
+                    sig = dynamic = None
+                if dynamic and sig not in self._auto_sigs:
+                    if len(self._auto_sigs) >= self._AUTO_MAX_SIGNATURES:
+                        # honor the same saturation bound as _try_auto_update:
+                        # warming N shape variants must not grow an unbounded
+                        # executable cache — past the cap this signature
+                        # streams eagerly like any other overflow shape
+                        if _OBS.enabled:
+                            _telemetry_for(self).inc("signature_overflow")
+                        report["reason"] = (
+                            f"signature cache saturated ({self._AUTO_MAX_SIGNATURES} shapes):"
+                            " this signature streams eagerly"
+                        )
+                        return report
+                    self._auto_sigs[sig] = 0
+                    if _OBS.enabled:
+                        self._obs_compile_event("auto_update", treedef, statics, sig[2])
+                self.update(*args, **kwargs)
+                if "_auto_update_fn" not in self.__dict__ and not self._auto_disabled:
+                    # lazily-shaped states (ring buffers) warm up eagerly on
+                    # the first pass; the second pass builds the executable
+                    self.update(*args, **kwargs)
+        finally:
+            self.__dict__.pop("_journal_suspend", None)
+            self._update_count = saved_count
+            self._computed = saved_computed
+            object.__setattr__(self, "_viol_flags", saved_viol)
+            if saved_nan_batches is None:
+                self.__dict__.pop("_nan_seen_batches", None)
+            else:
+                self.__dict__["_nan_seen_batches"] = saved_nan_batches
+            self._restore_state(global_state)
+        report["engaged"] = "_auto_update_fn" in self.__dict__ and not self._auto_disabled
+        if not report["engaged"]:
+            report["reason"] = "update did not compile (see telemetry `auto_path_disabled` events)"
+        return report
 
     def jit_update(self, *args: Any, **kwargs: Any) -> None:
         """``update()`` compiled into a single XLA computation.
