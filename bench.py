@@ -1824,6 +1824,111 @@ def _bench_locksan() -> tuple:
 
 
 # --------------------------------------------------------------------- #
+# analysis: memory-model sanitizer disabled-path cost + pool admission    #
+# check throughput (ANALYSIS.md "Memory-footprint prover")                #
+# --------------------------------------------------------------------- #
+
+MEMSAN_BENCH_UPDATES = 16  # updates per timed cycle (matches the telemetry estimator)
+MEMSAN_BENCH_REPS = 240  # interleaved cycle pairs
+POOL_ADMISSION_CHECKS = 2000  # ceiling checks per timed cycle
+POOL_ADMISSION_REPS = 30
+
+
+def _bench_memsan() -> tuple:
+    """(sanitizer-compiled-out updates/sec, never-imported shim updates/sec).
+
+    The instrumented seam is ``Metric._journal_record`` — the commit point
+    every update path (eager/auto/jit/forward) funnels through, now carrying
+    the memsan branch (``if method == "update" and _MEMSAN.enabled:
+    check_metric(...)``). The workload is the ``default_update_per_sec``
+    configuration (ctor-default MulticlassAccuracy, auto-compiled path) —
+    what a deployment actually pays per batch, same granularity as the
+    telemetry/tracing retention lines. Side A runs the shipped class with
+    the sanitizer DISABLED (the branch reduced to one string compare + slot
+    load + jump); side B shadows ``_journal_record`` with a twin whose
+    branch is deleted — the closest runtime approximation of a build that
+    never imported the sanitizer. The snapshot-hook probe stays on BOTH
+    sides: it is journal machinery, not sanitizer overhead. Paired-
+    interleave / alternating-lead / interquartile-mean-of-pair-ratios, the
+    locksan/telemetry estimator exactly.
+    """
+    import jax
+
+    from torchmetrics_tpu._analysis.memsan import set_memsan_enabled
+    from torchmetrics_tpu.classification import MulticlassAccuracy
+
+    set_memsan_enabled(False)
+    preds = jax.random.uniform(jax.random.PRNGKey(0), (BATCH, NUM_CLASSES))
+    target = jax.random.randint(jax.random.PRNGKey(1), (BATCH,), 0, NUM_CLASSES)
+    metric = MulticlassAccuracy(num_classes=NUM_CLASSES)
+    real_record = metric._journal_record
+
+    def shim_record(method, args, kwargs, _m=metric):
+        # Metric._journal_record minus the memsan branch (never-imported twin)
+        hook = _m.__dict__.get("_snapshot_hook")
+        if hook is not None and "_journal_suspend" not in _m.__dict__:
+            hook.record(_m, method, args, kwargs)
+
+    def cycle() -> float:
+        t0 = time.perf_counter()
+        for _ in range(MEMSAN_BENCH_UPDATES):
+            metric.update(preds, target)
+        jax.block_until_ready(metric.tp)
+        return time.perf_counter() - t0
+
+    try:
+        for _ in range(8):  # warm the compile + signature caches
+            cycle()
+        r_times, s_times = [], []
+        for rep in range(MEMSAN_BENCH_REPS):
+            first_real = rep % 2 == 0
+            for real_side in (first_real, not first_real):
+                object.__setattr__(
+                    metric, "_journal_record", real_record if real_side else shim_record
+                )
+                (r_times if real_side else s_times).append(cycle())
+    finally:
+        object.__setattr__(metric, "_journal_record", real_record)
+    ratios = sorted(s / r for r, s in zip(r_times, s_times))
+    core = ratios[len(ratios) // 4 : -(len(ratios) // 4)]
+    pair_ratio = sum(core) / len(core)
+    shim_med = sorted(s_times)[len(s_times) // 2]
+    shim_rate = MEMSAN_BENCH_UPDATES / shim_med
+    return pair_ratio * shim_rate, shim_rate
+
+
+def _bench_pool_admission() -> float:
+    """Admission-control ceiling checks/sec (p50 over timed cycles).
+
+    Times the full ``StreamPool._check_memory_ceiling`` path with a ceiling
+    SET: resolve the template's manifest entry, evaluate the closed-form
+    polynomial against live ctor args, apply the ``(capacity + 1) * F``
+    scaling law, compare. This is the cost a deployment pays once per pool
+    construction and once per capacity doubling — never per batch — so the
+    number exists to show the check is cheap enough to leave on everywhere.
+    """
+    from torchmetrics_tpu._streams.pool import StreamPool, set_memory_ceiling
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    pool = StreamPool(MeanSquaredError(), capacity=8)
+    set_memory_ceiling(1e12)  # ample: the admit path, not the raise path
+    try:
+        check = pool._check_memory_ceiling
+        for _ in range(POOL_ADMISSION_CHECKS):  # warm manifest + Poly caches
+            check(8, at="bench warmup")
+        times = []
+        for _ in range(POOL_ADMISSION_REPS):
+            t0 = time.perf_counter()
+            for _ in range(POOL_ADMISSION_CHECKS):
+                check(8, at="bench")
+            times.append(time.perf_counter() - t0)
+        med = sorted(times)[len(times) // 2]
+        return POOL_ADMISSION_CHECKS / med
+    finally:
+        set_memory_ceiling(None)
+
+
+# --------------------------------------------------------------------- #
 # AOT executable cache: cold start + disabled/enabled-path cost           #
 # (torchmetrics_tpu/_aot — README "Cold start & AOT cache")               #
 # --------------------------------------------------------------------- #
@@ -2595,6 +2700,39 @@ def main() -> None:
             )
         )
 
+    def sec_memsan() -> None:
+        san_off_rate, shim_rate = _bench_memsan()
+        _emit((
+                {
+                    "metric": "memsan_disabled_retention",
+                    "value": round(san_off_rate, 1),
+                    "unit": (
+                        f"compiled default updates/sec (ctor-default MulticlassAccuracy batch={BATCH},"
+                        " TM_TPU_MEMSAN off — the shipped one-branch sanitizer site at the"
+                        " `_journal_record` update-commit seam; baseline = the same workload with"
+                        " a shim record whose branch is deleted (never-imported twin,"
+                        " snapshot-hook probe kept), paired-interleaved per-pair-ratio"
+                        " interquartile mean — vs_baseline is the retention ratio, target >= 0.97)"
+                    ),
+                    "vs_baseline": round(san_off_rate / shim_rate, 3),
+                }
+            )
+        )
+        admission_rate = _bench_pool_admission()
+        _emit((
+                {
+                    "metric": "pool_admission_check_per_sec",
+                    "value": round(admission_rate, 1),
+                    "unit": (
+                        "ceiling checks/sec (StreamPool._check_memory_ceiling with a ceiling"
+                        " set: manifest lookup + closed-form polynomial eval against live ctor"
+                        " args + (capacity+1)*F scaling law + compare; paid once per pool"
+                        " construction / capacity doubling, never per batch)"
+                    ),
+                }
+            )
+        )
+
     def sec_aot_cold_start() -> None:
         cold = _bench_aot_cold_start()
         _emit((
@@ -2684,6 +2822,7 @@ def main() -> None:
         ("telemetry_disabled_retention", sec_telemetry),
         ("tracing_disabled_retention", sec_tracing),
         ("locksan_disabled_retention", sec_locksan),
+        ("memsan_disabled_retention", sec_memsan),
         ("cold_start_ms", sec_aot_cold_start),
         ("aot_disabled_retention", sec_aot_retention),
     ):
@@ -2766,6 +2905,8 @@ _README_LABELS = {
     "tracing_disabled_retention": ("Tracing (disabled) compiled default `update()`", "{v:,.0f} updates/s"),
     "flight_recorder_dump_ms": ("Flight-recorder post-mortem dump", "{v:.2f} ms"),
     "locksan_disabled_retention": ("Lock sanitizer (disabled) `StreamLabeler.note()`", "{v:,.0f} notes/s"),
+    "memsan_disabled_retention": ("Memory sanitizer (disabled) compiled default `update()`", "{v:,.0f} updates/s"),
+    "pool_admission_check_per_sec": ("StreamPool admission ceiling check", "{v:,.0f} checks/s"),
     "cold_start_ms": ("Cold start: spawn → first result (warm AOT cache)", "{v:,.0f} ms"),
     "aot_warm_vs_cold_speedup": ("AOT warm vs cold certified-sweep speedup", "{v:.1f}x"),
     "aot_disabled_retention": ("AOT cache (disabled) compiled default `update()`", "{v:,.0f} updates/s"),

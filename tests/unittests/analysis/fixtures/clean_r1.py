@@ -11,7 +11,7 @@ class GoodRegisteredState(Metric):
     def __init__(self, **kwargs):
         super().__init__(**kwargs)
         self.add_state("total", default=jnp.array(0), dist_reduce_fx="sum")
-        self.add_state("chunks", default=[], dist_reduce_fx="cat")
+        self.add_state("chunks", default=[], dist_reduce_fx="cat")  # lint-ok: R10 capacity set per-deployment
         self.window = 8  # config set once at construction is fine
 
     def update(self, preds) -> None:

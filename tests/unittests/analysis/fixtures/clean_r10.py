@@ -1,0 +1,24 @@
+"""False-positive twin for R10: the same cat-state shape, bounded.
+
+The class pins ``cat_state_capacity`` at construction, so the ``default=[]``
+cat state becomes a fixed-capacity device ring buffer with a closed-form
+byte formula — the escape hatch R10's message recommends. Must stay silent.
+"""
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+
+class GoodBoundedCat(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(cat_state_capacity=256, **kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds) -> None:
+        self.preds.append(preds)
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return self.total

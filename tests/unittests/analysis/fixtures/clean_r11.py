@@ -1,0 +1,24 @@
+"""False-positive twin for R11: the same ctor-sized state, linear.
+
+Per-class vectors scale O(num_classes); only degree >= 2 growth in
+constructor arguments is a footprint blowup. Must stay silent.
+"""
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+
+class GoodLinearState(Metric):
+    def __init__(self, num_classes: int, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.add_state("hits", default=jnp.zeros(num_classes), dist_reduce_fx="sum")
+        self.add_state("misses", default=jnp.zeros(num_classes), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        self.hits = self.hits + jnp.zeros_like(self.hits)
+        self.misses = self.misses + jnp.zeros_like(self.misses)
+
+    def compute(self):
+        return self.hits / (self.hits + self.misses)

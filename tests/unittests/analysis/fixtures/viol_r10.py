@@ -1,0 +1,21 @@
+"""True-positive fixture for R10: unbounded append-mode list state growth."""
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+
+class BadUnboundedCat(Metric):
+    def __init__(self, **kwargs):
+        super().__init__(**kwargs)
+        self.add_state("preds", default=[], dist_reduce_fx="cat")
+        self.add_state("target", default=[], dist_reduce_fx="cat")
+        self.add_state("total", default=jnp.array(0.0), dist_reduce_fx="sum")
+
+    def update(self, preds, target) -> None:
+        self.preds.append(preds)
+        self.target.append(target)
+        self.total = self.total + preds.sum()
+
+    def compute(self):
+        return jnp.concatenate(self.preds).mean() + self.total

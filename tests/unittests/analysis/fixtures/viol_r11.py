@@ -1,0 +1,22 @@
+"""True-positive fixture for R11: super-linear closed-form state footprint."""
+
+import jax.numpy as jnp
+
+from torchmetrics_tpu.metric import Metric
+
+
+class BadQuadraticState(Metric):
+    def __init__(self, num_classes: int, **kwargs):
+        super().__init__(**kwargs)
+        self.num_classes = num_classes
+        self.add_state(
+            "pairmat",
+            default=jnp.zeros((num_classes, num_classes)),
+            dist_reduce_fx="sum",
+        )
+
+    def update(self, preds, target) -> None:
+        self.pairmat = self.pairmat + jnp.zeros_like(self.pairmat)
+
+    def compute(self):
+        return self.pairmat.sum()

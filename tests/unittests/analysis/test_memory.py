@@ -1,0 +1,225 @@
+"""Memory-footprint prover: formula goldens, runtime consumption, sanitizer.
+
+Four consumers of the closed-form cost model are exercised here:
+
+1. the golden sweep — every bounded class parameterized by a constructor
+   size symbol is constructed at ``10`` and ``1000`` and the resolved
+   prediction must land within 10% of the measured registered-state bytes;
+2. the ``cat_state_capacity`` escape hatch — unbounded classes flip to
+   finite bounded predictions on instances constructed with a capacity;
+3. StreamPool admission control — pools over the ceiling are refused at
+   construction/growth, naming the class and the predicted bytes;
+4. the runtime memory sanitizer — an injected wrong manifest formula is
+   detected as drift at the next update boundary (rate-limited per class).
+"""
+
+import importlib
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import jax.numpy as jnp
+import pytest
+
+from torchmetrics_tpu._analysis import analyze_paths
+from torchmetrics_tpu._analysis import manifest as _manifest
+from torchmetrics_tpu._analysis import memsan
+from torchmetrics_tpu._analysis.manifest import (
+    MEMORY_PATH,
+    live_state_bytes,
+    predicted_state_bytes,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[3]
+FIXTURES = Path(__file__).parent / "fixtures"
+
+MEMORY = json.loads(MEMORY_PATH.read_text(encoding="utf-8"))["classes"]
+
+# symbols the sweep knows how to thread into a constructor
+_SWEEP_SYMBOLS = ("num_classes", "num_labels", "num_outputs")
+# required non-size args for classes whose __init__ has extra mandatory params
+_EXTRA_ARGS = {"MulticlassFBetaScore": {"beta": 1.0}, "MultilabelFBetaScore": {"beta": 1.0}}
+
+SWEEP = sorted(
+    q
+    for q, e in MEMORY.items()
+    if e["verdict"] == "bounded" and e["symbols"] and set(e["symbols"]) <= set(_SWEEP_SYMBOLS)
+)
+
+
+def _load(qualname):
+    mod, _, cls = qualname.rpartition(".")
+    return getattr(importlib.import_module(mod), cls)
+
+
+def test_sweep_is_nontrivial():
+    # the model must price a healthy share of the size-parameterized catalog
+    assert len(SWEEP) >= 25, SWEEP
+
+
+@pytest.mark.parametrize("n", [10, 1000])
+def test_golden_sweep_predicted_within_10pct(n):
+    """Predicted-vs-measured bytes within 10% across the sized catalog."""
+    failures = []
+    for qualname in SWEEP:
+        cls = _load(qualname)
+        entry = MEMORY[qualname]
+        kwargs = {sym: n for sym in entry["symbols"]}
+        kwargs.update(_EXTRA_ARGS.get(cls.__name__, {}))
+        obj = cls(**kwargs)
+        pred = predicted_state_bytes(obj)
+        assert pred is not None and pred.exact and pred.verdict == "bounded", qualname
+        live = live_state_bytes(obj)
+        if abs(live - pred.bytes) > 0.10 * max(live, 1.0):
+            failures.append((qualname, pred.bytes, live))
+    assert not failures, f"formula drift at size {n}: {failures}"
+
+
+def test_catmetric_flips_bounded_with_capacity():
+    from torchmetrics_tpu.aggregation import CatMetric
+
+    unbounded = predicted_state_bytes(CatMetric())
+    assert unbounded is not None
+    assert unbounded.verdict == "unbounded" and unbounded.bytes == float("inf")
+
+    capped = CatMetric(cat_state_capacity=64)
+    capped.update(jnp.ones(4))
+    pred = predicted_state_bytes(capped)
+    assert pred is not None and pred.verdict == "bounded"
+    assert pred.bytes < float("inf")
+    # ring layout: 64 float32 rows + validity plane + count scalar
+    assert pred.bytes == pytest.approx(live_state_bytes(capped), rel=0.10)
+    # concat-then-reduce computes carry a transient peak estimate
+    assert pred.peak_factor >= 2.0
+
+
+def test_retrieval_family_flips_bounded_with_capacity():
+    from torchmetrics_tpu.retrieval import RetrievalMRR
+
+    assert predicted_state_bytes(RetrievalMRR()).verdict == "unbounded"
+    capped = RetrievalMRR(cat_state_capacity=32)
+    capped.update(jnp.ones(4), jnp.ones(4, dtype=bool), indexes=jnp.zeros(4, dtype=jnp.int32))
+    pred = predicted_state_bytes(capped)
+    assert pred is not None and pred.verdict == "bounded" and pred.bytes < float("inf")
+    assert pred.bytes == pytest.approx(live_state_bytes(capped), rel=0.10)
+
+
+def test_r10_message_names_the_escape_hatch():
+    result = analyze_paths([str(FIXTURES / "viol_r10.py")])
+    r10 = [v for v in result.violations if v.rule == "R10"]
+    assert r10 and all("cat_state_capacity" in v.message for v in r10)
+    # severity term: the message names the per-update growth rate
+    assert any("row_bytes(preds)" in v.message for v in r10)
+
+
+def test_pool_admission_refused_over_ceiling():
+    from torchmetrics_tpu.regression import MeanSquaredError
+    from torchmetrics_tpu._streams.pool import (
+        StreamPool,
+        StreamPoolAdmissionError,
+        set_memory_ceiling,
+    )
+
+    try:
+        # MSE is 8 bytes/stream: capacity 8 predicts (8+1)*8 = 72 bytes
+        set_memory_ceiling(50)
+        with pytest.raises(StreamPoolAdmissionError) as exc:
+            StreamPool(MeanSquaredError(), capacity=8)
+        msg = str(exc.value)
+        assert "MeanSquaredError" in msg and "72 bytes" in msg and "50 bytes" in msg
+
+        # under the ceiling the pool admits, but the growth that would
+        # breach it is refused at attach time with zero state committed
+        set_memory_ceiling(100)
+        pool = StreamPool(MeanSquaredError(), capacity=8)
+        slots = [pool.attach() for _ in range(8)]
+        assert len(slots) == 8
+        with pytest.raises(StreamPoolAdmissionError, match="136 bytes"):
+            pool.attach()
+        assert pool.capacity == 8  # refusal left the pool untouched
+    finally:
+        set_memory_ceiling(None)
+
+
+def test_pool_predicted_stream_bytes_matches_model():
+    from torchmetrics_tpu.regression import MeanSquaredError
+    from torchmetrics_tpu._streams.pool import StreamPool
+
+    pool = StreamPool(MeanSquaredError(), capacity=4)
+    assert pool.predicted_stream_bytes() == predicted_state_bytes(MeanSquaredError()).bytes
+
+
+def test_memsan_detects_injected_drift():
+    """A wrong checked-in formula is caught live at the update boundary."""
+    from torchmetrics_tpu._observability.events import BUS
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    entry = _manifest.memory_entry_for(MeanSquaredError)
+    assert entry is not None
+    fake = json.loads(json.dumps(entry))  # deep copy
+    fake["total_terms"] = [{"coeff": 100000.0, "vars": {}}]
+    fake["states"] = [
+        {**s, "terms": [{"coeff": 100000.0, "vars": {}}]} for s in fake["states"]
+    ]
+    memsan.reset()
+    memsan.set_memsan_enabled(True)
+    _manifest._memory_class_cache[MeanSquaredError] = fake
+    try:
+        m = MeanSquaredError()
+        m.update(jnp.ones(4), jnp.zeros(4))
+        found = memsan.violations()
+        assert len(found) == 1, found
+        assert "MeanSquaredError" in found[0] and "memory-model drift" in found[0]
+        # rate-limited: the second drifting update is counted, not re-reported
+        m.update(jnp.ones(4), jnp.zeros(4))
+        assert len(memsan.violations()) == 1
+        assert memsan.suppressed_count() >= 1
+        events = [e for e in BUS.events() if e.kind == "memory_model_drift"]
+        # both MSE states carry the injected 100k-term: prediction sums them
+        assert events and events[-1].data["predicted_bytes"] == pytest.approx(200000.0)
+    finally:
+        memsan.set_memsan_enabled(False)
+        memsan.reset()
+        _manifest.invalidate_cache()
+
+
+def test_memsan_silent_on_correct_model():
+    from torchmetrics_tpu.regression import MeanSquaredError
+
+    memsan.reset()
+    memsan.set_memsan_enabled(True)
+    try:
+        m = MeanSquaredError()
+        m.update(jnp.ones(4), jnp.zeros(4))
+        m.update(jnp.ones(4), jnp.zeros(4))
+        assert memsan.violations() == []
+    finally:
+        memsan.set_memsan_enabled(False)
+        memsan.reset()
+
+
+def test_cli_json_rule_counts_include_memory_rules():
+    """``--json`` publishes R10/R11 zero-counts even on a clean scan."""
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "lint_metrics.py"),
+         str(FIXTURES / "clean_r10.py"), "--json"],
+        capture_output=True, text=True, cwd=str(REPO_ROOT),
+    )
+    assert proc.returncode == 0, proc.stderr
+    payload = json.loads(proc.stdout)
+    counts = payload["rule_counts"]
+    for rule_id in ("R10", "R11"):
+        assert counts[rule_id] == {"new": 0, "baselined": 0}
+
+
+def test_cli_explain_memory_renders_formula():
+    proc = subprocess.run(
+        [sys.executable, str(REPO_ROOT / "tools" / "lint_metrics.py"),
+         "torchmetrics_tpu/classification/confusion_matrix.py",
+         "--explain-memory", "MulticlassConfusionMatrix"],
+        capture_output=True, text=True, cwd=str(REPO_ROOT),
+    )
+    assert proc.returncode == 0, proc.stderr
+    assert "4*num_classes^2" in proc.stdout
+    assert "verdict: bounded" in proc.stdout

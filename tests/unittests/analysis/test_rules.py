@@ -21,6 +21,8 @@ EXPECTED = {
     "viol_r4.py": [("R4", 14), ("R4", 15), ("R4", 16)],
     "viol_r5.py": [("R5", 13)],
     "viol_r6.py": [("R6", 27)],
+    "viol_r10.py": [("R10", 11), ("R10", 12)],
+    "viol_r11.py": [("R11", 12)],
 }
 
 
@@ -34,7 +36,16 @@ def test_true_positives_fire_with_exact_lines(fixture):
 
 @pytest.mark.parametrize(
     "fixture",
-    ["clean_r1.py", "clean_r2.py", "clean_r3.py", "clean_r4.py", "clean_r5.py", "clean_r6.py"],
+    [
+        "clean_r1.py",
+        "clean_r2.py",
+        "clean_r3.py",
+        "clean_r4.py",
+        "clean_r5.py",
+        "clean_r6.py",
+        "clean_r10.py",
+        "clean_r11.py",
+    ],
 )
 def test_clean_twins_stay_silent(fixture):
     result = analyze_paths([str(FIXTURES / fixture)])

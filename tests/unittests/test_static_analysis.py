@@ -16,6 +16,7 @@ import json
 from torchmetrics_tpu._analysis import (
     ELIGIBILITY_PATH,
     MANIFEST_PATH,
+    MEMORY_PATH,
     RULES,
     THREAD_SAFETY_PATH,
     analyze_paths,
@@ -23,6 +24,7 @@ from torchmetrics_tpu._analysis import (
     is_runtime_path,
     load_baseline,
     load_manifest,
+    memory_to_json,
     split_baselined,
     thread_safety_to_json,
 )
@@ -254,6 +256,74 @@ def test_thread_safety_spot_checks():
     assert workers and workers[0]["daemon"] is True and workers[0]["joined"] is False
     # every module in the manifest is serving-runtime scoped
     assert all(is_runtime_path(p) for p in modules)
+
+
+def test_checked_in_memory_model_matches_code():
+    """Staleness gate: memory.json silently rots as state registrations are
+    edited unless a fresh scan reproduces it exactly (same contract as the
+    certified.json / eligibility.json / thread_safety.json gates)."""
+    result, _ = _scan()
+    current = memory_to_json(result.memory)
+    checked_in = json.loads(MEMORY_PATH.read_text(encoding="utf-8"))
+    cur_classes, old_classes = current["classes"], checked_in.get("classes", {})
+    added = sorted(set(cur_classes) - set(old_classes))
+    removed = sorted(set(old_classes) - set(cur_classes))
+    changed = sorted(
+        q for q in set(cur_classes) & set(old_classes) if cur_classes[q] != old_classes[q]
+    )
+    assert current == checked_in, (
+        "memory.json is out of sync with the memory prover — regenerate with"
+        " `python tools/lint_metrics.py torchmetrics_tpu/ --write-memory`."
+        f" added: {added[:5]}; removed: {removed[:5]}; changed formulas: {changed[:5]}"
+    )
+
+
+def test_memory_model_covers_every_public_class():
+    """ISSUE-16 acceptance: every public Metric class gets a byte formula;
+    at most 10 may be opaque, each citing a path:line reason."""
+    result, _ = _scan()
+    public = {q: m for q, m in result.memory.items() if m.public}
+    eligibility_public = {q for q, v in result.eligibility.items() if v.public}
+    assert set(public) == eligibility_public  # same catalog, no gaps
+    opaque = {q: m for q, m in public.items() if m.verdict == "opaque"}
+    assert len(opaque) <= 10, sorted(opaque)
+    for q, m in opaque.items():
+        assert m.opaque_reason and ":" in m.opaque_reason, (q, m.opaque_reason)
+
+
+def test_memory_prover_module_scans_clean():
+    """ISSUE-16 acceptance: the memory prover and sanitizer modules are clean
+    under the FULL rule set with ZERO baseline additions — no entry in the
+    checked-in baseline may reference them, and a fresh scan must find
+    nothing new."""
+    new_modules = (
+        "torchmetrics_tpu/_analysis/memory.py",
+        "torchmetrics_tpu/_analysis/memsan.py",
+    )
+    result, _ = _scan()
+    findings = [v for v in result.violations if v.path in new_modules]
+    assert not findings, [v.render() for v in findings]
+    baseline = load_baseline(BASELINE)
+    leaked = [e for e in baseline.values() if e.path in new_modules]
+    assert not leaked, f"baseline entries must never cover the ISSUE-16 modules: {leaked}"
+
+
+def test_memory_baseline_entries_justified():
+    """Every baselined R10/R11 finding carries a real (non-TODO)
+    justification, and the suppressed set actually exercises both rules."""
+    result, _ = _scan()
+    baseline = load_baseline(BASELINE)
+    new, suppressed, _stale = split_baselined(result.violations, baseline)
+    mem_new = [v for v in new if v.rule in ("R10", "R11")]
+    rendered = "\n".join(v.render() for v in mem_new)
+    assert not mem_new, f"un-baselined memory-footprint findings:\n{rendered}"
+    for entry in baseline.values():
+        if entry.rule in ("R10", "R11"):
+            assert entry.justification and "TODO" not in entry.justification, (
+                f"memory baseline entry without a cited justification: {entry}"
+            )
+    assert any(v.rule == "R10" for v in suppressed)
+    assert any(v.rule == "R11" for v in suppressed)
 
 
 def test_manifest_is_nontrivial_and_scoped():

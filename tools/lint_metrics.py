@@ -47,8 +47,17 @@ def main(argv=None) -> int:
         help="regenerate the concurrency guard-map manifest (per-module verdicts, R7-R9)",
     )
     parser.add_argument(
+        "--write-memory", action="store_true",
+        help="regenerate the memory cost-model manifest (closed-form byte formula per public Metric subclass)",
+    )
+    parser.add_argument(
         "--explain", metavar="CLASS", default=None,
         help="print the proven eligibility verdict, check inventory, and blockers for one class"
+        " (bare class name or dotted qualname)",
+    )
+    parser.add_argument(
+        "--explain-memory", metavar="CLASS", default=None,
+        help="print the derived state-size formula, per-state breakdown, and memory verdict for one class"
         " (bare class name or dotted qualname)",
     )
     args = parser.parse_args(argv)
@@ -56,16 +65,19 @@ def main(argv=None) -> int:
     from torchmetrics_tpu._analysis import (
         ELIGIBILITY_PATH,
         MANIFEST_PATH,
+        MEMORY_PATH,
         RULES,
         THREAD_SAFETY_PATH,
         analyze_paths,
         eligibility_to_json,
         load_baseline,
+        memory_to_json,
         split_baselined,
         thread_safety_to_json,
         write_baseline,
         write_eligibility,
         write_manifest,
+        write_memory,
         write_thread_safety,
     )
 
@@ -156,6 +168,63 @@ def main(argv=None) -> int:
             thread_safety_to_json(result.thread_safety.values()), THREAD_SAFETY_PATH
         )
         print(f"wrote {n} module thread-safety verdicts to {THREAD_SAFETY_PATH}")
+        return 0
+
+    if args.write_memory:
+        from torchmetrics_tpu._analysis.manifest import load_memory
+
+        prior = load_memory(MEMORY_PATH) if MEMORY_PATH.exists() else {}
+        current = {q for q, m in result.memory.items() if m.public}
+        dropped = sorted(
+            q for q in prior
+            if q not in current and not any(f in scanned for f in _module_files(q))
+        )
+        if dropped:
+            print(
+                f"refusing --write-memory on a partial scan: {len(dropped)} previously"
+                f" recorded class(es) live in unscanned files (e.g. {dropped[0]});"
+                " rerun on the package root"
+            )
+            return 2
+        n = write_memory(memory_to_json(result.memory), MEMORY_PATH)
+        print(f"wrote {n} memory cost-model entries to {MEMORY_PATH}")
+        return 0
+
+    if args.explain_memory:
+        wanted = args.explain_memory
+        matches = [
+            m for q, m in sorted(result.memory.items())
+            if q == wanted or q.rsplit(".", 1)[-1] == wanted
+        ]
+        if not matches:
+            print(f"no Metric subclass named {wanted!r} found in the scanned tree")
+            return 2
+        for m in matches:
+            print(f"{m.qualname}  ({m.path}:{m.line})")
+            print(f"  verdict: {m.verdict}")
+            print(f"  total bytes: {m.total.render()}")
+            if m.bounded_total is not None:
+                print(f"  bounded (with cat_state_capacity): {m.bounded_total.render()}")
+            if m.peak_factor != 1.0:
+                print(f"  transient peak factor (concat-then-reduce compute): x{m.peak_factor:g}")
+            if m.symbols:
+                print(f"  symbols: {', '.join(sorted(m.symbols))}")
+            print("  states:")
+            for rec in m.states:
+                flags = []
+                if rec.conditional:
+                    flags.append("conditional")
+                if rec.kind == "list":
+                    flags.append(f"grows ~{rec.growth.render()}/update" if rec.growth else "grows")
+                suffix = f"  [{', '.join(flags)}]" if flags else ""
+                detail = rec.bytes.render() if rec.kind != "list" else "unbounded"
+                print(f"    - {rec.name} ({rec.kind}, {rec.reduction}) = {detail}{suffix}"
+                      f"  @ {rec.path}:{rec.lineno}")
+                if rec.opaque_reason:
+                    print(f"      opaque: {rec.opaque_reason}")
+            pool = "(capacity + 1) * F"
+            print(f"  scaling: StreamPool bytes = {pool}; SPMD per-device bytes = F")
+            print()
         return 0
 
     if args.explain:

@@ -33,17 +33,35 @@ from torchmetrics_tpu._analysis.engine import AnalysisResult, analyze_paths, ana
 from torchmetrics_tpu._analysis.manifest import (
     ELIGIBILITY_PATH,
     MANIFEST_PATH,
+    MEMORY_PATH,
     THREAD_SAFETY_PATH,
+    PredictedMemory,
     compiled_validation_eligible,
     fingerprint_skip_allowed,
+    live_state_bytes,
     load_eligibility,
     load_manifest,
+    load_memory,
     load_thread_safety,
+    memory_entry_for,
+    predicted_state_bytes,
     set_eligibility_enabled,
     set_fingerprint_skip_enabled,
+    set_memory_model_enabled,
     write_eligibility,
     write_manifest,
+    write_memory,
     write_thread_safety,
+)
+from torchmetrics_tpu._analysis.memory import (
+    ClassMemory,
+    MemoryPass,
+    StateRecord,
+    memory_to_json,
+)
+from torchmetrics_tpu._analysis.memsan import (
+    memsan_enabled,
+    set_memsan_enabled,
 )
 from torchmetrics_tpu._analysis.model import Violation
 from torchmetrics_tpu._analysis.rules import RULES, Rule, rule
@@ -54,12 +72,17 @@ __all__ = [
     "Blocker",
     "CheckSite",
     "ClassEligibility",
+    "ClassMemory",
     "ELIGIBILITY_PATH",
     "EligibilityPass",
     "MANIFEST_PATH",
+    "MEMORY_PATH",
+    "MemoryPass",
     "ModuleConcurrency",
+    "PredictedMemory",
     "RULES",
     "Rule",
+    "StateRecord",
     "THREAD_SAFETY_PATH",
     "ThreadSite",
     "Violation",
@@ -69,17 +92,26 @@ __all__ = [
     "eligibility_to_json",
     "fingerprint_skip_allowed",
     "is_runtime_path",
+    "live_state_bytes",
     "load_baseline",
     "load_eligibility",
     "load_manifest",
+    "load_memory",
     "load_thread_safety",
+    "memory_entry_for",
+    "memory_to_json",
+    "memsan_enabled",
+    "predicted_state_bytes",
+    "set_memsan_enabled",
     "rule",
     "thread_safety_to_json",
     "write_thread_safety",
     "set_eligibility_enabled",
     "set_fingerprint_skip_enabled",
+    "set_memory_model_enabled",
     "split_baselined",
     "write_baseline",
     "write_eligibility",
     "write_manifest",
+    "write_memory",
 ]

@@ -19,6 +19,7 @@ from torchmetrics_tpu._analysis.eligibility import (
     ClassEligibility,
     EligibilityPass,
 )
+from torchmetrics_tpu._analysis.memory import ClassMemory, MemoryPass
 from torchmetrics_tpu._analysis.model import SourceInfo, Violation
 from torchmetrics_tpu._analysis.registry import Registry
 
@@ -43,6 +44,10 @@ class AnalysisResult:
     # rule-checked module — the thread_safety.json manifest writer and the
     # locksan guard-map loader both read from here
     thread_safety: Dict[str, "concurrency.ModuleConcurrency"] = field(default_factory=dict)
+    # memory cost model (qualname -> ClassMemory) for every metric class in a
+    # scanned module — the memory.json manifest writer, the R10/R11 rules,
+    # and the runtime admission-control evaluator all read from here
+    memory: Dict[str, ClassMemory] = field(default_factory=dict)
     # display paths of rule-checked files (context siblings excluded):
     # baseline staleness is only decidable for files that were scanned
     scanned_paths: List[str] = field(default_factory=list)
@@ -217,9 +222,26 @@ def analyze_paths(paths: Sequence[str]) -> AnalysisResult:
         scan_kernels = ".functional" in f".{module}" or "/functional/" in source.path
         _run_rules_for_module(registry, mod, source, result, scan_kernels=scan_kernels, eligibility=eligibility)
 
+    # pass 4: memory cost model (interprocedural — add_state sites anchor in
+    # base-class modules, so this runs over whole classes, not per module;
+    # R10/R11 findings are filtered to scanned files inside emit_violations)
+    _run_memory_pass(registry, [m for m, _ in modules], result)
+
     result.violations.sort(key=lambda v: (v.path, v.line, v.rule))
     result.certified.sort()
     return result
+
+
+def _run_memory_pass(registry: Registry, scanned_modules: Sequence[str], result: AnalysisResult) -> None:
+    memory_pass = MemoryPass(registry)
+    for module in scanned_modules:
+        mod = registry.modules[module]
+        for cls in mod.classes.values():
+            if registry.is_metric_subclass(cls):
+                result.memory[cls.qualname] = memory_pass.analyze_class(cls)
+    result.violations.extend(
+        memory_pass.emit_violations(list(result.memory.values()), set(result.scanned_paths))
+    )
 
 
 def _check_r6(cls, verdict: Optional[ClassEligibility], source) -> List[Violation]:
@@ -310,6 +332,7 @@ def analyze_source(text: str, path: str = "<string>", module: Optional[str] = No
     _run_rules_for_module(
         registry, mod, source, result, scan_kernels=True, eligibility=EligibilityPass(registry)
     )
+    _run_memory_pass(registry, [mod_name], result)
     result.violations.sort(key=lambda v: (v.path, v.line, v.rule))
     result.certified.sort()
     return result

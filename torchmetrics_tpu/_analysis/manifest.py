@@ -14,10 +14,11 @@ subclass (whose source the analyzer never saw) keeps the runtime guard.
 
 from __future__ import annotations
 
+import fnmatch
 import json
 import os
 from pathlib import Path
-from typing import Dict, FrozenSet, Iterable, Optional
+from typing import Dict, FrozenSet, Iterable, List, NamedTuple, Optional
 
 MANIFEST_PATH = Path(__file__).parent / "certified.json"
 MANIFEST_VERSION = 1
@@ -25,6 +26,8 @@ MANIFEST_VERSION = 1
 ELIGIBILITY_PATH = Path(__file__).parent / "eligibility.json"
 
 THREAD_SAFETY_PATH = Path(__file__).parent / "thread_safety.json"
+
+MEMORY_PATH = Path(__file__).parent / "memory.json"
 
 _manifest_cache: Optional[FrozenSet[str]] = None
 _class_cache: Dict[type, bool] = {}
@@ -84,7 +87,7 @@ def fingerprint_skip_enabled() -> bool:
 
 def invalidate_cache() -> None:
     global _manifest_cache, _eligibility_cache, _in_graph_cache
-    global _thread_safety_cache, _guard_map_cache
+    global _thread_safety_cache, _guard_map_cache, _memory_cache
     _manifest_cache = None
     _class_cache.clear()
     _eligibility_cache = None
@@ -94,6 +97,8 @@ def invalidate_cache() -> None:
     _stream_pool_class_cache.clear()
     _thread_safety_cache = None
     _guard_map_cache = None
+    _memory_cache = None
+    _memory_class_cache.clear()
 
 
 def write_eligibility(payload: Dict[str, object], path: Optional[Path] = None) -> int:
@@ -325,3 +330,269 @@ def fingerprint_skip_allowed(cls: type) -> bool:
         allowed = bool(allowed)
     _class_cache[cls] = allowed
     return allowed
+
+
+# ---------------------------------------------------------------------------
+# memory cost model (see memory.py): the admission-control primitive
+
+
+_memory_cache: Optional[Dict[str, dict]] = None
+_memory_class_cache: Dict[type, Optional[dict]] = {}
+# kill switch: with the model disabled every consumer (pool ceiling, SPMD
+# telemetry, memsan) sees "no prediction" and degrades to its pre-model path
+_memory_enabled = os.environ.get("TM_TPU_DISABLE_MEMORY_MODEL", "") != "1"
+
+
+def set_memory_model_enabled(flag: bool) -> None:
+    """Benchmark/diagnostic toggle for the static memory cost model."""
+    global _memory_enabled
+    _memory_enabled = bool(flag)
+    _memory_class_cache.clear()
+
+
+def memory_model_enabled() -> bool:
+    return _memory_enabled
+
+
+def write_memory(payload: Dict[str, object], path: Optional[Path] = None) -> int:
+    """Write the memory cost-model manifest (see ``memory.py``)."""
+    (path or MEMORY_PATH).write_text(
+        json.dumps(payload, indent=2, sort_keys=True) + "\n", encoding="utf-8"
+    )
+    classes = payload.get("classes", {})
+    return len(classes) if isinstance(classes, dict) else 0
+
+
+def load_memory(path: Optional[Path] = None) -> Dict[str, dict]:
+    """qualname -> manifest entry map from the checked-in memory manifest."""
+    global _memory_cache
+    if path is None and _memory_cache is not None:
+        return _memory_cache
+    p = path or MEMORY_PATH
+    try:
+        data = json.loads(p.read_text(encoding="utf-8"))
+        classes = data.get("classes", {})
+        if not isinstance(classes, dict):
+            classes = {}
+    except (OSError, ValueError, AttributeError):
+        classes = {}
+    if path is None:
+        _memory_cache = classes
+    return classes
+
+
+def memory_entry_for(cls: type) -> Optional[dict]:
+    """Manifest entry for the EXACT class (user subclasses read None)."""
+    if not _memory_enabled:
+        return None
+    if cls in _memory_class_cache:
+        return _memory_class_cache[cls]
+    entry = load_memory().get(f"{cls.__module__}.{cls.__qualname__}")
+    _memory_class_cache[cls] = entry
+    return entry
+
+
+class PredictedMemory(NamedTuple):
+    """One instance's predicted steady-state state footprint.
+
+    ``bytes`` is ``float("inf")`` for an unbounded verdict (a cat-list state
+    with no ``cat_state_capacity``) — the admission ceiling refuses those by
+    construction. ``exact`` is False when any state's symbols could not be
+    resolved against the live instance and its LIVE leaf bytes were used
+    instead (still a usable number, no longer a closed form).
+    """
+
+    bytes: float
+    verdict: str  # "bounded" | "unbounded"
+    exact: bool
+    peak_factor: float
+
+
+def _leaf_bytes(value: object) -> Optional[float]:
+    """Duck-typed byte count of one live state (no device sync: ``nbytes``
+    is array metadata, ring leaves are read without materializing)."""
+    if value is None:
+        return None
+    nbytes = getattr(value, "nbytes", None)
+    if nbytes is not None and not callable(nbytes):
+        return float(nbytes)
+    # RingBuffer quacks: capacity + data/valid/count leaves
+    if hasattr(value, "capacity") and hasattr(value, "append") and hasattr(value, "count"):
+        total = 0.0
+        for leaf_name in ("data", "valid", "count"):
+            leaf = getattr(value, leaf_name, None)
+            if leaf is not None and hasattr(leaf, "nbytes"):
+                total += float(leaf.nbytes)
+        return total
+    if isinstance(value, (list, tuple)):
+        total = 0.0
+        for item in value:
+            if hasattr(item, "nbytes"):
+                total += float(item.nbytes)
+        return total
+    return None
+
+
+def _row_bytes(obj: object, state_name: str) -> Optional[float]:
+    """Bytes of one appended row of a cat state, from the live leaves."""
+    value = getattr(obj, state_name, None)
+    if value is None:
+        return None
+    if hasattr(value, "capacity") and hasattr(value, "append"):
+        data = getattr(value, "data", None)
+        if data is not None and hasattr(data, "nbytes") and getattr(value, "capacity", 0):
+            return float(data.nbytes) / float(value.capacity)
+        return None
+    if isinstance(value, (list, tuple)) and value and hasattr(value[0], "nbytes"):
+        first = value[0]
+        lead = first.shape[0] if getattr(first, "ndim", 0) >= 1 and first.shape[0] else 1
+        return float(first.nbytes) / float(lead)
+    return None
+
+
+def _resolve_symbol(obj: object, sym: str) -> Optional[float]:
+    """Resolve one formula symbol against a live instance.
+
+    Grammar: a bare name is a numeric constructor arg (stored as
+    ``self.<name>``; arrays resolve to their leading dim — the
+    ``thresholds`` count idiom); ``len(x)`` is the length of a stored
+    collection; ``row_bytes(s)`` is the live row width of cat state ``s``.
+    """
+    if sym.startswith("row_bytes(") and sym.endswith(")"):
+        return _row_bytes(obj, sym[len("row_bytes(") : -1])
+    if sym.startswith("len(") and sym.endswith(")"):
+        value = getattr(obj, sym[4:-1], None)
+        try:
+            return float(len(value))  # type: ignore[arg-type]
+        except TypeError:
+            return None
+    value = getattr(obj, sym, None)
+    if value is None:
+        return None
+    if isinstance(value, bool):
+        return float(value)
+    if isinstance(value, (int, float)):
+        return float(value)
+    shape = getattr(value, "shape", None)
+    if shape is not None and len(shape) >= 1:
+        return float(shape[0])
+    try:
+        return float(len(value))  # type: ignore[arg-type]
+    except TypeError:
+        return None
+
+
+def _eval_terms(obj: object, terms: List[dict]) -> Optional[float]:
+    total = 0.0
+    for term in terms:
+        value = float(term.get("coeff", 0.0))
+        for sym, power in (term.get("vars") or {}).items():
+            resolved = _resolve_symbol(obj, sym)
+            if resolved is None:
+                return None
+            value *= resolved ** int(power)
+        total += value
+    return total
+
+
+def _expand_state_names(obj: object, pattern: str) -> List[str]:
+    """Dynamic-name records (``rouge*_*``) expand against the live state
+    registry; literal names pass through."""
+    if "*" not in pattern:
+        return [pattern]
+    defaults = getattr(obj, "_defaults", None)
+    if not isinstance(defaults, dict):
+        return []
+    return sorted(n for n in defaults if fnmatch.fnmatch(n, pattern))
+
+
+_RING_VALID_PLUS_COUNT = 1  # valid mask: 1 byte/row; count: 4 bytes flat
+
+
+def predicted_state_bytes(obj: object) -> Optional[PredictedMemory]:
+    """Evaluate the class's closed-form byte formula against a live instance.
+
+    Returns None when the model has nothing to say (class absent from the
+    manifest — user subclasses —, an opaque verdict, or the kill switch
+    thrown). An instance constructed with ``cat_state_capacity`` flips an
+    ``unbounded`` class verdict to a bounded per-instance formula — the ring
+    buffers the runtime substitutes for its cat lists have closed forms.
+    """
+    entry = memory_entry_for(type(obj))
+    if entry is None:
+        return None
+    if entry.get("verdict") == "opaque":
+        return None
+    capacity = getattr(obj, "cat_state_capacity", None)
+    defaults = getattr(obj, "_defaults", None)
+    total = 0.0
+    exact = True
+    verdict = "bounded"
+    for state in entry.get("states", ()):
+        kind = state.get("kind")
+        if kind == "opaque":
+            exact = False
+            continue
+        names = _expand_state_names(obj, state.get("name", ""))
+        conditional = bool(state.get("conditional"))
+        if isinstance(defaults, dict):
+            live_names = [n for n in names if n in defaults]
+            if conditional:
+                names = live_names
+            elif live_names:
+                names = live_names
+        if not names:
+            if conditional:
+                continue
+            names = [state.get("name", "")]
+        for name in names:
+            if kind == "list":
+                if capacity:
+                    row = _row_bytes(obj, name)
+                    if row is None:
+                        row, exact = 4.0, False  # uninitialized ring: minimum row
+                    total += float(capacity) * (row + _RING_VALID_PLUS_COUNT) + 4.0
+                else:
+                    verdict = "unbounded"
+                    total = float("inf")
+                continue
+            value = _eval_terms(obj, state.get("terms", ()))
+            if value is None:
+                live = _live_state_bytes_by_name(obj, name)
+                if live is None:
+                    exact = False
+                    continue
+                value, exact = live, False
+            total += value
+    if total != total:  # pragma: no cover - NaN guard
+        return None
+    return PredictedMemory(
+        bytes=total,
+        verdict=verdict,
+        exact=exact and verdict == "bounded",
+        peak_factor=float(entry.get("peak_factor", 1.0)),
+    )
+
+
+def _live_state_bytes_by_name(obj: object, name: str) -> Optional[float]:
+    try:
+        value = getattr(obj, name)
+    except AttributeError:
+        return None
+    return _leaf_bytes(value)
+
+
+def live_state_bytes(obj: object) -> Optional[float]:
+    """Sum of the instance's LIVE state leaf bytes (``nbytes`` metadata only,
+    never a device sync) — what memsan compares the prediction against."""
+    defaults = getattr(obj, "_defaults", None)
+    if not isinstance(defaults, dict):
+        return None
+    total = 0.0
+    seen = False
+    for name in defaults:
+        state_bytes = _live_state_bytes_by_name(obj, name)
+        if state_bytes is not None:
+            total += state_bytes
+            seen = True
+    return total if seen else None

@@ -1,0 +1,1589 @@
+"""Memory-footprint prover: closed-form per-class state-size cost model.
+
+The fourth prover in the ``_analysis`` stack (after trace-safety R1-R5,
+eligibility R6, concurrency R7-R9). It replays every Metric class's
+``__init__`` chain *symbolically* — pure AST interpretation, nothing is
+imported or executed — and derives, for each registered state, a byte
+formula polynomial in the constructor arguments (``num_classes``,
+``thresholds``, ``cat_state_capacity``, ...). Per-class totals land in the
+versioned ``memory.json`` manifest; the runtime consumes them for
+StreamPool admission control, SPMD per-device footprint telemetry, and the
+opt-in memory sanitizer (``memsan.py``).
+
+Two rules ride the model:
+
+- **R10 (unbounded-state-growth)**: an append-mode ``default=[]`` state with
+  no capacity bound grows O(updates); the finding names the
+  ``cat_state_capacity`` ring-buffer escape hatch and the per-update growth
+  term.
+- **R11 (footprint-blowup)**: a state's byte formula carries a super-linear
+  (degree >= 2) monomial in ctor args (O(C^2) confusion matrices,
+  O(thresholds x classes) curve states).
+
+Scaling laws (documented in ANALYSIS.md, applied by the consumers): a
+StreamPool stacks every per-stream state, so pool bytes =
+``(capacity + 1) * F``; the SPMD engine shards the stacked ``(world, ...)``
+states one replica row per device, so per-device bytes = ``F``.
+
+Anything the interpreter cannot resolve degrades gracefully to an explicit
+``opaque`` verdict carrying a ``path:line`` reason — never a wrong formula.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from torchmetrics_tpu._analysis.model import SourceInfo, Violation
+from torchmetrics_tpu._analysis.registry import ClassInfo, Registry
+
+MEMORY_VERSION = 1
+
+# ---------------------------------------------------------------------------
+# dtype widths under the runtime's default JAX config (x64 DISABLED): every
+# 64-bit request silently truncates to its 32-bit sibling, so the *honest*
+# static width for float64/int64/uint64 is 4 (and complex128 is 8)
+_DTYPE_BYTES: Dict[str, int] = {
+    "float32": 4, "float64": 4, "float_": 4, "double": 4,
+    "int32": 4, "int64": 4, "int_": 4, "long": 4,
+    "uint32": 4, "uint64": 4,
+    "float16": 2, "bfloat16": 2, "int16": 2, "uint16": 2,
+    "int8": 1, "uint8": 1, "bool": 1, "bool_": 1,
+    "complex64": 8, "complex128": 8,
+}
+
+# count leaf of a ring buffer: one int32 scalar
+_RING_COUNT_BYTES = 4
+
+
+def _dtype_width(name: str) -> int:
+    return _DTYPE_BYTES.get(name, 4)
+
+
+# ---------------------------------------------------------------------------
+# Poly: sparse multivariate polynomial with non-negative integer powers.
+# Monomial key = tuple of sorted (symbol, power) pairs; () is the constant.
+
+Monomial = Tuple[Tuple[str, int], ...]
+
+
+class Poly:
+    """Closed-form byte count, polynomial in ctor-arg symbols."""
+
+    __slots__ = ("terms",)
+
+    def __init__(self, terms: Optional[Dict[Monomial, float]] = None) -> None:
+        self.terms: Dict[Monomial, float] = {k: v for k, v in (terms or {}).items() if v != 0}
+
+    @staticmethod
+    def const(c: float) -> "Poly":
+        return Poly({(): float(c)})
+
+    @staticmethod
+    def sym(name: str) -> "Poly":
+        return Poly({((name, 1),): 1.0})
+
+    # ------------------------------------------------------------- predicates
+    def is_const(self) -> bool:
+        return all(k == () for k in self.terms)
+
+    def const_value(self) -> float:
+        return self.terms.get((), 0.0)
+
+    def degree(self) -> int:
+        return max((sum(p for _, p in mono) for mono in self.terms), default=0)
+
+    def symbols(self) -> Set[str]:
+        return {s for mono in self.terms for s, _ in mono}
+
+    # ------------------------------------------------------------ arithmetic
+    def __add__(self, other: "Poly") -> "Poly":
+        out = dict(self.terms)
+        for mono, c in other.terms.items():
+            out[mono] = out.get(mono, 0.0) + c
+        return Poly(out)
+
+    def __sub__(self, other: "Poly") -> "Poly":
+        return self + (other * Poly.const(-1))
+
+    def __mul__(self, other: "Poly") -> "Poly":
+        out: Dict[Monomial, float] = {}
+        for m1, c1 in self.terms.items():
+            for m2, c2 in other.terms.items():
+                powers: Dict[str, int] = {}
+                for s, p in m1 + m2:
+                    powers[s] = powers.get(s, 0) + p
+                mono = tuple(sorted(powers.items()))
+                out[mono] = out.get(mono, 0.0) + c1 * c2
+        return Poly(out)
+
+    # ----------------------------------------------------------------- output
+    def evaluate(self, env: Dict[str, float]) -> float:
+        total = 0.0
+        for mono, c in self.terms.items():
+            val = c
+            for s, p in mono:
+                val *= float(env[s]) ** p
+            total += val
+        return total
+
+    def _mono_render(self, mono: Monomial) -> str:
+        return "*".join(s if p == 1 else f"{s}^{p}" for s, p in mono)
+
+    def render(self) -> str:
+        if not self.terms:
+            return "0"
+        def fmt(c: float) -> str:
+            return str(int(c)) if float(c).is_integer() else f"{c:g}"
+        parts: List[str] = []
+        for mono in sorted(self.terms, key=lambda m: (-sum(p for _, p in m), m)):
+            c = self.terms[mono]
+            if mono == ():
+                parts.append(fmt(c))
+            elif c == 1:
+                parts.append(self._mono_render(mono))
+            else:
+                parts.append(f"{fmt(c)}*{self._mono_render(mono)}")
+        return " + ".join(parts)
+
+    def to_json(self) -> List[Dict[str, Any]]:
+        out = []
+        for mono in sorted(self.terms, key=lambda m: (-sum(p for _, p in m), m)):
+            out.append({"coeff": self.terms[mono], "vars": {s: p for s, p in mono}})
+        return out
+
+    @staticmethod
+    def from_json(terms: Sequence[Dict[str, Any]]) -> "Poly":
+        out: Dict[Monomial, float] = {}
+        for t in terms:
+            mono = tuple(sorted((str(s), int(p)) for s, p in t.get("vars", {}).items()))
+            out[mono] = out.get(mono, 0.0) + float(t["coeff"])
+        return Poly(out)
+
+    def _score(self) -> float:
+        """Dominance heuristic: evaluate at every symbol = 64."""
+        return self.evaluate({s: 64.0 for s in self.symbols()})
+
+    def __repr__(self) -> str:  # pragma: no cover - debug aid
+        return f"Poly({self.render()})"
+
+
+def ring_bytes(capacity: Poly, row_bytes: Poly) -> Poly:
+    """RingBuffer leaves: data (cap x row), valid (cap x 1 byte), count (4)."""
+    return capacity * row_bytes + capacity + Poly.const(_RING_COUNT_BYTES)
+
+
+def row_bytes_symbol(state: str) -> str:
+    """Reserved runtime-resolvable symbol: bytes of one appended row."""
+    return f"row_bytes({state})"
+
+
+# ---------------------------------------------------------------------------
+# interpreter value domain
+
+
+class _Unknown:
+    __slots__ = ("reason",)
+
+    def __init__(self, reason: str) -> None:
+        self.reason = reason
+
+
+class _ListDefault:
+    """The empty-list (append/cat-mode) state default."""
+
+    __slots__ = ()
+
+
+@dataclass(frozen=True)
+class _ArrayVal:
+    shape: Tuple[Poly, ...]
+    dtype: str
+
+    def nbytes(self) -> Poly:
+        total = Poly.const(_dtype_width(self.dtype))
+        for dim in self.shape:
+            total = total * dim
+        return total
+
+
+@dataclass(frozen=True)
+class _RingVal:
+    capacity: Poly
+
+
+@dataclass(frozen=True)
+class _LambdaVal:
+    node: ast.Lambda
+    frame: "_Frame"
+
+
+@dataclass(frozen=True)
+class _Either:
+    """Config-dependent value: ``a`` on the default path, ``b`` otherwise."""
+
+    a: Any
+    b: Any
+
+
+class _ListCtor:
+    """The ``list`` builtin bound as a value (``default, fx = list, "cat"``)."""
+
+    __slots__ = ()
+
+
+_LIST_CTOR = _ListCtor()
+
+
+class _OpaqueError(Exception):
+    """Evaluation gave up; carries the ``path:line`` reason."""
+
+    def __init__(self, reason: str, lineno: int = 0) -> None:
+        super().__init__(reason)
+        self.reason = reason
+        self.lineno = lineno
+
+
+@dataclass
+class _Frame:
+    """One function invocation: locals + shared self-attribute store."""
+
+    locals: Dict[str, Any]
+    self_attrs: Dict[str, Any]
+    cls: ClassInfo  # lexical class whose method body is executing
+    module: str  # module the executing code lives in (import resolution)
+    conditional: bool = False
+    method: str = "__init__"
+
+
+# ---------------------------------------------------------------------------
+# results
+
+
+@dataclass
+class StateRecord:
+    """One registered state with its derived byte formula."""
+
+    name: str
+    kind: str  # "array" | "list" | "ring" | "opaque"
+    dtype: Optional[str]
+    shape: Optional[Tuple[Poly, ...]]
+    bytes: Poly  # fixed footprint (0 for unbounded lists)
+    growth: Optional[Poly]  # per-update growth term (lists only)
+    conditional: bool
+    lineno: int
+    path: str
+    registered_in: str  # "ClassName.method" lexical scope of the call site
+    reduction: str
+    opaque_reason: Optional[str] = None
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "name": self.name,
+            "kind": self.kind,
+            "bytes": self.bytes.render(),
+            "terms": self.bytes.to_json(),
+            "conditional": self.conditional,
+            "line": self.lineno,
+            "path": self.path,
+            "registered_in": self.registered_in,
+            "reduction": self.reduction,
+        }
+        if self.dtype is not None:
+            out["dtype"] = self.dtype
+        if self.shape is not None:
+            out["shape"] = [d.render() for d in self.shape]
+        if self.growth is not None:
+            out["growth_per_update"] = self.growth.render()
+            out["bounded_bytes"] = ring_bytes(
+                Poly.sym("cat_state_capacity"), Poly.sym(row_bytes_symbol(self.name))
+            ).render()
+        if self.opaque_reason is not None:
+            out["opaque_reason"] = self.opaque_reason
+        return out
+
+
+@dataclass
+class ClassMemory:
+    """Per-class verdict + closed-form byte formula."""
+
+    qualname: str
+    path: str
+    line: int
+    public: bool
+    verdict: str  # "bounded" | "unbounded" | "opaque"
+    states: List[StateRecord] = field(default_factory=list)
+    total: Poly = field(default_factory=lambda: Poly.const(0))
+    bounded_total: Optional[Poly] = None  # unbounded classes, given capacity
+    peak_factor: float = 1.0
+    opaque_reason: Optional[str] = None
+
+    @property
+    def symbols(self) -> Set[str]:
+        syms = set(self.total.symbols())
+        for rec in self.states:
+            syms |= rec.bytes.symbols()
+            if rec.growth is not None:
+                syms |= rec.growth.symbols()
+        if self.bounded_total is not None:
+            syms |= self.bounded_total.symbols()
+        return syms
+
+    @property
+    def unbounded_states(self) -> List[str]:
+        return [r.name for r in self.states if r.kind == "list" and not r.conditional]
+
+    @property
+    def conditional_unbounded_states(self) -> List[str]:
+        return [r.name for r in self.states if r.kind == "list" and r.conditional]
+
+    def to_json(self) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "path": self.path,
+            "line": self.line,
+            "verdict": self.verdict,
+            "symbols": sorted(self.symbols),
+            "total_bytes": self.total.render(),
+            "total_terms": self.total.to_json(),
+            "peak_factor": self.peak_factor,
+            "states": [r.to_json() for r in self.states],
+        }
+        if self.bounded_total is not None:
+            out["bounded_total_bytes"] = self.bounded_total.render()
+            out["bounded_total_terms"] = self.bounded_total.to_json()
+        if self.unbounded_states:
+            out["unbounded_states"] = self.unbounded_states
+        if self.conditional_unbounded_states:
+            out["conditional_unbounded_states"] = self.conditional_unbounded_states
+        if self.opaque_reason is not None:
+            out["opaque_reason"] = self.opaque_reason
+        return out
+
+
+def memory_to_json(memory: Dict[str, "ClassMemory"]) -> Dict[str, Any]:
+    """Versioned manifest payload: every PUBLIC metric class's formula."""
+    return {
+        "version": MEMORY_VERSION,
+        "classes": {
+            qual: mem.to_json() for qual, mem in sorted(memory.items()) if mem.public
+        },
+    }
+
+
+# ---------------------------------------------------------------------------
+# the symbolic interpreter
+
+_ARRAY_MODULES = {"jnp", "np", "numpy", "jax"}
+_EVAL_FUEL = 20000
+_MAX_CALL_DEPTH = 10
+_MAX_UNROLL = 16
+
+
+def _literal_dtype(node: ast.expr) -> str:
+    """Dtype jnp.array() infers for a python literal (x64 disabled)."""
+    saw_float = saw_int = saw_bool = False
+    for sub in ast.walk(node):
+        if isinstance(sub, ast.Constant):
+            if isinstance(sub.value, bool):
+                saw_bool = True
+            elif isinstance(sub.value, int):
+                saw_int = True
+            elif isinstance(sub.value, float):
+                saw_float = True
+    if saw_float:
+        return "float32"
+    if saw_int:
+        return "int32"
+    if saw_bool:
+        return "bool"
+    return "float32"
+
+
+def _dtype_from_attr(node: ast.expr) -> Optional[str]:
+    """``jnp.int32`` / ``np.bool_`` / bare ``int``/``float``/``bool`` -> name."""
+    if isinstance(node, ast.Attribute) and node.attr in _DTYPE_BYTES:
+        return node.attr
+    if isinstance(node, ast.Name):
+        return {"int": "int32", "float": "float32", "bool": "bool"}.get(node.id)
+    if isinstance(node, ast.Constant) and isinstance(node.value, str) and node.value in _DTYPE_BYTES:
+        return node.value
+    return None
+
+
+def _is_array_module_attr(func: ast.expr) -> Optional[str]:
+    """``jnp.zeros`` / ``np.full`` -> the builder name, else None."""
+    if isinstance(func, ast.Attribute):
+        base = func.value
+        if isinstance(base, ast.Name) and base.id in _ARRAY_MODULES:
+            return func.attr
+        # jax.numpy.zeros style
+        if isinstance(base, ast.Attribute) and base.attr == "numpy":
+            return func.attr
+    return None
+
+
+class _ChainEvaluator:
+    """Replay one class's ``__init__`` chain symbolically."""
+
+    def __init__(self, registry: Registry, leaf: ClassInfo) -> None:
+        self.registry = registry
+        self.leaf = leaf
+        self.chain, self.reaches_metric, self.fully_resolved = registry.chain(leaf)
+        self.states: List[StateRecord] = []
+        self.cat_capacity: Optional[Any] = None  # value bound to cat_state_capacity
+        self.fuel = _EVAL_FUEL
+        self.depth = 0
+
+    # ---------------------------------------------------------------- helpers
+    def _burn(self, node: Optional[ast.AST] = None) -> None:
+        self.fuel -= 1
+        if self.fuel <= 0:
+            raise _OpaqueError(
+                "evaluation budget exceeded", getattr(node, "lineno", 0)
+            )
+
+    def _site(self, frame: _Frame, lineno: int) -> str:
+        return f"{frame.cls.path}:{lineno}"
+
+    def _pick(self, value: Any) -> Any:
+        """Resolve an Either to its dominant (bigger-footprint) alternative."""
+        if isinstance(value, _Either):
+            a, b = self._pick(value.a), self._pick(value.b)
+            pa, pb = isinstance(a, Poly), isinstance(b, Poly)
+            if pa and pb:
+                return a if a._score() >= b._score() else b
+            return a if a is not None else b
+        return value
+
+    # ----------------------------------------------------------- entry point
+    def run(self) -> None:
+        init = self._find_init(0)
+        if init is None:
+            return  # no __init__ anywhere in the scanned chain: no own states
+        idx, cls, func = init
+        frame = _Frame(locals={}, self_attrs={}, cls=cls, module=cls.module)
+        self._bind_params(func, frame, args=[], keywords={}, symbolic=True)
+        self._exec_block(func.body, frame, chain_idx=idx)
+
+    def _find_init(self, start: int) -> Optional[Tuple[int, ClassInfo, ast.FunctionDef]]:
+        for i in range(start, len(self.chain)):
+            cls = self.chain[i]
+            if "__init__" in cls.methods:
+                return i, cls, cls.methods["__init__"]
+        return None
+
+    # ---------------------------------------------------------- param binding
+    def _bind_params(
+        self,
+        func: ast.FunctionDef,
+        frame: _Frame,
+        args: List[Any],
+        keywords: Dict[str, Any],
+        symbolic: bool,
+        extra_kwargs: Optional[Dict[str, Any]] = None,
+    ) -> None:
+        """Bind call arguments (or, for the leaf ``__init__``, symbols).
+
+        ``symbolic=True`` is the leaf entry: parameters become symbols named
+        after themselves, EXCEPT ``None``-defaulted parameters (bound to
+        ``None`` — the out-of-the-box config, matching the analyzer's
+        ``thresholds=None`` branch idiom) and str/bool-defaulted parameters
+        (bound to their literal default so config ``if``s stay decidable).
+        """
+        params = list(func.args.posonlyargs) + list(func.args.args)
+        defaults: Dict[str, ast.expr] = {}
+        pos_defaults = list(func.args.defaults)
+        for p, d in zip(params[len(params) - len(pos_defaults):], pos_defaults):
+            defaults[p.arg] = d
+        for p, d in zip(func.args.kwonlyargs, func.args.kw_defaults):
+            if d is not None:
+                defaults[p.arg] = d
+        names = [p.arg for p in params if p.arg != "self"]
+        names += [p.arg for p in func.args.kwonlyargs]
+        kwargs_pool = dict(extra_kwargs or {})
+        pos = list(args)
+        for name in names:
+            if pos:
+                frame.locals[name] = pos.pop(0)
+                continue
+            if name in keywords:
+                frame.locals[name] = keywords.pop(name)
+                continue
+            if name in kwargs_pool:
+                frame.locals[name] = kwargs_pool.pop(name)
+                continue
+            default = defaults.get(name)
+            if symbolic:
+                frame.locals[name] = self._symbolize(name, default, frame)
+            elif default is not None:
+                frame.locals[name] = self._eval(default, frame)
+            else:
+                frame.locals[name] = _Unknown(f"unbound parameter `{name}`")
+        # surplus keywords flow into **kwargs (Metric kwargs chain)
+        if func.args.kwarg is not None:
+            kwargs_pool.update(keywords)
+            frame.locals[func.args.kwarg.arg] = kwargs_pool
+        elif keywords:
+            # keywords the signature does not accept: tolerated (validation
+            # helpers aside, super().__init__ chains always accept **kwargs)
+            pass
+
+    def _symbolize(self, name: str, default: Optional[ast.expr], frame: _Frame) -> Any:
+        if default is not None and isinstance(default, ast.Constant):
+            v = default.value
+            if v is None or isinstance(v, (str, bool)):
+                return v
+        return Poly.sym(name)
+
+    # ------------------------------------------------------------- statements
+    def _exec_block(self, stmts: Sequence[ast.stmt], frame: _Frame, chain_idx: int = 0) -> None:
+        for stmt in stmts:
+            self._burn(stmt)
+            if isinstance(stmt, ast.Assign):
+                try:
+                    value = self._eval(stmt.value, frame)
+                except _OpaqueError as err:
+                    value = _Unknown(err.reason)
+                for tgt in stmt.targets:
+                    self._assign(tgt, value, frame)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                try:
+                    value = self._eval(stmt.value, frame)
+                except _OpaqueError as err:
+                    value = _Unknown(err.reason)
+                self._assign(stmt.target, value, frame)
+            elif isinstance(stmt, ast.AugAssign):
+                try:
+                    cur = self._eval_target_value(stmt.target, frame)
+                    inc = self._eval(stmt.value, frame)
+                    value = self._binop_values(type(stmt.op), cur, inc)
+                except _OpaqueError as err:
+                    value = _Unknown(err.reason)
+                self._assign(stmt.target, value, frame)
+            elif isinstance(stmt, ast.Expr):
+                self._exec_expr_stmt(stmt.value, frame)
+            elif isinstance(stmt, ast.If):
+                self._exec_if(stmt, frame)
+            elif isinstance(stmt, ast.For):
+                self._exec_for(stmt, frame)
+            elif isinstance(stmt, (ast.With,)):
+                self._exec_block(stmt.body, frame)
+            elif isinstance(stmt, ast.Try):
+                self._exec_block(stmt.body, frame)
+                for handler in stmt.handlers:
+                    self._exec_block(handler.body, self._fork(frame, conditional=True))
+                self._exec_block(stmt.orelse, frame)
+                self._exec_block(stmt.finalbody, frame)
+            elif isinstance(stmt, ast.Return):
+                value = None if stmt.value is None else self._eval(stmt.value, frame)
+                raise _Return(value)
+            # Raise / Assert / Pass / Import / While / nested defs: no state
+            # registration can hide there that we could still prove — skip
+
+    def _exec_expr_stmt(self, call: ast.expr, frame: _Frame) -> None:
+        """Bare expression statement: only self-method / super / add_state
+        calls can register states; module-function calls (validation helpers)
+        are side-effect-free for the memory model and are skipped."""
+        if not isinstance(call, ast.Call):
+            return
+        fn = call.func
+        is_super = (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Call)
+            and isinstance(fn.value.func, ast.Name)
+            and fn.value.func.id == "super"
+        )
+        is_self_method = (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Name)
+            and fn.value.id == "self"
+        )
+        if not (is_super or is_self_method):
+            return
+        try:
+            self._eval(call, frame)
+        except _Return:  # pragma: no cover - defensive
+            pass
+        except _OpaqueError as err:
+            # a helper we could not follow MAY have registered states: an
+            # honest model must say so rather than silently under-count
+            if is_self_method and fn.attr != "add_state":
+                self._record_opaque(
+                    f"?{fn.attr}", frame, call.lineno,
+                    f"helper call `self.{fn.attr}(...)` not resolvable: {err.reason}",
+                )
+
+    def _assign(self, tgt: ast.expr, value: Any, frame: _Frame) -> None:
+        if isinstance(tgt, ast.Name):
+            frame.locals[tgt.id] = value
+        elif isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            frame.self_attrs[tgt.attr] = value
+        elif isinstance(tgt, (ast.Tuple, ast.List)):
+            value = self._pick(value)
+            vals = list(value) if isinstance(value, tuple) and len(value) == len(tgt.elts) else None
+            for i, elt in enumerate(tgt.elts):
+                self._assign(elt, vals[i] if vals is not None else _Unknown("tuple unpack"), frame)
+        # subscript targets etc: ignored
+
+    def _eval_target_value(self, tgt: ast.expr, frame: _Frame) -> Any:
+        if isinstance(tgt, ast.Name):
+            return frame.locals.get(tgt.id, _Unknown(f"name `{tgt.id}`"))
+        if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) and tgt.value.id == "self":
+            return frame.self_attrs.get(tgt.attr, _Unknown(f"self.{tgt.attr}"))
+        return _Unknown("augmented target")
+
+    def _fork(self, frame: _Frame, conditional: bool) -> _Frame:
+        return _Frame(
+            locals=dict(frame.locals),
+            self_attrs=dict(frame.self_attrs),
+            cls=frame.cls,
+            module=frame.module,
+            conditional=frame.conditional or conditional,
+            method=frame.method,
+        )
+
+    def _merge_forks(self, frame: _Frame, fa: _Frame, fb: _Frame) -> None:
+        for store, sa, sb in (
+            (frame.locals, fa.locals, fb.locals),
+            (frame.self_attrs, fa.self_attrs, fb.self_attrs),
+        ):
+            for key in set(sa) | set(sb):
+                va = sa.get(key, store.get(key))
+                vb = sb.get(key, store.get(key))
+                store[key] = va if _same(va, vb) else _Either(va, vb)
+
+    def _exec_if(self, stmt: ast.If, frame: _Frame) -> None:
+        verdict, true_bind, false_bind = self._decide(stmt.test, frame)
+        if verdict is True:
+            for k, v in true_bind.items():
+                frame.locals[k] = v
+            self._exec_block(stmt.body, frame)
+            alt = self._fork(frame, conditional=True)
+            alt.locals.update(false_bind)
+            self._exec_block(stmt.orelse, alt)
+        elif verdict is False:
+            for k, v in false_bind.items():
+                frame.locals[k] = v
+            self._exec_block(stmt.orelse, frame)
+            alt = self._fork(frame, conditional=True)
+            alt.locals.update(true_bind)
+            self._exec_block(stmt.body, alt)
+        else:
+            fa = self._fork(frame, conditional=True)
+            fa.locals.update(true_bind)
+            fb = self._fork(frame, conditional=True)
+            fb.locals.update(false_bind)
+            self._exec_block(stmt.body, fa)
+            self._exec_block(stmt.orelse, fb)
+            self._merge_forks(frame, fa, fb)
+
+    def _exec_for(self, stmt: ast.For, frame: _Frame) -> None:
+        try:
+            seq = self._pick(self._eval(stmt.iter, frame))
+        except _OpaqueError:
+            seq = None
+        if (
+            isinstance(seq, tuple)
+            and len(seq) <= _MAX_UNROLL
+            and isinstance(stmt.target, ast.Name)
+            and all(not isinstance(v, _Unknown) for v in seq)
+        ):
+            for item in seq:
+                frame.locals[stmt.target.id] = item
+                self._exec_block(stmt.body, frame)
+        else:
+            if isinstance(stmt.target, ast.Name):
+                frame.locals[stmt.target.id] = _Unknown("loop variable")
+            self._exec_block(stmt.body, frame)
+        self._exec_block(stmt.orelse, frame)
+
+    # --------------------------------------------------------------- branches
+    def _decide(self, test: ast.expr, frame: _Frame) -> Tuple[Optional[bool], Dict[str, Any], Dict[str, Any]]:
+        """Statically decide a config ``if``.
+
+        Returns ``(verdict, true_bindings, false_bindings)``: verdict None
+        means undecidable (both branches run as conditional); bindings refine
+        names inside the respective branch (the ``Either(None, array)``
+        threshold idiom binds the array alternative in the else branch).
+        """
+        self._burn(test)
+        if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+            verdict, tb, fb = self._decide(test.operand, frame)
+            return (None if verdict is None else not verdict), fb, tb
+        if isinstance(test, ast.BoolOp):
+            verdicts = [self._decide(v, frame)[0] for v in test.values]
+            if all(v is not None for v in verdicts):
+                if isinstance(test.op, ast.And):
+                    return all(verdicts), {}, {}
+                return any(verdicts), {}, {}
+            return None, {}, {}
+        if isinstance(test, ast.Compare) and len(test.ops) == 1:
+            try:
+                left = self._eval(test.left, frame)
+                right = self._eval(test.comparators[0], frame)
+            except _OpaqueError:
+                return None, {}, {}
+            op = test.ops[0]
+            name = test.left.id if isinstance(test.left, ast.Name) else None
+            # `x is None` on the Either(None, alt) threshold idiom: the None
+            # side IS the default config; the else branch sees the alternative
+            if isinstance(left, _Either) and right is None and left.a is None:
+                if isinstance(op, ast.Is):
+                    return True, {}, ({name: left.b} if name else {})
+                if isinstance(op, ast.IsNot):
+                    return False, ({name: left.b} if name else {}), {}
+            left, right = self._pick(left), self._pick(right)
+            lc, rc = _concrete(left), _concrete(right)
+            if lc is not _UNDECIDED and rc is not _UNDECIDED:
+                if isinstance(op, (ast.Is, ast.Eq)):
+                    return lc == rc, {}, {}
+                if isinstance(op, (ast.IsNot, ast.NotEq)):
+                    return lc != rc, {}, {}
+                if isinstance(op, ast.In) and isinstance(rc, tuple):
+                    return lc in rc, {}, {}
+                if isinstance(op, ast.NotIn) and isinstance(rc, tuple):
+                    return lc not in rc, {}, {}
+                try:
+                    if isinstance(op, ast.Gt):
+                        return lc > rc, {}, {}
+                    if isinstance(op, ast.GtE):
+                        return lc >= rc, {}, {}
+                    if isinstance(op, ast.Lt):
+                        return lc < rc, {}, {}
+                    if isinstance(op, ast.LtE):
+                        return lc <= rc, {}, {}
+                except TypeError:
+                    return None, {}, {}
+            # `x is None` where x evaluated to a non-None model value: decided
+            if right is None and isinstance(op, (ast.Is, ast.IsNot)):
+                if left is None:
+                    return isinstance(op, ast.Is), {}, {}
+                if isinstance(left, (_ArrayVal, _ListDefault, _RingVal, tuple, str, bool, Poly)):
+                    return isinstance(op, ast.IsNot), {}, {}
+            return None, {}, {}
+        if isinstance(test, (ast.Compare, ast.BoolOp)):
+            # multi-op chains (`a < b < c`) are undecidable here; evaluating
+            # them would bounce back through `_eval`'s Compare branch forever
+            return None, {}, {}
+        try:
+            value = self._pick(self._eval(test, frame))
+        except _OpaqueError:
+            return None, {}, {}
+        if isinstance(value, bool):
+            return value, {}, {}
+        if value is None:
+            return False, {}, {}
+        return None, {}, {}
+
+    # ------------------------------------------------------------ expressions
+    def _eval(self, node: ast.expr, frame: _Frame) -> Any:
+        self._burn(node)
+        if isinstance(node, ast.Constant):
+            v = node.value
+            if isinstance(v, bool) or v is None or isinstance(v, (str, bytes)):
+                return v
+            if isinstance(v, (int, float)):
+                return Poly.const(v)
+            return _Unknown(f"constant {v!r}")
+        if isinstance(node, ast.Name):
+            if node.id in frame.locals:
+                return frame.locals[node.id]
+            if node.id == "list":
+                return _LIST_CTOR
+            return _Unknown(f"name `{node.id}`")
+        if isinstance(node, ast.Attribute):
+            return self._eval_attribute(node, frame)
+        if isinstance(node, ast.Tuple):
+            return tuple(self._eval(e, frame) for e in node.elts)
+        if isinstance(node, ast.List):
+            if not node.elts:
+                return _ListDefault()
+            return tuple(self._eval(e, frame) for e in node.elts)
+        if isinstance(node, ast.BinOp):
+            left = self._pick(self._eval(node.left, frame))
+            right = self._pick(self._eval(node.right, frame))
+            return self._binop_values(type(node.op), left, right)
+        if isinstance(node, ast.UnaryOp):
+            operand = self._pick(self._eval(node.operand, frame))
+            if isinstance(node.op, ast.USub) and isinstance(operand, Poly):
+                return operand * Poly.const(-1)
+            if isinstance(node.op, ast.Not) and isinstance(operand, bool):
+                return not operand
+            return _Unknown("unary op")
+        if isinstance(node, ast.IfExp):
+            verdict, tb, fb = self._decide(node.test, frame)
+            if verdict is True:
+                return self._eval(node.body, frame)
+            if verdict is False:
+                return self._eval(node.orelse, frame)
+            try:
+                a = self._eval(node.body, frame)
+            except _OpaqueError as err:
+                a = _Unknown(err.reason)
+            try:
+                b = self._eval(node.orelse, frame)
+            except _OpaqueError as err:
+                b = _Unknown(err.reason)
+            return _Either(a, b)
+        if isinstance(node, ast.Subscript):
+            value = self._pick(self._eval(node.value, frame))
+            if isinstance(node.slice, ast.Slice):
+                return _Unknown("slice")
+            index = self._pick(self._eval(node.slice, frame))
+            if isinstance(value, tuple) and isinstance(index, Poly) and index.is_const():
+                i = int(index.const_value())
+                if -len(value) <= i < len(value):
+                    return value[i]
+            return _Unknown("subscript")
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, frame)
+        if isinstance(node, ast.Lambda):
+            return _LambdaVal(node, frame)
+        if isinstance(node, ast.Compare):
+            verdict, _, _ = self._decide(node, frame)
+            return verdict if verdict is not None else _Unknown("comparison")
+        if isinstance(node, ast.BoolOp):
+            verdict, _, _ = self._decide(node, frame)
+            return verdict if verdict is not None else _Unknown("bool op")
+        if isinstance(node, ast.JoinedStr):
+            return _Unknown("f-string")
+        return _Unknown(type(node).__name__)
+
+    def _eval_attribute(self, node: ast.Attribute, frame: _Frame) -> Any:
+        base = node.value
+        if isinstance(base, ast.Name):
+            if base.id == "self":
+                if node.attr in frame.self_attrs:
+                    return frame.self_attrs[node.attr]
+                return _Unknown(f"self.{node.attr} untracked")
+            if base.id in _ARRAY_MODULES and base.id not in frame.locals:
+                if node.attr in _DTYPE_BYTES:
+                    return node.attr  # dtype object used as a value
+                if node.attr == "inf":
+                    return Poly.const(float("inf"))
+                if node.attr == "nan":
+                    return Poly.const(float("nan"))
+                if node.attr == "pi":
+                    return Poly.const(3.141592653589793)
+                return _Unknown(f"{base.id}.{node.attr}")
+        value = self._pick(self._eval(base, frame))
+        if isinstance(value, _ArrayVal):
+            if node.attr == "shape":
+                return value.shape
+            if node.attr == "dtype":
+                return value.dtype
+            if node.attr == "size":
+                total = Poly.const(1)
+                for d in value.shape:
+                    total = total * d
+                return total
+            if node.attr == "ndim":
+                return Poly.const(len(value.shape))
+        return _Unknown(f"attribute `{node.attr}`")
+
+    def _binop_values(self, op: type, left: Any, right: Any) -> Any:
+        if isinstance(left, Poly) and isinstance(right, Poly):
+            if op is ast.Add:
+                return left + right
+            if op is ast.Sub:
+                return left - right
+            if op is ast.Mult:
+                return left * right
+            if op in (ast.Div, ast.FloorDiv):
+                if right.is_const() and right.const_value() not in (0, 0.0):
+                    return left * Poly.const(1.0 / right.const_value())
+                return _Unknown("symbolic division")
+            if op is ast.Pow and right.is_const() and float(right.const_value()).is_integer():
+                out = Poly.const(1)
+                for _ in range(int(right.const_value())):
+                    out = out * left
+                return out
+            return _Unknown("binary op")
+        if isinstance(left, str) and isinstance(right, str) and op is ast.Add:
+            return left + right
+        if isinstance(left, tuple) and isinstance(right, tuple) and op is ast.Add:
+            return left + right
+        if isinstance(left, tuple) and isinstance(right, Poly) and right.is_const() and op is ast.Mult:
+            return left * int(right.const_value())
+        return _Unknown("binary op")
+
+    # ------------------------------------------------------------------ calls
+    def _call_kwargs(self, node: ast.Call, frame: _Frame) -> Tuple[List[Any], Dict[str, Any], Dict[str, Any]]:
+        """Evaluate call arguments; ``**kwargs`` spreads merge into a pool."""
+        args: List[Any] = []
+        for a in node.args:
+            if isinstance(a, ast.Starred):
+                spread = self._pick(self._eval(a.value, frame))
+                args.extend(spread if isinstance(spread, tuple) else [_Unknown("*args")])
+            else:
+                args.append(self._eval(a, frame))
+        keywords: Dict[str, Any] = {}
+        pool: Dict[str, Any] = {}
+        for kw in node.keywords:
+            if kw.arg is None:
+                spread = self._pick(self._eval(kw.value, frame))
+                if isinstance(spread, dict):
+                    pool.update(spread)
+            else:
+                keywords[kw.arg] = self._eval(kw.value, frame)
+        return args, keywords, pool
+
+    def _eval_call(self, node: ast.Call, frame: _Frame) -> Any:
+        fn = node.func
+        # super().__init__(...)
+        if (
+            isinstance(fn, ast.Attribute)
+            and isinstance(fn.value, ast.Call)
+            and isinstance(fn.value.func, ast.Name)
+            and fn.value.func.id == "super"
+        ):
+            if fn.attr == "__init__":
+                return self._call_super(node, frame)
+            return _Unknown(f"super().{fn.attr}")
+        # self.<method>(...)
+        if isinstance(fn, ast.Attribute) and isinstance(fn.value, ast.Name) and fn.value.id == "self":
+            if fn.attr == "add_state":
+                self._record_add_state(node, frame)
+                return None
+            return self._call_self_method(fn.attr, node, frame)
+        # jnp.zeros / np.full / ...
+        builder = _is_array_module_attr(fn)
+        if builder is not None:
+            return self._array_builder(builder, node, frame)
+        # plain-name calls: builtins, lambdas, module functions
+        if isinstance(fn, ast.Name):
+            return self._call_name(fn.id, node, frame)
+        # value-call (e.g. a method-held lambda): evaluate the callee
+        try:
+            callee = self._pick(self._eval(fn, frame))
+        except _OpaqueError:
+            return _Unknown("call target")
+        return self._call_value(callee, node, frame)
+
+    def _call_value(self, callee: Any, node: ast.Call, frame: _Frame) -> Any:
+        if isinstance(callee, _LambdaVal):
+            inner = self._fork(callee.frame, conditional=frame.conditional)
+            lam = callee.node
+            args, keywords, _ = self._call_kwargs(node, frame)
+            params = [p.arg for p in lam.args.args]
+            for name, val in zip(params, args):
+                inner.locals[name] = val
+            inner.locals.update(keywords)
+            return self._eval(lam.body, inner)
+        if isinstance(callee, _ListCtor):
+            return _ListDefault()
+        return _Unknown("uncallable value")
+
+    def _call_name(self, name: str, node: ast.Call, frame: _Frame) -> Any:
+        if name in frame.locals:
+            return self._call_value(self._pick(frame.locals[name]), node, frame)
+        args, keywords, _ = self._call_kwargs(node, frame)
+        picked = [self._pick(a) for a in args]
+        if name == "len":
+            if picked and isinstance(picked[0], tuple):
+                return Poly.const(len(picked[0]))
+            if picked and isinstance(picked[0], (str, bytes)):
+                return Poly.const(len(picked[0]))
+            if picked and isinstance(picked[0], _ListDefault):
+                return Poly.const(0)
+            # `len(<ctor arg>)` / `len(self.<attr>)` of a symbolic collection:
+            # a derived symbol the runtime resolves against the live instance
+            arg0 = node.args[0] if node.args else None
+            if isinstance(arg0, ast.Name):
+                return Poly.sym(f"len({arg0.id})")
+            if (
+                isinstance(arg0, ast.Attribute)
+                and isinstance(arg0.value, ast.Name)
+                and arg0.value.id == "self"
+            ):
+                return Poly.sym(f"len({arg0.attr})")
+            return _Unknown("len of symbolic value")
+        if name in ("int", "float"):
+            if picked and isinstance(picked[0], Poly):
+                return picked[0]
+            if picked and isinstance(picked[0], str):
+                try:
+                    return Poly.const(float(picked[0]))
+                except ValueError:
+                    return _Unknown("int()/float() of str")
+            return _Unknown(f"{name}() of model value")
+        if name in ("max", "min") and len(picked) >= 2 and all(isinstance(p, Poly) for p in picked):
+            consts = [p for p in picked if p.is_const()]
+            if len(consts) == len(picked):
+                vals = [p.const_value() for p in picked]
+                return Poly.const(max(vals) if name == "max" else min(vals))
+            # symbolic max: the dominance pick (upper-bound flavored)
+            return max(picked, key=lambda p: p._score()) if name == "max" else min(picked, key=lambda p: p._score())
+        if name == "tuple" and picked and isinstance(picked[0], tuple):
+            return picked[0]
+        if name == "list":
+            if not picked:
+                return _ListDefault()
+            return picked[0] if isinstance(picked[0], tuple) else _Unknown("list(x)")
+        if name == "RingBuffer" and picked and isinstance(picked[0], Poly):
+            return _RingVal(capacity=picked[0])
+        if name == "_adjust_threshold_arg":
+            # pervasive classification helper: None passes through (the list
+            # path), an int/list/array becomes the (T,) threshold grid whose
+            # length is the `thresholds` ctor symbol
+            arg = picked[0] if picked else None
+            if arg is None:
+                return _Either(None, _ArrayVal((Poly.sym("thresholds"),), "float32"))
+            if isinstance(arg, Poly):
+                return _ArrayVal((Poly.sym("thresholds"),), "float32")
+            if isinstance(arg, _ArrayVal):
+                return arg
+            if isinstance(arg, _Either):
+                return arg
+            return _Unknown("threshold arg")
+        resolved = self.registry.resolve_function(frame.module, name)
+        if resolved is not None:
+            owner_mod, func = resolved
+            return self._call_function(func, owner_mod.module if hasattr(owner_mod, "module") else frame.module, args, keywords, frame)
+        return _Unknown(f"call `{name}`")
+
+    def _call_function(
+        self,
+        func: ast.FunctionDef,
+        module: str,
+        args: List[Any],
+        keywords: Dict[str, Any],
+        caller: _Frame,
+    ) -> Any:
+        if self.depth >= _MAX_CALL_DEPTH:
+            raise _OpaqueError("call depth exceeded", func.lineno)
+        inner = _Frame(
+            locals={}, self_attrs=caller.self_attrs, cls=caller.cls,
+            module=module, conditional=caller.conditional, method=func.name,
+        )
+        self._bind_params(func, inner, args=args, keywords=dict(keywords), symbolic=False)
+        self.depth += 1
+        try:
+            self._exec_block(func.body, inner)
+        except _Return as ret:
+            return ret.value
+        finally:
+            self.depth -= 1
+        return None
+
+    def _call_self_method(self, attr: str, node: ast.Call, frame: _Frame) -> Any:
+        resolved = self.registry.resolve_method(self.leaf, attr)
+        if resolved is None:
+            raise _OpaqueError(f"method `self.{attr}` not found on chain", node.lineno)
+        owner, func = resolved
+        if self.depth >= _MAX_CALL_DEPTH:
+            raise _OpaqueError("call depth exceeded", node.lineno)
+        args, keywords, pool = self._call_kwargs(node, frame)
+        inner = _Frame(
+            locals={}, self_attrs=frame.self_attrs, cls=owner,
+            module=owner.module, conditional=frame.conditional, method=attr,
+        )
+        self._bind_params(func, inner, args=args, keywords=keywords, symbolic=False, extra_kwargs=pool)
+        self.depth += 1
+        try:
+            self._exec_block(func.body, inner)
+        except _Return as ret:
+            return ret.value
+        finally:
+            self.depth -= 1
+        return None
+
+    def _call_super(self, node: ast.Call, frame: _Frame) -> Any:
+        # position of the class whose method body is executing
+        idx = next((i for i, c in enumerate(self.chain) if c.qualname == frame.cls.qualname), 0)
+        args, keywords, pool = self._call_kwargs(node, frame)
+        nxt = self._find_init(idx + 1)
+        if nxt is None:
+            # bottomed out at the trusted Metric base: it registers no states,
+            # but it CONSUMES cat_state_capacity — the per-instance bound that
+            # turns every cat-list state into a ring buffer
+            cap = keywords.get("cat_state_capacity", pool.get("cat_state_capacity"))
+            if cap is not None and not isinstance(cap, _Unknown):
+                self.cat_capacity = self._pick(cap)
+            return None
+        nidx, ncls, nfunc = nxt
+        inner = _Frame(
+            locals={}, self_attrs=frame.self_attrs, cls=ncls,
+            module=ncls.module, conditional=frame.conditional, method="__init__",
+        )
+        self._bind_params(nfunc, inner, args=args, keywords=keywords, symbolic=False, extra_kwargs=pool)
+        self.depth += 1
+        try:
+            self._exec_block(nfunc.body, inner, chain_idx=nidx)
+        except _Return:
+            pass
+        finally:
+            self.depth -= 1
+        return None
+
+    # --------------------------------------------------------- array builders
+    def _dtype_arg(self, node: ast.Call, frame: _Frame, positional: Optional[int]) -> Optional[str]:
+        """Resolve a builder's dtype argument (keyword first, then positional)."""
+        for kw in node.keywords:
+            if kw.arg == "dtype":
+                d = _dtype_from_attr(kw.value)
+                if d is not None:
+                    return d
+                v = self._pick(self._eval(kw.value, frame))
+                return v if isinstance(v, str) and v in _DTYPE_BYTES else None
+        if positional is not None and len(node.args) > positional:
+            arg = node.args[positional]
+            d = _dtype_from_attr(arg)
+            if d is not None:
+                return d
+            v = self._pick(self._eval(arg, frame))
+            return v if isinstance(v, str) and v in _DTYPE_BYTES else None
+        return None
+
+    def _shape_of(self, value: Any, node: ast.AST) -> Tuple[Poly, ...]:
+        """Normalize an evaluated shape argument to a tuple of Polys.
+
+        ``Either`` alternatives pick the LARGER shape (product scored with all
+        symbols at 64) — the model is an upper bound, so `() if size == 1 else
+        (size,)` must resolve to ``(size,)`` when ``size`` is symbolic.
+        """
+        if isinstance(value, _Either):
+            try:
+                a = self._shape_of(value.a, node)
+            except _OpaqueError:
+                a = None
+            try:
+                b = self._shape_of(value.b, node)
+            except _OpaqueError:
+                b = None
+            if a is None and b is None:
+                raise _OpaqueError("undecidable shape", getattr(node, "lineno", 0))
+            if a is None:
+                return b
+            if b is None:
+                return a
+
+            def score(shape: Tuple[Poly, ...]) -> float:
+                total = Poly.const(1)
+                for d in shape:
+                    total = total * d
+                return total._score()
+
+            return a if score(a) >= score(b) else b
+        if isinstance(value, tuple):
+            dims = []
+            for d in value:
+                d = self._pick(d)
+                if not isinstance(d, Poly):
+                    raise _OpaqueError("non-numeric shape dimension", getattr(node, "lineno", 0))
+                dims.append(d)
+            return tuple(dims)
+        if isinstance(value, Poly):
+            return (value,)
+        raise _OpaqueError("unresolvable shape argument", getattr(node, "lineno", 0))
+
+    def _array_builder(self, builder: str, node: ast.Call, frame: _Frame) -> Any:
+        lineno = node.lineno
+        if builder in ("zeros", "ones", "empty", "full"):
+            if not node.args:
+                raise _OpaqueError(f"`{builder}` with no shape", lineno)
+            shape = self._shape_of(self._eval(node.args[0], frame), node)
+            dtype_pos = 1 if builder != "full" else 2
+            dtype = self._dtype_arg(node, frame, dtype_pos) or "float32"
+            return _ArrayVal(shape, dtype)
+        if builder in ("zeros_like", "ones_like", "full_like", "empty_like"):
+            src = self._pick(self._eval(node.args[0], frame)) if node.args else None
+            if isinstance(src, _ArrayVal):
+                dtype = self._dtype_arg(node, frame, None) or src.dtype
+                return _ArrayVal(src.shape, dtype)
+            raise _OpaqueError(f"`{builder}` of non-array", lineno)
+        if builder == "eye":
+            if not node.args:
+                raise _OpaqueError("`eye` with no size", lineno)
+            n = self._pick(self._eval(node.args[0], frame))
+            if not isinstance(n, Poly):
+                raise _OpaqueError("`eye` size not numeric", lineno)
+            m = n
+            if len(node.args) > 1:
+                m2 = self._pick(self._eval(node.args[1], frame))
+                if isinstance(m2, Poly):
+                    m = m2
+            dtype = self._dtype_arg(node, frame, None) or "float32"
+            return _ArrayVal((n, m), dtype)
+        if builder == "arange":
+            if not node.args:
+                raise _OpaqueError("`arange` with no stop", lineno)
+            vals = [self._pick(self._eval(a, frame)) for a in node.args]
+            if len(vals) == 1 and isinstance(vals[0], Poly):
+                dtype = self._dtype_arg(node, frame, None) or "int32"
+                return _ArrayVal((vals[0],), dtype)
+            if len(vals) >= 2 and all(isinstance(v, Poly) for v in vals[:2]):
+                dtype = self._dtype_arg(node, frame, None) or "int32"
+                return _ArrayVal((vals[1] - vals[0],), dtype)
+            raise _OpaqueError("`arange` bounds not numeric", lineno)
+        if builder == "linspace":
+            num: Any = Poly.const(50)
+            if len(node.args) > 2:
+                num = self._pick(self._eval(node.args[2], frame))
+            for kw in node.keywords:
+                if kw.arg == "num":
+                    num = self._pick(self._eval(kw.value, frame))
+            if not isinstance(num, Poly):
+                raise _OpaqueError("`linspace` num not numeric", lineno)
+            return _ArrayVal((num,), "float32")
+        if builder in ("array", "asarray", "atleast_1d", "tensor"):
+            if not node.args:
+                raise _OpaqueError(f"`{builder}` with no value", lineno)
+            val = self._pick(self._eval(node.args[0], frame))
+            dtype_kw = self._dtype_arg(node, frame, 1)
+            if isinstance(val, _ArrayVal):
+                return _ArrayVal(val.shape, dtype_kw or val.dtype)
+            if isinstance(val, Poly):
+                inferred = "float32"
+                if val.is_const() and float(val.const_value()).is_integer():
+                    inferred = _literal_dtype(node.args[0])
+                if builder == "atleast_1d":
+                    return _ArrayVal((Poly.const(1),), dtype_kw or inferred)
+                return _ArrayVal((), dtype_kw or inferred)
+            if isinstance(val, bool):
+                return _ArrayVal((), dtype_kw or "bool")
+            if isinstance(val, tuple):
+                # literal nested-list structure: shape from the AST literal
+                dims: List[Poly] = [Poly.const(len(val))]
+                inner = node.args[0]
+                while isinstance(inner, (ast.List, ast.Tuple)) and inner.elts:
+                    first = inner.elts[0]
+                    if isinstance(first, (ast.List, ast.Tuple)):
+                        dims.append(Poly.const(len(first.elts)))
+                    inner = first
+                return _ArrayVal(tuple(dims), dtype_kw or _literal_dtype(node.args[0]))
+            if isinstance(val, _ListDefault):
+                return _ArrayVal((Poly.const(0),), dtype_kw or "float32")
+            raise _OpaqueError(f"`{builder}` of unresolvable value", lineno)
+        raise _OpaqueError(f"array builder `{builder}` not modeled", lineno)
+
+    # -------------------------------------------------------- state recording
+    def _record_add_state(self, node: ast.Call, frame: _Frame) -> None:
+        lineno = node.lineno
+        # resolve the state name
+        name_val: Any = None
+        if node.args:
+            try:
+                name_val = self._pick(self._eval(node.args[0], frame))
+            except _OpaqueError:
+                name_val = None
+        for kw in node.keywords:
+            if kw.arg == "name":
+                try:
+                    name_val = self._pick(self._eval(kw.value, frame))
+                except _OpaqueError:
+                    name_val = None
+        if not isinstance(name_val, str):
+            # dynamic names (f-string loops) keep a recognizable pattern; the
+            # byte model is still sound when the DEFAULT resolves — the states
+            # differ only in name, not in footprint
+            name_node = node.args[0] if node.args else None
+            for kw in node.keywords:
+                if kw.arg == "name":
+                    name_node = kw.value
+            if isinstance(name_node, ast.JoinedStr):
+                name_val = "".join(
+                    part.value if isinstance(part, ast.Constant) else "*"
+                    for part in name_node.values
+                )
+            else:
+                name_val = "?dynamic"
+        # resolve the reduction kind
+        reduction: str = "?"
+        red_node: Optional[ast.expr] = node.args[2] if len(node.args) > 2 else None
+        for kw in node.keywords:
+            if kw.arg == "dist_reduce_fx":
+                red_node = kw.value
+        if red_node is None:
+            reduction = "none"
+        else:
+            try:
+                red_val = self._pick(self._eval(red_node, frame))
+            except _OpaqueError:
+                red_val = None
+            if isinstance(red_val, str):
+                reduction = red_val
+            elif red_val is None and isinstance(red_node, ast.Constant):
+                reduction = "none"
+        # resolve the default value
+        default_node: Optional[ast.expr] = node.args[1] if len(node.args) > 1 else None
+        for kw in node.keywords:
+            if kw.arg == "default":
+                default_node = kw.value
+        if default_node is None:
+            self._record_opaque(name_val, frame, lineno, "add_state without a default argument")
+            return
+        try:
+            default_val = self._pick(self._eval(default_node, frame))
+        except _OpaqueError as err:
+            self._record_opaque(name_val, frame, lineno, f"default not resolvable: {err.reason}")
+            return
+        registered_in = f"{frame.cls.name}.{frame.method}"
+        common = dict(
+            name=name_val,
+            conditional=frame.conditional,
+            lineno=lineno,
+            path=frame.cls.path,
+            registered_in=registered_in,
+            reduction=reduction,
+        )
+        if isinstance(default_val, _ArrayVal):
+            self.states.append(
+                StateRecord(
+                    kind="array", dtype=default_val.dtype, shape=default_val.shape,
+                    bytes=default_val.nbytes(), growth=None, **common,
+                )
+            )
+            return
+        if isinstance(default_val, Poly):
+            # a raw python scalar default becomes a 0-d device array
+            dtype = "float32"
+            if default_val.is_const() and float(default_val.const_value()).is_integer():
+                dtype = _literal_dtype(default_node)
+            self.states.append(
+                StateRecord(
+                    kind="array", dtype=dtype, shape=(),
+                    bytes=Poly.const(_dtype_width(dtype)), growth=None, **common,
+                )
+            )
+            return
+        if isinstance(default_val, _RingVal):
+            self.states.append(
+                StateRecord(
+                    kind="ring", dtype=None, shape=None,
+                    bytes=ring_bytes(default_val.capacity, Poly.sym(row_bytes_symbol(name_val))),
+                    growth=None, **common,
+                )
+            )
+            return
+        if isinstance(default_val, _ListDefault):
+            cap = self.cat_capacity
+            # the Metric base rings BOTH cat-reduce and reduce-less (None)
+            # append lists when a capacity is set — mirror that gate here
+            if reduction in ("cat", "none") and isinstance(cap, Poly):
+                # the Metric base turns this list into a fixed-capacity ring
+                self.states.append(
+                    StateRecord(
+                        kind="ring", dtype=None, shape=None,
+                        bytes=ring_bytes(cap, Poly.sym(row_bytes_symbol(name_val))),
+                        growth=None, **common,
+                    )
+                )
+                return
+            self.states.append(
+                StateRecord(
+                    kind="list", dtype=None, shape=None,
+                    bytes=Poly.const(0),
+                    growth=Poly.sym(row_bytes_symbol(name_val)), **common,
+                )
+            )
+            return
+        reason = default_val.reason if isinstance(default_val, _Unknown) else type(default_val).__name__
+        self._record_opaque(name_val, frame, lineno, f"default not resolvable: {reason}")
+
+    def _record_opaque(self, name: str, frame: _Frame, lineno: int, reason: str) -> None:
+        self.states.append(
+            StateRecord(
+                name=name, kind="opaque", dtype=None, shape=None,
+                bytes=Poly.const(0), growth=None,
+                conditional=frame.conditional, lineno=lineno, path=frame.cls.path,
+                registered_in=f"{frame.cls.name}.{frame.method}",
+                reduction="?", opaque_reason=f"{self._site(frame, lineno)}: {reason}",
+            )
+        )
+
+
+class _Return(Exception):
+    """Control-flow carrier for ``return`` inside an executed function body."""
+
+    def __init__(self, value: Any) -> None:
+        super().__init__("return")
+        self.value = value
+
+
+_UNDECIDED = object()
+
+
+def _concrete(value: Any) -> Any:
+    """Concretize a model value for comparisons; ``_UNDECIDED`` when symbolic."""
+    if value is None or isinstance(value, (bool, str)):
+        return value
+    if isinstance(value, Poly) and value.is_const():
+        return value.const_value()
+    if isinstance(value, tuple):
+        out = tuple(_concrete(v) for v in value)
+        if any(v is _UNDECIDED for v in out):
+            return _UNDECIDED
+        return out
+    return _UNDECIDED
+
+
+def _same(a: Any, b: Any) -> bool:
+    if a is b:
+        return True
+    if isinstance(a, Poly) and isinstance(b, Poly):
+        return a.terms == b.terms
+    if a is None or isinstance(a, (bool, str, int, float)):
+        return type(a) is type(b) and a == b
+    if isinstance(a, tuple) and isinstance(b, tuple) and len(a) == len(b):
+        return all(_same(x, y) for x, y in zip(a, b))
+    return False
+
+
+def _ctor_degree(poly: Poly) -> int:
+    """Polynomial degree over constructor-arg symbols only.
+
+    ``row_bytes(<state>)`` pseudo-symbols are runtime-resolved leaf widths,
+    not constructor args — a ring's ``capacity x row_bytes`` product is
+    linear in the deployment's knobs, not an R11 blowup.
+    """
+    best = 0
+    for mono in poly.terms:
+        deg = sum(p for s, p in mono if not s.startswith("row_bytes("))
+        best = max(best, deg)
+    return best
+
+
+# ---------------------------------------------------------------------------
+# the pass
+
+
+class MemoryPass:
+    """Derive per-class byte formulas and emit R10/R11 violations."""
+
+    def __init__(self, registry: Registry) -> None:
+        self.registry = registry
+        self._cache: Dict[str, ClassMemory] = {}
+
+    # ------------------------------------------------------------- per class
+    def analyze_class(self, cls: ClassInfo) -> ClassMemory:
+        cached = self._cache.get(cls.qualname)
+        if cached is not None:
+            return cached
+        evaluator = _ChainEvaluator(self.registry, cls)
+        top_reason: Optional[str] = None
+        try:
+            evaluator.run()
+        except _OpaqueError as err:
+            top_reason = f"{cls.path}:{err.lineno or cls.lineno}: {err.reason}"
+        except _Return:
+            pass
+        except RecursionError:  # pragma: no cover - defensive
+            top_reason = f"{cls.path}:{cls.lineno}: recursive __init__ chain"
+        # de-duplicate records that re-ran through merged branches: one record
+        # per (name, kind, conditional) lexical role, last registration wins
+        dedup: Dict[Tuple[str, str, bool], StateRecord] = {}
+        for rec in evaluator.states:
+            dedup[(rec.name, rec.kind, rec.conditional)] = rec
+        records = list(dedup.values())
+        # a conditional record is redundant when the same name resolved to the
+        # same kind on the main path (decided-if alternates re-register)
+        main_keys = {(r.name, r.kind) for r in records if not r.conditional}
+        records = [r for r in records if not (r.conditional and (r.name, r.kind) in main_keys)]
+        records.sort(key=lambda r: (r.lineno, r.name))
+
+        total = Poly.const(0)
+        for rec in records:
+            if not rec.conditional:
+                total = total + rec.bytes
+        opaque_main = [r for r in records if r.kind == "opaque" and not r.conditional]
+        unbounded_main = [r for r in records if r.kind == "list" and not r.conditional]
+        if top_reason is not None or opaque_main:
+            verdict = "opaque"
+        elif unbounded_main:
+            verdict = "unbounded"
+        else:
+            verdict = "bounded"
+        opaque_reason = top_reason
+        if opaque_reason is None and opaque_main:
+            opaque_reason = opaque_main[0].opaque_reason
+        bounded_total: Optional[Poly] = None
+        list_records = [r for r in records if r.kind == "list"]
+        if list_records:
+            bounded_total = total
+            for rec in list_records:
+                if not rec.conditional:
+                    bounded_total = bounded_total + ring_bytes(
+                        Poly.sym("cat_state_capacity"), Poly.sym(row_bytes_symbol(rec.name))
+                    )
+        # concat-then-reduce computes transiently hold the concatenated copy
+        # next to the source rows: cat-reduce states, and reduce-less append
+        # states (retrieval-style lists/rings), both pay the x2 peak
+        peak = 2.0 if any(
+            (r.reduction == "cat" or (r.reduction == "none" and r.kind in ("list", "ring")))
+            and r.kind != "opaque"
+            for r in records
+        ) else 1.0
+        mem = ClassMemory(
+            qualname=cls.qualname,
+            path=cls.path,
+            line=cls.lineno,
+            public=not cls.name.startswith("_"),
+            verdict=verdict,
+            states=records,
+            total=total,
+            bounded_total=bounded_total,
+            peak_factor=peak,
+            opaque_reason=opaque_reason,
+        )
+        self._cache[cls.qualname] = mem
+        return mem
+
+    # ------------------------------------------------------------ violations
+    def emit_violations(
+        self, memories: Sequence[ClassMemory], scanned_paths: Set[str]
+    ) -> List[Violation]:
+        """R10/R11 findings for every lexical registration site.
+
+        Sites are deduplicated by (path, line, rule): a base-class
+        ``add_state`` shared by a dozen subclasses is one finding, anchored in
+        the module that owns the line (so ``# lint-ok`` comments there are
+        honored), and only emitted when that module was actually scanned.
+        """
+        sources: Dict[str, SourceInfo] = {
+            mod.path: mod.source for mod in self.registry.modules.values()
+        }
+        out: List[Violation] = []
+        seen: Set[Tuple[str, int, str]] = set()
+
+        def emit(rule_id: str, rec: StateRecord, scope: str, message: str) -> None:
+            key = (rec.path, rec.lineno, rule_id)
+            if key in seen or rec.path not in scanned_paths:
+                return
+            seen.add(key)
+            src = sources.get(rec.path)
+            if src is None:  # pragma: no cover - registry always indexes scanned files
+                return
+            v = src.violation(rule_id, rec.lineno, scope, message)
+            if v is not None:
+                out.append(v)
+
+        for mem in sorted(memories, key=lambda m: m.qualname):
+            for rec in mem.states:
+                scope = rec.registered_in
+                if rec.kind == "list":
+                    qualifier = (
+                        " only under a non-default config branch" if rec.conditional else ""
+                    )
+                    emit(
+                        "R10",
+                        rec,
+                        scope,
+                        f"state `{rec.name}` is an append-mode list{qualifier}: footprint grows"
+                        f" ~{rec.growth.render() if rec.growth else 'row_bytes'} per update with no bound."
+                        " Construct the metric with `cat_state_capacity=N` to swap it for a"
+                        " fixed-capacity device ring buffer with a closed-form byte formula.",
+                    )
+                elif rec.kind != "opaque" and _ctor_degree(rec.bytes) >= 2:
+                    emit(
+                        "R11",
+                        rec,
+                        scope,
+                        f"state `{rec.name}` costs {rec.bytes.render()} bytes — super-linear"
+                        " (degree >= 2) in constructor args. A setting cheap at small sizes"
+                        " blows up quadratically at fleet scale (and stacked pool/SPMD layouts"
+                        " multiply it again); baseline with a justification if deliberate.",
+                    )
+        out.sort(key=lambda v: (v.path, v.line, v.rule))
+        return out

@@ -1,0 +1,156 @@
+"""Runtime memory-model sanitizer (``TM_TPU_MEMSAN``).
+
+The static memory pass (``memory.py``, rules R10-R11) derives a closed-form
+byte formula per metric class and writes it to ``memory.json``. This module
+*verifies* those formulas on live instances, so deployments that size
+admission ceilings off the cost model are checking a validated prediction
+rather than trusting the static walk:
+
+- :func:`check_metric` compares the manifest's resolved prediction
+  (:func:`~torchmetrics_tpu._analysis.manifest.predicted_state_bytes`)
+  against the live registered-state footprint
+  (:func:`~torchmetrics_tpu._analysis.manifest.live_state_bytes`) at an
+  update boundary. Both sides are computed from host-side array metadata
+  (``shape``/``dtype``) — no ``device_get``, no sync, nothing is pulled off
+  the accelerator. Drift beyond :data:`DRIFT_TOLERANCE` publishes a
+  ``memory_model_drift`` bus event naming the class and both byte counts,
+  and is recorded in :func:`violations` for harness assertions.
+- Unbounded verdicts, inexact predictions (a symbol fell back to live
+  measurement), and classes the model calls opaque are skipped — the
+  sanitizer only cross-checks claims the model actually makes.
+
+Instrumentation sites follow the telemetry kill-switch contract exactly
+(``state.py``/``locksan.py``): every site is ``if MEMSAN.enabled:
+check_metric(...)`` — one slot load and one branch when disabled, measured
+by the ``memsan_disabled_retention`` bench line (target >= 0.97).
+
+Enable with env ``TM_TPU_MEMSAN=1`` (read at import) or
+:func:`set_memsan_enabled(True)` at runtime. Drift is reported once per
+class (rate-limited, like recompile-churn warnings); later drifts on the
+same class are counted as suppressed.
+
+This module must stay import-light (no jax, no numpy): ``metric.py``
+imports it at module scope, and the prediction/measurement helpers in
+``manifest.py`` are duck-typed over ``.nbytes``/``.shape`` so neither side
+of the comparison forces an array-library import either.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from typing import Dict, List
+
+__all__ = [
+    "MEMSAN",
+    "DRIFT_TOLERANCE",
+    "check_metric",
+    "memsan_enabled",
+    "reset",
+    "set_memsan_enabled",
+    "suppressed_count",
+    "violations",
+]
+
+# relative drift the sanitizer forgives: the model's dtype table truncates
+# 64-bit requests under x64-off JAX and upper-bounds Either-shaped states,
+# so exact equality is the common case but not the contract. Matches the
+# golden-sweep acceptance bound for the static formulas themselves.
+DRIFT_TOLERANCE = 0.10
+
+# absolute floor below which drift is noise (a couple of scalar states)
+_MIN_DRIFT_BYTES = 64.0
+
+
+class _SanState:
+    """Process-wide sanitizer switch (same ``__slots__`` contract as OBS)."""
+
+    __slots__ = ("enabled",)
+
+    def __init__(self) -> None:
+        self.enabled = os.environ.get("TM_TPU_MEMSAN", "") == "1"
+
+
+MEMSAN = _SanState()
+
+# bookkeeping shared across threads — one lock, never held across the
+# prediction/measurement work (grab, mutate, release)
+_meta_lock = threading.Lock()
+_violations: List[str] = []
+_reported_classes: Dict[str, int] = {}  # class name -> suppressed-after-first count
+
+
+def memsan_enabled() -> bool:
+    return MEMSAN.enabled
+
+
+def set_memsan_enabled(flag: bool) -> None:
+    """Runtime switch (tests/harness boundaries only)."""
+    MEMSAN.enabled = bool(flag)
+
+
+def violations() -> List[str]:
+    """Every drift finding recorded since the last :func:`reset`."""
+    with _meta_lock:
+        return list(_violations)
+
+
+def suppressed_count() -> int:
+    """Drift observations rate-limited away after a class's first report."""
+    with _meta_lock:
+        return sum(_reported_classes.values())
+
+
+def reset() -> None:
+    """Clear recorded findings and the per-class rate limiter (tests)."""
+    with _meta_lock:
+        _violations.clear()
+        _reported_classes.clear()
+
+
+def check_metric(obj: object) -> None:
+    """Cross-check the static byte formula against the live footprint.
+
+    Called at update boundaries with the sanitizer enabled. Skips silently
+    whenever the model makes no exact claim for ``obj``: no manifest entry
+    (user subclass or killed model), opaque/unbounded verdict, or a
+    prediction whose symbols fell back to live measurement (``exact=False``
+    — comparing a measurement against itself proves nothing).
+    """
+    from torchmetrics_tpu._analysis.manifest import live_state_bytes, predicted_state_bytes
+
+    pred = predicted_state_bytes(obj)
+    if pred is None or not pred.exact or pred.verdict != "bounded":
+        return
+    if pred.bytes != pred.bytes or pred.bytes == float("inf"):  # NaN/inf guard
+        return
+    live = live_state_bytes(obj)
+    drift = abs(live - pred.bytes)
+    if drift <= _MIN_DRIFT_BYTES or drift <= DRIFT_TOLERANCE * max(pred.bytes, 1.0):
+        return
+    cls_name = type(obj).__name__
+    message = (
+        f"memory-model drift on `{cls_name}`: static cost model predicts"
+        f" {pred.bytes:.0f} state bytes but the live registered states hold"
+        f" {live:.0f} ({drift:.0f} bytes / {drift / max(pred.bytes, 1.0):.0%} off)."
+        " The closed-form formula in memory.json no longer matches this class —"
+        " regenerate it with `python tools/lint_metrics.py torchmetrics_tpu/"
+        " --write-memory` or fix the state registration it mis-models."
+    )
+    with _meta_lock:
+        if cls_name in _reported_classes:
+            _reported_classes[cls_name] += 1
+            return
+        _reported_classes[cls_name] = 0
+        _violations.append(message)
+    from torchmetrics_tpu._observability.events import BUS
+
+    BUS.publish(
+        "memory_model_drift",
+        cls_name,
+        message,
+        data={"predicted_bytes": pred.bytes, "live_bytes": live},
+        # the sanitizer is its own opt-in layer: drift must land on the bus
+        # even when the general telemetry switch is off
+        force=True,
+    )

@@ -110,6 +110,27 @@ def iter_self_mutations(func: ast.FunctionDef) -> List[MutationSite]:
     return out
 
 
+@dataclass(frozen=True)
+class StateSite:
+    """One lexical ``self.add_state(...)`` call site inside a method body.
+
+    The memory prover (``memory.py``) replays these sites symbolically to
+    derive per-class byte formulas; ``default`` is the raw default-argument
+    expression (None when absent), ``method`` the enclosing method name, and
+    ``under_if`` marks config-dependent registration (same branch semantics
+    as :func:`_walk_with_branch_flag`). ``name`` is None for dynamic
+    (non-literal) state names — the enclosing ``for`` loop, if any, is the
+    prover's to unroll.
+    """
+
+    name: Optional[str]
+    default: Optional[ast.expr]
+    reduction: str  # same encoding as ClassInfo.state_reductions values
+    lineno: int
+    method: str
+    under_if: bool
+
+
 @dataclass
 class ClassInfo:
     name: str
@@ -147,6 +168,8 @@ class ClassInfo:
     # `self.<plain-attr>` assignment targets per method (mutation candidates)
     mutated_attrs: Dict[str, Set[str]] = field(default_factory=dict)
     dynamic_setattr_methods: Set[str] = field(default_factory=set)
+    # every lexical add_state call site, in source order (memory prover input)
+    state_sites: List[StateSite] = field(default_factory=list)
 
     @property
     def qualname(self) -> str:
@@ -259,7 +282,9 @@ def _scan_class(node: ast.ClassDef, module: str, path: str) -> ClassInfo:
                         reduction = reduce_arg.value
                     else:
                         reduction = "?"  # ctor pass-through / callable: runtime-decidable
+                    literal_name: Optional[str] = None
                     if isinstance(name_arg, ast.Constant) and isinstance(name_arg.value, str):
+                        literal_name = name_arg.value
                         info.own_states.add(name_arg.value)
                         info.state_reductions.setdefault(name_arg.value, reduction)
                         if isinstance(default_arg, ast.List):
@@ -271,6 +296,16 @@ def _scan_class(node: ast.ClassDef, module: str, path: str) -> ClassInfo:
                     else:
                         info.dynamic_add_state = True
                         info.dynamic_state_reductions.add(reduction)
+                    info.state_sites.append(
+                        StateSite(
+                            name=literal_name,
+                            default=default_arg,
+                            reduction=reduction,
+                            lineno=sub.lineno,
+                            method=item.name,
+                            under_if=under_if,
+                        )
+                    )
         # the mutation index and the R1 rule share one walker (MutationSite),
         # so certification and reporting can never drift apart again
         mutated: Set[str] = set()

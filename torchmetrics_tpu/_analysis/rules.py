@@ -1,10 +1,11 @@
 """Rule catalog for the trace-safety static analyzer.
 
 Each rule encodes one hazard class specific to this codebase — XLA
-semantics for R1-R6, thread-safety of the serving runtime for R7-R9
-(see ``ANALYSIS.md`` for the full catalog with examples and baselining
-instructions). Rules are identified by stable short IDs (``R1``..``R9``)
-that appear in violations, baseline entries, and inline suppressions.
+semantics for R1-R6, thread-safety of the serving runtime for R7-R9,
+memory-footprint discipline for R10-R11 (see ``ANALYSIS.md`` for the
+full catalog with examples and baselining instructions). Rules are
+identified by stable short IDs (``R1``..``R11``) that appear in
+violations, baseline entries, and inline suppressions.
 """
 
 from __future__ import annotations
@@ -116,6 +117,33 @@ RULES: Dict[str, Rule] = {
                 " the guarded-sync watchdog exists to catch at runtime. Locks in this runtime"
                 " guard host-side bookkeeping only; anything that can block must run outside"
                 " the critical section."
+            ),
+        ),
+        Rule(
+            id="R10",
+            name="unbounded-state-growth",
+            summary="append-mode (cat) list state with no capacity bound grows host memory per update",
+            rationale=(
+                "A `default=[]` state appends one batch-sized array per `update()` forever: the"
+                " footprint is O(updates x row_bytes), not a function of the constructor args,"
+                " so no deployment can be admission-checked against a memory ceiling. The"
+                " runtime already ships the escape hatch — construct the metric with"
+                " `cat_state_capacity=N` and the list transparently becomes a fixed-capacity"
+                " device ring buffer with a closed-form byte formula."
+            ),
+        ),
+        Rule(
+            id="R11",
+            name="footprint-blowup",
+            summary="state byte formula carries a super-linear (degree >= 2) term in constructor args",
+            rationale=(
+                "An O(C^2) confusion matrix or O(thresholds x classes) curve state that is"
+                " cheap at C=10 is 10,000x bigger at C=1000 — and the stacked StreamPool /"
+                " SPMD layouts multiply it again by capacity or world size. Super-linear"
+                " terms must be deliberate (baselined with a justification) so the memory"
+                " cost model's blowup classes are decisions, not surprises; the transient"
+                " concat-then-reduce peak of cat states is reported alongside in"
+                " `memory.json`."
             ),
         ),
         Rule(

@@ -66,6 +66,11 @@ _HELP: Dict[str, str] = {
     "pool_detach": "StreamPool detach() calls.",
     "pool_growths": "StreamPool capacity-doubling growth events.",
     "pool_computes": "StreamPool compute dispatches by kind (cache misses only).",
+    "predicted_state_bytes": (
+        "Closed-form predicted metric-state bytes from the static memory cost model"
+        " (memory.json), summed over live instances; per-device for SPMD engines."
+    ),
+    "memory_model_drift": "Memory sanitizer drift findings (predicted vs live bytes).",
 }
 
 # reservoir quantiles exported as summary lines (satellite: p50/p90/p99 per op)
@@ -123,6 +128,9 @@ def render_prometheus(aggregate: Dict[str, Dict[str, Any]], bus: Any, enabled: b
                     summary_ops.add(labels["op"])
                 continue
             emit(family, {**base, **labels}, entry["counters"][key])
+        for key in sorted(entry.get("gauges", ())):
+            family, labels = _split_key(key)
+            emit(family, {**base, **labels}, entry["gauges"][key], kind="gauge")
         for op in sorted(summary_ops):
             # Prometheus summary: quantile-labelled samples over the retained
             # reservoir window + lifetime-monotonic `_sum`/`_count` drawn from
@@ -163,6 +171,7 @@ def to_json(aggregate: Dict[str, Dict[str, Any]], bus: Any, enabled: bool) -> Di
         "metrics": {
             name: {
                 "counters": {k: v for k, v in sorted(entry["counters"].items())},
+                "gauges": {k: v for k, v in sorted(entry.get("gauges", {}).items())},
                 "latency": entry["latency"],
                 "instances": entry["instances"],
                 "retired_instances": entry["retired_instances"],

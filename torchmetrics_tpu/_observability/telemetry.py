@@ -94,6 +94,7 @@ class MetricTelemetry:  # concurrency: shared exporters scrape via the registry 
         "name",
         "counters",
         "reservoirs",
+        "gauges",
         "_ticks",
         "_compile_keys",
         "_recent_keys",
@@ -107,6 +108,7 @@ class MetricTelemetry:  # concurrency: shared exporters scrape via the registry 
         self.name = name
         self.counters: Dict[str, float] = {}
         self.reservoirs: Dict[str, LatencyReservoir] = {}
+        self.gauges: Dict[str, float] = {}
         self._ticks: Dict[str, int] = {}
         # compiled-path cache keys already seen, per compile kind
         self._compile_keys: set = set()
@@ -119,6 +121,16 @@ class MetricTelemetry:  # concurrency: shared exporters scrape via the registry 
     # ------------------------------------------------------------- recording
     def inc(self, key: str, n: float = 1) -> None:
         self.counters[key] = self.counters.get(key, 0) + n
+
+    def set_gauge(self, key: str, value: float) -> None:
+        """Set an instantaneous (non-monotonic) value; last write wins.
+
+        Gauges describe the instance's *current* state (e.g. the predicted
+        per-replica state footprint), so they are summed over live instances
+        at aggregation time and deliberately NOT folded into retired totals
+        — a collected metric no longer occupies the bytes it predicted.
+        """
+        self.gauges[key] = float(value)
 
     def sample_due(self, op: str) -> bool:
         """True once every ``OBS.sample_every`` calls OF THIS OP.
@@ -366,7 +378,8 @@ class TelemetryRegistry:
             retired_n = dict(self._retired_instances)
         for telem in live:
             entry = out.setdefault(
-                telem.name, {"counters": {}, "latency": {}, "instances": 0, "retired_instances": 0}
+                telem.name,
+                {"counters": {}, "gauges": {}, "latency": {}, "instances": 0, "retired_instances": 0},
             )
             entry["instances"] += 1
             # dict(...) is a C-level copy (atomic under the GIL): the hot
@@ -375,12 +388,17 @@ class TelemetryRegistry:
             # raise "dictionary changed size during iteration"
             for key, val in dict(telem.counters).items():
                 entry["counters"][key] = entry["counters"].get(key, 0) + val
+            # gauges sum over LIVE instances only: they are instantaneous
+            # occupancy, not lifetime totals, so retirement drops them
+            for key, val in dict(telem.gauges).items():
+                entry["gauges"][key] = entry["gauges"].get(key, 0) + val
             for op, res in dict(telem.reservoirs).items():
                 pool = entry["latency"].setdefault(op, [])
                 pool.extend(res.values())
         for name, counters in retired.items():
             entry = out.setdefault(
-                name, {"counters": {}, "latency": {}, "instances": 0, "retired_instances": 0}
+                name,
+                {"counters": {}, "gauges": {}, "latency": {}, "instances": 0, "retired_instances": 0},
             )
             entry["retired_instances"] = retired_n.get(name, 0)
             for key, val in counters.items():

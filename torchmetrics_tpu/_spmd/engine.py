@@ -50,7 +50,7 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import PartitionSpec
 
-from torchmetrics_tpu._analysis.manifest import in_graph_sync_eligible
+from torchmetrics_tpu._analysis.manifest import in_graph_sync_eligible, predicted_state_bytes
 from torchmetrics_tpu._aot.state import AOT as _AOT
 from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.state import OBS as _OBS
@@ -639,6 +639,39 @@ class SpmdEngine:
         self._states = jax.tree_util.tree_map(
             lambda d: jax.device_put(d, self._sharding), self._stacked_defaults
         )
+        if _OBS.enabled:
+            per_device = self.predicted_device_bytes()
+            if per_device is not None:
+                # per-device scaling law: each device holds ONE replica row of
+                # every registered state, so predicted per-device bytes = F
+                # (the class's closed-form formula), independent of mesh size
+                _telemetry_for(self.target).set_gauge(
+                    "predicted_state_bytes|scope=spmd_device", per_device
+                )
+
+    def predicted_device_bytes(self) -> Optional[float]:
+        """Closed-form predicted state bytes PER DEVICE, or ``None``.
+
+        Resolved from the static memory cost model (``memory.json``) on the
+        template instance(s). ``None`` when the model makes no exact finite
+        claim (absent entry, opaque verdict, or an unbounded cat-list
+        without ``cat_state_capacity``) — the telemetry gauge stands down
+        rather than publish a guess.
+        """
+        from torchmetrics_tpu.collections import MetricCollection
+
+        metrics = (
+            list(self.target._modules.values())
+            if isinstance(self.target, MetricCollection)
+            else [self.target]
+        )
+        total = 0.0
+        for m in metrics:
+            pred = predicted_state_bytes(m)
+            if pred is None or not pred.exact or pred.bytes == float("inf"):
+                return None
+            total += pred.bytes
+        return total
 
     def _install_stacked_defaults(self, units: List[_Unit], ring_default: Any) -> None:
         """Build ``_stacked_defaults`` + the flat ``_defaults`` mirror.

@@ -10,13 +10,22 @@ gains a bounded ``stream=`` label dimension (:class:`StreamLabeler`).
 """
 
 from torchmetrics_tpu._streams.durability import StreamRestoreReport, StreamSnapshotManager
-from torchmetrics_tpu._streams.pool import StreamPool, StreamPoolUnsupported
+from torchmetrics_tpu._streams.pool import (
+    StreamPool,
+    StreamPoolAdmissionError,
+    StreamPoolUnsupported,
+    memory_ceiling,
+    set_memory_ceiling,
+)
 from torchmetrics_tpu._streams.telemetry import StreamLabeler
 
 __all__ = [
     "StreamLabeler",
     "StreamPool",
+    "StreamPoolAdmissionError",
     "StreamPoolUnsupported",
     "StreamRestoreReport",
     "StreamSnapshotManager",
+    "memory_ceiling",
+    "set_memory_ceiling",
 ]

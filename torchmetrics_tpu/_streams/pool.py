@@ -44,6 +44,7 @@ labels in :mod:`~torchmetrics_tpu._streams.telemetry`. See STREAMS.md.
 from __future__ import annotations
 
 import heapq
+import os
 import time
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple
@@ -52,7 +53,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from torchmetrics_tpu._analysis.manifest import stream_pool_eligible
+from torchmetrics_tpu._analysis.manifest import predicted_state_bytes, stream_pool_eligible
 from torchmetrics_tpu._aot.state import AOT as _AOT
 from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.events import BUS as _BUS
@@ -62,7 +63,13 @@ from torchmetrics_tpu._streams.telemetry import StreamLabeler
 from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
 from torchmetrics_tpu.utilities.ringbuffer import RingBuffer
 
-__all__ = ["StreamPool", "StreamPoolUnsupported"]
+__all__ = [
+    "StreamPool",
+    "StreamPoolAdmissionError",
+    "StreamPoolUnsupported",
+    "memory_ceiling",
+    "set_memory_ceiling",
+]
 
 
 class StreamPoolUnsupported(TorchMetricsUserError):
@@ -71,6 +78,44 @@ class StreamPoolUnsupported(TorchMetricsUserError):
     Raised at pool construction — never mid-stream — so callers keep the
     plain per-instance eager path with zero state committed.
     """
+
+
+class StreamPoolAdmissionError(TorchMetricsUserError):
+    """Admission refused: the pool's predicted footprint exceeds the ceiling.
+
+    Raised at pool construction or at the ``attach()`` that would trigger a
+    capacity doubling — never mid-update — with zero state committed, so the
+    caller can shed the tenant, raise the ceiling, or shrink the template.
+    """
+
+
+# process-wide predicted-footprint ceiling in bytes (None = unlimited).
+# Seeded from TM_TPU_MEM_CEILING at import; admission checks run only at
+# construction and capacity growth — never on the per-batch hot path.
+_MEM_CEILING_ENV = "TM_TPU_MEM_CEILING"
+_memory_ceiling: Optional[float] = (
+    float(os.environ[_MEM_CEILING_ENV]) if os.environ.get(_MEM_CEILING_ENV) else None
+)
+
+
+def set_memory_ceiling(limit_bytes: Optional[float]) -> None:
+    """Set (or clear, with ``None``) the pool admission ceiling in bytes.
+
+    The ceiling bounds each pool's *predicted* stacked-state footprint
+    ``(capacity + 1) * F`` where ``F`` is the template's closed-form
+    per-stream byte formula from the static memory cost model
+    (``memory.json``). Templates the model cannot price exactly (absent
+    from the manifest, opaque, or unbounded without ``cat_state_capacity``)
+    are admitted unchecked — the ceiling enforces claims the model makes,
+    it does not guess.
+    """
+    global _memory_ceiling
+    _memory_ceiling = None if limit_bytes is None else float(limit_bytes)
+
+
+def memory_ceiling() -> Optional[float]:
+    """The active admission ceiling in bytes, or ``None`` when unlimited."""
+    return _memory_ceiling
 
 
 def _is_array(x: Any) -> bool:
@@ -150,6 +195,7 @@ class StreamPool:
                     " nan_policy='quarantine' or None"
                 )
         self.capacity = int(capacity)
+        self._check_memory_ceiling(self.capacity, at="construction")
         # slot bookkeeping: a min-heap free-list gives deterministic O(log N)
         # attach (lowest slot first — replay-stable for the journal), and
         # detach pushes the zeroed slot back
@@ -261,10 +307,58 @@ class StreamPool:
         if attached and sid not in self._active:
             raise TorchMetricsUserError(f"stream {sid} is not attached")
 
+    # ------------------------------------------------------------- admission
+    def predicted_stream_bytes(self) -> Optional[float]:
+        """Closed-form predicted bytes for ONE stream row, or ``None``.
+
+        ``None`` means the static memory cost model makes no exact finite
+        claim for this template (absent manifest entry, opaque verdict, or
+        an unbounded cat-list without ``cat_state_capacity``) — admission
+        control and the telemetry gauge both stand down for such pools.
+        """
+        metrics = (
+            list(self.target._modules.values()) if self._collection is not None else [self.target]
+        )
+        total = 0.0
+        for m in metrics:
+            pred = predicted_state_bytes(m)
+            if pred is None or not pred.exact or pred.bytes == float("inf"):
+                return None
+            total += pred.bytes
+        return total
+
+    def _check_memory_ceiling(self, new_capacity: int, at: str) -> None:
+        """Refuse admission when the predicted footprint would breach the ceiling.
+
+        Runs at construction and capacity growth only — O(active ceiling
+        check) off the per-batch hot path. The predicted footprint is the
+        scaling law ``(capacity + 1) * F`` (the +1 is the scratch row).
+        """
+        ceiling = _memory_ceiling
+        if ceiling is None:
+            return
+        per_stream = self.predicted_stream_bytes()
+        if per_stream is None:
+            return
+        predicted = (new_capacity + 1) * per_stream
+        if predicted <= ceiling:
+            return
+        cls_name = type(self.target).__name__
+        raise StreamPoolAdmissionError(
+            f"StreamPool admission refused at {at}: `{cls_name}` is predicted to occupy"
+            f" {predicted:.0f} bytes of stacked state at capacity {new_capacity}"
+            f" ((capacity + 1) x {per_stream:.0f} bytes/stream from the static memory"
+            f" cost model), over the configured ceiling of {ceiling:.0f} bytes"
+            f" (set via set_memory_ceiling() or {_MEM_CEILING_ENV}). Raise the ceiling,"
+            " lower the pool capacity, or shrink the template's state"
+            " (e.g. a smaller cat_state_capacity)."
+        )
+
     def _grow(self) -> None:
         """Double capacity: re-pad every stacked leaf, one recompile next step."""
         old_cap = self.capacity
         new_cap = old_cap * 2
+        self._check_memory_ceiling(new_cap, at="attach-time capacity growth")
         self._free.extend(range(old_cap, new_cap))
         heapq.heapify(self._free)
         self.capacity = new_cap
@@ -306,6 +400,11 @@ class StreamPool:
         if _OBS.enabled:
             telem = _telemetry_for(self)
             telem.inc("pool_growths")
+            per_stream = self.predicted_stream_bytes()
+            if per_stream is not None:
+                telem.set_gauge(
+                    "predicted_state_bytes|scope=pool", (new_cap + 1) * per_stream
+                )
             _BUS.publish(
                 "stream_pool_growth",
                 type(self).__name__,

@@ -37,6 +37,8 @@ import numpy as np
 # the eager per-update hot path, where a function-level import costs a dict
 # lookup + lock round-trip per call; manifest.py imports nothing heavy
 from torchmetrics_tpu._analysis.manifest import compiled_validation_eligible, fingerprint_skip_allowed
+from torchmetrics_tpu._analysis.memsan import MEMSAN as _MEMSAN
+from torchmetrics_tpu._analysis.memsan import check_metric as _memsan_check
 
 # AOT executable-cache hot switch (_aot/state.py): consulted ONLY when a new
 # executable is built (never per update call), so the unset-cache path stays
@@ -294,7 +296,7 @@ class Metric(ABC):
                 )
             if len(default):
                 raise ValueError(f"RingBuffer default for state {name!r} must be empty")
-        if is_list and self.cat_state_capacity is not None and dist_reduce_fx == "cat":
+        if is_list and self.cat_state_capacity is not None and dist_reduce_fx in ("cat", None):
             default = RingBuffer(self.cat_state_capacity)
             is_list, is_ring = False, True
         if is_ring:
@@ -481,7 +483,7 @@ class Metric(ABC):
                 reduced = jnp.maximum(global_state, local_state)
             elif reduce_fn == "min":
                 reduced = jnp.minimum(global_state, local_state)
-            elif reduce_fn == "cat" and isinstance(global_state, RingBuffer):
+            elif reduce_fn in ("cat", None) and isinstance(global_state, RingBuffer):
                 reduced = global_state.copy().extend(local_state)
             elif (reduce_fn == "cat" or reduce_fn is None) and isinstance(global_state, list):
                 reduced = global_state + list(local_state)
@@ -611,6 +613,11 @@ class Metric(ABC):
         ``_journal_suspend`` — mid-dance state is batch-local and must not
         be journaled or snapshotted.
         """
+        if method == "update" and _MEMSAN.enabled:
+            # every update path (eager/auto/jit/forward) commits through this
+            # seam, so one sanitizer site cross-checks them all; disabled
+            # cost is one slot load + branch (memsan_disabled_retention)
+            _memsan_check(self)
         hook = self.__dict__.get("_snapshot_hook")
         if hook is not None and "_journal_suspend" not in self.__dict__:
             hook.record(self, method, args, kwargs)
