@@ -1,0 +1,337 @@
+"""Exposition-correctness tests for the telemetry export surfaces.
+
+Beyond the parser smoke tests in ``test_telemetry.py``, this file enforces
+the wire-format contracts dashboards actually depend on: strict classic
+text-exposition line grammar, label escaping on hostile values, OpenMetrics
+exemplar syntax and the ``# EOF`` terminator, cumulative-histogram
+invariants, and that every rendered family/label stays inside the declared
+:data:`~torchmetrics_tpu._observability.export.EXPORT_SCHEMA`.
+"""
+
+from __future__ import annotations
+
+import re
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._observability import (
+    BUS,
+    REGISTRY,
+    set_profiling_enabled,
+    set_telemetry_enabled,
+    set_telemetry_sampling,
+    set_tracing_enabled,
+    trace_context,
+)
+from torchmetrics_tpu._observability.export import (
+    EXPORT_SCHEMA,
+    _escape_label,
+)
+from torchmetrics_tpu._observability.profiling import reset_ledger
+from torchmetrics_tpu._observability.state import DEFAULT_SAMPLE_EVERY
+from torchmetrics_tpu._observability.telemetry import LATENCY_BUCKETS, _BUCKET_LABELS
+from torchmetrics_tpu._observability.tracing import TRACER
+
+
+@pytest.fixture()
+def full_surface():
+    """Telemetry + tracing + profiling on: the widest export surface."""
+    reset_ledger()
+    REGISTRY.reset()
+    BUS.clear()
+    TRACER.clear()
+    set_telemetry_enabled(True)
+    set_telemetry_sampling(1)
+    set_tracing_enabled(True)
+    set_profiling_enabled(True)
+    yield
+    set_profiling_enabled(False)
+    set_tracing_enabled(False)
+    set_telemetry_sampling(DEFAULT_SAMPLE_EVERY)
+    set_telemetry_enabled(False)
+    TRACER.clear()
+    reset_ledger()
+    REGISTRY.reset()
+    BUS.clear()
+
+
+def _drive_traffic():
+    """Produce counters, gauges, summaries, histograms, exemplars, ledger rows."""
+    metric = tm.MeanSquaredError()
+    with trace_context("exposition-test"):
+        for _ in range(4):
+            metric.update(jnp.ones(8), jnp.zeros(8))
+        metric.compute()
+    from torchmetrics_tpu._streams import StreamPool
+    from torchmetrics_tpu.aggregation import MeanMetric
+
+    pool = StreamPool(MeanMetric(), capacity=4)
+    ids = np.array([pool.attach() for _ in range(2)])
+    for step in range(3):
+        pool.update(ids, jnp.ones((2, 3)) * step)
+    BUS.publish("degradation", "MeanSquaredError", "synthetic")
+    return metric, pool
+
+
+# ------------------------------------------------------- strict classic format
+# Classic exposition grammar (prometheus.io/docs/instrumenting/exposition_formats)
+_HELP_RE = re.compile(r"^# HELP [a-zA-Z_:][a-zA-Z0-9_:]* \S.*$")
+_TYPE_RE = re.compile(r"^# TYPE ([a-zA-Z_:][a-zA-Z0-9_:]*) (counter|gauge|summary|histogram)$")
+_SAMPLE_RE = re.compile(
+    r"^([a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{([a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\""
+    r"(?:,[a-zA-Z_][a-zA-Z0-9_]*=\"(?:[^\"\\\n]|\\\\|\\\"|\\n)*\")*)\})?"
+    r" (-?(?:[0-9]+(?:\.[0-9]+)?(?:e[+-]?[0-9]+)?|[0-9.]+e[+-]?[0-9]+))$"
+)
+
+_SUFFIXES = ("_bucket", "_sum", "_count")
+
+
+def _family_base(sample_name: str, declared: set) -> str:
+    """Map a sample name back to its declared family (strip known suffixes)."""
+    if sample_name in declared:
+        return sample_name
+    for suffix in _SUFFIXES:
+        if sample_name.endswith(suffix) and sample_name[: -len(suffix)] in declared:
+            return sample_name[: -len(suffix)]
+    return sample_name
+
+
+def test_classic_exposition_strict_line_format(full_surface):
+    _drive_traffic()
+    text = REGISTRY.render_prometheus()
+    assert text.endswith("\n") and not text.endswith("\n\n")
+    declared: set = set()
+    seen_order: list = []
+    current: str = ""
+    for line in text.splitlines():
+        assert line == line.rstrip(), f"trailing whitespace: {line!r}"
+        if line.startswith("# HELP "):
+            assert _HELP_RE.match(line), f"malformed HELP line: {line!r}"
+            continue
+        if line.startswith("# TYPE "):
+            m = _TYPE_RE.match(line)
+            assert m, f"malformed TYPE line: {line!r}"
+            name, kind = m.group(1), m.group(2)
+            assert name not in declared, f"family {name} declared twice"
+            declared.add(name)
+            seen_order.append(name)
+            current = name
+            # classic convention: counter family names end in _total
+            if kind == "counter":
+                assert name.endswith("_total"), f"counter family without _total: {name}"
+            continue
+        m = _SAMPLE_RE.match(line)
+        assert m, f"malformed sample line: {line!r}"
+        base = _family_base(m.group(1), declared)
+        # family contiguity: every sample belongs to the most recent TYPE
+        assert base == current, f"sample {m.group(1)} outside its family block"
+    assert seen_order == sorted(seen_order), "families not emitted in sorted order"
+    # classic format must not leak OpenMetrics-only syntax
+    assert "# EOF" not in text and " # {" not in text
+
+
+def test_classic_parses_and_counter_samples_carry_total(full_surface):
+    parser = pytest.importorskip("prometheus_client.parser")
+    _drive_traffic()
+    text = REGISTRY.render_prometheus()
+    families = {f.name: f for f in parser.text_string_to_metric_families(text)}
+    # the profiling families ride the same exposition
+    assert "tmtpu_profile_device_seconds" in families
+    assert "tmtpu_profiling_enabled" in families
+    assert families["tmtpu_profiling_enabled"].samples[0].value == 1
+    for fam in families.values():
+        assert fam.documentation, f"family {fam.name} missing HELP"
+        for s in fam.samples:
+            if fam.type == "counter":
+                assert s.name == f"{fam.name}_total"
+            assert s.value >= 0 or fam.type == "gauge"
+
+
+def test_label_escaping_round_trips_through_parser(full_surface):
+    parser = pytest.importorskip("prometheus_client.parser")
+    hostile = 'he said "hi"\\path\nnewline'
+    assert _escape_label(hostile) == 'he said \\"hi\\"\\\\path\\nnewline'
+    # a hostile label value must survive render -> standard parser intact
+    from torchmetrics_tpu._observability.telemetry import telemetry_for
+
+    metric = tm.MeanSquaredError()
+    metric.update(jnp.ones(4), jnp.zeros(4))
+    telemetry_for(metric).inc(f"degradations|kind={hostile}")
+    text = REGISTRY.render_prometheus()
+    families = {f.name: f for f in parser.text_string_to_metric_families(text)}
+    values = {
+        s.labels["kind"]
+        for s in families["tmtpu_degradations"].samples
+        if "kind" in s.labels
+    }
+    assert hostile in values
+
+
+# ------------------------------------------------------------------ OpenMetrics
+def test_openmetrics_ends_with_eof_and_parses(full_surface):
+    _drive_traffic()
+    text = REGISTRY.render_openmetrics()
+    assert text.endswith("# EOF\n")
+    assert text.count("# EOF") == 1
+    om_parser = pytest.importorskip("prometheus_client.openmetrics.parser")
+    families = {
+        f.name: f for f in om_parser.text_string_to_metric_families(text)
+    }
+    assert "tmtpu_update_calls" in families
+    assert "tmtpu_latency_hist_seconds" in families
+    assert "tmtpu_profile_device_seconds" in families
+    # OpenMetrics: family declared WITHOUT _total, counter samples WITH it
+    assert "tmtpu_update_calls_total" not in families
+    for s in families["tmtpu_update_calls"].samples:
+        assert s.name == "tmtpu_update_calls_total"
+
+
+def test_openmetrics_exemplars_carry_trace_ids(full_surface):
+    _drive_traffic()
+    text = REGISTRY.render_openmetrics()
+    exemplar_re = re.compile(
+        r"^(tmtpu_latency_hist_seconds_bucket\{[^}]*\}) ([0-9.e+-]+)"
+        r" # \{trace_id=\"([0-9]+)\"\} ([0-9.e+-]+) ([0-9.]+)$"
+    )
+    matched = [m for m in map(exemplar_re.match, text.splitlines()) if m]
+    assert matched, "no exemplars rendered despite active tracing"
+    for m in matched:
+        series, bucket_val, trace_id, obs_val, ts = m.groups()
+        assert int(trace_id) >= 1
+        assert float(obs_val) >= 0.0
+        assert float(ts) > 1.5e9  # sane unix timestamp
+        # the exemplar's observed value must fall inside its bucket
+        le = re.search(r'le="([^"]+)"', series).group(1)
+        if le != "+Inf":
+            assert float(obs_val) <= float(le)
+    # exemplars appear ONLY on _bucket sample lines
+    for line in text.splitlines():
+        if " # {" in line:
+            assert "_bucket{" in line
+    # the standard OpenMetrics parser accepts the exemplar syntax
+    om_parser = pytest.importorskip("prometheus_client.openmetrics.parser")
+    fams = {f.name: f for f in om_parser.text_string_to_metric_families(text)}
+    with_ex = [
+        s
+        for s in fams["tmtpu_latency_hist_seconds"].samples
+        if s.exemplar is not None
+    ]
+    assert with_ex
+    assert all(s.exemplar.labels.get("trace_id") for s in with_ex)
+
+
+def test_classic_drops_exemplars_but_keeps_buckets(full_surface):
+    _drive_traffic()
+    om = REGISTRY.render_openmetrics()
+    classic = REGISTRY.render_prometheus()
+    assert " # {" in om
+    assert " # {" not in classic
+    assert "tmtpu_latency_hist_seconds_bucket" in classic
+
+
+# -------------------------------------------------------------------- histogram
+def test_histogram_buckets_cumulative_and_complete(full_surface):
+    parser = pytest.importorskip("prometheus_client.parser")
+    _drive_traffic()
+    text = REGISTRY.render_prometheus()
+    families = {f.name: f for f in parser.text_string_to_metric_families(text)}
+    hist = families["tmtpu_latency_hist_seconds"]
+    by_series: dict = {}
+    for s in hist.samples:
+        key = (s.labels.get("metric"), s.labels.get("op"))
+        by_series.setdefault(key, {"buckets": {}, "count": None, "sum": None})
+        if s.name.endswith("_bucket"):
+            by_series[key]["buckets"][s.labels["le"]] = s.value
+        elif s.name.endswith("_count"):
+            by_series[key]["count"] = s.value
+        elif s.name.endswith("_sum"):
+            by_series[key]["sum"] = s.value
+    assert by_series
+    expected_les = set(_BUCKET_LABELS)
+    assert len(LATENCY_BUCKETS) + 1 == len(_BUCKET_LABELS)
+    for (metric, op), series in by_series.items():
+        assert set(series["buckets"]) == expected_les, (metric, op)
+        ordered = [series["buckets"][le] for le in _BUCKET_LABELS]
+        assert ordered == sorted(ordered), f"non-cumulative buckets for {op}"
+        assert series["buckets"]["+Inf"] == series["count"]
+        assert series["count"] >= 1
+        assert series["sum"] is not None and series["sum"] >= 0
+
+
+def test_histogram_monotonic_across_scrapes(full_surface):
+    """Scrape-to-scrape, every cumulative bucket only ever grows."""
+    metric, pool = _drive_traffic()
+
+    def bucket_values():
+        parser = pytest.importorskip("prometheus_client.parser")
+        fams = {
+            f.name: f
+            for f in parser.text_string_to_metric_families(REGISTRY.render_prometheus())
+        }
+        return {
+            (s.labels.get("op"), s.labels.get("le")): s.value
+            for s in fams["tmtpu_latency_hist_seconds"].samples
+            if s.name.endswith("_bucket")
+        }
+
+    first = bucket_values()
+    with trace_context("second-wave"):
+        for _ in range(3):
+            metric.update(jnp.ones(8), jnp.zeros(8))
+    second = bucket_values()
+    assert set(first) <= set(second)
+    for key, val in first.items():
+        assert second[key] >= val, f"bucket regressed between scrapes: {key}"
+
+
+# ------------------------------------------------------------- schema coverage
+def _parse_rendered_families(text):
+    parser = pytest.importorskip("prometheus_client.parser")
+    return list(parser.text_string_to_metric_families(text))
+
+
+def test_rendered_output_stays_inside_export_schema(full_surface):
+    _drive_traffic()
+    prefixed = {f"tmtpu_{family}": spec for family, spec in EXPORT_SCHEMA.items()}
+    for fam in _parse_rendered_families(REGISTRY.render_prometheus()):
+        assert fam.name in prefixed, f"undeclared family rendered: {fam.name}"
+        spec = prefixed[fam.name]
+        assert fam.type == spec["kind"], fam.name
+        allowed = set(spec["labels"])
+        for s in fam.samples:
+            extra = set(s.labels) - allowed
+            assert not extra, f"{fam.name} sample leaks undeclared labels {extra}"
+
+
+def test_schema_kinds_are_valid():
+    assert all(
+        spec["kind"] in {"counter", "gauge", "summary", "histogram"}
+        for spec in EXPORT_SCHEMA.values()
+    )
+    # label tuples are already sorted & unique (the manifest canonical form)
+    for family, spec in EXPORT_SCHEMA.items():
+        labels = spec["labels"]
+        assert len(set(labels)) == len(labels), family
+
+
+def test_json_export_round_trips_with_exemplars_and_profiling(full_surface):
+    import json
+
+    _drive_traffic()
+    blob = json.loads(json.dumps(REGISTRY.to_json()))
+    assert blob["version"] == 2
+    assert "profiling" in blob and blob["profiling"]["enabled"]
+    assert blob["profiling"]["seams"], "ledger rows missing from JSON export"
+    exemplars = {
+        k: v
+        for entry in blob["metrics"].values()
+        for k, v in entry.get("exemplars", {}).items()
+    }
+    assert exemplars, "no exemplars in JSON export despite tracing"
+    for ex in exemplars.values():
+        assert set(ex) == {"value", "ts", "trace_id"}
+        assert ex["trace_id"] >= 1

@@ -220,3 +220,100 @@ def test_arming_with_telemetry_off_warns():
         recorder = arm_flight_recorder()
     recorder.disarm()
     disarm_flight_recorder()
+
+
+# ------------------------------------------------------------ disk retention
+def test_disk_retention_cap_evicts_oldest_first(tmp_path):
+    set_telemetry_enabled(True)
+    dump_dir = tmp_path / "flight"
+    recorder = arm_flight_recorder(directory=str(dump_dir), max_files=5)
+    try:
+        events = [
+            BUS.publish("degradation", "M", f"boom {i}", data={"kind": "sync_degraded"})
+            for i in range(12)
+        ]
+        assert recorder.dump_count == 12
+        files = sorted(dump_dir.glob("flight_*.json"))
+        assert len(files) == 5, "flood must converge to the retention cap"
+        surviving = {int(f.name.split("_")[1]) for f in files}
+        newest = {e.seq for e in events[-5:]}
+        assert surviving == newest, "eviction must drop oldest seqs first"
+    finally:
+        disarm_flight_recorder()
+        set_telemetry_enabled(False)
+        BUS.clear()
+
+
+def test_disk_retention_never_touches_foreign_files(tmp_path):
+    set_telemetry_enabled(True)
+    dump_dir = tmp_path / "flight"
+    dump_dir.mkdir()
+    (dump_dir / "notes.txt").write_text("keep me", encoding="utf-8")
+    (dump_dir / "flight_report.json").write_text("{}", encoding="utf-8")  # unparseable seq
+    (dump_dir / "flight_plan.md").write_text("# keep", encoding="utf-8")
+    recorder = arm_flight_recorder(directory=str(dump_dir), max_files=2)
+    try:
+        for i in range(6):
+            BUS.publish("degradation", "M", f"boom {i}", data={"kind": "sync_degraded"})
+        assert (dump_dir / "notes.txt").exists()
+        assert (dump_dir / "flight_report.json").exists()
+        assert (dump_dir / "flight_plan.md").exists()
+        assert len(list(dump_dir.glob("flight_0*.json"))) == 2
+    finally:
+        disarm_flight_recorder()
+        set_telemetry_enabled(False)
+        BUS.clear()
+
+
+def test_max_files_env_knob(tmp_path, monkeypatch):
+    monkeypatch.setenv("TM_TPU_FLIGHT_MAX_FILES", "3")
+    assert FlightRecorder(directory=str(tmp_path)).max_files == 3
+    monkeypatch.setenv("TM_TPU_FLIGHT_MAX_FILES", "not-a-number")
+    from torchmetrics_tpu._observability.flight import DEFAULT_MAX_FILES
+
+    assert FlightRecorder(directory=str(tmp_path)).max_files == DEFAULT_MAX_FILES
+    monkeypatch.setenv("TM_TPU_FLIGHT_MAX_FILES", "0")
+    assert FlightRecorder(directory=str(tmp_path)).max_files == 1  # floor: keep latest
+    # explicit ctor arg wins over the env
+    assert FlightRecorder(directory=str(tmp_path), max_files=9).max_files == 9
+
+
+# ------------------------------------------------------------ perf regression
+def test_perf_regression_dump_carries_profiling_section(flight, tmp_path):
+    from torchmetrics_tpu._observability.profiling import (
+        LEDGER,
+        reset_ledger,
+        set_profiling_enabled,
+    )
+
+    reset_ledger()
+    set_profiling_enabled(True)
+    try:
+        with trace_context("soak"):
+            for _ in range(200):
+                LEDGER.record_step("update_compiled", "MeanMetric", 0.001)
+            for _ in range(10):
+                LEDGER.record_step("update_compiled", "MeanMetric", 0.010)
+        assert flight.dump_count == 1
+        (dump,) = flight.dumps()
+        assert dump["trigger"]["kind"] == "perf_regression"
+        assert dump["seam"] == "update_compiled"  # data seam wins over the table
+        assert dump["trigger"]["data"]["trace_id"] == dump["trace_id"]
+        prof = dump["profiling"]
+        seams = {r["seam"] for r in prof["ledger"]["seams"]}
+        assert "update_compiled" in seams
+        assert prof["ledger"]["regressions"] == {"update_compiled": 1}
+        assert isinstance(prof["tenant_costs"], dict)
+        # the on-disk artifact carries the same profiling section
+        (path,) = (tmp_path / "flight").glob("flight_*_perf_regression.json")
+        on_disk = json.loads(path.read_text(encoding="utf-8"))
+        assert on_disk["profiling"]["ledger"]["regressions"] == {"update_compiled": 1}
+    finally:
+        set_profiling_enabled(False)
+        reset_ledger()
+
+
+def test_ordinary_dumps_carry_no_profiling_section(flight):
+    BUS.publish("degradation", "M", "boom", data={"kind": "sync_degraded"})
+    (dump,) = flight.dumps()
+    assert "profiling" not in dump

@@ -1,0 +1,98 @@
+"""Tier-1 gate: the telemetry export schema matches the checked-in manifest.
+
+The observability twin of the recompile golden: dashboards and alert rules
+key on exported family names and label schemas, so an accidental rename,
+drop, or new label dimension must fail CI until the manifest is
+regenerated on purpose (``python tools/perf_manifest.py --write``).
+"""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from torchmetrics_tpu._observability.export import EXPORT_SCHEMA
+from torchmetrics_tpu._observability.manifest import (
+    MANIFEST_PATH,
+    MANIFEST_VERSION,
+    check_schema,
+    load_manifest,
+    schema_to_json,
+)
+
+
+def test_manifest_file_is_checked_in_and_current():
+    problems = check_schema(load_manifest())
+    assert problems == [], (
+        "export schema diverged from the perf manifest; if intentional run"
+        " `python tools/perf_manifest.py --write` and commit the result:\n- "
+        + "\n- ".join(problems)
+    )
+
+
+def test_manifest_file_shape():
+    blob = json.loads(MANIFEST_PATH.read_text(encoding="utf-8"))
+    assert blob["version"] == MANIFEST_VERSION
+    assert blob["families"] == schema_to_json()
+    # canonical form: families sorted, label lists sorted
+    fams = list(blob["families"])
+    assert fams == sorted(fams)
+    for spec in blob["families"].values():
+        assert spec["labels"] == sorted(spec["labels"])
+
+
+def test_check_schema_detects_drift():
+    manifest = schema_to_json()
+    assert check_schema(manifest) == []
+    assert check_schema({}) != []  # missing manifest is a failure, not a pass
+    # removed family
+    broken = dict(manifest)
+    removed = broken.pop(sorted(broken)[0])
+    assert any("absent from the manifest" in p for p in check_schema(broken))
+    # phantom family
+    broken = {**manifest, "zz_ghost": removed}
+    assert any("no longer exported" in p for p in check_schema(broken))
+    # kind flip
+    fam = sorted(manifest)[0]
+    broken = {**manifest, fam: {**manifest[fam], "kind": "weird"}}
+    assert any("kind changed" in p for p in check_schema(broken))
+    # label drift
+    broken = {**manifest, fam: {**manifest[fam], "labels": ["rogue"]}}
+    assert any("label schema changed" in p for p in check_schema(broken))
+
+
+def test_manifest_covers_every_profiling_family():
+    families = load_manifest()
+    for expected in (
+        "profiling_enabled",
+        "profile_device_seconds",
+        "profile_flops",
+        "profile_steps",
+        "profile_unattributed_steps",
+        "profile_mfu",
+        "profile_roofline_ceiling",
+        "profile_compile_seconds",
+        "pool_cost_device_seconds",
+        "pool_cost_flops",
+        "pool_cost_state_byte_updates",
+        "latency_hist_seconds",
+    ):
+        assert expected in families, expected
+        assert families[expected] == {
+            "kind": EXPORT_SCHEMA[expected]["kind"],
+            "labels": sorted(EXPORT_SCHEMA[expected]["labels"]),
+        }
+
+
+def test_manifest_cli_check_passes(capsys):
+    import sys
+
+    sys.path.insert(0, str(MANIFEST_PATH.parents[2] / "tools"))
+    try:
+        import perf_manifest
+    finally:
+        sys.path.pop(0)
+    assert perf_manifest.main(["--check"]) == 0
+    out = capsys.readouterr().out
+    assert "matches manifest" in out

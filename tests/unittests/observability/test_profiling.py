@@ -1,0 +1,416 @@
+"""Unit tests for continuous profiling & cost attribution (OBSERVABILITY.md).
+
+Covers the cost model (XLA cost extraction, ceilings resolution, roofline
+math), the process-wide cost ledger (seam/class buckets, executable
+compile-seconds surface, MFU gauges), the perf-anomaly detector (EWMA+MAD
+baseline, sustained-regression triggering, cooldown, both-switches bus
+contract), seam wiring through the real metric/pool paths, per-tenant cost
+apportionment, and the ``tools/perf_report.py`` attribution report.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from pathlib import Path
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu._observability import (
+    BUS,
+    REGISTRY,
+    set_profiling_enabled,
+    set_telemetry_enabled,
+)
+from torchmetrics_tpu._observability.costs import (
+    CEILINGS_PATH,
+    DEFAULT_HBM_BYTES_PER_S,
+    DEFAULT_PEAK_FLOPS,
+    Ceilings,
+    ExecutableCost,
+    extract_cost,
+    get_ceilings,
+    load_measured_ceilings,
+    set_ceilings,
+)
+from torchmetrics_tpu._observability.profiling import (
+    LEDGER,
+    CostLedger,
+    SEAM_KINDS,
+    owner_class,
+    profiling_enabled,
+    reset_ledger,
+)
+
+REPO_ROOT = Path(__file__).resolve().parents[3]
+
+
+@pytest.fixture()
+def profiling():
+    """Profiling on, ledger + registry pristine before and after."""
+    reset_ledger()
+    REGISTRY.reset()
+    BUS.clear()
+    set_profiling_enabled(True)
+    yield LEDGER
+    set_profiling_enabled(False)
+    set_telemetry_enabled(False)
+    reset_ledger()
+    REGISTRY.reset()
+    BUS.clear()
+    set_ceilings(None)
+
+
+# ------------------------------------------------------------------ cost model
+class _FakeCompiled:
+    def __init__(self, analysis):
+        self._analysis = analysis
+
+    def cost_analysis(self):
+        if isinstance(self._analysis, Exception):
+            raise self._analysis
+        return self._analysis
+
+
+def test_extract_cost_accepts_dict_and_list_shapes():
+    want = ExecutableCost(flops=10.0, bytes_accessed=4.0)
+    assert extract_cost(_FakeCompiled({"flops": 10.0, "bytes accessed": 4.0})) == want
+    assert extract_cost(_FakeCompiled([{"flops": 10.0, "bytes accessed": 4.0}])) == want
+
+
+def test_extract_cost_degrades_to_none():
+    assert extract_cost(_FakeCompiled(RuntimeError("no analysis"))) is None
+    assert extract_cost(_FakeCompiled(None)) is None
+    assert extract_cost(_FakeCompiled([])) is None
+    assert extract_cost(_FakeCompiled({"flops": 0.0, "bytes accessed": 0.0})) is None
+    assert extract_cost(_FakeCompiled({"flops": "garbage"})) is None
+
+
+def test_roofline_math():
+    ceil = Ceilings(peak_flops=100.0, hbm_bytes_per_s=10.0, source="test")
+    # AI = 20/4 = 5 flops/byte -> ceiling = 5 * 10 / 100 = 0.5
+    cost = ExecutableCost(flops=20.0, bytes_accessed=4.0)
+    assert cost.arithmetic_intensity == pytest.approx(5.0)
+    assert cost.roofline_ceiling(ceil) == pytest.approx(0.5)
+    # compute-bound kernels clamp at 1.0
+    fat = ExecutableCost(flops=1000.0, bytes_accessed=1.0)
+    assert fat.roofline_ceiling(ceil) == 1.0
+    # mfu: 20 flops in 1s at peak 100 -> 0.2
+    assert cost.mfu(1.0, ceil) == pytest.approx(0.2)
+    assert cost.mfu(0.0, ceil) == 0.0
+
+
+def test_ceilings_resolution_order(monkeypatch, tmp_path):
+    # env beats everything
+    monkeypatch.setenv("TM_TPU_PEAK_FLOPS", "1e12")
+    monkeypatch.setenv("TM_TPU_HBM_BW", "1e11")
+    set_ceilings(None)
+    ceil = get_ceilings()
+    assert ceil.source == "env"
+    assert ceil.peak_flops == pytest.approx(1e12)
+    # explicit measured JSON beats defaults
+    monkeypatch.delenv("TM_TPU_PEAK_FLOPS")
+    monkeypatch.delenv("TM_TPU_HBM_BW")
+    blob = {"version": 1, "peak_flops": 2e12, "hbm_bytes_per_s": 3e11}
+    path = tmp_path / "ceilings.json"
+    path.write_text(json.dumps(blob), encoding="utf-8")
+    monkeypatch.setenv("TM_TPU_CEILINGS_JSON", str(path))
+    set_ceilings(None)
+    ceil = get_ceilings()
+    assert ceil.source.startswith("measured:")
+    assert ceil.peak_flops == pytest.approx(2e12)
+    # malformed file degrades to the checked-in/default chain, never raises
+    path.write_text("not json", encoding="utf-8")
+    set_ceilings(None)
+    assert get_ceilings().peak_flops > 0
+    set_ceilings(None)
+
+
+def test_checked_in_ceilings_artifact_loads_and_matches_bench_constants():
+    """The committed roofline_ceilings.json must parse AND agree with the
+    bench suite's v5e constants — one source of truth for the denominators."""
+    ceil = load_measured_ceilings(CEILINGS_PATH)
+    assert ceil is not None, f"unreadable {CEILINGS_PATH}"
+    assert ceil.peak_flops == pytest.approx(DEFAULT_PEAK_FLOPS)
+    assert ceil.hbm_bytes_per_s == pytest.approx(DEFAULT_HBM_BYTES_PER_S)
+    bench_src = (REPO_ROOT / "bench.py").read_text(encoding="utf-8")
+    peak = float(re.search(r"_PEAK_BF16_FLOPS\s*=\s*([\d.e]+)", bench_src).group(1))
+    hbm = float(re.search(r"_HBM_BYTES_PER_S\s*=\s*([\d.e]+)", bench_src).group(1))
+    assert peak == pytest.approx(DEFAULT_PEAK_FLOPS)
+    assert hbm == pytest.approx(DEFAULT_HBM_BYTES_PER_S)
+
+
+def test_owner_class_parsing():
+    assert owner_class("StreamPool[BinaryAccuracy]") == "BinaryAccuracy"
+    assert owner_class("SpmdEngine[FrechetInceptionDistance]") == "FrechetInceptionDistance"
+    assert owner_class("torchmetrics_tpu.aggregation.MeanMetric") == "MeanMetric"
+    assert owner_class("MeanMetric") == "MeanMetric"
+
+
+# ---------------------------------------------------------------------- ledger
+def test_ledger_buckets_and_attribution(profiling):
+    led = profiling
+    led.note_executable(
+        owner="m.MeanMetric",
+        kind="auto_update",
+        digest="abc123def456789",
+        cost=ExecutableCost(flops=100.0, bytes_accessed=50.0),
+        compile_seconds=0.5,
+    )
+    for _ in range(4):
+        led.record_step("update_compiled", "MeanMetric", 0.01)
+    # a seam with no cost claim: wall time bucketed, flops unattributed
+    led.record_step("update_jit", "MeanMetric", 0.02)
+    snap = led.snapshot()
+    rows = {(r["seam"], r["class"]): r for r in snap["seams"]}
+    auto = rows[("update_compiled", "MeanMetric")]
+    assert auto["steps"] == 4
+    assert auto["device_seconds"] == pytest.approx(0.04)
+    assert auto["flops"] == pytest.approx(400.0)
+    assert auto["unattributed_steps"] == 0
+    jit = rows[("update_jit", "MeanMetric")]
+    assert jit["unattributed_steps"] == 1
+    assert "flops" in jit and jit["flops"] == 0.0
+    # executable surface keyed by digest prefix, compile seconds accrued
+    assert snap["executables"]["abc123def456"]["compile_seconds"] == pytest.approx(0.5)
+    assert led.total_device_seconds() == pytest.approx(0.06)
+
+
+def test_ledger_mfu_gauge_closed_form(profiling):
+    led = profiling
+    set_ceilings(Ceilings(peak_flops=1000.0, hbm_bytes_per_s=100.0, source="test"))
+    led.note_executable(
+        owner="m.M",
+        kind="auto_update",
+        digest="d1",
+        cost=ExecutableCost(flops=50.0, bytes_accessed=10.0),
+    )
+    led.record_step("update_compiled", "M", 0.5)
+    gauges = led.gauges()
+    entry = gauges["update_compiled|M"]
+    # mfu = 50 / (0.5 * 1000) = 0.1; ceiling = (50/10) * 100 / 1000 = 0.5
+    assert entry["mfu"] == pytest.approx(0.1)
+    assert entry["roofline_ceiling"] == pytest.approx(0.5)
+    row = next(r for r in led.snapshot()["seams"] if r["seam"] == "update_compiled")
+    assert row["mfu"] == pytest.approx(0.1)
+    assert row["roofline_ceiling"] == pytest.approx(0.5)
+
+
+def test_ledger_executable_cap(profiling):
+    led = profiling
+    for i in range(300):
+        led.note_executable(owner="m.M", kind="auto_update", digest=f"{i:015d}", cost=None)
+    assert len(led.snapshot()["executables"]) <= 256
+
+
+def test_seam_kinds_cover_every_profiled_seam():
+    assert set(SEAM_KINDS) == {
+        "update_compiled",
+        "forward_compiled",
+        "update_jit",
+        "update_scan",
+        "spmd_step",
+        "stream_step",
+    }
+
+
+# ------------------------------------------------------------ anomaly detector
+def _fresh_ledger(warmup=16, sustain=4):
+    led = CostLedger()
+    led.warmup = warmup
+    led.sustain = sustain
+    return led
+
+
+def test_regression_triggers_after_sustained_run(profiling):
+    set_telemetry_enabled(True)
+    led = _fresh_ledger()
+    for _ in range(30):
+        led.record_step("update_compiled", "M", 0.001)
+    BUS.clear()
+    # a single spike must NOT trigger (sustain=4)
+    led.record_step("update_compiled", "M", 0.05)
+    assert not [e for e in BUS.events() if e.kind == "perf_regression"]
+    for _ in range(4):
+        led.record_step("update_compiled", "M", 0.05)
+    events = [e for e in BUS.events() if e.kind == "perf_regression"]
+    assert len(events) == 1
+    data = events[0].data
+    assert data["seam"] == "update_compiled"
+    assert data["class"] == "M"
+    assert data["observed_seconds"] == pytest.approx(0.05)
+    assert data["baseline_seconds"] == pytest.approx(0.001, rel=0.5)
+    assert data["threshold_seconds"] < 0.05
+    # cooldown: continued slowness does not re-trigger immediately
+    for _ in range(20):
+        led.record_step("update_compiled", "M", 0.05)
+    assert len([e for e in BUS.events() if e.kind == "perf_regression"]) == 1
+    assert led.snapshot()["regressions"] == {"update_compiled": 1}
+
+
+def test_regression_baseline_frozen_during_high_run(profiling):
+    set_telemetry_enabled(True)
+    led = _fresh_ledger()
+    for _ in range(30):
+        led.record_step("update_compiled", "M", 0.001)
+    ewma_before = led.snapshot()["baselines"]["update_compiled"]["ewma_seconds"]
+    for _ in range(3):  # below sustain: high samples, no trigger yet
+        led.record_step("update_compiled", "M", 0.05)
+    ewma_after = led.snapshot()["baselines"]["update_compiled"]["ewma_seconds"]
+    # the regression must not EWMA-absorb into its own threshold
+    assert ewma_after == pytest.approx(ewma_before)
+
+
+def test_regression_detector_needs_no_warmup_violation(profiling):
+    """Inside the warmup window nothing triggers, however wild the samples."""
+    set_telemetry_enabled(True)
+    led = _fresh_ledger(warmup=50)
+    for i in range(49):
+        led.record_step("update_compiled", "M", 0.001 if i % 2 else 10.0)
+    assert not [e for e in BUS.events() if e.kind == "perf_regression"]
+
+
+def test_regression_bus_event_requires_telemetry_switch(profiling):
+    """Ledger accounting works with profiling alone; the bus publish (and so
+    the flight dump) additionally needs OBS.enabled — documented contract."""
+    set_telemetry_enabled(False)
+    assert profiling_enabled()
+    led = _fresh_ledger()
+    for _ in range(30):
+        led.record_step("update_compiled", "M", 0.001)
+    for _ in range(10):
+        led.record_step("update_compiled", "M", 0.05)
+    assert not [e for e in BUS.events() if e.kind == "perf_regression"]
+    # the ledger still counted the trigger locally
+    assert led.snapshot()["regressions"] == {"update_compiled": 1}
+
+
+# ------------------------------------------------------------------ seam wiring
+def test_metric_auto_update_feeds_ledger(profiling):
+    from torchmetrics_tpu.aggregation import MeanMetric
+
+    m = MeanMetric()
+    for i in range(5):
+        m.update(jnp.ones((4,)) * i)
+    snap = LEDGER.snapshot()
+    rows = {(r["seam"], r["class"]): r for r in snap["seams"]}
+    row = rows[("update_compiled", "MeanMetric")]
+    assert row["steps"] >= 1
+    assert row["device_seconds"] > 0
+    # CPU jax exposes cost_analysis, so flops attribution is live end-to-end
+    assert row["flops"] > 0
+    assert row["unattributed_steps"] == 0
+    assert any(rec["kind"] == "auto_update" for rec in snap["executables"].values())
+
+
+def test_profiling_off_records_nothing():
+    reset_ledger()
+    set_profiling_enabled(False)
+    from torchmetrics_tpu.aggregation import MeanMetric
+
+    m = MeanMetric()
+    for i in range(3):
+        m.update(jnp.ones((4,)) * i)
+    assert LEDGER.snapshot()["seams"] == []
+
+
+def test_pool_tenant_cost_apportionment(profiling):
+    set_telemetry_enabled(True)
+    from torchmetrics_tpu._streams import StreamPool
+    from torchmetrics_tpu.aggregation import MeanMetric
+
+    pool = StreamPool(MeanMetric(), capacity=8)
+    ids = np.array([pool.attach() for _ in range(4)])
+    for step in range(6):
+        pool.update(ids, jnp.ones((4, 3)) * step)
+    totals = REGISTRY.counter_totals()
+    per_stream = {
+        k.partition("=")[2]: v
+        for k, v in totals.items()
+        if k.startswith("pool_cost_device_seconds|")
+    }
+    assert set(per_stream) == {str(s) for s in ids.tolist()}
+    # equal-share apportionment: every tenant in a uniform batch pays the same
+    vals = list(per_stream.values())
+    assert all(v == pytest.approx(vals[0]) for v in vals)
+    # the metered seconds reconcile with the ledger's stream_step bucket
+    row = next(r for r in LEDGER.snapshot()["seams"] if r["seam"] == "stream_step")
+    assert sum(vals) == pytest.approx(row["device_seconds"], rel=1e-6)
+    # flops split the executable's cost claim equally too
+    flops = [v for k, v in totals.items() if k.startswith("pool_cost_flops|")]
+    assert flops and all(v == pytest.approx(flops[0]) for v in flops)
+    # predicted state bytes metered per applied row (MeanMetric has an exact claim)
+    sbytes = [v for k, v in totals.items() if k.startswith("pool_cost_state_byte_updates|")]
+    assert sbytes and all(v > 0 for v in sbytes)
+
+
+# ------------------------------------------------------------------ perf report
+def test_perf_report_attribution_and_json(profiling, tmp_path):
+    set_telemetry_enabled(True)
+    import sys
+
+    sys.path.insert(0, str(REPO_ROOT / "tools"))
+    try:
+        import perf_report
+    finally:
+        sys.path.pop(0)
+
+    from torchmetrics_tpu._streams import StreamPool
+    from torchmetrics_tpu.aggregation import MeanMetric
+
+    pool = StreamPool(MeanMetric(), capacity=8)
+    ids = np.array([pool.attach() for _ in range(4)])
+    for step in range(8):
+        pool.update(ids, jnp.ones((4, 3)) * step)
+    m = MeanMetric()
+    for i in range(6):
+        m.update(jnp.ones((2,)) * i)
+
+    snap_path = tmp_path / "snap.json"
+    snap_path.write_text(json.dumps(REGISTRY.to_json()), encoding="utf-8")
+    ledger, tenants, source = perf_report.load_snapshot(str(snap_path))
+    report = perf_report.build_report(ledger, tenants, source)
+    att = report["attribution"]
+    # acceptance: >= 95% of measured step device time attributed
+    assert att["time_bucketed_fraction"] == 1.0
+    assert att["flops_attributed_fraction"] >= 0.95
+    assert att["tenant_metered_fraction"] >= 0.95
+    assert report["total_device_seconds"] > 0
+    assert report["compiles"], "compile-seconds surface missing"
+    assert report["tenants"], "tenant table missing"
+    # the human renderer and --json both consume the same report
+    text = perf_report.render_text(report)
+    assert "stream_step" in text and "tenant" in text
+    json.dumps(report)  # CI consumes --json: must be serializable
+
+
+def test_perf_report_reads_flight_dump(profiling, tmp_path):
+    set_telemetry_enabled(True)
+    import sys
+
+    sys.path.insert(0, str(REPO_ROOT / "tools"))
+    try:
+        import perf_report
+    finally:
+        sys.path.pop(0)
+    from torchmetrics_tpu._observability import arm_flight_recorder, disarm_flight_recorder
+
+    recorder = arm_flight_recorder(directory=str(tmp_path / "flight"))
+    try:
+        led = LEDGER
+        for _ in range(200):
+            led.record_step("update_compiled", "M", 0.001)
+        for _ in range(10):
+            led.record_step("update_compiled", "M", 0.05)
+        dumps = recorder.dumps()
+        assert dumps and dumps[0]["trigger"]["kind"] == "perf_regression"
+        dump_file = next((tmp_path / "flight").glob("flight_*_perf_regression.json"))
+        ledger, tenants, source = perf_report.load_snapshot(str(dump_file))
+        report = perf_report.build_report(ledger, tenants, source)
+        assert report["profiling_enabled"]
+        assert report["regressions"] == {"update_compiled": 1}
+        assert "flight dump" in report["source"]
+    finally:
+        disarm_flight_recorder()

@@ -222,6 +222,36 @@ def test_aot_modules_scan_clean():
     assert cache_mod["classes"]["AotCache"]["fields"]["_stats"]["guards"] == ["_lock"]
 
 
+def test_profiling_modules_scan_clean():
+    """ISSUE-17 acceptance: the continuous-profiling modules (cost ledger,
+    ceilings/cost model, export-schema manifest) are clean under the FULL
+    rule set with ZERO baseline additions — no entry in the checked-in
+    baseline may reference them, and a fresh scan must find nothing new
+    (all ledger mutation is under one lock; costs/manifest hold no shared
+    mutable state at all)."""
+    new_modules = (
+        "torchmetrics_tpu/_observability/profiling.py",
+        "torchmetrics_tpu/_observability/costs.py",
+        "torchmetrics_tpu/_observability/manifest.py",
+    )
+    result, _ = _scan()
+    findings = [v for v in result.violations if v.path in new_modules]
+    assert not findings, [v.render() for v in findings]
+    baseline = load_baseline(BASELINE)
+    leaked = [e for e in baseline.values() if e.path in new_modules]
+    assert not leaked, f"baseline entries must never cover the ISSUE-17 modules: {leaked}"
+    # guard-map manifest: the shared ledger is all-guarded under its one
+    # lock; the cost/manifest helpers carry no concurrency surface at all
+    modules = json.loads(THREAD_SAFETY_PATH.read_text(encoding="utf-8"))["modules"]
+    ledger_mod = modules["torchmetrics_tpu/_observability/profiling.py"]
+    assert ledger_mod["verdict"] == "guarded", ledger_mod["verdict"]
+    fields = ledger_mod["classes"]["CostLedger"]["fields"]
+    for field in ("_costs", "_executables", "_buckets", "_baselines"):
+        assert fields[field]["guards"] == ["_lock"], (field, fields[field])
+    for path in new_modules[1:]:
+        assert modules[path]["verdict"] == "no_concurrency", (path, modules[path])
+
+
 def test_checked_in_thread_safety_matches_code():
     """Staleness gate: thread_safety.json silently rots as the runtime grows
     threads unless a fresh scan reproduces it exactly (same contract as the

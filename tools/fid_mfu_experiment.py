@@ -3,8 +3,19 @@
 Sweeps batch size and measures achieved FLOP/s vs the v5e bf16 peak using
 XLA's own cost analysis, to locate the InceptionV3 trunk's utilization
 ceiling. Run on the real chip: ``python tools/fid_mfu_experiment.py``.
+
+``--json [PATH]`` emits the sweep as a machine-readable document in the
+``_analysis/roofline_ceilings.json`` schema (version 1: ``peak_flops``,
+``hbm_bytes_per_s``, per-batch ``measurements``). Checking that file in
+makes the measured ceilings the denominators of the live
+``tmtpu_profile_mfu`` / ``tmtpu_profile_roofline_ceiling`` gauges
+(``torchmetrics_tpu/_observability/costs.py`` resolves it ahead of the
+paper constants), so dashboards divide by what THIS fleet's chips actually
+sustain rather than a datasheet number.
 """
 
+import argparse
+import json
 import os
 import sys
 import time
@@ -56,7 +67,18 @@ def bench(ext, batch, stream=16, reps=3):
     return rate, mfu, flops, roofline
 
 
-def main():
+def main(argv=None):
+    parser = argparse.ArgumentParser(description=__doc__)
+    parser.add_argument(
+        "--json",
+        nargs="?",
+        const="-",
+        default=None,
+        metavar="PATH",
+        help="emit the sweep as roofline_ceilings.json (version 1); '-' or no value = stdout",
+    )
+    args = parser.parse_args(argv)
+    rows = []
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
@@ -64,6 +86,17 @@ def main():
         for batch in (128, 256, 512):
             ext = InceptionFeatureExtractor(feature="2048")
             rate, mfu, flops, roofline = bench(ext, batch)
+            rows.append(
+                {
+                    "batch": batch,
+                    "images_per_s": rate,
+                    "mfu": mfu,
+                    "flops_per_image": flops / batch,
+                    "roofline_ceiling": roofline,
+                }
+            )
+            if args.json is not None:
+                continue
             line = (
                 f"batch={batch:4d}  imgs/s={rate:9.1f}  MFU={mfu:6.1%}"
                 f"  flops/img={flops / batch / 1e9:.2f} GF"
@@ -71,6 +104,25 @@ def main():
             if roofline:
                 line += f"  HBM-roofline={roofline:6.1%}  of-roofline={mfu / roofline:6.1%}"
             print(line)
+    if args.json is not None:
+        blob = {
+            "version": 1,
+            # ceilings stay the bench constants: the sweep MEASURES achieved
+            # MFU against them; a fleet that derates peak/bandwidth edits
+            # these two numbers (or sets TM_TPU_PEAK_FLOPS/TM_TPU_HBM_BW)
+            "peak_flops": PEAK,
+            "hbm_bytes_per_s": HBM_BW,
+            "source": "tools/fid_mfu_experiment.py",
+            "backend": jax.default_backend(),
+            "measurements": rows,
+        }
+        text = json.dumps(blob, indent=1, sort_keys=True) + "\n"
+        if args.json == "-":
+            sys.stdout.write(text)
+        else:
+            with open(args.json, "w", encoding="utf-8") as fh:
+                fh.write(text)
+            print(f"wrote {args.json}", file=sys.stderr)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,63 @@
+#!/usr/bin/env python
+"""Golden export-schema manifest CLI for the telemetry exposition.
+
+Usage:
+    python tools/perf_manifest.py --check       # CI gate (default)
+    python tools/perf_manifest.py --write       # regenerate the manifest
+
+The manifest (``torchmetrics_tpu/_analysis/perf_manifest.json``) pins every
+metric family the exporters may emit — name, sample kind (counter / gauge /
+summary / histogram), and the complete allowed label set — frozen from
+:data:`torchmetrics_tpu._observability.export.EXPORT_SCHEMA`. Dashboards
+and alert rules key on these names; a silent rename or a new unbounded
+label is an outage for them. ``--check`` fails (exit 1) when the schema and
+the manifest diverge, naming each added / removed / changed family. The
+tier-1 gate ``tests/unittests/observability/test_perf_manifest.py`` runs
+the same comparison on every CI pass, plus a driven-render check that live
+output never strays outside the declared schema.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from pathlib import Path
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument("--write", action="store_true", help="regenerate the manifest")
+    parser.add_argument("--check", action="store_true", help="gate the schema against the manifest")
+    args = parser.parse_args(argv)
+
+    from torchmetrics_tpu._observability.manifest import (
+        MANIFEST_PATH,
+        check_schema,
+        load_manifest,
+        write_manifest,
+    )
+
+    if args.write:
+        blob = write_manifest()
+        print(f"wrote {MANIFEST_PATH}: {len(blob['families'])} families")
+        return 0
+
+    problems = check_schema(load_manifest())
+    if problems:
+        print(f"PERF MANIFEST GATE FAILED ({len(problems)} problem(s)):")
+        for p in problems:
+            print(f"  - {p}")
+        print("regenerate intentionally with: python tools/perf_manifest.py --write")
+        return 1
+    manifest = load_manifest()
+    print(f"export schema matches manifest: {len(manifest)} families")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,245 @@
+#!/usr/bin/env python
+"""Render the continuous-profiling cost ledger as a perf report.
+
+Usage:
+    python tools/perf_report.py SNAPSHOT.json          # human tables
+    python tools/perf_report.py SNAPSHOT.json --json   # machine-readable (CI)
+    python tools/perf_report.py --live [--json]        # this process's registry
+
+``SNAPSHOT.json`` is either a registry export (``REGISTRY.to_json()`` — it
+carries a ``profiling`` ledger section) or a flight-recorder dump (a
+``perf_regression`` dump carries ``profiling.ledger`` + the per-tenant
+``pool_cost_*`` counter slice frozen at dump time). ``--live`` reads the
+in-process registry instead — useful from a REPL/soak harness after driving
+traffic with ``TM_TPU_PROFILING=1``.
+
+The report answers the four capacity/regression questions the raw
+exposition can't directly:
+
+- **Where does device time go?** Per (seam, class) buckets of measured wall
+  seconds, flops, and step counts, plus the attribution fraction — how much
+  of the measured time has an XLA cost claim behind its flops (the ``--json``
+  field CI gates on: a soak run should attribute >= 95%).
+- **How close to the roofline?** Achieved cumulative MFU vs the
+  arithmetic-intensity ceiling per seam/class, using the active ceilings
+  (env > measured ``roofline_ceilings.json`` > v5e defaults).
+- **What did compiles cost?** Trace+lower+compile wall seconds per
+  executable digest (the churn detector's cache-key world, priced).
+- **Who spends it?** Per-tenant ``stream=`` cost counters (device seconds,
+  flops, state-byte updates) from the StreamPool apportionment.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Tuple
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+REPORT_VERSION = 1
+
+
+def _tenant_costs_from_metrics(metrics: Dict[str, Any]) -> Dict[str, float]:
+    totals: Dict[str, float] = {}
+    for entry in metrics.values():
+        for key, val in entry.get("counters", {}).items():
+            if key.startswith("pool_cost_"):
+                totals[key] = totals.get(key, 0.0) + float(val)
+    return totals
+
+
+def load_snapshot(path: Optional[str]) -> Tuple[Dict[str, Any], Dict[str, float], str]:
+    """-> (ledger snapshot, flat pool_cost_* counter totals, source label)."""
+    if path is None:
+        from torchmetrics_tpu._observability.profiling import LEDGER
+        from torchmetrics_tpu._observability.telemetry import REGISTRY
+
+        tenants = {
+            k: v for k, v in REGISTRY.counter_totals().items() if k.startswith("pool_cost_")
+        }
+        return LEDGER.snapshot(), tenants, "live registry"
+    blob = json.loads(Path(path).read_text(encoding="utf-8"))
+    if "trigger" in blob:  # flight-recorder dump
+        prof = blob.get("profiling") or {}
+        return (
+            prof.get("ledger") or {},
+            {k: float(v) for k, v in (prof.get("tenant_costs") or {}).items()},
+            f"flight dump ({blob['trigger'].get('kind', '?')})",
+        )
+    # registry to_json() export
+    return (
+        blob.get("profiling") or {},
+        _tenant_costs_from_metrics(blob.get("metrics") or {}),
+        "registry export",
+    )
+
+
+def build_report(
+    ledger: Dict[str, Any], tenants: Dict[str, float], source: str
+) -> Dict[str, Any]:
+    seams: List[Dict[str, Any]] = list(ledger.get("seams") or [])
+    total_seconds = sum(r["device_seconds"] for r in seams)
+    # a step whose executable made no cost claim still has measured wall
+    # time in its bucket; its flops are unattributed. Attributed seconds
+    # pro-rate each bucket by its claimed-step fraction.
+    attributed_seconds = sum(
+        r["device_seconds"] * ((r["steps"] - r["unattributed_steps"]) / r["steps"])
+        for r in seams
+        if r["steps"]
+    )
+    tenant_rows: Dict[str, Dict[str, float]] = {}
+    for key, val in tenants.items():
+        family, _, rest = key.partition("|")
+        stream = rest.partition("=")[2] or "?"
+        tenant_rows.setdefault(stream, {})[family] = tenant_rows.setdefault(
+            stream, {}
+        ).get(family, 0.0) + float(val)
+    stream_step_seconds = sum(
+        r["device_seconds"] for r in seams if r["seam"] == "stream_step"
+    )
+    tenant_metered = sum(
+        row.get("pool_cost_device_seconds", 0.0) for row in tenant_rows.values()
+    )
+    compiles = [
+        {"digest": digest, **rec}
+        for digest, rec in sorted(
+            (ledger.get("executables") or {}).items(),
+            key=lambda kv: -kv[1].get("compile_seconds", 0.0),
+        )
+    ]
+    return {
+        "version": REPORT_VERSION,
+        "source": source,
+        "profiling_enabled": bool(ledger.get("enabled")),
+        "ceilings": ledger.get("ceilings") or {},
+        "total_device_seconds": total_seconds,
+        "attribution": {
+            # every measured step lands in a (seam, class) bucket; the flops
+            # fraction is the part backed by an XLA cost claim
+            "time_bucketed_fraction": 1.0 if seams else 0.0,
+            "flops_attributed_fraction": (
+                attributed_seconds / total_seconds if total_seconds else 0.0
+            ),
+            "tenant_metered_fraction": (
+                tenant_metered / stream_step_seconds if stream_step_seconds else None
+            ),
+        },
+        "seams": seams,
+        "compiles": compiles,
+        "compile_seconds_total": sum(c.get("compile_seconds", 0.0) for c in compiles),
+        "tenants": {k: tenant_rows[k] for k in sorted(tenant_rows)},
+        "baselines": ledger.get("baselines") or {},
+        "regressions": ledger.get("regressions") or [],
+    }
+
+
+def _fmt_s(v: float) -> str:
+    return f"{v:10.4f}"
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    out: List[str] = []
+    ceil = report["ceilings"]
+    out.append(
+        f"perf report — {report['source']} | profiling "
+        f"{'ON' if report['profiling_enabled'] else 'off'} | ceilings: "
+        f"{ceil.get('source', '?')} (peak {ceil.get('peak_flops', 0) / 1e12:.0f} TF/s,"
+        f" HBM {ceil.get('hbm_bytes_per_s', 0) / 1e9:.0f} GB/s)"
+    )
+    att = report["attribution"]
+    out.append(
+        f"device time {report['total_device_seconds']:.4f}s | flops-attributed"
+        f" {att['flops_attributed_fraction']:.1%}"
+        + (
+            f" | tenant-metered {att['tenant_metered_fraction']:.1%} of stream_step"
+            if att["tenant_metered_fraction"] is not None
+            else ""
+        )
+    )
+    if report["seams"]:
+        out.append("")
+        out.append(
+            f"{'seam':<18} {'class':<24} {'seconds':>10} {'steps':>8}"
+            f" {'MFU':>8} {'ceiling':>8} {'of-ceil':>8}"
+        )
+        for r in sorted(report["seams"], key=lambda r: -r["device_seconds"]):
+            mfu = r.get("mfu")
+            ceiling = r.get("roofline_ceiling")
+            line = (
+                f"{r['seam']:<18} {r['class']:<24} {_fmt_s(r['device_seconds'])}"
+                f" {int(r['steps']):>8}"
+            )
+            line += f" {mfu:>8.2%}" if mfu is not None else f" {'—':>8}"
+            if mfu is not None and ceiling:
+                line += f" {ceiling:>8.2%} {mfu / ceiling:>8.2%}"
+            out.append(line)
+    if report["compiles"]:
+        out.append("")
+        out.append(f"{'digest':<14} {'kind':<16} {'class':<24} {'compile s':>10}")
+        for c in report["compiles"]:
+            out.append(
+                f"{c['digest']:<14} {c.get('kind', '?'):<16} {c.get('class', '?'):<24}"
+                f" {_fmt_s(c.get('compile_seconds', 0.0))}"
+            )
+        out.append(f"compile seconds total: {report['compile_seconds_total']:.4f}")
+    if report["tenants"]:
+        out.append("")
+        out.append(
+            f"{'tenant':<20} {'device s':>10} {'flops':>14} {'state bytes':>14}"
+        )
+        rows = sorted(
+            report["tenants"].items(),
+            key=lambda kv: -kv[1].get("pool_cost_device_seconds", 0.0),
+        )
+        for stream, row in rows:
+            out.append(
+                f"{stream:<20} {_fmt_s(row.get('pool_cost_device_seconds', 0.0))}"
+                f" {row.get('pool_cost_flops', 0.0):>14.3e}"
+                f" {row.get('pool_cost_state_byte_updates', 0.0):>14.3e}"
+            )
+    if report["regressions"]:
+        out.append("")
+        out.append(
+            f"perf regressions recorded: {sum(report['regressions'].values())}"
+        )
+        for seam, n in sorted(report["regressions"].items()):
+            base = report["baselines"].get(seam, {})
+            out.append(
+                f"  {seam}: {n} trigger(s); baseline"
+                f" {base.get('ewma_seconds', 0.0):.6f}s"
+            )
+    return "\n".join(out) + "\n"
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter
+    )
+    parser.add_argument(
+        "snapshot", nargs="?", default=None,
+        help="registry to_json() export or flight dump (omit with --live)",
+    )
+    parser.add_argument(
+        "--live", action="store_true",
+        help="read the in-process registry/ledger instead of a file",
+    )
+    parser.add_argument("--json", action="store_true", help="machine-readable output")
+    args = parser.parse_args(argv)
+    if args.snapshot is None and not args.live:
+        parser.error("pass a SNAPSHOT.json or --live")
+    ledger, tenants, source = load_snapshot(None if args.live else args.snapshot)
+    report = build_report(ledger, tenants, source)
+    if args.json:
+        json.dump(report, sys.stdout, indent=1, sort_keys=True)
+        sys.stdout.write("\n")
+    else:
+        sys.stdout.write(render_text(report))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -49,6 +49,7 @@ from torchmetrics_tpu._analysis.locksan import check_access as _san_check
 from torchmetrics_tpu._analysis.locksan import new_lock as _san_lock
 from torchmetrics_tpu._aot import artifacts as _artifacts
 from torchmetrics_tpu._aot.state import AOT
+from torchmetrics_tpu._observability import costs as _costs
 from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.events import BUS as _BUS
 from torchmetrics_tpu._observability.state import OBS as _OBS
@@ -77,6 +78,20 @@ def _fsync_dir(directory: Path) -> None:
         os.fsync(fd)
     finally:
         os.close(fd)
+
+
+def _cost_from_header(header: Optional[Dict[str, Any]]) -> Optional[Any]:
+    """Rebuild the stored cost claim from an artifact header, if present."""
+    if not header:
+        return None
+    try:
+        flops = float(header.get("cost_flops", 0.0) or 0.0)
+        bytes_accessed = float(header.get("cost_bytes_accessed", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        return None
+    if flops <= 0.0 and bytes_accessed <= 0.0:
+        return None
+    return _costs.ExecutableCost(flops=flops, bytes_accessed=bytes_accessed)
 
 
 def _aval_signature(args: tuple) -> Tuple[str, Tuple[Any, ...]]:
@@ -157,27 +172,32 @@ class AotCache:  # concurrency: shared hot paths bump stats while benches/tests 
         return self.directory / f"{kind}.{digest[:24]}{_SUFFIX}"
 
     # ------------------------------------------------------------------- load
-    def load(self, kind: str, digest: str) -> Tuple[Optional[Callable], Optional[str], Optional[str]]:
-        """Rehydrate one artifact: ``(callable, None, fmt)`` on a verified
-        hit, ``(None, None, None)`` on a clean miss (no artifact),
-        ``(None, reason, fmt-or-None)`` when an artifact exists but cannot
-        be trusted or loaded — ``fmt`` names the stored format so the caller
-        can rebuild around a format whose payloads fail to deserialize on
-        this runtime (see ``build_artifact(avoid_format=...)``)."""
+    def load(
+        self, kind: str, digest: str
+    ) -> Tuple[Optional[Callable], Optional[str], Optional[str], Optional[Dict]]:
+        """Rehydrate one artifact: ``(callable, None, fmt, header)`` on a
+        verified hit, ``(None, None, None, None)`` on a clean miss (no
+        artifact), ``(None, reason, fmt-or-None, header-or-None)`` when an
+        artifact exists but cannot be trusted or loaded — ``fmt`` names the
+        stored format so the caller can rebuild around a format whose
+        payloads fail to deserialize on this runtime (see
+        ``build_artifact(avoid_format=...)``). The header rides along so a
+        disk hit recovers compile-time metadata (the profiling layer's
+        ``cost_flops``/``cost_bytes_accessed``) without re-lowering."""
         path = self.artifact_path(kind, digest)
         try:
             raw = path.read_bytes()
         except FileNotFoundError:
-            return None, None, None
+            return None, None, None, None
         except OSError as err:
-            return None, f"unreadable artifact: {type(err).__name__}", None
+            return None, f"unreadable artifact: {type(err).__name__}", None, None
         header, payload, reason = self._parse(raw, digest)
         if header is None:
-            return None, reason, None
+            return None, reason, None, None
         fn = _artifacts.load_artifact(header["format"], payload)
         if fn is None:
-            return None, f"deserialization failed (format={header['format']})", header["format"]
-        return fn, None, header["format"]
+            return None, f"deserialization failed (format={header['format']})", header["format"], header
+        return fn, None, header["format"], header
 
     def _parse(self, raw: bytes, digest: str) -> Tuple[Optional[Dict], bytes, Optional[str]]:
         if not raw.startswith(_MAGIC):
@@ -509,13 +529,19 @@ class _AotDispatch:
         if cache is not None:
             try:
                 digest = _digest(self._owner, self._kind, self._key_repr, sig)
-                fn, reason, stored_fmt = cache.load(self._kind, digest)
+                fn, reason, stored_fmt, header = cache.load(self._kind, digest)
             except Exception as err:  # noqa: BLE001 - cache failure never breaks the stream
-                fn, reason, stored_fmt = None, f"cache probe failed: {type(err).__name__}: {err}", None
+                fn, reason, stored_fmt, header = (
+                    None, f"cache probe failed: {type(err).__name__}: {err}", None, None,
+                )
             if fn is not None:
                 cache._bump("hits", self._telem_obj, "hit")
                 self._resolved[sig] = fn
                 self._fast = fn if len(self._resolved) == 1 else None
+                if _OBS.profiling:
+                    # a disk hit skips lower+compile, so cost_analysis() is
+                    # unreachable — the artifact header carried it forward
+                    self._note_cost(_cost_from_header(header), digest, 0.0, "aot_hit")
                 return "hit", fn
             if reason is not None:
                 self._note_fallback(reason, cache)
@@ -527,9 +553,11 @@ class _AotDispatch:
                     avoid_fmt = stored_fmt
             else:
                 cache._bump("misses", self._telem_obj, "miss")
+        t_compile = time.perf_counter()
         compiled, fmt, payload = _artifacts.build_artifact(
             self._jit_fn, args, avoid_format=avoid_fmt, want_payload=cache is not None
         )
+        compile_seconds = time.perf_counter() - t_compile
         if compiled is None:
             # lowering failed (e.g. non-jittable leftovers): the plain jitted
             # call will surface the real error to the caller's own handler
@@ -540,14 +568,40 @@ class _AotDispatch:
             return "fallback", self._jit_fn
         self._resolved[sig] = compiled
         self._fast = compiled if len(self._resolved) == 1 else None
+        cost = _costs.extract_cost(compiled) if (cache is not None or _OBS.profiling) else None
+        if _OBS.profiling:
+            if digest is None:
+                digest = _digest(self._owner, self._kind, self._key_repr, sig)
+            self._note_cost(cost, digest, compile_seconds, "compiled")
         if cache is not None and digest is not None and fmt is not None:
-            cache.store(
-                self._kind, digest, fmt, payload,
-                {"owner": self._owner, "kind": self._kind, "key": self._key_repr},
-            )
+            meta: Dict[str, Any] = {
+                "owner": self._owner,
+                "kind": self._kind,
+                "key": self._key_repr,
+                "compile_seconds": compile_seconds,
+            }
+            if cost is not None:
+                meta["cost_flops"] = cost.flops
+                meta["cost_bytes_accessed"] = cost.bytes_accessed
+            cache.store(self._kind, digest, fmt, payload, meta)
         elif cache is not None:
             self._note_fallback("no serialization format available", cache)
         return "compiled", compiled
+
+    def _note_cost(
+        self, cost: Optional[Any], digest: Optional[str], compile_seconds: float, source: str
+    ) -> None:
+        """Report one resolved executable to the profiling cost ledger."""
+        from torchmetrics_tpu._observability.profiling import LEDGER
+
+        LEDGER.note_executable(
+            owner=self._owner,
+            kind=self._kind,
+            digest=digest or "",
+            cost=cost,
+            compile_seconds=compile_seconds,
+            source=source,
+        )
 
     def _note_fallback(self, reason: str, cache: Optional[AotCache] = None) -> None:
         cache = cache if cache is not None else get_cache() if self._use_disk else None

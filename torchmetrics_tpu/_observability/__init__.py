@@ -32,6 +32,14 @@ package makes them *observable* in production:
 - **SLOs** (``slo.py``) — declarative latency/error-budget objectives with
   burn-rate evaluation over the collected signals and a readiness-probe
   :func:`health_report`.
+- **Continuous profiling & cost attribution** (``profiling.py``,
+  ``costs.py``) — XLA ``cost_analysis()`` captured per executable at
+  compile/AOT-load time, combined with measured step wall time into a
+  device-time cost ledger (:data:`LEDGER`): per-seam/per-class buckets,
+  live MFU and roofline-ceiling gauges, per-tenant ``pool_cost_*``
+  counters, and a rolling EWMA+MAD latency baseline whose sustained
+  regressions trigger ``perf_regression`` flight dumps
+  (``TM_TPU_PROFILING=1`` / :func:`set_profiling_enabled`).
 
 Everything is **off by default**: the disabled hot path is a single
 cached-bool branch (``state.OBS.enabled``) with no dict lookups and no
@@ -41,12 +49,27 @@ eager boundaries — never inside traced functions (CI-verified by the
 trace-safety analyzer).
 """
 
+from torchmetrics_tpu._observability.costs import (
+    Ceilings,
+    ExecutableCost,
+    extract_cost,
+    get_ceilings,
+    set_ceilings,
+)
 from torchmetrics_tpu._observability.events import BUS, EventBus, TelemetryEvent
 from torchmetrics_tpu._observability.flight import (
     FlightRecorder,
     arm_flight_recorder,
     disarm_flight_recorder,
     get_flight_recorder,
+)
+from torchmetrics_tpu._observability.profiling import (
+    LEDGER,
+    CostLedger,
+    get_ledger,
+    profiling_enabled,
+    reset_ledger,
+    set_profiling_enabled,
 )
 from torchmetrics_tpu._observability.reservoir import LatencyReservoir
 from torchmetrics_tpu._observability.slo import (
@@ -94,9 +117,13 @@ from torchmetrics_tpu._observability.tracing import (
 
 __all__ = [
     "BUS",
+    "Ceilings",
+    "CostLedger",
     "EventBus",
+    "ExecutableCost",
     "FlightRecorder",
     "HealthReport",
+    "LEDGER",
     "LatencyReservoir",
     "MetricTelemetry",
     "OBS",
@@ -117,13 +144,20 @@ __all__ = [
     "current_trace_id",
     "disarm_flight_recorder",
     "export_chrome_trace",
+    "extract_cost",
+    "get_ceilings",
     "get_flight_recorder",
+    "get_ledger",
     "get_registry",
     "health_report",
     "named_scope",
+    "profiling_enabled",
     "profiling_scopes_active",
     "report_for",
+    "reset_ledger",
+    "set_ceilings",
     "set_profile_scopes",
+    "set_profiling_enabled",
     "set_slos",
     "set_telemetry_enabled",
     "set_telemetry_sampling",

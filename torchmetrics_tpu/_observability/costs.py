@@ -1,0 +1,196 @@
+"""Hardware ceilings + XLA cost extraction for the profiling layer.
+
+The roofline model needs two kinds of numbers:
+
+- **What the executable does** — flops and bytes accessed, from XLA's own
+  ``compiled.cost_analysis()``. :func:`extract_cost` normalizes the two
+  shapes jax returns it in (a dict, or a singleton list of dicts) into an
+  :class:`ExecutableCost`, captured ONCE at compile time (``_aot/cache.py``)
+  and persisted in the artifact header so an AOT disk hit — which skips
+  compilation entirely — still recovers the cost without re-lowering.
+- **What the hardware could do** — peak flops and HBM bandwidth, the
+  denominators of the MFU and roofline gauges. :func:`get_ceilings`
+  resolves them in priority order: env overrides (``TM_TPU_PEAK_FLOPS``,
+  ``TM_TPU_HBM_BW``), a measured-ceilings JSON checked in from
+  ``tools/fid_mfu_experiment.py --json`` (``TM_TPU_CEILINGS_JSON`` or the
+  default ``_analysis/roofline_ceilings.json``), then the TPU v5e paper
+  constants the bench suite uses.
+
+With cost and ceilings in hand the gauges are closed-form::
+
+    mfu      = flops / (seconds * peak_flops)
+    ceiling  = min(1, arithmetic_intensity * hbm_bw / peak_flops)
+
+where ``arithmetic_intensity = flops / bytes_accessed``. ``ceiling`` is the
+roofline bound on MFU for a memory-bound kernel: achieved/ceiling is the
+fraction of the *attainable* (not absolute) peak, which is the number a
+kernel-optimization effort actually moves (ROADMAP item 5).
+
+This module must stay import-light (no jax, no numpy): the ledger imports
+it at module scope, and the ledger is imported by ``metric.py``.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from dataclasses import dataclass
+from pathlib import Path
+from typing import Any, Dict, Optional
+
+__all__ = [
+    "ExecutableCost",
+    "Ceilings",
+    "extract_cost",
+    "get_ceilings",
+    "set_ceilings",
+    "load_measured_ceilings",
+    "CEILINGS_PATH",
+    "DEFAULT_PEAK_FLOPS",
+    "DEFAULT_HBM_BYTES_PER_S",
+]
+
+# TPU v5e bf16 peak + HBM bandwidth — the same constants bench.py's roofline
+# sections use (kept in sync by tests/unittests/observability/test_profiling.py)
+DEFAULT_PEAK_FLOPS = 394e12
+DEFAULT_HBM_BYTES_PER_S = 819e9
+
+CEILINGS_PATH = Path(__file__).resolve().parents[1] / "_analysis" / "roofline_ceilings.json"
+_CEILINGS_VERSION = 1
+
+
+@dataclass(frozen=True)
+class ExecutableCost:
+    """XLA's static cost claim for ONE compiled executable."""
+
+    flops: float
+    bytes_accessed: float
+
+    @property
+    def arithmetic_intensity(self) -> float:
+        """Flops per byte of memory traffic (0 when bytes are unknown)."""
+        return self.flops / self.bytes_accessed if self.bytes_accessed > 0 else 0.0
+
+    def roofline_ceiling(self, ceilings: "Ceilings") -> float:
+        """Attainable MFU under the roofline: memory-bound kernels cap below 1."""
+        if self.bytes_accessed <= 0 or ceilings.peak_flops <= 0:
+            return 1.0
+        return min(1.0, self.arithmetic_intensity * ceilings.hbm_bytes_per_s / ceilings.peak_flops)
+
+    def mfu(self, seconds: float, ceilings: "Ceilings") -> float:
+        """Achieved fraction of absolute peak for one step of ``seconds``."""
+        if seconds <= 0 or ceilings.peak_flops <= 0:
+            return 0.0
+        return self.flops / (seconds * ceilings.peak_flops)
+
+    def to_json(self) -> Dict[str, float]:
+        return {"flops": self.flops, "bytes_accessed": self.bytes_accessed}
+
+
+@dataclass(frozen=True)
+class Ceilings:
+    """Hardware performance ceilings the gauges divide by."""
+
+    peak_flops: float
+    hbm_bytes_per_s: float
+    source: str  # "env" | "measured:<path>" | "default"
+
+    def to_json(self) -> Dict[str, Any]:
+        return {
+            "peak_flops": self.peak_flops,
+            "hbm_bytes_per_s": self.hbm_bytes_per_s,
+            "source": self.source,
+        }
+
+
+def extract_cost(compiled: Any) -> Optional[ExecutableCost]:
+    """Normalize ``compiled.cost_analysis()`` into an :class:`ExecutableCost`.
+
+    Returns ``None`` when the backend exposes no cost analysis (older
+    runtimes, some CPU builds) or the call fails — profiling then degrades
+    to pure wall-time accounting for that executable, never to an error on
+    the compile path.
+    """
+    try:
+        analysis = compiled.cost_analysis()
+    except Exception:  # noqa: BLE001 - any backend failure degrades to no-cost
+        return None
+    # jax has returned both a bare dict and a one-element list of dicts
+    # across versions; accept either
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else None
+    if not isinstance(analysis, dict):
+        return None
+    try:
+        flops = float(analysis.get("flops", 0.0) or 0.0)
+        bytes_accessed = float(analysis.get("bytes accessed", 0.0) or 0.0)
+    except (TypeError, ValueError):
+        return None
+    if flops <= 0.0 and bytes_accessed <= 0.0:
+        return None
+    return ExecutableCost(flops=flops, bytes_accessed=bytes_accessed)
+
+
+def load_measured_ceilings(path: Optional[Path] = None) -> Optional[Ceilings]:
+    """Ceilings from a checked-in ``fid_mfu_experiment.py --json`` artifact.
+
+    Returns ``None`` when the file is absent or unreadable — measured
+    ceilings are an upgrade, never a requirement.
+    """
+    target = Path(path) if path is not None else CEILINGS_PATH
+    try:
+        blob = json.loads(target.read_text(encoding="utf-8"))
+    except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+        return None
+    if not isinstance(blob, dict) or blob.get("version") != _CEILINGS_VERSION:
+        return None
+    try:
+        return Ceilings(
+            peak_flops=float(blob["peak_flops"]),
+            hbm_bytes_per_s=float(blob["hbm_bytes_per_s"]),
+            source=f"measured:{target.name}",
+        )
+    except (KeyError, TypeError, ValueError):
+        return None
+
+
+# process-wide resolved ceilings; a list so set_ceilings swaps atomically
+# under the GIL without a lock (single small-object assignment)
+_ACTIVE: list = []
+
+
+def _resolve() -> Ceilings:
+    env_peak = os.environ.get("TM_TPU_PEAK_FLOPS")
+    env_bw = os.environ.get("TM_TPU_HBM_BW")
+    if env_peak or env_bw:
+        try:
+            return Ceilings(
+                peak_flops=float(env_peak) if env_peak else DEFAULT_PEAK_FLOPS,
+                hbm_bytes_per_s=float(env_bw) if env_bw else DEFAULT_HBM_BYTES_PER_S,
+                source="env",
+            )
+        except ValueError:
+            pass  # malformed override falls through to measured/default
+    measured_path = os.environ.get("TM_TPU_CEILINGS_JSON")
+    measured = load_measured_ceilings(Path(measured_path) if measured_path else None)
+    if measured is not None:
+        return measured
+    return Ceilings(
+        peak_flops=DEFAULT_PEAK_FLOPS,
+        hbm_bytes_per_s=DEFAULT_HBM_BYTES_PER_S,
+        source="default",
+    )
+
+
+def get_ceilings() -> Ceilings:
+    """The active hardware ceilings (env > measured JSON > v5e defaults)."""
+    if not _ACTIVE:
+        _ACTIVE.append(_resolve())
+    return _ACTIVE[0]
+
+
+def set_ceilings(ceilings: Optional[Ceilings]) -> None:
+    """Override the active ceilings (``None`` re-resolves from env/JSON)."""
+    _ACTIVE.clear()
+    if ceilings is not None:
+        _ACTIVE.append(ceilings)

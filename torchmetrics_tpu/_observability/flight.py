@@ -21,10 +21,19 @@ it freezes a self-contained post-mortem JSON dump:
 Triggers: ``degradation`` events (covers quarantined batches, degraded
 syncs/handshakes, SPMD fallbacks, restore fallbacks — every
 ``DegradationEvent`` is bus-published), ``recompile_churn``, failed
-``snapshot_restore``, and ``chaos_fault`` (the chaos harness names each
-injected fault). Each trigger produces exactly ONE dump (deduped on the bus
-seq); dumps are retained in memory (last ``keep``) and, with a directory
-armed, written as ``flight_<seq>_<kind>.json`` files.
+``snapshot_restore``, ``chaos_fault`` (the chaos harness names each
+injected fault), and ``perf_regression`` (the cost ledger's sustained
+latency-baseline breach — see ``profiling.py``). Each trigger produces
+exactly ONE dump (deduped on the bus seq); dumps are retained in memory
+(last ``keep``) and, with a directory armed, written as
+``flight_<seq>_<kind>.json`` files. On-disk retention is bounded: at most
+``max_files`` dumps (env ``TM_TPU_FLIGHT_MAX_FILES``, default 64) are kept,
+oldest-first eviction by bus seq — a trigger flood cannot fill the disk.
+
+``perf_regression`` dumps additionally carry a ``profiling`` section: the
+cost ledger snapshot (per-seam buckets, MFU, baselines, regressions) and
+the per-tenant ``pool_cost_*`` counter slice at dump time, so the
+post-mortem shows WHERE the device time was going when the seam slowed.
 
 Hot-path cost: zero — the recorder is a bus subscriber, so nothing runs
 until an (already rare, already telemetry-gated) trigger event publishes.
@@ -59,17 +68,21 @@ FLIGHT_DUMP_VERSION = 1
 DEFAULT_KEEP = 32  # dumps retained in memory
 DEFAULT_SPAN_WINDOW = 32  # spans per dump
 DEFAULT_EVENT_WINDOW = 64  # bus events per dump
+DEFAULT_MAX_FILES = 64  # dumps retained on disk (oldest evicted first)
 
 # event kinds that freeze a dump. `snapshot_restore` is conditional: only
 # failed outcomes are faults (`fallback` restores additionally publish a
 # degradation event, which IS a trigger — one dump, not two).
-_TRIGGER_KINDS = frozenset({"degradation", "recompile_churn", "chaos_fault", "snapshot_restore"})
+_TRIGGER_KINDS = frozenset(
+    {"degradation", "recompile_churn", "chaos_fault", "snapshot_restore", "perf_regression"}
+)
 
 # kind (and, for degradations, DegradationEvent kind) -> failing seam.
 # A publisher that knows better ships `data["seam"]`, which always wins.
 _SEAM_FOR_KIND = {
     "recompile_churn": "compile",
     "snapshot_restore": "snapshot.restore",
+    "perf_regression": "metric.update",
 }
 _SEAM_FOR_DEGRADATION = {
     "nan_quarantine": "metric.update",
@@ -99,10 +112,17 @@ class FlightRecorder:  # concurrency: shared bus publisher threads dump while te
         keep: int = DEFAULT_KEEP,
         span_window: int = DEFAULT_SPAN_WINDOW,
         event_window: int = DEFAULT_EVENT_WINDOW,
+        max_files: Optional[int] = None,
     ) -> None:
         self.directory = str(directory) if directory is not None else None
         self.span_window = int(span_window)
         self.event_window = int(event_window)
+        if max_files is None:
+            try:
+                max_files = int(os.environ.get("TM_TPU_FLIGHT_MAX_FILES", DEFAULT_MAX_FILES))
+            except ValueError:
+                max_files = DEFAULT_MAX_FILES
+        self.max_files = max(1, int(max_files))
         self._lock = _san_lock("FlightRecorder._lock")
         self._dumps: "deque[Dict[str, Any]]" = deque(maxlen=max(1, int(keep)))
         self._seen: "deque[int]" = deque(maxlen=512)  # trigger seqs already dumped
@@ -206,6 +226,8 @@ class FlightRecorder:  # concurrency: shared bus publisher threads dump while te
             "spans_dropped": TRACER.dropped,
             "events_dropped": BUS.dropped,
         }
+        if trigger.kind == "perf_regression":
+            dump["profiling"] = self._profiling_section()
         # self-contained = serializable, guaranteed at the source. The
         # recorder runs inside a bus subscriber: an exception here would get
         # the subscriber silently dropped (one warning, then no post-mortems
@@ -229,6 +251,18 @@ class FlightRecorder:  # concurrency: shared bus publisher threads dump while te
             )
         return json.loads(text), text
 
+    def _profiling_section(self) -> Dict[str, Any]:
+        """Cost-ledger snapshot + per-tenant cost counters for perf dumps."""
+        from torchmetrics_tpu._observability.profiling import LEDGER
+        from torchmetrics_tpu._observability.telemetry import REGISTRY
+
+        tenants = {
+            key: val
+            for key, val in REGISTRY.counter_totals().items()
+            if key.startswith("pool_cost_")
+        }
+        return {"ledger": LEDGER.snapshot(), "tenant_costs": tenants}
+
     def _write(self, dump: Dict[str, Any], text: str) -> None:
         try:
             os.makedirs(self.directory, exist_ok=True)
@@ -237,11 +271,37 @@ class FlightRecorder:  # concurrency: shared bus publisher threads dump while te
             with open(tmp, "w", encoding="utf-8") as fh:
                 fh.write(text)
             os.replace(tmp, os.path.join(self.directory, name))
+            self._evict()
         except OSError:
             # a post-mortem writer must never break the runtime path that
             # published the trigger; the in-memory dump ring still has it
             with self._lock:
                 self.write_errors += 1
+
+    def _evict(self) -> None:
+        """Drop oldest on-disk dumps beyond ``max_files`` (by bus seq).
+
+        Disk retention is a cap, not an archive: a trigger flood (churn
+        storm, chaos soak) must converge to bounded disk, with the newest
+        post-mortems — the ones an on-call will actually open — surviving.
+        """
+        names = []
+        for fname in os.listdir(self.directory):
+            if not (fname.startswith("flight_") and fname.endswith(".json")):
+                continue
+            parts = fname[len("flight_") :].split("_", 1)
+            try:
+                names.append((int(parts[0]), fname))
+            except (ValueError, IndexError):
+                continue  # foreign file in the dump dir: never delete it
+        if len(names) <= self.max_files:
+            return
+        names.sort()
+        for _, fname in names[: len(names) - self.max_files]:
+            try:
+                os.remove(os.path.join(self.directory, fname))
+            except OSError:
+                pass  # already gone (concurrent eviction) — the cap still holds
 
     # ----------------------------------------------------------------- reading
     def dumps(self) -> List[Dict[str, Any]]:

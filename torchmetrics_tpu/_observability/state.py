@@ -25,6 +25,13 @@ own independent slot bool (``OBS.tracing``, env ``TM_TPU_TRACING=1``): span
 collection can be on while counters are off and vice versa, and each seam
 pays exactly one slot load + branch per switch it honors.
 
+The continuous-profiling layer (``profiling.py``) follows the same pattern
+with ``OBS.profiling`` (env ``TM_TPU_PROFILING=1``): device-time accounting,
+MFU/roofline gauges, and per-tenant cost meters all hang off one slot bool,
+so the disabled runtime pays one load + branch per step seam (see the
+``profiling_disabled_retention`` bench line). The setter lives in
+``profiling.set_profiling_enabled``.
+
 This module must stay import-light (no jax, no numpy): it is imported by
 ``metric.py`` at module scope.
 """
@@ -50,7 +57,7 @@ class _ObsState:
     branch) and makes accidental attribute growth an error.
     """
 
-    __slots__ = ("enabled", "sample_every", "profile_scopes", "tracing")
+    __slots__ = ("enabled", "sample_every", "profile_scopes", "tracing", "profiling")
 
     def __init__(self) -> None:
         self.enabled = os.environ.get("TM_TPU_TELEMETRY", "") == "1"
@@ -60,6 +67,10 @@ class _ObsState:
         # deployment can trace sampled requests without paying for counters
         # (or vice versa); the setter lives in tracing.set_tracing_enabled
         self.tracing = os.environ.get("TM_TPU_TRACING", "") == "1"
+        # continuous profiling (profiling.py) — device-time accounting, MFU
+        # gauges, tenant cost meters; the setter lives in
+        # profiling.set_profiling_enabled
+        self.profiling = os.environ.get("TM_TPU_PROFILING", "") == "1"
 
 
 OBS = _ObsState()

@@ -24,6 +24,7 @@ needed to pin down why a "compiled" metric keeps paying trace time.
 
 from __future__ import annotations
 
+import time
 import weakref
 from collections import deque
 from dataclasses import dataclass, field
@@ -35,6 +36,7 @@ from torchmetrics_tpu._analysis.locksan import new_lock as _san_lock
 from torchmetrics_tpu._observability.events import BUS
 from torchmetrics_tpu._observability.reservoir import LatencyReservoir
 from torchmetrics_tpu._observability.state import OBS
+from torchmetrics_tpu._observability.tracing import current_trace_id
 
 __all__ = [
     "diff_components",
@@ -63,6 +65,36 @@ def diff_components(prev: Dict[str, str], cur: Dict[str, str]) -> Tuple[List[str
     changed = sorted(k for k in set(prev) | set(cur) if prev.get(k) != cur.get(k))
     diff = "; ".join(f"{k}: {prev.get(k)!r} -> {cur.get(k)!r}" for k in changed)
     return changed, diff
+
+
+# histogram bucket upper bounds (seconds) for `latency_bucket|op=|le=`
+# counters. Buckets are recorded NON-cumulative (one counter bump per
+# observation, in the first bucket whose bound covers it); the exporter
+# cumsums over the sorted bounds — a sum of monotonic counters stays
+# monotonic, so the exposed cumulative series never regresses.
+LATENCY_BUCKETS: Tuple[float, ...] = (
+    0.0005,
+    0.001,
+    0.0025,
+    0.005,
+    0.01,
+    0.025,
+    0.05,
+    0.1,
+    0.25,
+    0.5,
+    1.0,
+    2.5,
+)
+
+_BUCKET_LABELS: Tuple[str, ...] = tuple(repr(b) for b in LATENCY_BUCKETS) + ("+Inf",)
+
+
+def _bucket_label(seconds: float) -> str:
+    for bound, label in zip(LATENCY_BUCKETS, _BUCKET_LABELS):
+        if seconds <= bound:
+            return label
+    return "+Inf"
 
 
 def _split_key(key: str) -> Tuple[str, Dict[str, str]]:
@@ -95,6 +127,7 @@ class MetricTelemetry:  # concurrency: shared exporters scrape via the registry 
         "counters",
         "reservoirs",
         "gauges",
+        "exemplars",
         "_ticks",
         "_compile_keys",
         "_recent_keys",
@@ -109,6 +142,10 @@ class MetricTelemetry:  # concurrency: shared exporters scrape via the registry 
         self.counters: Dict[str, float] = {}
         self.reservoirs: Dict[str, LatencyReservoir] = {}
         self.gauges: Dict[str, float] = {}
+        # "op|le" -> (observed value, unix ts, trace id): the most recent
+        # traced observation per histogram bucket, exported as an
+        # OpenMetrics exemplar. Cardinality is ops x buckets — bounded.
+        self.exemplars: Dict[str, Tuple[float, float, int]] = {}
         self._ticks: Dict[str, int] = {}
         # compiled-path cache keys already seen, per compile kind
         self._compile_keys: set = set()
@@ -154,6 +191,12 @@ class MetricTelemetry:  # concurrency: shared exporters scrape via the registry 
         # (the reservoir's retained window shrinks/vanishes on GC)
         self.inc(f"latency_samples|op={op}")
         self.inc(f"latency_sum_seconds|op={op}", seconds)
+        le = _bucket_label(seconds)
+        self.inc(f"latency_bucket|op={op}|le={le}")
+        if OBS.tracing:
+            tid = current_trace_id()
+            if tid is not None:
+                self.exemplars[f"{op}|{le}"] = (seconds, time.time(), tid)
 
     # ---------------------------------------------------------------- compile
     # distinct cache keys remembered for dedup; beyond this a churn-pathology
@@ -376,11 +419,16 @@ class TelemetryRegistry:
             live = [t for _, t in self._live.values()]
             retired = {k: dict(v) for k, v in self._retired.items()}
             retired_n = dict(self._retired_instances)
+        blank = lambda: {  # noqa: E731 — one-line schema shared by both loops
+            "counters": {},
+            "gauges": {},
+            "latency": {},
+            "exemplars": {},
+            "instances": 0,
+            "retired_instances": 0,
+        }
         for telem in live:
-            entry = out.setdefault(
-                telem.name,
-                {"counters": {}, "gauges": {}, "latency": {}, "instances": 0, "retired_instances": 0},
-            )
+            entry = out.setdefault(telem.name, blank())
             entry["instances"] += 1
             # dict(...) is a C-level copy (atomic under the GIL): the hot
             # path may be inserting first-time keys concurrently with an
@@ -395,11 +443,15 @@ class TelemetryRegistry:
             for op, res in dict(telem.reservoirs).items():
                 pool = entry["latency"].setdefault(op, [])
                 pool.extend(res.values())
+            # most recent traced observation per op|le bucket wins across
+            # instances — an exemplar is a pointer at fresh evidence, not
+            # an aggregate, so summing would be meaningless
+            for key, ex in dict(telem.exemplars).items():
+                cur = entry["exemplars"].get(key)
+                if cur is None or ex[1] > cur[1]:
+                    entry["exemplars"][key] = ex
         for name, counters in retired.items():
-            entry = out.setdefault(
-                name,
-                {"counters": {}, "gauges": {}, "latency": {}, "instances": 0, "retired_instances": 0},
-            )
+            entry = out.setdefault(name, blank())
             entry["retired_instances"] = retired_n.get(name, 0)
             for key, val in counters.items():
                 entry["counters"][key] = entry["counters"].get(key, 0) + val
@@ -436,13 +488,24 @@ class TelemetryRegistry:
     # --------------------------------------------------------------- exports
     def render_prometheus(self) -> str:
         from torchmetrics_tpu._observability.export import render_prometheus
+        from torchmetrics_tpu._observability.profiling import LEDGER
 
-        return render_prometheus(self.aggregate(), BUS, OBS.enabled)
+        return render_prometheus(self.aggregate(), BUS, OBS.enabled, ledger=LEDGER)
+
+    def render_openmetrics(self) -> str:
+        """OpenMetrics text exposition (``application/openmetrics-text``):
+        same families as :meth:`render_prometheus` plus trace-id exemplars
+        on the latency histogram buckets, terminated by ``# EOF``."""
+        from torchmetrics_tpu._observability.export import render_openmetrics
+        from torchmetrics_tpu._observability.profiling import LEDGER
+
+        return render_openmetrics(self.aggregate(), BUS, OBS.enabled, ledger=LEDGER)
 
     def to_json(self) -> Dict[str, Any]:
         from torchmetrics_tpu._observability.export import to_json
+        from torchmetrics_tpu._observability.profiling import LEDGER
 
-        return to_json(self.aggregate(), BUS, OBS.enabled)
+        return to_json(self.aggregate(), BUS, OBS.enabled, ledger=LEDGER)
 
 
 REGISTRY = TelemetryRegistry()

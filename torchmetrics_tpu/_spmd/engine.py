@@ -53,6 +53,7 @@ from jax.sharding import PartitionSpec
 from torchmetrics_tpu._analysis.manifest import in_graph_sync_eligible, predicted_state_bytes
 from torchmetrics_tpu._aot.state import AOT as _AOT
 from torchmetrics_tpu._observability import tracing as _obs_trace
+from torchmetrics_tpu._observability.profiling import LEDGER as _PROF_LEDGER
 from torchmetrics_tpu._observability.state import OBS as _OBS
 from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
 from torchmetrics_tpu._spmd import faultinject as _faultinject
@@ -262,7 +263,7 @@ class SpmdEngine:
         built = fn is None
         if built:
             fn = self._build_step(treedef, statics, len(dynamic))
-            if _AOT.active:
+            if _AOT.active or _OBS.profiling:
                 fn = self._aot_wrap(fn, "spmd_step", key)
             if _OBS.enabled:
                 # first call = trace+lower+execute: time it once, then the
@@ -271,14 +272,17 @@ class SpmdEngine:
                 fn = self._units[0].metric._obs_timed_first_call(self._step_fns, key, fn)
             self._step_fns[key] = fn
         obs_sample = False
+        # first (built) calls pay trace+lower+execute — the ledger accounts
+        # compile time separately, so they stay out of device-time buckets
+        prof = _OBS.profiling and not built
         t0 = 0.0
         if _OBS.enabled:
             telem = _telemetry_for(self.target)
             if built:
                 self._units[0].metric._obs_compile_event("spmd_step", treedef, statics, sig[2])
             obs_sample = telem.sample_due("spmd_step")
-            if obs_sample:
-                t0 = time.perf_counter()
+        if obs_sample or prof:
+            t0 = time.perf_counter()
         try:
             new_states, value = _faultinject.dispatch(fn, self._states, dynamic)
         except jax.errors.JAXTypeError as err:
@@ -295,11 +299,15 @@ class SpmdEngine:
             return self._eager_step(args, kwargs)
         self._states = new_states
         self._steps += 1
+        if obs_sample or prof:
+            elapsed = time.perf_counter() - t0
+            if prof:
+                _PROF_LEDGER.record_step("spmd_step", type(self.target).__name__, elapsed)
         if _OBS.enabled:
             telem = _telemetry_for(self.target)
             telem.inc("update_calls|path=spmd")
             if obs_sample:
-                telem.observe("spmd_step", time.perf_counter() - t0)
+                telem.observe("spmd_step", elapsed)
         hook = self.__dict__.get("_snapshot_hook")
         if hook is not None:
             hook.note_update()

@@ -57,6 +57,7 @@ from torchmetrics_tpu._analysis.manifest import predicted_state_bytes, stream_po
 from torchmetrics_tpu._aot.state import AOT as _AOT
 from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.events import BUS as _BUS
+from torchmetrics_tpu._observability.profiling import LEDGER as _PROF_LEDGER
 from torchmetrics_tpu._observability.state import OBS as _OBS
 from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
 from torchmetrics_tpu._streams.telemetry import StreamLabeler
@@ -327,6 +328,19 @@ class StreamPool:
             total += pred.bytes
         return total
 
+    def _profiled_stream_bytes(self) -> float:
+        """``predicted_stream_bytes()`` collapsed to a cached float for metering.
+
+        Cost counters prefer 0.0 over ``None`` (no claim -> no bytes accrued)
+        and must not re-walk the memory manifest on every micro-batch.
+        """
+        cached = self.__dict__.get("_prof_stream_bytes")
+        if cached is None:
+            pred = self.predicted_stream_bytes()
+            cached = 0.0 if pred is None else float(pred)
+            self.__dict__["_prof_stream_bytes"] = cached
+        return cached
+
     def _check_memory_ceiling(self, new_capacity: int, at: str) -> None:
         """Refuse admission when the predicted footprint would breach the ceiling.
 
@@ -490,13 +504,17 @@ class StreamPool:
         built = fn is None
         if built:
             fn = self._build_step(treedef, statics, len(dynamic))
-            if _AOT.active:
+            if _AOT.active or _OBS.profiling:
                 fn = self._aot_wrap(fn, "stream_step", key)
             if _OBS.enabled:
                 fn = self._obs_timed_first_call(key, fn)
             self._step_fns[key] = fn
         obs_sample = False
+        # built (first) calls pay trace+lower+execute; the ledger accounts
+        # compile time separately, so they stay out of the cost buckets
+        prof = _OBS.profiling and not built
         t0 = 0.0
+        prof_t0 = 0.0
         if _OBS.enabled:
             telem = _telemetry_for(self)
             if built:
@@ -516,6 +534,8 @@ class StreamPool:
             obs_sample = telem.sample_due("stream_step")
             if obs_sample:
                 t0 = time.perf_counter()
+        if prof:
+            prof_t0 = time.perf_counter()
         if _sp is not None:
             # the compiled vmapped dispatch as a child span: host prep vs
             # device step separate cleanly in the request tree
@@ -554,11 +574,38 @@ class StreamPool:
         applied_ids = ids[applied]
         self._counts[applied_ids] += 1
         self._dirty[applied_ids] = True
+        label_rows: Dict[str, int] = {}
         for sid in applied_ids.tolist():
             self._value_cache.pop(sid, None)
             label = self.labeler.note(sid)
+            if prof:
+                label_rows[label] = label_rows.get(label, 0) + 1
             if _OBS.enabled:
                 _telemetry_for(self).inc(f"pool_stream_updates|stream={label}")
+        if prof:
+            elapsed = time.perf_counter() - prof_t0
+            cls_name = type(self.target).__name__
+            _PROF_LEDGER.record_step("stream_step", cls_name, elapsed)
+            rows = int(applied_ids.size)
+            if rows:
+                # equal shares across applied rows: a vmapped micro-batch runs
+                # every live lane for the same wall time, so per-row device
+                # seconds (and the executable's flops) split evenly; label
+                # tallies first so cost stays O(labels), not O(rows)
+                cost = _PROF_LEDGER.cost_for("stream_step", cls_name)
+                flops_per_row = (cost.flops / rows) if cost is not None else 0.0
+                bytes_per_row = self._profiled_stream_bytes()
+                share = elapsed / rows
+                telem = _telemetry_for(self)
+                for label, n in label_rows.items():
+                    telem.inc(f"pool_cost_device_seconds|stream={label}", share * n)
+                    if flops_per_row:
+                        telem.inc(f"pool_cost_flops|stream={label}", flops_per_row * n)
+                    if bytes_per_row:
+                        telem.inc(
+                            f"pool_cost_state_byte_updates|stream={label}",
+                            bytes_per_row * n,
+                        )
         if _sp is not None:
             # bounded `stream=` attribution, read AFTER this batch's note()
             # calls so the span agrees with the per-row counter labels above
@@ -918,7 +965,7 @@ class StreamPool:
         )
 
     def _maybe_aot(self, fn: Any, kind: str, force: bool = False) -> Any:
-        if _AOT.active or force:
+        if _AOT.active or force or _OBS.profiling:
             return self._aot_wrap(fn, kind, (self.physical,))
         return fn
 

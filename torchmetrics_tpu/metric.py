@@ -58,6 +58,7 @@ _ensure_xla_cache()
 from torchmetrics_tpu._observability import scopes as _obs_scopes
 from torchmetrics_tpu._observability import tracing as _obs_trace
 from torchmetrics_tpu._observability.events import BUS as _BUS
+from torchmetrics_tpu._observability.profiling import LEDGER as _PROF_LEDGER
 from torchmetrics_tpu._observability.state import OBS as _OBS
 from torchmetrics_tpu._observability.telemetry import telemetry_for as _telemetry_for
 from torchmetrics_tpu.utilities.data import (
@@ -627,20 +628,29 @@ class Metric(ABC):
     # `_OBS.enabled`); they may allocate, probe dicts, and read the clock.
     # All mutation is host-side at eager boundaries — never under trace.
 
+    # _obs_call ops the cost ledger accounts (jit/scan compiled dispatches);
+    # eager ops stay out — profiling prices device executables, not host loops
+    _PROF_OPS = frozenset({"update_jit", "update_scan"})
+
     def _obs_call(self, counter_key: Optional[str], op: str, method: str, fn: Callable) -> Any:
         """Run ``fn`` counted, latency-sampled, and profiler-annotated."""
         telem = _telemetry_for(self)
         if counter_key:
             telem.inc(counter_key)
         sample = telem.sample_due(op)
-        t0 = time.perf_counter() if sample else 0.0
+        prof = _OBS.profiling and op in self._PROF_OPS
+        t0 = time.perf_counter() if (sample or prof) else 0.0
         if _OBS.profile_scopes:
             with _obs_scopes.annotation(f"{type(self).__name__}.{method}"):
                 out = fn()
         else:
             out = fn()
-        if sample:
-            telem.observe(op, time.perf_counter() - t0)
+        if sample or prof:
+            elapsed = time.perf_counter() - t0
+            if prof:
+                _PROF_LEDGER.record_step(op, type(self).__name__, elapsed)
+            if sample:
+                telem.observe(op, elapsed)
         return out
 
     def _obs_compile_event(
@@ -1443,10 +1453,14 @@ class Metric(ABC):
         key = (key, policy)
         if key not in cache:
             fn = jax.jit(build())
-            if _AOT.active:
+            if _AOT.active or _OBS.profiling:
                 # route trace+compile through the persistent executable cache:
                 # a warm artifact loads instead of tracing, a cold one is
-                # serialized after its first compile for the next process
+                # serialized after its first compile for the next process.
+                # With profiling on (and no AOT directory) the dispatcher is
+                # memory-only — it exists so compile time and XLA's
+                # cost_analysis() are captured at the one place the compiled
+                # object is in hand (`_AotDispatch._resolve_inner`).
                 from torchmetrics_tpu._aot.cache import wrap_executable
 
                 fn = wrap_executable(
@@ -1737,11 +1751,14 @@ class Metric(ABC):
             return _pure
 
         obs_sample = False
+        prof = _OBS.profiling
         t0 = 0.0
         if _OBS.enabled:
             obs_sample = _telemetry_for(self).sample_due("update_compiled")
-            if obs_sample:
-                t0 = time.perf_counter()
+        if obs_sample or prof:
+            # profiling times EVERY step (cost accounting must add up);
+            # latency sampling stays 1-in-N
+            t0 = time.perf_counter()
         try:
             # the fused-flag marker lets traced bodies that need a raise-or-
             # drop escape hatch (aggregator NaN "error") know their violation
@@ -1762,11 +1779,15 @@ class Metric(ABC):
             if _OBS.enabled:
                 self._obs_auto_disabled(f"compiled update failed: {type(err).__name__}: {err}")
             return False
+        if obs_sample or prof:
+            elapsed = time.perf_counter() - t0
+            if prof:
+                _PROF_LEDGER.record_step("update_compiled", type(self).__name__, elapsed)
         if _OBS.enabled:
             telem = _telemetry_for(self)
             telem.inc("update_calls|path=auto_compiled")
             if obs_sample:
-                telem.observe("update_compiled", time.perf_counter() - t0)
+                telem.observe("update_compiled", elapsed)
         if validate:
             object.__setattr__(self, "_viol_flags", new_viol)
         seen[sig] += 1
@@ -1911,11 +1932,12 @@ class Metric(ABC):
         if cnt is None or cnt[0] != self._update_count:
             cnt = (self._update_count, jnp.int32(self._update_count))
         obs_sample = False
+        prof = _OBS.profiling
         t0 = 0.0
         if _OBS.enabled:
             obs_sample = _telemetry_for(self).sample_due("forward_compiled")
-            if obs_sample:
-                t0 = time.perf_counter()
+        if obs_sample or prof:
+            t0 = time.perf_counter()
         try:
             if validate:
                 self.__dict__["_fused_flags_tracing"] = True
@@ -1937,11 +1959,15 @@ class Metric(ABC):
             if _OBS.enabled:
                 self._obs_auto_disabled(f"compiled forward failed: {type(err).__name__}: {err}")
             return False, None
+        if obs_sample or prof:
+            elapsed = time.perf_counter() - t0
+            if prof:
+                _PROF_LEDGER.record_step("forward_compiled", type(self).__name__, elapsed)
         if _OBS.enabled:
             telem = _telemetry_for(self)
             telem.inc("update_calls|path=forward_compiled")
             if obs_sample:
-                telem.observe("forward_compiled", time.perf_counter() - t0)
+                telem.observe("forward_compiled", elapsed)
         if validate:
             object.__setattr__(self, "_viol_flags", new_viol)
         object.__setattr__(self, "_auto_cnt", (self._update_count + 1, new_cnt))
