@@ -866,13 +866,35 @@ FID_BATCH = 128
 FID_STREAM = 16  # batches streamed back-to-back per timed fetch
 
 
+def _trunk_scaled() -> bool:
+    """True when the conv/attention trunk sections should run CPU-scaled shapes.
+
+    The full-size trunk configs (batch-128 InceptionV3, batch-64 VGG16/BERT)
+    take hours on a bare CPU container, which is why BENCH_r05/r06 carried
+    ``TM_TPU_BENCH_SKIP`` stubs for these sections. Scaled shapes keep every
+    section runnable on any backend — the unit strings label the shapes, so
+    a CPU-scaled row can never be mistaken for a chip number.
+    """
+    return _on_cpu_backend()
+
+
+def _cost_dict(analysis) -> dict:
+    """Normalize ``compiled.cost_analysis()``: the CPU backend returns a
+    singleton list of dicts where TPU returns a bare dict."""
+    if isinstance(analysis, (list, tuple)):
+        analysis = analysis[0] if analysis else {}
+    return analysis if isinstance(analysis, dict) else {}
+
+
 def _bench_fid_imgs_per_sec() -> tuple:
     """images/sec through the jitted Flax InceptionV3 trunk + FID state fold.
 
-    Returns ``(imgs_per_sec, mfu, roofline_mfu)``: MFU = achieved FLOP/s over
-    the chip's bf16 peak (per XLA cost analysis of the compiled trunk);
-    ``roofline_mfu`` = the HBM-bandwidth-implied ceiling from the trunk's
-    arithmetic intensity (0.0 when cost analysis is unavailable).
+    Returns ``(imgs_per_sec, mfu, roofline_mfu, note, batch)``: MFU =
+    achieved FLOP/s over the chip's bf16 peak (per XLA cost analysis of the
+    compiled trunk); ``roofline_mfu`` = the HBM-bandwidth-implied ceiling
+    from the trunk's arithmetic intensity (0.0 when cost analysis is
+    unavailable). The trunk runs the fused kernel layer's default path
+    (folded-BN convs through ``torchmetrics_tpu._kernels.conv_bias_act``).
     """
     import warnings
 
@@ -880,6 +902,8 @@ def _bench_fid_imgs_per_sec() -> tuple:
     import jax.numpy as jnp
     import numpy as np
 
+    scaled = _trunk_scaled()
+    batch, stream = (4, 2) if scaled else (FID_BATCH, FID_STREAM)
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         from torchmetrics_tpu.image._inception import InceptionFeatureExtractor
@@ -887,9 +911,11 @@ def _bench_fid_imgs_per_sec() -> tuple:
         ext = InceptionFeatureExtractor(feature="2048")
         # bf16-stored weights halve the trunk's HBM weight traffic; measure
         # both and report the faster (a no-gain result is itself diagnostic:
-        # the trunk is then activation-bound, not weight-bound)
-        ext16 = InceptionFeatureExtractor(feature="2048", weights_dtype=jnp.bfloat16)
-    imgs = jnp.asarray(np.random.default_rng(0).integers(0, 255, (FID_BATCH, 3, 299, 299)), jnp.uint8)
+        # the trunk is then activation-bound, not weight-bound). On a
+        # CPU-scaled run the bf16 variant is skipped — CPU matmuls emulate
+        # bf16, so the comparison measures emulation, not weight traffic
+        ext16 = None if scaled else InceptionFeatureExtractor(feature="2048", weights_dtype=jnp.bfloat16)
+    imgs = jnp.asarray(np.random.default_rng(0).integers(0, 255, (batch, 3, 299, 299)), jnp.uint8)
 
     def _make_step(extractor):
         def step():
@@ -897,28 +923,31 @@ def _bench_fid_imgs_per_sec() -> tuple:
             # batches — dispatch a stream of trunk forwards + state folds,
             # fetch once
             acc = jnp.zeros(())
-            for _ in range(FID_STREAM):
+            for _ in range(stream):
                 feats = extractor(imgs)
                 acc = acc + jnp.sum(feats.T @ feats) + jnp.sum(feats)  # cov + sum fold
             return float(acc)
 
         return step
 
-    rate_f32w = FID_BATCH * FID_STREAM / _min_time(_make_step(ext), reps=3)
-    rate_bf16w = FID_BATCH * FID_STREAM / _min_time(_make_step(ext16), reps=3)
-    if rate_bf16w > rate_f32w:
-        rate, ext, weights_note = rate_bf16w, ext16, f"bf16-stored weights (+{rate_bf16w / rate_f32w - 1:.0%} vs f32)"
+    rate_f32w = batch * stream / _min_time(_make_step(ext), reps=3)
+    if ext16 is None:
+        rate, weights_note = rate_f32w, "f32 weights (CPU-scaled run: bf16-storage variant skipped)"
     else:
-        rate, weights_note = rate_f32w, f"f32 weights (bf16 storage gained nothing: activation-bound; bf16 {rate_bf16w:.0f}/s)"
+        rate_bf16w = batch * stream / _min_time(_make_step(ext16), reps=3)
+        if rate_bf16w > rate_f32w:
+            rate, ext, weights_note = rate_bf16w, ext16, f"bf16-stored weights (+{rate_bf16w / rate_f32w - 1:.0%} vs f32)"
+        else:
+            rate, weights_note = rate_f32w, f"f32 weights (bf16 storage gained nothing: activation-bound; bf16 {rate_bf16w:.0f}/s)"
 
     try:
-        cost = ext._forward.lower(ext.variables, imgs).compile().cost_analysis()
+        cost = _cost_dict(ext._forward.lower(ext.variables, imgs).compile().cost_analysis())
         flops_per_batch = float(cost.get("flops", 0.0))
         bytes_per_batch = float(cost.get("bytes accessed", 0.0))
     except Exception:
         flops_per_batch = bytes_per_batch = 0.0
     peak = _PEAK_BF16_FLOPS
-    mfu = (rate / FID_BATCH) * flops_per_batch / peak if flops_per_batch else 0.0
+    mfu = (rate / batch) * flops_per_batch / peak if flops_per_batch else 0.0
     # HBM roofline from MEASURED bandwidth (a timed streaming copy on this
     # device, not the datasheet number): arithmetic intensity caps the
     # achievable MFU, so report the ceiling alongside
@@ -929,7 +958,7 @@ def _bench_fid_imgs_per_sec() -> tuple:
         else 0.0
     )
     weights_note += f"; roofline vs {bw_src} HBM BW {hbm_bw / 1e9:.0f} GB/s"
-    return rate, mfu, roofline, weights_note
+    return rate, mfu, roofline, weights_note, batch
 
 
 _HBM_MEASURED = [None]
@@ -1092,11 +1121,14 @@ LPIPS_STREAM = 8
 
 
 def _bench_lpips() -> tuple:
-    """(imgs/sec, MFU, torch-CPU baseline imgs/sec).
+    """(imgs/sec, MFU, torch-CPU baseline imgs/sec, batch, res).
 
     The CPU baseline is the same VGG16 conv stack (random weights) in plain
     torch modules — torchvision is absent, but the trunk architecture is
-    fixed, so this is an honest same-math reference-forward cost.
+    fixed, so this is an honest same-math reference-forward cost. The jax
+    side runs the fused kernel layer's default path (fused LPIPS heads via
+    ``torchmetrics_tpu._kernels.lpips_head``); on a CPU session the shapes
+    scale down and the kernel layer takes its XLA fallback.
     """
     import warnings
 
@@ -1104,28 +1136,30 @@ def _bench_lpips() -> tuple:
     import jax.numpy as jnp
     import numpy as np
 
+    scaled = _trunk_scaled()
+    batch, res, stream = (4, 64, 2) if scaled else (LPIPS_BATCH, LPIPS_RES, LPIPS_STREAM)
     with warnings.catch_warnings():
         warnings.simplefilter("ignore")
         from torchmetrics_tpu.image._lpips import LPIPSExtractor
 
         ext = LPIPSExtractor()
     rng = np.random.default_rng(0)
-    a = jnp.asarray(rng.random((LPIPS_BATCH, 3, LPIPS_RES, LPIPS_RES), np.float32) * 2 - 1)
-    b = jnp.asarray(rng.random((LPIPS_BATCH, 3, LPIPS_RES, LPIPS_RES), np.float32) * 2 - 1)
+    a = jnp.asarray(rng.random((batch, 3, res, res), np.float32) * 2 - 1)
+    b = jnp.asarray(rng.random((batch, 3, res, res), np.float32) * 2 - 1)
 
     def step():
         acc = jnp.zeros(())
-        for _ in range(LPIPS_STREAM):
+        for _ in range(stream):
             acc = acc + jnp.sum(ext(a, b))
         return float(acc)
 
-    rate = LPIPS_BATCH * LPIPS_STREAM / _min_time(step, reps=3)
+    rate = batch * stream / _min_time(step, reps=3)
     try:
-        cost = ext._forward.lower(ext.variables, a, b).compile().cost_analysis()
+        cost = _cost_dict(ext._forward.lower(ext.variables, a, b).compile().cost_analysis())
         flops = float(cost.get("flops", 0.0))
     except Exception:
         flops = 0.0
-    mfu = (rate / LPIPS_BATCH) * flops / _PEAK_BF16_FLOPS if flops else 0.0
+    mfu = (rate / batch) * flops / _PEAK_BF16_FLOPS if flops else 0.0
 
     # torch-CPU same-architecture VGG16 feature forward on both inputs
     import torch
@@ -1138,8 +1172,9 @@ def _bench_lpips() -> tuple:
             in_ch = ch
         layers.append(torch.nn.MaxPool2d(2))
     vgg = torch.nn.Sequential(*layers[:-1]).eval()
-    ta = torch.rand(4, 3, LPIPS_RES, LPIPS_RES)  # smaller batch: CPU would take minutes otherwise
-    tb = torch.rand(4, 3, LPIPS_RES, LPIPS_RES)
+    ref_batch = min(4, batch)  # smaller batch: CPU would take minutes otherwise
+    ta = torch.rand(ref_batch, 3, res, res)
+    tb = torch.rand(ref_batch, 3, res, res)
 
     def run_ref():
         with torch.no_grad():
@@ -1147,8 +1182,8 @@ def _bench_lpips() -> tuple:
             vgg(tb)
         return 0.0
 
-    base = 4 / _min_time(run_ref, reps=3, subtract_rtt=False)
-    return rate, mfu, base
+    base = ref_batch / _min_time(run_ref, reps=3, subtract_rtt=False)
+    return rate, mfu, base, batch, res
 
 
 # --------------------------------------------------------------------- #
@@ -1193,36 +1228,44 @@ BERT_STREAM = 8
 
 
 def _bench_bert_encoder() -> tuple:
-    """(tokens/sec, MFU) of the Flax BERT-base encoder in bf16 on the MXU."""
+    """(tokens/sec, MFU, batch, length, dtype-label) of the Flax BERT-base encoder.
+
+    bf16 on the MXU; a CPU-scaled session runs f32 (CPU bf16 is emulation)
+    at a small batch. The encoder runs the fused kernel layer's default path
+    (fused attention + layernorm/residual via ``torchmetrics_tpu._kernels``).
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
 
     from torchmetrics_tpu.text._bert_encoder import BertConfig, BertEncoder
 
+    scaled = _trunk_scaled()
+    batch, length, stream = (4, 128, 2) if scaled else (BERT_BATCH, BERT_LEN, BERT_STREAM)
+    dtype, dtype_label = (jnp.float32, "f32") if scaled else (jnp.bfloat16, "bf16")
     cfg = BertConfig(vocab_size=30522, hidden_size=768, num_layers=12, num_heads=12, intermediate_size=3072)
-    net = BertEncoder(cfg, dtype=jnp.bfloat16)
+    net = BertEncoder(cfg, dtype=dtype)
     rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (BERT_BATCH, BERT_LEN)), jnp.int32)
-    mask = jnp.ones((BERT_BATCH, BERT_LEN), jnp.int32)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, length)), jnp.int32)
+    mask = jnp.ones((batch, length), jnp.int32)
     variables = net.init(jax.random.PRNGKey(0), ids, mask)
     fwd = jax.jit(lambda v, i, m: net.apply(v, i, m)[-1])
 
     def step():
         acc = jnp.zeros(())
-        for _ in range(BERT_STREAM):
+        for _ in range(stream):
             acc = acc + jnp.sum(fwd(variables, ids, mask))
         return float(acc)
 
-    rate = BERT_BATCH * BERT_LEN * BERT_STREAM / _min_time(step, reps=3)
+    rate = batch * length * stream / _min_time(step, reps=3)
     try:
-        cost = fwd.lower(variables, ids, mask).compile().cost_analysis()
+        cost = _cost_dict(fwd.lower(variables, ids, mask).compile().cost_analysis())
         flops = float(cost.get("flops", 0.0))  # per batch
     except Exception:
         flops = 0.0
-    batches_per_sec = rate / (BERT_BATCH * BERT_LEN)
+    batches_per_sec = rate / (batch * length)
     mfu = batches_per_sec * flops / _PEAK_BF16_FLOPS if flops else 0.0
-    return rate, mfu
+    return rate, mfu, batch, length, dtype_label
 
 
 def _bench_chip_parity() -> tuple:
@@ -2506,13 +2549,15 @@ def main() -> None:
         _emit((map_upd_line))
 
     def sec_fid() -> None:
-        fid_rate, fid_mfu, fid_roof, fid_weights_note = _bench_fid_imgs_per_sec()
+        fid_rate, fid_mfu, fid_roof, fid_weights_note, fid_batch = _bench_fid_imgs_per_sec()
+        scaled_note = " CPU-SCALED SHAPES (not comparable to chip rows);" if _trunk_scaled() else ""
         _emit((
                 {
                     "metric": "fid_inception_images_per_sec",
                     "value": round(fid_rate, 1),
                     "unit": (
-                        f"imgs/sec (batch={FID_BATCH}, 299x299, InceptionV3 2048-d + cov fold; {fid_weights_note};"
+                        f"imgs/sec (batch={fid_batch}, 299x299, InceptionV3 2048-d + cov fold, fused kernel layer"
+                        f" TM_TPU_KERNELS path;{scaled_note} {fid_weights_note};"
                         f" MFU={fid_mfu:.1%} of v5e bf16 peak per XLA cost analysis"
                         + (
                             f" — the trunk is HBM-bound: arithmetic intensity caps the roofline at"
@@ -2529,13 +2574,15 @@ def main() -> None:
         )
 
     def sec_lpips() -> None:
-        lpips_rate, lpips_mfu, lpips_base = _bench_lpips()
+        lpips_rate, lpips_mfu, lpips_base, lpips_batch, lpips_res = _bench_lpips()
+        scaled_note = " CPU-SCALED SHAPES (not comparable to chip rows);" if _trunk_scaled() else ""
         _emit((
                 {
                     "metric": "lpips_images_per_sec",
                     "value": round(lpips_rate, 1),
                     "unit": (
-                        f"imgs/sec (batch={LPIPS_BATCH}, {LPIPS_RES}x{LPIPS_RES}, VGG16 trunk + LPIPS heads;"
+                        f"imgs/sec (batch={lpips_batch}, {lpips_res}x{lpips_res}, VGG16 trunk + fused LPIPS heads"
+                        f" TM_TPU_KERNELS path;{scaled_note}"
                         f" MFU={lpips_mfu:.1%} of v5e bf16 peak per XLA cost analysis;"
                         " baseline = same-architecture VGG16 forward in plain torch on CPU)"
                     ),
@@ -2545,13 +2592,15 @@ def main() -> None:
         )
 
     def sec_bert_encoder() -> None:
-        bert_enc_rate, bert_enc_mfu = _bench_bert_encoder()
+        bert_enc_rate, bert_enc_mfu, bert_batch, bert_len, bert_dtype = _bench_bert_encoder()
+        scaled_note = " CPU-SCALED SHAPES (not comparable to chip rows);" if _trunk_scaled() else ""
         _emit((
                 {
                     "metric": "bert_encoder_tokens_per_sec",
                     "value": round(bert_enc_rate, 1),
                     "unit": (
-                        f"tokens/sec (BERT-base, batch={BERT_BATCH}, len={BERT_LEN}, bf16;"
+                        f"tokens/sec (BERT-base, batch={bert_batch}, len={bert_len}, {bert_dtype},"
+                        f" fused attention + layernorm TM_TPU_KERNELS path;{scaled_note}"
                         f" MFU={bert_enc_mfu:.1%} of v5e bf16 peak per XLA cost analysis;"
                         " no CPU reference measurable)"
                     ),
@@ -3056,7 +3105,7 @@ _README_LABELS = {
     "map_streaming_updates_per_sec": ("mAP streaming `update()`", "{v:,.0f} updates/s"),
     "fid_inception_images_per_sec": ("FID InceptionV3 trunk", "{v:,.0f} imgs/s"),
     "lpips_images_per_sec": ("LPIPS VGG16 trunk", "{v:,.0f} imgs/s"),
-    "bert_encoder_tokens_per_sec": ("BERT-base encoder (bf16)", "{v:,.0f} tokens/s"),
+    "bert_encoder_tokens_per_sec": ("BERT-base encoder", "{v:,.0f} tokens/s"),
     "bertscore_samples_per_sec": ("BERTScore scoring", "{v:,.0f} samples/s"),
     "rouge_samples_per_sec": ("ROUGE-1/2/L corpus scoring", "{v:,.0f} samples/s"),
     "cer_long_transcript_samples_per_sec": ("CER long transcripts", "{v:,.0f} samples/s"),
@@ -3079,6 +3128,9 @@ _README_LABELS = {
     "aot_warm_vs_cold_speedup": ("AOT warm vs cold certified-sweep speedup", "{v:.1f}x"),
     "aot_disabled_retention": ("AOT cache (disabled) compiled default `update()`", "{v:,.0f} updates/s"),
     "aot_enabled_update_per_sec": ("AOT cache (enabled, warm) compiled default `update()`", "{v:,.0f} updates/s"),
+    "chip_vs_cpu_parity": ("Chip-vs-CPU parity sweep (metrics checked)", "{v:.0f} metrics"),
+    "profiling_disabled_retention": ("Profiling (disabled) compiled default `update()`", "{v:,.0f} updates/s"),
+    "tenant_cost_accounting_overhead": ("Per-tenant cost metering (enabled) pool rows", "{v:,.0f} rows/s"),
 }
 
 
@@ -3090,12 +3142,25 @@ def update_readme(artifact_path: str, readme_path: str = "README.md") -> None:
     """
     rows = _parse_bench_artifact(artifact_path)
     src = os.path.basename(artifact_path)
+    platforms = {r.get("platform") for r in rows if r.get("platform")}
+    cpu_only = platforms == {"cpu"}
     table = [
         f"<!-- BENCH:BEGIN (generated by `python bench.py --readme {src}` — do not edit by hand) -->",
-        f"Driver-recorded on one TPU v5e chip (`{src}`); every `vs baseline` is an",
-        "honest same-machine measurement of the reference stack (details in the",
-        "artifact's unit strings).",
     ]
+    if cpu_only:
+        table += [
+            f"Driver-recorded on a CPU-only session (`{src}`): the conv/attention trunk",
+            "sections run CPU-scaled shapes (labeled in the artifact's unit strings) and",
+            "are NOT comparable to chip numbers — the latest on-chip trunk rates live in",
+            "`BENCH_r04.json`. Every `vs baseline` is an honest same-machine measurement",
+            "of the reference stack.",
+        ]
+    else:
+        table += [
+            f"Driver-recorded on one TPU v5e chip (`{src}`); every `vs baseline` is an",
+            "honest same-machine measurement of the reference stack (details in the",
+            "artifact's unit strings).",
+        ]
     if any(r.get("degraded") for r in rows) or any(
         str(r.get("metric", "")).endswith(".section_skipped") for r in rows
     ):

@@ -1,0 +1,178 @@
+"""Kernel-layer dispatch contracts: selection, degradation, AOT, cost claims.
+
+- ``TM_TPU_KERNELS`` resolution (``auto`` = backend-dependent, unknown
+  values never crash).
+- Forced Pallas trace failure (``TM_TPU_KERNELS_FORCE_FAIL``) degrades that
+  kernel to its XLA fallback with a ``kernel_fallback`` bus event and a
+  byte-correct result — the ``_spmd`` fail-into-correctness contract.
+- Top-level kernel calls dispatch through the AOT cache: artifacts persist
+  under ``kernel.*`` kinds and their headers carry the closed-form
+  flop/byte claims (XLA cost analysis cannot see inside Pallas ops).
+"""
+
+import glob
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import _kernels as K
+from torchmetrics_tpu._kernels.dispatch import reset_degradations
+from torchmetrics_tpu._observability.events import BUS
+from torchmetrics_tpu._observability.state import OBS
+
+RNG = np.random.default_rng(11)
+
+
+@pytest.fixture(autouse=True)
+def _clean_kernel_state(monkeypatch):
+    reset_degradations()
+    monkeypatch.delenv(K.KERNELS_ENV, raising=False)
+    monkeypatch.delenv(K.FORCE_FAIL_ENV, raising=False)
+    yield
+    reset_degradations()
+
+
+@pytest.fixture()
+def telemetry_on():
+    was = OBS.enabled
+    OBS.enabled = True
+    yield
+    OBS.enabled = was
+
+
+def _conv_args(dtype=jnp.float32):
+    x = jnp.asarray(RNG.normal(size=(2, 6, 7, 40)), dtype)
+    w = jnp.asarray(RNG.normal(size=(1, 1, 40, 24)) * 0.1, dtype)
+    b = jnp.asarray(RNG.normal(size=(24,)), dtype)
+    return x, w, b
+
+
+def _conv_oracle(x, w, b):
+    y = jax.lax.conv_general_dilated(
+        x, w, (1, 1), "VALID", dimension_numbers=("NHWC", "HWIO", "NHWC")
+    )
+    return jax.nn.relu(y + b)
+
+
+class TestModeResolution:
+    def test_auto_resolves_by_backend(self, monkeypatch):
+        monkeypatch.setenv(K.KERNELS_ENV, "auto")
+        expected = "pallas" if jax.default_backend() == "tpu" else "xla"
+        assert K.kernel_mode() == expected
+
+    def test_default_is_auto(self):
+        assert K.kernel_mode() in ("pallas", "xla")
+
+    def test_explicit_modes(self, monkeypatch):
+        monkeypatch.setenv(K.KERNELS_ENV, "pallas")
+        assert K.kernel_mode() == "pallas" and K.use_pallas()
+        monkeypatch.setenv(K.KERNELS_ENV, "xla")
+        assert K.kernel_mode() == "xla" and not K.use_pallas()
+
+    def test_unknown_value_behaves_like_auto(self, monkeypatch):
+        monkeypatch.setenv(K.KERNELS_ENV, "cuda-graphs")
+        expected = "pallas" if jax.default_backend() == "tpu" else "xla"
+        assert K.kernel_mode() == expected
+
+    def test_interpret_mode_tracks_backend(self):
+        assert K.interpret_mode() == (jax.default_backend() != "tpu")
+
+
+class TestDegradation:
+    def test_forced_trace_failure_degrades_with_event_and_correct_output(
+        self, monkeypatch, telemetry_on
+    ):
+        monkeypatch.setenv(K.KERNELS_ENV, "pallas")
+        monkeypatch.setenv(K.FORCE_FAIL_ENV, "conv_epilogue")
+        x, w, b = _conv_args()
+        got = K.conv_bias_act(x, w, b)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(_conv_oracle(x, w, b)), rtol=1e-6)
+        degraded = K.degraded_kernels()
+        assert "conv_epilogue" in degraded and "ForcedKernelFailure" in degraded["conv_epilogue"]
+        events = BUS.events(kind="kernel_fallback")
+        assert events and any(e.data.get("kernel") == "conv_epilogue" for e in events)
+
+    def test_degradation_pins_for_the_process(self, monkeypatch, telemetry_on):
+        monkeypatch.setenv(K.KERNELS_ENV, "pallas")
+        monkeypatch.setenv(K.FORCE_FAIL_ENV, "lpips_head")
+        f0 = jnp.asarray(RNG.normal(size=(2, 5, 5, 64)), jnp.float32)
+        f1 = jnp.asarray(RNG.normal(size=(2, 5, 5, 64)), jnp.float32)
+        w = jnp.asarray(RNG.normal(size=(1, 1, 64, 1)), jnp.float32)
+        K.lpips_head(f0, f1, w)
+        n_events = len(BUS.events(kind="kernel_fallback"))
+        monkeypatch.delenv(K.FORCE_FAIL_ENV)  # failure cause gone, pin stays
+        K.lpips_head(f0, f1, w)
+        assert "lpips_head" in K.degraded_kernels()
+        # one event per degradation, not per call
+        assert len(BUS.events(kind="kernel_fallback")) == n_events
+
+    def test_other_kernels_unaffected_by_one_degradation(self, monkeypatch):
+        monkeypatch.setenv(K.KERNELS_ENV, "pallas")
+        monkeypatch.setenv(K.FORCE_FAIL_ENV, "conv_epilogue")
+        x, w, b = _conv_args()
+        K.conv_bias_act(x, w, b)
+        assert set(K.degraded_kernels()) == {"conv_epilogue"}
+        f0 = jnp.asarray(RNG.normal(size=(1, 4, 4, 64)), jnp.float32)
+        K.lpips_head(f0, f0 * 0.5, jnp.ones((1, 1, 64, 1), jnp.float32))
+        assert set(K.degraded_kernels()) == {"conv_epilogue"}
+
+
+class TestCostClaims:
+    def test_conv_claim_leading_term(self):
+        x, w, b = _conv_args()
+        cost = K.conv_bias_act_cost(x, w, b)
+        m = x.shape[0] * x.shape[1] * x.shape[2]
+        assert cost.flops >= 2.0 * m * 40 * 24
+        assert cost.bytes_accessed > 0
+
+    def test_all_kernels_claim_nonzero(self):
+        x, w, b = _conv_args()
+        assert K.conv_bias_act_cost(x, w, b).flops > 0
+        f = jnp.zeros((2, 4, 4, 64), jnp.float32)
+        assert K.lpips_head_cost(f, f, jnp.zeros((1, 1, 64, 1))).flops > 0
+        q = jnp.zeros((2, 16, 64), jnp.float32)
+        mask = jnp.ones((2, 16), jnp.float32)
+        assert K.attention_cost(q, q, q, mask, num_heads=4).flops > 0
+        assert K.layernorm_residual_cost(q, q, jnp.ones((64,)), jnp.zeros((64,))).flops > 0
+
+    def test_attention_claim_scales_quadratically_in_length(self):
+        def claim(length):
+            q = jnp.zeros((1, length, 64), jnp.float32)
+            return K.attention_cost(q, q, q, jnp.ones((1, length)), num_heads=4).flops
+
+        assert claim(256) / claim(128) == pytest.approx(4.0, rel=0.1)
+
+
+class TestAotIntegration:
+    def test_kernel_artifacts_persist_with_claimed_cost(self, tmp_path, monkeypatch):
+        import torchmetrics_tpu as tm
+        from torchmetrics_tpu._aot.cache import get_cache
+
+        monkeypatch.setenv(K.KERNELS_ENV, "xla")
+        # fresh dispatcher key so the artifact is written under this cache dir
+        x, w, b = _conv_args()
+        w = jnp.asarray(RNG.normal(size=(3, 1, 40, 24)) * 0.1, jnp.float32)
+        tm.set_aot_cache(str(tmp_path / "aot"))
+        try:
+            got = K.conv_bias_act(x, w, b, padding=((1, 1), (0, 0)))
+            np.testing.assert_allclose(
+                np.asarray(got),
+                np.asarray(
+                    jax.nn.relu(
+                        jax.lax.conv_general_dilated(
+                            x, w, (1, 1), ((1, 1), (0, 0)),
+                            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+                        )
+                        + b
+                    )
+                ),
+                rtol=1e-6,
+            )
+            arts = glob.glob(str(tmp_path / "aot" / "kernel.conv_epilogue.*"))
+            assert arts, "kernel executable did not persist to the AOT cache"
+            entries = [e for e in get_cache().entries() if str(e.get("kind", "")).startswith("kernel.")]
+            assert entries and entries[0]["status"] == "ok"
+        finally:
+            tm.set_aot_cache(None)

@@ -1,0 +1,206 @@
+"""Fused kernel layer vs unfused XLA oracles (ISSUE-18 acceptance surface).
+
+Every fused kernel is checked against the literal unfused graph it
+replaces, across dtypes (f32, bf16 compute), odd non-tile-multiple shapes,
+and both ``TM_TPU_KERNELS`` modes — on CPU the ``pallas`` mode runs the
+real kernels in interpret mode, so tier-1 exercises the Pallas programs
+everywhere. Trunk-level tests pin the wired graphs (Inception / LPIPS /
+BERT) against their ``unfused`` oracle builds with shared parameters.
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from torchmetrics_tpu import _kernels as K
+from torchmetrics_tpu._kernels.dispatch import reset_degradations
+
+RNG = np.random.default_rng(42)
+
+MODES = ("pallas", "xla")
+
+
+@pytest.fixture(autouse=True)
+def _clean_kernel_state(monkeypatch):
+    reset_degradations()
+    monkeypatch.delenv(K.KERNELS_ENV, raising=False)
+    monkeypatch.delenv(K.FORCE_FAIL_ENV, raising=False)
+    yield
+    reset_degradations()
+
+
+def _arr(shape, dtype=jnp.float32, scale=1.0, seed_offset=0):
+    return jnp.asarray(RNG.normal(size=shape) * scale, dtype)
+
+
+def _tol(dtype):
+    return dict(rtol=2e-2, atol=2e-2) if dtype == jnp.bfloat16 else dict(rtol=2e-5, atol=2e-5)
+
+
+# ------------------------------------------------------------ conv epilogue
+
+def _conv_oracle(x, w, b, strides=(1, 1), padding="VALID"):
+    y = jax.lax.conv_general_dilated(
+        x, w, window_strides=strides, padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+    return jax.nn.relu(y + b.astype(y.dtype))
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize(
+    "kshape,strides,padding",
+    [
+        ((1, 1, 70, 33), (1, 1), "VALID"),  # pointwise: fused Pallas GEMM, odd C in/out
+        ((3, 3, 70, 20), (2, 2), ((1, 1), (1, 1))),  # spatial: conv + fused epilogue
+        ((1, 7, 70, 24), (1, 1), ((0, 0), (3, 3))),  # asymmetric Inception-C shape
+    ],
+)
+def test_conv_bias_act_matches_oracle(monkeypatch, mode, dtype, kshape, strides, padding):
+    monkeypatch.setenv(K.KERNELS_ENV, mode)
+    x = _arr((2, 9, 11, kshape[2]), dtype)
+    w = _arr(kshape, dtype, scale=0.1)
+    b = _arr((kshape[-1],), dtype)
+    got = K.conv_bias_act(x, w, b, strides=strides, padding=padding)
+    ref = _conv_oracle(x, w, b, strides, padding)
+    assert got.dtype == ref.dtype and got.shape == ref.shape
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32), **_tol(dtype)
+    )
+    assert not K.degraded_kernels()
+
+
+# --------------------------------------------------------------- lpips head
+
+def _lpips_oracle(f0, f1, w):
+    def norm(t):
+        return t / (jnp.sqrt(jnp.sum(t**2, axis=-1, keepdims=True)) + 1e-10)
+
+    f0, f1 = f0.astype(jnp.float32), f1.astype(jnp.float32)
+    d = (norm(f0) - norm(f1)) ** 2
+    lin = jax.lax.conv_general_dilated(
+        d, w.reshape(1, 1, -1, 1), (1, 1), "VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), precision=jax.lax.Precision.HIGHEST,
+    )
+    return jnp.mean(lin, axis=(1, 2, 3))
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("shape", [(3, 13, 17, 64), (2, 7, 5, 35), (1, 33, 31, 256)])
+def test_lpips_head_matches_oracle(monkeypatch, mode, shape):
+    monkeypatch.setenv(K.KERNELS_ENV, mode)
+    f0, f1 = _arr(shape), _arr(shape, seed_offset=1)
+    w = _arr((1, 1, shape[-1], 1), scale=0.3)
+    got = K.lpips_head(f0, f1, w)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(_lpips_oracle(f0, f1, w)), rtol=1e-5, atol=1e-7)
+    assert not K.degraded_kernels()
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_lpips_head_bf16_features(monkeypatch, mode):
+    monkeypatch.setenv(K.KERNELS_ENV, mode)
+    f0 = _arr((2, 6, 9, 64), jnp.bfloat16)
+    f1 = _arr((2, 6, 9, 64), jnp.bfloat16)
+    w = _arr((1, 1, 64, 1), scale=0.3)
+    got = K.lpips_head(f0, f1, w)  # accumulates in f32 like the oracle
+    assert got.dtype == jnp.float32
+    np.testing.assert_allclose(np.asarray(got), np.asarray(_lpips_oracle(f0, f1, w)), rtol=1e-3, atol=1e-5)
+
+
+# ---------------------------------------------------------------- attention
+
+def _attention_oracle(q, k, v, mask, num_heads):
+    bsz, length, hidden = q.shape
+    head_dim = hidden // num_heads
+
+    def split(t):
+        return t.reshape(bsz, length, num_heads, head_dim).transpose(0, 2, 1, 3)
+
+    scores = jnp.einsum("bhqd,bhkd->bhqk", split(q), split(k), precision="highest")
+    scores = scores / jnp.sqrt(jnp.asarray(head_dim, scores.dtype))
+    bias = (1.0 - mask[:, None, None, :].astype(scores.dtype)) * -1e9
+    probs = jax.nn.softmax(scores + bias, axis=-1)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, split(v), precision="highest")
+    return ctx.transpose(0, 2, 1, 3).reshape(bsz, length, hidden)
+
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("dtype", [jnp.float32, jnp.bfloat16])
+@pytest.mark.parametrize("length", [37, 128])  # odd non-tile L and exact-tile L
+def test_attention_matches_oracle(monkeypatch, mode, dtype, length):
+    monkeypatch.setenv(K.KERNELS_ENV, mode)
+    bsz, hidden, heads = 2, 96, 4
+    q, k, v = (_arr((bsz, length, hidden), dtype, seed_offset=i) for i in range(3))
+    mask = jnp.asarray(RNG.integers(0, 2, (bsz, length)), jnp.float32).at[:, 0].set(1)
+    got = K.attention(q, k, v, mask, num_heads=heads)
+    ref = _attention_oracle(q, k, v, mask, heads)
+    assert got.shape == ref.shape
+    np.testing.assert_allclose(
+        np.asarray(got, np.float32), np.asarray(ref, np.float32), **_tol(dtype)
+    )
+    assert not K.degraded_kernels()
+
+
+# ------------------------------------------------------- layernorm+residual
+
+@pytest.mark.parametrize("mode", MODES)
+@pytest.mark.parametrize("feat", [256, 70])  # lane-aligned Pallas path and unaligned fused-XLA path
+def test_layernorm_residual_matches_flax(monkeypatch, mode, feat):
+    monkeypatch.setenv(K.KERNELS_ENV, mode)
+    x, h = _arr((3, 5, feat)), _arr((3, 5, feat), seed_offset=1)
+    scale, bias = _arr((feat,)), _arr((feat,))
+    got = K.layernorm_residual(x, h, scale, bias, eps=1e-12)
+    ref = nn.LayerNorm(epsilon=1e-12).apply({"params": {"scale": scale, "bias": bias}}, x + h)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-5, atol=1e-5)
+    assert not K.degraded_kernels()
+
+
+# ------------------------------------------------------------- trunk wiring
+
+@pytest.mark.parametrize("mode", MODES)
+def test_bert_encoder_fused_matches_unfused_oracle(monkeypatch, mode):
+    from torchmetrics_tpu.text._bert_encoder import BertConfig, BertEncoder
+
+    monkeypatch.setenv(K.KERNELS_ENV, mode)
+    cfg = BertConfig(vocab_size=120, hidden_size=128, num_layers=2, num_heads=4, intermediate_size=256)
+    ids = jnp.asarray(RNG.integers(0, 120, (3, 21)))
+    mask = jnp.ones((3, 21), jnp.float32).at[0, 15:].set(0)
+    oracle = BertEncoder(cfg, unfused=True)
+    variables = oracle.init(jax.random.PRNGKey(0), ids, mask)
+    ref = oracle.apply(variables, ids, mask)[-1]
+    got = jax.jit(lambda v, i, m: BertEncoder(cfg).apply(v, i, m)[-1])(variables, ids, mask)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-5)
+    assert not K.degraded_kernels()
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_lpips_net_fused_matches_unfused_oracle(monkeypatch, mode):
+    from torchmetrics_tpu.image._lpips import LPIPSNet
+
+    monkeypatch.setenv(K.KERNELS_ENV, mode)
+    img0 = _arr((2, 3, 37, 41))
+    img1 = img0 * 0.5 + 0.1
+    oracle = LPIPSNet(net_type="vgg", unfused=True)
+    variables = oracle.init(jax.random.PRNGKey(0), img0, img1)
+    ref = oracle.apply(variables, img0, img1)
+    got = jax.jit(LPIPSNet(net_type="vgg").apply)(variables, img0, img1)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-7)
+    assert not K.degraded_kernels()
+
+
+@pytest.mark.parametrize("mode", MODES)
+def test_inception_fused_matches_unfused_oracle(monkeypatch, mode):
+    from torchmetrics_tpu.image._inception import InceptionV3, fold_batchnorm
+
+    monkeypatch.setenv(K.KERNELS_ENV, mode)
+    x = _arr((1, 80, 80, 3))
+    unfused = InceptionV3(fuse_bn=False)
+    variables = unfused.init(jax.random.PRNGKey(0), x)
+    ref = unfused.apply(variables, x)["2048"]
+    folded = fold_batchnorm(variables)
+    got = jax.jit(lambda v, xx: InceptionV3(fuse_bn=True).apply(v, xx)["2048"])(folded, x)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref), rtol=1e-4, atol=1e-5)
+    assert not K.degraded_kernels()

@@ -422,7 +422,10 @@ class _AotDispatch:
     around its stats.
     """
 
-    __slots__ = ("_jit_fn", "_owner", "_kind", "_key_repr", "_telem_obj", "_use_disk", "_resolved", "_fast")
+    __slots__ = (
+        "_jit_fn", "_owner", "_kind", "_key_repr", "_telem_obj", "_use_disk",
+        "_resolved", "_fast", "_cost_claim",
+    )
 
     def __init__(
         self,
@@ -432,6 +435,7 @@ class _AotDispatch:
         key_repr: str,
         telem_obj: Any = None,
         use_disk: bool = True,
+        cost_claim: Optional[Callable[[tuple], Any]] = None,
     ) -> None:
         self._jit_fn = jit_fn
         self._owner = owner
@@ -439,6 +443,11 @@ class _AotDispatch:
         self._key_repr = key_repr
         self._telem_obj = telem_obj
         self._use_disk = use_disk
+        # closed-form ExecutableCost claim computed from the concrete call
+        # args — authoritative for executables XLA cannot price (Pallas ops
+        # report zero flops to cost_analysis(), which would zero the MFU
+        # gauges); persisted in the artifact header like an extracted cost
+        self._cost_claim = cost_claim
         self._resolved: Dict[Any, Callable] = {}
         # steady-state fast slot: every seam's structural cache key already
         # pins arg structure + shapes + dtypes, so a dispatcher normally sees
@@ -569,6 +578,9 @@ class _AotDispatch:
         self._resolved[sig] = compiled
         self._fast = compiled if len(self._resolved) == 1 else None
         cost = _costs.extract_cost(compiled) if (cache is not None or _OBS.profiling) else None
+        claim = self._claimed_cost(args) if (cache is not None or _OBS.profiling) else None
+        if claim is not None:
+            cost = claim
         if _OBS.profiling:
             if digest is None:
                 digest = _digest(self._owner, self._kind, self._key_repr, sig)
@@ -587,6 +599,15 @@ class _AotDispatch:
         elif cache is not None:
             self._note_fallback("no serialization format available", cache)
         return "compiled", compiled
+
+    def _claimed_cost(self, args: tuple) -> Optional[Any]:
+        """Evaluate the closed-form cost claim; claim failures never break dispatch."""
+        if self._cost_claim is None:
+            return None
+        try:
+            return self._cost_claim(args)
+        except Exception:  # noqa: BLE001 - pricing is best-effort
+            return None
 
     def _note_cost(
         self, cost: Optional[Any], digest: Optional[str], compile_seconds: float, source: str
@@ -627,13 +648,16 @@ def wrap_executable(
     key_repr: str,
     telem_obj: Any = None,
     use_disk: Optional[bool] = None,
+    cost_claim: Optional[Callable[[tuple], Any]] = None,
 ) -> _AotDispatch:
     """Wrap a fresh jitted callable in the AOT dispatcher.
 
     ``use_disk=None`` follows the process switch at call time (the usual
     seam integration); ``False`` builds a memory-only dispatcher — used by
     ``warm_start()`` so explicit pre-compilation works even without a cache
-    directory.
+    directory. ``cost_claim`` (concrete call args -> ``ExecutableCost``)
+    prices executables XLA's cost analysis cannot see into — the Pallas
+    kernels pass their closed-form flop/byte claims here.
     """
     return _AotDispatch(
         jit_fn,
@@ -642,4 +666,5 @@ def wrap_executable(
         key_repr=key_repr,
         telem_obj=telem_obj,
         use_disk=AOT.active if use_disk is None else use_disk,
+        cost_claim=cost_claim,
     )

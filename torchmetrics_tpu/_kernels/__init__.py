@@ -1,0 +1,56 @@
+"""Fused Pallas kernel layer for the heavy encoder trunks (ROADMAP item 5).
+
+Three fused hot blocks, each with a Pallas TPU kernel and a pure-XLA
+fallback that mirrors the unfused flax graph:
+
+- :func:`conv_bias_act` — conv + bias + ReLU epilogue (1x1 convs run as a
+  single fused GEMM) for the BN-folded Inception trunk.
+- :func:`lpips_head` — unit-normalize -> 1x1 conv -> spatial mean for the
+  LPIPS distance heads, collapsed into one bandwidth pass.
+- :func:`attention` / :func:`layernorm_residual` — fused attention core and
+  post-block LayerNorm for the BERT encoder.
+
+Selection is runtime-gated by ``TM_TPU_KERNELS`` (``auto`` | ``pallas`` |
+``xla``; ``auto`` = pallas on TPU, xla elsewhere — on CPU the Pallas path
+runs in interpret mode so tests exercise it anywhere). A Pallas failure
+degrades that kernel to its XLA fallback with a ``kernel_fallback`` bus
+event; results are never wrong. Top-level calls dispatch through the AOT
+executable cache with closed-form flop/byte cost claims (XLA's
+``cost_analysis()`` cannot see inside Pallas ops).
+"""
+
+from torchmetrics_tpu._kernels.attention import (
+    attention,
+    attention_cost,
+    layernorm_residual,
+    layernorm_residual_cost,
+)
+from torchmetrics_tpu._kernels.conv_epilogue import conv_bias_act, conv_bias_act_cost
+from torchmetrics_tpu._kernels.dispatch import (
+    FORCE_FAIL_ENV,
+    KERNELS_ENV,
+    degraded_kernels,
+    interpret_mode,
+    kernel_mode,
+    reset_degradations,
+    use_pallas,
+)
+from torchmetrics_tpu._kernels.lpips_head import lpips_head, lpips_head_cost
+
+__all__ = [
+    "KERNELS_ENV",
+    "FORCE_FAIL_ENV",
+    "kernel_mode",
+    "use_pallas",
+    "interpret_mode",
+    "degraded_kernels",
+    "reset_degradations",
+    "conv_bias_act",
+    "conv_bias_act_cost",
+    "lpips_head",
+    "lpips_head_cost",
+    "attention",
+    "attention_cost",
+    "layernorm_residual",
+    "layernorm_residual_cost",
+]

@@ -1,0 +1,200 @@
+"""Kernel-layer selection, degradation, and AOT/cost integration.
+
+The fused kernels (``conv_epilogue``, ``lpips_head``, ``attention``) each
+ship two implementations: a Pallas TPU kernel and a pure-XLA fallback whose
+math mirrors the unfused flax graph op-for-op. This module decides which
+one runs and keeps the choice safe and observable:
+
+- **Selection** — ``TM_TPU_KERNELS`` ∈ ``auto`` | ``pallas`` | ``xla``
+  (default ``auto`` = pallas on TPU, xla everywhere else). On non-TPU
+  backends the Pallas path runs in interpret mode, so ``pallas`` is valid
+  on CPU too — tier-1 exercises the kernels everywhere.
+- **Degradation** — a Pallas trace failure never surfaces to the metric:
+  the kernel is pinned to its XLA fallback for the rest of the process and
+  a ``kernel_fallback`` bus event records why, the same
+  fail-into-correctness contract the ``_spmd`` engine uses. Results are
+  never wrong, only unfused. ``TM_TPU_KERNELS_FORCE_FAIL`` (comma list of
+  kernel names) forces the failure path for tests.
+- **AOT dispatch** — top-level (untraced) kernel calls route through
+  ``_aot.cache.wrap_executable`` so compiled kernels serialize into the
+  AOT artifact cache like every other executable seam. Calls made *inside*
+  an outer trace (the trunk forwards) inline into that jit instead.
+- **Cost claims** — Pallas ops are opaque to XLA's ``cost_analysis()``
+  (their flops/bytes report as zero), which would silently zero the MFU
+  gauges. Each kernel therefore carries a closed-form flop/byte claim
+  (``ExecutableCost``) computed from the concrete shapes; the dispatcher
+  hands it to the AOT layer, which prices the ledger with it and persists
+  it in the artifact header so disk hits stay priced too.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+from torchmetrics_tpu._observability.costs import ExecutableCost
+from torchmetrics_tpu._observability.events import BUS as _BUS
+
+__all__ = [
+    "KERNELS_ENV",
+    "FORCE_FAIL_ENV",
+    "kernel_mode",
+    "use_pallas",
+    "interpret_mode",
+    "run_kernel",
+    "degraded_kernels",
+    "reset_degradations",
+]
+
+KERNELS_ENV = "TM_TPU_KERNELS"
+FORCE_FAIL_ENV = "TM_TPU_KERNELS_FORCE_FAIL"
+
+_MODES = ("auto", "pallas", "xla")
+
+
+def kernel_mode() -> str:
+    """Resolved kernel mode: ``pallas`` or ``xla`` (``auto`` is resolved here)."""
+    raw = os.environ.get(KERNELS_ENV, "auto").strip().lower() or "auto"
+    if raw not in _MODES:
+        raw = "auto"  # unknown value behaves like the default, never crashes
+    if raw == "auto":
+        return "pallas" if jax.default_backend() == "tpu" else "xla"
+    return raw
+
+
+def use_pallas() -> bool:
+    return kernel_mode() == "pallas"
+
+
+def interpret_mode() -> bool:
+    """Pallas interpret mode: real Mosaic lowering only on an actual TPU."""
+    return jax.default_backend() != "tpu"
+
+
+class _ForcedKernelFailure(RuntimeError):
+    """Injected trace failure (``TM_TPU_KERNELS_FORCE_FAIL``) for tests."""
+
+
+def _forced_failures() -> Tuple[str, ...]:
+    raw = os.environ.get(FORCE_FAIL_ENV, "")
+    return tuple(s.strip() for s in raw.split(",") if s.strip())
+
+
+# kernels pinned to the XLA fallback after a Pallas failure; process-wide so
+# a failing kernel degrades once, not once per call site
+_DEGRADED: Dict[str, str] = {}
+_DEGRADED_LOCK = threading.Lock()
+
+
+def degraded_kernels() -> Dict[str, str]:
+    """``{kernel_name: reason}`` for every kernel pinned to its fallback."""
+    with _DEGRADED_LOCK:
+        return dict(_DEGRADED)
+
+
+def reset_degradations() -> None:
+    """Clear the degradation pins (tests only)."""
+    with _DEGRADED_LOCK:
+        _DEGRADED.clear()
+
+
+def _degrade(name: str, owner: str, err: BaseException) -> None:
+    reason = f"{type(err).__name__}: {err}"
+    with _DEGRADED_LOCK:
+        already = name in _DEGRADED
+        _DEGRADED[name] = reason
+    if not already:
+        _BUS.publish(
+            "kernel_fallback",
+            owner,
+            f"{name}: pallas path failed, pinned to XLA fallback: {reason}",
+            data={"kernel": name, "reason": reason[:400]},
+        )
+
+
+# ------------------------------------------------------------------ AOT seam
+# one dispatcher per (kernel name, impl, static config): the aval signature
+# inside _AotDispatch handles shape/dtype variation per dispatcher
+_DISPATCHERS: Dict[Tuple[str, str], Any] = {}
+_DISPATCHERS_LOCK = threading.Lock()
+
+
+def _dispatcher(
+    name: str,
+    static_key: str,
+    fn: Callable,
+    cost_claim: Optional[Callable[[tuple], Optional[ExecutableCost]]],
+) -> Callable:
+    key = (name, static_key)
+    disp = _DISPATCHERS.get(key)
+    if disp is None:
+        with _DISPATCHERS_LOCK:
+            disp = _DISPATCHERS.get(key)
+            if disp is None:
+                from torchmetrics_tpu._aot.cache import wrap_executable
+
+                disp = wrap_executable(
+                    jax.jit(fn),
+                    owner="kernels",
+                    kind=f"kernel.{name}",
+                    key_repr=static_key,
+                    cost_claim=cost_claim,
+                )
+                _DISPATCHERS[key] = disp
+    return disp
+
+
+def _any_tracer(arrays: tuple) -> bool:
+    return any(isinstance(leaf, jax.core.Tracer) for leaf in jax.tree_util.tree_leaves(arrays))
+
+
+def run_kernel(
+    name: str,
+    owner: str,
+    static_key: str,
+    pallas_fn: Callable,
+    xla_fn: Callable,
+    arrays: tuple,
+    cost_claim: Optional[Callable[[tuple], Optional[ExecutableCost]]] = None,
+):
+    """Run one fused op through the selection/degradation/AOT machinery.
+
+    ``pallas_fn``/``xla_fn`` are positional-array callables with every static
+    already bound (``static_key`` names that binding for the AOT digest).
+    Inside an outer trace the chosen implementation inlines into that jit;
+    at top level it dispatches through the AOT cache.
+    """
+    traced = _any_tracer(arrays)
+    with _DEGRADED_LOCK:
+        pinned = name in _DEGRADED
+    if use_pallas() and not pinned:
+        try:
+            if name in _forced_failures():
+                raise _ForcedKernelFailure(f"{FORCE_FAIL_ENV} lists {name!r}")
+            if traced:
+                return pallas_fn(*arrays)
+            return _dispatcher(name + ".pallas", static_key, pallas_fn, cost_claim)(*arrays)
+        except (KeyboardInterrupt, SystemExit):
+            raise
+        except Exception as err:  # noqa: BLE001 - any pallas failure degrades to XLA
+            _degrade(name, owner, err)
+    if traced:
+        return xla_fn(*arrays)
+    return _dispatcher(name + ".xla", static_key, xla_fn, cost_claim)(*arrays)
+
+
+def claim_from(cost_fn: Callable[..., ExecutableCost]) -> Callable[[tuple], Optional[ExecutableCost]]:
+    """Adapt a shape-based cost function into an AOT ``cost_claim`` callable."""
+
+    @functools.wraps(cost_fn)
+    def _claim(args: tuple) -> Optional[ExecutableCost]:
+        try:
+            return cost_fn(*args)
+        except Exception:  # noqa: BLE001 - a cost claim must never break dispatch
+            return None
+
+    return _claim

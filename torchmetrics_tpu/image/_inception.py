@@ -31,6 +31,39 @@ Array = jax.Array
 from torchmetrics_tpu.utilities.compute import _mxu_precision  # noqa: E402
 
 
+class _FusedConvBiasRelu(nn.Module):
+    """``relu(conv + bias)`` through the fused kernel layer (``_kernels``).
+
+    Drop-in for the ``fuse_bn=True`` conv: named ``Conv_0`` with the same
+    ``kernel``/``bias`` param names, shapes, and initializers as ``nn.Conv``,
+    so :func:`fold_batchnorm` output and converted checkpoints load
+    unchanged. The epilogue (bias add + ReLU) fuses into the conv through
+    ``_kernels.conv_bias_act`` — Pallas on TPU, the identical-math XLA
+    graph elsewhere.
+    """
+
+    features: int
+    kernel_size: Sequence[int]
+    strides: Sequence[int]
+    padding: Any
+    dtype: Any
+
+    @nn.compact
+    def __call__(self, x: Array) -> Array:
+        from torchmetrics_tpu import _kernels
+
+        kh, kw = self.kernel_size
+        kernel = self.param(
+            "kernel", nn.initializers.lecun_normal(), (kh, kw, x.shape[-1], self.features), jnp.float32
+        )
+        bias = self.param("bias", nn.initializers.zeros_init(), (self.features,), jnp.float32)
+        return _kernels.conv_bias_act(
+            x.astype(self.dtype), kernel.astype(self.dtype), bias.astype(self.dtype),
+            strides=tuple(self.strides), padding=self.padding,
+            precision=_mxu_precision(self.dtype),
+        )
+
+
 class BasicConv2d(nn.Module):
     out_channels: int
     kernel_size: Sequence[int]
@@ -41,12 +74,18 @@ class BasicConv2d(nn.Module):
 
     @nn.compact
     def __call__(self, x: Array) -> Array:
+        if self.fuse_bn:
+            # BN already folded into kernel/bias: conv + bias + relu runs as
+            # ONE fused op through the kernel layer
+            return _FusedConvBiasRelu(
+                self.out_channels, tuple(self.kernel_size), tuple(self.strides),
+                self.padding, self.dtype, name="Conv_0",
+            )(x)
         x = nn.Conv(
             self.out_channels, self.kernel_size, self.strides, padding=self.padding,
-            use_bias=self.fuse_bn, dtype=self.dtype, precision=_mxu_precision(self.dtype),
+            use_bias=False, dtype=self.dtype, precision=_mxu_precision(self.dtype),
         )(x)
-        if not self.fuse_bn:
-            x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9, dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=True, epsilon=1e-3, momentum=0.9, dtype=self.dtype)(x)
         return nn.relu(x)
 
 

@@ -163,11 +163,36 @@ def _normalize_tensor(x: Array, eps: float = 1e-10) -> Array:
     return x / (norm + eps)
 
 
+class _FusedLinHead(nn.Module):
+    """One LPIPS ``lin{i}`` head through the fused kernel layer.
+
+    Same param name/shape/init as the oracle ``nn.Conv(1, (1, 1),
+    use_bias=False)`` head, so checkpoints load unchanged; the
+    normalize -> 1x1 conv -> spatial-mean chain runs as ONE pass via
+    ``_kernels.lpips_head``.
+    """
+
+    @nn.compact
+    def __call__(self, f0: Array, f1: Array) -> Array:
+        from torchmetrics_tpu import _kernels
+
+        c = f0.shape[-1]
+        kernel = self.param("kernel", nn.initializers.lecun_normal(), (1, 1, c, 1), jnp.float32)
+        return _kernels.lpips_head(f0, f1, kernel)
+
+
 class LPIPSNet(nn.Module):
-    """Full LPIPS: trunk + per-tap linear heads, spatial-averaged and summed."""
+    """Full LPIPS: trunk + per-tap linear heads, spatial-averaged and summed.
+
+    ``unfused=True`` keeps the literal oracle graph (normalize, subtract,
+    square, 1x1 conv, mean as separate ops) — the reference the fused
+    kernel path is verified against and the denominator of the
+    fused-vs-unfused bench lines.
+    """
 
     dtype: Any = jnp.float32
     net_type: str = "vgg"  # 'vgg' | 'alex' | 'squeeze', like the reference
+    unfused: bool = False
 
     @nn.compact
     def __call__(self, img0: Array, img1: Array) -> Array:
@@ -192,9 +217,12 @@ class LPIPSNet(nn.Module):
         for i, (f0, f1) in enumerate(zip(feats0, feats1)):
             # distances accumulate in float32 regardless of trunk dtype
             f0, f1 = f0.astype(jnp.float32), f1.astype(jnp.float32)
-            d = (_normalize_tensor(f0) - _normalize_tensor(f1)) ** 2
-            lin = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}", precision="highest")(d)
-            total = total + jnp.mean(lin, axis=(1, 2, 3))
+            if self.unfused:
+                d = (_normalize_tensor(f0) - _normalize_tensor(f1)) ** 2
+                lin = nn.Conv(1, (1, 1), use_bias=False, name=f"lin{i}", precision="highest")(d)
+                total = total + jnp.mean(lin, axis=(1, 2, 3))
+            else:
+                total = total + _FusedLinHead(name=f"lin{i}")(f0, f1)
         return total
 
 
@@ -204,13 +232,22 @@ class LPIPSExtractor(PickleableJitMixin):
     _COMPILED_ATTRS = ("_forward",)
 
 
-    def __init__(self, net_type: str = "vgg", weights_path: str = None, seed: int = 0, compute_dtype=None) -> None:
+    def __init__(
+        self,
+        net_type: str = "vgg",
+        weights_path: str = None,
+        seed: int = 0,
+        compute_dtype=None,
+        unfused: bool = False,
+    ) -> None:
         if net_type not in ("vgg", "alex", "squeeze"):
             raise ValueError(f"Argument `net_type` must be one of 'vgg', 'alex' or 'squeeze', but got {net_type}")
         # bfloat16 trunk by default: the convs hit the MXU at twice the fp32
         # rate; params and the per-tap distance heads stay float32
         self.net = LPIPSNet(
-            dtype=compute_dtype if compute_dtype is not None else jnp.bfloat16, net_type=net_type
+            dtype=compute_dtype if compute_dtype is not None else jnp.bfloat16,
+            net_type=net_type,
+            unfused=unfused,
         )
         dummy = jnp.zeros((1, 3, 64, 64), jnp.float32)
         if weights_path:

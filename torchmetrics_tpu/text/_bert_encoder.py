@@ -52,11 +52,32 @@ class BertConfig:
         self.with_mlm_head = with_mlm_head
 
 
+class _FusedLayerNormResidual(nn.Module):
+    """``LayerNorm(x + h)`` through the fused kernel layer.
+
+    Same ``scale``/``bias`` param names, shapes, and initializers as
+    ``nn.LayerNorm`` (checkpoints load unchanged); the residual add and the
+    normalization fuse into one pass via ``_kernels.layernorm_residual``.
+    """
+
+    eps: float
+
+    @nn.compact
+    def __call__(self, x: Array, h: Array) -> Array:
+        from torchmetrics_tpu import _kernels
+
+        c = x.shape[-1]
+        scale = self.param("scale", nn.initializers.ones_init(), (c,), jnp.float32)
+        bias = self.param("bias", nn.initializers.zeros_init(), (c,), jnp.float32)
+        return _kernels.layernorm_residual(x, h, scale, bias, eps=self.eps)
+
+
 class _SelfAttention(nn.Module):
     hidden_size: int
     num_heads: int
     eps: float
     dtype: Any
+    unfused: bool = False
 
     @nn.compact
     def __call__(self, x: Array, attention_mask: Array) -> Array:
@@ -65,18 +86,25 @@ class _SelfAttention(nn.Module):
         k = nn.Dense(self.hidden_size, name="key", dtype=self.dtype, precision=_mxu_precision(self.dtype))(x)
         v = nn.Dense(self.hidden_size, name="value", dtype=self.dtype, precision=_mxu_precision(self.dtype))(x)
 
-        def split(t):  # (B, L, H) -> (B, heads, L, head_dim)
-            return t.reshape(*t.shape[:2], self.num_heads, head_dim).transpose(0, 2, 1, 3)
+        if self.unfused:
+            def split(t):  # (B, L, H) -> (B, heads, L, head_dim)
+                return t.reshape(*t.shape[:2], self.num_heads, head_dim).transpose(0, 2, 1, 3)
 
-        scores = jnp.einsum("bhqd,bhkd->bhqk", split(q), split(k), precision="highest")
-        scores = scores / jnp.sqrt(jnp.asarray(head_dim, scores.dtype))
-        # HF-style additive mask: masked keys get a large negative bias
-        bias = (1.0 - attention_mask[:, None, None, :].astype(scores.dtype)) * -1e9
-        probs = jax.nn.softmax(scores + bias, axis=-1)
-        ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, split(v), precision="highest")
-        ctx = ctx.transpose(0, 2, 1, 3).reshape(*x.shape[:2], self.hidden_size)
+            scores = jnp.einsum("bhqd,bhkd->bhqk", split(q), split(k), precision="highest")
+            scores = scores / jnp.sqrt(jnp.asarray(head_dim, scores.dtype))
+            # HF-style additive mask: masked keys get a large negative bias
+            bias = (1.0 - attention_mask[:, None, None, :].astype(scores.dtype)) * -1e9
+            probs = jax.nn.softmax(scores + bias, axis=-1)
+            ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, split(v), precision="highest")
+            ctx = ctx.transpose(0, 2, 1, 3).reshape(*x.shape[:2], self.hidden_size)
+        else:
+            from torchmetrics_tpu import _kernels
+
+            ctx = _kernels.attention(q, k, v, attention_mask, num_heads=self.num_heads)
         out = nn.Dense(self.hidden_size, name="out", dtype=self.dtype, precision=_mxu_precision(self.dtype))(ctx)
-        return nn.LayerNorm(epsilon=self.eps, name="ln")(x + out)
+        if self.unfused:
+            return nn.LayerNorm(epsilon=self.eps, name="ln")(x + out)
+        return _FusedLayerNormResidual(self.eps, name="ln")(x, out)
 
 
 class _EncoderLayer(nn.Module):
@@ -85,16 +113,19 @@ class _EncoderLayer(nn.Module):
     intermediate_size: int
     eps: float
     dtype: Any
+    unfused: bool = False
 
     @nn.compact
     def __call__(self, x: Array, attention_mask: Array) -> Array:
-        x = _SelfAttention(self.hidden_size, self.num_heads, self.eps, self.dtype, name="attention")(
-            x, attention_mask
-        )
+        x = _SelfAttention(
+            self.hidden_size, self.num_heads, self.eps, self.dtype, self.unfused, name="attention"
+        )(x, attention_mask)
         h = nn.Dense(self.intermediate_size, name="intermediate", dtype=self.dtype, precision=_mxu_precision(self.dtype))(x)
         h = jax.nn.gelu(h, approximate=False)  # HF "gelu" is the erf form
         h = nn.Dense(self.hidden_size, name="output", dtype=self.dtype, precision=_mxu_precision(self.dtype))(h)
-        return nn.LayerNorm(epsilon=self.eps, name="ln")(x + h)
+        if self.unfused:
+            return nn.LayerNorm(epsilon=self.eps, name="ln")(x + h)
+        return _FusedLayerNormResidual(self.eps, name="ln")(x, h)
 
 
 class BertEncoder(nn.Module):
@@ -102,6 +133,7 @@ class BertEncoder(nn.Module):
 
     config: BertConfig
     dtype: Any = jnp.float32
+    unfused: bool = False  # literal oracle graph (separate einsum/LN ops)
 
     @nn.compact
     def __call__(
@@ -122,7 +154,7 @@ class BertEncoder(nn.Module):
         for i in range(cfg.num_layers):
             x = _EncoderLayer(
                 cfg.hidden_size, cfg.num_heads, cfg.intermediate_size, cfg.layer_norm_eps, self.dtype,
-                name=f"layer_{i}",
+                self.unfused, name=f"layer_{i}",
             )(x, attention_mask)
             hidden_states.append(x.astype(jnp.float32))
         return hidden_states
@@ -146,10 +178,13 @@ class BertMLMHead(nn.Module):
 class _BertWithHead(nn.Module):
     config: BertConfig
     dtype: Any = jnp.float32
+    unfused: bool = False
 
     @nn.compact
     def __call__(self, input_ids: Array, attention_mask: Array):
-        hidden_states = BertEncoder(self.config, self.dtype, name="bert")(input_ids, attention_mask)
+        hidden_states = BertEncoder(self.config, self.dtype, self.unfused, name="bert")(
+            input_ids, attention_mask
+        )
         logits = None
         if self.config.with_mlm_head:
             logits = BertMLMHead(self.config, self.dtype, name="mlm")(hidden_states[-1])
@@ -196,10 +231,20 @@ class BertEncoderExtractor(PickleableJitMixin):
     _COMPILED_ATTRS = ("_forward",)
 
 
-    def __init__(self, weights_path: str, num_layers: Optional[int] = None, compute_dtype=None) -> None:
+    def __init__(
+        self,
+        weights_path: str,
+        num_layers: Optional[int] = None,
+        compute_dtype=None,
+        unfused: bool = False,
+    ) -> None:
         flat = dict(np.load(weights_path))
         self.config = _config_from_npz(flat)
-        self.net = _BertWithHead(self.config, dtype=compute_dtype if compute_dtype is not None else jnp.float32)
+        self.net = _BertWithHead(
+            self.config,
+            dtype=compute_dtype if compute_dtype is not None else jnp.float32,
+            unfused=unfused,
+        )
         self.variables = {"params": _params_tree_from_flat(flat)}
         self.num_layers = num_layers
         self._build_forward()
