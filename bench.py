@@ -2430,6 +2430,206 @@ def _emit(line: dict) -> None:
     print(json.dumps(line))
 
 
+# --------------------------------------------------------------------------
+# Metrics-as-a-service serving runtime (SERVING.md / ISSUE-19)
+# --------------------------------------------------------------------------
+
+SERVING_STREAMS = 8  # concurrent tenants in the sustained-ingest run
+SERVING_ROUNDS = 40  # rounds x streams = acked rows per side
+SERVING_OVERHEAD_S = 0.002  # injected per-dispatch overhead (what batching amortizes)
+SERVING_RECOVERY_EPISODES = 3  # shed/recover cycles measured
+SERVING_WARM_CHILDREN = 3  # fresh-process warm-boot pairs
+
+_SERVING_WARM_CHILD = r"""
+import json, time
+t0 = time.monotonic()
+import numpy as np
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._serving import ControllerConfig, MetricServer
+
+rng = np.random.default_rng(0)
+srv = MetricServer(
+    tm.MeanSquaredError(), capacity=4,
+    controller=ControllerConfig(max_batch=8, interval_s=0.05),
+)
+sid = srv.attach_stream()
+ex = rng.normal(size=(256,)).astype(np.float32)
+srv.warm(ex, ex)
+srv.start()
+
+def one():
+    p = rng.normal(size=(256,)).astype(np.float32)
+    t = rng.normal(size=(256,)).astype(np.float32)
+    ack = srv.submit(sid, p, t)
+    assert ack.result(timeout=60) == "acked"
+    lat = ack.latency_s
+    return (lat if lat is not None else 0.0) * 1000.0
+
+first_ms = one()
+steady = sorted(one() for _ in range(200))
+srv.close()
+p99 = steady[min(len(steady) - 1, int(round(0.99 * (len(steady) - 1))))]
+print(json.dumps({
+    "first_ms": first_ms,
+    "steady_p99_ms": p99,
+    "spawn_to_first_ms": (time.monotonic() - t0) * 1000.0,
+}))
+"""
+
+
+def _serving_row(rng):
+    import numpy as np
+
+    return (
+        rng.normal(size=(64,)).astype(np.float32),
+        rng.normal(size=(64,)).astype(np.float32),
+    )
+
+
+def _bench_serving_sustained(max_batch: int):
+    """Acked rows/sec + ingest latencies for one (fixed or adaptive) run."""
+    import numpy as np
+
+    import torchmetrics_tpu as tm
+    from torchmetrics_tpu._observability import REGISTRY
+    from torchmetrics_tpu._serving import ControllerConfig, MetricServer
+
+    rng = np.random.default_rng(19)
+    cfg = ControllerConfig(
+        min_batch=1, max_batch=max_batch, interval_s=0.005,
+        target_ms=2000.0, objective=0.95,
+    )
+    srv = MetricServer(
+        tm.MeanSquaredError(), capacity=SERVING_STREAMS, queue_capacity=1024, controller=cfg
+    )
+    sids = [srv.attach_stream() for _ in range(SERVING_STREAMS)]
+    srv.warm(*_serving_row(rng))
+    with srv:
+        srv.set_step_delay(SERVING_OVERHEAD_S)
+        t0 = time.perf_counter()
+        acks = []
+        for _ in range(SERVING_ROUNDS):
+            for sid in sids:
+                acks.append(srv.submit(sid, *_serving_row(rng)))
+        for ack in acks:
+            assert ack.result(timeout=120) == "acked"
+        elapsed = time.perf_counter() - t0
+        target = srv.controller.target
+    latencies_ms = sorted(a.latency_s * 1000.0 for a in acks)
+    REGISTRY.reset()  # isolate the two sides' burn signals
+    qps = len(acks) / elapsed
+    p99 = latencies_ms[min(len(latencies_ms) - 1, int(round(0.99 * (len(latencies_ms) - 1))))]
+    return qps, p99, target
+
+
+def _bench_serving_recovery():
+    """p50 ms from latency-fault END to the loop re-admitting (shed exit)."""
+    import numpy as np
+
+    import torchmetrics_tpu as tm
+    from torchmetrics_tpu._observability import REGISTRY
+    from torchmetrics_tpu._serving import BackpressureError, ControllerConfig, MetricServer
+
+    rng = np.random.default_rng(23)
+    cfg = ControllerConfig(
+        min_batch=1, max_batch=8, interval_s=0.01, target_ms=5.0, objective=0.95
+    )
+    srv = MetricServer(tm.MeanSquaredError(), capacity=4, queue_capacity=32, controller=cfg)
+    sid = srv.attach_stream()
+    srv.warm(*_serving_row(rng))
+    recoveries = []
+
+    def pump():
+        try:
+            ack = srv.submit(sid, *_serving_row(rng))
+            ack.wait(timeout=30.0)
+        except BackpressureError as err:
+            time.sleep(min(err.retry_after_s, 0.005))
+
+    with srv:
+        for _ in range(SERVING_RECOVERY_EPISODES):
+            srv.set_step_delay(0.03)  # burn the 5ms objective at page-now speed
+            deadline = time.monotonic() + 60.0
+            while not srv.controller.shedding and time.monotonic() < deadline:
+                pump()
+            assert srv.controller.shedding, "burn never tripped the shed law"
+            srv.set_step_delay(0.0)  # the fault ends; clients keep retrying
+            t0 = time.perf_counter()
+            while srv.controller.shedding and time.monotonic() < deadline:
+                pump()
+            assert not srv.controller.shedding, "loop never re-admitted"
+            recoveries.append((time.perf_counter() - t0) * 1000.0)
+    REGISTRY.reset()
+    return sorted(recoveries)[len(recoveries) // 2]
+
+
+def _bench_serving_admission():
+    """Tenants admitted at a 10k-stream ceiling; the 10,001st must refuse."""
+    import torchmetrics_tpu as tm
+    from torchmetrics_tpu._serving import MetricServer
+    from torchmetrics_tpu._streams.pool import StreamPoolAdmissionError, set_memory_ceiling
+
+    n = 10_000
+    srv = MetricServer(tm.MeanSquaredError(), capacity=n, queue_capacity=16)
+    per_stream = srv.pool.predicted_stream_bytes()
+    assert per_stream is not None, "MSE must have an exact memory cost model"
+    ceiling = (n + 1) * per_stream  # exactly n tenants + the scratch row
+    set_memory_ceiling(ceiling)
+    try:
+        admitted = 0
+        for _ in range(n):
+            srv.attach_stream()
+            admitted += 1
+        held = False
+        try:
+            srv.attach_stream()  # forces capacity growth past the ceiling
+        except StreamPoolAdmissionError:
+            held = True
+        assert held, "ceiling must refuse the 10,001st tenant"
+    finally:
+        set_memory_ceiling(None)
+        srv.close()
+    return admitted, (n + 1) * per_stream / 1e6
+
+
+def _run_serving_warm_child():
+    env = dict(os.environ)
+    try:
+        res = subprocess.run(
+            [sys.executable, "-c", _SERVING_WARM_CHILD],
+            env=env,
+            capture_output=True,
+            text=True,
+            cwd=os.path.dirname(os.path.abspath(__file__)),
+            timeout=900,
+        )
+    except subprocess.TimeoutExpired:
+        return None
+    if res.returncode != 0:
+        return None
+    return json.loads(res.stdout.strip().splitlines()[-1])
+
+
+def _bench_serving_warm_boot():
+    """First-request p99 vs steady-state p99, each in a FRESH process.
+
+    ``warm()`` pre-resolves every bucket executable before the first
+    request, so the ratio should sit near 1.0; the 1.2x acceptance bound
+    is asserted by the serving test suite, reported here as the measured
+    fleet number (p50 over fresh children).
+    """
+    ratios, firsts, steadies = [], [], []
+    for _ in range(SERVING_WARM_CHILDREN):
+        rec = _run_serving_warm_child()
+        if rec is None:
+            raise RuntimeError("serving warm-boot child failed")
+        firsts.append(rec["first_ms"])
+        steadies.append(rec["steady_p99_ms"])
+        ratios.append(rec["first_ms"] / max(rec["steady_p99_ms"], 1e-9))
+    mid = len(ratios) // 2
+    return sorted(ratios)[mid], sorted(firsts)[mid], sorted(steadies)[mid]
+
+
 def _emit_summary() -> None:
     if not _RESULTS:
         return
@@ -3020,6 +3220,90 @@ def main() -> None:
             )
         )
 
+    def sec_serving() -> None:
+        from torchmetrics_tpu._observability import (
+            REGISTRY,
+            set_telemetry_enabled,
+            set_telemetry_sampling,
+        )
+        from torchmetrics_tpu._observability.state import DEFAULT_SAMPLE_EVERY
+
+        # the control loop reads the ingest SLO: telemetry must be live
+        set_telemetry_enabled(True)
+        set_telemetry_sampling(1)
+        try:
+            adaptive_qps, p99_ms, target = _bench_serving_sustained(max_batch=8)
+            fixed_qps, _, _ = _bench_serving_sustained(max_batch=1)
+            recovery_ms = _bench_serving_recovery()
+            admitted, footprint_mb = _bench_serving_admission()
+            warm_ratio, first_ms, steady_ms = _bench_serving_warm_boot()
+        finally:
+            set_telemetry_enabled(False)
+            set_telemetry_sampling(DEFAULT_SAMPLE_EVERY)
+            REGISTRY.reset()
+        _emit((
+                {
+                    "metric": "serving_sustained_qps",
+                    "value": round(adaptive_qps, 1),
+                    "unit": (
+                        f"acked rows/sec (MetricServer, {SERVING_STREAMS} tenants x {SERVING_ROUNDS} rounds,"
+                        f" {SERVING_OVERHEAD_S * 1000:.0f}ms injected per-dispatch overhead, SLO-closed-loop"
+                        f" adaptive micro-batching grew the target to {target}; baseline = same server pinned"
+                        " to batch 1 — vs_baseline is the adaptive/fixed throughput ratio)"
+                    ),
+                    "vs_baseline": round(adaptive_qps / fixed_qps, 3),
+                }
+            )
+        )
+        _emit((
+                {
+                    "metric": "serving_ingest_p99_ms",
+                    "value": round(p99_ms, 2),
+                    "unit": (
+                        "ms enqueue-to-ack p99 during the adaptive sustained run (acks resolve only"
+                        " after the micro-batch is applied AND journaled — acked means durable)"
+                    ),
+                }
+            )
+        )
+        _emit((
+                {
+                    "metric": "serving_backpressure_recovery_ms",
+                    "value": round(recovery_ms, 1),
+                    "unit": (
+                        f"ms p50 over {SERVING_RECOVERY_EPISODES} shed episodes: injected latency burn trips"
+                        " load shedding; measured from the fault ENDING to the burn-rate loop re-admitting"
+                        " on its own (canary-probe admissions refresh the signal; no operator input)"
+                    ),
+                }
+            )
+        )
+        _emit((
+                {
+                    "metric": "serving_pool_admission_10k_streams",
+                    "value": admitted,
+                    "unit": (
+                        f"tenants admitted under a {footprint_mb:.1f} MB memory ceiling sized for exactly"
+                        " 10k streams (closed-form state cost model); the 10,001st attach is refused"
+                        " with StreamPoolAdmissionError — the ceiling HELD"
+                    ),
+                }
+            )
+        )
+        _emit((
+                {
+                    "metric": "serving_warm_boot_p99_ratio",
+                    "value": round(warm_ratio, 3),
+                    "unit": (
+                        f"first-request ms / steady-state p99 ms, p50 over {SERVING_WARM_CHILDREN} FRESH"
+                        f" processes ({first_ms:.2f}ms first vs {steady_ms:.2f}ms steady p99) — warm()"
+                        " pre-resolves every power-of-two bucket executable before the first request"
+                        " (acceptance bound: <= 1.2x)"
+                    ),
+                }
+            )
+        )
+
     for name, section in (
         ("multiclass_accuracy_updates_per_sec", sec_headline_accuracy),
         ("class_api_updates_per_sec", sec_class_api),
@@ -3042,6 +3326,7 @@ def main() -> None:
         ("cold_start_ms", sec_aot_cold_start),
         ("aot_disabled_retention", sec_aot_retention),
         ("profiling_disabled_retention", sec_profiling),
+        ("serving_sustained_qps", sec_serving),
     ):
         _run_section(name, section)
 
@@ -3131,6 +3416,11 @@ _README_LABELS = {
     "chip_vs_cpu_parity": ("Chip-vs-CPU parity sweep (metrics checked)", "{v:.0f} metrics"),
     "profiling_disabled_retention": ("Profiling (disabled) compiled default `update()`", "{v:,.0f} updates/s"),
     "tenant_cost_accounting_overhead": ("Per-tenant cost metering (enabled) pool rows", "{v:,.0f} rows/s"),
+    "serving_sustained_qps": ("Serving sustained ingest (SLO-adaptive micro-batching)", "{v:,.0f} rows/s"),
+    "serving_ingest_p99_ms": ("Serving ingest p99 (enqueue → durable ack)", "{v:.2f} ms"),
+    "serving_backpressure_recovery_ms": ("Load-shed recovery (fault end → re-admission)", "{v:,.0f} ms"),
+    "serving_pool_admission_10k_streams": ("Serving admission @10k tenants (ceiling held)", "{v:,.0f} streams"),
+    "serving_warm_boot_p99_ratio": ("Warm boot: first-request vs steady-state p99", "{v:.2f}x"),
 }
 
 

@@ -476,7 +476,12 @@ class TestBootstrapperVmapped:
             base.append((p, t))
         return m, base
 
-    @pytest.mark.parametrize("strategy", ["poisson", "multinomial"])
+    @pytest.mark.parametrize(
+        "strategy",
+        # multinomial keeps the tier-1 statistical-soundness leg; the poisson
+        # variant exercises the same vmapped path (round-19 budget reclaim)
+        [pytest.param("poisson", marks=pytest.mark.slow), "multinomial"],
+    )
     def test_fast_path_engages_and_is_statistically_sound(self, strategy):
         from torchmetrics_tpu.classification import BinaryAccuracy
 

@@ -195,6 +195,8 @@ def test_lpips_with_custom_net():
     assert np.isclose(float(m.compute()), 0.0, atol=1e-7)
 
 
+@pytest.mark.slow  # ~8s VGG compile for a shapes-only check; the LPIPS trunk
+# equivalence + fused-kernel suites compile the same graph in tier-1 already
 def test_lpips_builtin_net_shapes():
     # random-weight trunk: values are meaningless but shapes/pipeline must work
     m = tm.LearnedPerceptualImagePatchSimilarity(net_type="vgg")
@@ -232,6 +234,8 @@ def test_perceptual_path_length_with_toy_generator():
     assert np.isfinite(float(mean2))
 
 
+@pytest.mark.slow  # ~28s of pure compile; the trunk-equivalence and fused-kernel
+# suites compile the same InceptionV3 against real weights in tier-1 already
 def test_inception_trunk_forward_shapes():
     # random weights; just prove the Flax InceptionV3 compiles and the taps
     # have the right dimensionality on small inputs

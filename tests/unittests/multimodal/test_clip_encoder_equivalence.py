@@ -162,6 +162,8 @@ def test_string_text_without_tokenizer_raises(converted):
         ex.get_text_features(["a photo of a cat"])
 
 
+@pytest.mark.slow  # ctor-wiring convenience check; CLIPScore/CLIP-IQA
+# converted-model equivalence above covers the path in tier-1
 def test_modular_weights_path_wiring(converted):
     from torchmetrics_tpu.multimodal import CLIPImageQualityAssessment, CLIPScore
 

@@ -1,0 +1,129 @@
+"""Chaos under load: seeded fault schedules against the live serving loop.
+
+Tier-1 runs fixed-seed smokes (seconds each); the multi-seed soak runs
+under ``-m slow`` with a per-seed wall-clock budget. Every schedule asserts
+the ISSUE-19 invariants through ``ServingChaosResult.ok``: golden equality
+over acknowledged batches (quarantined rows excluded), bounded preemption
+recovery, and the wall-clock budget (no deadlocks) — while ingest, reads,
+and scrapes keep flowing.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import pytest
+
+from torchmetrics_tpu._serving import ServingChaosSpec, run_serving_chaos, run_serving_chaos_soak
+
+
+def _run(seed, **kwargs):
+    # degradation warnings (quarantine drops, sync retries, recompiles) are
+    # the stack WORKING as designed mid-schedule — only the invariants matter
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        result = run_serving_chaos(seed, **kwargs)
+    assert result.ok, result.describe()
+    return result
+
+
+# ---------------------------------------------------------------------------
+# tier-1 smoke: fixed seeds, seconds of wall clock
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("seed", [0, 1])
+def test_serving_chaos_smoke(seed):
+    result = _run(seed)
+    assert result.acked > 0
+    assert result.golden_equal and result.within_budget
+
+
+def test_serving_chaos_preemption_recovery_is_bounded():
+    """Seed 0's schedule includes preemption kills; every recovery (rebuild
+    + journal replay + worker restart) lands inside the spec budget and no
+    acknowledged batch is lost across the kill (golden equality covers it)."""
+    spec = ServingChaosSpec(recovery_budget_ms=30000)
+    result = _run(0, spec=spec)
+    assert result.preemptions >= 1, "seed 0 must exercise the preemption path"
+    assert len(result.recovery_ms) == result.preemptions
+    assert all(0.0 < ms < spec.recovery_budget_ms for ms in result.recovery_ms)
+
+
+def test_serving_chaos_under_locksan():
+    """The full serving loop (client threads, ingest worker, snapshot
+    journal, controller, event bus) satisfies the statically-declared lock
+    discipline live, under a fault-heavy schedule."""
+    from torchmetrics_tpu._analysis import locksan
+
+    locksan.set_locksan_enabled(True)
+    locksan.reset()
+    try:
+        _run(2)
+        assert locksan.violations() == [], locksan.violations()
+    finally:
+        locksan.set_locksan_enabled(False)
+
+
+def test_serving_chaos_faults_produce_flight_dumps(tmp_path):
+    """Every injected fault (preemption kill, collective failure) freezes
+    exactly one ``chaos_fault`` post-mortem with the right seam; dumps are
+    deduplicated per bus event (unique seqs, one per fault)."""
+    from torchmetrics_tpu._observability import (
+        BUS,
+        REGISTRY,
+        arm_flight_recorder,
+        disarm_flight_recorder,
+        set_telemetry_enabled,
+    )
+
+    set_telemetry_enabled(True)
+    BUS.clear()
+    recorder = arm_flight_recorder(directory=str(tmp_path), keep=256)
+    try:
+        result = _run(0)
+        assert result.fault_events >= 1
+        dumps = [d for d in recorder.dumps() if d["trigger"]["kind"] == "chaos_fault"]
+        assert len(dumps) == result.fault_events, (len(dumps), result.fault_events)
+        seqs = [d["trigger"]["seq"] for d in dumps]
+        assert len(seqs) == len(set(seqs)), "one dump per fault event"
+        seams = {d["seam"] for d in dumps}
+        assert seams <= {"snapshot.restore", "guard.sync"}, seams
+        if result.preemptions:
+            assert "snapshot.restore" in seams
+    finally:
+        disarm_flight_recorder()
+        set_telemetry_enabled(False)
+        REGISTRY.reset()
+        BUS.clear()
+
+
+# ---------------------------------------------------------------------------
+# multi-seed soak (slow): distinct schedules, per-seed wall-clock budget
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("seed", range(500, 508))
+def test_serving_chaos_soak(seed):
+    # the per-seed wall-clock budget is itself an invariant (deadlock net)
+    result = _run(seed, spec=ServingChaosSpec(wallclock_budget_s=60))
+    assert result.elapsed_s < 60
+
+
+@pytest.mark.slow
+def test_serving_chaos_soak_heavy_schedule():
+    """Longer schedule, more tenants, tighter queue — the soak variant that
+    actually exercises backpressure mid-fault."""
+    spec = ServingChaosSpec(
+        n_steps=32, n_streams=8, batch_size=8, p_nan=0.3, p_preempt=0.25, queue_capacity=16
+    )
+    result = _run(510, spec=spec)
+    assert result.acked >= spec.n_streams * (spec.n_steps - result.quarantined) / 2
+
+
+def test_serving_chaos_soak_runner_aggregates():
+    """The soak entry point runs every seed and reports per-seed results."""
+    results = run_serving_chaos_soak([0, 1])
+    assert len(results) == 2
+    assert all(r.ok for r in results), [r.describe() for r in results]

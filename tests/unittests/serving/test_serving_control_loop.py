@@ -1,0 +1,208 @@
+"""ISSUE-19 acceptance: the SLO control loop closes without an operator.
+
+Three behaviours, each demonstrated end to end against a live server:
+
+1. **Burn -> shrink/shed -> recovery.** An injected latency fault pushes the
+   ingest burn past FAST_BURN; the controller shrinks the batch target and
+   sheds at the ingress edge; when the fault ends, canary admissions refresh
+   the burn signal and the loop re-admits on its own — no operator input
+   between fault injection and the burn falling back under 1.0.
+2. **Headroom -> grow.** A standing backlog with latency headroom grows the
+   micro-batch target additively; adaptive sizing beats a fixed
+   minimum-batch loop on sustained rows/second under per-dispatch overhead.
+3. **Journal.** Every non-hold decision is a ``controller_decision`` bus
+   event (seam ``serving.controller``) and a shed episode freezes exactly
+   one ``load_shed`` flight dump.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._analysis import locksan
+from torchmetrics_tpu._observability import (
+    BUS,
+    REGISTRY,
+    arm_flight_recorder,
+    disarm_flight_recorder,
+    set_telemetry_enabled,
+    set_telemetry_sampling,
+)
+from torchmetrics_tpu._observability.slo import FAST_BURN
+from torchmetrics_tpu._observability.state import DEFAULT_SAMPLE_EVERY
+from torchmetrics_tpu._serving import (
+    BackpressureError,
+    ControllerConfig,
+    MetricServer,
+)
+
+
+@pytest.fixture()
+def serving_env():
+    set_telemetry_enabled(True)
+    set_telemetry_sampling(1)
+    locksan.set_locksan_enabled(True)
+    locksan.reset()
+    yield
+    assert locksan.violations() == [], locksan.violations()
+    locksan.set_locksan_enabled(False)
+    set_telemetry_enabled(False)
+    set_telemetry_sampling(DEFAULT_SAMPLE_EVERY)
+    REGISTRY.reset()
+    BUS.clear()
+
+
+def _row(rng):
+    return (
+        rng.normal(size=(8,)).astype(np.float32),
+        rng.normal(size=(8,)).astype(np.float32),
+    )
+
+
+def _submit_with_retry(srv, sid, rng, deadline):
+    """One client iteration: honor backpressure, return the ack or None."""
+    while time.monotonic() < deadline:
+        try:
+            return srv.submit(sid, *_row(rng))
+        except BackpressureError as err:
+            time.sleep(min(err.retry_after_s, 0.005))
+    return None
+
+
+def test_closed_loop_burn_shed_and_autonomous_recovery(serving_env):
+    """Injected burn -> shrink+shed -> fault ends -> burn < 1.0, re-admit.
+
+    Nothing touches the controller or the queue between fault injection and
+    the final assertion: shedding both starts AND stops purely from the
+    burn-rate signal (canary admissions keep the signal alive mid-shed).
+    """
+    rng = np.random.default_rng(7)
+    # objective 0.95 puts the all-bad burn at 20 > FAST_BURN (14.4), so the
+    # page-now band is reachable; target 5ms makes the 30ms fault "bad"
+    cfg = ControllerConfig(
+        min_batch=1, max_batch=8, interval_s=0.01, target_ms=5.0, objective=0.95
+    )
+    srv = MetricServer(tm.MeanSquaredError(), capacity=4, queue_capacity=32, controller=cfg)
+    sid = srv.attach_stream()
+    srv.warm(*_row(rng))
+    with srv:
+        # ---- phase 1: inject the fault, drive traffic until the loop sheds
+        srv.set_step_delay(0.03)
+        deadline = time.monotonic() + 60.0
+        while not srv.controller.shedding and time.monotonic() < deadline:
+            ack = _submit_with_retry(srv, sid, rng, deadline)
+            if ack is not None:
+                ack.wait(timeout=30.0)
+        assert srv.controller.shedding, "burn never tripped the shed law"
+        actions = [d.action for d in srv.controller.decisions()]
+        assert "shed" in actions
+        shed_decisions = [d for d in srv.controller.decisions() if d.action == "shed"]
+        assert shed_decisions[0].burn > FAST_BURN
+        # multiplicative decrease engaged (target at the floor after shed)
+        assert srv.controller.target == cfg.min_batch
+
+        # ---- phase 2: the fault ends; clients keep retrying — nothing else
+        srv.set_step_delay(0.0)
+        while (
+            srv.controller.shedding or srv.controller.burn_rate() >= 1.0
+        ) and time.monotonic() < deadline:
+            ack = _submit_with_retry(srv, sid, rng, deadline)
+            if ack is not None:
+                ack.wait(timeout=30.0)
+        assert not srv.controller.shedding, "loop never re-admitted"
+        assert srv.controller.burn_rate() < 1.0
+        # the recovery is journaled: decisions + shed transitions on the bus
+        actions = [d.action for d in srv.controller.decisions()]
+        assert actions.index("shed") < len(actions) - 1 - actions[::-1].index("hold")
+        assert BUS.events(kind="controller_decision"), "decisions must hit the bus"
+        assert BUS.events(kind="load_shed") and BUS.events(kind="load_shed_recovered")
+    assert srv.queue.shed_episodes >= 1
+
+
+def test_headroom_grows_target_and_beats_fixed_batching(serving_env):
+    """A backlog with latency headroom grows the target; adaptive sizing
+    sustains more rows/second than a pinned minimum batch under the same
+    per-dispatch overhead (the amortization the grow law exists for)."""
+    rounds, n_streams, overhead_s = 12, 8, 0.005
+
+    def drive(max_batch):
+        rng = np.random.default_rng(11)
+        cfg = ControllerConfig(
+            min_batch=1,
+            max_batch=max_batch,
+            interval_s=0.005,
+            target_ms=2000.0,  # generous: queue wait must not read as burn
+            objective=0.95,
+        )
+        srv = MetricServer(
+            tm.MeanSquaredError(), capacity=n_streams, queue_capacity=256, controller=cfg
+        )
+        sids = [srv.attach_stream() for _ in range(n_streams)]
+        srv.warm(*_row(rng))
+        with srv:
+            srv.set_step_delay(overhead_s)
+            t0 = time.perf_counter()
+            acks = []
+            for _ in range(rounds):
+                for sid in sids:
+                    acks.append(srv.submit(sid, *_row(rng)))
+            for ack in acks:
+                assert ack.result(timeout=60.0) == "acked"
+            elapsed = time.perf_counter() - t0
+            decisions = srv.controller.decisions()
+            target = srv.controller.target
+        qps = len(acks) / elapsed
+        REGISTRY.reset()  # isolate the two runs' burn signals
+        return qps, decisions, target, srv.batches
+
+    adaptive_qps, decisions, target, adaptive_batches = drive(max_batch=8)
+    fixed_qps, _, fixed_target, fixed_batches = drive(max_batch=1)
+
+    assert any(d.action == "grow" for d in decisions), [d.action for d in decisions]
+    assert target > 1, "headroom + backlog must raise the target"
+    assert fixed_target == 1
+    # fewer, fuller dispatches -> per-dispatch overhead amortized
+    assert adaptive_batches < fixed_batches
+    assert adaptive_qps > fixed_qps, (adaptive_qps, fixed_qps)
+
+
+def test_shed_episode_freezes_exactly_one_flight_dump(serving_env, tmp_path):
+    """Load shedding is a flight-recorder trigger: entering an episode dumps
+    once (seam serving.ingress); the recovery transition does not dump."""
+    rng = np.random.default_rng(3)
+    recorder = arm_flight_recorder(directory=str(tmp_path), keep=64)
+    try:
+        cfg = ControllerConfig(
+            min_batch=1, max_batch=4, interval_s=0.01, target_ms=5.0, objective=0.95
+        )
+        srv = MetricServer(tm.MeanSquaredError(), capacity=2, queue_capacity=16, controller=cfg)
+        sid = srv.attach_stream()
+        srv.warm(*_row(rng))
+        with srv:
+            srv.set_step_delay(0.03)
+            deadline = time.monotonic() + 60.0
+            while not srv.controller.shedding and time.monotonic() < deadline:
+                ack = _submit_with_retry(srv, sid, rng, deadline)
+                if ack is not None:
+                    ack.wait(timeout=30.0)
+            assert srv.controller.shedding
+            srv.set_step_delay(0.0)
+            while srv.controller.shedding and time.monotonic() < deadline:
+                ack = _submit_with_retry(srv, sid, rng, deadline)
+                if ack is not None:
+                    ack.wait(timeout=30.0)
+        episodes = srv.queue.shed_episodes
+        assert episodes >= 1
+        dumps = [d for d in recorder.dumps() if d["trigger"]["kind"] == "load_shed"]
+        assert len(dumps) == episodes, "exactly one dump per shed episode"
+        for dump in dumps:
+            assert dump["seam"] == "serving.ingress"
+            assert dump["trigger"]["data"]["phase"] == "enter"
+        seqs = [d["trigger"]["seq"] for d in dumps]
+        assert len(seqs) == len(set(seqs))
+    finally:
+        disarm_flight_recorder()

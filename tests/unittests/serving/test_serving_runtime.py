@@ -1,0 +1,364 @@
+"""Unit + end-to-end tests for the metrics-as-a-service runtime (SERVING.md).
+
+Covers the ingress queue's admission edge (bounded FIFO, retry-after from
+the live drain rate, shed-canary admission), ack semantics, controller
+config validation, and the MetricServer serving loop end to end: warm boot,
+concurrent multi-stream ingest with golden equality against eager replicas,
+serving reads + Prometheus scrapes while ingesting, backpressure, and
+fault isolation (one bad batch never kills the worker) — all with the lock
+sanitizer armed.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._analysis import locksan
+from torchmetrics_tpu._observability import (
+    BUS,
+    REGISTRY,
+    set_telemetry_enabled,
+    set_telemetry_sampling,
+)
+from torchmetrics_tpu._observability.state import DEFAULT_SAMPLE_EVERY
+from torchmetrics_tpu._serving import (
+    Ack,
+    BackpressureError,
+    BatchController,
+    ControllerConfig,
+    IngressQueue,
+    MetricServer,
+    ServerClosedError,
+    UpdateRequest,
+)
+
+
+@pytest.fixture()
+def serving_env():
+    """Telemetry + locksan armed for every serving test; clean teardown."""
+    set_telemetry_enabled(True)
+    set_telemetry_sampling(1)
+    locksan.set_locksan_enabled(True)
+    locksan.reset()
+    yield
+    assert locksan.violations() == [], locksan.violations()
+    locksan.set_locksan_enabled(False)
+    set_telemetry_enabled(False)
+    set_telemetry_sampling(DEFAULT_SAMPLE_EVERY)
+    REGISTRY.reset()
+    BUS.clear()
+
+
+def _req(sid=0):
+    return UpdateRequest(sid, (np.zeros(4, dtype=np.float32),), {})
+
+
+# ------------------------------------------------------------- IngressQueue
+class TestIngressQueue:
+    def test_fifo_order_and_depth(self, serving_env):
+        q = IngressQueue(capacity=8)
+        reqs = [_req(i) for i in range(3)]
+        for r in reqs:
+            q.put(r)
+        assert q.depth == 3
+        assert [q.get(timeout=0.1) for _ in range(3)] == reqs
+        assert q.depth == 0
+        assert q.get(timeout=0.01) is None
+
+    def test_full_queue_rejects_synchronously_with_retry_hint(self, serving_env):
+        q = IngressQueue(capacity=2)
+        q.put(_req(0))
+        q.put(_req(1))
+        with pytest.raises(BackpressureError) as exc:
+            q.put(_req(2))
+        assert exc.value.kind == "full"
+        assert exc.value.retry_after_s > 0.0
+        assert q.depth == 2  # the rejected request never occupied a slot
+
+    def test_retry_after_tracks_live_drain_rate(self, serving_env):
+        q = IngressQueue(capacity=4)
+        for i in range(4):
+            q.put(_req(i))
+        cold = q.retry_after()  # no drain evidence: pessimistic clamp
+        q.note_drained(rows=100, elapsed_s=0.1)  # 1000 rows/s
+        warm = q.retry_after()
+        assert warm < cold
+        assert abs(warm - 4 / 1000.0) < 0.05  # depth / EWMA rate
+
+    def test_shedding_rejects_but_admits_one_canary(self, serving_env):
+        q = IngressQueue(capacity=8)
+        BUS.clear()
+        assert q.set_shedding(True)
+        # empty queue: the canary probe is admitted (recovery needs samples)
+        q.put(_req(0))
+        assert q.depth == 1
+        # with a probe in flight, further arrivals shed
+        with pytest.raises(BackpressureError) as exc:
+            q.put(_req(1))
+        assert exc.value.kind == "shed"
+
+    def test_shed_transitions_publish_once_each(self, serving_env):
+        q = IngressQueue(capacity=8)
+        BUS.clear()
+        assert q.set_shedding(True)
+        assert not q.set_shedding(True)  # no re-publish while already shedding
+        for i in range(3):
+            with pytest.raises(BackpressureError):
+                q.put(_req(0))
+                q.put(_req(1))
+        assert q.set_shedding(False)
+        assert not q.set_shedding(False)
+        entered = BUS.events(kind="load_shed")
+        exited = BUS.events(kind="load_shed_recovered")
+        assert len(entered) == 1 and len(exited) == 1
+        assert entered[0].data["seam"] == "serving.ingress"
+        assert entered[0].data["episode"] == 1
+        assert q.shed_episodes == 1
+
+    def test_requeue_bypasses_admission(self, serving_env):
+        q = IngressQueue(capacity=1)
+        r = _req(0)
+        q.put(r)
+        q.set_shedding(True)
+        q.requeue(_req(1))  # already-accepted request: never rejected
+        assert q.depth == 2
+
+    def test_wake_unblocks_get(self, serving_env):
+        q = IngressQueue(capacity=2)
+        got = []
+        t = threading.Thread(target=lambda: got.append(q.get(timeout=5.0)))
+        t.start()
+        q.wake()
+        t.join(timeout=5.0)
+        assert not t.is_alive()
+        assert got == [None]
+
+    def test_capacity_validation(self, serving_env):
+        with pytest.raises(ValueError, match="capacity"):
+            IngressQueue(capacity=0)
+
+
+# --------------------------------------------------------------------- Ack
+class TestAck:
+    def test_resolution_publishes_fields(self, serving_env):
+        ack = Ack()
+        assert ack.state == "pending" and not ack.wait(timeout=0.01)
+        ack._resolve("acked", latency_s=0.25, quarantined=True)
+        assert ack.wait(timeout=1.0)
+        assert ack.result() == "acked"
+        assert ack.acked and ack.quarantined and ack.latency_s == 0.25
+
+    def test_failed_result_reraises_worker_error(self, serving_env):
+        ack = Ack()
+        ack._resolve("failed", error=ValueError("boom"))
+        with pytest.raises(ValueError, match="boom"):
+            ack.result()
+
+    def test_timeout_raises(self, serving_env):
+        with pytest.raises(TimeoutError):
+            Ack().result(timeout=0.01)
+
+
+# ------------------------------------------------------------- config law
+class TestControllerConfig:
+    def test_validation(self, serving_env):
+        with pytest.raises(ValueError, match="min_batch"):
+            ControllerConfig(min_batch=0)
+        with pytest.raises(ValueError, match="min_batch"):
+            ControllerConfig(min_batch=8, max_batch=4)
+        with pytest.raises(ValueError, match="shrink_factor"):
+            ControllerConfig(shrink_factor=1.0)
+        with pytest.raises(ValueError, match="grow_step"):
+            ControllerConfig(grow_step=0)
+
+    def test_interval_gate(self, serving_env):
+        ctl = BatchController(ControllerConfig(interval_s=60.0))
+        assert ctl.maybe_decide(queue_depth=0) is not None
+        assert ctl.maybe_decide(queue_depth=0) is None  # within the interval
+
+
+# ------------------------------------------------------------ MetricServer
+class TestMetricServer:
+    def _server(self, **kw):
+        kw.setdefault("capacity", 8)
+        kw.setdefault("queue_capacity", 64)
+        kw.setdefault(
+            "controller", ControllerConfig(max_batch=8, interval_s=0.01)
+        )
+        return MetricServer(tm.MeanSquaredError(nan_policy="quarantine"), **kw)
+
+    def test_end_to_end_golden_equality(self, serving_env):
+        """Concurrent multi-stream ingest computes exactly what per-stream
+        eager replicas compute, while scrapes and reads run mid-ingest."""
+        rng = np.random.default_rng(0)
+        srv = self._server()
+        sids = [srv.attach_stream() for _ in range(4)]
+        outcomes = srv.warm(
+            rng.normal(size=(16,)).astype(np.float32),
+            rng.normal(size=(16,)).astype(np.float32),
+        )
+        # every bucket in the ladder resolved before the first request
+        for bucket in (1, 2, 4, 8):
+            assert outcomes[f"{bucket}:stream_step"] in ("hit", "compiled")
+        with srv:
+            golden = {sid: [] for sid in sids}
+            acks = []
+            for _ in range(10):
+                for sid in sids:
+                    p = rng.normal(size=(16,)).astype(np.float32)
+                    t = rng.normal(size=(16,)).astype(np.float32)
+                    golden[sid].append((p, t))
+                    acks.append(srv.submit(sid, p, t))
+            scrape_mid = srv.scrape()  # serving WHILE ingesting
+            for ack in acks:
+                assert ack.result(timeout=30.0) == "acked"
+            assert all(ack.latency_s is not None for ack in acks)
+            for sid in sids:
+                eager = tm.MeanSquaredError()
+                for p, t in golden[sid]:
+                    eager.update(p, t)
+                assert float(srv.compute(sid)) == pytest.approx(
+                    float(eager.compute()), rel=1e-5
+                )
+            assert set(srv.compute_all()) == set(sids)
+            final = srv.scrape()
+        assert "tmtpu_serving_batches_total" in final
+        assert "tmtpu_serving_batch_rows_total" in final
+        assert isinstance(scrape_mid, str)
+        assert srv.rows_applied >= 40  # 40 client rows (+ the start() warm probe)
+        assert srv.health() is not None
+
+    def test_one_bad_batch_does_not_kill_the_worker(self, serving_env):
+        srv = self._server()
+        sid = srv.attach_stream()
+        with srv:
+            # stream id 99 was never attached: the pool step raises, the
+            # ack fails with that error, and the worker keeps serving
+            bad = srv.submit(99, np.ones(4, dtype=np.float32), np.ones(4, dtype=np.float32))
+            with pytest.raises(Exception):
+                bad.result(timeout=30.0)
+            good = srv.submit(sid, np.ones(4, dtype=np.float32), np.ones(4, dtype=np.float32))
+            assert good.result(timeout=30.0) == "acked"
+            assert float(srv.compute(sid)) == pytest.approx(0.0)
+
+    def test_submit_rejected_when_not_running(self, serving_env):
+        srv = self._server()
+        with pytest.raises(ServerClosedError):
+            srv.submit(0, np.ones(4, dtype=np.float32))
+        srv.close()
+        with pytest.raises(ServerClosedError):
+            srv.compute(0)
+
+    def test_backpressure_full_queue_end_to_end(self, serving_env):
+        """A slow device + tiny queue rejects synchronously with an honest
+        retry hint; honoring it eventually lands every row (no losses)."""
+        srv = self._server(queue_capacity=4)
+        sid = srv.attach_stream()
+        srv.warm(np.ones(4, dtype=np.float32), np.ones(4, dtype=np.float32))
+        with srv:
+            srv.set_step_delay(0.05)  # ~20 rows/s drain ceiling
+            acked, rejections = [], 0
+            deadline = time.monotonic() + 60.0
+            while len(acked) < 12 and time.monotonic() < deadline:
+                try:
+                    acked.append(srv.submit(sid, np.ones(4, dtype=np.float32), np.ones(4, dtype=np.float32)))
+                except BackpressureError as err:
+                    rejections += 1
+                    assert err.kind in ("full", "shed")
+                    assert 0.0 < err.retry_after_s <= 5.0
+                    time.sleep(min(err.retry_after_s, 0.2))
+            srv.set_step_delay(0.0)
+            assert len(acked) == 12
+            assert rejections > 0, "queue of 4 at 20 rows/s must push back"
+            for ack in acked:
+                assert ack.result(timeout=30.0) == "acked"
+        assert srv.rows_applied >= 12
+
+    def test_quarantine_flag_rides_the_ack(self, serving_env):
+        srv = self._server()
+        sid = srv.attach_stream()
+        with srv:
+            poisoned = np.ones(4, dtype=np.float32)
+            poisoned[0] = np.nan
+            bad = srv.submit(sid, poisoned, np.ones(4, dtype=np.float32))
+            assert bad.result(timeout=30.0) == "acked"
+            assert bad.quarantined
+            good = srv.submit(sid, np.ones(4, dtype=np.float32), np.ones(4, dtype=np.float32))
+            assert good.result(timeout=30.0) == "acked"
+            assert not good.quarantined
+            # the quarantined row never contaminated the accumulator
+            assert float(srv.compute(sid)) == pytest.approx(0.0)
+
+    def test_stop_drains_accepted_requests(self, serving_env):
+        srv = self._server()
+        sid = srv.attach_stream()
+        srv.start()
+        acks = [
+            srv.submit(sid, np.full(4, i, dtype=np.float32), np.zeros(4, dtype=np.float32))
+            for i in range(6)
+        ]
+        srv.stop(drain=True)
+        assert all(a.acked for a in acks), [a.state for a in acks]
+        srv.close()
+
+
+_WARM_BOOT_CHILD = r"""
+import json, time
+import numpy as np
+import torchmetrics_tpu as tm
+from torchmetrics_tpu._serving import ControllerConfig, MetricServer
+
+rng = np.random.default_rng(0)
+srv = MetricServer(
+    tm.MeanSquaredError(), capacity=4,
+    controller=ControllerConfig(max_batch=8, interval_s=0.05),
+)
+sid = srv.attach_stream()
+ex = rng.normal(size=(256,)).astype(np.float32)
+srv.warm(ex, ex)
+srv.start()
+
+def one():
+    p = rng.normal(size=(256,)).astype(np.float32)
+    t = rng.normal(size=(256,)).astype(np.float32)
+    ack = srv.submit(sid, p, t)
+    assert ack.result(timeout=60) == "acked"
+    return ack.latency_s * 1000.0
+
+first_ms = one()
+steady = sorted(one() for _ in range(200))
+srv.close()
+p99 = steady[min(len(steady) - 1, int(round(0.99 * (len(steady) - 1))))]
+print(json.dumps({"first_ms": first_ms, "steady_p99_ms": p99}))
+"""
+
+
+@pytest.mark.slow
+def test_warm_boot_first_request_within_budget():
+    """ISSUE-19 acceptance (subprocess methodology): in a FRESH process,
+    warm() + the start() worker probe make the very first client request
+    cost no more than 1.2x the steady-state p99 — no cold-start cliff."""
+    import json
+    import os
+    import subprocess
+    import sys
+
+    ratios = []
+    for _ in range(3):
+        res = subprocess.run(
+            [sys.executable, "-c", _WARM_BOOT_CHILD],
+            capture_output=True,
+            text=True,
+            env=dict(os.environ),
+            timeout=600,
+        )
+        assert res.returncode == 0, res.stderr[-2000:]
+        rec = json.loads(res.stdout.strip().splitlines()[-1])
+        ratios.append(rec["first_ms"] / max(rec["steady_p99_ms"], 1e-9))
+    ratios.sort()
+    assert ratios[len(ratios) // 2] <= 1.2, ratios

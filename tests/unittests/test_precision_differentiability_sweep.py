@@ -212,6 +212,15 @@ HEAVY_SWEEP_KERNELS = frozenset({
     "PeakSignalNoiseRatioWithBlockedEffect",
     "PermutationInvariantTraining",
     "SpatialCorrelationCoefficient",
+    # round-19 budget reclaim: heavy windowed-image/clustering/cat-state tails;
+    # the full sweeps (and the mesh canary) still run under `-m slow`
+    "SpatialDistortionIndex",
+    "SpectralDistortionIndex",
+    "DaviesBouldinScore",
+    "CalinskiHarabaszScore",
+    "ConcordanceCorrCoef",
+    "MulticlassAUROC",
+    "MultilabelAUROC",
 })
 
 

@@ -368,3 +368,34 @@ def test_manifest_is_nontrivial_and_scoped():
         "torchmetrics_tpu.metric.CompositionalMetric",
     ):
         assert uncertifiable not in manifest, f"{uncertifiable} has R1 findings and must not be certified"
+
+
+def test_serving_modules_scan_clean():
+    """ISSUE-19 acceptance: the metrics-as-a-service runtime is clean under
+    the FULL R1-R11 rule set with ZERO baseline additions — no entry in the
+    checked-in baseline may reference it, and a fresh scan must find nothing
+    new (one pool lock serializes device access, the ingress FIFO is a
+    type-exempt queue.Queue, and every shared container/counter carries a
+    guarded verdict in the manifest)."""
+    result, _ = _scan()
+    findings = [v for v in result.violations if v.path.startswith("torchmetrics_tpu/_serving/")]
+    assert not findings, [v.render() for v in findings]
+    baseline = load_baseline(BASELINE)
+    leaked = [e for e in baseline.values() if e.path.startswith("torchmetrics_tpu/_serving/")]
+    assert not leaked, f"baseline entries must never cover the ISSUE-19 modules: {leaked}"
+    # guard-map manifest: the runtime-scoped concurrency pass covers the
+    # package, and the hot shared state all carries guarded verdicts
+    modules = json.loads(THREAD_SAFETY_PATH.read_text(encoding="utf-8"))["modules"]
+    server_mod = modules["torchmetrics_tpu/_serving/runtime.py"]
+    assert server_mod["verdict"] == "guarded", server_mod["verdict"]
+    fields = server_mod["classes"]["MetricServer"]["fields"]
+    for field in ("_warm_outcomes", "batches", "rows_applied", "recoveries"):
+        assert fields[field]["guards"] == ["_pool_lock"], (field, fields[field])
+    queue_mod = modules["torchmetrics_tpu/_serving/queue.py"]
+    assert queue_mod["verdict"] == "guarded", queue_mod["verdict"]
+    ctl_mod = modules["torchmetrics_tpu/_serving/controller.py"]
+    assert ctl_mod["classes"]["BatchController"]["fields"]["_decisions"]["guards"] == ["_lock"]
+    # the ingest worker is non-daemon and joined (R9-visible shutdown)
+    threads = [t for t in server_mod["threads"] if t["scope"] == "MetricServer.start"]
+    assert threads, server_mod
+    assert threads[0]["daemon"] is False and threads[0]["joined"] is True, threads

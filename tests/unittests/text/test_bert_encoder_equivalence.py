@@ -136,6 +136,8 @@ def test_bert_score_dict_updates_pad_to_max_length(converted):
     np.testing.assert_allclose(np.asarray(out["f1"]), 1.0, atol=1e-5)
 
 
+@pytest.mark.slow  # ctor-wiring convenience check; the converted-encoder
+# equivalence + BERTScore/InfoLM numeric tests above cover the path in tier-1
 def test_modular_weights_path_wiring(converted):
     """BERTScore(weights_path=...) and InfoLM(weights_path=...) construct the
     converted encoders without a model callable."""

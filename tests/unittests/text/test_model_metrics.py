@@ -203,6 +203,8 @@ class TestInfoLM:
         score = infolm(preds, preds, information_measure="l2_distance", idf=False)
         assert float(score) == pytest.approx(0.0, abs=1e-6)
 
+    @pytest.mark.slow  # property sweep over measures; the oracle/accumulation
+    # tests above keep InfoLM numerics in tier-1
     def test_symmetric_measures_nonnegative(self):
         preds = ["he read the book because he was interested in world history"]
         target = ["he was interested in world history because he read the book"]

@@ -67,6 +67,7 @@ _RUNTIME_PREFIXES = (
     "torchmetrics_tpu/_aot/",
     "torchmetrics_tpu/_observability/",
     "torchmetrics_tpu/_resilience/",
+    "torchmetrics_tpu/_serving/",
     "torchmetrics_tpu/_streams/",
     "torchmetrics_tpu/_spmd/",
 )

@@ -91,6 +91,15 @@ _HELP: Dict[str, str] = {
     "pool_detach": "StreamPool detach() calls.",
     "pool_growths": "StreamPool capacity-doubling growth events.",
     "pool_computes": "StreamPool compute dispatches by kind (cache misses only).",
+    "serving_requests": "MetricServer requests by outcome (accepted/rejected/shed/served/failed).",
+    "serving_batches": "Micro-batches dispatched by the ingest worker.",
+    "serving_batch_rows": "Live rows dispatched across all micro-batches (excludes bucket padding).",
+    "serving_controller_decisions": "SLO control-loop decisions by action (grow/shrink/shed/hold).",
+    "serving_shed_episodes": "Load-shedding episodes entered at the ingress edge.",
+    "serving_recoveries": "Preemption kill/restore cycles completed by the serving runtime.",
+    "serving_batch_target": "Current micro-batch size target chosen by the SLO control loop.",
+    "serving_ingest_burn": "Latest ingest-latency SLO burn rate seen by the control loop.",
+    "serving_queue_depth": "Current bounded ingress-queue depth.",
     "pool_cost_device_seconds": (
         "Per-tenant apportioned micro-batch device seconds (equal share per applied row;"
         " bounded stream= label dimension)."
@@ -181,6 +190,15 @@ EXPORT_SCHEMA: Dict[str, Dict[str, Any]] = {
     "profile_mfu": {"kind": "gauge", "labels": ("seam", "class")},
     "profile_roofline_ceiling": {"kind": "gauge", "labels": ("seam", "class")},
     "profile_compile_seconds": {"kind": "counter", "labels": ("digest", "kind", "class")},
+    "serving_requests": {"kind": "counter", "labels": ("metric", "outcome")},
+    "serving_batches": {"kind": "counter", "labels": ("metric",)},
+    "serving_batch_rows": {"kind": "counter", "labels": ("metric",)},
+    "serving_controller_decisions": {"kind": "counter", "labels": ("metric", "action")},
+    "serving_shed_episodes": {"kind": "counter", "labels": ("metric",)},
+    "serving_recoveries": {"kind": "counter", "labels": ("metric",)},
+    "serving_batch_target": {"kind": "gauge", "labels": ("metric",)},
+    "serving_ingest_burn": {"kind": "gauge", "labels": ("metric",)},
+    "serving_queue_depth": {"kind": "gauge", "labels": ("metric",)},
 }
 
 # reservoir quantiles exported as summary lines (satellite: p50/p90/p99 per op)

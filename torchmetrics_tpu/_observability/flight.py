@@ -72,9 +72,19 @@ DEFAULT_MAX_FILES = 64  # dumps retained on disk (oldest evicted first)
 
 # event kinds that freeze a dump. `snapshot_restore` is conditional: only
 # failed outcomes are faults (`fallback` restores additionally publish a
-# degradation event, which IS a trigger — one dump, not two).
+# degradation event, which IS a trigger — one dump, not two). `load_shed`
+# fires on shed-episode TRANSITIONS only (the ingress queue rate-limits the
+# publishes), so a shedding server freezes one dump per episode with the
+# controller's recent decisions in the event window, not one per rejection.
 _TRIGGER_KINDS = frozenset(
-    {"degradation", "recompile_churn", "chaos_fault", "snapshot_restore", "perf_regression"}
+    {
+        "degradation",
+        "recompile_churn",
+        "chaos_fault",
+        "snapshot_restore",
+        "perf_regression",
+        "load_shed",
+    }
 )
 
 # kind (and, for degradations, DegradationEvent kind) -> failing seam.
@@ -83,6 +93,7 @@ _SEAM_FOR_KIND = {
     "recompile_churn": "compile",
     "snapshot_restore": "snapshot.restore",
     "perf_regression": "metric.update",
+    "load_shed": "serving.ingress",
 }
 _SEAM_FOR_DEGRADATION = {
     "nan_quarantine": "metric.update",

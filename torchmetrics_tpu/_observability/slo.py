@@ -328,6 +328,10 @@ class SloTracker:  # concurrency: shared probe threads evaluate while ingestion 
 DEFAULT_SLOS: List[SLO] = [
     SLO(name="ingest_p99", op="stream_step", threshold_ms=50.0, objective=0.99),
     SLO(name="update_p99", op="update_compiled", threshold_ms=50.0, objective=0.99),
+    # the serving runtime's two request-facing ops: enqueue-to-ack for
+    # updates, dispatch-to-value for reads (MetricServer observes both)
+    SLO(name="serve_ingest_p99", op="ingest", threshold_ms=250.0, objective=0.99),
+    SLO(name="serve_compute_p99", op="serve_compute", threshold_ms=250.0, objective=0.99),
     SLO(
         name="quarantine_budget",
         bad=("quarantined_batches",),

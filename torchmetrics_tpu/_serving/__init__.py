@@ -1,0 +1,48 @@
+"""Metrics-as-a-service ingestion runtime (SERVING.md).
+
+The long-running serving layer over the multi-tenant
+:class:`~torchmetrics_tpu._streams.StreamPool`: a bounded ingress queue
+with backpressure, a single ingest worker that micro-batches concurrent
+update requests into one vmapped pool step, compute/scrape serving while
+ingesting, an SLO-closed control loop over micro-batch sizing and load
+shedding, AOT warm boot, and the chaos-under-load harness that proves the
+whole thing recovers.
+"""
+
+from torchmetrics_tpu._serving.controller import (
+    BatchController,
+    ControllerConfig,
+    Decision,
+    OK_BURN,
+)
+from torchmetrics_tpu._serving.chaos import (
+    ServingChaosResult,
+    ServingChaosSpec,
+    run_serving_chaos,
+    run_serving_chaos_soak,
+)
+from torchmetrics_tpu._serving.queue import IngressQueue
+from torchmetrics_tpu._serving.requests import (
+    Ack,
+    BackpressureError,
+    ServerClosedError,
+    UpdateRequest,
+)
+from torchmetrics_tpu._serving.runtime import MetricServer
+
+__all__ = [
+    "Ack",
+    "BackpressureError",
+    "BatchController",
+    "ControllerConfig",
+    "Decision",
+    "IngressQueue",
+    "MetricServer",
+    "OK_BURN",
+    "ServerClosedError",
+    "ServingChaosResult",
+    "ServingChaosSpec",
+    "UpdateRequest",
+    "run_serving_chaos",
+    "run_serving_chaos_soak",
+]
