@@ -2630,6 +2630,93 @@ def _bench_serving_warm_boot():
     return sorted(ratios)[mid], sorted(firsts)[mid], sorted(steadies)[mid]
 
 
+# --------------------------------------------------------------------------
+# Hierarchical fleet aggregation tier (FLEET.md / ISSUE-20)
+# --------------------------------------------------------------------------
+
+FLEET_BRANCHING = (8, 8)  # canonical 3-level shape: global -> 8 regions -> 64 edges
+FLEET_EPOCHS = 12  # timed fenced epochs per run (one extra warmup epoch)
+FLEET_STRAGGLER_FRAC = 0.10  # fraction of leaf publishes stalled past the deadline
+
+
+def _bench_fleet_rollup():
+    """Full-tree fenced-epoch throughput + degraded-mode staleness.
+
+    Clean run: every edge publishes one row per epoch, full fan-in at every
+    level; the timed unit is one complete edge -> region -> global fenced
+    epoch (64 publishes + 9 rollups). Degraded run: ~10% of leaf publishes
+    per epoch stall to 4x the fan-in deadline, so regions degrade to partial
+    rollups on time and fold the stragglers next epoch — the reported
+    staleness is the p50 contribution age across exactly those late folds
+    (the price of degrade-don't-await, bounded by stall + one epoch).
+    """
+    import numpy as np
+
+    from torchmetrics_tpu.aggregation import MeanMetric
+    from torchmetrics_tpu._fleet import FleetTree, InProcessKV
+    from torchmetrics_tpu._resilience.policy import RetryPolicy
+
+    retry = RetryPolicy(max_retries=2, backoff_base=0.01, backoff_max=0.05)
+    rng = np.random.default_rng(42)
+
+    def one_epoch(tree, epoch):
+        for leaf in tree.leaves:
+            leaf.update(float(rng.uniform()))
+        t0 = time.perf_counter()
+        rollup = tree.run_epoch(epoch)
+        return rollup, time.perf_counter() - t0
+
+    # clean run: generous deadline, fan-in always completes
+    tree = FleetTree.build(
+        MeanMetric(), FLEET_BRANCHING, deadline_s=10.0, retry=retry, namespace="bench"
+    )
+    one_epoch(tree, 0)  # warmup: thread pools, first-touch allocations
+    epoch_times = []
+    for e in range(1, FLEET_EPOCHS + 1):
+        rollup, dt = one_epoch(tree, e)
+        if rollup.partial:
+            raise RuntimeError(f"clean fleet epoch {e} degraded: {rollup.describe()}")
+        epoch_times.append(dt)
+    tree.join_pending(timeout=30.0)
+    p50_s = sorted(epoch_times)[len(epoch_times) // 2]
+
+    # degraded run: arm a stall on ~10% of the epoch's publishes, roll up at
+    # the (short) deadline, measure staleness of the late folds
+    kv = InProcessKV()
+    deadline_s = 0.08
+    tree_deg = FleetTree.build(
+        MeanMetric(), FLEET_BRANCHING, kv=kv, deadline_s=deadline_s, retry=retry,
+        namespace="benchdeg",
+    )
+    n_straggle = max(1, int(round(FLEET_STRAGGLER_FRAC * len(tree_deg.leaves))))
+    partial_epochs = 0
+    late_staleness_ms = []
+    for e in range(FLEET_EPOCHS):
+        for leaf in tree_deg.leaves:
+            leaf.update(float(rng.uniform()))
+        kv.stall_publishes(n_straggle, 4.0 * deadline_s)
+        tree_deg.run_epoch(e)
+        regions = [n.last_rollup for n in tree_deg.levels[1] if n.last_rollup is not None]
+        if any(r.partial for r in regions):
+            partial_epochs += 1
+        late_staleness_ms.extend(
+            r.staleness_ms for r in regions if r.late_arrivals > 0
+        )
+    tree_deg.join_pending(timeout=60.0)
+    if not late_staleness_ms:
+        raise RuntimeError("degraded fleet run produced no late folds to measure")
+    stale_p50 = sorted(late_staleness_ms)[len(late_staleness_ms) // 2]
+    return {
+        "rollups_per_sec": 1.0 / p50_s,
+        "epoch_p50_ms": p50_s * 1000.0,
+        "leaves": len(tree.leaves),
+        "degraded_staleness_p50_ms": stale_p50,
+        "partial_epochs": partial_epochs,
+        "late_folds": len(late_staleness_ms),
+        "stragglers_per_epoch": n_straggle,
+    }
+
+
 def _emit_summary() -> None:
     if not _RESULTS:
         return
@@ -3304,6 +3391,36 @@ def main() -> None:
             )
         )
 
+    def sec_fleet() -> None:
+        fleet = _bench_fleet_rollup()
+        _emit((
+                {
+                    "metric": "fleet_rollup_per_sec",
+                    "value": round(fleet["rollups_per_sec"], 1),
+                    "unit": (
+                        f"full-tree fenced epochs/sec (3-level global -> 8 regions ->"
+                        f" {fleet['leaves']} edges over the in-process KV: 64 async edge"
+                        f" publishes + 8 region rollups + 1 global rollup per epoch, full"
+                        f" fan-in, exactly-once fold; p50 {fleet['epoch_p50_ms']:.1f} ms/epoch)"
+                    ),
+                }
+            )
+        )
+        _emit((
+                {
+                    "metric": "fleet_rollup_degraded_staleness_ms",
+                    "value": round(fleet["degraded_staleness_p50_ms"], 1),
+                    "unit": (
+                        f"ms p50 contribution age across late folds ({fleet['stragglers_per_epoch']}"
+                        f"/{fleet['leaves']} leaf publishes per epoch stalled to 4x the 80ms fan-in"
+                        f" deadline; {fleet['partial_epochs']}/{FLEET_EPOCHS} epochs degraded partial"
+                        f" on time and folded {fleet['late_folds']} stragglers next epoch — the"
+                        " bounded price of degrade-don't-await)"
+                    ),
+                }
+            )
+        )
+
     for name, section in (
         ("multiclass_accuracy_updates_per_sec", sec_headline_accuracy),
         ("class_api_updates_per_sec", sec_class_api),
@@ -3327,6 +3444,7 @@ def main() -> None:
         ("aot_disabled_retention", sec_aot_retention),
         ("profiling_disabled_retention", sec_profiling),
         ("serving_sustained_qps", sec_serving),
+        ("fleet_rollup_per_sec", sec_fleet),
     ):
         _run_section(name, section)
 
@@ -3421,6 +3539,8 @@ _README_LABELS = {
     "serving_backpressure_recovery_ms": ("Load-shed recovery (fault end → re-admission)", "{v:,.0f} ms"),
     "serving_pool_admission_10k_streams": ("Serving admission @10k tenants (ceiling held)", "{v:,.0f} streams"),
     "serving_warm_boot_p99_ratio": ("Warm boot: first-request vs steady-state p99", "{v:.2f}x"),
+    "fleet_rollup_per_sec": ("Fleet rollup (3-level, 64 edges, fenced epoch)", "{v:,.1f} epochs/s"),
+    "fleet_rollup_degraded_staleness_ms": ("Fleet degraded-mode staleness (10% stragglers, p50)", "{v:,.0f} ms"),
 }
 
 

@@ -1,0 +1,109 @@
+"""Fleet-scale chaos soak: composed faults against a live 3-level tree.
+
+One :func:`run_fleet_chaos` run composes every failure mode the fleet tier
+claims to survive — node kill, payload corruption, KV publish faults,
+stragglers, zombie replays — and the assertions here pin the receipt's
+invariants: golden equality over the contributing set for every fenced
+epoch, exactly-once folding, bounded staleness, and a flight dump per
+degradation kind.
+"""
+
+import pytest
+
+from torchmetrics_tpu.aggregation import MeanMetric
+from torchmetrics_tpu._analysis import locksan
+from torchmetrics_tpu._fleet import FleetChaosSpec, run_fleet_chaos
+
+
+def _make_update(rng):
+    return (float(rng.uniform()),)
+
+
+SPEC = FleetChaosSpec(
+    epochs=10, branching=(2, 3), rows_per_epoch=2, deadline_s=0.25,
+)
+
+
+@pytest.fixture(scope="module")
+def soak():
+    # one soak, many assertions: the run composes every fault and takes
+    # a few seconds of wall clock — splitting it per-invariant would
+    # re-pay that for each test
+    return run_fleet_chaos(MeanMetric(), _make_update, SPEC)
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+class TestFleetChaos:
+    def test_soak_is_ok(self, soak):
+        assert soak.ok, soak.describe()
+        assert soak.failures == []
+        assert soak.epochs_run == SPEC.epochs + SPEC.drain_epochs
+        assert soak.leaves == 6
+
+    def test_golden_equality_every_fenced_epoch(self, soak):
+        assert soak.golden_checks == SPEC.epochs + SPEC.drain_epochs
+        assert soak.golden_equal
+
+    def test_every_fault_fired_and_was_survived(self, soak):
+        assert soak.partial_rollups >= 3  # kill, publish-fail, straggler epochs
+        assert soak.corrupt_quarantined == 1
+        assert soak.duplicates_dropped >= 1  # recent zombie fenced by the ledger
+        assert soak.transient_recovered == 1  # one fault absorbed by retry
+        assert soak.publish_degraded == 1  # retries exhausted -> delta retained
+        assert soak.late_folds >= 1  # straggler folded next epoch, not lost
+        assert soak.ttl_reaped >= 1  # stale zombie reaped by the janitor
+
+    def test_exactly_once_no_lost_live_sources(self, soak):
+        # every (leaf, epoch) fed to a live leaf is folded exactly once,
+        # minus only the contributions destroyed by injected corruption
+        assert soak.lost_sources  # corruption did destroy something real
+        assert soak.rows_fed > 0
+
+    def test_staleness_stays_within_budget(self, soak):
+        assert 0.0 <= soak.max_staleness_ms <= SPEC.staleness_budget_ms
+        assert soak.within_budget
+
+    def test_each_degradation_kind_dumped_once_per_event(self, soak):
+        assert soak.dumps_match_events, (soak.events_by_kind, soak.dumps_by_kind)
+        for kind in ("fleet_partial", "fleet_corrupt", "fleet_publish_degraded"):
+            assert soak.events_by_kind.get(kind, 0) >= 1, kind
+
+    def test_describe_is_one_line_receipt(self, soak):
+        line = soak.describe()
+        assert line.startswith("fleet-chaos[OK]") and "\n" not in line
+        assert "golden=equal" in line
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_chaos_under_locksan_is_clean():
+    # the whole fleet tier's locking discipline, sanitized under load
+    spec = FleetChaosSpec(branching=(2, 2), rows_per_epoch=1, deadline_s=0.25)
+    locksan.set_locksan_enabled(True)
+    locksan.reset()
+    try:
+        res = run_fleet_chaos(MeanMetric(), _make_update, spec)
+        assert res.ok, res.describe()
+        assert locksan.violations() == []
+    finally:
+        locksan.set_locksan_enabled(False)
+
+
+@pytest.mark.filterwarnings("ignore::UserWarning")
+def test_flight_dumps_land_on_disk(tmp_path):
+    spec = FleetChaosSpec(
+        branching=(2, 2), rows_per_epoch=1, deadline_s=0.25,
+        flight_dir=str(tmp_path),
+    )
+    res = run_fleet_chaos(MeanMetric(), _make_update, spec)
+    assert res.ok, res.describe()
+    dumps = sorted(tmp_path.glob("*.json"))
+    assert len(dumps) >= 1  # degradations persisted for post-mortem
+
+
+def test_spec_validation():
+    with pytest.raises(ValueError):
+        FleetChaosSpec(epochs=0)
+    with pytest.raises(ValueError):
+        FleetChaosSpec(branching=())
+    with pytest.raises(ValueError):
+        FleetChaosSpec(zombie_capture_epoch=9, zombie_epoch=8)

@@ -1,0 +1,236 @@
+"""AggregationNode mechanisms in isolation: fold, fence, quarantine, degrade.
+
+The composed-fault soak lives in ``test_chaos.py``; this file pins each
+failure semantics contract on a single parent/child pair so a chaos
+failure bisects cleanly.
+"""
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu._fleet.node import AggregationNode
+from torchmetrics_tpu._fleet.transport import InProcessKV, contribution_key
+from torchmetrics_tpu._fleet.wire import encode_contribution
+from torchmetrics_tpu._observability.state import OBS, set_telemetry_enabled
+from torchmetrics_tpu._resilience.policy import RetryPolicy
+
+FAST_RETRY = RetryPolicy(max_retries=2, backoff_base=0.005, backoff_max=0.02)
+
+
+def _pair(template=None, deadline_s=0.5, epoch_window=4):
+    template = template if template is not None else MeanMetric()
+    kv = InProcessKV()
+    leaf = AggregationNode("edge-00", template, kv, namespace="t", retry=FAST_RETRY)
+    parent = AggregationNode(
+        "region-00", template, kv, children=("edge-00",), namespace="t",
+        deadline_s=deadline_s, retry=FAST_RETRY, epoch_window=epoch_window,
+    )
+    return kv, leaf, parent
+
+
+class TestFold:
+    def test_leaf_publish_parent_fold_golden(self):
+        kv, leaf, parent = _pair()
+        leaf.update(2.0)
+        leaf.update(4.0)
+        assert leaf.publish(0)
+        r = parent.rollup(0)
+        assert not r.partial and r.contributing == (("edge-00", 0),)
+        assert r.sources == (("edge-00", 0),) and r.rows_folded == 2
+        assert parent.folded_sources == {("edge-00", 0)}
+        assert float(parent.metric.compute()) == pytest.approx(3.0)
+        # folded keys are reaped from the transport
+        assert kv.scan("") == {}
+
+    def test_delta_semantics_each_row_folds_once(self):
+        kv, leaf, parent = _pair(SumMetric())
+        leaf.update(np.float32(1.0))
+        assert leaf.publish(0)
+        parent.rollup(0)
+        leaf.update(np.float32(10.0))
+        assert leaf.publish(1)  # ships ONLY the new delta
+        parent.rollup(1)
+        assert float(parent.metric.compute()) == pytest.approx(11.0)
+
+    def test_zero_count_heartbeat_counts_for_fanin_not_provenance(self):
+        kv, leaf, parent = _pair()
+        assert leaf.publish(0)  # idle edge: no rows this epoch
+        r = parent.rollup(0)
+        assert not r.partial and r.contributing == (("edge-00", 0),)
+        assert r.sources == () and parent.folded_sources == set()
+        assert parent.metric._update_count == 0
+
+    def test_mean_weighting_survives_hierarchy(self):
+        # an idle epoch between data epochs must not skew the weighted mean
+        kv, leaf, parent = _pair()
+        leaf.update(1.0)
+        leaf.update(1.0)
+        leaf.update(1.0)
+        assert leaf.publish(0)
+        parent.rollup(0)
+        assert leaf.publish(1)  # zero-count heartbeat
+        parent.rollup(1)
+        leaf.update(5.0)
+        assert leaf.publish(2)
+        parent.rollup(2)
+        assert float(parent.metric.compute()) == pytest.approx(2.0)  # (1+1+1+5)/4
+
+
+class TestFence:
+    def test_duplicate_redelivery_dropped(self):
+        kv, leaf, parent = _pair()
+        leaf.update(1.0)
+        assert leaf.publish(0)
+        key, blob = next(iter(kv.scan("").items()))
+        parent.rollup(0)
+        kv.set(key, blob)  # at-least-once redelivery of the folded payload
+        r = parent.rollup(1)
+        assert r.duplicates_dropped == 1 and r.contributing == ()
+        assert float(parent.metric.compute()) == pytest.approx(1.0)  # no double fold
+
+    def test_zombie_below_watermark_never_swept(self):
+        kv, leaf, parent = _pair(epoch_window=2)
+        leaf.update(1.0)
+        assert leaf.publish(0)
+        key, blob = next(iter(kv.scan("").items()))
+        parent.rollup(0)
+        for e in range(1, 5):
+            assert leaf.publish(e)
+            parent.rollup(e)
+        kv.set(key, blob)  # zombie from epoch 0, watermark is now 2
+        r = parent.rollup(5)
+        assert r.duplicates_dropped == 0  # below the window: not even scanned
+        assert float(parent.metric.compute()) == pytest.approx(1.0)
+        # the orphan is the TTL janitor's to reap
+        import time
+
+        assert key in kv.sweep_expired(now=time.monotonic() + 10_000.0)
+
+    def test_late_arrival_folds_into_next_epoch(self):
+        kv, leaf, parent = _pair(deadline_s=0.05)
+        r0 = parent.rollup(0)  # leaf has not published: deadline degrades
+        assert r0.partial and r0.missing == ("edge-00",)
+        leaf.update(7.0)
+        assert leaf.publish(0)  # the straggler lands late
+        r1 = parent.rollup(1)
+        assert r1.late_arrivals == 1 and ("edge-00", 0) in r1.contributing
+        assert float(parent.metric.compute()) == pytest.approx(7.0)
+
+    def test_partial_rollup_records_degradation_with_missing_set(self):
+        kv, leaf, parent = _pair(deadline_s=0.05)
+        r = parent.rollup(0)
+        assert r.partial and r.missing == ("edge-00",)
+        events = [e for e in parent.metric.resilience_report().events if e.kind == "fleet_partial"]
+        assert len(events) == 1 and "edge-00" in events[0].detail
+
+
+class TestQuarantine:
+    def test_bit_flipped_payload_quarantined(self):
+        kv, leaf, parent = _pair()
+        leaf.update(3.0)
+        assert leaf.publish(0)
+        key, blob = next(iter(kv.scan("").items()))
+        flipped = bytearray(blob)
+        flipped[-1] ^= 0xFF
+        kv.set(key, bytes(flipped))
+        r = parent.rollup(0)
+        assert r.corrupt_quarantined == 1 and r.partial  # nothing usable folded
+        assert parent.metric._update_count == 0
+        events = [e for e in parent.metric.resilience_report().events if e.kind == "fleet_corrupt"]
+        assert len(events) == 1
+        assert kv.get(key) is None  # quarantined keys are deleted, not retried
+
+    def test_key_payload_fence_mismatch_quarantined(self):
+        kv, leaf, parent = _pair()
+        m = MeanMetric()
+        m.update(1.0)
+        blob, digest = encode_contribution(m, "edge-00", 3, (("edge-00", 3),))
+        # a zombie stamping its old payload under a fresh epoch's key
+        kv.set(contribution_key("t", "edge-00", 0, digest), blob)
+        r = parent.rollup(0)
+        assert r.corrupt_quarantined == 1
+
+    def test_wrong_metric_class_quarantined(self):
+        kv, leaf, parent = _pair()
+        other = SumMetric()
+        other.update(np.float32(5.0))
+        blob, digest = encode_contribution(other, "edge-00", 0, ())
+        kv.set(contribution_key("t", "edge-00", 0, digest), blob)
+        r = parent.rollup(0)
+        assert r.corrupt_quarantined == 1 and parent.metric._update_count == 0
+
+
+class TestPublishGuard:
+    def test_transient_fault_absorbed_by_retry(self):
+        kv, leaf, parent = _pair()
+        leaf.update(1.0)
+        kv.fail_publishes(1)
+        assert leaf.publish(0)  # one fault, retry lands it
+        assert not parent.rollup(0).partial
+        assert leaf.publish_failures == 0
+
+    def test_exhausted_retries_degrade_and_retain_delta(self):
+        kv, leaf, parent = _pair()
+        leaf.update(2.0)
+        kv.fail_publishes(FAST_RETRY.attempts)
+        assert not leaf.publish(0)  # all attempts consumed
+        assert leaf.publish_failures == 1
+        events = [
+            e for e in leaf.metric.resilience_report().events
+            if e.kind == "fleet_publish_degraded"
+        ]
+        assert len(events) == 1 and events[0].attempts == FAST_RETRY.attempts
+        # the delta rides the next epoch's publish — nothing lost
+        leaf.update(4.0)
+        assert leaf.publish(1)
+        r = parent.rollup(1)
+        assert set(r.sources) == {("edge-00", 0), ("edge-00", 1)}
+        assert float(parent.metric.compute()) == pytest.approx(3.0)
+
+    def test_async_publish_threads_are_joinable(self):
+        kv, leaf, parent = _pair()
+        leaf.update(1.0)
+        t = leaf.publish_async(0)
+        leaf.join_pending(timeout=5.0)
+        assert not t.is_alive()
+        assert not parent.rollup(0).partial
+
+
+class TestTelemetry:
+    def test_fleet_counters_and_staleness_gauge(self):
+        was = OBS.enabled
+        set_telemetry_enabled(True)
+        try:
+            kv, leaf, parent = _pair()
+            leaf.region = parent.region = "region-00"
+            leaf.update(1.0)
+            assert leaf.publish(0)
+            parent.rollup(0)
+            counters = dict(parent.metric.telemetry_report().counters)
+            assert counters.get("fleet_rollups|region=region-00|outcome=full") == 1
+            assert counters.get("fleet_contributions|region=region-00") == 1
+            from torchmetrics_tpu._observability.telemetry import telemetry_for
+
+            gauges = dict(telemetry_for(parent.metric).gauges)
+            assert "fleet_rollup_staleness_ms|region=region-00" in gauges
+        finally:
+            set_telemetry_enabled(was)
+
+    def test_rollup_exports_through_schema(self):
+        # rendered exposition must stay inside EXPORT_SCHEMA (fleet families
+        # are declared with their bounded region label)
+        was = OBS.enabled
+        set_telemetry_enabled(True)
+        try:
+            kv, leaf, parent = _pair()
+            leaf.update(1.0)
+            assert leaf.publish(0)
+            parent.rollup(0)
+            from torchmetrics_tpu._observability.telemetry import REGISTRY
+
+            text = REGISTRY.render_prometheus()
+            assert "tmtpu_fleet_rollups_total" in text
+            assert 'region="region-00"' in text
+        finally:
+            set_telemetry_enabled(was)

@@ -1,0 +1,154 @@
+"""Fleet KV transport + wire format: rendezvous, fault seams, integrity."""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import MeanMetric, SumMetric
+from torchmetrics_tpu._fleet.transport import (
+    InjectedKvFault,
+    InProcessKV,
+    contribution_key,
+    contribution_prefix,
+)
+from torchmetrics_tpu._fleet.wire import (
+    CorruptContribution,
+    decode_contribution,
+    encode_contribution,
+)
+
+
+class TestInProcessKV:
+    def test_set_get_scan_delete(self):
+        kv = InProcessKV()
+        kv.set("tm_tpu/fleet/ns/contrib/a/0/d1", b"one")
+        kv.set("tm_tpu/fleet/ns/contrib/a/1/d2", b"two")
+        kv.set("tm_tpu/fleet/ns/contrib/b/0/d3", b"three")
+        assert kv.get("tm_tpu/fleet/ns/contrib/a/0/d1") == b"one"
+        assert kv.get("missing") is None
+        snap = kv.scan("tm_tpu/fleet/ns/contrib/a/")
+        assert sorted(snap.values()) == [b"one", b"two"]
+        kv.delete("tm_tpu/fleet/ns/contrib/a/0/d1")
+        assert kv.get("tm_tpu/fleet/ns/contrib/a/0/d1") is None
+        assert len(kv.keys("tm_tpu/fleet/ns/contrib/*")) == 2
+
+    def test_wait_until_wakes_on_publish(self):
+        kv = InProcessKV()
+
+        def later():
+            time.sleep(0.05)
+            kv.set("k/x", b"v")
+
+        t = threading.Thread(target=later)
+        t.start()
+        try:
+            # wakes well before the 5s deadline: notify, not polling
+            t0 = time.perf_counter()
+            assert kv.wait_until(lambda snap: "k/x" in snap, 5.0)
+            assert time.perf_counter() - t0 < 2.0
+        finally:
+            t.join()
+
+    def test_wait_until_deadline_is_degrade_not_error(self):
+        kv = InProcessKV()
+        t0 = time.perf_counter()
+        assert not kv.wait_until(lambda snap: False, 0.05)
+        assert time.perf_counter() - t0 >= 0.04
+
+    def test_fault_injection_arms_next_n_sets(self):
+        kv = InProcessKV()
+        kv.fail_publishes(2)
+        for _ in range(2):
+            with pytest.raises(InjectedKvFault):
+                kv.set("k", b"v")
+        kv.set("k", b"v")  # third succeeds
+        assert kv.get("k") == b"v"
+        assert kv.faults_injected == 2 and kv.set_calls == 3
+
+    def test_stall_injection_delays_outside_lock(self):
+        kv = InProcessKV()
+        kv.stall_publishes(1, 0.15)
+        done = []
+
+        def stalled():
+            kv.set("slow", b"v")
+            done.append("slow")
+
+        t = threading.Thread(target=stalled)
+        t.start()
+        try:
+            time.sleep(0.03)
+            # a stalled publisher must not serialize everyone else
+            t0 = time.perf_counter()
+            kv.set("fast", b"v")
+            assert time.perf_counter() - t0 < 0.1
+            assert kv.get("fast") == b"v" and kv.get("slow") is None
+        finally:
+            t.join()
+        assert done == ["slow"] and kv.get("slow") == b"v"
+
+    def test_ttl_sweep_reaps_orphans(self):
+        kv = InProcessKV(ttl_s=10.0)
+        kv.set("orphan", b"v")
+        assert kv.sweep_expired() == []  # young key survives
+        reaped = kv.sweep_expired(now=time.monotonic() + 60.0)
+        assert reaped == ["orphan"] and kv.get("orphan") is None
+
+
+class TestKeys:
+    def test_contribution_key_carries_fence_coordinates(self):
+        key = contribution_key("prod", "edge-00-01", 7, "abcd1234")
+        assert key == "tm_tpu/fleet/prod/contrib/edge-00-01/7/abcd1234"
+        assert key.startswith(contribution_prefix("prod", "edge-00-01", 7))
+
+    def test_prefix_does_not_cross_epochs(self):
+        # epoch 1's prefix must not match epoch 10's keys
+        assert not contribution_key("ns", "a", 10, "d").startswith(
+            contribution_prefix("ns", "a", 1)
+        )
+
+
+class TestWire:
+    def _contrib(self, value=3.0):
+        m = MeanMetric()
+        m.update(value)
+        m.update(2 * value)
+        return encode_contribution(m, "edge-00", 4, (("edge-00", 4),))
+
+    def test_round_trip(self):
+        blob, digest = self._contrib()
+        c = decode_contribution(blob)
+        assert (c.node, c.epoch, c.count) == ("edge-00", 4, 2)
+        assert c.metric_class == "MeanMetric"
+        assert c.sources == (("edge-00", 4),)
+        assert c.digest == digest and len(digest) == 16
+        assert c.age_ms >= 0.0
+        # the shipped states carry an integrity block (verified at fold)
+        assert any(k.endswith("#integrity") for k in c.states)
+
+    def test_checksum_rejects_bit_flip_before_unpickle(self):
+        blob, _ = self._contrib()
+        flipped = bytearray(blob)
+        flipped[-1] ^= 0xFF
+        with pytest.raises(CorruptContribution, match="checksum"):
+            decode_contribution(bytes(flipped))
+
+    def test_truncation_and_garbage_rejected(self):
+        blob, _ = self._contrib()
+        with pytest.raises(CorruptContribution):
+            decode_contribution(blob[: len(blob) // 2])
+        with pytest.raises(CorruptContribution):
+            decode_contribution(b"not a contribution at all")
+
+    def test_digest_tracks_state_content(self):
+        _, d1 = self._contrib(3.0)
+        _, d2 = self._contrib(4.0)
+        assert d1 != d2
+
+    def test_class_name_travels(self):
+        m = SumMetric()
+        m.update(np.float32(1.0))
+        blob, _ = encode_contribution(m, "n", 0, ())
+        assert decode_contribution(blob).metric_class == "SumMetric"

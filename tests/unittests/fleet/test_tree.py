@@ -1,0 +1,93 @@
+"""FleetTree: builder topology, epoch driving, hierarchy golden equality."""
+
+import numpy as np
+import pytest
+
+from torchmetrics_tpu.aggregation import MeanMetric
+from torchmetrics_tpu.collections import MetricCollection
+from torchmetrics_tpu.regression import MeanSquaredError
+from torchmetrics_tpu._fleet import FleetTree
+from torchmetrics_tpu._resilience.policy import RetryPolicy
+
+FAST_RETRY = RetryPolicy(max_retries=2, backoff_base=0.005, backoff_max=0.02)
+
+
+class TestBuild:
+    def test_three_level_shape_and_regions(self):
+        tree = FleetTree.build(MeanMetric(), (2, 3), retry=FAST_RETRY)
+        assert [len(level) for level in tree.levels] == [1, 2, 6]
+        assert tree.root.node_id == "global"
+        assert {n.node_id for n in tree.levels[1]} == {"region-00", "region-01"}
+        # every edge carries its level-1 ancestor as its region label
+        for leaf in tree.leaves:
+            assert leaf.region in ("region-00", "region-01")
+            assert leaf.node_id.startswith("edge-")
+        assert tree.nodes["region-00"].children == (
+            "edge-00-00", "edge-00-01", "edge-00-02",
+        )
+
+    def test_four_level_tree_builds(self):
+        tree = FleetTree.build(MeanMetric(), (2, 2, 2), retry=FAST_RETRY)
+        assert [len(level) for level in tree.levels] == [1, 2, 4, 8]
+        assert all(n.node_id.startswith("zone-") for n in tree.levels[2])
+        assert all(n.node_id.startswith("edge-") for n in tree.levels[3])
+
+    def test_invalid_branching_rejected(self):
+        with pytest.raises(ValueError):
+            FleetTree.build(MeanMetric(), ())
+        with pytest.raises(ValueError):
+            FleetTree.build(MeanMetric(), (2, 0))
+
+
+class TestRunEpoch:
+    def test_hierarchy_equals_flat_fold(self):
+        rng = np.random.default_rng(11)
+        tree = FleetTree.build(MeanMetric(), (2, 2), deadline_s=1.0, retry=FAST_RETRY)
+        golden = MeanMetric()
+        for epoch in range(4):
+            for leaf in tree.leaves:
+                for _ in range(3):
+                    v = float(rng.uniform())
+                    leaf.update(v)
+                    golden.update(v)
+            rollup = tree.run_epoch(epoch)
+            assert not rollup.partial
+        tree.join_pending(timeout=5.0)
+        assert len(tree.root.folded_sources) == 4 * 4
+        np.testing.assert_allclose(
+            np.asarray(tree.root.metric.compute()),
+            np.asarray(golden.compute()),
+            rtol=1e-5,
+        )
+
+    def test_skip_degrades_only_that_region(self):
+        tree = FleetTree.build(MeanMetric(), (2, 2), deadline_s=0.05, retry=FAST_RETRY)
+        for leaf in tree.leaves:
+            leaf.update(1.0)
+        rollup = tree.run_epoch(0, skip=("edge-00-00",))
+        tree.join_pending(timeout=5.0)
+        assert not rollup.partial  # the root still hears from both regions
+        region = tree.nodes["region-00"].last_rollup
+        assert region.partial and region.missing == ("edge-00-00",)
+        other = tree.nodes["region-01"].last_rollup
+        assert not other.partial
+
+    def test_metric_collection_merges_member_wise(self):
+        # the collection-level fold seam the fleet tier leans on
+        golden = MetricCollection({"mean": MeanMetric(), "mse": MeanSquaredError()})
+        a = MetricCollection({"mean": MeanMetric(), "mse": MeanSquaredError()})
+        rng = np.random.default_rng(3)
+        for _ in range(4):
+            p, t = rng.normal(size=8).astype(np.float32), rng.normal(size=8).astype(np.float32)
+            a.update(p, t)
+            golden.update(p, t)
+        b = MetricCollection({"mean": MeanMetric(), "mse": MeanSquaredError()})
+        for _ in range(2):
+            p, t = rng.normal(size=8).astype(np.float32), rng.normal(size=8).astype(np.float32)
+            b.update(p, t)
+            golden.update(p, t)
+        a.merge_state(b)
+        for key, val in a.compute().items():
+            np.testing.assert_allclose(
+                np.asarray(val), np.asarray(golden.compute()[key]), rtol=1e-5
+            )

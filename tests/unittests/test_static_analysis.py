@@ -399,3 +399,28 @@ def test_serving_modules_scan_clean():
     threads = [t for t in server_mod["threads"] if t["scope"] == "MetricServer.start"]
     assert threads, server_mod
     assert threads[0]["daemon"] is False and threads[0]["joined"] is True, threads
+
+
+def test_fleet_modules_scan_clean():
+    """ISSUE-20 acceptance: the hierarchical fleet-aggregation package is
+    clean under the FULL R1-R11 rule set with ZERO baseline additions — no
+    entry in the checked-in baseline may reference it, and a fresh scan must
+    find nothing new (the pending-delta/ledger state is guarded by one
+    publish lock, the KV store serializes under its condition variable, and
+    async publish threads are attributably joined)."""
+    result, _ = _scan()
+    findings = [v for v in result.violations if v.path.startswith("torchmetrics_tpu/_fleet/")]
+    assert not findings, [v.render() for v in findings]
+    baseline = load_baseline(BASELINE)
+    leaked = [e for e in baseline.values() if e.path.startswith("torchmetrics_tpu/_fleet/")]
+    assert not leaked, f"baseline entries must never cover the ISSUE-20 modules: {leaked}"
+    # guard-map manifest: the runtime-scoped pass covers the package, and
+    # the fencing/pending state all carries guarded verdicts
+    modules = json.loads(THREAD_SAFETY_PATH.read_text(encoding="utf-8"))["modules"]
+    node_mod = modules["torchmetrics_tpu/_fleet/node.py"]
+    assert node_mod["verdict"] == "guarded", node_mod["verdict"]
+    fields = node_mod["classes"]["AggregationNode"]["fields"]
+    for field in ("_ledger", "_pending_sources", "_pending_epochs", "publish_failures"):
+        assert fields[field]["guards"] == ["_pub_lock"], (field, fields[field])
+    transport_mod = modules["torchmetrics_tpu/_fleet/transport.py"]
+    assert transport_mod["verdict"] == "guarded", transport_mod["verdict"]

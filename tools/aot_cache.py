@@ -5,6 +5,8 @@ Usage:
     python tools/aot_cache.py list   [--dir DIR] [--json]
     python tools/aot_cache.py verify [--dir DIR] [--json]
     python tools/aot_cache.py evict  [--dir DIR] [--stale] [--kind KIND] [--yes]
+    python tools/aot_cache.py pack   [--dir DIR] --out BUNDLE.tar.gz
+    python tools/aot_cache.py unpack [--dir DIR] --bundle BUNDLE.tar.gz [--force]
 
 ``--dir`` defaults to ``$TM_TPU_AOT_CACHE``. ``list`` prints every artifact
 with its kind, owning executable, format, size, and whether its backend
@@ -13,6 +15,13 @@ magic/header/payload-checksum integrity and exits 1 when any artifact is
 corrupt or stale (CI-friendly). ``evict`` deletes artifacts — all of them,
 one ``--kind``, or ``--stale`` only (fingerprint-mismatched + corrupt);
 ``--yes`` skips the confirmation prompt.
+
+``pack`` bundles the whole artifact store into one gzip tarball carrying a
+``MANIFEST.json`` with a per-file sha256 — the unit you copy between hosts
+or park in a release bucket. ``unpack`` installs a bundle into a cache
+directory, verifying every member against the manifest BEFORE anything is
+written into place: a corrupt/truncated/tampered bundle is refused whole
+(exit 1, target untouched). ``--force`` overwrites same-named artifacts.
 """
 
 from __future__ import annotations
@@ -109,18 +118,134 @@ def cmd_evict(directory: str, stale: bool, kind, assume_yes: bool) -> int:
     return 0
 
 
+BUNDLE_MANIFEST = "MANIFEST.json"
+BUNDLE_VERSION = 1
+
+
+def _sha256_file(path: Path) -> str:
+    import hashlib
+
+    digest = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            digest.update(chunk)
+    return digest.hexdigest()
+
+
+def cmd_pack(directory: str, out: str) -> int:
+    import tarfile
+
+    src = Path(directory)
+    artifacts = sorted(src.glob("*.aot"))
+    if not artifacts:
+        print(f"{directory}: no artifacts to pack", file=sys.stderr)
+        return 1
+    manifest = {
+        "version": BUNDLE_VERSION,
+        "artifacts": {p.name: {"sha256": _sha256_file(p), "bytes": p.stat().st_size} for p in artifacts},
+    }
+    out_path = Path(out)
+    tmp = out_path.with_suffix(out_path.suffix + ".tmp")
+    try:
+        with tarfile.open(tmp, "w:gz") as tar:
+            manifest_bytes = json.dumps(manifest, indent=1, sort_keys=True).encode()
+            info = tarfile.TarInfo(BUNDLE_MANIFEST)
+            info.size = len(manifest_bytes)
+            import io
+
+            tar.addfile(info, io.BytesIO(manifest_bytes))
+            for p in artifacts:
+                tar.add(p, arcname=p.name)
+        os.replace(tmp, out_path)
+    finally:
+        if tmp.exists():
+            tmp.unlink()
+    total = sum(e["bytes"] for e in manifest["artifacts"].values())
+    print(f"packed {len(artifacts)} artifact(s) ({total} bytes) -> {out_path}")
+    return 0
+
+
+def cmd_unpack(directory: str, bundle: str, force: bool) -> int:
+    """Verify-then-install: nothing lands in ``directory`` unless the whole
+    bundle checks out (manifest present, every member named, every checksum
+    matching, no member reaching outside the target directory)."""
+    import hashlib
+    import tarfile
+
+    dest = Path(directory)
+    try:
+        with tarfile.open(bundle, "r:gz") as tar:
+            members = {m.name: m for m in tar.getmembers()}
+            meta = members.get(BUNDLE_MANIFEST)
+            if meta is None:
+                print(f"refusing {bundle}: no {BUNDLE_MANIFEST} in bundle", file=sys.stderr)
+                return 1
+            fh = tar.extractfile(meta)
+            manifest = json.loads(fh.read()) if fh is not None else None
+            if not isinstance(manifest, dict) or manifest.get("version") != BUNDLE_VERSION:
+                print(f"refusing {bundle}: unknown bundle version", file=sys.stderr)
+                return 1
+            listed = manifest.get("artifacts", {})
+            payloads = {}
+            for name, m in members.items():
+                if name == BUNDLE_MANIFEST:
+                    continue
+                # path-traversal guard: members are flat basenames, nothing else
+                if not m.isfile() or "/" in name or "\\" in name or name.startswith(".."):
+                    print(f"refusing {bundle}: suspicious member {name!r}", file=sys.stderr)
+                    return 1
+                if name not in listed:
+                    print(f"refusing {bundle}: member {name!r} not in manifest", file=sys.stderr)
+                    return 1
+                data = tar.extractfile(m).read()
+                if hashlib.sha256(data).hexdigest() != listed[name]["sha256"]:
+                    print(f"refusing {bundle}: checksum mismatch for {name!r}", file=sys.stderr)
+                    return 1
+                payloads[name] = data
+            missing = sorted(set(listed) - set(payloads))
+            if missing:
+                print(f"refusing {bundle}: manifest lists absent member(s) {missing}", file=sys.stderr)
+                return 1
+    except (tarfile.TarError, OSError, ValueError, json.JSONDecodeError) as err:
+        print(f"refusing {bundle}: unreadable bundle ({err})", file=sys.stderr)
+        return 1
+    if not payloads:
+        print(f"refusing {bundle}: empty bundle", file=sys.stderr)
+        return 1
+    clobbered = [n for n in payloads if (dest / n).exists()]
+    if clobbered and not force:
+        print(
+            f"refusing to overwrite {len(clobbered)} existing artifact(s) (pass --force): "
+            + ", ".join(clobbered[:5]),
+            file=sys.stderr,
+        )
+        return 1
+    dest.mkdir(parents=True, exist_ok=True)
+    for name, data in sorted(payloads.items()):
+        tmp = dest / (name + ".tmp")
+        tmp.write_bytes(data)
+        os.replace(tmp, dest / name)
+    print(f"installed {len(payloads)} artifact(s) into {dest}")
+    return 0
+
+
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
     sub = parser.add_subparsers(dest="command", required=True)
-    for name in ("list", "verify", "evict"):
+    for name in ("list", "verify", "evict", "pack", "unpack"):
         p = sub.add_parser(name)
         p.add_argument("--dir", default=os.environ.get("TM_TPU_AOT_CACHE", ""), help="cache directory")
         if name in ("list", "verify"):
             p.add_argument("--json", action="store_true")
-        else:
+        elif name == "evict":
             p.add_argument("--stale", action="store_true", help="only fingerprint-stale/corrupt artifacts")
             p.add_argument("--kind", default=None, help="only artifacts of this executable kind")
             p.add_argument("--yes", action="store_true", help="skip the confirmation prompt")
+        elif name == "pack":
+            p.add_argument("--out", required=True, help="bundle tarball to write")
+        else:
+            p.add_argument("--bundle", required=True, help="bundle tarball to install")
+            p.add_argument("--force", action="store_true", help="overwrite same-named artifacts")
     args = parser.parse_args(argv)
     if not args.dir:
         print("no cache directory: pass --dir or set TM_TPU_AOT_CACHE", file=sys.stderr)
@@ -129,6 +254,10 @@ def main(argv=None) -> int:
         return cmd_list(args.dir, args.json)
     if args.command == "verify":
         return cmd_verify(args.dir, args.json)
+    if args.command == "pack":
+        return cmd_pack(args.dir, args.out)
+    if args.command == "unpack":
+        return cmd_unpack(args.dir, args.bundle, args.force)
     return cmd_evict(args.dir, args.stale, args.kind, args.yes)
 
 

@@ -65,6 +65,7 @@ THREAD_SAFETY_VERSION = 1
 # threads/locks/shared markers exist
 _RUNTIME_PREFIXES = (
     "torchmetrics_tpu/_aot/",
+    "torchmetrics_tpu/_fleet/",
     "torchmetrics_tpu/_observability/",
     "torchmetrics_tpu/_resilience/",
     "torchmetrics_tpu/_serving/",

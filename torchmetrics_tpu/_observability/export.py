@@ -132,6 +132,24 @@ _HELP: Dict[str, str] = {
     ),
     "profile_compile_seconds": "Trace+lower+compile wall seconds per executable digest.",
     "aot_cache": "AOT executable cache load outcomes.",
+    "fleet_rollups": (
+        "Fleet aggregation-tree rollups completed per region, by outcome"
+        " (full/partial; bounded region= label dimension)."
+    ),
+    "fleet_contributions": "Child contributions folded by fleet rollups per region.",
+    "fleet_late_arrivals": (
+        "Straggler contributions folded after their epoch's deadline per region."
+    ),
+    "fleet_duplicates_dropped": (
+        "Redelivered/zombie contributions dropped by the epoch fence per region."
+    ),
+    "fleet_corrupt_quarantined": (
+        "Contributions quarantined by integrity verification at fold time per region."
+    ),
+    "fleet_publish_attempts": "Guarded fleet publish attempts (includes retries) per region.",
+    "fleet_rollup_staleness_ms": (
+        "Age of the oldest contribution folded by the latest rollup per region."
+    ),
 }
 
 # Every family the exporters may emit: sample kind + complete allowed label
@@ -199,6 +217,13 @@ EXPORT_SCHEMA: Dict[str, Dict[str, Any]] = {
     "serving_batch_target": {"kind": "gauge", "labels": ("metric",)},
     "serving_ingest_burn": {"kind": "gauge", "labels": ("metric",)},
     "serving_queue_depth": {"kind": "gauge", "labels": ("metric",)},
+    "fleet_rollups": {"kind": "counter", "labels": ("metric", "region", "outcome")},
+    "fleet_contributions": {"kind": "counter", "labels": ("metric", "region")},
+    "fleet_late_arrivals": {"kind": "counter", "labels": ("metric", "region")},
+    "fleet_duplicates_dropped": {"kind": "counter", "labels": ("metric", "region")},
+    "fleet_corrupt_quarantined": {"kind": "counter", "labels": ("metric", "region")},
+    "fleet_publish_attempts": {"kind": "counter", "labels": ("metric", "region")},
+    "fleet_rollup_staleness_ms": {"kind": "gauge", "labels": ("metric", "region")},
 }
 
 # reservoir quantiles exported as summary lines (satellite: p50/p90/p99 per op)

@@ -102,6 +102,9 @@ _SEAM_FOR_DEGRADATION = {
     "spmd_degraded": "spmd.step",
     "snapshot_restore": "snapshot.restore",
     "snapshot_degraded": "snapshot.write",
+    "fleet_partial": "fleet.rollup",
+    "fleet_corrupt": "fleet.fold",
+    "fleet_publish_degraded": "fleet.publish",
 }
 
 

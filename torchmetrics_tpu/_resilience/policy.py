@@ -170,9 +170,14 @@ class DegradationEvent:
     (a batch's state contribution was rolled back by the NaN sentinel),
     ``"state_repair"`` (``load_state_dict(strict="repair")`` reset corrupted
     states), ``"snapshot_degraded"`` (the attached SnapshotManager hit an
-    IO error and disabled itself), or ``"snapshot_restore"``
+    IO error and disabled itself), ``"snapshot_restore"``
     (``restore_latest`` fell back past a corrupted generation or a
-    truncated journal).
+    truncated journal), ``"fleet_partial"`` (a fleet rollup's fan-in
+    deadline expired with children missing — partial rollup, stragglers
+    fold late), ``"fleet_corrupt"`` (a fleet contribution failed
+    integrity verification at fold time and was quarantined), or
+    ``"fleet_publish_degraded"`` (a fleet publish exhausted its retries;
+    the delta was retained to ride the next epoch).
     """
 
     kind: str

@@ -391,6 +391,35 @@ class MetricCollection:
         # mid-stream manual load: anchor the un-journalable transition
         self._journal_record("external", (), {})
 
+    def merge_state(self, incoming: "MetricCollection") -> None:
+        """Merge another collection's state member-wise (fleet rollup seam).
+
+        Both collections must hold the same member names with the same
+        metric types; every member merge uses ``Metric.merge_state`` (the
+        declared per-state reductions), so a collection folds across hosts
+        exactly like its members would individually. Validation runs before
+        any member merges — a mismatch leaves this collection untouched.
+        """
+        from torchmetrics_tpu.utilities.exceptions import TorchMetricsUserError
+
+        if not isinstance(incoming, MetricCollection):
+            raise TorchMetricsUserError(
+                f"MetricCollection.merge_state needs a MetricCollection, got {type(incoming).__name__}"
+            )
+        if set(incoming._modules) != set(self._modules):
+            missing = sorted(set(self._modules) ^ set(incoming._modules))
+            raise TorchMetricsUserError(
+                f"Cannot merge MetricCollections with different members (mismatched: {missing})"
+            )
+        for name, m in self._modules.items():
+            other = incoming._modules[name]
+            if type(other) is not type(m):
+                raise TorchMetricsUserError(
+                    f"Cannot merge member {name!r}: {type(other).__name__} into {type(m).__name__}"
+                )
+        for name, m in self._modules.items():
+            m.merge_state(incoming._modules[name])
+
     # ------------------------------------------------------------- resilience
     def set_resilience_policy(self, **kwargs: Any) -> "MetricCollection":
         """Fan a resilience-policy change out to every member metric.

@@ -2178,6 +2178,12 @@ class Metric(ABC):
 
         TPU-native first-class API: the same declared per-state reductions used
         by forward accumulation and distributed sync.
+
+        A raw state dict that carries an integrity block (saved with
+        ``state_dict(integrity=True)``) is verified before anything merges —
+        checksum mismatches or NaN-poisoned payloads raise
+        :class:`~torchmetrics_tpu._resilience.errors.StateCorruptionError`
+        instead of silently folding a corrupt contribution into this metric.
         """
         if isinstance(incoming, Metric):
             if type(incoming) is not type(self):
@@ -2187,6 +2193,17 @@ class Metric(ABC):
             incoming_state = incoming.metric_state
             incoming_count = incoming._update_count
         else:
+            from torchmetrics_tpu._resilience import integrity as _integrity
+
+            meta = incoming.get(_integrity.integrity_key(""))
+            if meta is not None:
+                # the dict announced verifiability: honoring the block is not
+                # optional, or a bit-flipped payload merges as clean data
+                corrupted = _integrity.verify_states(
+                    incoming, "", meta, type(self).__name__, include_missing=True
+                )
+                if corrupted:
+                    _integrity.raise_corrupted(type(self).__name__, corrupted)
             incoming_state = incoming
             incoming_count = 1
         self._merge_from(incoming_state, incoming_count)

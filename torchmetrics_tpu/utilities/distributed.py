@@ -16,6 +16,7 @@ on JAX collectives instead of ``torch.distributed``:
 
 from __future__ import annotations
 
+import time
 from typing import Any, Callable, Dict, List, Optional, Sequence, Union
 
 import jax
@@ -79,6 +80,95 @@ def class_reduce(
 
 
 # ---------------------------------------------------------------------------
+# Coordination-service KV namespace + TTL hygiene
+# ---------------------------------------------------------------------------
+
+# every key this library writes into the coordination service's KV store
+# lives under one namespace, so a shared coordinator (multi-job clusters,
+# the fleet aggregation tier) can attribute — and bulk-expire — our keys
+# without ever touching another tenant's
+KV_NAMESPACE = "tm_tpu"
+
+
+def kv_key(*parts: Any, namespace: str = KV_NAMESPACE) -> str:
+    """Build one namespaced coordination-service KV key.
+
+    Parts are joined with ``/`` under the library namespace; a part that
+    itself contains ``/`` (or is empty) is rejected — it would silently
+    change the key's depth and break prefix scans (the fleet tier's
+    contribution sweep and the TTL janitor both walk keys by prefix).
+    """
+    if not parts:
+        raise ValueError("kv_key needs at least one part")
+    rendered = []
+    for part in parts:
+        text = str(part)
+        if not text or "/" in text:
+            raise ValueError(f"kv_key part {part!r} must be non-empty and free of '/'")
+        rendered.append(text)
+    return "/".join([namespace, *rendered])
+
+
+class KvTtlJanitor:  # concurrency: shared fleet publishers note() while epoch sweeps expire
+    """Bounded TTL ledger for KV keys this process published.
+
+    The coordination service retains a key until someone deletes it, so a
+    long-running stream that publishes per-epoch keys (the fleet
+    aggregation tier, the allgather fallback) must garbage-collect its own
+    writes or grow the coordinator's memory without bound. Writers
+    :meth:`note` every key they publish; a periodic :meth:`sweep` deletes
+    the ones older than ``ttl_s`` through the caller's delete function —
+    consumed keys are :meth:`forget`-ed at fold time, so the janitor only
+    ever touches keys nobody claimed (dead publishers, orphaned epochs).
+    """
+
+    def __init__(self, ttl_s: float = 300.0) -> None:
+        if ttl_s <= 0:
+            raise ValueError(f"`ttl_s` must be positive, got {ttl_s}")
+        self.ttl_s = float(ttl_s)
+        import threading
+
+        self._lock = threading.Lock()
+        self._born: Dict[str, float] = {}
+
+    def note(self, key: str, now: Optional[float] = None) -> None:
+        """Record (or refresh) one published key's birth time."""
+        ts = time.monotonic() if now is None else float(now)
+        with self._lock:
+            self._born[key] = ts
+
+    def forget(self, key: str) -> None:
+        """Drop a key from the ledger (it was consumed and deleted by a reader)."""
+        with self._lock:
+            self._born.pop(key, None)
+
+    def pending(self) -> int:
+        with self._lock:
+            return len(self._born)
+
+    def sweep(
+        self, delete: Callable[[str], Any], now: Optional[float] = None
+    ) -> List[str]:
+        """Delete every tracked key older than the TTL; return the reaped keys.
+
+        Delete failures (key already consumed by a reader, coordinator
+        restart) drop the key from the ledger anyway — the janitor's job is
+        bounding coordinator memory, not guaranteeing deletion receipts.
+        """
+        ts = time.monotonic() if now is None else float(now)
+        with self._lock:
+            expired = [k for k, born in self._born.items() if ts - born >= self.ttl_s]
+            for key in expired:
+                del self._born[key]
+        for key in expired:
+            try:
+                delete(key)
+            except Exception:  # noqa: BLE001 - best-effort hygiene, never a fault
+                pass
+        return expired
+
+
+# ---------------------------------------------------------------------------
 # Eager multi-process gather (DCN / multi-host)
 # ---------------------------------------------------------------------------
 
@@ -134,7 +224,7 @@ def _kv_allgather_leaf(x: Any) -> Any:
     seq = _kv_seq
     _kv_seq += 1
     pid, nproc = jax.process_index(), jax.process_count()
-    base = f"tm_tpu/allgather/{seq}"
+    base = kv_key("allgather", seq)
     buf = io.BytesIO()
     np.save(buf, np.asarray(x), allow_pickle=False)
     client.key_value_set_bytes(f"{base}/{pid}", buf.getvalue())
